@@ -1,0 +1,222 @@
+"""Config system — the ``spark.shuffle.tpu.*`` key surface.
+
+TPU-native analog of the reference's ``UcxShuffleConf``
+(ref: src/main/scala/org/apache/spark/shuffle/UcxShuffleConf.scala:17-90),
+which extends SparkConf with the ``spark.shuffle.ucx.*`` namespace. We keep
+the same *shape* of surface — a typed view over a flat string key/value map,
+byte-size parsing, warm-up maps — but the keys describe TPU resources
+(host staging arenas, mesh axes, collective implementation) instead of UCX
+registration parameters.
+
+Key table (reference key -> ours):
+
+    spark.shuffle.ucx.driver.host/port      -> spark.shuffle.tpu.coordinator.address
+                                               (jax.distributed rendezvous)
+    spark.shuffle.ucx.rkeySize (x2 = 300B)  -> spark.shuffle.tpu.meta.recordSize
+                                               (segment-table slot, bytes)
+    spark.shuffle.ucx.rpc.metadata.bufferSize -> spark.shuffle.tpu.meta.bufferSize
+    spark.shuffle.ucx.memory.preAllocateBuffers -> spark.shuffle.tpu.memory.preAllocateBuffers
+    spark.shuffle.ucx.memory.minBufferSize  -> spark.shuffle.tpu.memory.minBufferSize
+    spark.shuffle.ucx.memory.minAllocationSize -> spark.shuffle.tpu.memory.minAllocationSize
+    spark.shuffle.ucx.memory.useOdp         -> spark.shuffle.tpu.memory.pinned
+    (new, TPU-only)                            spark.shuffle.tpu.mesh.*, .a2a.impl,
+                                               .a2a.capacityFactor, .dcn.*
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterator, Mapping, Optional, Tuple
+
+_SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kKmMgGtT]?)i?[bB]?\s*$")
+_SIZE_MULT = {"": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_bytes(text: str | int) -> int:
+    """Parse '4m', '1k', '300', '2GiB' into a byte count.
+
+    Mirrors SparkConf.getSizeAsBytes used throughout the reference conf
+    (ref: UcxShuffleConf.scala:36-49)."""
+    if isinstance(text, int):
+        return text
+    m = _SIZE_RE.match(str(text))
+    if not m:
+        raise ValueError(f"cannot parse byte size: {text!r}")
+    value, unit = m.groups()
+    return int(float(value) * _SIZE_MULT[unit.lower()])
+
+
+PREFIX = "spark.shuffle.tpu."
+
+
+def _norm(key: str) -> str:
+    """Case/punctuation-insensitive key form, so SPARKUCX_TPU_MIN_BUFFER_SIZE,
+    'memory.minBufferSize' and 'memory.minbuffersize' all collide."""
+    return key.lower().replace(".", "").replace("_", "")
+
+
+class TpuShuffleConf:
+    """Typed view over a flat ``spark.shuffle.tpu.*`` key/value map.
+
+    Construction accepts any mapping (e.g. a SparkConf dump, a dict of CLI
+    overrides) plus ``SPARKUCX_TPU_*`` environment variables
+    (``SPARKUCX_TPU_A2A_IMPL=dense`` -> ``spark.shuffle.tpu.a2a.impl=dense``).
+    """
+
+    def __init__(self, conf: Optional[Mapping[str, str]] = None, use_env: bool = True):
+        self._conf: Dict[str, str] = {}
+        self._index: Dict[str, str] = {}  # _norm(key) -> key, explicit conf wins
+        if conf:
+            for k, v in conf.items():
+                self._conf[str(k)] = str(v)
+                self._index[_norm(str(k))] = str(k)
+        if use_env:
+            for k, v in os.environ.items():
+                if k.startswith("SPARKUCX_TPU_"):
+                    key = PREFIX + k[len("SPARKUCX_TPU_"):].lower().replace("_", ".")
+                    if _norm(key) not in self._index:
+                        self._conf[key] = v
+                        self._index[_norm(key)] = key
+
+    # -- raw access -------------------------------------------------------
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._conf.get(key, default)
+
+    def set(self, key: str, value) -> "TpuShuffleConf":
+        self._conf[key] = str(value)
+        self._index[_norm(key)] = key
+        return self
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._conf
+
+    def items(self) -> Iterator[Tuple[str, str]]:
+        return iter(sorted(self._conf.items()))
+
+    # -- typed getters ----------------------------------------------------
+    def _get(self, short: str, default) -> str:
+        full = PREFIX + short
+        if full in self._conf:
+            return self._conf[full]
+        hit = self._index.get(_norm(full))
+        if hit is not None:
+            return self._conf[hit]
+        return str(default)
+
+    def get_int(self, short: str, default: int) -> int:
+        return int(self._get(short, default))
+
+    def get_bool(self, short: str, default: bool) -> bool:
+        return str(self._get(short, default)).strip().lower() in ("1", "true", "yes", "on")
+
+    def get_bytes(self, short: str, default) -> int:
+        return parse_bytes(self._get(short, default))
+
+    # -- the key surface --------------------------------------------------
+    @property
+    def coordinator_address(self) -> str:
+        """Rendezvous address for jax.distributed / multi-host bootstrap.
+
+        Analog of the driver sockaddr the reference listens on
+        (ref: UcxShuffleConf.scala:25-28, UcxNode.java:98-104)."""
+        return self._get("coordinator.address", "localhost:55443")
+
+    @property
+    def meta_record_size(self) -> int:
+        """Fixed size of one serialized map-output metadata record.
+
+        Analog of the 300-byte (2 x rkeySize) driver-table slot
+        (ref: UcxShuffleConf.scala:32-40, UcxWorkerWrapper.scala:29-32)."""
+        return self.get_bytes("meta.recordSize", 304)
+
+    @property
+    def meta_buffer_size(self) -> int:
+        """Bootstrap/metadata message buffer size
+        (ref: UcxShuffleConf.scala:42-49, default 4k)."""
+        return self.get_bytes("meta.bufferSize", "4k")
+
+    @property
+    def min_buffer_size(self) -> int:
+        """Size-class floor for the host arena
+        (ref: UcxShuffleConf.scala:66-72, default 1k)."""
+        return self.get_bytes("memory.minBufferSize", "1k")
+
+    @property
+    def min_allocation_size(self) -> int:
+        """Minimum slab carved from the OS, shared by small size classes
+        (ref: UcxShuffleConf.scala:74-81, default 4m)."""
+        return self.get_bytes("memory.minAllocationSize", "4m")
+
+    @property
+    def pre_allocate_buffers(self) -> Dict[int, int]:
+        """Warm-up map 'size:count,size:count' parsed to {bytes: count}
+        (ref: UcxShuffleConf.scala:52-64, MemoryPool.java:170-177)."""
+        spec = self._get("memory.preAllocateBuffers", "")
+        out: Dict[int, int] = {}
+        if spec:
+            for part in spec.split(","):
+                try:
+                    size, count = part.split(":")
+                    out[parse_bytes(size)] = int(count)
+                except ValueError as e:
+                    raise ValueError(
+                        f"preAllocateBuffers entry {part!r} is not 'size:count'"
+                    ) from e
+        return out
+
+    @property
+    def pinned_memory(self) -> bool:
+        """Whether host staging arenas should request pinned pages.
+
+        Plays the role the ODP toggle plays for registration strategy
+        (ref: UcxShuffleConf.scala:89)."""
+        return self.get_bool("memory.pinned", True)
+
+    # -- TPU-only keys ----------------------------------------------------
+    @property
+    def a2a_impl(self) -> str:
+        """Collective implementation: auto | native | dense | gather.
+
+        native = jax.lax.ragged_all_to_all (TPU ICI); dense = padded
+        all_to_all (portable); gather = all_gather oracle (tests)."""
+        return self._get("a2a.impl", "auto")
+
+    @property
+    def capacity_factor(self) -> float:
+        """Output-buffer headroom multiplier over perfectly-balanced size.
+
+        The static-shape answer to ragged skew (SURVEY.md §7 hard part (a))."""
+        return float(self._get("a2a.capacityFactor", 2.0))
+
+    @property
+    def mesh_ici_axis(self) -> str:
+        return self._get("mesh.iciAxis", "shuffle")
+
+    @property
+    def mesh_dcn_axis(self) -> str:
+        return self._get("mesh.dcnAxis", "dcn")
+
+    @property
+    def num_slices(self) -> int:
+        """Number of TPU slices (DCN-connected). 1 = single slice."""
+        return self.get_int("mesh.numSlices", 1)
+
+    @property
+    def num_processes(self) -> int:
+        """Processes in the cluster (ref: UcxShuffleConf.scala:20-21)."""
+        return self.get_int("numProcesses", 1)
+
+    @property
+    def cores_per_process(self) -> int:
+        """(ref: UcxShuffleConf.scala:22-23)."""
+        return self.get_int("coresPerProcess", 1)
+
+    @property
+    def connection_timeout_ms(self) -> int:
+        """Peer/metadata wait timeout (ref: UcxWorkerWrapper.scala:133-140,
+        spark.network.timeout)."""
+        return self.get_int("network.timeoutMs", 120_000)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"TpuShuffleConf({dict(self.items())})"

@@ -1,0 +1,49 @@
+"""Test fixture: run the whole suite on a virtual 8-device CPU mesh.
+
+This is the fake-backend strategy SURVEY.md §4 calls for: JAX CPU devices
+play the role UCX's TCP/shm transports play for the reference's RDMA path
+(ref: buildlib/test.sh:25-31 runs multi-process single-host). The axon
+sitecustomize force-registers the TPU plugin at interpreter start, so we
+flip the platform back to CPU via jax.config before any test touches a
+device — this works because backends are created lazily."""
+
+import os
+import re
+
+os.environ.setdefault("SPARKUCX_TPU_LOG", "WARNING")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" in _flags:
+    _flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "--xla_force_host_platform_device_count=8",
+        _flags,
+    )
+else:
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["XLA_FLAGS"] = _flags
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices), ("shuffle",))
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(0)

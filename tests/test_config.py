@@ -1,0 +1,68 @@
+import os
+
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf, parse_bytes
+
+
+def test_parse_bytes():
+    assert parse_bytes("300") == 300
+    assert parse_bytes("1k") == 1024
+    assert parse_bytes("4m") == 4 * 1024 * 1024
+    assert parse_bytes("2GiB") == 2 << 30
+    assert parse_bytes("1.5k") == 1536
+    assert parse_bytes(77) == 77
+    with pytest.raises(ValueError):
+        parse_bytes("lots")
+
+
+def test_defaults():
+    conf = TpuShuffleConf(use_env=False)
+    assert conf.coordinator_address == "localhost:55443"
+    assert conf.meta_record_size == 304
+    assert conf.meta_buffer_size == 4096
+    assert conf.min_buffer_size == 1024
+    assert conf.min_allocation_size == 4 * 1024 * 1024
+    assert conf.pre_allocate_buffers == {}
+    assert conf.a2a_impl == "auto"
+    assert conf.capacity_factor == 2.0
+    assert conf.num_slices == 1
+    assert conf.pinned_memory is True
+
+
+def test_overrides_and_prealloc_map():
+    conf = TpuShuffleConf(
+        {
+            "spark.shuffle.tpu.memory.preAllocateBuffers": "1k:16,4m:4",
+            "spark.shuffle.tpu.a2a.impl": "dense",
+            "spark.shuffle.tpu.a2a.capacityFactor": "1.25",
+        },
+        use_env=False,
+    )
+    assert conf.pre_allocate_buffers == {1024: 16, 4 * 1024 * 1024: 4}
+    assert conf.a2a_impl == "dense"
+    assert conf.capacity_factor == 1.25
+
+
+def test_env_ingestion(monkeypatch):
+    monkeypatch.setenv("SPARKUCX_TPU_A2A_IMPL", "gather")
+    conf = TpuShuffleConf()
+    assert conf.a2a_impl == "gather"
+    # explicit conf beats env
+    conf2 = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "native"})
+    assert conf2.a2a_impl == "native"
+
+
+def test_set_and_items():
+    conf = TpuShuffleConf(use_env=False)
+    conf.set("spark.shuffle.tpu.mesh.numSlices", 2)
+    assert conf.num_slices == 2
+    assert ("spark.shuffle.tpu.mesh.numSlices", "2") in list(conf.items())
+
+
+def test_env_camelcase_key(monkeypatch):
+    # SPARKUCX_TPU_A2A_CAPACITYFACTOR must reach the camelCase key
+    monkeypatch.setenv("SPARKUCX_TPU_A2A_CAPACITYFACTOR", "1.25")
+    assert TpuShuffleConf().capacity_factor == 1.25
+    monkeypatch.setenv("SPARKUCX_TPU_MEMORY_MIN_BUFFER_SIZE", "2k")
+    assert TpuShuffleConf().min_buffer_size == 2048
