@@ -1,0 +1,214 @@
+// Host staging arena — native core of the memory layer.
+//
+// TPU-native re-design of the reference's registered-memory machinery:
+//  * MemoryPool.java:23-177 — size-class pool of UCX-registered buffers so no
+//    registration happens on the hot path. Here the expensive resource is
+//    page-locked (mlock'd) host memory that jax.device_put / DLPack can DMA
+//    from without a bounce copy; same size-class + slab-carving design:
+//    power-of-two classes with a floor, small classes carved out of one big
+//    slab that shares a single lock/registration.
+//  * RegisteredMemory.java:17-42 — refcounted slices; many slices share one
+//    slab, a slice returns to its free list when its refcount hits zero.
+//  * UnsafeUtils.java:19-65 — mmap/munmap of shuffle files beyond 2 GB.
+//
+// C ABI only (loaded via ctypes; pybind11 is not in the image).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Block {
+  uint32_t cls;                  // size-class index
+  std::atomic<int32_t> refs{0};  // live references (RegisteredMemory analog)
+};
+
+struct SizeClass {
+  uint64_t block_size = 0;
+  std::deque<void*> free_list;   // AllocatorStack analog (MemoryPool.java:41-45)
+  uint64_t total_alloc = 0;      // blocks ever carved
+  uint64_t total_requests = 0;
+};
+
+class Arena {
+ public:
+  Arena(uint64_t min_block, uint64_t slab_size, bool pinned)
+      : min_block_(round_pow2(min_block ? min_block : 1024)),
+        slab_size_(slab_size ? slab_size : (4u << 20)), pinned_(pinned) {}
+
+  ~Arena() {
+    for (auto& s : slabs_) {
+      if (pinned_) munlock(s.first, s.second);
+      free(s.first);
+    }
+  }
+
+  static uint64_t round_pow2(uint64_t v) {
+    uint64_t r = 1;
+    while (r < v) r <<= 1;
+    return r;
+  }
+
+  uint32_t class_of(uint64_t size) {
+    uint64_t b = round_pow2(size < min_block_ ? min_block_ : size);
+    uint32_t idx = 0;
+    for (uint64_t x = min_block_; x < b; x <<= 1) ++idx;
+    return idx;
+  }
+
+  void* get(uint64_t size) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint32_t cls = class_of(size);
+    ensure_class(cls);
+    SizeClass& sc = classes_[cls];
+    sc.total_requests++;
+    if (sc.free_list.empty()) carve(cls, 1);
+    if (sc.free_list.empty()) return nullptr;  // OOM
+    void* p = sc.free_list.back();
+    sc.free_list.pop_back();
+    Block& b = blocks_[p];
+    b.cls = cls;
+    b.refs.store(1, std::memory_order_relaxed);
+    in_use_++;
+    return p;
+  }
+
+  // Increment a live buffer's refcount (shared slices of one fetch buffer,
+  // OnBlocksFetchCallback.java:35 pattern).
+  int ref(void* p) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = blocks_.find(p);
+    if (it == blocks_.end()) return -1;
+    return it->second.refs.fetch_add(1, std::memory_order_relaxed) + 1;
+  }
+
+  // Decrement; on zero the block returns to its free list (put()).
+  int unref(void* p) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = blocks_.find(p);
+    if (it == blocks_.end()) return -1;
+    int32_t left = it->second.refs.fetch_sub(1, std::memory_order_acq_rel) - 1;
+    if (left < 0) {
+      std::fprintf(stderr, "sxt_arena: double free of %p\n", p);
+      it->second.refs.store(0, std::memory_order_relaxed);
+      return -1;
+    }
+    if (left == 0) {
+      classes_[it->second.cls].free_list.push_back(p);
+      in_use_--;
+    }
+    return left;
+  }
+
+  uint64_t block_size(void* p) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = blocks_.find(p);
+    if (it == blocks_.end()) return 0;
+    return classes_[it->second.cls].block_size;
+  }
+
+  // Warm-up pre-allocation (MemoryPool.preAlocate, MemoryPool.java:170-177).
+  void preallocate(uint64_t size, uint64_t count) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint32_t cls = class_of(size);
+    ensure_class(cls);
+    carve(cls, count);
+    pre_allocs_ += count;
+  }
+
+  void stats(uint64_t out[4]) {
+    std::lock_guard<std::mutex> g(mu_);
+    uint64_t req = 0, alloc = 0;
+    for (auto& sc : classes_) { req += sc.total_requests; alloc += sc.total_alloc; }
+    out[0] = req; out[1] = alloc; out[2] = pre_allocs_; out[3] = in_use_;
+  }
+
+ private:
+  void ensure_class(uint32_t cls) {
+    while (classes_.size() <= cls) {
+      SizeClass sc;
+      sc.block_size = min_block_ << classes_.size();
+      classes_.push_back(std::move(sc));
+    }
+  }
+
+  // Carve `count` blocks for class `cls` out of a fresh slab. Small classes
+  // share one slab_size_ slab (minRegistrationSize floor,
+  // MemoryPool.java:55-63); blocks >= slab_size_ get dedicated slabs.
+  void carve(uint32_t cls, uint64_t count) {
+    SizeClass& sc = classes_[cls];
+    uint64_t bs = sc.block_size;
+    uint64_t need = bs * count;
+    uint64_t slab_bytes = need < slab_size_ ? slab_size_ : need;
+    void* slab = nullptr;
+    if (posix_memalign(&slab, 4096, slab_bytes) != 0) return;
+    if (pinned_ && mlock(slab, slab_bytes) != 0) {
+      // Graceful degrade: unpinned staging still works, just slower DMA.
+      pinned_ok_ = false;
+    }
+    slabs_.emplace_back(slab, slab_bytes);
+    uint64_t nblocks = slab_bytes / bs;
+    char* base = static_cast<char*>(slab);
+    for (uint64_t i = 0; i < nblocks; ++i) {
+      void* p = base + i * bs;
+      blocks_[p];  // default Block
+      sc.free_list.push_back(p);
+    }
+    sc.total_alloc += nblocks;
+  }
+
+  uint64_t min_block_, slab_size_;
+  bool pinned_, pinned_ok_ = true;
+  std::mutex mu_;
+  std::vector<SizeClass> classes_;
+  std::unordered_map<void*, Block> blocks_;
+  std::vector<std::pair<void*, uint64_t>> slabs_;
+  uint64_t pre_allocs_ = 0, in_use_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sxt_arena_create(uint64_t min_block, uint64_t slab_size, int pinned) {
+  return new Arena(min_block, slab_size, pinned != 0);
+}
+void sxt_arena_destroy(void* a) { delete static_cast<Arena*>(a); }
+void* sxt_get(void* a, uint64_t size) { return static_cast<Arena*>(a)->get(size); }
+int sxt_ref(void* a, void* p) { return static_cast<Arena*>(a)->ref(p); }
+int sxt_unref(void* a, void* p) { return static_cast<Arena*>(a)->unref(p); }
+uint64_t sxt_block_size(void* a, void* p) { return static_cast<Arena*>(a)->block_size(p); }
+void sxt_preallocate(void* a, uint64_t size, uint64_t count) {
+  static_cast<Arena*>(a)->preallocate(size, count);
+}
+void sxt_stats(void* a, uint64_t* out4) { static_cast<Arena*>(a)->stats(out4); }
+
+// ---- mmap of spill/shuffle files (UnsafeUtils.java:48-65 analog) ----------
+
+void* sxt_mmap(const char* path, uint64_t* len_out, int writable) {
+  int fd = open(path, writable ? O_RDWR : O_RDONLY);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size == 0) { close(fd); return nullptr; }
+  void* p = mmap(nullptr, st.st_size, writable ? (PROT_READ | PROT_WRITE) : PROT_READ,
+                 MAP_SHARED, fd, 0);
+  close(fd);
+  if (p == MAP_FAILED) return nullptr;
+  *len_out = st.st_size;
+  return p;
+}
+
+int sxt_munmap(void* p, uint64_t len) { return munmap(p, len); }
+
+}  // extern "C"

@@ -1,0 +1,187 @@
+"""The shuffle data plane — ragged all-to-all over the device mesh.
+
+This is the TPU-native replacement for the reference's entire reduce-side
+fetch machinery. Where SparkUCX issues, per (mapper, reducer) pair, a
+two-phase chain of one-sided RDMA reads —
+
+  phase 1: ``ucp_get`` of the ``[start, end)`` offset pair from the remote
+           index file (ref: reducer/compat/spark_3_0/UcxShuffleClient.java:95-127)
+  phase 2: ``ucp_get`` of the data bytes at those offsets
+           (ref: OnOffsetsFetchCallback.java:78-91)
+
+— the TPU build batches the *whole* reduce side into one collective: every
+device contributes its destination-sorted send buffer plus a [P] size row,
+and a single ``ragged_all_to_all`` moves all segments over ICI with no
+per-block host round-trips. This preserves the reference's headline property
+("the mapper's CPU is never involved in serving a fetch") in its TPU form:
+no host code runs per block — the whole exchange is one XLA op on the wire.
+
+Three interchangeable implementations (conf key ``spark.shuffle.tpu.a2a.impl``):
+
+``native``  — ``jax.lax.ragged_all_to_all``. The real ICI path on TPU.
+``dense``   — pad each peer segment to a static per-peer capacity and use
+              ``jax.lax.all_to_all``, then recompact. Portable (XLA:CPU has
+              no ragged-all-to-all thunk); also the fallback shape when a
+              skew-bounded exchange compiles better.
+``gather``  — ``all_gather`` everything and slice locally. O(P·cap) memory;
+              the test oracle, and the DCN-friendly shape for tiny tables.
+
+All three share static shapes (SURVEY.md §7 hard part (a)): callers choose
+``out_capacity`` (and ``peer_capacity`` for dense) via the conf's
+``capacityFactor``; overflow is *reported*, never silently truncated.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from sparkucx_tpu.meta.segments import exchange_plan
+
+IMPLS = ("native", "dense", "gather")
+
+
+def select_impl(impl: str, backend: Optional[str] = None) -> str:
+    """Resolve 'auto' to the best implementation for the backend.
+
+    The reference's analog decision is UCX picking RDMA vs TCP vs shm
+    transports under the same API (ref: README.md:2-3)."""
+    if impl != "auto":
+        if impl not in IMPLS:
+            raise ValueError(f"unknown a2a impl {impl!r}; want one of {IMPLS}")
+        return impl
+    backend = backend or jax.default_backend()
+    return "native" if backend in ("tpu", "gpu") else "dense"
+
+
+@dataclass
+class ShuffleResult:
+    """Per-shard outcome of one exchange.
+
+    ``data``       — [out_capacity, ...] received rows, densely packed from 0.
+    ``recv_sizes`` — [P] rows received from each peer.
+    ``total``      — [1] valid prefix length of ``data``.
+    ``overflow``   — [1] bool: capacities were exceeded somewhere; data is
+                     garbage and the caller must retry with a bigger plan
+                     (never silently truncated).
+    """
+
+    data: jnp.ndarray
+    recv_sizes: jnp.ndarray
+    total: jnp.ndarray
+    overflow: jnp.ndarray
+
+
+def _global_overflow(local_sizes, total, data_rows, out_capacity, axis_name):
+    """Mesh-wide overflow consensus: True everywhere if ANY device would
+    overrun its input buffer (send side) or output capacity (recv side).
+
+    Must be global: an overflowing exchange is retried by *all* participants
+    with a bigger plan, and the native path must not even issue the
+    collective with out-of-range offsets (undefined behavior on TPU)."""
+    local_bad = (total > out_capacity) | (local_sizes.sum() > data_rows)
+    return jax.lax.psum(local_bad.astype(jnp.int32), axis_name) > 0
+
+
+def _compact_from_segments(recv_sizes, out_capacity):
+    """Build [out_capacity] gather indices that concatenate P ragged segments.
+
+    For output slot j: find sender s via searchsorted over the inclusive
+    cumsum of recv_sizes, then offset-within-segment. Returns (sender_idx,
+    within_idx, valid_mask)."""
+    recv_cum = jnp.cumsum(recv_sizes)
+    total = recv_cum[-1]
+    j = jnp.arange(out_capacity, dtype=jnp.int32)
+    sender = jnp.searchsorted(recv_cum, j, side="right").astype(jnp.int32)
+    sender_c = jnp.minimum(sender, recv_sizes.shape[0] - 1)
+    excl = recv_cum - recv_sizes
+    within = j - excl[sender_c]
+    valid = j < total
+    return sender_c, within, valid
+
+
+def _a2a_native(data, local_sizes, axis_name, out_capacity):
+    in_off, send, out_off, recv, total = exchange_plan(local_sizes, axis_name)
+    overflow = _global_overflow(local_sizes, total, data.shape[0],
+                                out_capacity, axis_name)
+    # Out-of-range offsets are UB for ragged_all_to_all on TPU — on overflow
+    # every device sends a zeroed plan (consistent mesh-wide, since the flag
+    # is a psum) and the caller retries with a larger capacity.
+    z = jnp.where(overflow, 0, 1).astype(jnp.int32)
+    out_shape = (out_capacity,) + data.shape[1:]
+    output = jnp.zeros(out_shape, dtype=data.dtype)
+    result = jax.lax.ragged_all_to_all(
+        data, output, in_off * z, send * z, out_off * z, recv * z,
+        axis_name=axis_name)
+    return ShuffleResult(result, recv, total.reshape(1), overflow.reshape(1))
+
+
+def _a2a_gather(data, local_sizes, axis_name, out_capacity):
+    in_off, send, out_off, recv, total = exchange_plan(local_sizes, axis_name)
+    p = jax.lax.axis_index(axis_name)
+    all_data = jax.lax.all_gather(data, axis_name)          # [P, cap_in, ...]
+    all_in_off = jax.lax.all_gather(in_off, axis_name)      # [P, P]
+    sender, within, valid = _compact_from_segments(recv, out_capacity)
+    # source row inside sender s's buffer: in_off[s][p] + within
+    src = all_in_off[sender, p] + within
+    src = jnp.minimum(src, all_data.shape[1] - 1)
+    out = all_data[sender, src]
+    mask_shape = (out_capacity,) + (1,) * (data.ndim - 1)
+    out = jnp.where(valid.reshape(mask_shape), out, jnp.zeros_like(out))
+    overflow = _global_overflow(local_sizes, total, data.shape[0],
+                                out_capacity, axis_name)
+    return ShuffleResult(out, recv, total.reshape(1), overflow.reshape(1))
+
+
+def _a2a_dense(data, local_sizes, axis_name, out_capacity, peer_capacity):
+    in_off, send, out_off, recv, total = exchange_plan(local_sizes, axis_name)
+    # Pad my P segments into [P, peer_capacity, ...]
+    k = jnp.arange(peer_capacity, dtype=jnp.int32)
+    src = in_off[:, None] + k[None, :]                      # [P, peer_cap]
+    src_c = jnp.minimum(src, data.shape[0] - 1)
+    padded = data[src_c]                                    # [P, peer_cap, ...]
+    seg_mask = k[None, :] < send[:, None]
+    mask_shape = seg_mask.shape + (1,) * (data.ndim - 1)
+    padded = jnp.where(seg_mask.reshape(mask_shape), padded,
+                       jnp.zeros_like(padded))
+    swapped = jax.lax.all_to_all(
+        padded, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    # swapped[s] = the segment sender s aimed at me, padded to peer_capacity
+    sender, within, valid = _compact_from_segments(recv, out_capacity)
+    within_c = jnp.minimum(within, peer_capacity - 1)
+    out = swapped[sender, within_c]
+    vshape = (out_capacity,) + (1,) * (data.ndim - 1)
+    out = jnp.where(valid.reshape(vshape), out, jnp.zeros_like(out))
+    local_seg_bad = (send.max() > peer_capacity) | (recv.max() > peer_capacity)
+    overflow = _global_overflow(local_sizes, total, data.shape[0],
+                                out_capacity, axis_name) \
+        | (jax.lax.psum(local_seg_bad.astype(jnp.int32), axis_name) > 0)
+    return ShuffleResult(out, recv, total.reshape(1), overflow.reshape(1))
+
+
+def ragged_shuffle(data: jnp.ndarray, local_sizes: jnp.ndarray, axis_name: str,
+                   *, out_capacity: int, peer_capacity: Optional[int] = None,
+                   impl: str = "auto") -> ShuffleResult:
+    """One all-to-all exchange of destination-sorted rows. Call inside
+    ``shard_map`` over the mesh axis ``axis_name``.
+
+    ``data``        — [cap_in, ...] this shard's send buffer, rows grouped by
+                      destination device in ascending order (the map-side
+                      sort-shuffle invariant the reference inherits from
+                      SortShuffleManager, ref: CommonUcxShuffleManager.scala:22).
+    ``local_sizes`` — [P] rows destined to each peer; rows beyond
+                      ``local_sizes.sum()`` are padding and never sent.
+    """
+    if data.ndim < 1:
+        raise ValueError("data must have a leading row axis")
+    impl = select_impl(impl)
+    if impl == "native":
+        return _a2a_native(data, local_sizes, axis_name, out_capacity)
+    if impl == "gather":
+        return _a2a_gather(data, local_sizes, axis_name, out_capacity)
+    if peer_capacity is None:
+        peer_capacity = out_capacity
+    return _a2a_dense(data, local_sizes, axis_name, out_capacity, peer_capacity)

@@ -1,0 +1,157 @@
+"""Data-plane correctness vs a numpy oracle (SURVEY.md §7 stage 4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sparkucx_tpu.shuffle.alltoall import ragged_shuffle, select_impl
+
+PDEV = 8
+
+
+def oracle(buffers, sizes):
+    """numpy reference: buffers[p] = flat send rows sorted by dest;
+    sizes[p][q] = rows p sends q. Returns list of received arrays per dev."""
+    out = [[] for _ in range(PDEV)]
+    for p in range(PDEV):
+        off = 0
+        for q in range(PDEV):
+            n = int(sizes[p][q])
+            out[q].append(buffers[p][off:off + n])
+            off += n
+    return [np.concatenate(x) if x else np.zeros((0,)) for x in out]
+
+
+def run_shuffle(mesh8, buffers, sizes, impl, out_capacity, row_shape=()):
+    cap_in = buffers.shape[1]
+
+    def f(data, sz):
+        r = ragged_shuffle(
+            data.reshape((cap_in,) + row_shape), sz.reshape(-1), "shuffle",
+            out_capacity=out_capacity, impl=impl)
+        return r.data, r.recv_sizes, r.total, r.overflow
+
+    g = jax.jit(jax.shard_map(
+        f, mesh=mesh8,
+        in_specs=(P("shuffle"), P("shuffle")),
+        out_specs=(P("shuffle"),) * 4))
+    flat = jnp.asarray(buffers.reshape((-1,) + row_shape))
+    return g(flat, jnp.asarray(sizes.reshape(-1)))
+
+
+@pytest.mark.parametrize("impl", ["dense", "gather"])
+def test_matches_oracle(mesh8, rng, impl):
+    cap_in = 64
+    sizes = rng.integers(0, 8, size=(PDEV, PDEV))
+    buffers = np.zeros((PDEV, cap_in), dtype=np.float32)
+    for p in range(PDEV):
+        n = sizes[p].sum()
+        buffers[p, :n] = rng.normal(size=n)
+    out_cap = 128
+    data, recv, total, ovf = run_shuffle(mesh8, buffers, sizes, impl, out_cap)
+    data = np.asarray(data).reshape(PDEV, out_cap)
+    total = np.asarray(total).reshape(PDEV)
+    ovf = np.asarray(ovf).reshape(PDEV)
+    exp = oracle(buffers, sizes)
+    assert not ovf.any()
+    for q in range(PDEV):
+        assert total[q] == len(exp[q])
+        np.testing.assert_allclose(data[q, :total[q]], exp[q])
+        np.testing.assert_array_equal(data[q, total[q]:], 0)
+
+
+@pytest.mark.parametrize("impl", ["dense", "gather"])
+def test_multidim_rows(mesh8, rng, impl):
+    """Rows with trailing feature dims move intact."""
+    cap_in, width = 32, 4
+    sizes = rng.integers(0, 4, size=(PDEV, PDEV))
+    buffers = np.zeros((PDEV, cap_in, width), dtype=np.int32)
+    for p in range(PDEV):
+        n = sizes[p].sum()
+        buffers[p, :n] = rng.integers(0, 1000, size=(n, width))
+    out_cap = 64
+    data, recv, total, ovf = run_shuffle(
+        mesh8, buffers, sizes, impl, out_cap, row_shape=(width,))
+    data = np.asarray(data).reshape(PDEV, out_cap, width)
+    total = np.asarray(total).reshape(PDEV)
+    # oracle over flattened rows
+    exp_rows = [[] for _ in range(PDEV)]
+    for p in range(PDEV):
+        off = 0
+        for q in range(PDEV):
+            n = int(sizes[p][q])
+            exp_rows[q].extend(buffers[p][off:off + n])
+            off += n
+    for q in range(PDEV):
+        assert total[q] == len(exp_rows[q])
+        if exp_rows[q]:
+            np.testing.assert_array_equal(
+                data[q, :total[q]], np.stack(exp_rows[q]))
+
+
+@pytest.mark.parametrize("impl", ["dense", "gather"])
+def test_empty_and_skewed(mesh8, rng, impl):
+    """Empty partitions (reference skips empty map outputs,
+    ref: UcxShuffleBlockResolver skip-empty) and heavy skew."""
+    cap_in = 64
+    sizes = np.zeros((PDEV, PDEV), dtype=np.int64)
+    sizes[0, 1] = 40  # device 0 sends a lot to device 1 only
+    sizes[3, 1] = 20
+    buffers = np.zeros((PDEV, cap_in), dtype=np.float32)
+    buffers[0, :40] = np.arange(40)
+    buffers[3, :20] = np.arange(100, 120)
+    data, recv, total, ovf = run_shuffle(mesh8, buffers, sizes, impl, 64)
+    data = np.asarray(data).reshape(PDEV, 64)
+    total = np.asarray(total).reshape(PDEV)
+    assert not np.asarray(ovf).any()
+    assert total[1] == 60 and total[0] == 0 and total[2] == 0
+    np.testing.assert_array_equal(data[1, :40], np.arange(40))
+    np.testing.assert_array_equal(data[1, 40:60], np.arange(100, 120))
+
+
+@pytest.mark.parametrize("impl", ["dense", "gather"])
+def test_overflow_flagged(mesh8, rng, impl):
+    """Output capacity too small must be flagged, not silently truncated."""
+    cap_in = 64
+    sizes = np.full((PDEV, PDEV), 6, dtype=np.int64)  # each recv 48 rows
+    buffers = rng.normal(size=(PDEV, cap_in)).astype(np.float32)
+    _, _, _, ovf = run_shuffle(mesh8, buffers, sizes, impl, out_capacity=16)
+    assert np.asarray(ovf).reshape(PDEV).all()
+
+
+def test_select_impl():
+    assert select_impl("dense") == "dense"
+    assert select_impl("auto", backend="tpu") == "native"
+    assert select_impl("auto", backend="cpu") == "dense"
+    with pytest.raises(ValueError):
+        select_impl("bogus")
+
+
+def test_permutation_identity(mesh8, rng):
+    """Full random permutation shuffle: every row lands exactly once."""
+    cap_in = 40
+    sizes = rng.integers(0, 5, size=(PDEV, PDEV))
+    buffers = np.zeros((PDEV, cap_in), dtype=np.float32)
+    vals = []
+    for p in range(PDEV):
+        n = sizes[p].sum()
+        buffers[p, :n] = rng.permutation(np.arange(1, n + 1)) + 1000 * p
+        vals.append(buffers[p, :n])
+    data, recv, total, ovf = run_shuffle(mesh8, buffers, sizes, "dense", 80)
+    data = np.asarray(data).reshape(PDEV, 80)
+    total = np.asarray(total).reshape(PDEV)
+    got = np.concatenate([data[q, :total[q]] for q in range(PDEV)])
+    want = np.concatenate(vals)
+    np.testing.assert_array_equal(np.sort(got), np.sort(want))
+
+
+@pytest.mark.parametrize("impl", ["dense", "gather"])
+def test_send_side_overflow_flagged(mesh8, rng, impl):
+    """sum(local_sizes) > input rows must flag overflow (no silent dupes)."""
+    cap_in = 10
+    sizes = np.full((PDEV, PDEV), 2, dtype=np.int64)  # sends 16 > cap_in 10
+    buffers = rng.normal(size=(PDEV, cap_in)).astype(np.float32)
+    _, _, _, ovf = run_shuffle(mesh8, buffers, sizes, impl, out_capacity=64)
+    assert np.asarray(ovf).reshape(PDEV).all()

@@ -1,0 +1,122 @@
+import os
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.runtime.memory import HostMemoryPool, MappedFile
+
+
+@pytest.fixture(params=["native", "python"])
+def pool(request, monkeypatch):
+    if request.param == "python":
+        monkeypatch.setenv("SPARKUCX_TPU_NO_NATIVE", "1")
+        # force fresh decision
+        import sparkucx_tpu.native as native
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_failed", False)
+    conf = TpuShuffleConf(
+        {"spark.shuffle.tpu.memory.minBufferSize": "1k",
+         "spark.shuffle.tpu.memory.minAllocationSize": "64k"},
+        use_env=False)
+    p = HostMemoryPool(conf)
+    if request.param == "native" and p._arena is None:
+        pytest.skip("native toolchain unavailable")
+    yield p
+    p.close()
+
+
+def test_size_classes(pool):
+    assert pool.class_size(1) == 1024
+    assert pool.class_size(1024) == 1024
+    assert pool.class_size(1025) == 2048
+    assert pool.class_size(100_000) == 131072
+
+
+def test_get_put_reuse(pool):
+    a = pool.get(2000)
+    assert a.capacity == 2048 and a.requested == 2000
+    arr = a.view()
+    arr[:] = 7
+    ptr = a.ptr
+    pool.put(a)
+    b = pool.get(2048)
+    assert b.ptr == ptr  # reused from free list
+    pool.put(b)
+
+
+def test_refcount_sharing(pool):
+    a = pool.get(4096)
+    a.retain()  # two holders now
+    pool.put(a)
+    assert pool.stats()["in_use"] == 1  # still held
+    pool.put(a)
+    assert pool.stats()["in_use"] == 0
+
+
+def test_double_release_rejected(pool):
+    a = pool.get(1024)
+    pool.put(a)
+    if pool._arena is None:
+        with pytest.raises(ValueError):
+            pool.put(a)
+    else:
+        # native logs+refuses; buffer stays on free list exactly once
+        before = pool.stats()["in_use"]
+        pool._lib.sxt_unref(pool._arena, a.ptr)
+        assert pool.stats()["in_use"] == before
+
+
+def test_preallocate_and_stats(pool):
+    pool.preallocate(1024, 8)
+    st = pool.stats()
+    assert st["preallocated"] >= 8
+    a = pool.get(1024)
+    assert pool.stats()["in_use"] == 1
+    pool.put(a)
+
+
+def test_zero_copy_view(pool):
+    a = pool.get(1024)
+    v1 = a.view()
+    v1[:4] = [1, 2, 3, 4]
+    v2 = a.array()
+    np.testing.assert_array_equal(v2[:4], [1, 2, 3, 4])
+    pool.put(a)
+
+
+def test_bad_size(pool):
+    with pytest.raises(ValueError):
+        pool.get(0)
+
+
+def test_mapped_file(tmp_path):
+    path = tmp_path / "blob.bin"
+    data = np.arange(256, dtype=np.uint8)
+    path.write_bytes(data.tobytes())
+    m = MappedFile(str(path))
+    np.testing.assert_array_equal(m.data, data)
+    assert len(m) == 256
+    m.close()
+
+
+def test_mapped_file_writable(tmp_path):
+    path = tmp_path / "blob.bin"
+    path.write_bytes(bytes(64))
+    m = MappedFile(str(path), writable=True)
+    m.data[:4] = [9, 8, 7, 6]
+    m.close()
+    assert path.read_bytes()[:4] == bytes([9, 8, 7, 6])
+
+
+def test_non_pow2_min_buffer_size():
+    """Non-pow2 floor must round identically on Python and native sides."""
+    conf = TpuShuffleConf(
+        {"spark.shuffle.tpu.memory.minBufferSize": "1536"}, use_env=False)
+    p = HostMemoryPool(conf)
+    assert p.min_block == 2048
+    b = p.get(1600)
+    assert b.capacity == 2048
+    b.view()[:] = 1  # full capacity writable without overrun
+    p.put(b)
+    p.close()

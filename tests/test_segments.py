@@ -1,0 +1,110 @@
+import numpy as np
+import pytest
+
+from sparkucx_tpu.meta.segments import (
+    SegmentTable,
+    exchange_plan,
+    pack_record,
+    record_size,
+    unpack_record,
+)
+
+
+def test_record_roundtrip(rng):
+    sizes = rng.integers(0, 1 << 20, size=64).astype(np.uint64)
+    buf = pack_record(7, sizes)
+    assert len(buf) == record_size(64)
+    map_id, out = unpack_record(buf)
+    assert map_id == 7
+    np.testing.assert_array_equal(out, sizes)
+
+
+def test_record_corruption_detected(rng):
+    buf = bytearray(pack_record(3, np.arange(8, dtype=np.uint64)))
+    buf[20] ^= 0xFF
+    with pytest.raises(ValueError):
+        unpack_record(bytes(buf))
+
+
+def test_record_bad_magic():
+    with pytest.raises(ValueError, match="magic"):
+        unpack_record(b"\x00" * record_size(4))
+
+
+def test_table_offsets():
+    sizes = np.array([[10, 0, 5], [1, 2, 3]], dtype=np.uint64)
+    t = SegmentTable(sizes)
+    np.testing.assert_array_equal(t.offsets, [[0, 10, 10], [0, 1, 3]])
+    assert t.block_extent(0, 2) == (10, 15)
+    assert t.block_extent(1, 0) == (0, 1)
+
+
+def test_table_pack_roundtrip(rng):
+    sizes = rng.integers(0, 1000, size=(5, 16)).astype(np.uint64)
+    t = SegmentTable(sizes)
+    buf = t.pack()
+    t2 = SegmentTable.unpack(buf, 5, 16)
+    np.testing.assert_array_equal(t2.sizes, sizes)
+    with pytest.raises(ValueError, match="too small"):
+        SegmentTable.unpack(buf[:-1], 5, 16)
+
+
+def test_device_matrix():
+    # 4 maps, 4 reduce partitions, 2 devices (blocked assignment)
+    sizes = np.arange(16, dtype=np.uint64).reshape(4, 4)
+    t = SegmentTable(sizes)
+    m2d = np.array([0, 0, 1, 1])
+    r2d = np.array([0, 0, 1, 1])
+    S = t.device_matrix(m2d, r2d, 2)
+    # S[0,0] = sizes[0:2, 0:2].sum() etc
+    np.testing.assert_array_equal(
+        S, [[sizes[:2, :2].sum(), sizes[:2, 2:].sum()],
+            [sizes[2:, :2].sum(), sizes[2:, 2:].sum()]])
+
+
+def test_exchange_plan_matches_oracle(mesh8, rng):
+    """exchange_plan inside shard_map must reproduce the numpy oracle."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    PDEV = 8
+    S = rng.integers(0, 50, size=(PDEV, PDEV)).astype(np.int64)
+
+    def f(my_row):
+        in_off, send, out_off, recv, total = exchange_plan(
+            my_row.reshape(-1), "shuffle")
+        return in_off, send, out_off, recv, total.reshape(1)
+
+    g = jax.jit(jax.shard_map(
+        f, mesh=mesh8, in_specs=P("shuffle"),
+        out_specs=(P("shuffle"),) * 4 + (P("shuffle"),)))
+    in_off, send, out_off, recv, total = g(jnp.asarray(S.reshape(-1)))
+    in_off = np.asarray(in_off).reshape(PDEV, PDEV)
+    send = np.asarray(send).reshape(PDEV, PDEV)
+    out_off = np.asarray(out_off).reshape(PDEV, PDEV)
+    recv = np.asarray(recv).reshape(PDEV, PDEV)
+    total = np.asarray(total).reshape(PDEV)
+
+    np.testing.assert_array_equal(send, S)
+    for p in range(PDEV):
+        np.testing.assert_array_equal(
+            in_off[p], np.concatenate([[0], np.cumsum(S[p])[:-1]]))
+        np.testing.assert_array_equal(recv[p], S[:, p])
+        assert total[p] == S[:, p].sum()
+        for q in range(PDEV):
+            assert out_off[p, q] == S[:p, q].sum()
+
+
+def test_record_corrupt_numparts_field():
+    buf = bytearray(pack_record(2, np.arange(4, dtype=np.uint64)))
+    buf[12] = 0xFF  # blow up numPartitions
+    with pytest.raises(ValueError, match="corrupt header"):
+        unpack_record(bytes(buf))
+
+
+def test_validate_row_sizes():
+    from sparkucx_tpu.meta.segments import validate_row_sizes
+    validate_row_sizes(np.array([[100, 200], [300, 400]]))
+    with pytest.raises(ValueError, match="int32"):
+        validate_row_sizes(np.array([[1 << 31, 0], [0, 0]]))
