@@ -84,8 +84,11 @@ class TpuShuffleConf:
         return self._conf.get(key, default)
 
     def set(self, key: str, value) -> "TpuShuffleConf":
-        self._conf[key] = str(value)
-        self._index[_norm(key)] = key
+        # Case/punctuation-insensitive: writing through any spelling updates
+        # the canonical entry rather than shadowing it.
+        canonical = self._index.get(_norm(key), key)
+        self._conf[canonical] = str(value)
+        self._index[_norm(key)] = canonical
         return self
 
     def __contains__(self, key: str) -> bool:

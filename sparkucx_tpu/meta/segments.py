@@ -32,7 +32,7 @@ from __future__ import annotations
 import struct
 import zlib
 from dataclasses import dataclass
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -102,6 +102,7 @@ class SegmentTable:
         self.sizes = np.ascontiguousarray(self.sizes, dtype=np.uint64)
         if self.sizes.ndim != 2:
             raise ValueError(f"sizes must be [M, R], got {self.sizes.shape}")
+        self._offsets: Optional[np.ndarray] = None
 
     @property
     def num_maps(self) -> int:
@@ -114,10 +115,12 @@ class SegmentTable:
     @property
     def offsets(self) -> np.ndarray:
         """Exclusive prefix sums along R: where each partition starts inside
-        its map output buffer."""
-        out = np.zeros_like(self.sizes)
-        np.cumsum(self.sizes[:, :-1], axis=1, out=out[:, 1:])
-        return out
+        its map output buffer. Cached — sizes are immutable after init."""
+        if self._offsets is None:
+            out = np.zeros_like(self.sizes)
+            np.cumsum(self.sizes[:, :-1], axis=1, out=out[:, 1:])
+            self._offsets = out
+        return self._offsets
 
     def block_extent(self, map_id: int, reduce_id: int) -> Tuple[int, int]:
         """[start, end) of one block — one index-file offset pair."""

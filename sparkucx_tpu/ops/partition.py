@@ -1,0 +1,113 @@
+"""Partitioning ops — map-side record routing, jit-compatible.
+
+The reference inherits its map-side partitioning entirely from Spark's
+SortShuffleManager (records hash-partitioned and sorted into per-reduce
+runs in the data file, ref: CommonUcxShuffleManager.scala:22 and the
+index-file layout consumed at OnOffsetsFetchCallback.java:44-52). Here the
+same work is expressed as array ops that XLA fuses: a mixing hash, a stable
+destination sort, and segment counts — producing exactly the
+destination-sorted send buffer + size row that
+:func:`sparkucx_tpu.shuffle.alltoall.ragged_shuffle` consumes.
+
+Everything is static-shape: callers pass padded row buffers with a validity
+count; padding rows are routed to a sentinel destination that sorts last.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def hash32(keys: jnp.ndarray) -> jnp.ndarray:
+    """Deterministic 32-bit avalanche hash (murmur3 finalizer) of int keys.
+
+    Plays the role of Spark's key hash in HashPartitioner; must be identical
+    across hosts/devices so every shard routes a key to the same reducer."""
+    x = keys.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_partition(keys: jnp.ndarray, num_partitions: int) -> jnp.ndarray:
+    """keys -> reduce-partition id in [0, num_partitions)."""
+    return (hash32(keys) % jnp.uint32(num_partitions)).astype(jnp.int32)
+
+
+def destination_sort(
+    rows: jnp.ndarray,
+    dest: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    num_dests: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stable-sort padded rows by destination; padding sorts last.
+
+    rows      — [cap, ...] record buffer (leading row axis).
+    dest      — [cap] destination id per row (ignored for padding).
+    num_valid — scalar count of real rows (rows[num_valid:] are padding).
+    num_dests — static destination count.
+
+    Returns (sorted_rows [cap, ...], counts [num_dests]) where sorted_rows
+    holds destination-grouped real rows first — the send-buffer invariant of
+    the data plane — and counts is the local segment-size row (this map
+    shard's row of the segment table)."""
+    cap = rows.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < num_valid
+    key = jnp.where(valid, dest.astype(jnp.int32), jnp.int32(num_dests))
+    order = jnp.argsort(key, stable=True)
+    sorted_rows = jnp.take(rows, order, axis=0)
+    counts = jnp.bincount(
+        jnp.where(valid, dest.astype(jnp.int32), jnp.int32(num_dests)),
+        length=num_dests + 1)[:num_dests]
+    return sorted_rows, counts.astype(jnp.int32)
+
+
+def partition_and_pack(
+    keys: jnp.ndarray,
+    rows: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    num_partitions: int,
+    part_to_dest: jnp.ndarray,
+    num_devices: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused map-side pipeline: hash -> route -> destination sort.
+
+    ``part_to_dest`` — [num_partitions] int32 map from reduce partition to
+    owning device (the MapOutputTracker role: which executor owns which
+    reduce partition, ref: UcxShuffleReader.scala:40-41). ``num_devices``
+    is the static device count P.
+
+    Returns (send_rows [cap, ...], dest_counts [P], parts_sorted [cap]) —
+    the last carries each row's reduce-partition id in send order so the
+    receiver can bucket received rows into its local partitions."""
+    part = hash_partition(keys, num_partitions)
+    dest = jnp.take(part_to_dest, part)
+    cap = rows.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < num_valid
+    sort_key = jnp.where(valid, dest, jnp.int32(num_devices))
+    order = jnp.argsort(sort_key, stable=True)
+    send_rows = jnp.take(rows, order, axis=0)
+    parts_sorted = jnp.take(jnp.where(valid, part, -1), order)
+    counts = jnp.bincount(sort_key, length=num_devices + 1)[:num_devices]
+    return send_rows, counts.astype(jnp.int32), parts_sorted
+
+
+def blocked_partition_map(num_partitions: int, num_devices: int) -> jnp.ndarray:
+    """Default reduce-partition -> device assignment: contiguous blocks,
+    remainder spread over the first partitions (Spark's grouping of reduce
+    partitions per executor)."""
+    base = num_partitions // num_devices
+    rem = num_partitions % num_devices
+    counts = [base + (1 if d < rem else 0) for d in range(num_devices)]
+    out = []
+    for d, c in enumerate(counts):
+        out.extend([d] * c)
+    return jnp.asarray(out, dtype=jnp.int32)

@@ -1,0 +1,123 @@
+"""TpuNode — the per-process runtime singleton.
+
+The UcxNode analog (ref: UcxNode.java:31-96): one instance per process
+owning the process-wide resources every layer above shares. The reference's
+UcxNode holds {UcpContext, MemoryPool, global worker, listener thread,
+cluster address book}; TpuNode holds {device mesh, host memory pool,
+shuffle registry, metrics, distributed bootstrap state}.
+
+Bootstrap parity:
+
+  reference                                   TPU-native
+  ---------                                   ----------
+  driver opens UcpListener on sockaddr        jax.distributed coordinator
+    (UcxNode.java:98-104)                       (coordinator_address conf)
+  executors dial driver, send worker addr     jax.distributed.initialize(...)
+    (UcxNode.java:111-145)                      per process
+  driver full-mesh introduction RPC           implicit: the global device
+    (RpcConnectionCallback.java:70-84)          list IS the address book
+  thread-local worker per task thread         SPMD: no per-thread progress
+    (UcxNode.java:85-95)                        engine needed; XLA owns it
+
+Multi-process note: ``start(distributed=True)`` wires
+``jax.distributed.initialize`` so ``jax.devices()`` spans all hosts; the
+same mesh/collective code then runs unmodified (SPMD). Single-process
+multi-device (tests, single chip) skips that step.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional
+
+import jax
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.meta.registry import ShuffleRegistry
+from sparkucx_tpu.parallel.mesh import make_shuffle_mesh
+from sparkucx_tpu.runtime.memory import HostMemoryPool
+from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.metrics import Metrics
+
+log = get_logger("runtime.node")
+
+
+class TpuNode:
+    """Process-wide runtime state. Use :func:`TpuNode.start` /
+    :func:`TpuNode.get` — mirroring UcxNode's guarded singleton start
+    (ref: CommonUcxShuffleManager.scala:67-71 startUcxNodeIfMissing)."""
+
+    _instance: Optional["TpuNode"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: TpuShuffleConf, distributed: bool = False,
+                 process_id: int = 0):
+        self.conf = conf
+        self.process_id = process_id
+        self._distributed = distributed
+        if distributed and conf.num_processes > 1:
+            # Multi-host: rendezvous at the coordinator like executors
+            # dialing the driver sockaddr (UcxNode.java:130-134).
+            jax.distributed.initialize(
+                coordinator_address=conf.coordinator_address,
+                num_processes=conf.num_processes,
+                process_id=process_id)
+            log.info("jax.distributed up: process %d/%d via %s",
+                     process_id, conf.num_processes, conf.coordinator_address)
+        self.mesh = make_shuffle_mesh(conf=conf)
+        self.pool = HostMemoryPool(conf)
+        self.registry = ShuffleRegistry()
+        self.metrics = Metrics()
+        self._closed = False
+        log.info("TpuNode up: %d devices, mesh axes %s",
+                 len(jax.devices()), self.mesh.axis_names)
+
+    # -- singleton management --------------------------------------------
+    @classmethod
+    def start(cls, conf: Optional[TpuShuffleConf] = None,
+              distributed: bool = False, process_id: int = 0) -> "TpuNode":
+        """Idempotent start; the startUcxNodeIfMissing analog."""
+        with cls._lock:
+            if cls._instance is None or cls._instance._closed:
+                cls._instance = cls(conf or TpuShuffleConf(),
+                                    distributed, process_id)
+                atexit.register(cls._instance.close)
+            return cls._instance
+
+    @classmethod
+    def get(cls) -> "TpuNode":
+        inst = cls._instance
+        if inst is None or inst._closed:
+            raise RuntimeError("TpuNode not started; call TpuNode.start()")
+        return inst
+
+    # -- address book -----------------------------------------------------
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+    def device_of_shard(self, shard: int):
+        """Shard index -> device, the BlockManagerId->workerAddress lookup
+        analog (ref: UcxNode.java:170-172)."""
+        return self.mesh.devices.reshape(-1)[shard]
+
+    # -- teardown ---------------------------------------------------------
+    def close(self) -> None:
+        """Clean shutdown ordering mirrors UcxNode.close
+        (ref: UcxNode.java:194-221): stop accepting work, drop shuffle
+        state, then release memory."""
+        if self._closed:
+            return
+        self._closed = True
+        self.registry.clear()
+        self.pool.close()
+        if self._distributed and self.conf.num_processes > 1:
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # already down at interpreter exit
+                log.info("distributed shutdown: %s", e)
+        log.info("TpuNode closed; metrics: %s", self.metrics.snapshot())
+        with TpuNode._lock:
+            if TpuNode._instance is self:
+                TpuNode._instance = None

@@ -1,0 +1,200 @@
+"""TpuShuffleManager — the framework API layer (L4).
+
+The Spark SPI surface of the reference, capability for capability
+(ref: compat/spark_3_0/UcxShuffleManager.scala:25-60,
+CommonUcxShuffleManager.scala:39-91):
+
+  reference SPI                       here
+  -------------                       ----
+  registerShuffle(id, deps)        -> register_shuffle(id, num_maps, R)
+  getWriter(handle, mapId)         -> get_writer(handle, map_id)
+  getReader(handle, partitions)    -> read(handle) / read_partition(...)
+  unregisterShuffle(id)            -> unregister_shuffle(id)
+  stop()                           -> stop()
+
+The handle embeds the metadata-plane reference the way UcxShuffleHandle
+embeds the driver table's {address, rkey}
+(ref: CommonUcxShuffleManager.scala:49-52, rpc/UcxRemoteMemory.java:13-17).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+import numpy as np
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.meta.registry import ShuffleEntry
+from sparkucx_tpu.meta.segments import validate_row_sizes
+from sparkucx_tpu.runtime.node import TpuNode
+from sparkucx_tpu.shuffle.plan import ShufflePlan, make_plan
+from sparkucx_tpu.shuffle.reader import ShuffleReaderResult, read_shuffle
+from sparkucx_tpu.shuffle.writer import MapOutputWriter
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("shuffle.manager")
+
+
+@dataclass
+class ShuffleHandle:
+    """Broadcastable shuffle descriptor (UcxShuffleHandle analog)."""
+
+    shuffle_id: int
+    num_maps: int
+    num_partitions: int
+    entry: ShuffleEntry = field(repr=False)
+
+    def __post_init__(self):
+        if self.num_maps <= 0 or self.num_partitions <= 0:
+            raise ValueError("num_maps and num_partitions must be positive")
+
+
+class TpuShuffleManager:
+    """Per-process shuffle service bound to a TpuNode."""
+
+    def __init__(self, node: Optional[TpuNode] = None,
+                 conf: Optional[TpuShuffleConf] = None):
+        self.node = node or TpuNode.start(conf)
+        self.conf = conf or self.node.conf
+        self._writers: Dict[int, Dict[int, MapOutputWriter]] = {}
+        self._lock = threading.Lock()
+        mesh = self.node.mesh
+        self.axis = self.conf.mesh_ici_axis \
+            if self.conf.mesh_ici_axis in mesh.axis_names \
+            else mesh.axis_names[-1]
+        if len(mesh.axis_names) > 1:
+            # Multi-axis mesh (dcn x shuffle): the flat one-collective
+            # exchange runs over ALL devices, so the step uses a flattened
+            # alias mesh; the hierarchical dcn-staged path is a separate
+            # optimization (parallel/collectives).
+            from jax.sharding import Mesh as _Mesh
+            self.exchange_mesh = _Mesh(
+                mesh.devices.reshape(-1), (self.axis,))
+        else:
+            self.exchange_mesh = mesh
+
+    # -- lifecycle --------------------------------------------------------
+    def register_shuffle(self, shuffle_id: int, num_maps: int,
+                         num_partitions: int) -> ShuffleHandle:
+        """Allocate the metadata table for a shuffle
+        (ref: CommonUcxShuffleManager.scala:39-56)."""
+        entry = self.node.registry.register(shuffle_id, num_maps,
+                                            num_partitions)
+        with self._lock:
+            self._writers[shuffle_id] = {}
+        log.info("registered shuffle %d: %d maps x %d partitions "
+                 "(table %d B)", shuffle_id, num_maps, num_partitions,
+                 len(entry.table))
+        return ShuffleHandle(shuffle_id, num_maps, num_partitions, entry)
+
+    def get_writer(self, handle: ShuffleHandle,
+                   map_id: int) -> MapOutputWriter:
+        """Writer for one map task (ref: compat/spark_3_0/
+        UcxShuffleManager.scala:32-51)."""
+        if not (0 <= map_id < handle.num_maps):
+            raise IndexError(
+                f"mapId {map_id} out of range [0,{handle.num_maps})")
+        w = MapOutputWriter(handle.entry, map_id, self.node.pool)
+        with self._lock:
+            self._writers[handle.shuffle_id][map_id] = w
+        return w
+
+    # -- the read path ----------------------------------------------------
+    def read(self, handle: ShuffleHandle,
+             timeout: Optional[float] = None) -> ShuffleReaderResult:
+        """Execute the full exchange for a shuffle and return partitioned
+        results (the getReader + fetch-everything path, SURVEY.md §3.4).
+
+        Blocks until all map outputs are published, mirroring the metadata
+        wait (ref: UcxWorkerWrapper.scala:134-143)."""
+        timeout = timeout if timeout is not None \
+            else self.conf.connection_timeout_ms / 1e3
+        if not handle.entry.wait_complete(timeout):
+            raise TimeoutError(
+                f"shuffle {handle.shuffle_id}: only "
+                f"{handle.entry.num_present}/{handle.num_maps} map outputs "
+                f"published within {timeout}s")
+        table = handle.entry.fetch_table()
+
+        # Collect staged outputs, grouped round-robin onto mesh shards the
+        # way multiple map tasks colocate on one executor. Keys and values
+        # travel as aligned pairs per map output.
+        Pn = self.node.num_devices
+        with self._lock:
+            if handle.shuffle_id not in self._writers:
+                raise RuntimeError(
+                    f"shuffle {handle.shuffle_id} is not registered with "
+                    f"this manager (already unregistered?)")
+            writers = dict(self._writers[handle.shuffle_id])
+        shard_outputs = [[] for _ in range(Pn)]
+        has_vals = False
+        for map_id, w in sorted(writers.items()):
+            keys, values = w.materialize()
+            if values is not None and keys.shape[0]:
+                has_vals = True
+            shard_outputs[map_id % Pn].append((keys, values))
+        if has_vals:
+            for outs in shard_outputs:
+                for keys, values in outs:
+                    if keys.shape[0] and values is None:
+                        raise ValueError(
+                            "mixed schema: some map outputs have values, "
+                            "others have keys only")
+
+        # int32-range guard on what actually feeds the plan arithmetic:
+        # the per-DEVICE aggregated transfer matrix, not the raw [M, R]
+        from sparkucx_tpu.ops.partition import blocked_partition_map
+        map_to_dev = np.arange(handle.num_maps) % Pn
+        red_to_dev = np.asarray(
+            blocked_partition_map(handle.num_partitions, Pn))
+        validate_row_sizes(table.device_matrix(map_to_dev, red_to_dev, Pn))
+
+        key_dtype = np.int64
+        val_tail, val_dtype = (), None
+        for outs in shard_outputs:
+            for keys, values in outs:
+                if keys.shape[0]:
+                    key_dtype = keys.dtype
+                if values is not None and values.shape[0]:
+                    val_tail, val_dtype = values.shape[1:], values.dtype
+        nvalid = np.array(
+            [sum(k.shape[0] for k, _ in outs) for outs in shard_outputs],
+            dtype=np.int64)
+        plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf)
+
+        shard_keys = np.zeros((Pn, plan.cap_in), dtype=key_dtype)
+        shard_vals = np.zeros((Pn, plan.cap_in) + tuple(val_tail),
+                              dtype=val_dtype) if has_vals else None
+        for p in range(Pn):
+            off = 0
+            for keys, values in shard_outputs[p]:
+                n = keys.shape[0]
+                shard_keys[p, off:off + n] = keys
+                if has_vals and n:
+                    shard_vals[p, off:off + n] = values
+                off += n
+
+        with self.node.metrics.timeit("shuffle.read"):
+            result = read_shuffle(self.exchange_mesh, self.axis, plan,
+                                  shard_keys, shard_vals, nvalid)
+        self.node.metrics.inc("shuffle.rows", float(nvalid.sum()))
+        return result
+
+    # -- teardown ---------------------------------------------------------
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        """Release table + staged buffers
+        (ref: CommonUcxShuffleManager.scala:73-77)."""
+        with self._lock:
+            writers = self._writers.pop(shuffle_id, {})
+        for w in writers.values():
+            w.release()
+        self.node.registry.unregister(shuffle_id)
+
+    def stop(self) -> None:
+        """Tear everything down (ref: CommonUcxShuffleManager.scala:82-91)."""
+        with self._lock:
+            ids = list(self._writers.keys())
+        for sid in ids:
+            self.unregister_shuffle(sid)

@@ -1,0 +1,73 @@
+"""Shuffle plans — static-shape capacity policy.
+
+XLA compiles one program per shape, so the ragged reality of a shuffle
+(skewed partition sizes, ref hard-part (a) in SURVEY.md §7) is absorbed
+host-side into a small set of padded capacities. This module decides them:
+
+* ``cap_in``  — per-shard send-buffer rows (max staged rows, padded up)
+* ``cap_out`` — per-shard receive rows = balanced share x capacityFactor
+* retry policy — overflow is detected mesh-wide by the data plane; the
+  caller doubles ``cap_out`` and re-runs (geometric, bounded), the moral
+  equivalent of the reference's inflight-bytes throttling loop in Spark's
+  ShuffleBlockFetcherIterator (ref: UcxShuffleReader.scala:56-70) — except
+  here the budget is HBM instead of network credits.
+
+Capacities are rounded to multiples of 8 rows to keep TPU-friendly tiling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import numpy as np
+
+from sparkucx_tpu.config import TpuShuffleConf
+
+
+def _round_up(x: int, mult: int = 8) -> int:
+    return max(mult, ((int(x) + mult - 1) // mult) * mult)
+
+
+@dataclass(frozen=True)
+class ShufflePlan:
+    """Static shapes for one exchange step. Hashable: the jit-cache key."""
+
+    num_shards: int
+    num_partitions: int
+    cap_in: int
+    cap_out: int
+    impl: str
+    max_retries: int = 4
+
+    def grown(self) -> "ShufflePlan":
+        """Next plan after an overflow: double the receive capacity."""
+        return ShufflePlan(self.num_shards, self.num_partitions,
+                           self.cap_in, self.cap_out * 2, self.impl,
+                           self.max_retries)
+
+
+def make_plan(
+    shard_rows: np.ndarray,
+    num_shards: int,
+    num_partitions: int,
+    conf: Optional[TpuShuffleConf] = None,
+) -> ShufflePlan:
+    """Derive capacities from per-shard staged row counts.
+
+    ``shard_rows`` — [P] rows staged on each shard. cap_out starts at the
+    perfectly-balanced share times ``capacityFactor``; skew beyond that is
+    handled by the overflow-retry loop, trading one recompile for not
+    provisioning worst-case HBM everywhere."""
+    conf = conf or TpuShuffleConf()
+    total = int(np.sum(shard_rows))
+    cap_in = _round_up(int(np.max(shard_rows, initial=0)))
+    balanced = total / max(num_shards, 1)
+    cap_out = _round_up(int(np.ceil(balanced * conf.capacity_factor)))
+    return ShufflePlan(
+        num_shards=num_shards,
+        num_partitions=num_partitions,
+        cap_in=cap_in,
+        cap_out=cap_out,
+        impl=conf.a2a_impl,
+    )

@@ -1,0 +1,178 @@
+"""Reduce-side reader — the hot path, one collective per shuffle.
+
+The reference's reduce side is a per-(mapper, reducer) storm of one-sided
+reads driven by a spinning progress thread (call stack at SURVEY.md §3.4).
+The TPU build collapses all of it into ONE jitted SPMD step over the mesh:
+
+    stage:   [P, cap_in] keys/values staged per shard (host, pinned pool)
+    device:  hash -> destination sort -> ragged all-to-all -> partition sort
+    fetch:   per-reduce-partition slices, densely packed per shard
+
+so the reference's headline property — mapper CPU does nothing per fetch —
+becomes "host does nothing per block": no per-block round-trips exist at
+all, only one compiled program launch (SURVEY.md §7 hard part (c)).
+
+Overflow handling: the data plane flags capacity overflow mesh-wide; the
+reader retries with a doubled plan (one recompile) rather than
+provisioning worst-case HBM up front.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkucx_tpu.ops.partition import hash_partition, partition_and_pack
+from sparkucx_tpu.shuffle.alltoall import ragged_shuffle
+from sparkucx_tpu.shuffle.plan import ShufflePlan
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("shuffle.reader")
+
+
+@functools.lru_cache(maxsize=64)
+def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan,
+                key_dtype: str, val_shape: Optional[Tuple[int, ...]],
+                val_dtype: Optional[str]):
+    """Compile the exchange step for one (mesh, plan, dtypes) signature.
+
+    lru_cache keys on the hashable plan — the jit-cache discipline that
+    keeps one compiled program per shape family."""
+    R = plan.num_partitions
+    Pn = plan.num_shards
+    part_to_dest = _blocked_map(R, Pn)
+
+    def step(keys, values, nvalid):
+        # keys [cap_in], values [cap_in, ...] or dummy, nvalid [1]
+        send_keys, counts, _ = partition_and_pack(
+            keys, keys, nvalid[0], R, part_to_dest, Pn)
+        rk = ragged_shuffle(send_keys, counts, axis,
+                            out_capacity=plan.cap_out, impl=plan.impl)
+        if values is not None:
+            # same routing rule applied to the value rows; counts are
+            # identical by construction so the exchange plan is shared
+            send_vals, _, _ = partition_and_pack(
+                keys, values, nvalid[0], R, part_to_dest, Pn)
+            rv = ragged_shuffle(send_vals, counts, axis,
+                                out_capacity=plan.cap_out, impl=plan.impl)
+            vals_recv = rv.data
+        else:
+            vals_recv = None
+        # receiver: recompute partition ids from keys (no id stream needed),
+        # group by partition
+        j = jnp.arange(plan.cap_out, dtype=jnp.int32)
+        valid = j < rk.total[0]
+        parts = jnp.where(valid, hash_partition(rk.data, R), jnp.int32(R))
+        order2 = jnp.argsort(parts, stable=True)
+        keys_out = jnp.take(rk.data, order2, axis=0)
+        parts_sorted = jnp.take(parts, order2)
+        pcounts = jnp.bincount(parts_sorted, length=R + 1)[:R]
+        outs = [keys_out, pcounts.astype(jnp.int32), rk.total, rk.overflow]
+        if vals_recv is not None:
+            outs.insert(1, jnp.take(vals_recv, order2, axis=0))
+        return tuple(outs)
+
+    has_vals = val_shape is not None
+    out_specs = (P(axis),) * (5 if has_vals else 4)
+    sm = jax.shard_map(
+        (lambda k, v, n: step(k, v, n)) if has_vals
+        else (lambda k, n: step(k, None, n)),
+        mesh=mesh,
+        in_specs=(P(axis),) * (3 if has_vals else 2),
+        out_specs=out_specs)
+    return jax.jit(sm)
+
+
+@functools.lru_cache(maxsize=32)
+def _blocked_map(num_partitions: int, num_devices: int):
+    from sparkucx_tpu.ops.partition import blocked_partition_map
+    return blocked_partition_map(num_partitions, num_devices)
+
+
+class ShuffleReaderResult:
+    """Host-side view of one completed exchange."""
+
+    def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
+                 keys: np.ndarray, values: Optional[np.ndarray],
+                 pcounts: np.ndarray):
+        # keys: [P, cap_out]; pcounts: [P, R]
+        self.num_partitions = num_partitions
+        self._part_to_shard = part_to_shard
+        self._keys = keys
+        self._values = values
+        self._pcounts = pcounts
+        # per shard: partitions sorted ascending -> offsets via cumsum
+        self._offsets = np.zeros_like(pcounts)
+        np.cumsum(pcounts[:, :-1], axis=1, out=self._offsets[:, 1:])
+
+    def partition(self, r: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """(keys, values) of reduce partition r, densely packed."""
+        shard = int(self._part_to_shard[r])
+        start = int(self._offsets[shard, r])
+        n = int(self._pcounts[shard, r])
+        k = self._keys[shard, start:start + n]
+        v = self._values[shard, start:start + n] \
+            if self._values is not None else None
+        return k, v
+
+    def partitions(self):
+        for r in range(self.num_partitions):
+            yield r, self.partition(r)
+
+
+def read_shuffle(
+    mesh: Mesh,
+    axis: str,
+    plan: ShufflePlan,
+    shard_keys: np.ndarray,
+    shard_values: Optional[np.ndarray],
+    shard_nvalid: np.ndarray,
+) -> ShuffleReaderResult:
+    """Run the exchange with overflow retry.
+
+    shard_keys   — [P, cap_in] staged keys per shard (padding arbitrary)
+    shard_values — [P, cap_in, ...] or None
+    shard_nvalid — [P] valid row counts
+    """
+    Pn = plan.num_shards
+    R = plan.num_partitions
+    part_to_dest = np.asarray(_blocked_map(R, Pn))
+    part_to_shard = part_to_dest  # blocked: dest device owns the partition
+
+    cur = plan
+    for attempt in range(plan.max_retries + 1):
+        has_vals = shard_values is not None
+        step = _build_step(
+            mesh, axis, cur, str(shard_keys.dtype),
+            tuple(shard_values.shape[2:]) if has_vals else None,
+            str(shard_values.dtype) if has_vals else None)
+        keys_flat = jnp.asarray(shard_keys.reshape(-1))
+        nvalid = jnp.asarray(shard_nvalid.astype(np.int32).reshape(-1))
+        if has_vals:
+            vals_flat = jnp.asarray(
+                shard_values.reshape((-1,) + shard_values.shape[2:]))
+            out = step(keys_flat, vals_flat, nvalid)
+            keys_out, vals_out, pcounts, total, ovf = out
+        else:
+            out = step(keys_flat, nvalid)
+            keys_out, pcounts, total, ovf = out
+            vals_out = None
+        if not np.asarray(ovf).any():
+            return ShuffleReaderResult(
+                R, part_to_shard,
+                np.asarray(keys_out).reshape(Pn, cur.cap_out),
+                np.asarray(vals_out).reshape(
+                    (Pn, cur.cap_out) + shard_values.shape[2:])
+                if vals_out is not None else None,
+                np.asarray(pcounts).reshape(Pn, R))
+        log.info("shuffle overflow at cap_out=%d (attempt %d); growing",
+                 cur.cap_out, attempt)
+        cur = cur.grown()
+    raise RuntimeError(
+        f"shuffle still overflowing after {plan.max_retries} retries "
+        f"(cap_out={cur.cap_out}); extreme skew — repartition the data")

@@ -1,0 +1,220 @@
+"""End-to-end manager lifecycle on the 8-device CPU mesh — the
+GroupBy-style correctness workload (SURVEY.md §4 lesson: unit + e2e)."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.runtime.node import TpuNode
+from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+from sparkucx_tpu.shuffle.writer import _hash32_np
+
+
+@pytest.fixture()
+def manager(mesh8):
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    m = TpuShuffleManager(node, conf)
+    yield m
+    m.stop()
+    node.close()
+
+
+def expected_partition(keys, R):
+    return (_hash32_np(np.asarray(keys)) % np.uint32(R)).astype(np.int64)
+
+
+def test_register_duplicate_rejected(manager):
+    manager.register_shuffle(0, 4, 8)
+    with pytest.raises(ValueError):
+        manager.register_shuffle(0, 4, 8)
+    manager.unregister_shuffle(0)
+
+
+def test_full_lifecycle_keys_only(manager, rng):
+    R = 16
+    M = 8
+    h = manager.register_shuffle(1, M, R)
+    all_keys = []
+    for m in range(M):
+        w = manager.get_writer(h, m)
+        keys = rng.integers(0, 1 << 31, size=200).astype(np.int64)
+        w.write(keys)
+        w.commit(R)
+        all_keys.append(keys)
+    res = manager.read(h)
+    got_total = 0
+    for r, (k, v) in res.partitions():
+        assert v is None
+        assert (expected_partition(k, R) == r).all()
+        got_total += k.size
+    assert got_total == M * 200
+    # global multiset preserved
+    got = np.sort(np.concatenate(
+        [res.partition(r)[0] for r in range(R)]))
+    np.testing.assert_array_equal(got, np.sort(np.concatenate(all_keys)))
+    manager.unregister_shuffle(1)
+
+
+def test_full_lifecycle_with_values(manager, rng):
+    R = 8
+    M = 4
+    h = manager.register_shuffle(2, M, R)
+    kv = {}
+    for m in range(M):
+        w = manager.get_writer(h, m)
+        keys = rng.integers(0, 10_000, size=100).astype(np.int64)
+        vals = rng.normal(size=(100, 3)).astype(np.float32)
+        w.write(keys, vals)
+        w.commit(R)
+        for k, v in zip(keys, vals):
+            kv.setdefault(int(k), []).append(v)
+    res = manager.read(h)
+    seen = 0
+    for r in range(R):
+        k, v = res.partition(r)
+        assert v is not None and v.shape == (k.size, 3)
+        for ki, vi in zip(k, v):
+            cands = kv[int(ki)]
+            assert any(np.allclose(vi, c) for c in cands)
+        seen += k.size
+    assert seen == M * 100
+    manager.unregister_shuffle(2)
+
+
+def test_read_times_out_on_missing_map(manager, rng):
+    h = manager.register_shuffle(3, 4, 8)
+    w = manager.get_writer(h, 0)
+    w.write(rng.integers(0, 100, size=10).astype(np.int64))
+    w.commit(8)  # maps 1..3 never commit
+    with pytest.raises(TimeoutError, match="1/4"):
+        manager.read(h, timeout=0.2)
+    manager.unregister_shuffle(3)
+
+
+def test_empty_map_outputs(manager):
+    """Empty map outputs publish zero rows and the shuffle still runs
+    (reference skips empties, ref: UcxShuffleBlockResolver 2.4:35-38)."""
+    R = 8
+    h = manager.register_shuffle(4, 4, R)
+    for m in range(4):
+        w = manager.get_writer(h, m)
+        if m == 0:
+            w.write(np.arange(50, dtype=np.int64))
+        w.commit(R)
+    res = manager.read(h)
+    total = sum(res.partition(r)[0].size for r in range(R))
+    assert total == 50
+    manager.unregister_shuffle(4)
+
+
+def test_skewed_keys_trigger_retry(manager):
+    """All keys identical: one partition takes everything; the reader must
+    retry with a grown plan and still succeed."""
+    R = 16
+    M = 8
+    conf = manager.conf
+    conf.set("spark.shuffle.tpu.a2a.capacityFactor", 1.0)
+    h = manager.register_shuffle(5, M, R)
+    for m in range(M):
+        w = manager.get_writer(h, m)
+        w.write(np.full(100, 42, dtype=np.int64))
+        w.commit(R)
+    res = manager.read(h)
+    sizes = [res.partition(r)[0].size for r in range(R)]
+    assert sum(sizes) == M * 100
+    assert max(sizes) == M * 100  # all on one partition
+    manager.unregister_shuffle(5)
+
+
+def test_writer_validation(manager, rng):
+    h = manager.register_shuffle(6, 2, 4)
+    w = manager.get_writer(h, 0)
+    with pytest.raises(ValueError, match="1-D"):
+        w.write(np.zeros((2, 2)))
+    with pytest.raises(ValueError, match="rows"):
+        w.write(np.zeros(3, dtype=np.int64), np.zeros((2, 1)))
+    w.write(np.arange(4, dtype=np.int64))
+    w.commit(4)
+    with pytest.raises(RuntimeError, match="committed"):
+        w.commit(4)
+    with pytest.raises(IndexError):
+        manager.get_writer(h, 9)
+    manager.unregister_shuffle(6)
+
+
+def test_read_after_unregister_clear_error(manager, rng):
+    h = manager.register_shuffle(7, 1, 4)
+    w = manager.get_writer(h, 0)
+    w.write(np.arange(5, dtype=np.int64))
+    w.commit(4)
+    manager.unregister_shuffle(7)
+    with pytest.raises(RuntimeError, match="not registered"):
+        manager.read(h)
+
+
+def test_values_with_empty_map_outputs(manager, rng):
+    """Empty map output in a values-bearing shuffle must not misalign the
+    key/value pairing."""
+    R = 8
+    h = manager.register_shuffle(8, 4, R)
+    truth = {}
+    for m in range(4):
+        w = manager.get_writer(h, m)
+        if m != 1:  # map 1 is empty
+            keys = rng.integers(0, 100, size=50).astype(np.int64)
+            vals = (keys * 10).astype(np.float32).reshape(-1, 1)
+            w.write(keys, vals)
+        w.commit(R)
+    res = manager.read(h)
+    n = 0
+    for r in range(R):
+        k, v = res.partition(r)
+        np.testing.assert_allclose(v[:, 0], k * 10)  # pairing intact
+        n += k.size
+    assert n == 150
+    manager.unregister_shuffle(8)
+
+
+def test_multislice_mesh_read(rng):
+    """2-D (dcn x shuffle) mesh: manager flattens for the exchange."""
+    conf = TpuShuffleConf(
+        {"spark.shuffle.tpu.a2a.impl": "dense",
+         "spark.shuffle.tpu.mesh.numSlices": "2"}, use_env=False)
+    node = TpuNode.start(conf)
+    try:
+        m = TpuShuffleManager(node, conf)
+        assert node.mesh.axis_names == ("dcn", "shuffle")
+        h = m.register_shuffle(0, 4, 8)
+        allk = []
+        for mp in range(4):
+            w = m.get_writer(h, mp)
+            keys = rng.integers(0, 1000, size=64).astype(np.int64)
+            allk.append(keys)
+            w.write(keys)
+            w.commit(8)
+        res = m.read(h)
+        got = np.sort(np.concatenate(
+            [res.partition(r)[0] for r in range(8)]))
+        np.testing.assert_array_equal(got, np.sort(np.concatenate(allk)))
+        m.stop()
+    finally:
+        node.close()
+
+
+def test_conf_set_case_insensitive():
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense"},
+                          use_env=False)
+    conf.set("spark.shuffle.tpu.A2A.impl", "gather")
+    assert conf.a2a_impl == "gather"
+
+
+def test_writer_non_contiguous_input(manager, rng):
+    h = manager.register_shuffle(9, 1, 4)
+    w = manager.get_writer(h, 0)
+    base = np.arange(20, dtype=np.int64)
+    w.write(base[::2])  # strided view must be accepted
+    assert w.num_rows == 10
+    w.commit(4)
+    manager.unregister_shuffle(9)

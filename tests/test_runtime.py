@@ -1,0 +1,146 @@
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.meta.registry import ShuffleRegistry
+from sparkucx_tpu.ops.partition import (
+    blocked_partition_map,
+    destination_sort,
+    hash32,
+    hash_partition,
+    partition_and_pack,
+)
+from sparkucx_tpu.parallel.mesh import make_shuffle_mesh, mesh_num_shards
+from sparkucx_tpu.runtime.node import TpuNode
+from sparkucx_tpu.shuffle.writer import _hash32_np
+
+
+def test_hash_matches_numpy_twin(rng):
+    keys = rng.integers(0, 1 << 62, size=1000).astype(np.int64)
+    dev = np.asarray(hash32(jnp.asarray(keys)))
+    host = _hash32_np(keys)
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_hash_partition_range(rng):
+    keys = rng.integers(0, 1 << 31, size=1000).astype(np.int64)
+    p = np.asarray(hash_partition(jnp.asarray(keys), 7))
+    assert p.min() >= 0 and p.max() < 7
+    # roughly uniform
+    counts = np.bincount(p, minlength=7)
+    assert counts.min() > 50
+
+
+def test_destination_sort(rng):
+    cap = 32
+    n = 20
+    dest = rng.integers(0, 4, size=cap).astype(np.int32)
+    rows = np.arange(cap, dtype=np.float32)
+    srt, counts = destination_sort(
+        jnp.asarray(rows), jnp.asarray(dest), jnp.int32(n), 4)
+    srt, counts = np.asarray(srt), np.asarray(counts)
+    np.testing.assert_array_equal(
+        counts, np.bincount(dest[:n], minlength=4))
+    # grouped ascending by dest for the valid prefix
+    d_sorted = dest[srt[:n].astype(np.int64)]
+    assert (np.diff(d_sorted) >= 0).all()
+    # padding rows land at the end
+    assert set(srt[n:].astype(int)) == set(range(n, cap))
+
+
+def test_partition_and_pack(rng):
+    cap, n, R, P = 64, 50, 16, 4
+    keys = rng.integers(0, 1 << 31, size=cap).astype(np.int64)
+    p2d = blocked_partition_map(R, P)
+    send, counts, parts = partition_and_pack(
+        jnp.asarray(keys), jnp.asarray(keys), jnp.int32(n), R, p2d, P)
+    send, counts, parts = map(np.asarray, (send, counts, parts))
+    assert counts.sum() == n
+    # each sent row's destination matches its position segment
+    off = 0
+    p2d_np = np.asarray(p2d)
+    exp_part = _hash32_np(keys) % np.uint32(R)
+    for d in range(P):
+        seg = send[off:off + counts[d]]
+        assert (p2d_np[exp_part[np.isin(keys, seg)].astype(int)] == d).all()
+        off += counts[d]
+    # parts stream matches recomputed partition of sent keys
+    np.testing.assert_array_equal(
+        parts[:n], (exp_part[np.argsort(
+            np.where(np.arange(cap) < n, p2d_np[exp_part.astype(int)], P),
+            kind="stable")])[:n].astype(np.int32))
+
+
+def test_blocked_partition_map():
+    m = np.asarray(blocked_partition_map(10, 4))
+    assert m.shape == (10,)
+    np.testing.assert_array_equal(m, [0, 0, 0, 1, 1, 1, 2, 2, 3, 3])
+    m2 = np.asarray(blocked_partition_map(8, 8))
+    np.testing.assert_array_equal(m2, np.arange(8))
+
+
+def test_registry_publish_wait(rng):
+    reg = ShuffleRegistry()
+    e = reg.register(0, 4, 8)
+    assert not e.wait_complete(timeout=0.05)
+    rows = [rng.integers(0, 100, size=8) for _ in range(4)]
+
+    def publish_all():
+        for m in range(4):
+            e.publish(m, rows[m])
+
+    t = threading.Thread(target=publish_all)
+    t.start()
+    assert e.wait_complete(timeout=5)
+    t.join()
+    table = e.fetch_table()
+    for m in range(4):
+        np.testing.assert_array_equal(table.sizes[m], rows[m])
+        np.testing.assert_array_equal(e.fetch_record(m), rows[m])
+    with pytest.raises(KeyError):
+        reg.get(99)
+    reg.unregister(0)
+    with pytest.raises(KeyError):
+        reg.get(0)
+
+
+def test_registry_validation(rng):
+    reg = ShuffleRegistry()
+    e = reg.register(1, 2, 4)
+    with pytest.raises(IndexError):
+        e.publish(5, np.zeros(4))
+    with pytest.raises(ValueError, match="partitions"):
+        e.publish(0, np.zeros(3))
+    with pytest.raises(RuntimeError, match="missing"):
+        e.fetch_table()
+    with pytest.raises(RuntimeError, match="not yet"):
+        e.fetch_record(0)
+
+
+def test_mesh_and_node():
+    mesh = make_shuffle_mesh()
+    assert mesh.axis_names == ("shuffle",)
+    assert mesh_num_shards(mesh) == 8
+    conf = TpuShuffleConf({"spark.shuffle.tpu.mesh.numSlices": "2"},
+                          use_env=False)
+    mesh2 = make_shuffle_mesh(conf=conf)
+    assert mesh2.axis_names == ("dcn", "shuffle")
+    assert mesh2.devices.shape == (2, 4)
+    with pytest.raises(ValueError, match="divide"):
+        make_shuffle_mesh(devices=jax.devices()[:3],
+                          conf=TpuShuffleConf(
+                              {"spark.shuffle.tpu.mesh.numSlices": "2"},
+                              use_env=False))
+
+    node = TpuNode.start()
+    assert TpuNode.get() is node
+    assert TpuNode.start() is node  # idempotent
+    assert node.num_devices == 8
+    assert node.device_of_shard(0) == jax.devices()[0]
+    node.close()
+    with pytest.raises(RuntimeError):
+        TpuNode.get()
