@@ -1,0 +1,123 @@
+"""Benchmark: shuffle-read throughput per chip.
+
+North-star metric (BASELINE.md): HiBench-Terasort-style shuffle-read GB/s
+per chip. The measured pipeline is the framework's hot path end to end on
+device — hash partition -> stable destination sort -> ragged all-to-all ->
+receive-side partition grouping — i.e. everything the reference does with
+per-block ucp_get storms (SURVEY.md §3.4), as one compiled XLA step.
+
+Baseline: the reference publishes no in-repo numbers (BASELINE.md §1); the
+conventional UCX-RDMA shuffle-read rate on the Mellanox deployment the
+README points at is ~3 GB/s/node sustained, which we adopt as baseline=3.0
+so vs_baseline = GB/s-per-chip / 3.0. The BASELINE.json target is
+vs_baseline >= 4.
+
+Prints ONE JSON line:
+  {"metric": "shuffle_read_GBps_per_chip", "value": N, "unit": "GB/s",
+   "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+BASELINE_GBPS = 3.0
+
+
+def run(rows_log2: int, val_words: int, iters: int, warmup: int,
+        partitions_per_dev: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from sparkucx_tpu.ops.partition import blocked_partition_map, \
+        hash_partition, partition_and_pack
+    from sparkucx_tpu.shuffle.alltoall import ragged_shuffle
+
+    devs = jax.devices()
+    nchips = len(devs)
+    mesh = Mesh(np.array(devs), ("shuffle",))
+    rows = 1 << rows_log2                       # per shard
+    R = nchips * partitions_per_dev
+    cap_out = int(rows * 1.5)
+    width = 2 + val_words                       # fused int32 row
+    row_bytes = 4 * width
+    part_to_dest = blocked_partition_map(R, nchips)
+
+    def step(payload):
+        # the production hot path (shuffle/reader.py): route on key_lo,
+        # destination sort, one fused exchange, receive-side grouping
+        part = hash_partition(payload[:, 0], R)
+        dest = jnp.take(part_to_dest, part)
+        order = jnp.argsort(dest, stable=True)
+        send = jnp.take(payload, order, axis=0)
+        counts = jnp.bincount(dest, length=nchips).astype(jnp.int32)
+        r = ragged_shuffle(send, counts, "shuffle",
+                           out_capacity=cap_out, impl="auto")
+        parts = hash_partition(r.data[:, 0], R)
+        order2 = jnp.argsort(parts, stable=True)
+        return jnp.take(r.data, order2, axis=0), r.overflow
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P("shuffle"),),
+        out_specs=(P("shuffle"),) * 2))
+
+    rng = np.random.default_rng(0)
+    payload = jnp.asarray(
+        rng.integers(0, 1 << 31, size=(nchips * rows, width),
+                     dtype=np.int64).astype(np.int32))
+
+    for _ in range(warmup):
+        out = fn(payload)
+    jax.block_until_ready(out)
+    assert not np.asarray(out[1]).any(), "bench overflowed capacity"
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(payload)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+
+    total_bytes = nchips * rows * row_bytes
+    gbps_per_chip = total_bytes / dt / nchips / 1e9
+    return {
+        "metric": "shuffle_read_GBps_per_chip",
+        "value": round(gbps_per_chip, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps_per_chip / BASELINE_GBPS, 3),
+        "detail": {
+            "backend": jax.default_backend(),
+            "chips": nchips,
+            "rows_per_chip": rows,
+            "row_bytes": row_bytes,
+            "partitions": R,
+            "step_ms": round(dt * 1e3, 3),
+        },
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="small shapes for CI / CPU")
+    ap.add_argument("--rows-log2", type=int, default=None)
+    ap.add_argument("--val-words", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    if args.smoke:
+        rows_log2 = args.rows_log2 or 12
+        iters, warmup = 3, 1
+    else:
+        rows_log2 = args.rows_log2 or 21
+        iters, warmup = args.iters, 2
+    result = run(rows_log2, args.val_words, iters, warmup,
+                 partitions_per_dev=8)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""Arrow columnar ingress/egress.
+
+BASELINE.json's north star has fetched bytes land back as Arrow columnar
+batches for the host framework's reducers (the Spark-RAPIDS-style columnar
+interop config). This module converts between Arrow RecordBatches and the
+writer/reader surfaces: a batch's key column routes the shuffle, the
+remaining fixed-width columns ride as the fused value payload."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+    HAVE_ARROW = True
+except Exception:  # pragma: no cover - pyarrow is in the image
+    pa = None
+    HAVE_ARROW = False
+
+
+def _require_arrow() -> None:
+    if not HAVE_ARROW:
+        raise RuntimeError("pyarrow is not available in this environment")
+
+
+def _widen_bits(arr: np.ndarray) -> np.ndarray:
+    """Column -> int64 carrier, losslessly: integers widen by value (exact
+    for every width <= 64), floats widen to float64 by value (exact from
+    float32/16) and then reinterpret as bits. Never a lossy cast."""
+    if np.issubdtype(arr.dtype, np.integer):
+        return arr.astype(np.int64)
+    if np.issubdtype(arr.dtype, np.floating):
+        return np.ascontiguousarray(
+            arr.astype(np.float64)).view(np.int64)
+    raise TypeError(
+        f"column dtype {arr.dtype} is not fixed-width numeric; only "
+        f"numeric columns shuffle columnarly")
+
+
+def _narrow_bits(carrier: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    dtype = np.dtype(dtype)
+    if np.issubdtype(dtype, np.integer):
+        return carrier.astype(dtype)
+    return np.ascontiguousarray(carrier).view(np.float64).astype(dtype)
+
+
+def batch_to_kv(batch: "pa.RecordBatch", key_column: str,
+                ) -> Tuple[np.ndarray, Optional[np.ndarray], List[np.dtype]]:
+    """RecordBatch -> (keys int64, values [n, ncols] int64 carrier, dtypes).
+
+    Fixed-width numeric columns only (the columnar-shuffle contract).
+    Each value column rides as a lossless int64 carrier; ``dtypes`` is the
+    per-column recipe :func:`kv_to_batch` uses to reconstruct exactly."""
+    _require_arrow()
+    names = [f for f in batch.schema.names if f != key_column]
+    if key_column not in batch.schema.names:
+        raise KeyError(f"key column {key_column!r} not in batch")
+    keys = batch.column(key_column).to_numpy(zero_copy_only=False)
+    if not np.issubdtype(keys.dtype, np.integer):
+        raise TypeError(f"key column must be integer, got {keys.dtype}")
+    keys = keys.astype(np.int64, copy=False)
+    if not names:
+        return keys, None, []
+    cols, dtypes = [], []
+    for name in names:
+        arr = batch.column(name).to_numpy(zero_copy_only=False)
+        cols.append(_widen_bits(arr))
+        dtypes.append(arr.dtype)
+    return keys, np.stack(cols, axis=1), dtypes
+
+
+def kv_to_batch(keys: np.ndarray, values: Optional[np.ndarray],
+                key_column: str = "key",
+                value_columns: Optional[Sequence[str]] = None,
+                value_dtypes: Optional[Sequence] = None,
+                ) -> "pa.RecordBatch":
+    """(keys, int64-carrier values, dtypes) -> RecordBatch; exact inverse
+    of batch_to_kv. Without ``value_dtypes``, columns come back int64."""
+    _require_arrow()
+    arrays = [pa.array(np.ascontiguousarray(keys))]
+    names = [key_column]
+    if values is not None:
+        ncols = values.shape[1] if values.ndim > 1 else 1
+        vals2d = values.reshape(len(keys), ncols) if len(keys) else \
+            values.reshape(0, ncols)
+        value_columns = list(value_columns or
+                             [f"v{i}" for i in range(ncols)])
+        if len(value_columns) != ncols:
+            raise ValueError(
+                f"{len(value_columns)} names for {ncols} value columns")
+        value_dtypes = list(value_dtypes or [np.int64] * ncols)
+        if len(value_dtypes) != ncols:
+            raise ValueError(
+                f"{len(value_dtypes)} dtypes for {ncols} value columns")
+        for i, name in enumerate(value_columns):
+            col = _narrow_bits(
+                np.ascontiguousarray(vals2d[:, i]).astype(np.int64),
+                value_dtypes[i])
+            arrays.append(pa.array(col))
+            names.append(name)
+    return pa.RecordBatch.from_arrays(arrays, names=names)
+
+
+def write_batches(manager, handle, map_id: int,
+                  batches: Sequence["pa.RecordBatch"], key_column: str,
+                  num_partitions: Optional[int] = None) -> List[np.dtype]:
+    """Stage Arrow batches into one map output and commit. Returns the
+    value-column dtype recipe (also stashed on the handle for
+    read_batches)."""
+    _require_arrow()
+    w = manager.get_writer(handle, map_id)
+    dtypes: List[np.dtype] = []
+    for b in batches:
+        keys, values, dtypes = batch_to_kv(b, key_column)
+        if keys.shape[0]:
+            w.write(keys, values)
+    w.commit(num_partitions or handle.num_partitions)
+    handle.__dict__.setdefault("_arrow_value_dtypes", dtypes)
+    return dtypes
+
+
+def read_batches(manager, handle, key_column: str = "key",
+                 value_columns: Optional[Sequence[str]] = None,
+                 value_dtypes: Optional[Sequence] = None,
+                 timeout: Optional[float] = None) -> List["pa.RecordBatch"]:
+    """Run the exchange; one RecordBatch per non-empty reduce partition.
+    Column dtypes default to the recipe recorded by write_batches."""
+    _require_arrow()
+    if value_dtypes is None:
+        value_dtypes = handle.__dict__.get("_arrow_value_dtypes")
+    res = manager.read(handle, timeout=timeout)
+    out = []
+    for r, (k, v) in res.partitions():
+        if k.shape[0]:
+            out.append(kv_to_batch(k, v, key_column, value_columns,
+                                   value_dtypes))
+    return out

@@ -1,0 +1,43 @@
+"""DLPack zero-copy device interop.
+
+The BASELINE.json north star stages map-output partitions "from pinned host
+buffers into TPU HBM via DLPack/jax.device_put" and names GPU->TPU DLPack
+interop as a benchmark config. This module is that seam: zero-copy import
+and export of device/host arrays through the DLPack protocol, with
+jax.device_put as the HBM on-ramp."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def from_external(tensor: Any) -> jnp.ndarray:
+    """Import any __dlpack__-capable tensor (torch, cupy, numpy...) into
+    JAX without copying when the producer's memory space allows it."""
+    if hasattr(tensor, "__dlpack__"):
+        return jnp.from_dlpack(tensor)
+    # plain numpy (no device handshake needed)
+    return jnp.asarray(np.asarray(tensor))
+
+
+def to_external(arr: jnp.ndarray, consumer: str = "numpy") -> Any:
+    """Export a JAX array through DLPack. ``consumer``: numpy | torch."""
+    if consumer == "numpy":
+        return np.asarray(jax.device_get(arr))
+    if consumer == "torch":
+        import torch
+        return torch.from_dlpack(arr)
+    raise ValueError(f"unknown consumer {consumer!r}")
+
+
+def stage_to_device(host_array: np.ndarray,
+                    device: Optional[jax.Device] = None) -> jnp.ndarray:
+    """Pinned-host -> HBM on-ramp: the device_put step the reference's
+    mmapped+registered files feed via RDMA (ref:
+    CommonUcxShuffleBlockResolver.scala:45-57 — registration makes host
+    bytes DMA-reachable; here device_put performs the DMA)."""
+    return jax.device_put(host_array, device)

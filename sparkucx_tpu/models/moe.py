@@ -1,0 +1,188 @@
+"""Flagship model: expert-parallel MoE riding the shuffle data plane.
+
+SURVEY.md §2.6: the reference's shuffle primitive *is* an MoE-style ragged
+dispatch — R reducers pulling ragged segments from M mappers is exactly E
+experts pulling ragged token segments from P token shards. This module
+demonstrates (and stress-tests) that claim: the expert dispatch AND combine
+are the framework's own :func:`sparkucx_tpu.shuffle.alltoall.exchange`
+collective, differentiable end-to-end, so a training step drives the whole
+data plane — hash-free routing (router logits instead of key hashes) but
+the identical segment-table/exchange machinery.
+
+Parallelism: mesh axes ``(dp, ep)`` — tokens sharded over both, experts
+sharded over ``ep`` and replicated over ``dp``; dispatch crosses only the
+``ep`` axis (each data-parallel row dispatches within itself), so gradient
+psum over ``dp`` is handled by shard_map's replicated-input transpose.
+
+Token overflow per expert follows standard MoE capacity semantics: tokens
+beyond an expert's capacity are dropped (contribute zero). Exchange-level
+capacity overflow NaN-poisons activations (see alltoall.exchange): a
+collapsed router that overflows recv_capacity turns the loss NaN loudly
+instead of silently zeroing the batch; raise ``capacity_factor`` to fix.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sparkucx_tpu.shuffle.alltoall import exchange
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_hidden: int = 128
+    num_experts: int = 8
+    tokens_per_shard: int = 64     # static per-(dp,ep)-shard token count
+    capacity_factor: float = 2.0   # exchange + expert capacity headroom
+    impl: str = "auto"             # data-plane implementation
+
+    @property
+    def recv_capacity(self) -> int:
+        return max(8, int(self.tokens_per_shard * self.capacity_factor))
+
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> Dict[str, jnp.ndarray]:
+    """Global (unsharded) parameter pytree."""
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    s = cfg.d_model ** -0.5
+    return {
+        "router": jax.random.normal(k1, (cfg.d_model, cfg.num_experts)) * s,
+        "w1": jax.random.normal(
+            k2, (cfg.num_experts, cfg.d_model, cfg.d_hidden)) * s,
+        "w2": jax.random.normal(
+            k3, (cfg.num_experts, cfg.d_hidden, cfg.d_model))
+        * cfg.d_hidden ** -0.5,
+        "wout": jax.random.normal(k4, (cfg.d_model, cfg.d_model)) * s,
+    }
+
+
+def param_specs(cfg: MoEConfig, dp: str = "dp", ep: str = "ep"):
+    """shard_map in_specs for the param pytree: experts sharded over ep,
+    everything else replicated."""
+    return {
+        "router": P(),
+        "w1": P(ep),
+        "w2": P(ep),
+        "wout": P(),
+    }
+
+
+def _moe_shard(params, x, *, cfg: MoEConfig, ep_axis: str, ep_size: int):
+    """Per-shard forward: route -> dispatch (exchange) -> expert FFN ->
+    combine (exchange back) -> unsort. x: [T, D] local tokens."""
+    T = cfg.tokens_per_shard
+    E = cfg.num_experts
+    e_local = E // ep_size
+    cap_out = cfg.recv_capacity
+
+    # -- route (top-1) ----------------------------------------------------
+    logits = x @ params["router"]                       # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(logits, axis=-1)                # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    # -- dispatch over ep: destination shard owns expert block -----------
+    dest = (expert // e_local).astype(jnp.int32)        # [T]
+    order = jnp.argsort(dest, stable=True)
+    inv_order = jnp.argsort(order)                      # unsort permutation
+    x_sorted = jnp.take(x, order, axis=0)
+    counts = jnp.bincount(dest, length=ep_size).astype(jnp.int32)
+    recv = exchange(x_sorted, counts, ep_axis, cap_out, cfg.impl)  # [cap,D]
+
+    # -- local expert assignment of received tokens ----------------------
+    # recompute routing on received rows (router is replicated, argmax is
+    # deterministic — the reader-side recompute trick from shuffle/reader)
+    rlogits = recv @ params["router"]
+    rexpert = jnp.argmax(rlogits, axis=-1)
+    shard_id = jax.lax.axis_index(ep_axis)
+    le = rexpert - shard_id * e_local                   # local expert id
+    # my receive total: column `shard_id` of the gathered count matrix —
+    # also reused below as the reverse-exchange size row
+    recv_sizes = jax.lax.all_gather(counts, ep_axis)[:, shard_id]
+    my_recv = recv_sizes.sum()
+    j = jnp.arange(cap_out, dtype=jnp.int32)
+    rvalid = j < my_recv
+
+    # -- group by local expert, capacity-bounded scatter ------------------
+    cap_e = max(8, int(cap_out * cfg.capacity_factor / max(e_local, 1)))
+    le_key = jnp.where(rvalid, le.astype(jnp.int32), jnp.int32(e_local))
+    eorder = jnp.argsort(le_key, stable=True)
+    le_sorted = jnp.take(le_key, eorder)
+    rows_sorted = jnp.take(recv, eorder, axis=0)
+    ecounts = jnp.bincount(le_sorted, length=e_local + 1)[:e_local]
+    excl = jnp.concatenate(
+        [jnp.zeros((1,), ecounts.dtype), jnp.cumsum(ecounts)[:-1]])
+    le_c = jnp.minimum(le_sorted, e_local - 1)
+    within = jnp.arange(cap_out, dtype=jnp.int32) - excl[le_c].astype(jnp.int32)
+    fits = (within < cap_e) & (le_sorted < e_local)
+    within_c = jnp.clip(within, 0, cap_e - 1)
+    ebuf = jnp.zeros((e_local, cap_e, cfg.d_model), x.dtype)
+    ebuf = ebuf.at[le_c, within_c].add(
+        jnp.where(fits[:, None], rows_sorted, 0.0))
+
+    # -- expert FFN on the MXU: batched per-expert matmuls ----------------
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", ebuf, params["w1"]))
+    y = jnp.einsum("ech,ehd->ecd", h, params["w2"])     # [e_local,cap_e,D]
+
+    # -- un-scatter to received order, combine back -----------------------
+    out_sorted = jnp.where(fits[:, None], y[le_c, within_c], 0.0)
+    out_recv = jnp.zeros_like(recv).at[eorder].set(out_sorted)
+    # reverse exchange: send back what we received (sizes = what each peer
+    # sent us); result arrives in our original destination-sorted layout
+    back = exchange(out_recv, recv_sizes.astype(jnp.int32), ep_axis,
+                    T, cfg.impl)                        # [T, D]
+    combined = jnp.take(back, inv_order, axis=0)        # original order
+    out = combined * gate[:, None]
+    return out @ params["wout"]
+
+
+def forward(params, x, mesh: Mesh, cfg: MoEConfig,
+            dp_axis: str = "dp", ep_axis: str = "ep"):
+    """Full-model forward under shard_map. x: [B, D] global tokens,
+    B = dp*ep*tokens_per_shard."""
+    ep_size = dict(zip(mesh.axis_names, mesh.devices.shape))[ep_axis]
+    fn = functools.partial(_moe_shard, cfg=cfg, ep_axis=ep_axis,
+                           ep_size=ep_size)
+    sm = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs(cfg, dp_axis, ep_axis), P((dp_axis, ep_axis))),
+        out_specs=P((dp_axis, ep_axis)))
+    return sm(params, x)
+
+
+def loss_fn(params, x, y, mesh, cfg, dp_axis="dp", ep_axis="ep"):
+    pred = forward(params, x, mesh, cfg, dp_axis, ep_axis)
+    return jnp.mean((pred - y) ** 2)
+
+
+def make_train_step(mesh: Mesh, cfg: MoEConfig, lr: float = 1e-3,
+                    dp_axis: str = "dp", ep_axis: str = "ep"):
+    """Jitted full training step (fwd + bwd through both exchanges + SGD).
+
+    The gradient of the dispatch/combine collectives flows through the
+    custom VJP in shuffle/alltoall.py — the transposed exchange."""
+
+    import optax
+    opt = optax.adam(lr)
+
+    def init(rng):
+        params = init_params(rng, cfg)
+        return params, opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, x, y, mesh, cfg, dp_axis, ep_axis)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init, step

@@ -100,6 +100,31 @@ def partition_and_pack(
     return send_rows, counts.astype(jnp.int32), parts_sorted
 
 
+def range_partition(keys, bounds):
+    """keys -> partition via sorted split points (TeraSort-style range
+    partitioner: partition r holds keys in [bounds[r-1], bounds[r]) so
+    concatenating sorted partitions yields a globally sorted sequence).
+
+    ``bounds`` — [R-1] ascending split points, typically sampled quantiles
+    (the role of Spark's RangePartitioner sampling).
+
+    numpy inputs stay in numpy: jnp would silently truncate int64 keys to
+    int32 with x64 off, corrupting 64-bit sort keys host-side. The jnp
+    path serves device-resident (int32-safe) routing."""
+    import numpy as np
+    if isinstance(keys, np.ndarray):
+        return np.searchsorted(np.asarray(bounds), keys,
+                               side="right").astype(np.int32)
+    return jnp.searchsorted(bounds, keys, side="right").astype(jnp.int32)
+
+
+def sample_bounds(keys, num_partitions: int):
+    """Host-side quantile sampling for range partitioning."""
+    import numpy as np
+    qs = np.linspace(0, 1, num_partitions + 1)[1:-1]
+    return np.quantile(np.asarray(keys), qs).astype(np.asarray(keys).dtype)
+
+
 def blocked_partition_map(num_partitions: int, num_devices: int) -> jnp.ndarray:
     """Default reduce-partition -> device assignment: contiguous blocks,
     remainder spread over the first partitions (Spark's grouping of reduce

@@ -33,6 +33,7 @@ All three share static shapes (SURVEY.md §7 hard part (a)): callers choose
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import Optional
 
@@ -160,6 +161,56 @@ def _a2a_dense(data, local_sizes, axis_name, out_capacity, peer_capacity):
                                 out_capacity, axis_name) \
         | (jax.lax.psum(local_seg_bad.astype(jnp.int32), axis_name) > 0)
     return ShuffleResult(out, recv, total.reshape(1), overflow.reshape(1))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def exchange(data: jnp.ndarray, local_sizes: jnp.ndarray, axis_name: str,
+             out_capacity: int, impl: str = "auto") -> jnp.ndarray:
+    """Differentiable ragged exchange — the MoE-dispatch form of the data
+    plane (SURVEY.md §2.6: the shuffle primitive IS expert-parallel ragged
+    dispatch; same kernel serves both).
+
+    Forward: move destination-sorted rows, return the packed receive
+    buffer. Backward: the cotangent exchange is the SAME collective with
+    the transposed plan — each device sends back the gradient segments it
+    received, which land exactly in the sender's original segment layout.
+    Sizes are integer routing data and get no gradient.
+
+    Overflow policy: there is no host retry loop inside a training step, so
+    a capacity overflow NaN-poisons the (float) output instead of returning
+    silently zeroed activations — the loss goes NaN loudly and the caller
+    fixes the capacity. Integer payloads cannot be poisoned; use
+    :func:`ragged_shuffle` directly and check ``overflow`` for those."""
+    return _exchange_impl(data, local_sizes, axis_name, out_capacity, impl)
+
+
+def _exchange_impl(data, local_sizes, axis_name, out_capacity, impl):
+    r = ragged_shuffle(data, local_sizes, axis_name,
+                       out_capacity=out_capacity, impl=impl)
+    if jnp.issubdtype(r.data.dtype, jnp.floating):
+        poison = jnp.where(r.overflow[0], jnp.nan, 0.0).astype(r.data.dtype)
+        return r.data + poison
+    return r.data
+
+
+def _exchange_fwd(data, local_sizes, axis_name, out_capacity, impl):
+    r = ragged_shuffle(data, local_sizes, axis_name,
+                       out_capacity=out_capacity, impl=impl)
+    out = r.data
+    if jnp.issubdtype(out.dtype, jnp.floating):
+        poison = jnp.where(r.overflow[0], jnp.nan, 0.0).astype(out.dtype)
+        out = out + poison
+    return out, (local_sizes, r.recv_sizes, data.shape[0])
+
+
+def _exchange_bwd(axis_name, out_capacity, impl, res, g):
+    local_sizes, recv_sizes, cap_in = res
+    rb = ragged_shuffle(g, recv_sizes, axis_name,
+                        out_capacity=cap_in, impl=impl)
+    return rb.data, jnp.zeros_like(local_sizes)
+
+
+exchange.defvjp(_exchange_fwd, _exchange_bwd)
 
 
 def ragged_shuffle(data: jnp.ndarray, local_sizes: jnp.ndarray, axis_name: str,

@@ -30,7 +30,13 @@ from sparkucx_tpu.meta.registry import ShuffleEntry
 from sparkucx_tpu.meta.segments import validate_row_sizes
 from sparkucx_tpu.runtime.node import TpuNode
 from sparkucx_tpu.shuffle.plan import ShufflePlan, make_plan
-from sparkucx_tpu.shuffle.reader import ShuffleReaderResult, read_shuffle
+from sparkucx_tpu.shuffle.reader import (
+    KEY_WORDS,
+    ShuffleReaderResult,
+    pack_rows,
+    read_shuffle,
+    value_words,
+)
 from sparkucx_tpu.shuffle.writer import MapOutputWriter
 from sparkucx_tpu.utils.logging import get_logger
 
@@ -45,10 +51,13 @@ class ShuffleHandle:
     num_maps: int
     num_partitions: int
     entry: ShuffleEntry = field(repr=False)
+    partitioner: str = "hash"
 
     def __post_init__(self):
         if self.num_maps <= 0 or self.num_partitions <= 0:
             raise ValueError("num_maps and num_partitions must be positive")
+        if self.partitioner not in ("hash", "direct"):
+            raise ValueError(f"unknown partitioner {self.partitioner!r}")
 
 
 class TpuShuffleManager:
@@ -77,9 +86,12 @@ class TpuShuffleManager:
 
     # -- lifecycle --------------------------------------------------------
     def register_shuffle(self, shuffle_id: int, num_maps: int,
-                         num_partitions: int) -> ShuffleHandle:
+                         num_partitions: int,
+                         partitioner: str = "hash") -> ShuffleHandle:
         """Allocate the metadata table for a shuffle
-        (ref: CommonUcxShuffleManager.scala:39-56)."""
+        (ref: CommonUcxShuffleManager.scala:39-56). ``partitioner`` is the
+        Spark Partitioner-SPI analog: 'hash' groups by key hash; 'direct'
+        treats keys as precomputed partition ids (range partitioning)."""
         entry = self.node.registry.register(shuffle_id, num_maps,
                                             num_partitions)
         with self._lock:
@@ -87,7 +99,8 @@ class TpuShuffleManager:
         log.info("registered shuffle %d: %d maps x %d partitions "
                  "(table %d B)", shuffle_id, num_maps, num_partitions,
                  len(entry.table))
-        return ShuffleHandle(shuffle_id, num_maps, num_partitions, entry)
+        return ShuffleHandle(shuffle_id, num_maps, num_partitions, entry,
+                             partitioner)
 
     def get_writer(self, handle: ShuffleHandle,
                    map_id: int) -> MapOutputWriter:
@@ -96,7 +109,8 @@ class TpuShuffleManager:
         if not (0 <= map_id < handle.num_maps):
             raise IndexError(
                 f"mapId {map_id} out of range [0,{handle.num_maps})")
-        w = MapOutputWriter(handle.entry, map_id, self.node.pool)
+        w = MapOutputWriter(handle.entry, map_id, self.node.pool,
+                            partitioner=handle.partitioner)
         with self._lock:
             self._writers[handle.shuffle_id][map_id] = w
         return w
@@ -130,10 +144,12 @@ class TpuShuffleManager:
             writers = dict(self._writers[handle.shuffle_id])
         shard_outputs = [[] for _ in range(Pn)]
         has_vals = False
+        val_tail, val_dtype = None, None
         for map_id, w in sorted(writers.items()):
             keys, values = w.materialize()
             if values is not None and keys.shape[0]:
                 has_vals = True
+                val_tail, val_dtype = values.shape[1:], values.dtype
             shard_outputs[map_id % Pn].append((keys, values))
         if has_vals:
             for outs in shard_outputs:
@@ -151,34 +167,30 @@ class TpuShuffleManager:
             blocked_partition_map(handle.num_partitions, Pn))
         validate_row_sizes(table.device_matrix(map_to_dev, red_to_dev, Pn))
 
-        key_dtype = np.int64
-        val_tail, val_dtype = (), None
-        for outs in shard_outputs:
-            for keys, values in outs:
-                if keys.shape[0]:
-                    key_dtype = keys.dtype
-                if values is not None and values.shape[0]:
-                    val_tail, val_dtype = values.shape[1:], values.dtype
         nvalid = np.array(
             [sum(k.shape[0] for k, _ in outs) for outs in shard_outputs],
             dtype=np.int64)
-        plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf)
+        plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
+                         partitioner=handle.partitioner)
 
-        shard_keys = np.zeros((Pn, plan.cap_in), dtype=key_dtype)
-        shard_vals = np.zeros((Pn, plan.cap_in) + tuple(val_tail),
-                              dtype=val_dtype) if has_vals else None
+        # fuse key+value bytes into one int32 row matrix (bit views, no
+        # value casts — jnp would silently truncate int64 with x64 off)
+        width = KEY_WORDS + (value_words(val_tail, val_dtype)
+                             if has_vals else 0)
+        shard_rows = np.zeros((Pn, plan.cap_in, width), dtype=np.int32)
         for p in range(Pn):
             off = 0
             for keys, values in shard_outputs[p]:
                 n = keys.shape[0]
-                shard_keys[p, off:off + n] = keys
-                if has_vals and n:
-                    shard_vals[p, off:off + n] = values
+                if n:
+                    shard_rows[p, off:off + n] = pack_rows(
+                        keys, values if has_vals else None, width)
                 off += n
 
         with self.node.metrics.timeit("shuffle.read"):
             result = read_shuffle(self.exchange_mesh, self.axis, plan,
-                                  shard_keys, shard_vals, nvalid)
+                                  shard_rows, nvalid,
+                                  val_tail if has_vals else None, val_dtype)
         self.node.metrics.inc("shuffle.rows", float(nvalid.sum()))
         return result
 

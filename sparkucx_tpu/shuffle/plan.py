@@ -38,13 +38,14 @@ class ShufflePlan:
     cap_in: int
     cap_out: int
     impl: str
+    partitioner: str = "hash"  # hash | direct (keys ARE partition ids)
     max_retries: int = 4
 
     def grown(self) -> "ShufflePlan":
         """Next plan after an overflow: double the receive capacity."""
         return ShufflePlan(self.num_shards, self.num_partitions,
                            self.cap_in, self.cap_out * 2, self.impl,
-                           self.max_retries)
+                           self.partitioner, self.max_retries)
 
 
 def make_plan(
@@ -52,6 +53,7 @@ def make_plan(
     num_shards: int,
     num_partitions: int,
     conf: Optional[TpuShuffleConf] = None,
+    partitioner: str = "hash",
 ) -> ShufflePlan:
     """Derive capacities from per-shard staged row counts.
 
@@ -64,10 +66,13 @@ def make_plan(
     cap_in = _round_up(int(np.max(shard_rows, initial=0)))
     balanced = total / max(num_shards, 1)
     cap_out = _round_up(int(np.ceil(balanced * conf.capacity_factor)))
+    if partitioner not in ("hash", "direct"):
+        raise ValueError(f"unknown partitioner {partitioner!r}")
     return ShufflePlan(
         num_shards=num_shards,
         num_partitions=num_partitions,
         cap_in=cap_in,
         cap_out=cap_out,
         impl=conf.a2a_impl,
+        partitioner=partitioner,
     )

@@ -4,13 +4,21 @@ The reference's reduce side is a per-(mapper, reducer) storm of one-sided
 reads driven by a spinning progress thread (call stack at SURVEY.md §3.4).
 The TPU build collapses all of it into ONE jitted SPMD step over the mesh:
 
-    stage:   [P, cap_in] keys/values staged per shard (host, pinned pool)
-    device:  hash -> destination sort -> ragged all-to-all -> partition sort
+    stage:   [P, cap_in, W] int32 row matrix staged per shard (host pool)
+    device:  route -> destination sort -> ragged all-to-all -> partition sort
     fetch:   per-reduce-partition slices, densely packed per shard
 
 so the reference's headline property — mapper CPU does nothing per fetch —
 becomes "host does nothing per block": no per-block round-trips exist at
 all, only one compiled program launch (SURVEY.md §7 hard part (c)).
+
+Transport format: rows are fused int32 columns — ``[key_lo, key_hi,
+value_words...]`` — produced by bit-exact views on the host (never dtype
+casts: jnp would silently truncate int64 with x64 off). Routing uses the
+low 32 key bits, which is exactly what the 32-bit mixing hash consumes, so
+host-published size rows and device routing agree for 64-bit keys. One
+fused stream also means ONE exchange per shuffle instead of one per
+column family.
 
 Overflow handling: the data plane flags capacity overflow mesh-wide; the
 reader retries with a doubled plan (one recompile) rather than
@@ -20,26 +28,31 @@ provisioning worst-case HBM up front.
 from __future__ import annotations
 
 import functools
-from typing import Dict, List, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from sparkucx_tpu.ops.partition import hash_partition, partition_and_pack
+from sparkucx_tpu.ops.partition import blocked_partition_map, hash_partition
 from sparkucx_tpu.shuffle.alltoall import ragged_shuffle
 from sparkucx_tpu.shuffle.plan import ShufflePlan
 from sparkucx_tpu.utils.logging import get_logger
 
 log = get_logger("shuffle.reader")
 
+KEY_WORDS = 2  # int64 key as two int32 columns [lo, hi]
+
+
+@functools.lru_cache(maxsize=32)
+def _blocked_map(num_partitions: int, num_devices: int):
+    return blocked_partition_map(num_partitions, num_devices)
+
 
 @functools.lru_cache(maxsize=64)
-def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan,
-                key_dtype: str, val_shape: Optional[Tuple[int, ...]],
-                val_dtype: Optional[str]):
-    """Compile the exchange step for one (mesh, plan, dtypes) signature.
+def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
+    """Compile the exchange step for one (mesh, plan, row width).
 
     lru_cache keys on the hashable plan — the jit-cache discipline that
     keeps one compiled program per shape family."""
@@ -47,66 +60,100 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan,
     Pn = plan.num_shards
     part_to_dest = _blocked_map(R, Pn)
 
-    def step(keys, values, nvalid):
-        # keys [cap_in], values [cap_in, ...] or dummy, nvalid [1]
-        send_keys, counts, _ = partition_and_pack(
-            keys, keys, nvalid[0], R, part_to_dest, Pn)
-        rk = ragged_shuffle(send_keys, counts, axis,
-                            out_capacity=plan.cap_out, impl=plan.impl)
-        if values is not None:
-            # same routing rule applied to the value rows; counts are
-            # identical by construction so the exchange plan is shared
-            send_vals, _, _ = partition_and_pack(
-                keys, values, nvalid[0], R, part_to_dest, Pn)
-            rv = ragged_shuffle(send_vals, counts, axis,
-                                out_capacity=plan.cap_out, impl=plan.impl)
-            vals_recv = rv.data
-        else:
-            vals_recv = None
-        # receiver: recompute partition ids from keys (no id stream needed),
-        # group by partition
-        j = jnp.arange(plan.cap_out, dtype=jnp.int32)
-        valid = j < rk.total[0]
-        parts = jnp.where(valid, hash_partition(rk.data, R), jnp.int32(R))
-        order2 = jnp.argsort(parts, stable=True)
-        keys_out = jnp.take(rk.data, order2, axis=0)
-        parts_sorted = jnp.take(parts, order2)
-        pcounts = jnp.bincount(parts_sorted, length=R + 1)[:R]
-        outs = [keys_out, pcounts.astype(jnp.int32), rk.total, rk.overflow]
-        if vals_recv is not None:
-            outs.insert(1, jnp.take(vals_recv, order2, axis=0))
-        return tuple(outs)
+    def part_fn(key_lo):
+        # pluggable partitioner (Spark's Partitioner SPI analog): hash for
+        # key-grouping shuffles, direct for pre-partitioned routing (range
+        # partitioners, TeraSort) where the key IS the partition id
+        if plan.partitioner == "direct":
+            return jnp.clip(key_lo, 0, R - 1)
+        return hash_partition(key_lo, R)
 
-    has_vals = val_shape is not None
-    out_specs = (P(axis),) * (5 if has_vals else 4)
-    sm = jax.shard_map(
-        (lambda k, v, n: step(k, v, n)) if has_vals
-        else (lambda k, n: step(k, None, n)),
-        mesh=mesh,
-        in_specs=(P(axis),) * (3 if has_vals else 2),
-        out_specs=out_specs)
+    def step(payload, nvalid):
+        # payload [cap_in, width] int32, col 0 = key_lo; nvalid [1]
+        part = part_fn(payload[:, 0])
+        dest = jnp.take(part_to_dest, part)
+        idx = jnp.arange(payload.shape[0], dtype=jnp.int32)
+        sort_key = jnp.where(idx < nvalid[0], dest, jnp.int32(Pn))
+        order = jnp.argsort(sort_key, stable=True)
+        send = jnp.take(payload, order, axis=0)
+        counts = jnp.bincount(sort_key, length=Pn + 1)[:Pn].astype(jnp.int32)
+
+        r = ragged_shuffle(send, counts, axis,
+                           out_capacity=plan.cap_out, impl=plan.impl)
+
+        # receive side: group rows by partition (recomputed from key_lo)
+        j = jnp.arange(plan.cap_out, dtype=jnp.int32)
+        valid = j < r.total[0]
+        parts = jnp.where(valid, part_fn(r.data[:, 0]), jnp.int32(R))
+        order2 = jnp.argsort(parts, stable=True)
+        rows_out = jnp.take(r.data, order2, axis=0)
+        pcounts = jnp.bincount(
+            jnp.take(parts, order2), length=R + 1)[:R].astype(jnp.int32)
+        return rows_out, pcounts, r.total, r.overflow
+
+    sm = jax.shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
+                       out_specs=(P(axis),) * 4)
     return jax.jit(sm)
 
 
-@functools.lru_cache(maxsize=32)
-def _blocked_map(num_partitions: int, num_devices: int):
-    from sparkucx_tpu.ops.partition import blocked_partition_map
-    return blocked_partition_map(num_partitions, num_devices)
+def pack_rows(keys: np.ndarray, values: Optional[np.ndarray],
+              width: int) -> np.ndarray:
+    """Host-side fuse: int64 keys + arbitrary fixed-width values into an
+    int32 row matrix via bit views (never value casts)."""
+    n = keys.shape[0]
+    out = np.zeros((n, width), dtype=np.int32)
+    out[:, :KEY_WORDS] = np.ascontiguousarray(
+        keys.astype(np.int64, copy=False)).view(np.int32).reshape(n, 2)
+    if values is not None and n:
+        vb = np.ascontiguousarray(values).view(np.uint8).reshape(n, -1)
+        pad = (-vb.shape[1]) % 4
+        if pad:
+            vb = np.concatenate(
+                [vb, np.zeros((n, pad), np.uint8)], axis=1)
+        vw = vb.shape[1] // 4
+        out[:, KEY_WORDS:KEY_WORDS + vw] = vb.view(np.int32).reshape(n, vw)
+    return out
+
+
+def value_words(val_shape: Tuple[int, ...], val_dtype) -> int:
+    nbytes = int(np.prod(val_shape, dtype=np.int64)) * np.dtype(val_dtype).itemsize
+    return (nbytes + 3) // 4
+
+
+def unpack_rows(rows: np.ndarray, val_shape: Optional[Tuple[int, ...]],
+                val_dtype) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Inverse of pack_rows for a [n, width] int32 block."""
+    n = rows.shape[0]
+    if n == 0:
+        keys = np.zeros(0, dtype=np.int64)
+        values = (np.zeros((0,) + tuple(val_shape), dtype=val_dtype)
+                  if val_shape is not None else None)
+        return keys, values
+    keys = np.ascontiguousarray(
+        rows[:, :KEY_WORDS]).view(np.int64).reshape(n)
+    if val_shape is None:
+        return keys, None
+    vw = value_words(val_shape, val_dtype)
+    nbytes = int(np.prod(val_shape, dtype=np.int64)) * np.dtype(val_dtype).itemsize
+    vb = np.ascontiguousarray(
+        rows[:, KEY_WORDS:KEY_WORDS + vw]).view(np.uint8).reshape(n, -1)
+    values = vb[:, :nbytes].copy().view(val_dtype).reshape((n,) + tuple(val_shape))
+    return keys, values
 
 
 class ShuffleReaderResult:
     """Host-side view of one completed exchange."""
 
     def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
-                 keys: np.ndarray, values: Optional[np.ndarray],
-                 pcounts: np.ndarray):
-        # keys: [P, cap_out]; pcounts: [P, R]
+                 rows: np.ndarray, pcounts: np.ndarray,
+                 val_shape: Optional[Tuple[int, ...]], val_dtype):
+        # rows: [P, cap_out, width] int32; pcounts: [P, R]
         self.num_partitions = num_partitions
         self._part_to_shard = part_to_shard
-        self._keys = keys
-        self._values = values
+        self._rows = rows
         self._pcounts = pcounts
-        # per shard: partitions sorted ascending -> offsets via cumsum
+        self._val_shape = val_shape
+        self._val_dtype = val_dtype
         self._offsets = np.zeros_like(pcounts)
         np.cumsum(pcounts[:, :-1], axis=1, out=self._offsets[:, 1:])
 
@@ -115,10 +162,8 @@ class ShuffleReaderResult:
         shard = int(self._part_to_shard[r])
         start = int(self._offsets[shard, r])
         n = int(self._pcounts[shard, r])
-        k = self._keys[shard, start:start + n]
-        v = self._values[shard, start:start + n] \
-            if self._values is not None else None
-        return k, v
+        return unpack_rows(self._rows[shard, start:start + n],
+                           self._val_shape, self._val_dtype)
 
     def partitions(self):
         for r in range(self.num_partitions):
@@ -129,47 +174,34 @@ def read_shuffle(
     mesh: Mesh,
     axis: str,
     plan: ShufflePlan,
-    shard_keys: np.ndarray,
-    shard_values: Optional[np.ndarray],
+    shard_rows: np.ndarray,
     shard_nvalid: np.ndarray,
+    val_shape: Optional[Tuple[int, ...]],
+    val_dtype,
 ) -> ShuffleReaderResult:
     """Run the exchange with overflow retry.
 
-    shard_keys   — [P, cap_in] staged keys per shard (padding arbitrary)
-    shard_values — [P, cap_in, ...] or None
+    shard_rows   — [P, cap_in, width] fused int32 rows per shard
     shard_nvalid — [P] valid row counts
     """
     Pn = plan.num_shards
     R = plan.num_partitions
-    part_to_dest = np.asarray(_blocked_map(R, Pn))
-    part_to_shard = part_to_dest  # blocked: dest device owns the partition
+    width = shard_rows.shape[2]
+    part_to_shard = np.asarray(_blocked_map(R, Pn))
 
     cur = plan
     for attempt in range(plan.max_retries + 1):
-        has_vals = shard_values is not None
-        step = _build_step(
-            mesh, axis, cur, str(shard_keys.dtype),
-            tuple(shard_values.shape[2:]) if has_vals else None,
-            str(shard_values.dtype) if has_vals else None)
-        keys_flat = jnp.asarray(shard_keys.reshape(-1))
+        step = _build_step(mesh, axis, cur, width)
+        rows_flat = jnp.asarray(
+            shard_rows.reshape(-1, width))
         nvalid = jnp.asarray(shard_nvalid.astype(np.int32).reshape(-1))
-        if has_vals:
-            vals_flat = jnp.asarray(
-                shard_values.reshape((-1,) + shard_values.shape[2:]))
-            out = step(keys_flat, vals_flat, nvalid)
-            keys_out, vals_out, pcounts, total, ovf = out
-        else:
-            out = step(keys_flat, nvalid)
-            keys_out, pcounts, total, ovf = out
-            vals_out = None
+        rows_out, pcounts, total, ovf = step(rows_flat, nvalid)
         if not np.asarray(ovf).any():
             return ShuffleReaderResult(
                 R, part_to_shard,
-                np.asarray(keys_out).reshape(Pn, cur.cap_out),
-                np.asarray(vals_out).reshape(
-                    (Pn, cur.cap_out) + shard_values.shape[2:])
-                if vals_out is not None else None,
-                np.asarray(pcounts).reshape(Pn, R))
+                np.asarray(rows_out).reshape(Pn, cur.cap_out, width),
+                np.asarray(pcounts).reshape(Pn, R),
+                val_shape, val_dtype)
         log.info("shuffle overflow at cap_out=%d (attempt %d); growing",
                  cur.cap_out, attempt)
         cur = cur.grown()

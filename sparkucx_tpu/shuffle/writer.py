@@ -46,10 +46,11 @@ class MapOutputWriter:
     """Writer for one map task's output (one row of the segment table)."""
 
     def __init__(self, entry: ShuffleEntry, map_id: int,
-                 pool: HostMemoryPool):
+                 pool: HostMemoryPool, partitioner: str = "hash"):
         self.entry = entry
         self.map_id = map_id
         self.pool = pool
+        self.partitioner = partitioner
         self._keys: List[np.ndarray] = []
         self._values: List[np.ndarray] = []
         self._staged: List[ArenaBuffer] = []
@@ -64,6 +65,12 @@ class MapOutputWriter:
         keys = np.ascontiguousarray(keys)
         if keys.ndim != 1:
             raise ValueError("keys must be 1-D")
+        if not np.issubdtype(keys.dtype, np.integer):
+            raise ValueError(
+                f"keys must be integers, got {keys.dtype}; put non-integer "
+                f"sort keys in the value payload")
+        if keys.dtype != np.int64:
+            keys = keys.astype(np.int64)
         if values is not None:
             values = np.ascontiguousarray(values)
             if values.shape[0] != keys.shape[0]:
@@ -102,9 +109,18 @@ class MapOutputWriter:
         with Timer() as t:
             if self._keys:
                 keys = np.concatenate(self._keys)
-                parts = _hash32_np(keys) % np.uint32(num_partitions)
-                sizes = np.bincount(parts.astype(np.int64),
-                                    minlength=num_partitions)
+                if self.partitioner == "direct":
+                    if (keys < 0).any() or (keys >= num_partitions).any():
+                        bad = keys[(keys < 0) | (keys >= num_partitions)][:4]
+                        raise ValueError(
+                            f"direct partitioner: keys must be partition "
+                            f"ids in [0, {num_partitions}); got e.g. "
+                            f"{bad.tolist()}")
+                    parts = keys.astype(np.int64)
+                else:
+                    parts = (_hash32_np(keys)
+                             % np.uint32(num_partitions)).astype(np.int64)
+                sizes = np.bincount(parts, minlength=num_partitions)
             else:
                 sizes = np.zeros(num_partitions, dtype=np.int64)
             self.entry.publish(self.map_id, sizes)

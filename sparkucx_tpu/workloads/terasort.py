@@ -1,0 +1,62 @@
+"""TeraSort workload — the BASELINE.md headline benchmark shape.
+
+HiBench Terasort = range-partition by key, shuffle, sort each partition
+locally; concatenating partitions in order yields the globally sorted
+dataset. Uses the manager's ``direct`` partitioner (the Spark
+RangePartitioner analog): routing keys are precomputed range ids from
+sampled split points, the true sort key rides in the value payload.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from sparkucx_tpu.ops.partition import range_partition, sample_bounds
+from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+
+def run_terasort(manager: TpuShuffleManager, *, num_mappers: int = 8,
+                 rows_per_mapper: int = 2000, num_partitions: int = 32,
+                 shuffle_id: int = 9002, seed: int = 0) -> Dict[str, int]:
+    """Distributed sort of random uint keys; verifies global order."""
+    rng = np.random.default_rng(seed)
+    shards = [rng.integers(0, 1 << 40, size=rows_per_mapper).astype(np.int64)
+              for _ in range(num_mappers)]
+    # sampled split points (the RangePartitioner reservoir-sampling role)
+    sample = np.concatenate([s[:: max(1, len(s) // 64)] for s in shards])
+    bounds = sample_bounds(sample, num_partitions)
+
+    h = manager.register_shuffle(shuffle_id, num_mappers, num_partitions,
+                                 partitioner="direct")
+    try:
+        for m, keys in enumerate(shards):
+            w = manager.get_writer(h, m)
+            part = np.asarray(range_partition(keys, bounds),
+                              dtype=np.int64)
+            w.write(part, keys.reshape(-1, 1))
+            w.commit(num_partitions)
+        res = manager.read(h)
+
+        out = []
+        rows = 0
+        for r in range(num_partitions):
+            pid, v = res.partition(r)
+            assert (pid == r).all(), "direct routing misplaced rows"
+            local = np.sort(v[:, 0])
+            # range invariant: partition r's keys fall inside its bounds
+            if local.size:
+                if r > 0:
+                    assert local[0] >= bounds[r - 1]
+                if r < num_partitions - 1:
+                    assert local[-1] <= bounds[r]
+            out.append(local)
+            rows += local.size
+        merged = np.concatenate(out)
+        want = np.sort(np.concatenate(shards))
+        if not np.array_equal(merged, want):
+            raise AssertionError("terasort output is not globally sorted")
+        return {"rows": rows, "partitions": num_partitions}
+    finally:
+        manager.unregister_shuffle(shuffle_id)

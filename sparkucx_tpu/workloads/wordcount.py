@@ -1,0 +1,42 @@
+"""WordCount — HiBench bigdata-profile shape (BASELINE.md configs).
+
+Map side emits (word-id, 1) pairs; the shuffle groups by word; reducers
+sum. Counts are verified exactly against a host dictionary."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+
+def run_wordcount(manager: TpuShuffleManager, *, num_mappers: int = 8,
+                  words_per_mapper: int = 5000, vocab: int = 1000,
+                  num_partitions: int = 32, shuffle_id: int = 9003,
+                  seed: int = 0) -> Dict[str, int]:
+    rng = np.random.default_rng(seed)
+    h = manager.register_shuffle(shuffle_id, num_mappers, num_partitions)
+    try:
+        truth: Dict[int, int] = {}
+        for m in range(num_mappers):
+            w = manager.get_writer(h, m)
+            # zipf-ish skewed word distribution, the realistic stressor
+            words = (rng.zipf(1.3, size=words_per_mapper) % vocab).astype(
+                np.int64)
+            w.write(words, np.ones((words_per_mapper, 1), dtype=np.float32))
+            w.commit(num_partitions)
+            for x in words:
+                truth[int(x)] = truth.get(int(x), 0) + 1
+        res = manager.read(h)
+        got: Dict[int, int] = {}
+        for r, (k, v) in res.partitions():
+            for ki, vi in zip(k, v[:, 0]):
+                got[int(ki)] = got.get(int(ki), 0) + int(vi)
+        if got != truth:
+            raise AssertionError("wordcount totals mismatch")
+        return {"distinct_words": len(got),
+                "total_words": num_mappers * words_per_mapper}
+    finally:
+        manager.unregister_shuffle(shuffle_id)

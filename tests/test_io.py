@@ -1,0 +1,113 @@
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.runtime.node import TpuNode
+from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+pa = pytest.importorskip("pyarrow")
+
+from sparkucx_tpu.io.arrow import (  # noqa: E402
+    batch_to_kv,
+    kv_to_batch,
+    read_batches,
+    write_batches,
+)
+from sparkucx_tpu.io.dlpack import from_external, stage_to_device, to_external  # noqa: E402
+
+
+@pytest.fixture()
+def manager(mesh8):
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    m = TpuShuffleManager(node, conf)
+    yield m
+    m.stop()
+    node.close()
+
+
+def test_batch_kv_roundtrip(rng):
+    keys = rng.integers(0, 1 << 40, size=32).astype(np.int64)
+    a = rng.normal(size=32)
+    b = rng.integers(0, 100, size=32).astype(np.int64)
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array(keys), pa.array(a), pa.array(b)], names=["k", "a", "b"])
+    k, v, dtypes = batch_to_kv(batch, "k")
+    np.testing.assert_array_equal(k, keys)
+    back = kv_to_batch(k, v, "k", ["a", "b"], dtypes)
+    np.testing.assert_array_equal(back.column("a").to_numpy(), a)
+    np.testing.assert_array_equal(back.column("b").to_numpy(), b)
+    assert back.schema.field("a").type == pa.float64()
+    assert back.schema.field("b").type == pa.int64()
+
+
+def test_batch_kv_bit_exact_large_int64(rng):
+    """int64 values beyond 2^53 (nanosecond timestamps) must survive the
+    shuffle bit-exactly — a float64 carrier would round them."""
+    ts = np.array([1_700_000_000_123_456_789, (1 << 62) + 1, -7],
+                  dtype=np.int64)
+    keys = np.arange(3, dtype=np.int64)
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array(keys), pa.array(ts)], names=["k", "ts"])
+    k, v, dtypes = batch_to_kv(batch, "k")
+    back = kv_to_batch(k, v, "k", ["ts"], dtypes)
+    np.testing.assert_array_equal(back.column("ts").to_numpy(), ts)
+
+
+def test_batch_kv_validation(rng):
+    batch = pa.RecordBatch.from_arrays(
+        [pa.array(["x", "y"]), pa.array([1.0, 2.0])], names=["k", "v"])
+    with pytest.raises(TypeError):
+        batch_to_kv(batch, "k")
+    with pytest.raises(KeyError):
+        batch_to_kv(batch, "missing")
+
+
+def test_arrow_shuffle_end_to_end(manager, rng):
+    """Columnar in -> shuffle -> columnar out (the Spark-RAPIDS-style
+    interop path from BASELINE.md)."""
+    R = 8
+    h = manager.register_shuffle(9200, 4, R)
+    truth = {}
+    for m in range(4):
+        keys = rng.integers(0, 200, size=100).astype(np.int64)
+        vals = rng.normal(size=100)
+        batch = pa.RecordBatch.from_arrays(
+            [pa.array(keys), pa.array(vals)], names=["key", "score"])
+        write_batches(manager, h, m, [batch], "key")
+        for k, v in zip(keys, vals):
+            truth.setdefault(int(k), []).append(v)
+    batches = read_batches(manager, h, "key", ["score"])
+    rows = 0
+    for b in batches:
+        ks = b.column("key").to_numpy()
+        vs = b.column("score").to_numpy()
+        for k, v in zip(ks, vs):
+            assert any(np.isclose(v, c) for c in truth[int(k)])
+        rows += len(ks)
+    assert rows == 400
+    manager.unregister_shuffle(9200)
+
+
+def test_dlpack_numpy_roundtrip(rng):
+    x = rng.normal(size=(16, 4)).astype(np.float32)
+    arr = from_external(x)
+    back = to_external(arr, "numpy")
+    np.testing.assert_array_equal(back, x)
+
+
+def test_dlpack_torch_roundtrip(rng):
+    torch = pytest.importorskip("torch")
+    t = torch.arange(24, dtype=torch.float32).reshape(6, 4)
+    arr = from_external(t)
+    assert arr.shape == (6, 4)
+    back = to_external(arr, "torch")
+    assert torch.equal(back.cpu(), t)
+
+
+def test_stage_to_device(rng):
+    import jax
+    x = rng.normal(size=(8, 8)).astype(np.float32)
+    arr = stage_to_device(x, jax.devices()[0])
+    np.testing.assert_allclose(np.asarray(arr), x)

@@ -218,3 +218,12 @@ def test_writer_non_contiguous_input(manager, rng):
     assert w.num_rows == 10
     w.commit(4)
     manager.unregister_shuffle(9)
+
+
+def test_direct_partitioner_rejects_out_of_range(manager):
+    h = manager.register_shuffle(10, 1, 4, partitioner="direct")
+    w = manager.get_writer(h, 0)
+    w.write(np.array([0, 3, 99], dtype=np.int64))
+    with pytest.raises(ValueError, match=r"\[0, 4\)"):
+        w.commit(4)
+    manager.unregister_shuffle(10)

@@ -1,0 +1,99 @@
+"""Flagship MoE model: forward correctness + differentiability of the
+exchange-based dispatch/combine on the (dp, ep) CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from sparkucx_tpu.models.moe import (
+    MoEConfig,
+    forward,
+    init_params,
+    make_train_step,
+)
+
+CFG = MoEConfig(d_model=16, d_hidden=32, num_experts=8, tokens_per_shard=16,
+                impl="dense")
+
+
+@pytest.fixture(scope="module")
+def mesh_dp_ep():
+    devs = np.array(jax.devices()).reshape(2, 4)
+    return Mesh(devs, ("dp", "ep"))
+
+
+def _dense_reference(params, x, cfg):
+    """Oracle: same top-1 MoE computed densely without any dispatch."""
+    logits = x @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(logits, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    h = jax.nn.gelu(jnp.einsum("td,edh->teh", x, params["w1"]))
+    y = jnp.einsum("teh,ehd->ted", h, params["w2"])
+    own = jnp.take_along_axis(
+        y, expert[:, None, None].repeat(cfg.d_model, axis=2), axis=1)[:, 0]
+    return (own * gate[:, None]) @ params["wout"]
+
+
+def test_forward_matches_dense_oracle(mesh_dp_ep):
+    cfg = CFG
+    rng = jax.random.PRNGKey(0)
+    params = init_params(rng, cfg)
+    B = 2 * 4 * cfg.tokens_per_shard
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, cfg.d_model))
+    got = forward(params, x, mesh_dp_ep, cfg)
+    want = _dense_reference(params, x, cfg)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_train_step_learns(mesh_dp_ep):
+    cfg = CFG
+    init, step = make_train_step(mesh_dp_ep, cfg, lr=3e-3)
+    params, opt_state = init(jax.random.PRNGKey(0))
+    B = 2 * 4 * cfg.tokens_per_shard
+    kx, ky = jax.random.split(jax.random.PRNGKey(2))
+    x = jax.random.normal(kx, (B, cfg.d_model))
+    y = jax.random.normal(ky, (B, cfg.d_model)) * 0.1
+    losses = []
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses[::10]
+    assert np.isfinite(losses).all()
+
+
+def test_gradients_flow_through_exchange(mesh_dp_ep):
+    """Router and expert weights must receive nonzero grads through the
+    dispatch/combine collectives (custom VJP path)."""
+    from sparkucx_tpu.models.moe import loss_fn
+    cfg = CFG
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    B = 2 * 4 * cfg.tokens_per_shard
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, cfg.d_model))
+    y = jnp.zeros((B, cfg.d_model))
+    grads = jax.grad(loss_fn)(params, x, y, mesh_dp_ep, cfg)
+    for name in ("w1", "w2", "wout", "router"):
+        g = np.asarray(grads[name])
+        assert np.isfinite(g).all(), name
+        assert np.abs(g).max() > 0, f"zero grad for {name}"
+
+
+def test_exchange_overflow_poisons_loss(mesh_dp_ep):
+    """A collapsed router that overflows the exchange capacity must surface
+    as a NaN loss, not silently-zeroed activations."""
+    from sparkucx_tpu.models.moe import loss_fn
+    cfg = MoEConfig(d_model=16, d_hidden=32, num_experts=8,
+                    tokens_per_shard=16, capacity_factor=1.0, impl="dense")
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    # bias the router so every token picks expert 0 -> shard 0 receives
+    # 4x its capacity
+    params = dict(params)
+    params["router"] = params["router"].at[:, 0].set(100.0)
+    B = 2 * 4 * cfg.tokens_per_shard
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, cfg.d_model))
+    y = jnp.zeros((B, cfg.d_model))
+    loss = loss_fn(params, x, y, mesh_dp_ep, cfg)
+    assert not np.isfinite(float(loss))

@@ -1,0 +1,74 @@
+"""The e2e workload suite — GroupBy + SparkTC are the reference CI's
+correctness jobs (ref: buildlib/test.sh:162-172); TeraSort/WordCount/ALS
+cover the BASELINE.md benchmark configs."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.runtime.node import TpuNode
+from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+from sparkucx_tpu.workloads.als import run_als
+from sparkucx_tpu.workloads.groupby import run_groupby
+from sparkucx_tpu.workloads.tc import run_tc
+from sparkucx_tpu.workloads.terasort import run_terasort
+from sparkucx_tpu.workloads.wordcount import run_wordcount
+
+
+@pytest.fixture(scope="module")
+def manager(request):
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    m = TpuShuffleManager(node, conf)
+    yield m
+    m.stop()
+    node.close()
+
+
+def test_groupby(manager):
+    out = run_groupby(manager, num_mappers=8, pairs_per_mapper=500,
+                      key_space=100, num_partitions=16)
+    assert out["rows"] == 4000
+    assert out["distinct_keys"] == 100
+
+
+def test_terasort(manager):
+    out = run_terasort(manager, num_mappers=8, rows_per_mapper=1000,
+                       num_partitions=16)
+    assert out["rows"] == 8000
+
+
+def test_transitive_closure(manager):
+    out = run_tc(manager, num_vertices=30, num_edges=70)
+    assert out["closure"] >= out["edges"]
+    assert out["iterations"] >= 2
+
+
+def test_wordcount_zipf_skew(manager):
+    out = run_wordcount(manager, num_mappers=4, words_per_mapper=2000,
+                        vocab=300, num_partitions=16)
+    assert out["total_words"] == 8000
+
+
+def test_als_converges(manager):
+    out = run_als(manager, iterations=3)
+    assert out["rmse_final"] < out["rmse_initial"] * 0.5
+
+
+def test_terasort_direct_partitioner_hotpath(manager):
+    """Direct partitioner routes partition ids verbatim — ids must land on
+    their blocked owner with zero misroutes even under duplicates."""
+    h = manager.register_shuffle(9100, 2, 8, partitioner="direct")
+    w0 = manager.get_writer(h, 0)
+    w0.write(np.array([0, 0, 7, 3], dtype=np.int64))
+    w0.commit(8)
+    w1 = manager.get_writer(h, 1)
+    w1.write(np.array([3, 3, 3, 7], dtype=np.int64))
+    w1.commit(8)
+    res = manager.read(h)
+    assert res.partition(0)[0].size == 2
+    assert res.partition(3)[0].size == 4
+    assert res.partition(7)[0].size == 2
+    assert res.partition(1)[0].size == 0
+    manager.unregister_shuffle(9100)
