@@ -35,7 +35,7 @@ def run(rows_log2: int, val_words: int, iters: int, warmup: int,
     from jax.sharding import Mesh, PartitionSpec as P
 
     from sparkucx_tpu.ops.partition import blocked_partition_map, \
-        hash_partition, partition_and_pack
+        destination_sort, hash_partition
     from sparkucx_tpu.shuffle.alltoall import ragged_shuffle
 
     devs = jax.devices()
@@ -51,16 +51,14 @@ def run(rows_log2: int, val_words: int, iters: int, warmup: int,
     def step(payload):
         # the production hot path (shuffle/reader.py): route on key_lo,
         # destination sort, one fused exchange, receive-side grouping
-        part = hash_partition(payload[:, 0], R)
-        dest = jnp.take(part_to_dest, part)
-        order = jnp.argsort(dest, stable=True)
-        send = jnp.take(payload, order, axis=0)
-        counts = jnp.bincount(dest, length=nchips).astype(jnp.int32)
+        dest = jnp.take(part_to_dest, hash_partition(payload[:, 0], R))
+        send, counts = destination_sort(
+            payload, dest, payload.shape[0], nchips)
         r = ragged_shuffle(send, counts, "shuffle",
                            out_capacity=cap_out, impl="auto")
-        parts = hash_partition(r.data[:, 0], R)
-        order2 = jnp.argsort(parts, stable=True)
-        return jnp.take(r.data, order2, axis=0), r.overflow
+        rows_out, _ = destination_sort(
+            r.data, hash_partition(r.data[:, 0], R), r.total[0], R)
+        return rows_out, r.overflow
 
     fn = jax.jit(jax.shard_map(
         step, mesh=mesh, in_specs=(P("shuffle"),),

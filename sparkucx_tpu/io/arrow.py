@@ -111,14 +111,31 @@ def write_batches(manager, handle, map_id: int,
     read_batches)."""
     _require_arrow()
     w = manager.get_writer(handle, map_id)
-    dtypes: List[np.dtype] = []
+    recipe: Optional[List[np.dtype]] = None
     for b in batches:
         keys, values, dtypes = batch_to_kv(b, key_column)
-        if keys.shape[0]:
-            w.write(keys, values)
+        if not keys.shape[0]:
+            continue
+        if recipe is None:
+            recipe = dtypes
+        elif dtypes != recipe:
+            raise ValueError(
+                f"batch schema mismatch within map {map_id}: "
+                f"{dtypes} vs {recipe}")
+        w.write(keys, values)
+    # Recipe checks must precede commit: once committed, the output is
+    # published to the metadata plane and a blocked reader may decode it —
+    # a mismatch found later would already be a silent bit
+    # reinterpretation on the read side. setdefault keeps the
+    # check-then-set atomic under concurrent map tasks.
+    if recipe is not None:
+        winner = handle.__dict__.setdefault("_arrow_value_dtypes", recipe)
+        if list(winner) != list(recipe):
+            raise ValueError(
+                f"value schema mismatch across map tasks: map {map_id} "
+                f"wrote {recipe}, an earlier task wrote {list(winner)}")
     w.commit(num_partitions or handle.num_partitions)
-    handle.__dict__.setdefault("_arrow_value_dtypes", dtypes)
-    return dtypes
+    return recipe or []
 
 
 def read_batches(manager, handle, key_column: str = "key",

@@ -149,7 +149,16 @@ class TpuShuffleManager:
             keys, values = w.materialize()
             if values is not None and keys.shape[0]:
                 has_vals = True
-                val_tail, val_dtype = values.shape[1:], values.dtype
+                if val_dtype is None:
+                    val_tail, val_dtype = values.shape[1:], values.dtype
+                elif (values.shape[1:], values.dtype) != (val_tail,
+                                                          val_dtype):
+                    # bit-reinterpreting one writer's rows under another's
+                    # schema would silently corrupt — reject up front
+                    raise ValueError(
+                        f"mixed value schema across map outputs: mapId "
+                        f"{map_id} wrote {values.dtype}{values.shape[1:]}, "
+                        f"earlier outputs wrote {val_dtype}{val_tail}")
             shard_outputs[map_id % Pn].append((keys, values))
         if has_vals:
             for outs in shard_outputs:

@@ -35,7 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from sparkucx_tpu.ops.partition import blocked_partition_map, hash_partition
+from sparkucx_tpu.ops.partition import (
+    blocked_partition_map, destination_sort, hash_partition)
 from sparkucx_tpu.shuffle.alltoall import ragged_shuffle
 from sparkucx_tpu.shuffle.plan import ShufflePlan
 from sparkucx_tpu.utils.logging import get_logger
@@ -70,25 +71,15 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
 
     def step(payload, nvalid):
         # payload [cap_in, width] int32, col 0 = key_lo; nvalid [1]
-        part = part_fn(payload[:, 0])
-        dest = jnp.take(part_to_dest, part)
-        idx = jnp.arange(payload.shape[0], dtype=jnp.int32)
-        sort_key = jnp.where(idx < nvalid[0], dest, jnp.int32(Pn))
-        order = jnp.argsort(sort_key, stable=True)
-        send = jnp.take(payload, order, axis=0)
-        counts = jnp.bincount(sort_key, length=Pn + 1)[:Pn].astype(jnp.int32)
+        dest = jnp.take(part_to_dest, part_fn(payload[:, 0]))
+        send, counts = destination_sort(payload, dest, nvalid[0], Pn)
 
         r = ragged_shuffle(send, counts, axis,
                            out_capacity=plan.cap_out, impl=plan.impl)
 
         # receive side: group rows by partition (recomputed from key_lo)
-        j = jnp.arange(plan.cap_out, dtype=jnp.int32)
-        valid = j < r.total[0]
-        parts = jnp.where(valid, part_fn(r.data[:, 0]), jnp.int32(R))
-        order2 = jnp.argsort(parts, stable=True)
-        rows_out = jnp.take(r.data, order2, axis=0)
-        pcounts = jnp.bincount(
-            jnp.take(parts, order2), length=R + 1)[:R].astype(jnp.int32)
+        rows_out, pcounts = destination_sort(
+            r.data, part_fn(r.data[:, 0]), r.total[0], R)
         return rows_out, pcounts, r.total, r.overflow
 
     sm = jax.shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
