@@ -16,7 +16,6 @@ data-dependent Python control flow.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

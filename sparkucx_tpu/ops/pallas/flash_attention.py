@@ -1,0 +1,156 @@
+"""Pallas flash-attention kernel — the MXU hot path for the attention ops.
+
+The reference has no compute kernels (its native layer is the external UCX
+C library, SURVEY.md §0); this framework's equivalent of "drop to native
+for the hot path" is a Pallas kernel feeding the MXU. The kernel computes
+one (batch*head, q-block) tile per grid step, streaming K/V blocks from
+VMEM with the online-softmax recurrence — the same math as
+:func:`sparkucx_tpu.ops.attention.blockwise_attention`, which remains both
+the CPU fallback and the backward implementation (flash backward
+rematerialises anyway; the scan's VJP is the memory-equivalent form).
+
+Use :func:`flash_attention`; it dispatches pallas-on-TPU / scan-elsewhere
+and is differentiable either way.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from sparkucx_tpu.ops.attention import NEG_INF, blockwise_attention
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, scale: float,
+               causal: bool, block_q: int):
+    """One [block_q, D] output tile; K/V streamed in [block_k, D] slices."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    T = k_ref.shape[1]
+    nk = T // block_k
+    bq, d = q.shape
+
+    row = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (bq, block_k), 0)
+    col0 = jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1)
+
+    def body(i, carry):
+        o, m, l = carry
+        k_blk = k_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [bq, bk]
+        if causal:
+            col = i * block_k + col0
+            s = jnp.where(col <= row, s, NEG_INF)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        dead = m_new <= NEG_INF / 2
+        m_safe = jnp.where(dead, 0.0, m_new)
+        alpha = jnp.where(dead, 1.0, jnp.exp(m - m_safe))
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(dead[:, None], 0.0, p)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        o_new = o * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    if causal:
+        # blocks strictly past the diagonal contribute nothing; bound the
+        # loop at the last block that intersects this q tile
+        nk_live = jnp.minimum(
+            nk, ((qi + 1) * block_q + block_k - 1) // block_k)
+    else:
+        nk_live = nk
+    o, m, l = jax.lax.fori_loop(0, nk_live, body, (o0, m0, l0))
+    denom = jnp.where(l <= 0.0, 1.0, l)
+    o_ref[0] = (o / denom[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd_pallas(q, k, v, block_q: int, block_k: int, causal: bool,
+                      scale: float, interpret: bool):
+    B, H, T, D = q.shape
+    # snap blocks down to divisors of T so any length compiles; gcd keeps
+    # lane-aligned sizes for the common power-of-two lengths
+    bq = math.gcd(min(block_q, T), T)
+    bk = math.gcd(min(block_k, T), T)
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, T, D)
+    vf = v.reshape(B * H, T, D)
+    grid = (B * H, T // bq)
+    kernel = functools.partial(_fa_kernel, block_k=bk, scale=scale,
+                               causal=causal, block_q=bq)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, block_q, block_k, causal, scale, interpret):
+    return _flash_fwd_pallas(q, k, v, block_q, block_k, causal, scale,
+                             interpret)
+
+
+def _flash_fwd(q, k, v, block_q, block_k, causal, scale, interpret):
+    return _flash(q, k, v, block_q, block_k, causal, scale, interpret), \
+        (q, k, v)
+
+
+def _flash_bwd(block_q, block_k, causal, scale, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(
+            q, k, v, block_k=block_k, causal=causal, scale=scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    block_q: int = 256, block_k: int = 256,
+                    causal: bool = False, scale: Optional[float] = None,
+                    impl: str = "auto") -> jax.Array:
+    """[B, H, T, D] attention; pallas kernel on TPU, scan fallback on CPU.
+
+    ``impl``: 'auto' | 'pallas' | 'interpret' (pallas interpreter — CPU
+    debugging) | 'scan'.
+    """
+    scale_ = q.shape[-1] ** -0.5 if scale is None else scale
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "scan"
+    if impl == "scan":
+        return blockwise_attention(q, k, v, block_k=block_k, causal=causal,
+                                   scale=scale_)
+    if impl not in ("pallas", "interpret"):
+        raise ValueError(f"unknown flash_attention impl {impl!r}")
+    return _flash(q, k, v, block_q, block_k, causal, scale_,
+                  impl == "interpret")
+
+
+__all__ = ["flash_attention"]

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkucx_tpu.ops.attention import (
-    _block_update, _finalize, make_block_bias)
+    NEG_INF, _block_update, _finalize, make_block_bias)
 
 
 def _ring_attention_sharded(q, k, v, axis: str, causal: bool,
@@ -53,11 +53,16 @@ def _ring_attention_sharded(q, k, v, axis: str, causal: bool,
         return (k_nxt, v_nxt, o, m, l), None
 
     o0 = jnp.zeros_like(q)
-    m0 = jnp.full(q.shape[:-1], -1e30, q.dtype)
+    m0 = jnp.full(q.shape[:-1], NEG_INF, q.dtype)
     l0 = jnp.zeros(q.shape[:-1], q.dtype)
-    (k_f, v_f, o, m, l), _ = jax.lax.scan(
-        step, (k, v, o0, m0, l0), jnp.arange(p))
-    del k_f, v_f
+    # scan the first p-1 hops (each ends with a rotation feeding the next
+    # step), then consume the final resident block without rotating — the
+    # p-th ppermute pair would only move KV that is never read again
+    (k_last, v_last, o, m, l), _ = jax.lax.scan(
+        step, (k, v, o0, m0, l0), jnp.arange(p - 1))
+    src = jax.lax.rem(idx + 1, p)  # idx - (p-1) mod p
+    bias = make_block_bias(t, t, idx * t, src * t, causal)
+    o, m, l = _block_update(q, k_last, v_last, o, m, l, bias, scale_)
     return _finalize(o, m, l)
 
 

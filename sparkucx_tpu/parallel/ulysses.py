@@ -25,11 +25,12 @@ from typing import Optional
 import jax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from sparkucx_tpu.ops.attention import blockwise_attention
+from sparkucx_tpu.ops.pallas.flash_attention import flash_attention
 
 
 def _ulysses_sharded(q, k, v, axis: str, causal: bool,
-                     scale: Optional[float], block_k: int):
+                     scale: Optional[float], block_q: int, block_k: int,
+                     impl: str):
     """Per-device body. q/k/v local: [B, H, t, D] (seq-sharded)."""
     # seq-sharded [B, H, t, D] -> head-sharded [B, H/P, T, D]:
     # split axis 1 (heads) across peers, concat axis 2 (seq) from peers
@@ -42,15 +43,15 @@ def _ulysses_sharded(q, k, v, axis: str, causal: bool,
                                   tiled=True)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    oh = blockwise_attention(qh, kh, vh, block_k=block_k, causal=causal,
-                             scale=scale)
+    oh = flash_attention(qh, kh, vh, block_q=block_q, block_k=block_k,
+                         causal=causal, scale=scale, impl=impl)
     return to_seq(oh)
 
 
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                       axis: str = "sp", causal: bool = False,
-                      scale: Optional[float] = None,
-                      block_k: int = 512) -> jax.Array:
+                      scale: Optional[float] = None, block_q: int = 256,
+                      block_k: int = 512, impl: str = "auto") -> jax.Array:
     """Global-view Ulysses attention.
 
     ``q``/``k``/``v``: [B, H, T, D]; both H and T must divide by the
@@ -64,7 +65,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     pspec = P(None, None, axis, None)
     fn = jax.shard_map(
         functools.partial(_ulysses_sharded, axis=axis, causal=causal,
-                          scale=scale, block_k=block_k),
+                          scale=scale, block_q=block_q, block_k=block_k,
+                          impl=impl),
         mesh=mesh, in_specs=(pspec, pspec, pspec),
         out_specs=pspec, check_vma=False)
     return fn(q, k, v)

@@ -1,0 +1,92 @@
+"""Pallas kernels via the interpreter (XLA:CPU has no Mosaic backend);
+the same code paths compile on TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkucx_tpu.ops.attention import reference_attention
+from sparkucx_tpu.ops.pallas.flash_attention import flash_attention
+from sparkucx_tpu.ops.pallas.quant import dequantize_rows, quantize_rows
+
+B, H, T, D = 2, 4, 128, 32
+
+
+def _qkv(key=0):
+    ks = jax.random.split(jax.random.PRNGKey(key), 3)
+    return tuple(jax.random.normal(k, (B, H, T, D), jnp.float32)
+                 for k in ks)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_interpret_matches_reference(causal):
+    q, k, v = _qkv()
+    ref = reference_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, block_q=32, block_k=32, causal=causal,
+                          impl="interpret")
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_scan_fallback_matches_reference():
+    q, k, v = _qkv(1)
+    ref = reference_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, causal=True, impl="scan", block_k=32)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
+
+
+def test_flash_grad_matches_reference():
+    q, k, v = _qkv(2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=32, block_k=32,
+                                       causal=True, impl="interpret") ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_snaps_blocks_to_divisors():
+    # block sizes that don't divide T are snapped down (gcd), not rejected
+    q, k, v = _qkv(3)
+    ref = reference_attention(q, k, v, causal=True)
+    out = flash_attention(q, k, v, block_q=48, block_k=80, causal=True,
+                          impl="interpret")
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["jnp", "interpret"])
+def test_quantize_roundtrip(impl):
+    x = jax.random.normal(jax.random.PRNGKey(0), (256, 16)) * 10.0
+    q, s = quantize_rows(x, seed=7, impl=impl, block_n=64)
+    assert q.dtype == jnp.int8 and s.shape == (256, 1)
+    back = dequantize_rows(q, s)
+    # stochastic rounding error is bounded by one quantization step
+    step = np.asarray(s).reshape(-1, 1)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    assert (err < step + 1e-6).all(), (err / step).max()
+
+
+@pytest.mark.parametrize("impl", ["jnp", "interpret"])
+def test_quantize_zero_rows_stable(impl):
+    x = jnp.zeros((32, 8))
+    q, s = quantize_rows(x, seed=0, impl=impl, block_n=32)
+    assert not np.asarray(jnp.isnan(s)).any()
+    np.testing.assert_array_equal(np.asarray(dequantize_rows(q, s)), 0.0)
+
+
+def test_quantize_unbiased_mean():
+    # stochastic rounding: E[dequant] ~= x
+    x = jnp.full((4, 8), 0.3) * jnp.linspace(1, 4, 4)[:, None]
+    outs = []
+    for seed in range(200):
+        q, s = quantize_rows(x, seed=seed, impl="jnp")
+        outs.append(np.asarray(dequantize_rows(q, s)))
+    err = np.abs(np.mean(outs, axis=0) - np.asarray(x))
+    assert err.max() < 0.02, err.max()
