@@ -34,10 +34,12 @@ class ShuffleEntry:
     tracking (the wait/notify the reference does on workerAdresses and on
     request completion)."""
 
-    def __init__(self, shuffle_id: int, num_maps: int, num_partitions: int):
+    def __init__(self, shuffle_id: int, num_maps: int, num_partitions: int,
+                 partitioner: str = "hash"):
         self.shuffle_id = shuffle_id
         self.num_maps = num_maps
         self.num_partitions = num_partitions
+        self.partitioner = partitioner
         self.slot = record_size(num_partitions)
         self.table = bytearray(self.slot * num_maps)
         self._present = np.zeros(num_maps, dtype=bool)
@@ -99,11 +101,12 @@ class ShuffleRegistry:
         self._lock = threading.Lock()
 
     def register(self, shuffle_id: int, num_maps: int,
-                 num_partitions: int) -> ShuffleEntry:
+                 num_partitions: int,
+                 partitioner: str = "hash") -> ShuffleEntry:
         with self._lock:
             if shuffle_id in self._entries:
                 raise ValueError(f"shuffle {shuffle_id} already registered")
-            e = ShuffleEntry(shuffle_id, num_maps, num_partitions)
+            e = ShuffleEntry(shuffle_id, num_maps, num_partitions, partitioner)
             self._entries[shuffle_id] = e
             return e
 

@@ -36,9 +36,12 @@ import jax
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.meta.registry import ShuffleRegistry
 from sparkucx_tpu.parallel.mesh import make_shuffle_mesh
+from sparkucx_tpu.runtime.failures import (EpochManager, FaultInjector,
+                                           HealthMonitor, RetryPolicy)
 from sparkucx_tpu.runtime.memory import HostMemoryPool
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.metrics import Metrics
+from sparkucx_tpu.utils.trace import configure_from_conf
 
 log = get_logger("runtime.node")
 
@@ -69,6 +72,14 @@ class TpuNode:
         self.pool = HostMemoryPool(conf)
         self.registry = ShuffleRegistry()
         self.metrics = Metrics()
+        self.tracer = configure_from_conf(conf)
+        # Failure plane (SURVEY.md §5 do-better): injection sites, bounded
+        # retries, active liveness probing, epoch fencing for remesh.
+        self.faults = FaultInjector(conf)
+        self.retry_policy = RetryPolicy.from_conf(conf)
+        self.health = HealthMonitor(
+            self.mesh, timeout_ms=conf.connection_timeout_ms)
+        self.epochs = EpochManager()
         self._closed = False
         log.info("TpuNode up: %d devices, mesh axes %s",
                  len(jax.devices()), self.mesh.axis_names)

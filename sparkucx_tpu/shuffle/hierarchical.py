@@ -1,0 +1,130 @@
+"""Hierarchical multi-slice shuffle — two-stage ragged exchange (ICI, DCN).
+
+SURVEY.md §7 hard part (d): on one slice, the flat one-collective exchange
+(shuffle/reader.py) rides ICI and is optimal. Across slices a flat
+all-to-all over all P = S x D devices pushes most pairs over DCN — the slow
+inter-slice fabric — exactly the regime where the reference's one-big-read
+model "degrades to point-to-point transfers again". The classic fix is the
+two-stage decomposition of the all-to-all:
+
+    route (s, d) -> (s', d')  as  (s, d) --ICI--> (s, d') --DCN--> (s', d')
+
+    stage 1 (ici axis):  within each slice, exchange rows grouped by the
+                         *destination device index* d' — all traffic on ICI.
+    stage 2 (dcn axis):  exchange rows grouped by the *destination slice*
+                         s' at fixed device index d' — each row crosses DCN
+                         exactly once, on the one link pair that must carry
+                         it.
+
+Load balance falls out of the algebra: with T total rows, the stage-1
+intermediate at (s, d') holds (rows of slice s) ∩ (destined to device
+index d') ≈ T/S x 1/D = T/P — the same balanced share as the final state,
+so both stages run with the same capacity plan.
+
+Destinations are *recomputed from row keys* between stages (the partitioner
+is deterministic), so no routing metadata rides the wire — the same trick
+the reference plays by deriving block sizes from the index-file offsets
+instead of shipping a size manifest (ref: OnOffsetsFetchCallback.java:44-52).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sparkucx_tpu.ops.partition import destination_sort, hash_partition
+from sparkucx_tpu.shuffle.alltoall import ragged_shuffle
+from sparkucx_tpu.shuffle.plan import ShufflePlan
+from sparkucx_tpu.shuffle.reader import ShuffleReaderResult, _blocked_map
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("shuffle.hierarchical")
+
+
+@functools.lru_cache(maxsize=64)
+def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
+                     plan: ShufflePlan, width: int):
+    """Compile the two-stage exchange for one (mesh, plan, width).
+
+    Mesh must be 2-D ``(dcn=S, ici=D)``; global shard id g = s*D + d
+    matches ``mesh.devices.reshape(-1)`` order, so the flat
+    ``blocked_partition_map`` routing is identical to the flat reader's."""
+    S, D = mesh.devices.shape
+    R = plan.num_partitions
+    Pn = plan.num_shards
+    assert Pn == S * D, (Pn, S, D)
+    part_to_dest = _blocked_map(R, Pn)
+
+    def part_fn(key_lo):
+        if plan.partitioner == "direct":
+            return jnp.clip(key_lo, 0, R - 1)
+        return hash_partition(key_lo, R)
+
+    def step(payload, nvalid):
+        # payload [cap_in, W] int32, col 0 = key_lo; nvalid [1]
+        g = jnp.take(part_to_dest, part_fn(payload[:, 0]))  # global shard
+
+        # stage 1 — ICI: group by destination device index d' = g % D
+        send1, counts1 = destination_sort(
+            payload, g % D, nvalid[0], D)
+        r1 = ragged_shuffle(send1, counts1, ici_axis,
+                            out_capacity=plan.cap_out, impl=plan.impl)
+
+        # stage 2 — DCN: recompute destinations, group by slice s' = g // D
+        g2 = jnp.take(part_to_dest, part_fn(r1.data[:, 0]))
+        send2, counts2 = destination_sort(
+            r1.data, g2 // D, r1.total[0], S)
+        r2 = ragged_shuffle(send2, counts2, dcn_axis,
+                            out_capacity=plan.cap_out, impl=plan.impl)
+
+        # receive side: group rows by reduce partition
+        rows_out, pcounts = destination_sort(
+            r2.data, part_fn(r2.data[:, 0]), r2.total[0], R)
+        overflow = r1.overflow | r2.overflow
+        return rows_out, pcounts, r2.total, overflow
+
+    spec = P((dcn_axis, ici_axis))
+    sm = jax.shard_map(step, mesh=mesh, in_specs=(spec, spec),
+                       out_specs=(spec,) * 4)
+    return jax.jit(sm)
+
+
+def read_shuffle_hierarchical(
+    mesh: Mesh,
+    dcn_axis: str,
+    ici_axis: str,
+    plan: ShufflePlan,
+    shard_rows: np.ndarray,
+    shard_nvalid: np.ndarray,
+    val_shape,
+    val_dtype,
+) -> ShuffleReaderResult:
+    """Two-stage exchange with the same overflow-retry contract as the
+    flat :func:`sparkucx_tpu.shuffle.reader.read_shuffle`."""
+    Pn = plan.num_shards
+    R = plan.num_partitions
+    width = shard_rows.shape[2]
+    part_to_shard = np.asarray(_blocked_map(R, Pn))
+
+    cur = plan
+    for attempt in range(plan.max_retries + 1):
+        step = _build_hier_step(mesh, dcn_axis, ici_axis, cur, width)
+        rows_flat = jnp.asarray(shard_rows.reshape(-1, width))
+        nvalid = jnp.asarray(shard_nvalid.astype(np.int32).reshape(-1))
+        rows_out, pcounts, total, ovf = step(rows_flat, nvalid)
+        if not np.asarray(ovf).any():
+            return ShuffleReaderResult(
+                R, part_to_shard,
+                np.asarray(rows_out).reshape(Pn, cur.cap_out, width),
+                np.asarray(pcounts).reshape(Pn, R),
+                val_shape, val_dtype)
+        log.info("hierarchical overflow at cap_out=%d (attempt %d); growing",
+                 cur.cap_out, attempt)
+        cur = cur.grown()
+    raise RuntimeError(
+        f"hierarchical shuffle still overflowing after {plan.max_retries} "
+        f"retries (cap_out={cur.cap_out}); extreme skew — repartition")

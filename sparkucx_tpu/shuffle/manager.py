@@ -45,13 +45,18 @@ log = get_logger("shuffle.manager")
 
 @dataclass
 class ShuffleHandle:
-    """Broadcastable shuffle descriptor (UcxShuffleHandle analog)."""
+    """Broadcastable shuffle descriptor (UcxShuffleHandle analog).
+
+    ``epoch`` pins the handle to the mesh membership it was registered
+    under; a remesh invalidates it fail-fast (runtime/failures.py
+    EpochManager) instead of letting a collective hang."""
 
     shuffle_id: int
     num_maps: int
     num_partitions: int
     entry: ShuffleEntry = field(repr=False)
     partitioner: str = "hash"
+    epoch: int = 0
 
     def __post_init__(self):
         if self.num_maps <= 0 or self.num_partitions <= 0:
@@ -73,11 +78,17 @@ class TpuShuffleManager:
         self.axis = self.conf.mesh_ici_axis \
             if self.conf.mesh_ici_axis in mesh.axis_names \
             else mesh.axis_names[-1]
+        self.hierarchical = False
         if len(mesh.axis_names) > 1:
-            # Multi-axis mesh (dcn x shuffle): the flat one-collective
-            # exchange runs over ALL devices, so the step uses a flattened
-            # alias mesh; the hierarchical dcn-staged path is a separate
-            # optimization (parallel/collectives).
+            dcn = self.conf.mesh_dcn_axis
+            dcn_size = mesh.devices.shape[mesh.axis_names.index(dcn)] \
+                if dcn in mesh.axis_names else 1
+            # Multi-slice: prefer the two-stage ICI->DCN exchange
+            # (shuffle/hierarchical.py) so each row crosses DCN exactly
+            # once; `a2a.hierarchical=false` falls back to the flat
+            # one-collective exchange over a flattened alias mesh.
+            self.hierarchical = dcn_size > 1 and \
+                self.conf.get_bool("a2a.hierarchical", True)
             from jax.sharding import Mesh as _Mesh
             self.exchange_mesh = _Mesh(
                 mesh.devices.reshape(-1), (self.axis,))
@@ -93,14 +104,14 @@ class TpuShuffleManager:
         Spark Partitioner-SPI analog: 'hash' groups by key hash; 'direct'
         treats keys as precomputed partition ids (range partitioning)."""
         entry = self.node.registry.register(shuffle_id, num_maps,
-                                            num_partitions)
+                                            num_partitions, partitioner)
         with self._lock:
             self._writers[shuffle_id] = {}
         log.info("registered shuffle %d: %d maps x %d partitions "
                  "(table %d B)", shuffle_id, num_maps, num_partitions,
                  len(entry.table))
         return ShuffleHandle(shuffle_id, num_maps, num_partitions, entry,
-                             partitioner)
+                             partitioner, self.node.epochs.current)
 
     def get_writer(self, handle: ShuffleHandle,
                    map_id: int) -> MapOutputWriter:
@@ -110,7 +121,8 @@ class TpuShuffleManager:
             raise IndexError(
                 f"mapId {map_id} out of range [0,{handle.num_maps})")
         w = MapOutputWriter(handle.entry, map_id, self.node.pool,
-                            partitioner=handle.partitioner)
+                            partitioner=handle.partitioner,
+                            faults=self.node.faults)
         with self._lock:
             self._writers[handle.shuffle_id][map_id] = w
         return w
@@ -123,6 +135,9 @@ class TpuShuffleManager:
 
         Blocks until all map outputs are published, mirroring the metadata
         wait (ref: UcxWorkerWrapper.scala:134-143)."""
+        tracer = self.node.tracer
+        self.node.epochs.validate(handle.epoch,
+                                  f"shuffle {handle.shuffle_id}")
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
         if not handle.entry.wait_complete(timeout):
@@ -130,7 +145,11 @@ class TpuShuffleManager:
                 f"shuffle {handle.shuffle_id}: only "
                 f"{handle.entry.num_present}/{handle.num_maps} map outputs "
                 f"published within {timeout}s")
-        table = handle.entry.fetch_table()
+        # Metadata fetch is a retryable control-plane step (the reference
+        # leans on Spark task retry here; we carry our own policy).
+        table = self.node.retry_policy.run(
+            lambda: (self.node.faults.check("fetch"),
+                     handle.entry.fetch_table())[1])
 
         # Collect staged outputs, grouped round-robin onto mesh shards the
         # way multiple map tasks colocate on one executor. Keys and values
@@ -179,29 +198,63 @@ class TpuShuffleManager:
         nvalid = np.array(
             [sum(k.shape[0] for k, _ in outs) for outs in shard_outputs],
             dtype=np.int64)
-        plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
-                         partitioner=handle.partitioner)
+        with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
+            plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
+                             partitioner=handle.partitioner)
 
         # fuse key+value bytes into one int32 row matrix (bit views, no
         # value casts — jnp would silently truncate int64 with x64 off)
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)
-        shard_rows = np.zeros((Pn, plan.cap_in, width), dtype=np.int32)
-        for p in range(Pn):
-            off = 0
-            for keys, values in shard_outputs[p]:
-                n = keys.shape[0]
-                if n:
-                    shard_rows[p, off:off + n] = pack_rows(
-                        keys, values if has_vals else None, width)
-                off += n
+        with tracer.span("shuffle.pack", rows=int(nvalid.sum())):
+            shard_rows = np.zeros((Pn, plan.cap_in, width), dtype=np.int32)
+            for p in range(Pn):
+                off = 0
+                for keys, values in shard_outputs[p]:
+                    n = keys.shape[0]
+                    if n:
+                        shard_rows[p, off:off + n] = pack_rows(
+                            keys, values if has_vals else None, width)
+                    off += n
 
-        with self.node.metrics.timeit("shuffle.read"):
-            result = read_shuffle(self.exchange_mesh, self.axis, plan,
-                                  shard_rows, nvalid,
-                                  val_tail if has_vals else None, val_dtype)
+        self.node.faults.check("exchange")
+        with self.node.metrics.timeit("shuffle.read"), \
+                tracer.span("shuffle.exchange",
+                            shuffle_id=handle.shuffle_id,
+                            rows=int(nvalid.sum()), width=width,
+                            hierarchical=self.hierarchical):
+            vt = val_tail if has_vals else None
+            if self.hierarchical:
+                from sparkucx_tpu.shuffle.hierarchical import \
+                    read_shuffle_hierarchical
+                result = read_shuffle_hierarchical(
+                    self.node.mesh, self.conf.mesh_dcn_axis, self.axis,
+                    plan, shard_rows, nvalid, vt, val_dtype)
+            else:
+                result = read_shuffle(self.exchange_mesh, self.axis, plan,
+                                      shard_rows, nvalid, vt, val_dtype)
         self.node.metrics.inc("shuffle.rows", float(nvalid.sum()))
         return result
+
+    # -- checkpoint support ----------------------------------------------
+    def live_shuffles(self):
+        """Registered shuffle ids (snapshot enumeration)."""
+        with self._lock:
+            return sorted(self._writers.keys())
+
+    def export_shuffle(self, shuffle_id: int):
+        """{map_id: (keys, values, committed)} staged state for
+        runtime.checkpoint.snapshot_shuffles (shape + partitioner come
+        from the registry entry — the single source of truth)."""
+        with self._lock:
+            if shuffle_id not in self._writers:
+                raise KeyError(f"shuffle {shuffle_id} not registered")
+            writers = dict(self._writers[shuffle_id])
+        staged = {}
+        for map_id, w in writers.items():
+            keys, values = w.materialize()
+            staged[map_id] = (keys, values, w.committed)
+        return staged
 
     # -- teardown ---------------------------------------------------------
     def unregister_shuffle(self, shuffle_id: int) -> None:

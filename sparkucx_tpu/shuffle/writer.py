@@ -26,6 +26,7 @@ from sparkucx_tpu.meta.registry import ShuffleEntry
 from sparkucx_tpu.runtime.memory import ArenaBuffer, HostMemoryPool
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.metrics import Timer
+from sparkucx_tpu.utils.trace import GLOBAL_TRACER
 
 log = get_logger("shuffle.writer")
 
@@ -46,11 +47,13 @@ class MapOutputWriter:
     """Writer for one map task's output (one row of the segment table)."""
 
     def __init__(self, entry: ShuffleEntry, map_id: int,
-                 pool: HostMemoryPool, partitioner: str = "hash"):
+                 pool: HostMemoryPool, partitioner: str = "hash",
+                 faults=None):
         self.entry = entry
         self.map_id = map_id
         self.pool = pool
         self.partitioner = partitioner
+        self.faults = faults  # runtime.failures.FaultInjector, site "publish"
         self._keys: List[np.ndarray] = []
         self._values: List[np.ndarray] = []
         self._staged: List[ArenaBuffer] = []
@@ -98,6 +101,10 @@ class MapOutputWriter:
     def num_rows(self) -> int:
         return sum(k.shape[0] for k in self._keys)
 
+    @property
+    def committed(self) -> bool:
+        return self._committed
+
     def commit(self, num_partitions: int) -> np.ndarray:
         """Compute and publish this map output's size row; returns it.
 
@@ -106,7 +113,10 @@ class MapOutputWriter:
         (ref: CommonUcxShuffleBlockResolver.scala:78-103)."""
         if self._committed:
             raise RuntimeError("writer already committed")
-        with Timer() as t:
+        if self.faults is not None:
+            self.faults.check("publish")
+        with Timer() as t, GLOBAL_TRACER.span(
+                "shuffle.publish", map_id=self.map_id, rows=self.num_rows):
             if self._keys:
                 keys = np.concatenate(self._keys)
                 if self.partitioner == "direct":

@@ -47,3 +47,35 @@ def mesh8(devices):
 @pytest.fixture()
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture()
+def manager_factory(mesh8):
+    """Build a TpuShuffleManager with conf overrides; tears down after."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+    created = []
+
+    def make(overrides=None):
+        # TpuNode.start is an idempotent singleton: tear down any node this
+        # factory already made so the new conf actually takes effect.
+        while created:
+            m_old, node_old = created.pop()
+            m_old.stop()
+            node_old.close()
+        conf_map = {"spark.shuffle.tpu.a2a.impl": "dense"}
+        conf_map.update(overrides or {})
+        conf = TpuShuffleConf(conf_map, use_env=False)
+        node = TpuNode.start(conf)
+        assert node.conf is conf, \
+            "stale TpuNode singleton reused; a previous test leaked a node"
+        m = TpuShuffleManager(node, conf)
+        created.append((m, node))
+        return m
+
+    yield make
+    for m, node in created:
+        m.stop()
+        node.close()

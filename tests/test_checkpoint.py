@@ -1,0 +1,188 @@
+"""Checkpoint/resume tests (runtime/checkpoint.py).
+
+The reference persists nothing (SURVEY.md §5: durability = shuffle files
+on disk); these cover the do-better subsystem: Orbax train-state
+checkpoints with retention + resume, and shuffle-state snapshot/restore
+through the manager."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.runtime.checkpoint import (TrainCheckpointer,
+                                             restore_shuffles,
+                                             snapshot_shuffles)
+
+
+# -- TrainCheckpointer ----------------------------------------------------
+def make_state(step):
+    return {
+        "params": {"w": np.full((4, 4), float(step), np.float32),
+                   "b": np.arange(4, dtype=np.float32) * step},
+        "step": np.int64(step),
+    }
+
+
+def test_save_restore_roundtrip(tmp_path):
+    with TrainCheckpointer(str(tmp_path / "ckpt")) as ck:
+        state = make_state(1)
+        assert ck.save(1, state)
+        out = ck.restore(1)
+        np.testing.assert_array_equal(out["params"]["w"],
+                                      state["params"]["w"])
+        np.testing.assert_array_equal(out["params"]["b"],
+                                      state["params"]["b"])
+        assert int(out["step"]) == 1
+
+
+def test_latest_and_retention(tmp_path):
+    with TrainCheckpointer(str(tmp_path / "ckpt"), keep=2) as ck:
+        for s in (1, 2, 3):
+            ck.save(s, make_state(s))
+        assert ck.latest_step() == 3
+        assert ck.all_steps() == [2, 3]  # keep=2 pruned step 1
+        out = ck.restore()  # default: latest
+        assert float(out["params"]["w"][0, 0]) == 3.0
+
+
+def test_restore_empty_raises(tmp_path):
+    with TrainCheckpointer(str(tmp_path / "empty")) as ck:
+        with pytest.raises(FileNotFoundError):
+            ck.restore()
+
+
+def test_restore_with_target_pytree(tmp_path):
+    import jax
+
+    with TrainCheckpointer(str(tmp_path / "ckpt")) as ck:
+        state = make_state(5)
+        ck.save(5, state)
+        target = jax.tree.map(np.zeros_like, state)
+        out = ck.restore(5, target=target)
+        np.testing.assert_array_equal(out["params"]["w"],
+                                      state["params"]["w"])
+
+
+def test_resume_across_instances(tmp_path):
+    d = str(tmp_path / "ckpt")
+    with TrainCheckpointer(d) as ck:
+        ck.save(7, make_state(7))
+    # "job restart": new process/instance finds the old step
+    with TrainCheckpointer(d) as ck2:
+        assert ck2.latest_step() == 7
+        assert float(ck2.restore()["params"]["w"][0, 0]) == 7.0
+
+
+# -- shuffle snapshots ----------------------------------------------------
+def test_shuffle_snapshot_roundtrip(manager_factory, rng, tmp_path):
+    mgr = manager_factory()
+    h = mgr.register_shuffle(920, num_maps=3, num_partitions=8)
+    written = {}
+    for m in range(3):
+        w = mgr.get_writer(h, m)
+        keys = rng.integers(0, 1 << 20, size=40 + m)
+        vals = rng.standard_normal((40 + m, 2)).astype(np.float32)
+        w.write(keys, vals)
+        w.commit(h.num_partitions)
+        written[m] = (keys, vals)
+    snap = str(tmp_path / "snap")
+    assert snapshot_shuffles(mgr, snap) == 1
+
+    # simulate preemption: tear everything down, then resume
+    mgr.unregister_shuffle(920)
+    handles = restore_shuffles(mgr, snap)
+    assert set(handles) == {920}
+
+    entry = mgr.node.registry.get(920)
+    assert entry.num_present == 3
+    result = mgr.read(handles[920])
+    got = {}
+    for r, (keys, vals) in result.partitions():
+        for k, v in zip(keys, vals):
+            got.setdefault(int(k), []).append(v)
+    want = {}
+    for m, (keys, vals) in written.items():
+        for k, v in zip(keys, vals):
+            want.setdefault(int(k), []).append(v)
+    assert set(got) == set(want)
+    total_got = sum(len(v) for v in got.values())
+    assert total_got == sum(len(v) for v in want.values())
+    mgr.unregister_shuffle(920)
+
+
+def test_snapshot_uncommitted_writer(manager_factory, rng, tmp_path):
+    """An uncommitted writer survives as staged-but-unpublished."""
+    mgr = manager_factory()
+    h = mgr.register_shuffle(921, num_maps=2, num_partitions=4)
+    w0 = mgr.get_writer(h, 0)
+    w0.write(rng.integers(0, 100, size=10))
+    w0.commit(h.num_partitions)
+    w1 = mgr.get_writer(h, 1)
+    w1.write(rng.integers(0, 100, size=5))  # never committed
+    snap = str(tmp_path / "snap2")
+    snapshot_shuffles(mgr, snap)
+
+    mgr.unregister_shuffle(921)
+    restore_shuffles(mgr, snap)
+    entry = mgr.node.registry.get(921)
+    assert entry.num_present == 1  # only map 0 republished
+    mgr.unregister_shuffle(921)
+
+
+def test_snapshot_keys_only_shuffle(manager_factory, rng, tmp_path):
+    mgr = manager_factory()
+    h = mgr.register_shuffle(922, num_maps=2, num_partitions=4)
+    for m in range(2):
+        w = mgr.get_writer(h, m)
+        w.write(rng.integers(0, 1000, size=16))
+        w.commit(h.num_partitions)
+    snap = str(tmp_path / "snap3")
+    snapshot_shuffles(mgr, snap)
+    mgr.unregister_shuffle(922)
+    handles = restore_shuffles(mgr, snap)
+    total = sum(k.shape[0]
+                for _, (k, v) in mgr.read(handles[922]).partitions())
+    assert total == 32
+    mgr.unregister_shuffle(922)
+
+
+def test_snapshot_preserves_direct_partitioner(manager_factory, rng,
+                                               tmp_path):
+    """A 'direct' shuffle snapshotted before any writer exists must come
+    back 'direct' — the partitioner lives on the registry entry."""
+    mgr = manager_factory()
+    mgr.register_shuffle(924, num_maps=2, num_partitions=4,
+                         partitioner="direct")
+    snap = str(tmp_path / "snap5")
+    snapshot_shuffles(mgr, snap)
+    mgr.unregister_shuffle(924)
+    handles = restore_shuffles(mgr, snap)
+    assert handles[924].partitioner == "direct"
+    assert mgr.node.registry.get(924).partitioner == "direct"
+    # direct semantics actually apply: keys are partition ids
+    w = mgr.get_writer(handles[924], 0)
+    w.write(np.array([0, 1, 3, 3], np.int64))
+    w.commit(4)
+    w1 = mgr.get_writer(handles[924], 1)
+    w1.commit(4)
+    res = mgr.read(handles[924])
+    assert res.partition(3)[0].tolist() == [3, 3]
+    mgr.unregister_shuffle(924)
+
+
+def test_restore_version_guard(manager_factory, tmp_path, rng):
+    mgr = manager_factory()
+    h = mgr.register_shuffle(923, num_maps=1, num_partitions=2)
+    w = mgr.get_writer(h, 0)
+    w.write(rng.integers(0, 10, size=4))
+    w.commit(2)
+    snap = str(tmp_path / "snap4")
+    snapshot_shuffles(mgr, snap)
+    mgr.unregister_shuffle(923)
+    # corrupt the version
+    import numpy as _np
+    path = snap + "/shuffle_923.npz"
+    data = dict(_np.load(path))
+    data["version"] = _np.int64(99)
+    _np.savez_compressed(path, **data)
+    with pytest.raises(ValueError, match="version 99"):
+        restore_shuffles(mgr, snap)

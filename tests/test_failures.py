@@ -1,0 +1,251 @@
+"""Failure plane tests (runtime/failures.py).
+
+The reference delegates failure handling to Spark and has no fault
+injection (SURVEY.md §5); these tests cover the in-framework equivalents:
+deterministic injection, bounded retry, liveness probing, numeric checks,
+and epoch fencing — plus integration through the shuffle manager."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.runtime.failures import (DeviceUnhealthy, EpochManager,
+                                           FaultInjector, HealthMonitor,
+                                           InjectedFault, NumericFailure,
+                                           RetryPolicy, StaleEpochError,
+                                           TransientError)
+
+
+# -- FaultInjector --------------------------------------------------------
+def test_injector_inactive_is_noop():
+    fi = FaultInjector()
+    for _ in range(100):
+        fi.check("anything")
+    assert fi.stats() == {}
+
+
+def test_injector_fail_count():
+    fi = FaultInjector()
+    fi.arm("publish", fail_count=2)
+    with pytest.raises(InjectedFault):
+        fi.check("publish")
+    with pytest.raises(InjectedFault):
+        fi.check("publish")
+    fi.check("publish")  # exhausted
+    hits, injected = fi.stats()["publish"]
+    assert (hits, injected) == (3, 2)
+
+
+def test_injector_fail_rate_deterministic():
+    a = FaultInjector(seed=42)
+    b = FaultInjector(seed=42)
+    a.arm("x", fail_rate=0.5)
+    b.arm("x", fail_rate=0.5)
+
+    def pattern(fi):
+        out = []
+        for _ in range(50):
+            try:
+                fi.check("x")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    pa, pb = pattern(a), pattern(b)
+    assert pa == pb
+    assert 0 < sum(pa) < 50
+
+
+def test_injector_from_conf():
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.fault.publish.failCount": "1",
+        "spark.shuffle.tpu.fault.fetch.failRate": "0.0",
+        "spark.shuffle.tpu.fault.seed": "7",
+    }, use_env=False)
+    fi = FaultInjector(conf)
+    assert fi.active
+    with pytest.raises(InjectedFault):
+        fi.check("publish")
+    fi.check("publish")
+    fi.check("fetch")  # rate 0 never fires
+
+
+def test_injector_env_cased_knobs():
+    """Env-derived keys arrive lowercased; knob match must still hit."""
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.fault.publish.failcount": "1",
+        "spark.shuffle.tpu.fault.publish.delayms": "1",
+    }, use_env=False)
+    fi = FaultInjector(conf)
+    assert fi.active
+    with pytest.raises(InjectedFault):
+        fi.check("publish")
+
+
+def test_retry_rejects_zero_attempts():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+
+
+def test_manager_exchange_fault_site(manager_factory, rng):
+    mgr = manager_factory({"spark.shuffle.tpu.fault.exchange.failCount": "1"})
+    h = mgr.register_shuffle(913, num_maps=1, num_partitions=4)
+    w = mgr.get_writer(h, 0)
+    w.write(rng.integers(0, 100, size=8))
+    w.commit(4)
+    with pytest.raises(InjectedFault):
+        mgr.read(h)
+    total = sum(k.shape[0] for _, (k, _) in mgr.read(h).partitions())
+    assert total == 8
+    mgr.unregister_shuffle(913)
+
+
+def test_injector_disarm():
+    fi = FaultInjector()
+    fi.arm("s", fail_count=5)
+    fi.disarm("s")
+    fi.check("s")
+
+
+# -- RetryPolicy ----------------------------------------------------------
+def test_retry_succeeds_after_transient():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("boom")
+        return "ok"
+
+    assert RetryPolicy(max_attempts=3, backoff_ms=1).run(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_exhausts_and_raises():
+    def always():
+        raise TransientError("nope")
+
+    with pytest.raises(TransientError):
+        RetryPolicy(max_attempts=2, backoff_ms=1).run(always)
+
+
+def test_retry_does_not_catch_fatal():
+    def fatal():
+        raise ValueError("fatal")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=3, backoff_ms=1).run(fatal)
+
+
+def test_retry_on_retry_hook():
+    seen = []
+
+    def flaky():
+        if not seen:
+            raise TransientError("x")
+        return 1
+
+    RetryPolicy(max_attempts=2, backoff_ms=1).run(
+        flaky, on_retry=lambda attempt, e: seen.append(attempt))
+    assert seen == [1]
+
+
+def test_retry_from_conf():
+    conf = TpuShuffleConf({"spark.shuffle.tpu.failure.maxAttempts": "5"},
+                          use_env=False)
+    assert RetryPolicy.from_conf(conf).max_attempts == 5
+
+
+# -- HealthMonitor --------------------------------------------------------
+def test_probe_all_devices_alive(mesh8):
+    hm = HealthMonitor(mesh8, timeout_ms=30_000)
+    results = hm.probe()
+    assert len(results) == 8
+    assert all(results.values())
+    hm.assert_healthy()
+
+
+def test_check_finite():
+    HealthMonitor.check_finite("loss", np.float32(1.0))
+    with pytest.raises(NumericFailure, match="nan=1"):
+        HealthMonitor.check_finite("loss", np.array([1.0, np.nan]))
+    with pytest.raises(NumericFailure):
+        HealthMonitor.check_finite("grad", np.array([np.inf]))
+
+
+# -- EpochManager ---------------------------------------------------------
+def test_epoch_bump_and_validate():
+    em = EpochManager()
+    assert em.current == 0
+    em.validate(0)
+    em.bump("device lost")
+    assert em.current == 1
+    with pytest.raises(StaleEpochError, match="epoch 0"):
+        em.validate(0, "shuffle 3")
+
+
+def test_epoch_listeners():
+    em = EpochManager()
+    seen = []
+    em.on_bump(seen.append)
+    em.bump()
+    em.bump()
+    assert seen == [1, 2]
+
+
+# -- integration through the manager -------------------------------------
+def _write_all(mgr, h, rng, rows=32):
+    for m in range(h.num_maps):
+        w = mgr.get_writer(h, m)
+        w.write(rng.integers(0, 1 << 20, size=rows))
+        w.commit(h.num_partitions)
+
+
+def test_manager_fetch_fault_retried(manager_factory, rng):
+    """A transient fetch fault is absorbed by the node retry policy."""
+    mgr = manager_factory({"spark.shuffle.tpu.fault.fetch.failCount": "1"})
+    h = mgr.register_shuffle(910, num_maps=2, num_partitions=4)
+    _write_all(mgr, h, rng)
+    result = mgr.read(h)  # first fetch attempt fails, retry succeeds
+    total = sum(k.shape[0] for _, (k, _) in result.partitions())
+    assert total == 2 * 32
+    assert mgr.node.faults.stats()["fetch"] == (2, 1)
+    mgr.unregister_shuffle(910)
+
+
+def test_manager_publish_fault_surfaces(manager_factory, rng):
+    """Publish faults surface to the caller (task-retry is above us),
+    and a fresh writer can redo the commit — idempotent publish."""
+    mgr = manager_factory({"spark.shuffle.tpu.fault.publish.failCount": "1"})
+    h = mgr.register_shuffle(911, num_maps=1, num_partitions=4)
+    w = mgr.get_writer(h, 0)
+    w.write(rng.integers(0, 1 << 20, size=16))
+    with pytest.raises(InjectedFault):
+        w.commit(h.num_partitions)
+    # retry the task: new writer, same map id
+    w2 = mgr.get_writer(h, 0)
+    w2.write(rng.integers(0, 1 << 20, size=16))
+    w2.commit(h.num_partitions)
+    result = mgr.read(h)
+    total = sum(k.shape[0] for _, (k, _) in result.partitions())
+    assert total == 16
+    mgr.unregister_shuffle(911)
+
+
+def test_manager_stale_epoch_fenced(manager_factory, rng):
+    """After a remesh bump, reads against old handles fail fast instead of
+    issuing a collective pinned to dead membership."""
+    mgr = manager_factory()
+    h = mgr.register_shuffle(912, num_maps=2, num_partitions=4)
+    _write_all(mgr, h, rng)
+    mgr.node.epochs.bump("simulated device loss")
+    with pytest.raises(StaleEpochError):
+        mgr.read(h)
+    mgr.unregister_shuffle(912)
+    # re-registering under the new epoch works
+    h2 = mgr.register_shuffle(912, num_maps=2, num_partitions=4)
+    _write_all(mgr, h2, rng)
+    total = sum(k.shape[0] for _, (k, _) in mgr.read(h2).partitions())
+    assert total == 2 * 32
+    mgr.unregister_shuffle(912)

@@ -1,0 +1,190 @@
+"""Hierarchical (multi-slice) shuffle tests — shuffle/hierarchical.py.
+
+Runs the two-stage ICI->DCN exchange on a virtual 2x4 mesh (2 "slices" of
+4 CPU devices) and checks it against the flat exchange and a numpy oracle.
+This is the dry-run form of SURVEY.md §7 hard part (d)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.shuffle.hierarchical import read_shuffle_hierarchical
+from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+from sparkucx_tpu.shuffle.plan import ShufflePlan
+from sparkucx_tpu.shuffle.reader import (KEY_WORDS, pack_rows, read_shuffle,
+                                         unpack_rows)
+from sparkucx_tpu.shuffle.writer import _hash32_np
+
+
+@pytest.fixture(scope="module")
+def mesh2x4(request):
+    devs = jax.devices()
+    assert len(devs) == 8
+    return Mesh(np.array(devs).reshape(2, 4), ("dcn", "shuffle"))
+
+
+def make_inputs(rng, Pn, rows_per_shard, R, width=KEY_WORDS):
+    keys = [rng.integers(0, 1 << 20, size=rows_per_shard)
+            for _ in range(Pn)]
+    cap_in = rows_per_shard
+    shard_rows = np.zeros((Pn, cap_in, width), np.int32)
+    for p, k in enumerate(keys):
+        shard_rows[p] = pack_rows(k, None, width)
+    nvalid = np.full(Pn, rows_per_shard, np.int64)
+    return keys, shard_rows, nvalid
+
+
+def partition_of(keys, R):
+    return (_hash32_np(np.asarray(keys)) % np.uint32(R)).astype(np.int64)
+
+
+def collect(result, R):
+    """partition id -> sorted key list."""
+    out = {}
+    for r in range(R):
+        k, _ = result.partition(r)
+        out[r] = sorted(k.tolist())
+    return out
+
+
+@pytest.mark.parametrize("R", [8, 16, 13])
+def test_hierarchical_matches_flat(mesh2x4, rng, R):
+    Pn, rows = 8, 64
+    keys, shard_rows, nvalid = make_inputs(rng, Pn, rows, R)
+    plan = ShufflePlan(Pn, R, cap_in=rows, cap_out=256, impl="dense")
+    hier = read_shuffle_hierarchical(
+        mesh2x4, "dcn", "shuffle", plan, shard_rows, nvalid, None, None)
+
+    flat_mesh = Mesh(mesh2x4.devices.reshape(-1), ("shuffle",))
+    flat = read_shuffle(flat_mesh, "shuffle", plan, shard_rows, nvalid,
+                        None, None)
+    assert collect(hier, R) == collect(flat, R)
+
+    # and against the numpy oracle
+    all_keys = np.concatenate(keys)
+    parts = partition_of(all_keys, R)
+    want = {r: sorted(all_keys[parts == r].tolist()) for r in range(R)}
+    assert collect(hier, R) == want
+
+
+def test_hierarchical_with_values(mesh2x4, rng):
+    Pn, rows, R = 8, 32, 8
+    width = KEY_WORDS + 1
+    all_keys, all_vals = [], []
+    shard_rows = np.zeros((Pn, rows, width), np.int32)
+    for p in range(Pn):
+        k = rng.integers(0, 1 << 16, size=rows)
+        v = rng.standard_normal((rows, 1)).astype(np.float32)
+        shard_rows[p] = pack_rows(k, v, width)
+        all_keys.append(k)
+        all_vals.append(v)
+    nvalid = np.full(Pn, rows, np.int64)
+    plan = ShufflePlan(Pn, R, cap_in=rows, cap_out=128, impl="dense")
+    res = read_shuffle_hierarchical(
+        mesh2x4, "dcn", "shuffle", plan, shard_rows, nvalid,
+        (1,), np.float32)
+
+    ak = np.concatenate(all_keys)
+    av = np.concatenate(all_vals)
+    parts = partition_of(ak, R)
+    got_pairs, want_pairs = set(), set()
+    for r in range(R):
+        k, v = res.partition(r)
+        assert (partition_of(k, R) == r).all()
+        got_pairs |= {(int(a), float(b)) for a, b in zip(k, v[:, 0])}
+        sel = parts == r
+        want_pairs |= {(int(a), float(b))
+                       for a, b in zip(ak[sel], av[sel, 0])}
+    assert got_pairs == want_pairs
+
+
+def test_hierarchical_overflow_retry(mesh2x4, rng):
+    """All keys land in one partition -> tiny cap_out overflows, the retry
+    loop grows it, and the result is still complete."""
+    Pn, rows, R = 8, 16, 8
+    shard_rows = np.zeros((Pn, rows, KEY_WORDS), np.int32)
+    key = 12345  # every row identical -> single destination
+    for p in range(Pn):
+        shard_rows[p] = pack_rows(np.full(rows, key, np.int64), None,
+                                  KEY_WORDS)
+    nvalid = np.full(Pn, rows, np.int64)
+    plan = ShufflePlan(Pn, R, cap_in=rows, cap_out=8, impl="dense")
+    res = read_shuffle_hierarchical(
+        mesh2x4, "dcn", "shuffle", plan, shard_rows, nvalid, None, None)
+    r = int(partition_of([key], R)[0])
+    k, _ = res.partition(r)
+    assert k.shape[0] == Pn * rows
+    assert (k == key).all()
+
+
+def test_hierarchical_direct_partitioner(mesh2x4, rng):
+    Pn, rows, R = 8, 24, 16
+    shard_rows = np.zeros((Pn, rows, KEY_WORDS), np.int32)
+    all_parts = []
+    for p in range(Pn):
+        part_ids = rng.integers(0, R, size=rows)
+        shard_rows[p] = pack_rows(part_ids.astype(np.int64), None, KEY_WORDS)
+        all_parts.append(part_ids)
+    nvalid = np.full(Pn, rows, np.int64)
+    plan = ShufflePlan(Pn, R, cap_in=rows, cap_out=128, impl="dense",
+                       partitioner="direct")
+    res = read_shuffle_hierarchical(
+        mesh2x4, "dcn", "shuffle", plan, shard_rows, nvalid, None, None)
+    ap = np.concatenate(all_parts)
+    for r in range(R):
+        k, _ = res.partition(r)
+        assert k.shape[0] == int((ap == r).sum())
+        assert (k == r).all()
+
+
+def test_manager_uses_hierarchical_on_2d_mesh(rng):
+    """A manager on a (dcn=2, shuffle=4) mesh routes reads through the
+    two-stage path and still produces correct partitions."""
+    from sparkucx_tpu.runtime.node import TpuNode
+
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense",
+                           "spark.shuffle.tpu.mesh.numSlices": "2"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    try:
+        mgr = TpuShuffleManager(node, conf)
+        assert mgr.hierarchical
+        R, M = 8, 4
+        h = mgr.register_shuffle(930, M, R)
+        all_keys = []
+        for m in range(M):
+            w = mgr.get_writer(h, m)
+            k = rng.integers(0, 1 << 18, size=50)
+            w.write(k)
+            w.commit(R)
+            all_keys.append(k)
+        res = mgr.read(h)
+        ak = np.concatenate(all_keys)
+        parts = partition_of(ak, R)
+        for r in range(R):
+            k, _ = res.partition(r)
+            assert sorted(k.tolist()) == sorted(ak[parts == r].tolist())
+        mgr.unregister_shuffle(930)
+        span = [s for s in node.tracer.spans("shuffle.exchange")]
+        # tracer disabled by default -> no spans; flag lives on manager
+        mgr.stop()
+    finally:
+        node.close()
+
+
+def test_manager_hierarchical_optout(rng):
+    from sparkucx_tpu.runtime.node import TpuNode
+
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense",
+                           "spark.shuffle.tpu.mesh.numSlices": "2",
+                           "spark.shuffle.tpu.a2a.hierarchical": "false"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    try:
+        mgr = TpuShuffleManager(node, conf)
+        assert not mgr.hierarchical
+        mgr.stop()
+    finally:
+        node.close()

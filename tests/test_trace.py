@@ -1,0 +1,170 @@
+"""Tracing subsystem tests (utils/trace.py).
+
+The reference has no tracer (SURVEY.md §5) — these tests cover the
+do-better subsystem: span recording, nesting, thread tracks, ring-buffer
+bounds, Chrome export, conf wiring, and end-to-end spans from a real
+shuffle read."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.utils.trace import (GLOBAL_TRACER, Tracer,
+                                      configure_from_conf)
+
+
+def test_disabled_tracer_records_nothing():
+    t = Tracer(enabled=False)
+    with t.span("x"):
+        pass
+    t.instant("marker")
+    assert t.spans() == []
+
+
+def test_disabled_span_is_shared_noop():
+    t = Tracer(enabled=False)
+    assert t.span("a") is t.span("b")  # no per-call allocation
+
+
+def test_span_timing_and_attrs():
+    t = Tracer(enabled=True)
+    with t.span("work", shuffle_id=7) as s:
+        s.set(rows=123)
+    (span,) = t.spans()
+    assert span.name == "work"
+    assert span.attrs == {"shuffle_id": 7, "rows": 123}
+    assert span.dur_us >= 0
+    assert span.depth == 0
+
+
+def test_nesting_depth():
+    t = Tracer(enabled=True)
+    with t.span("outer"):
+        with t.span("inner"):
+            pass
+    by_name = {s.name: s for s in t.spans()}
+    assert by_name["outer"].depth == 0
+    assert by_name["inner"].depth == 1
+    # inner finishes first (recorded first)
+    assert t.spans()[0].name == "inner"
+
+
+def test_threads_get_own_tracks():
+    t = Tracer(enabled=True)
+
+    def work():
+        with t.span("threaded"):
+            pass
+
+    th = threading.Thread(target=work)
+    th.start()
+    th.join()
+    with t.span("main"):
+        pass
+    tids = {s.tid for s in t.spans()}
+    assert len(tids) == 2
+
+
+def test_ring_buffer_bound_and_drop_count():
+    t = Tracer(enabled=True, capacity=4)
+    for i in range(10):
+        t.instant(f"e{i}")
+    assert len(t.spans()) == 4
+    assert t.dropped == 6
+    assert [s.name for s in t.spans()] == ["e6", "e7", "e8", "e9"]
+
+
+def test_summary_aggregates():
+    t = Tracer(enabled=True)
+    for _ in range(5):
+        with t.span("op"):
+            pass
+    s = t.summary()["op"]
+    assert s["count"] == 5
+    assert s["total_ms"] >= 0
+    assert s["p50_ms"] <= s["max_ms"]
+
+
+def test_chrome_export(tmp_path):
+    t = Tracer(enabled=True)
+    with t.span("exported", k="v"):
+        pass
+    path = str(tmp_path / "trace.json")
+    n = t.export_chrome_trace(path)
+    assert n == 1
+    doc = json.load(open(path))
+    (ev,) = doc["traceEvents"]
+    assert ev["name"] == "exported"
+    assert ev["ph"] == "X"
+    assert ev["args"] == {"k": "v"}
+
+
+def test_export_nonjsonable_attr(tmp_path):
+    t = Tracer(enabled=True)
+    t.instant("x", arr=np.arange(3))
+    path = str(tmp_path / "t.json")
+    t.export_chrome_trace(path)
+    doc = json.load(open(path))
+    assert "arr" in doc["traceEvents"][0]["args"]
+
+
+def test_configure_from_conf():
+    conf = TpuShuffleConf({"spark.shuffle.tpu.trace.enabled": "true",
+                           "spark.shuffle.tpu.trace.capacity": "128"},
+                          use_env=False)
+    tr = configure_from_conf(conf)
+    try:
+        assert tr is GLOBAL_TRACER
+        assert tr.enabled
+        assert tr._capacity == 128
+    finally:
+        tr.enabled = False
+        tr.clear()
+
+
+def test_clear_resets():
+    t = Tracer(enabled=True, capacity=2)
+    for i in range(5):
+        t.instant(str(i))
+    t.clear()
+    assert t.spans() == []
+    assert t.dropped == 0
+
+
+def test_device_trace_degrades_gracefully(tmp_path):
+    # On CPU the profiler may or may not be available; either way the
+    # context must not raise and host spans must still record.
+    t = Tracer(enabled=True)
+    with t.device_trace(str(tmp_path / "xla")):
+        with t.span("inside"):
+            pass
+    assert t.spans("inside")
+
+
+def test_shuffle_read_emits_spans(manager_factory):
+    """End-to-end: a real shuffle read leaves plan/pack/exchange/publish
+    spans in the node tracer."""
+    mgr = manager_factory({"spark.shuffle.tpu.trace.enabled": "true"})
+    tracer = mgr.node.tracer
+    tracer.clear()
+    try:
+        h = mgr.register_shuffle(901, num_maps=4, num_partitions=8)
+        rng = np.random.default_rng(0)
+        for m in range(4):
+            w = mgr.get_writer(h, m)
+            w.write(rng.integers(0, 1 << 20, size=64))
+            w.commit(h.num_partitions)
+        mgr.read(h)
+        names = {s.name for s in tracer.spans()}
+        assert {"shuffle.plan", "shuffle.pack", "shuffle.exchange",
+                "shuffle.publish"} <= names
+        pub = tracer.spans("shuffle.publish")
+        assert len(pub) == 4
+        assert {s.attrs["map_id"] for s in pub} == {0, 1, 2, 3}
+    finally:
+        mgr.unregister_shuffle(901)
+        tracer.enabled = False
+        tracer.clear()
