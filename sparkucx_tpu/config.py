@@ -110,6 +110,9 @@ class TpuShuffleConf:
     def get_int(self, short: str, default: int) -> int:
         return int(self._get(short, default))
 
+    def get_float(self, short: str, default: float) -> float:
+        return float(self._get(short, default))
+
     def get_bool(self, short: str, default: bool) -> bool:
         return str(self._get(short, default)).strip().lower() in ("1", "true", "yes", "on")
 

@@ -198,7 +198,7 @@ class RetryPolicy:
     def from_conf(cls, conf) -> "RetryPolicy":
         return cls(
             max_attempts=conf.get_int("failure.maxAttempts", 3),
-            backoff_ms=float(conf._get("failure.backoffMs", 10.0)),
+            backoff_ms=conf.get_float("failure.backoffMs", 10.0),
         )
 
 

@@ -53,6 +53,10 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
     Mesh must be 2-D ``(dcn=S, ici=D)``; global shard id g = s*D + d
     matches ``mesh.devices.reshape(-1)`` order, so the flat
     ``blocked_partition_map`` routing is identical to the flat reader's."""
+    if mesh.axis_names != (dcn_axis, ici_axis):
+        raise ValueError(
+            f"hierarchical shuffle needs mesh axes ({dcn_axis!r}, "
+            f"{ici_axis!r}) in that order, got {mesh.axis_names}")
     S, D = mesh.devices.shape
     R = plan.num_partitions
     Pn = plan.num_shards
