@@ -6,6 +6,14 @@ device — hash partition -> stable destination sort -> ragged all-to-all ->
 receive-side partition grouping — i.e. everything the reference does with
 per-block ucp_get storms (SURVEY.md §3.4), as one compiled XLA step.
 
+Timing methodology: the per-dispatch round trip to a tunneled TPU backend
+can exceed the step time by orders of magnitude, and `block_until_ready`
+does not reliably block there. So the step is iterated INSIDE one compiled
+program (`lax.scan` with an optimization_barrier-enforced data dependency
+between iterations), completion is forced by a real device-to-host read,
+and the fixed dispatch/transfer overhead is cancelled by differencing two
+scan lengths: per_step = (t(k2) - t(k1)) / (k2 - k1).
+
 Baseline: the reference publishes no in-repo numbers (BASELINE.md §1); the
 conventional UCX-RDMA shuffle-read rate on the Mellanox deployment the
 README points at is ~3 GB/s/node sustained, which we adopt as baseline=3.0
@@ -27,11 +35,12 @@ import time
 BASELINE_GBPS = 3.0
 
 
-def run(rows_log2: int, val_words: int, iters: int, warmup: int,
+def run(rows_log2: int, val_words: int, k1: int, k2: int, reps: int,
         partitions_per_dev: int) -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
+    from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
 
     from sparkucx_tpu.ops.partition import blocked_partition_map, \
@@ -60,28 +69,53 @@ def run(rows_log2: int, val_words: int, iters: int, warmup: int,
             r.data, hash_partition(r.data[:, 0], R), r.total[0], R)
         return rows_out, r.overflow
 
-    fn = jax.jit(jax.shard_map(
-        step, mesh=mesh, in_specs=(P("shuffle"),),
-        out_specs=(P("shuffle"),) * 2))
+    def make(k):
+        def many(payload):
+            def body(carry, _):
+                carry = lax.optimization_barrier(carry)
+                out, ovf = step(carry)
+                # fold one received row back in: a real cross-iteration
+                # data dependency so XLA cannot hoist or dedupe the steps
+                carry = carry ^ lax.optimization_barrier(
+                    out[0:1, :]).astype(carry.dtype)
+                return carry, ovf
+            carry, ovfs = lax.scan(body, payload, None, length=k)
+            return carry[0:1, 0], jnp.any(ovfs).reshape(1)
+        return jax.jit(jax.shard_map(
+            many, mesh=mesh, in_specs=(P("shuffle"),),
+            out_specs=(P("shuffle"), P("shuffle"))))
 
     rng = np.random.default_rng(0)
-    payload = jnp.asarray(
-        rng.integers(0, 1 << 31, size=(nchips * rows, width),
-                     dtype=np.int64).astype(np.int32))
+    payload = jax.device_put(
+        jnp.asarray(rng.integers(0, 1 << 31, size=(nchips * rows, width),
+                                 dtype=np.int64).astype(np.int32)),
+        jax.sharding.NamedSharding(mesh, P("shuffle")))
 
-    for _ in range(warmup):
-        out = fn(payload)
-    jax.block_until_ready(out)
-    assert not np.asarray(out[1]).any(), "bench overflowed capacity"
+    def timed(k):
+        fn = make(k)
+        out = fn(payload)                        # compile + warm up
+        ovf = bool(np.asarray(out[1]).any())     # real D2H: blocks for real
+        assert not ovf, "bench overflowed capacity"
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(payload)
+            _ = np.asarray(out[0])
+            best = min(best, time.perf_counter() - t0)
+        return best
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(payload)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
+    t_small, t_large = timed(k1), timed(k2)
+    degenerate = t_large <= t_small
+    if degenerate:
+        # Noise swamped the differencing; fall back to the conservative
+        # whole-call time (includes dispatch overhead, so it UNDERSTATES
+        # throughput) and say so rather than report a nonsense number.
+        per_step = t_large / k2
+    else:
+        per_step = (t_large - t_small) / (k2 - k1)
 
     total_bytes = nchips * rows * row_bytes
-    gbps_per_chip = total_bytes / dt / nchips / 1e9
+    gbps_per_chip = total_bytes / per_step / nchips / 1e9
     return {
         "metric": "shuffle_read_GBps_per_chip",
         "value": round(gbps_per_chip, 3),
@@ -93,7 +127,10 @@ def run(rows_log2: int, val_words: int, iters: int, warmup: int,
             "rows_per_chip": rows,
             "row_bytes": row_bytes,
             "partitions": R,
-            "step_ms": round(dt * 1e3, 3),
+            "step_ms": round(per_step * 1e3, 3),
+            "t_small_ms": round(t_small * 1e3, 3),
+            "t_large_ms": round(t_large * 1e3, 3),
+            "degenerate_timing": degenerate,
         },
     }
 
@@ -104,15 +141,15 @@ def main() -> None:
                     help="small shapes for CI / CPU")
     ap.add_argument("--rows-log2", type=int, default=None)
     ap.add_argument("--val-words", type=int, default=8)
-    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--reps", type=int, default=3)
     args = ap.parse_args()
     if args.smoke:
         rows_log2 = args.rows_log2 or 12
-        iters, warmup = 3, 1
+        k1, k2, reps = 1, 3, 1
     else:
         rows_log2 = args.rows_log2 or 21
-        iters, warmup = args.iters, 2
-    result = run(rows_log2, args.val_words, iters, warmup,
+        k1, k2, reps = 2, 12, args.reps
+    result = run(rows_log2, args.val_words, k1, k2, reps,
                  partitions_per_dev=8)
     print(json.dumps(result))
 

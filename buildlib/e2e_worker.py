@@ -1,0 +1,109 @@
+"""One cluster member of the multi-process e2e harness.
+
+The per-worker half of the test.sh analog (ref: buildlib/test.sh:147-172
+starts a master + N workers and runs GroupByTest/SparkTC on the cluster).
+Launched by run_cluster.py with SPARKUCX_TPU_PROC_ID / _NPROCS /
+_COORDINATOR in the environment; every process runs this same script SPMD.
+
+Workload: a distributed GroupBy (the reference CI's primary correctness
+job, ref: buildlib/test.sh:162-166). Map data is generated DETERMINISTICALLY
+from the map id, so every process can reconstruct the full global truth
+locally and verify its partitions without any extra wire.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    proc_id = int(os.environ["SPARKUCX_TPU_PROC_ID"])
+    nprocs = int(os.environ["SPARKUCX_TPU_NPROCS"])
+    coordinator = os.environ["SPARKUCX_TPU_COORDINATOR"]
+    devices_per_proc = int(os.environ.get("SPARKUCX_TPU_LOCAL_DEVICES", "4"))
+
+    # CPU backend with per-process virtual devices (the fake-backend role
+    # UCX-over-shm plays for the reference, SURVEY.md §4) — must be set
+    # before any backend initializes.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices_per_proc}"
+    ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    from sparkucx_tpu.shuffle.writer import _hash32_np
+
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.coordinator.address": coordinator,
+        "spark.shuffle.tpu.numProcesses": str(nprocs),
+        "spark.shuffle.tpu.a2a.impl": "dense",
+    }, use_env=False)
+    node = TpuNode.start(conf, distributed=True, process_id=proc_id)
+    mgr = TpuShuffleManager(node, conf)
+
+    num_maps = 2 * nprocs           # maps per process x processes
+    R = 4 * node.num_devices
+    key_space = 1000
+    pairs_per_map = 600
+    h = mgr.register_shuffle(7, num_maps, R)
+
+    def map_data(map_id: int):
+        rng = np.random.default_rng(1000 + map_id)
+        keys = rng.integers(0, key_space, size=pairs_per_map)\
+            .astype(np.int64)
+        vals = np.repeat(keys[:, None], 2, axis=1).astype(np.int32)
+        return keys, vals
+
+    # each process writes ITS map tasks (maps round-robin over processes,
+    # like tasks over executors)
+    my_maps = [m for m in range(num_maps) if m % nprocs == proc_id]
+    for m in my_maps:
+        w = mgr.get_writer(h, m)
+        k, v = map_data(m)
+        w.write(k, v)
+        w.commit(R)
+
+    res = mgr.read(h)               # collective across all processes
+
+    # global truth, reconstructed locally
+    allk = np.concatenate([map_data(m)[0] for m in range(num_maps)])
+    allv = np.concatenate([map_data(m)[1] for m in range(num_maps)])
+    parts = _hash32_np(allk) % R
+
+    checked = 0
+    for r, (gk, gv) in res.partitions():
+        wk = allk[parts == r]
+        wv = allv[parts == r]
+        got = sorted(zip(gk.tolist(), map(tuple, gv.tolist())))
+        want = sorted(zip(wk.tolist(), map(tuple, wv.tolist())))
+        assert got == want, f"partition {r} mismatch on process {proc_id}"
+        # values must be the key repeated (row integrity through the wire)
+        assert (gv == gk[:, None]).all(), f"row corruption in partition {r}"
+        checked += 1
+
+    # every partition must be owned by exactly one process: allgather the
+    # per-process ownership bitmaps and check the partition of unity
+    from sparkucx_tpu.shuffle.distributed import allgather_blob
+    owned = np.zeros(R, dtype=np.int64)
+    for r in range(R):
+        owned[r] = 1 if res.is_local(r) else 0
+    ownership = allgather_blob(owned)
+    assert (ownership.sum(axis=0) == 1).all(), \
+        f"partition ownership not a partition of unity:\n{ownership}"
+
+    mgr.stop()
+    node.close()
+    print(f"worker {proc_id}/{nprocs}: verified {checked} local "
+          f"partitions of {R} OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

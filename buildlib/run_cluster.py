@@ -1,0 +1,100 @@
+"""Multi-process e2e cluster launcher — the test.sh analog.
+
+The reference stands up a Spark standalone cluster (master + N worker
+processes on one host, ref: buildlib/test.sh:147-160) and runs shuffle-dense
+jobs over it. Here: N python processes on localhost rendezvous through the
+jax.distributed coordinator (the driver-sockaddr analog) and run the SPMD
+GroupBy workload in buildlib/e2e_worker.py.
+
+Usage:  python buildlib/run_cluster.py [--nprocs 2] [--devices 4]
+Exit code 0 iff every worker verified its partitions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nprocs", type=int, default=2)
+    ap.add_argument("--devices", type=int, default=4,
+                    help="virtual CPU devices per process")
+    ap.add_argument("--timeout", type=float, default=480.0)
+    args = ap.parse_args()
+
+    coordinator = f"localhost:{free_port()}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "buildlib", "e2e_worker.py")
+
+    procs, logs = [], []
+    try:
+        for pid in range(args.nprocs):
+            env = dict(os.environ)
+            env.update({
+                "SPARKUCX_TPU_PROC_ID": str(pid),
+                "SPARKUCX_TPU_NPROCS": str(args.nprocs),
+                "SPARKUCX_TPU_COORDINATOR": coordinator,
+                "SPARKUCX_TPU_LOCAL_DEVICES": str(args.devices),
+                # never let a worker grab the real TPU (one chip cannot be
+                # shared by N processes — the RDMA-device gate analog,
+                # ref: buildlib/azure-pipelines.yml:39-49 skips without HW)
+                "PALLAS_AXON_POOL_IPS": "",
+                "JAX_PLATFORMS": "cpu",
+                "PYTHONPATH": repo + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+            })
+            # per-worker log FILES, not pipes: SPMD workers block as a
+            # group, so one worker stalled on a full stdout pipe would
+            # deadlock the whole cluster
+            logs.append(tempfile.NamedTemporaryFile(
+                mode="w+", suffix=f".worker{pid}.log", delete=False))
+            procs.append(subprocess.Popen(
+                [sys.executable, worker], env=env,
+                stdout=logs[-1], stderr=subprocess.STDOUT, text=True))
+
+        deadline = time.monotonic() + args.timeout
+        ok = True
+        for pid, p in enumerate(procs):
+            remaining = max(1.0, deadline - time.monotonic())
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+                ok = False
+                print(f"--- worker {pid} TIMED OUT ---")
+            logs[pid].flush()
+            logs[pid].seek(0)
+            out = logs[pid].read()
+            tail = "\n".join(out.strip().splitlines()[-8:])
+            print(f"--- worker {pid} (exit {p.returncode}) ---\n{tail}")
+            ok = ok and p.returncode == 0
+        print("CLUSTER E2E:", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+    finally:
+        for p in procs:           # trap-EXIT cleanup (test.sh:185)
+            if p.poll() is None:
+                p.kill()
+        for f in logs:
+            try:
+                f.close()
+                os.unlink(f.name)
+            except OSError:
+                pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,7 +59,8 @@ class TpuNode:
         self.conf = conf
         self.process_id = process_id
         self._distributed = distributed
-        if distributed and conf.num_processes > 1:
+        self.is_distributed = distributed and conf.num_processes > 1
+        if self.is_distributed:
             # Multi-host: rendezvous at the coordinator like executors
             # dialing the driver sockaddr (UcxNode.java:130-134).
             jax.distributed.initialize(
@@ -107,6 +108,16 @@ class TpuNode:
     @property
     def num_devices(self) -> int:
         return self.mesh.devices.size
+
+    @property
+    def local_shard_ids(self):
+        """Global flat shard indices owned by this process (all of them in
+        single-process mode) — the "which executor owns which block"
+        half of the address book (ref: UcxNode.java:42-44)."""
+        if not self.is_distributed:
+            return list(range(self.num_devices))
+        from sparkucx_tpu.shuffle.distributed import local_shard_ids
+        return local_shard_ids(self.mesh)
 
     def device_of_shard(self, shard: int):
         """Shard index -> device, the BlockManagerId->workerAddress lookup

@@ -1,0 +1,172 @@
+"""Multi-process (multi-host) read path — the DCN-scale deployment shape.
+
+The reference runs one ``UcxNode`` per Spark executor process and scales to
+many hosts through the driver's full-mesh introduction RPC
+(ref: UcxNode.java:111-145, rpc/RpcConnectionCallback.java:70-84). The TPU
+analog is JAX multi-controller: every process calls
+``jax.distributed.initialize`` (the rendezvous), ``jax.devices()`` spans
+the cluster, and ONE SPMD program executes the exchange — the same
+compiled step as single-process, just over a bigger mesh.
+
+What is genuinely different from the single-process path:
+
+- **Map outputs are process-local.** A mapper's staged rows live in its
+  process's host arena and can only be device_put onto that process's
+  devices — exactly Spark's "map outputs stay on the executor's local
+  disk". So map outputs round-robin over the *local* shards, and the
+  global send buffer is assembled with
+  ``jax.make_array_from_process_local_data``.
+- **The metadata plane needs a real wire.** Size rows / schema / presence
+  are per-process facts; they cross processes with
+  ``multihost_utils.process_allgather`` (the driver-table fetch analog,
+  ref: UcxWorkerWrapper.scala:176-196, as a collective instead of a
+  one-sided read of a driver buffer).
+- **Results are partial views.** Each process owns the reduce partitions
+  that land on its shards (Spark reducers read only their partition);
+  ``partition(r)`` raises for non-local partitions instead of silently
+  returning wrong data.
+
+Every process MUST call :func:`read_shuffle_distributed` (it is a
+collective); mismatched call counts deadlock, like any SPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkucx_tpu.shuffle.plan import ShufflePlan
+from sparkucx_tpu.shuffle.reader import (
+    ShuffleReaderResult, _blocked_map, _build_step)
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("shuffle.distributed")
+
+
+def local_shard_ids(mesh: Mesh) -> list:
+    """Global flat shard indices owned by this process, in mesh order."""
+    me = jax.process_index()
+    return [i for i, d in enumerate(mesh.devices.reshape(-1))
+            if d.process_index == me]
+
+
+def allgather_sizes(local_vals: np.ndarray, shard_ids: Sequence[int],
+                    num_shards: int) -> np.ndarray:
+    """Scatter this process's per-shard values into a [num_shards] row and
+    sum-allgather so every process holds the full size row — the
+    driver-table fetch (ref: UcxWorkerWrapper.scala:176-196) as a
+    collective."""
+    from jax.experimental import multihost_utils
+    row = np.zeros(num_shards, dtype=np.int64)
+    row[list(shard_ids)] = np.asarray(local_vals, dtype=np.int64)
+    gathered = multihost_utils.process_allgather(row)   # [nproc, num_shards]
+    return gathered.sum(axis=0)
+
+
+def allgather_blob(blob: np.ndarray) -> np.ndarray:
+    """[nproc, ...] stack of one small host array per process (schema
+    agreement checks)."""
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(blob))
+
+
+class DistributedReaderResult(ShuffleReaderResult):
+    """Partial, process-local view: only partitions on local shards are
+    readable (the Spark-reducer contract)."""
+
+    def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
+                 shard_ids: Sequence[int], local_rows: np.ndarray,
+                 local_pcounts: np.ndarray, val_shape, val_dtype):
+        self.num_partitions = num_partitions
+        self._part_to_shard = part_to_shard
+        self._shard_ord = {int(s): i for i, s in enumerate(shard_ids)}
+        self._rows = local_rows          # [L, cap_out, width]
+        self._pcounts = local_pcounts    # [L, R]
+        self._val_shape = val_shape
+        self._val_dtype = val_dtype
+        self._offsets = np.zeros_like(local_pcounts)
+        np.cumsum(local_pcounts[:, :-1], axis=1, out=self._offsets[:, 1:])
+
+    def is_local(self, r: int) -> bool:
+        return int(self._part_to_shard[r]) in self._shard_ord
+
+    def partition(self, r: int):
+        shard = int(self._part_to_shard[r])
+        if shard not in self._shard_ord:
+            raise KeyError(
+                f"partition {r} lives on shard {shard}, not on this "
+                f"process (local shards: {sorted(self._shard_ord)})")
+        ordinal = self._shard_ord[shard]
+        start = int(self._offsets[ordinal, r])
+        n = int(self._pcounts[ordinal, r])
+        from sparkucx_tpu.shuffle.reader import unpack_rows
+        return unpack_rows(self._rows[ordinal, start:start + n],
+                           self._val_shape, self._val_dtype)
+
+    def partitions(self):
+        for r in range(self.num_partitions):
+            if self.is_local(r):
+                yield r, self.partition(r)
+
+
+def _local_shards_of(arr: jax.Array, shard_ids: Sequence[int],
+                     rows_per_shard: int) -> np.ndarray:
+    """Collect this process's shards of a P(axis)-sharded global array
+    into [L, rows_per_shard, ...] in shard_ids order."""
+    by_start = {}
+    for s in arr.addressable_shards:
+        start = s.index[0].start or 0
+        by_start[start // rows_per_shard] = np.asarray(s.data)
+    return np.stack([by_start[int(i)] for i in shard_ids])
+
+
+def read_shuffle_distributed(
+    mesh: Mesh,
+    axis: str,
+    plan: ShufflePlan,
+    local_rows: np.ndarray,
+    local_nvalid: np.ndarray,
+    shard_ids: Sequence[int],
+    val_shape: Optional[Tuple[int, ...]],
+    val_dtype,
+) -> DistributedReaderResult:
+    """Run the exchange across all processes; COLLECTIVE — every process
+    must call with the same plan/width.
+
+    local_rows   — [L, cap_in, width] fused rows for this process's shards
+    local_nvalid — [L] valid counts
+    shard_ids    — global shard indices of this process (mesh order)
+    """
+    Pn = plan.num_shards
+    R = plan.num_partitions
+    L, cap_in, width = local_rows.shape
+    part_to_shard = np.asarray(_blocked_map(R, Pn))
+    sharding = NamedSharding(mesh, P(axis))
+
+    cur = plan
+    for attempt in range(plan.max_retries + 1):
+        step = _build_step(mesh, axis, cur, width)
+        payload = jax.make_array_from_process_local_data(
+            sharding, local_rows.reshape(L * cap_in, width))
+        nvalid = jax.make_array_from_process_local_data(
+            sharding, local_nvalid.astype(np.int32).reshape(L))
+        rows_out, pcounts, total, ovf = step(payload, nvalid)
+        # the overflow flag is a mesh-wide psum: every process sees the
+        # same value on each of its shards
+        ovf_local = bool(np.asarray(ovf.addressable_shards[0].data).any())
+        if not ovf_local:
+            return DistributedReaderResult(
+                R, part_to_shard, shard_ids,
+                _local_shards_of(rows_out, shard_ids, cur.cap_out),
+                _local_shards_of(pcounts, shard_ids, R),
+                val_shape, val_dtype)
+        log.info("distributed shuffle overflow at cap_out=%d (attempt %d)",
+                 cur.cap_out, attempt)
+        cur = cur.grown()
+    raise RuntimeError(
+        f"shuffle still overflowing after {plan.max_retries} retries "
+        f"(cap_out={cur.cap_out}); extreme skew — repartition the data")

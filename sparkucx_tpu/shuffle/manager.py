@@ -140,6 +140,8 @@ class TpuShuffleManager:
                                   f"shuffle {handle.shuffle_id}")
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
+        if self.node.is_distributed:
+            return self._read_distributed(handle, timeout)
         if not handle.entry.wait_complete(timeout):
             raise TimeoutError(
                 f"shuffle {handle.shuffle_id}: only "
@@ -161,31 +163,9 @@ class TpuShuffleManager:
                     f"shuffle {handle.shuffle_id} is not registered with "
                     f"this manager (already unregistered?)")
             writers = dict(self._writers[handle.shuffle_id])
-        shard_outputs = [[] for _ in range(Pn)]
-        has_vals = False
-        val_tail, val_dtype = None, None
-        for map_id, w in sorted(writers.items()):
-            keys, values = w.materialize()
-            if values is not None and keys.shape[0]:
-                has_vals = True
-                if val_dtype is None:
-                    val_tail, val_dtype = values.shape[1:], values.dtype
-                elif (values.shape[1:], values.dtype) != (val_tail,
-                                                          val_dtype):
-                    # bit-reinterpreting one writer's rows under another's
-                    # schema would silently corrupt — reject up front
-                    raise ValueError(
-                        f"mixed value schema across map outputs: mapId "
-                        f"{map_id} wrote {values.dtype}{values.shape[1:]}, "
-                        f"earlier outputs wrote {val_dtype}{val_tail}")
-            shard_outputs[map_id % Pn].append((keys, values))
-        if has_vals:
-            for outs in shard_outputs:
-                for keys, values in outs:
-                    if keys.shape[0] and values is None:
-                        raise ValueError(
-                            "mixed schema: some map outputs have values, "
-                            "others have keys only")
+        shard_outputs, has_vals, val_tail, val_dtype = \
+            self._materialize_outputs(
+                writers, Pn, lambda ordinal, map_id: map_id % Pn)
 
         # int32-range guard on what actually feeds the plan arithmetic:
         # the per-DEVICE aggregated transfer matrix, not the raw [M, R]
@@ -207,15 +187,8 @@ class TpuShuffleManager:
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)
         with tracer.span("shuffle.pack", rows=int(nvalid.sum())):
-            shard_rows = np.zeros((Pn, plan.cap_in, width), dtype=np.int32)
-            for p in range(Pn):
-                off = 0
-                for keys, values in shard_outputs[p]:
-                    n = keys.shape[0]
-                    if n:
-                        shard_rows[p, off:off + n] = pack_rows(
-                            keys, values if has_vals else None, width)
-                    off += n
+            shard_rows = self._pack_shards(shard_outputs, plan.cap_in,
+                                           width, has_vals)
 
         self.node.faults.check("exchange")
         with self.node.metrics.timeit("shuffle.read"), \
@@ -234,6 +207,167 @@ class TpuShuffleManager:
                 result = read_shuffle(self.exchange_mesh, self.axis, plan,
                                       shard_rows, nvalid, vt, val_dtype)
         self.node.metrics.inc("shuffle.rows", float(nvalid.sum()))
+        return result
+
+    # -- shared staging helpers -------------------------------------------
+    @staticmethod
+    def _materialize_outputs(writers, num_slots, slot_of):
+        """Materialize committed map outputs into per-slot lists and agree
+        on one value schema. ``slot_of(ordinal, map_id)`` places each map
+        output (slots = shards single-process, local shards distributed).
+
+        Returns (slot_outputs, has_vals, val_tail, val_dtype); raises on a
+        mixed schema — bit-reinterpreting one writer's rows under another's
+        schema would silently corrupt."""
+        slot_outputs = [[] for _ in range(num_slots)]
+        has_vals = False
+        val_tail, val_dtype = None, None
+        for ordinal, (map_id, w) in enumerate(sorted(writers.items())):
+            keys, values = w.materialize()
+            if values is not None and keys.shape[0]:
+                has_vals = True
+                if val_dtype is None:
+                    val_tail, val_dtype = values.shape[1:], values.dtype
+                elif (values.shape[1:], values.dtype) != (val_tail,
+                                                          val_dtype):
+                    raise ValueError(
+                        f"mixed value schema across map outputs: mapId "
+                        f"{map_id} wrote {values.dtype}{values.shape[1:]}, "
+                        f"earlier outputs wrote {val_dtype}{val_tail}")
+            slot_outputs[slot_of(ordinal, map_id)].append((keys, values))
+        if has_vals:
+            for outs in slot_outputs:
+                for keys, values in outs:
+                    if keys.shape[0] and values is None:
+                        raise ValueError(
+                            "mixed schema: some map outputs have values, "
+                            "others have keys only")
+        return slot_outputs, has_vals, val_tail, val_dtype
+
+    @staticmethod
+    def _pack_shards(slot_outputs, cap_in, width, has_vals):
+        """Fuse key+value bytes into one [slots, cap_in, width] int32 row
+        matrix (bit views, no value casts — jnp would silently truncate
+        int64 with x64 off)."""
+        rows = np.zeros((len(slot_outputs), cap_in, width), dtype=np.int32)
+        for p, outs in enumerate(slot_outputs):
+            off = 0
+            for keys, values in outs:
+                n = keys.shape[0]
+                if n:
+                    rows[p, off:off + n] = pack_rows(
+                        keys, values if has_vals else None, width)
+                off += n
+        return rows
+
+    # -- the multi-process read path --------------------------------------
+    def _read_distributed(self, handle: ShuffleHandle, timeout: float):
+        """COLLECTIVE multi-process read (shuffle/distributed.py). Map
+        outputs stay on this process's shards (Spark: outputs live on the
+        writing executor's local disk); metadata crosses processes via
+        allgather; the exchange is the same jitted SPMD step over the
+        global mesh. Hierarchical ICI/DCN applies unchanged when the mesh
+        is 2-D, since the exchange mesh flattening is identical on every
+        process."""
+        import time as _time
+
+        from sparkucx_tpu.shuffle.distributed import (
+            allgather_blob, allgather_sizes, read_shuffle_distributed)
+
+        tracer = self.node.tracer
+        shard_ids = self.node.local_shard_ids
+        L = len(shard_ids)
+        Pn = self.node.num_devices
+
+        with self._lock:
+            writers = dict(self._writers.get(handle.shuffle_id, {}))
+
+        # Completeness barrier: poll the global committed-map count (the
+        # wait_complete analog, ref: UcxWorkerWrapper.scala:134-143). Both
+        # the success exit AND the timeout exit ride the allgathered values
+        # — one process's expired clock makes every process raise together,
+        # never leaving a peer blocked in the next collective.
+        deadline = _time.monotonic() + timeout
+        while True:
+            present = sum(1 for w in writers.values() if w.committed)
+            expired = 1 if _time.monotonic() > deadline else 0
+            gathered = allgather_blob(
+                np.array([present, expired], dtype=np.int64))
+            total = int(gathered[:, 0].sum())
+            if total >= handle.num_maps:
+                break
+            if gathered[:, 1].any():
+                raise TimeoutError(
+                    f"shuffle {handle.shuffle_id}: only {total}/"
+                    f"{handle.num_maps} map outputs published within "
+                    f"{timeout}s")
+            _time.sleep(0.05)
+            with self._lock:
+                writers = dict(self._writers.get(handle.shuffle_id, {}))
+
+        # Local materialize + schema summary (maps round-robin over LOCAL
+        # shards: outputs stay on the writing process, like Spark's
+        # executor-local shuffle files).
+        shard_outputs, has_vals, val_tail, val_dtype = \
+            self._materialize_outputs(
+                writers, L, lambda ordinal, map_id: ordinal % L)
+        local_rows_n = sum(k.shape[0]
+                           for outs in shard_outputs for k, _ in outs)
+
+        # Schema agreement across processes. Wildcard (-1) = this process
+        # wrote no valued rows and adopts the cluster schema.
+        blob = np.full(8, -1, dtype=np.int64)
+        if local_rows_n:
+            blob[0] = 1 if has_vals else 0
+        if has_vals:
+            if len(val_tail) > 5:
+                raise ValueError(
+                    f"value rank {len(val_tail)} > 5 unsupported in "
+                    f"multi-process mode; flatten the trailing dims")
+            dt = np.dtype(val_dtype).str.encode()[:6]
+            blob[1] = int.from_bytes(dt, "little")
+            blob[2] = len(val_tail)
+            blob[3:3 + len(val_tail)] = val_tail
+        schemas = allgather_blob(blob)                 # [nproc, 8]
+        known = schemas[schemas[:, 0] >= 0]
+        if known.size:
+            if not (known == known[0]).all():
+                # covers keys-only vs valued processes too (blob[0] differs)
+                raise ValueError(
+                    f"mixed value schema across processes: {schemas}")
+            ref = known[0]
+            if ref[0] == 1 and not has_vals:
+                val_dtype = np.dtype(
+                    int(ref[1]).to_bytes(6, "little").rstrip(b"\0").decode())
+                val_tail = tuple(int(x) for x in ref[3:3 + int(ref[2])])
+            has_vals = bool(ref[0])
+
+        nvalid_local = np.array(
+            [sum(k.shape[0] for k, _ in outs) for outs in shard_outputs],
+            dtype=np.int64)
+        nvalid = allgather_sizes(nvalid_local, shard_ids, Pn)
+        validate_row_sizes(nvalid.reshape(1, -1))
+        with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
+            plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
+                             partitioner=handle.partitioner)
+
+        width = KEY_WORDS + (value_words(val_tail, val_dtype)
+                             if has_vals else 0)
+        with tracer.span("shuffle.pack", rows=int(nvalid_local.sum())):
+            local_rows = self._pack_shards(shard_outputs, plan.cap_in,
+                                           width, has_vals)
+
+        self.node.faults.check("exchange")
+        with self.node.metrics.timeit("shuffle.read"), \
+                tracer.span("shuffle.exchange",
+                            shuffle_id=handle.shuffle_id,
+                            rows=int(nvalid.sum()), width=width,
+                            distributed=True):
+            vt = val_tail if has_vals else None
+            result = read_shuffle_distributed(
+                self.exchange_mesh, self.axis, plan, local_rows,
+                nvalid_local, shard_ids, vt, val_dtype)
+        self.node.metrics.inc("shuffle.rows", float(nvalid_local.sum()))
         return result
 
     # -- checkpoint support ----------------------------------------------

@@ -1,0 +1,21 @@
+"""Multi-process e2e: the test.sh-analog cluster harness must pass.
+
+Spawns real OS processes (2 x 4 virtual CPU devices) that rendezvous via
+jax.distributed and run the distributed GroupBy in buildlib/e2e_worker.py —
+the closest analog of the reference's standalone-cluster CI job
+(ref: buildlib/test.sh:147-166)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_two_process_cluster_groupby():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "buildlib", "run_cluster.py"),
+         "--nprocs", "2", "--devices", "4", "--timeout", "400"],
+        capture_output=True, text=True, timeout=460)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert "CLUSTER E2E: PASS" in proc.stdout
