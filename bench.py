@@ -36,7 +36,7 @@ BASELINE_GBPS = 3.0
 
 
 def run(rows_log2: int, val_words: int, k1: int, k2: int, reps: int,
-        partitions_per_dev: int) -> dict:
+        partitions_per_dev: int, sort_impl: str = "auto") -> dict:
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -62,11 +62,12 @@ def run(rows_log2: int, val_words: int, k1: int, k2: int, reps: int,
         # destination sort, one fused exchange, receive-side grouping
         dest = jnp.take(part_to_dest, hash_partition(payload[:, 0], R))
         send, counts = destination_sort(
-            payload, dest, payload.shape[0], nchips)
+            payload, dest, payload.shape[0], nchips, method=sort_impl)
         r = ragged_shuffle(send, counts, "shuffle",
                            out_capacity=cap_out, impl="auto")
         rows_out, _ = destination_sort(
-            r.data, hash_partition(r.data[:, 0], R), r.total[0], R)
+            r.data, hash_partition(r.data[:, 0], R), r.total[0], R,
+            method=sort_impl)
         return rows_out, r.overflow
 
     def make(k):
@@ -142,6 +143,9 @@ def main() -> None:
     ap.add_argument("--rows-log2", type=int, default=None)
     ap.add_argument("--val-words", type=int, default=8)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--sort-impl", default="auto",
+                    help="destination_sort method: auto|argsort|multisort|"
+                         "counting (A/B the hot path)")
     args = ap.parse_args()
     if args.smoke:
         rows_log2 = args.rows_log2 or 12
@@ -150,7 +154,7 @@ def main() -> None:
         rows_log2 = args.rows_log2 or 21
         k1, k2, reps = 2, 12, args.reps
     result = run(rows_log2, args.val_words, k1, k2, reps,
-                 partitions_per_dev=8)
+                 partitions_per_dev=8, sort_impl=args.sort_impl)
     print(json.dumps(result))
 
 

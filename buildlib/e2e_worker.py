@@ -40,10 +40,14 @@ def main() -> int:
     from sparkucx_tpu.shuffle.manager import TpuShuffleManager
     from sparkucx_tpu.shuffle.writer import _hash32_np
 
+    num_slices = int(os.environ.get("SPARKUCX_TPU_NUM_SLICES", "1"))
     conf = TpuShuffleConf({
         "spark.shuffle.tpu.coordinator.address": coordinator,
         "spark.shuffle.tpu.numProcesses": str(nprocs),
         "spark.shuffle.tpu.a2a.impl": "dense",
+        # >1 slices: 2-D (dcn, ici) mesh -> the two-stage hierarchical
+        # exchange runs across processes (shuffle/hierarchical.py)
+        "spark.shuffle.tpu.mesh.numSlices": str(num_slices),
     }, use_env=False)
     node = TpuNode.start(conf, distributed=True, process_id=proc_id)
     mgr = TpuShuffleManager(node, conf)

@@ -32,6 +32,8 @@ def main() -> int:
     ap.add_argument("--nprocs", type=int, default=2)
     ap.add_argument("--devices", type=int, default=4,
                     help="virtual CPU devices per process")
+    ap.add_argument("--slices", type=int, default=1,
+                    help=">1 exercises the hierarchical ICI/DCN exchange")
     ap.add_argument("--timeout", type=float, default=480.0)
     args = ap.parse_args()
 
@@ -48,6 +50,7 @@ def main() -> int:
                 "SPARKUCX_TPU_NPROCS": str(args.nprocs),
                 "SPARKUCX_TPU_COORDINATOR": coordinator,
                 "SPARKUCX_TPU_LOCAL_DEVICES": str(args.devices),
+                "SPARKUCX_TPU_NUM_SLICES": str(args.slices),
                 # never let a worker grab the real TPU (one chip cannot be
                 # shared by N processes — the RDMA-device gate analog,
                 # ref: buildlib/azure-pipelines.yml:39-49 skips without HW)
@@ -79,8 +82,11 @@ def main() -> int:
             logs[pid].flush()
             logs[pid].seek(0)
             out = logs[pid].read()
-            tail = "\n".join(out.strip().splitlines()[-8:])
-            print(f"--- worker {pid} (exit {p.returncode}) ---\n{tail}")
+            if p.returncode == 0:
+                out = "\n".join(out.strip().splitlines()[-8:])
+            # on failure print the FULL log — the temp file is deleted in
+            # the finally block, so this is the only surviving copy
+            print(f"--- worker {pid} (exit {p.returncode}) ---\n{out}")
             ok = ok and p.returncode == 0
         print("CLUSTER E2E:", "PASS" if ok else "FAIL")
         return 0 if ok else 1

@@ -189,6 +189,12 @@ class TpuShuffleConf:
         return self._get("a2a.impl", "auto")
 
     @property
+    def sort_impl(self) -> str:
+        """Destination-sort formulation for the exchange hot path:
+        auto | argsort | multisort | counting (ops/partition.py)."""
+        return self._get("a2a.sortImpl", "auto")
+
+    @property
     def capacity_factor(self) -> float:
         """Output-buffer headroom multiplier over perfectly-balanced size.
 

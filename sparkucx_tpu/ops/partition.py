@@ -40,11 +40,15 @@ def hash_partition(keys: jnp.ndarray, num_partitions: int) -> jnp.ndarray:
     return (hash32(keys) % jnp.uint32(num_partitions)).astype(jnp.int32)
 
 
+SORT_METHODS = ("auto", "argsort", "multisort", "counting")
+
+
 def destination_sort(
     rows: jnp.ndarray,
     dest: jnp.ndarray,
     num_valid: jnp.ndarray,
     num_dests: int,
+    method: str = "auto",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Stable-sort padded rows by destination; padding sorts last.
 
@@ -52,6 +56,19 @@ def destination_sort(
     dest      — [cap] destination id per row (ignored for padding).
     num_valid — scalar count of real rows (rows[num_valid:] are padding).
     num_dests — static destination count.
+    method    — hot-path formulation; all are bit-identical in output, they
+                differ only in how they map to the hardware:
+        ``argsort``   — argsort the [cap] key then row-gather. The gather
+                        moves whole padded lane tiles per row.
+        ``multisort`` — one multi-operand ``lax.sort`` carrying every row
+                        column through the sort network; no gather at all.
+                        Needs 2-D rows.
+        ``counting``  — counting sort: one-hot cumsum ranks (no comparison
+                        sort), then a single row-gather via the inverse
+                        permutation. O(cap x num_dests) scratch — only for
+                        small destination counts.
+        ``auto``      — argsort (re-measured per backend by bench.py; flip
+                        ``spark.shuffle.tpu.a2a.sortImpl`` after measuring).
 
     Returns (sorted_rows [cap, ...], counts [num_dests]) where sorted_rows
     holds destination-grouped real rows first — the send-buffer invariant of
@@ -61,11 +78,36 @@ def destination_sort(
     idx = jnp.arange(cap, dtype=jnp.int32)
     valid = idx < num_valid
     key = jnp.where(valid, dest.astype(jnp.int32), jnp.int32(num_dests))
-    order = jnp.argsort(key, stable=True)
-    sorted_rows = jnp.take(rows, order, axis=0)
-    counts = jnp.bincount(
-        jnp.where(valid, dest.astype(jnp.int32), jnp.int32(num_dests)),
-        length=num_dests + 1)[:num_dests]
+    counts = jnp.bincount(key, length=num_dests + 1)[:num_dests]
+    if method == "auto":
+        method = "argsort"
+    if method == "counting" and num_dests > 64:
+        method = "argsort"  # O(cap x D) scratch would dwarf the payload
+    if method == "multisort" and rows.ndim != 2:
+        method = "argsort"
+
+    if method == "argsort":
+        order = jnp.argsort(key, stable=True)
+        sorted_rows = jnp.take(rows, order, axis=0)
+    elif method == "multisort":
+        ops = (key,) + tuple(rows[:, i] for i in range(rows.shape[1]))
+        out = jax.lax.sort(ops, num_keys=1, is_stable=True)
+        sorted_rows = jnp.stack(out[1:], axis=1)
+    elif method == "counting":
+        oh = (key[:, None] == jnp.arange(num_dests + 1,
+                                         dtype=jnp.int32)[None, :])
+        ranks = jnp.cumsum(oh.astype(jnp.int32), axis=0)
+        rank = jnp.take_along_axis(ranks, key[:, None], axis=1)[:, 0] - 1
+        counts_full = ranks[-1]                       # [num_dests + 1]
+        start = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32),
+             jnp.cumsum(counts_full)[:-1].astype(jnp.int32)])
+        pos = jnp.take(start, key) + rank
+        inv = jnp.zeros((cap,), jnp.int32).at[pos].set(idx)
+        sorted_rows = jnp.take(rows, inv, axis=0)
+    else:
+        raise ValueError(
+            f"unknown sort method {method!r}; want one of {SORT_METHODS}")
     return sorted_rows, counts.astype(jnp.int32)
 
 

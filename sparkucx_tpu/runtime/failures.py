@@ -304,6 +304,15 @@ class EpochManager:
         with self._lock:
             self._listeners.append(fn)
 
+    def remove_listener(self, fn: Callable[[int], None]) -> None:
+        """Deregister a bump listener (no-op if absent) — long-lived nodes
+        must not keep stopped managers alive through this list."""
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
     def validate(self, epoch: int, what: str = "work") -> None:
         cur = self.current
         if epoch != cur:

@@ -124,6 +124,37 @@ class TpuNode:
         analog (ref: UcxNode.java:170-172)."""
         return self.mesh.devices.reshape(-1)[shard]
 
+    # -- elastic membership (SURVEY.md §7 hard part (e)) ------------------
+    def remesh(self, devices=None, reason: str = "") -> int:
+        """Rebuild the mesh over ``devices`` (default: re-probe all) and
+        bump the epoch — the elastic answer to executor loss.
+
+        The reference admits late joiners through the driver's full-mesh
+        introduction RPC (ref: RpcConnectionCallback.java:70-84) and leans
+        on Spark to re-run work after a loss. JAX's process set is static,
+        so membership change = new mesh + new epoch: every handle pinned to
+        the old epoch fails fast (StaleEpochError) instead of hanging a
+        collective; callers re-register their shuffles and re-run — the
+        stage-resubmission analog. Registered shuffle state is dropped,
+        like unregisterShuffle on all live shuffles
+        (ref: CommonUcxShuffleManager.scala:73-77).
+
+        Returns the new epoch."""
+        import jax as _jax
+        if devices is None:
+            alive = self.health.probe()
+            devices = [d for d in _jax.devices() if alive.get(str(d), True)]
+        if not devices:
+            raise RuntimeError("remesh with zero surviving devices")
+        self.mesh = make_shuffle_mesh(devices, self.conf)
+        self.health = HealthMonitor(
+            self.mesh, timeout_ms=self.conf.connection_timeout_ms)
+        self.registry.clear()
+        epoch = self.epochs.bump(reason or "remesh")
+        log.warning("remesh: %d devices, epoch %d (%s)",
+                    self.mesh.devices.size, epoch, reason or "requested")
+        return epoch
+
     # -- teardown ---------------------------------------------------------
     def close(self) -> None:
         """Clean shutdown ordering mirrors UcxNode.close

@@ -133,32 +133,53 @@ def read_shuffle_distributed(
     shard_ids: Sequence[int],
     val_shape: Optional[Tuple[int, ...]],
     val_dtype,
+    hier_mesh: Optional[Mesh] = None,
+    dcn_axis: Optional[str] = None,
 ) -> DistributedReaderResult:
     """Run the exchange across all processes; COLLECTIVE — every process
     must call with the same plan/width.
 
     local_rows   — [L, cap_in, width] fused rows for this process's shards
     local_nvalid — [L] valid counts
-    shard_ids    — global shard indices of this process (mesh order)
+    shard_ids    — global shard indices of this process (mesh order;
+                   identical for the flat and 2-D mesh because the
+                   hierarchical flattening is row-major over (dcn, ici))
+    hier_mesh    — when set (with ``dcn_axis``), run the two-stage
+                   ICI-then-DCN exchange over this 2-D mesh instead of the
+                   flat single collective, so each row crosses the slow
+                   DCN links exactly once (shuffle/hierarchical.py)
     """
     Pn = plan.num_shards
     R = plan.num_partitions
     L, cap_in, width = local_rows.shape
     part_to_shard = np.asarray(_blocked_map(R, Pn))
-    sharding = NamedSharding(mesh, P(axis))
+    if hier_mesh is not None:
+        from sparkucx_tpu.shuffle.hierarchical import _build_hier_step
+        spec = P((dcn_axis, axis))
+        sharding = NamedSharding(hier_mesh, spec)
+    else:
+        sharding = NamedSharding(mesh, P(axis))
 
     cur = plan
     for attempt in range(plan.max_retries + 1):
-        step = _build_step(mesh, axis, cur, width)
+        if hier_mesh is not None:
+            step = _build_hier_step(hier_mesh, dcn_axis, axis, cur, width)
+        else:
+            step = _build_step(mesh, axis, cur, width)
         payload = jax.make_array_from_process_local_data(
             sharding, local_rows.reshape(L * cap_in, width))
         nvalid = jax.make_array_from_process_local_data(
             sharding, local_nvalid.astype(np.int32).reshape(L))
         rows_out, pcounts, total, ovf = step(payload, nvalid)
-        # the overflow flag is a mesh-wide psum: every process sees the
-        # same value on each of its shards
-        ovf_local = bool(np.asarray(ovf.addressable_shards[0].data).any())
-        if not ovf_local:
+        # The retry decision must be identical on every process or the
+        # SPMD group diverges. The flat exchange's flag is a mesh-wide
+        # psum, but the hierarchical flag (r1|r2) is only uniform within a
+        # slice — so allgather the local verdicts and OR them globally.
+        mine = any(bool(np.asarray(s.data).any())
+                   for s in ovf.addressable_shards)
+        ovf_global = bool(allgather_blob(
+            np.array([1 if mine else 0], dtype=np.int64)).any())
+        if not ovf_global:
             return DistributedReaderResult(
                 R, part_to_shard, shard_ids,
                 _local_shards_of(rows_out, shard_ids, cur.cap_out),

@@ -74,20 +74,21 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
 
         # stage 1 — ICI: group by destination device index d' = g % D
         send1, counts1 = destination_sort(
-            payload, g % D, nvalid[0], D)
+            payload, g % D, nvalid[0], D, method=plan.sort_impl)
         r1 = ragged_shuffle(send1, counts1, ici_axis,
                             out_capacity=plan.cap_out, impl=plan.impl)
 
         # stage 2 — DCN: recompute destinations, group by slice s' = g // D
         g2 = jnp.take(part_to_dest, part_fn(r1.data[:, 0]))
         send2, counts2 = destination_sort(
-            r1.data, g2 // D, r1.total[0], S)
+            r1.data, g2 // D, r1.total[0], S, method=plan.sort_impl)
         r2 = ragged_shuffle(send2, counts2, dcn_axis,
                             out_capacity=plan.cap_out, impl=plan.impl)
 
         # receive side: group rows by reduce partition
         rows_out, pcounts = destination_sort(
-            r2.data, part_fn(r2.data[:, 0]), r2.total[0], R)
+            r2.data, part_fn(r2.data[:, 0]), r2.total[0], R,
+            method=plan.sort_impl)
         overflow = r1.overflow | r2.overflow
         return rows_out, pcounts, r2.total, overflow
 

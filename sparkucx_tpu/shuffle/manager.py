@@ -74,6 +74,14 @@ class TpuShuffleManager:
         self.conf = conf or self.node.conf
         self._writers: Dict[int, Dict[int, MapOutputWriter]] = {}
         self._lock = threading.Lock()
+        self._bind_mesh()
+        # Elastic membership: a remesh (node.remesh) bumps the epoch; this
+        # manager rebinds to the new mesh and drops writer state for the
+        # cleared shuffles — handles from the old epoch fail fast in read()
+        self.node.epochs.on_bump(self._on_epoch_bump)
+
+    def _bind_mesh(self) -> None:
+        """Derive the exchange topology from the node's current mesh."""
         mesh = self.node.mesh
         self.axis = self.conf.mesh_ici_axis \
             if self.conf.mesh_ici_axis in mesh.axis_names \
@@ -94,6 +102,15 @@ class TpuShuffleManager:
                 mesh.devices.reshape(-1), (self.axis,))
         else:
             self.exchange_mesh = mesh
+
+    def _on_epoch_bump(self, epoch: int) -> None:
+        self._bind_mesh()
+        with self._lock:
+            self._writers.clear()
+        log.warning("manager rebound to epoch %d: mesh %s, shuffle state "
+                    "dropped — re-register and re-run live shuffles",
+                    epoch, dict(zip(self.node.mesh.axis_names,
+                                    self.node.mesh.devices.shape)))
 
     # -- lifecycle --------------------------------------------------------
     def register_shuffle(self, shuffle_id: int, num_maps: int,
@@ -163,6 +180,9 @@ class TpuShuffleManager:
                     f"shuffle {handle.shuffle_id} is not registered with "
                     f"this manager (already unregistered?)")
             writers = dict(self._writers[handle.shuffle_id])
+        # completeness is tracked by distinct map id in the metadata table;
+        # an extra uncommitted (half-written) writer must not inject rows
+        writers = {m: w for m, w in writers.items() if w.committed}
         shard_outputs, has_vals, val_tail, val_dtype = \
             self._materialize_outputs(
                 writers, Pn, lambda ordinal, map_id: map_id % Pn)
@@ -282,21 +302,32 @@ class TpuShuffleManager:
         with self._lock:
             writers = dict(self._writers.get(handle.shuffle_id, {}))
 
-        # Completeness barrier: poll the global committed-map count (the
-        # wait_complete analog, ref: UcxWorkerWrapper.scala:134-143). Both
-        # the success exit AND the timeout exit ride the allgathered values
-        # — one process's expired clock makes every process raise together,
-        # never leaving a peer blocked in the next collective.
+        # Completeness barrier: poll the global DISTINCT-map-id presence
+        # bitmap (the wait_complete analog, ref:
+        # UcxWorkerWrapper.scala:134-143) — a count would let a duplicate
+        # commit mask a missing map. Both the success exit AND the timeout
+        # exit ride the allgathered values — one process's expired clock
+        # makes every process raise together, never leaving a peer blocked
+        # in the next collective.
         deadline = _time.monotonic() + timeout
         while True:
-            present = sum(1 for w in writers.values() if w.committed)
-            expired = 1 if _time.monotonic() > deadline else 0
-            gathered = allgather_blob(
-                np.array([present, expired], dtype=np.int64))
-            total = int(gathered[:, 0].sum())
+            bitmap = np.zeros(handle.num_maps + 1, dtype=np.int64)
+            for map_id, w in writers.items():
+                if w.committed:
+                    bitmap[map_id] = 1
+            bitmap[-1] = 1 if _time.monotonic() > deadline else 0
+            gathered = allgather_blob(bitmap)          # [nproc, M+1]
+            owners = gathered[:, :-1].sum(axis=0)
+            if (owners > 1).any():
+                dups = np.nonzero(owners > 1)[0].tolist()
+                raise RuntimeError(
+                    f"shuffle {handle.shuffle_id}: map ids {dups} committed "
+                    f"by multiple processes — ambiguous ownership (maps "
+                    f"must be partitioned over processes)")
+            total = int((owners > 0).sum())
             if total >= handle.num_maps:
                 break
-            if gathered[:, 1].any():
+            if gathered[:, -1].any():
                 raise TimeoutError(
                     f"shuffle {handle.shuffle_id}: only {total}/"
                     f"{handle.num_maps} map outputs published within "
@@ -304,6 +335,11 @@ class TpuShuffleManager:
             _time.sleep(0.05)
             with self._lock:
                 writers = dict(self._writers.get(handle.shuffle_id, {}))
+
+        # only committed outputs enter the exchange; an uncommitted
+        # (half-written) writer for an already-satisfied map id must not
+        # inject partial rows
+        writers = {m: w for m, w in writers.items() if w.committed}
 
         # Local materialize + schema summary (maps round-robin over LOCAL
         # shards: outputs stay on the writing process, like Spark's
@@ -362,11 +398,15 @@ class TpuShuffleManager:
                 tracer.span("shuffle.exchange",
                             shuffle_id=handle.shuffle_id,
                             rows=int(nvalid.sum()), width=width,
+                            hierarchical=self.hierarchical,
                             distributed=True):
             vt = val_tail if has_vals else None
             result = read_shuffle_distributed(
                 self.exchange_mesh, self.axis, plan, local_rows,
-                nvalid_local, shard_ids, vt, val_dtype)
+                nvalid_local, shard_ids, vt, val_dtype,
+                hier_mesh=self.node.mesh if self.hierarchical else None,
+                dcn_axis=self.conf.mesh_dcn_axis
+                if self.hierarchical else None)
         self.node.metrics.inc("shuffle.rows", float(nvalid_local.sum()))
         return result
 
@@ -402,6 +442,7 @@ class TpuShuffleManager:
 
     def stop(self) -> None:
         """Tear everything down (ref: CommonUcxShuffleManager.scala:82-91)."""
+        self.node.epochs.remove_listener(self._on_epoch_bump)
         with self._lock:
             ids = list(self._writers.keys())
         for sid in ids:

@@ -40,12 +40,12 @@ class ShufflePlan:
     impl: str
     partitioner: str = "hash"  # hash | direct (keys ARE partition ids)
     max_retries: int = 4
+    sort_impl: str = "auto"    # ops/partition.py destination_sort method
 
     def grown(self) -> "ShufflePlan":
         """Next plan after an overflow: double the receive capacity."""
-        return ShufflePlan(self.num_shards, self.num_partitions,
-                           self.cap_in, self.cap_out * 2, self.impl,
-                           self.partitioner, self.max_retries)
+        import dataclasses
+        return dataclasses.replace(self, cap_out=self.cap_out * 2)
 
 
 def make_plan(
@@ -75,4 +75,5 @@ def make_plan(
         cap_out=cap_out,
         impl=conf.a2a_impl,
         partitioner=partitioner,
+        sort_impl=conf.sort_impl,
     )

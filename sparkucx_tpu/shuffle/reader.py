@@ -72,14 +72,16 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
     def step(payload, nvalid):
         # payload [cap_in, width] int32, col 0 = key_lo; nvalid [1]
         dest = jnp.take(part_to_dest, part_fn(payload[:, 0]))
-        send, counts = destination_sort(payload, dest, nvalid[0], Pn)
+        send, counts = destination_sort(payload, dest, nvalid[0], Pn,
+                                        method=plan.sort_impl)
 
         r = ragged_shuffle(send, counts, axis,
                            out_capacity=plan.cap_out, impl=plan.impl)
 
         # receive side: group rows by partition (recomputed from key_lo)
         rows_out, pcounts = destination_sort(
-            r.data, part_fn(r.data[:, 0]), r.total[0], R)
+            r.data, part_fn(r.data[:, 0]), r.total[0], R,
+            method=plan.sort_impl)
         return rows_out, pcounts, r.total, r.overflow
 
     sm = jax.shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),

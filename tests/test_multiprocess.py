@@ -12,10 +12,21 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_two_process_cluster_groupby():
+def _run(*extra):
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "buildlib", "run_cluster.py"),
-         "--nprocs", "2", "--devices", "4", "--timeout", "400"],
+         "--nprocs", "2", "--devices", "4", "--timeout", "400", *extra],
         capture_output=True, text=True, timeout=460)
     assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
     assert "CLUSTER E2E: PASS" in proc.stdout
+
+
+def test_two_process_cluster_groupby():
+    _run()
+
+
+def test_two_process_hierarchical_cluster():
+    # 2 slices over 2 processes x 4 devices: slice boundary == process
+    # boundary, so the DCN stage of the hierarchical exchange crosses
+    # processes — the multi-slice deployment shape
+    _run("--slices", "2")

@@ -1,0 +1,67 @@
+"""All destination_sort formulations must produce identical output.
+
+The hot path exposes three mathematically identical groupings that map to
+the hardware differently (ops/partition.py); conf key
+``spark.shuffle.tpu.a2a.sortImpl`` flips between them after measuring.
+Correctness must not depend on the choice.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sparkucx_tpu.ops.partition import destination_sort
+
+METHODS = ("argsort", "multisort", "counting")
+
+
+@pytest.mark.parametrize("method", METHODS)
+@pytest.mark.parametrize("num_dests,cap,nvalid", [
+    (4, 64, 64),     # full buffer
+    (4, 64, 37),     # padding tail
+    (1, 32, 32),     # single destination (the dp=1 shard case)
+    (16, 256, 0),    # all padding
+    (3, 100, 99),    # non-power-of-two everything
+])
+def test_methods_identical(method, num_dests, cap, nvalid):
+    rng = np.random.default_rng(42)
+    rows = jnp.asarray(rng.integers(0, 1 << 30, size=(cap, 5),
+                                    dtype=np.int64).astype(np.int32))
+    dest = jnp.asarray(rng.integers(0, num_dests, size=cap,
+                                    dtype=np.int64).astype(np.int32))
+    want_rows, want_counts = jax.jit(
+        lambda r, d: destination_sort(r, d, nvalid, num_dests,
+                                      method="argsort"))(rows, dest)
+    got_rows, got_counts = jax.jit(
+        lambda r, d: destination_sort(r, d, nvalid, num_dests,
+                                      method=method))(rows, dest)
+    np.testing.assert_array_equal(np.asarray(got_counts),
+                                  np.asarray(want_counts))
+    # compare only the valid prefix: the padding tail's ORDER is
+    # unspecified (argsort keeps input order, counting scatters), but its
+    # rows beyond nvalid are never read by the data plane
+    np.testing.assert_array_equal(np.asarray(got_rows)[:nvalid],
+                                  np.asarray(want_rows)[:nvalid])
+
+
+def test_counting_falls_back_for_many_dests():
+    # >64 destinations: counting would need O(cap x D) scratch; silently
+    # uses argsort — outputs must still be correct
+    rng = np.random.default_rng(0)
+    cap = 128
+    rows = jnp.asarray(rng.integers(0, 100, size=(cap, 3),
+                                    dtype=np.int64).astype(np.int32))
+    dest = jnp.asarray(rng.integers(0, 100, size=cap,
+                                    dtype=np.int64).astype(np.int32))
+    a, ca = destination_sort(rows, dest, cap, 100, method="argsort")
+    b, cb = destination_sort(rows, dest, cap, 100, method="counting")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(ca), np.asarray(cb))
+
+
+def test_bad_method_raises():
+    rows = jnp.zeros((8, 2), jnp.int32)
+    dest = jnp.zeros(8, jnp.int32)
+    with pytest.raises(ValueError, match="unknown sort method"):
+        destination_sort(rows, dest, 8, 2, method="bogus")

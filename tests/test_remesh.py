@@ -1,0 +1,53 @@
+"""Elastic recovery: lose devices, remesh, re-run — the full loop.
+
+SURVEY.md §7 hard part (e): the reference handles membership change with a
+driver introduction RPC and Spark stage resubmission; here membership
+change = node.remesh() (new mesh + epoch bump), stale handles fail fast,
+and re-registered work completes on the shrunken mesh.
+"""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.runtime.failures import StaleEpochError
+from sparkucx_tpu.workloads.groupby import run_groupby
+
+
+def test_remesh_shrink_and_rerun(manager_factory):
+    mgr = manager_factory()
+    node = mgr.node
+    assert node.num_devices == 8
+
+    # register under epoch 0, then lose two devices
+    h_old = mgr.register_shuffle(50, num_maps=4, num_partitions=16)
+    w = mgr.get_writer(h_old, 0)
+    w.write(np.arange(10, dtype=np.int64))
+    w.commit(16)
+
+    import jax
+    survivors = jax.devices()[:6]
+    new_epoch = node.remesh(devices=survivors, reason="2 devices lost")
+    assert new_epoch == 1
+    assert node.num_devices == 6
+    assert mgr.exchange_mesh.devices.size == 6
+
+    # the old handle is fenced off, not hung
+    with pytest.raises(StaleEpochError):
+        mgr.read(h_old)
+
+    # re-registered work completes on the shrunken mesh (stage
+    # resubmission analog) — full groupby with verification inside
+    out = run_groupby(mgr, num_mappers=4, pairs_per_mapper=200,
+                      num_partitions=12, key_space=100, shuffle_id=51)
+    assert out["rows"] == 800
+
+
+def test_remesh_rejects_empty():
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.config import TpuShuffleConf
+    node = TpuNode.start(TpuShuffleConf({}, use_env=False))
+    try:
+        with pytest.raises(RuntimeError, match="zero surviving"):
+            node.remesh(devices=[])
+    finally:
+        node.close()
