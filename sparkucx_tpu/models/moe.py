@@ -32,7 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from sparkucx_tpu.shuffle.alltoall import exchange
+from sparkucx_tpu.shuffle.alltoall import (
+    exchange, exchange_quantized, ragged_shuffle)
 
 
 @dataclass(frozen=True)
@@ -43,6 +44,8 @@ class MoEConfig:
     tokens_per_shard: int = 64     # static per-(dp,ep)-shard token count
     capacity_factor: float = 2.0   # exchange + expert capacity headroom
     impl: str = "auto"             # data-plane implementation
+    wire: str = "f32"              # f32 | int8 (wire-quantized dispatch:
+                                   # 4x fewer ICI bytes, STE gradients)
 
     @property
     def recv_capacity(self) -> int:
@@ -75,9 +78,11 @@ def param_specs(cfg: MoEConfig, dp: str = "dp", ep: str = "ep"):
     }
 
 
-def _moe_shard(params, x, *, cfg: MoEConfig, ep_axis: str, ep_size: int):
+def _moe_shard(params, x, seed, *, cfg: MoEConfig, ep_axis: str,
+               ep_size: int):
     """Per-shard forward: route -> dispatch (exchange) -> expert FFN ->
-    combine (exchange back) -> unsort. x: [T, D] local tokens."""
+    combine (exchange back) -> unsort. x: [T, D] local tokens; ``seed`` —
+    [1] int32 step counter feeding the wire-quantization noise stream."""
     T = cfg.tokens_per_shard
     E = cfg.num_experts
     e_local = E // ep_size
@@ -95,18 +100,27 @@ def _moe_shard(params, x, *, cfg: MoEConfig, ep_axis: str, ep_size: int):
     inv_order = jnp.argsort(order)                      # unsort permutation
     x_sorted = jnp.take(x, order, axis=0)
     counts = jnp.bincount(dest, length=ep_size).astype(jnp.int32)
-    recv = exchange(x_sorted, counts, ep_axis, cap_out, cfg.impl)  # [cap,D]
+    seed = jnp.asarray(seed, jnp.int32).reshape(())
+    if cfg.wire == "int8":
+        recv = exchange_quantized(x_sorted, counts, seed * 2, ep_axis,
+                                  cap_out, cfg.impl)
+    else:
+        recv = exchange(x_sorted, counts, ep_axis, cap_out, cfg.impl)
 
     # -- local expert assignment of received tokens ----------------------
-    # recompute routing on received rows (router is replicated, argmax is
-    # deterministic — the reader-side recompute trick from shuffle/reader)
-    rlogits = recv @ params["router"]
-    rexpert = jnp.argmax(rlogits, axis=-1)
+    # the expert id travels WITH the token as lossless integer rows (its
+    # own small exchange): recomputing argmax on received rows would
+    # disagree with the sender's routing whenever wire quantization (or
+    # any future lossy transport) perturbs near-tied logits, silently
+    # zeroing tokens. The id exchange's recv_sizes also serves as the
+    # reverse-exchange size row (replacing a separate all_gather).
+    expert_sorted = jnp.take(expert.astype(jnp.int32), order)
+    rid = ragged_shuffle(expert_sorted[:, None], counts, ep_axis,
+                         out_capacity=cap_out, impl=cfg.impl)
+    rexpert = rid.data[:, 0]
     shard_id = jax.lax.axis_index(ep_axis)
     le = rexpert - shard_id * e_local                   # local expert id
-    # my receive total: column `shard_id` of the gathered count matrix —
-    # also reused below as the reverse-exchange size row
-    recv_sizes = jax.lax.all_gather(counts, ep_axis)[:, shard_id]
+    recv_sizes = rid.recv_sizes
     my_recv = recv_sizes.sum()
     j = jnp.arange(cap_out, dtype=jnp.int32)
     rvalid = j < my_recv
@@ -137,29 +151,35 @@ def _moe_shard(params, x, *, cfg: MoEConfig, ep_axis: str, ep_size: int):
     out_recv = jnp.zeros_like(recv).at[eorder].set(out_sorted)
     # reverse exchange: send back what we received (sizes = what each peer
     # sent us); result arrives in our original destination-sorted layout
-    back = exchange(out_recv, recv_sizes.astype(jnp.int32), ep_axis,
-                    T, cfg.impl)                        # [T, D]
+    if cfg.wire == "int8":
+        back = exchange_quantized(out_recv, recv_sizes.astype(jnp.int32),
+                                  seed * 2 + 1, ep_axis, T, cfg.impl)
+    else:
+        back = exchange(out_recv, recv_sizes.astype(jnp.int32), ep_axis,
+                        T, cfg.impl)                    # [T, D]
     combined = jnp.take(back, inv_order, axis=0)        # original order
     out = combined * gate[:, None]
     return out @ params["wout"]
 
 
 def forward(params, x, mesh: Mesh, cfg: MoEConfig,
-            dp_axis: str = "dp", ep_axis: str = "ep"):
+            dp_axis: str = "dp", ep_axis: str = "ep", seed=0):
     """Full-model forward under shard_map. x: [B, D] global tokens,
-    B = dp*ep*tokens_per_shard."""
+    B = dp*ep*tokens_per_shard. ``seed``: step counter for the wire-
+    quantization noise stream (ignored for f32 wire)."""
     ep_size = dict(zip(mesh.axis_names, mesh.devices.shape))[ep_axis]
     fn = functools.partial(_moe_shard, cfg=cfg, ep_axis=ep_axis,
                            ep_size=ep_size)
     sm = jax.shard_map(
         fn, mesh=mesh,
-        in_specs=(param_specs(cfg, dp_axis, ep_axis), P((dp_axis, ep_axis))),
+        in_specs=(param_specs(cfg, dp_axis, ep_axis), P((dp_axis, ep_axis)),
+                  P()),
         out_specs=P((dp_axis, ep_axis)))
-    return sm(params, x)
+    return sm(params, x, jnp.asarray(seed, jnp.int32).reshape(1))
 
 
-def loss_fn(params, x, y, mesh, cfg, dp_axis="dp", ep_axis="ep"):
-    pred = forward(params, x, mesh, cfg, dp_axis, ep_axis)
+def loss_fn(params, x, y, mesh, cfg, dp_axis="dp", ep_axis="ep", seed=0):
+    pred = forward(params, x, mesh, cfg, dp_axis, ep_axis, seed)
     return jnp.mean((pred - y) ** 2)
 
 
@@ -178,9 +198,11 @@ def make_train_step(mesh: Mesh, cfg: MoEConfig, lr: float = 1e-3,
         return params, opt.init(params)
 
     @jax.jit
-    def step(params, opt_state, x, y):
+    def step(params, opt_state, x, y, step_idx=0):
+        # step_idx feeds the wire-quantization noise stream: pass the real
+        # step counter when wire="int8" so rounding noise is fresh per step
         loss, grads = jax.value_and_grad(loss_fn)(
-            params, x, y, mesh, cfg, dp_axis, ep_axis)
+            params, x, y, mesh, cfg, dp_axis, ep_axis, step_idx)
         updates, opt_state = opt.update(grads, opt_state)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

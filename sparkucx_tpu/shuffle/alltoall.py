@@ -213,6 +213,83 @@ def _exchange_bwd(axis_name, out_capacity, impl, res, g):
 exchange.defvjp(_exchange_fwd, _exchange_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def exchange_quantized(data: jnp.ndarray, local_sizes: jnp.ndarray,
+                       seed: jnp.ndarray, axis_name: str, out_capacity: int,
+                       impl: str = "auto") -> jnp.ndarray:
+    """Differentiable ragged exchange with int8 wire compression.
+
+    Float rows are stochastically quantized to int8 + one float32 scale per
+    row, bit-packed into the int32 transport format, moved with ONE
+    collective, and dequantized on arrival — 4x fewer ICI/DCN bytes than
+    :func:`exchange` for bf16/f32 activations. The reference's wire-cost
+    lever is transport selection (RDMA vs TCP, ref: README.md:2-3); on TPU
+    the lever is payload width. Output matches ``data``'s dtype.
+
+    ``seed`` is a TRACED int32 scalar — thread a step counter through it so
+    each training step draws fresh rounding noise; a static constant would
+    freeze the noise realization and the stochastic rounding would no
+    longer average out across steps. The backward pass derives its own
+    stream from the same seed.
+
+    Gradients use the straight-through estimator (quantization treated as
+    identity) and the cotangent exchange is ALSO int8-quantized — gradient
+    compression, the standard trade for distributed training traffic.
+    Rounding is unbiased (stochastic), so compressed gradients stay
+    unbiased in expectation."""
+    out, _ = _exchange_quantized_fwd(data, local_sizes, seed, axis_name,
+                                     out_capacity, impl)
+    return out
+
+
+def _quantized_move(data, local_sizes, axis_name, out_capacity, impl, seed):
+    from sparkucx_tpu.ops.pallas.quant import dequantize_rows, quantize_rows
+    in_dtype = data.dtype
+    n, w = data.shape
+    pad = (-w) % 4
+    if pad:
+        data = jnp.concatenate(
+            [data, jnp.zeros((n, pad), data.dtype)], axis=1)
+    q, scale = quantize_rows(data, seed)            # int8 [n, w+pad], f32 [n,1]
+    packed = jnp.concatenate([
+        jax.lax.bitcast_convert_type(
+            q.reshape(n, -1, 4), jnp.int32).reshape(n, -1),
+        jax.lax.bitcast_convert_type(scale, jnp.int32).reshape(n, 1),
+    ], axis=1)
+    r = ragged_shuffle(packed, local_sizes, axis_name,
+                       out_capacity=out_capacity, impl=impl)
+    qw = packed.shape[1] - 1
+    q_out = jax.lax.bitcast_convert_type(
+        r.data[:, :qw].reshape(out_capacity, qw, 1), jnp.int8
+    ).reshape(out_capacity, qw * 4)[:, :w]
+    s_out = jax.lax.bitcast_convert_type(
+        r.data[:, qw:], jnp.float32)                # [cap, 1]
+    out = dequantize_rows(q_out, s_out, jnp.float32)
+    poison = jnp.where(r.overflow[0], jnp.nan, 0.0)
+    return (out + poison).astype(in_dtype), r.recv_sizes
+
+
+def _exchange_quantized_fwd(data, local_sizes, seed, axis_name,
+                            out_capacity, impl):
+    seed = jnp.asarray(seed, jnp.int32)
+    out, recv_sizes = _quantized_move(data, local_sizes, axis_name,
+                                      out_capacity, impl, seed)
+    return out, (local_sizes, recv_sizes, seed, data.shape[0])
+
+
+def _exchange_quantized_bwd(axis_name, out_capacity, impl, res, g):
+    local_sizes, recv_sizes, seed, cap_in = res
+    # independent noise stream for the gradient compression; the output
+    # dtype matches the primal input (the forward casts back), so the
+    # cotangent g already carries the right dtype through _quantized_move
+    gb, _ = _quantized_move(g, recv_sizes, axis_name, cap_in, impl,
+                            seed ^ jnp.int32(0x5DEECE6))
+    return gb, jnp.zeros_like(local_sizes), jnp.zeros_like(seed)
+
+
+exchange_quantized.defvjp(_exchange_quantized_fwd, _exchange_quantized_bwd)
+
+
 def ragged_shuffle(data: jnp.ndarray, local_sizes: jnp.ndarray, axis_name: str,
                    *, out_capacity: int, peer_capacity: Optional[int] = None,
                    impl: str = "auto") -> ShuffleResult:

@@ -1,0 +1,131 @@
+"""int8 wire-compressed exchange: accuracy, routing parity, gradients.
+
+exchange_quantized moves float rows as int8+scale through the transport
+(4x fewer wire bytes); reconstruction error per row is bounded by
+amax/127 (one quantization step), routing must match the exact exchange,
+and the straight-through VJP must deliver finite compressed gradients.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sparkucx_tpu.shuffle.alltoall import exchange, exchange_quantized
+
+PDEV = 8
+CAP = 32
+W = 6  # deliberately not a multiple of 4: exercises the pad path
+
+
+def _mk(rng):
+    buffers = rng.normal(size=(PDEV, CAP, W)).astype(np.float32)
+    sizes = np.zeros((PDEV, PDEV), np.int32)
+    for p in range(PDEV):
+        left = CAP
+        for q in range(PDEV - 1):
+            sizes[p, q] = rng.integers(0, left // 2 + 1)
+            left -= sizes[p, q]
+        sizes[p, -1] = left
+    return buffers, sizes
+
+
+def _run(mesh8, fn, buffers, sizes, out_cap):
+    g = jax.jit(jax.shard_map(
+        lambda d, s: fn(d.reshape(CAP, W), s.reshape(-1)),
+        mesh=mesh8, in_specs=(P("shuffle"), P("shuffle")),
+        out_specs=P("shuffle")))
+    out = g(jnp.asarray(buffers.reshape(-1, W)),
+            jnp.asarray(sizes.reshape(-1)))
+    return np.asarray(out).reshape(PDEV, out_cap, W)
+
+
+def test_quantized_matches_exact_within_step(mesh8, rng):
+    buffers, sizes = _mk(rng)
+    out_cap = int(sizes.sum(axis=0).max()) + 8
+
+    exact = _run(mesh8, lambda d, s: exchange(
+        d, s, "shuffle", out_cap, "dense"), buffers, sizes, out_cap)
+    quant = _run(mesh8, lambda d, s: exchange_quantized(
+        d, s, 7, "shuffle", out_cap, "dense"), buffers, sizes, out_cap)
+
+    recv = sizes.sum(axis=0)
+    for q in range(PDEV):
+        e, v = exact[q, :recv[q]], quant[q, :recv[q]]
+        # per-row error bound: one stochastic-rounding step of amax/127
+        step = np.abs(e).max(axis=1, keepdims=True) / 127.0 + 1e-7
+        assert (np.abs(e - v) <= step + 1e-6).all(), \
+            f"dev {q}: max err {np.abs(e - v).max()}, bound {step.max()}"
+
+
+def test_quantized_gradients_finite_and_close(mesh8, rng):
+    buffers, sizes = _mk(rng)
+    out_cap = int(sizes.sum(axis=0).max()) + 8
+
+    def loss(fn):
+        def f(d, s):
+            out = fn(d.reshape(CAP, W), s.reshape(-1))
+            return jnp.sum(out ** 2).reshape(1)
+        def run(flat):
+            parts = jax.jit(jax.shard_map(
+                f, mesh=mesh8, in_specs=(P("shuffle"), P("shuffle")),
+                out_specs=P("shuffle")))(flat, jnp.asarray(
+                    sizes.reshape(-1)))
+            return parts.sum()
+        return jax.grad(run)(jnp.asarray(buffers.reshape(-1, W)))
+
+    g_exact = np.asarray(loss(lambda d, s: exchange(
+        d, s, "shuffle", out_cap, "dense")))
+    g_quant = np.asarray(loss(lambda d, s: exchange_quantized(
+        d, s, 11, "shuffle", out_cap, "dense")))
+    assert np.isfinite(g_quant).all()
+    # STE gradient of sum(out^2) is 2*out exchanged back: quantization
+    # noise enters twice (fwd value + bwd compression) — loose bound
+    denom = np.abs(g_exact).max() + 1e-6
+    rel = np.abs(g_quant - g_exact).max() / denom
+    assert rel < 0.1, f"relative grad error {rel}"
+
+
+def test_unbiased_rounding(mesh8, rng):
+    # stochastic rounding: averaging many seeds converges to the exact value
+    buffers, sizes = _mk(rng)
+    out_cap = int(sizes.sum(axis=0).max()) + 8
+    exact = _run(mesh8, lambda d, s: exchange(
+        d, s, "shuffle", out_cap, "dense"), buffers, sizes, out_cap)
+    acc = np.zeros_like(exact)
+    K = 24
+    for seed in range(K):
+        acc += _run(mesh8, lambda d, s, seed=seed: exchange_quantized(
+            d, s, seed, "shuffle", out_cap, "dense"), buffers, sizes,
+            out_cap)
+    mean = acc / K
+    recv = sizes.sum(axis=0)
+    for q in range(PDEV):
+        e, m = exact[q, :recv[q]], mean[q, :recv[q]]
+        step = np.abs(e).max(axis=1, keepdims=True) / 127.0 + 1e-7
+        # mean error shrinks ~1/sqrt(K) below one step
+        assert (np.abs(e - m) <= step * 0.5 + 1e-6).all()
+
+
+def test_bf16_activations_differentiate(mesh8, rng):
+    # the advertised bf16 path: output dtype matches input, and the custom
+    # VJP's cotangent aval must line up (regression: bwd returned f32)
+    buffers, sizes = _mk(rng)
+    out_cap = int(sizes.sum(axis=0).max()) + 8
+
+    def f(d, s):
+        out = exchange_quantized(d.reshape(CAP, W).astype(jnp.bfloat16),
+                                 s.reshape(-1), 3, "shuffle", out_cap,
+                                 "dense")
+        assert out.dtype == jnp.bfloat16
+        return jnp.sum(out.astype(jnp.float32) ** 2).reshape(1)
+
+    def run(flat):
+        parts = jax.jit(jax.shard_map(
+            f, mesh=mesh8, in_specs=(P("shuffle"), P("shuffle")),
+            out_specs=P("shuffle")))(flat, jnp.asarray(sizes.reshape(-1)))
+        return parts.sum()
+
+    g = jax.grad(run)(jnp.asarray(buffers.reshape(-1, W)))
+    assert np.isfinite(np.asarray(g, np.float32)).all()
