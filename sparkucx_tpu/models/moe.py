@@ -108,19 +108,25 @@ def _moe_shard(params, x, seed, *, cfg: MoEConfig, ep_axis: str,
         recv = exchange(x_sorted, counts, ep_axis, cap_out, cfg.impl)
 
     # -- local expert assignment of received tokens ----------------------
-    # the expert id travels WITH the token as lossless integer rows (its
-    # own small exchange): recomputing argmax on received rows would
-    # disagree with the sender's routing whenever wire quantization (or
-    # any future lossy transport) perturbs near-tied logits, silently
-    # zeroing tokens. The id exchange's recv_sizes also serves as the
-    # reverse-exchange size row (replacing a separate all_gather).
-    expert_sorted = jnp.take(expert.astype(jnp.int32), order)
-    rid = ragged_shuffle(expert_sorted[:, None], counts, ep_axis,
-                         out_capacity=cap_out, impl=cfg.impl)
-    rexpert = rid.data[:, 0]
     shard_id = jax.lax.axis_index(ep_axis)
+    if cfg.wire == "int8":
+        # lossy wire: the expert id must travel WITH the token as lossless
+        # integer rows (its own small exchange) — recomputing argmax on
+        # dequantized rows would disagree with the sender whenever the
+        # quantization noise perturbs near-tied logits, silently zeroing
+        # tokens. Its recv_sizes doubles as the reverse-exchange size row.
+        expert_sorted = jnp.take(expert.astype(jnp.int32), order)
+        rid = ragged_shuffle(expert_sorted[:, None], counts, ep_axis,
+                             out_capacity=cap_out, impl=cfg.impl)
+        rexpert = rid.data[:, 0]
+        recv_sizes = rid.recv_sizes
+    else:
+        # exact wire: recomputing routing on received rows is provably
+        # identical (router replicated, rows bit-exact) — no extra
+        # collective needed, just the tiny count all_gather
+        rexpert = jnp.argmax(recv @ params["router"], axis=-1)
+        recv_sizes = jax.lax.all_gather(counts, ep_axis)[:, shard_id]
     le = rexpert - shard_id * e_local                   # local expert id
-    recv_sizes = rid.recv_sizes
     my_recv = recv_sizes.sum()
     j = jnp.arange(cap_out, dtype=jnp.int32)
     rvalid = j < my_recv
@@ -198,9 +204,18 @@ def make_train_step(mesh: Mesh, cfg: MoEConfig, lr: float = 1e-3,
         return params, opt.init(params)
 
     @jax.jit
-    def step(params, opt_state, x, y, step_idx=0):
-        # step_idx feeds the wire-quantization noise stream: pass the real
-        # step counter when wire="int8" so rounding noise is fresh per step
+    def step(params, opt_state, x, y, step_idx=None):
+        # the wire-quantization noise stream must advance every step; by
+        # default ride the optimizer's own step counter so plain
+        # step(params, opt_state, x, y) callers get fresh noise for free
+        if step_idx is None:
+            # a NamedTuple state with a `count` FIELD (e.g. ScaleByAdamState)
+            # — plain tuples also have a .count (the method), so test fields
+            def has_count(s):
+                return "count" in getattr(s, "_fields", ())
+            counts = [s.count for s in jax.tree_util.tree_leaves(
+                opt_state, is_leaf=has_count) if has_count(s)]
+            step_idx = counts[0] if counts else 0
         loss, grads = jax.value_and_grad(loss_fn)(
             params, x, y, mesh, cfg, dp_axis, ep_axis, step_idx)
         updates, opt_state = opt.update(grads, opt_state)

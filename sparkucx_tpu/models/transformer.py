@@ -61,6 +61,7 @@ class TransformerConfig:
     microbatches: int = 2      # GPipe microbatches per local batch
     capacity_factor: float = 2.0
     impl: str = "auto"         # data-plane implementation for the exchange
+    attn: str = "ring"         # ring | ulysses context parallelism
 
 
 def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
@@ -195,6 +196,21 @@ def _moe_ffn(lp, x, cfg: TransformerConfig, ep_axis: str, tp_axis: str):
     return combined * gate[:, None]
 
 
+def _ulysses_attn(q, k, v, sp_axis: str):
+    """Causal Ulysses attention on local [mb, h, t, d] shards — delegates
+    to the flash-based per-shard body in parallel/ulysses.py (blockwise,
+    O(t) memory), which reshards heads<->sequence with two all-to-alls.
+    Needs local heads divisible by the sp size."""
+    from sparkucx_tpu.parallel.ulysses import _ulysses_sharded
+    p = jax.lax.axis_size(sp_axis)
+    if p > 1 and q.shape[1] % p != 0:
+        raise ValueError(
+            f"ulysses attention needs local heads {q.shape[1]} divisible "
+            f"by sp={p}; use attn='ring' for small head counts")
+    return _ulysses_sharded(q, k, v, axis=sp_axis, causal=True, scale=None,
+                            block_q=256, block_k=512, impl="auto")
+
+
 def _layer(h, lp, cfg: TransformerConfig, sp_axis: str, tp_axis: str,
            ep_axis: str):
     """One transformer layer on local [mb, t, D] activations."""
@@ -203,7 +219,10 @@ def _layer(h, lp, cfg: TransformerConfig, sp_axis: str, tp_axis: str,
     q = jnp.einsum("mtd,dhk->mhtk", x, lp["wqkv"][0])
     k = jnp.einsum("mtd,dhk->mhtk", x, lp["wqkv"][1])
     v = jnp.einsum("mtd,dhk->mhtk", x, lp["wqkv"][2])
-    attn = _ring_attn(q, k, v, sp_axis)                  # [mb, hl, t, dh]
+    if cfg.attn == "ulysses":
+        attn = _ulysses_attn(q, k, v, sp_axis)           # [mb, hl, t, dh]
+    else:
+        attn = _ring_attn(q, k, v, sp_axis)              # [mb, hl, t, dh]
     proj = jnp.einsum("mhtk,hkd->mtd", attn, lp["wo"])
     h = h + jax.lax.psum(proj, tp_axis)
 

@@ -41,8 +41,13 @@ def _quantize_pallas(x: jax.Array, u: jax.Array, block_n: int,
                      interpret: bool):
     N, W = x.shape
     bn = min(block_n, N)
-    if N % bn:
-        raise ValueError(f"N={N} must divide block_n={bn}")
+    pad = (-N) % bn
+    if pad:
+        # zero rows quantize to zeros and are sliced off below — any row
+        # count works, not just multiples of the block
+        x = jnp.concatenate([x, jnp.zeros((pad, W), x.dtype)])
+        u = jnp.concatenate([u, jnp.zeros((pad, W), u.dtype)])
+        N = N + pad
     q, s = pl.pallas_call(
         _quant_kernel,
         out_shape=(jax.ShapeDtypeStruct((N, W), jnp.int8),
@@ -62,6 +67,8 @@ def _quantize_pallas(x: jax.Array, u: jax.Array, block_n: int,
         ),
         interpret=interpret,
     )(x, u)
+    if pad:
+        q, s = q[:-pad], s[:-pad]
     return q, s
 
 

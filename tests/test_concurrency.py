@@ -1,0 +1,80 @@
+"""Thread-safety of the host-side control plane.
+
+The reference's concurrency discipline is "safety by construction":
+thread-local workers, ConcurrentHashMaps, synchronized singleton start
+(SURVEY.md §5 race detection). The analog here: many task threads share
+one manager/pool/registry; writes from concurrent map tasks must neither
+corrupt staged rows nor lose publishes."""
+
+import threading
+
+import numpy as np
+
+from sparkucx_tpu.runtime.memory import HostMemoryPool
+from sparkucx_tpu.shuffle.writer import _hash32_np
+
+
+def test_concurrent_map_tasks_one_manager(manager_factory):
+    mgr = manager_factory()
+    M, R = 16, 32
+    h = mgr.register_shuffle(80, M, R)
+    rows_per_map = 500
+    errs = []
+
+    def map_task(m):
+        try:
+            rng = np.random.default_rng(m)
+            w = mgr.get_writer(h, m)
+            keys = rng.integers(0, 10_000, size=rows_per_map)\
+                .astype(np.int64)
+            vals = np.repeat(keys[:, None], 3, axis=1).astype(np.int32)
+            # several small batches to interleave pool traffic
+            for i in range(0, rows_per_map, 100):
+                w.write(keys[i:i + 100], vals[i:i + 100])
+            w.commit(R)
+        except Exception as e:  # pragma: no cover
+            errs.append((m, e))
+
+    threads = [threading.Thread(target=map_task, args=(m,))
+               for m in range(M)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+
+    res = mgr.read(h)
+    total = 0
+    for r, (k, v) in res.partitions():
+        assert (v == k[:, None]).all(), f"row corruption in partition {r}"
+        assert (_hash32_np(k) % R == r).all(), f"misroute in partition {r}"
+        total += k.shape[0]
+    assert total == M * rows_per_map
+
+
+def test_concurrent_pool_get_put():
+    pool = HostMemoryPool()
+    errs = []
+
+    def worker(seed):
+        try:
+            rng = np.random.default_rng(seed)
+            for _ in range(200):
+                size = int(rng.integers(64, 8192))
+                buf = pool.get(size)
+                view = buf.view()
+                view[:8] = seed % 256
+                assert (view[:8] == seed % 256).all()
+                pool.put(buf)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    stats = pool.stats()
+    assert stats["in_use"] == 0, stats
+    pool.close()

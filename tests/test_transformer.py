@@ -81,3 +81,23 @@ def test_grads_finite_all_leaves():
         lambda p, x, y: jax.grad(loss_fn)(p, x, y, mesh, CFG))(params, x, y)
     for path, g in jax.tree_util.tree_flatten_with_path(grads)[0]:
         assert np.isfinite(np.asarray(g)).all(), path
+
+
+def test_ulysses_attn_matches_oracle(oracle):
+    import dataclasses
+    params, x, y, want = oracle
+    cfg_u = dataclasses.replace(CFG, attn="ulysses")
+    # sp=2 with 4 heads / tp=1: heads divisible by sp
+    got = forward(params, x, _mesh((2, 1, 2, 1, 2)), cfg_u)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    # one head cannot be split over sp=2 — must raise, not misroute
+    import dataclasses
+    cfg_bad = dataclasses.replace(CFG, attn="ulysses", num_heads=1,
+                                  head_dim=16)
+    params = init_params(jax.random.PRNGKey(0), cfg_bad)
+    x, _ = _data(np.random.default_rng(0))
+    with pytest.raises(ValueError, match="divisible"):
+        forward(params, x, _mesh((2, 1, 2, 1, 2)), cfg_bad)
