@@ -136,6 +136,32 @@ def run(rows_log2: int, val_words: int, k1: int, k2: int, reps: int,
     }
 
 
+def _arm_watchdog(seconds: float):
+    """Print an honest failure line and hard-exit if the backend wedges.
+
+    A tunneled TPU backend can hang indefinitely inside a transfer or
+    compile (observed in practice); without this, the bench produces no
+    output at all. The watchdog emits a diagnosable JSON line instead.
+    Returns the timer — CANCEL it once measurement succeeds, or a slow-
+    but-healthy run would get a second JSON line and exit 2."""
+    import os
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": "shuffle_read_GBps_per_chip", "value": 0.0,
+            "unit": "GB/s", "vs_baseline": 0.0,
+            "detail": {"error": f"watchdog: backend unresponsive after "
+                                f"{seconds:.0f}s (wedged tunnel/compile)"},
+        }), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true",
@@ -146,7 +172,11 @@ def main() -> None:
     ap.add_argument("--sort-impl", default="auto",
                     help="destination_sort method: auto|argsort|multisort|"
                          "counting (A/B the hot path)")
+    ap.add_argument("--watchdog", type=float, default=900.0,
+                    help="seconds before declaring the backend wedged "
+                         "(0 disables)")
     args = ap.parse_args()
+    watchdog = _arm_watchdog(args.watchdog) if args.watchdog else None
     if args.smoke:
         rows_log2 = args.rows_log2 or 12
         k1, k2, reps = 1, 3, 1
