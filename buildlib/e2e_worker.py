@@ -9,12 +9,25 @@ Workload: a distributed GroupBy (the reference CI's primary correctness
 job, ref: buildlib/test.sh:162-166). Map data is generated DETERMINISTICALLY
 from the map id, so every process can reconstruct the full global truth
 locally and verify its partitions without any extra wire.
+
+Recovery mode (SPARKUCX_TPU_RECOVERY_PHASE=1): the worker-loss drill.
+All members stage + commit, then the victim process dies abruptly
+(os._exit — no goodbye, like a lost executor). Survivors learn of the
+loss from the controller's signal file — the role the driver's RPC
+error callback plays in the reference (a disconnect surfaces there,
+ref: rpc/RpcConnectionCallback.java:91-98) — bump the epoch, and prove
+the stale handle fails fast with StaleEpochError instead of hanging a
+collective. The controller then re-runs the WHOLE map set on the
+survivors in a fresh world (run_cluster.py --recovery), the
+stage-resubmission analog: JAX's process set is static, so membership
+change = new world + new epoch (SURVEY.md §7 hard part (e)).
 """
 
 from __future__ import annotations
 
 import os
 import sys
+import time
 
 
 def main() -> int:
@@ -22,6 +35,9 @@ def main() -> int:
     nprocs = int(os.environ["SPARKUCX_TPU_NPROCS"])
     coordinator = os.environ["SPARKUCX_TPU_COORDINATOR"]
     devices_per_proc = int(os.environ.get("SPARKUCX_TPU_LOCAL_DEVICES", "4"))
+    recovery_phase = os.environ.get("SPARKUCX_TPU_RECOVERY_PHASE", "")
+    victim = int(os.environ.get("SPARKUCX_TPU_VICTIM", "-1"))
+    loss_file = os.environ.get("SPARKUCX_TPU_LOSS_FILE", "")
 
     # CPU backend with per-process virtual devices (the fake-backend role
     # UCX-over-shm plays for the reference, SURVEY.md §4) — must be set
@@ -52,7 +68,10 @@ def main() -> int:
     node = TpuNode.start(conf, distributed=True, process_id=proc_id)
     mgr = TpuShuffleManager(node, conf)
 
-    num_maps = 2 * nprocs           # maps per process x processes
+    # NUM_MAPS override lets the recovery re-run execute the ORIGINAL
+    # map set on fewer survivors (lost maps redistribute, like Spark
+    # rescheduling a dead executor's tasks)
+    num_maps = int(os.environ.get("SPARKUCX_TPU_NUM_MAPS", 2 * nprocs))
     R = 4 * node.num_devices
     key_space = 1000
     pairs_per_map = 600
@@ -73,6 +92,37 @@ def main() -> int:
         k, v = map_data(m)
         w.write(k, v)
         w.commit(R)
+
+    if recovery_phase == "1":
+        from sparkucx_tpu.runtime.failures import StaleEpochError
+        from sparkucx_tpu.shuffle.distributed import allgather_blob
+
+        # barrier: everyone has staged before the loss happens
+        allgather_blob(np.zeros(1, dtype=np.int64))
+        if proc_id == victim:
+            print(f"worker {proc_id}: dying abruptly (victim)", flush=True)
+            os._exit(1)
+        # survivor: wait for the controller's loss notification (the
+        # driver's disconnect-detection analog)
+        deadline = time.monotonic() + 60
+        while not (loss_file and os.path.exists(loss_file)):
+            if time.monotonic() > deadline:
+                print("ERROR: no loss signal within 60s", flush=True)
+                os._exit(3)
+            time.sleep(0.1)
+        # membership changed -> bump the epoch; the manager drops its
+        # shuffle state and every handle from the old epoch is fenced
+        node.epochs.bump(f"member loss: worker {victim}")
+        try:
+            mgr.read(h, timeout=5)
+            print("ERROR: stale handle was not fenced", flush=True)
+            os._exit(4)
+        except StaleEpochError as e:
+            print(f"worker {proc_id}: STALE-FENCED OK ({e})", flush=True)
+        # the old world's collectives are unusable with a dead member;
+        # exit without the collective shutdown barrier (orphaned world),
+        # the controller re-runs the job on a fresh one
+        os._exit(0)
 
     res = mgr.read(h)               # collective across all processes
 

@@ -27,6 +27,138 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "buildlib", "e2e_worker.py")
+
+
+def spawn(pid: int, nprocs: int, coordinator: str, devices: int,
+          slices: int, extra_env=None):
+    env = dict(os.environ)
+    env.update({
+        "SPARKUCX_TPU_PROC_ID": str(pid),
+        "SPARKUCX_TPU_NPROCS": str(nprocs),
+        "SPARKUCX_TPU_COORDINATOR": coordinator,
+        "SPARKUCX_TPU_LOCAL_DEVICES": str(devices),
+        "SPARKUCX_TPU_NUM_SLICES": str(slices),
+        # never let a worker grab the real TPU (one chip cannot be
+        # shared by N processes — the RDMA-device gate analog,
+        # ref: buildlib/azure-pipelines.yml:39-49 skips without HW)
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    })
+    env.update(extra_env or {})
+    # per-worker log FILES, not pipes: SPMD workers block as a
+    # group, so one worker stalled on a full stdout pipe would
+    # deadlock the whole cluster
+    logf = tempfile.NamedTemporaryFile(
+        mode="w+", suffix=f".worker{pid}.log", delete=False)
+    proc = subprocess.Popen([sys.executable, WORKER], env=env,
+                            stdout=logf, stderr=subprocess.STDOUT, text=True)
+    return proc, logf
+
+
+def reap(procs, logs, deadline, expect_rc=None) -> bool:
+    """Wait for every worker; print tails (full log on failure). When
+    ``expect_rc`` maps pid -> required exit code (e.g. the victim MUST die
+    with 1), mismatches fail the run."""
+    ok = True
+    for pid, p in enumerate(procs):
+        remaining = max(1.0, deadline - time.monotonic())
+        try:
+            p.wait(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+            ok = False
+            print(f"--- worker {pid} TIMED OUT ---")
+        logs[pid].flush()
+        logs[pid].seek(0)
+        out = logs[pid].read()
+        want = (expect_rc or {}).get(pid, 0)
+        if p.returncode == want:
+            out = "\n".join(out.strip().splitlines()[-8:])
+        # on failure print the FULL log — the temp file is deleted in
+        # the finally block, so this is the only surviving copy
+        print(f"--- worker {pid} (exit {p.returncode}) ---\n{out}")
+        ok = ok and p.returncode == want
+    return ok
+
+
+def run_recovery(args) -> int:
+    """Worker-loss drill: lose a member mid-job, fence the stale epoch on
+    the survivors, re-run the whole map set on a fresh (smaller) world —
+    detect -> remesh -> re-register -> re-run -> verify."""
+    assert args.nprocs >= 3, "recovery drill needs >= 3 processes"
+    victim = args.nprocs - 1
+    num_maps = 2 * args.nprocs
+    loss_dir = tempfile.mkdtemp(prefix="sxt_loss_")
+    loss_file = os.path.join(loss_dir, "member_lost")
+    deadline = time.monotonic() + args.timeout
+    procs, logs = [], []
+    all_logs = []                 # both phases; the finally cleans these
+    try:
+        # phase 1: full membership; victim dies after staging
+        coordinator = f"localhost:{free_port()}"
+        for pid in range(args.nprocs):
+            p, f = spawn(pid, args.nprocs, coordinator, args.devices, 1,
+                         {"SPARKUCX_TPU_RECOVERY_PHASE": "1",
+                          "SPARKUCX_TPU_VICTIM": str(victim),
+                          "SPARKUCX_TPU_LOSS_FILE": loss_file,
+                          "SPARKUCX_TPU_NUM_MAPS": str(num_maps)})
+            procs.append(p)
+            logs.append(f)
+            all_logs.append(f)
+        # the controller notices the death (the driver's RPC-disconnect
+        # callback analog, ref: rpc/RpcConnectionCallback.java:91-98) and
+        # signals the survivors
+        while procs[victim].poll() is None:
+            if time.monotonic() > deadline:
+                print("victim never died"); return 1
+            time.sleep(0.1)
+        with open(loss_file, "w") as f:
+            f.write(f"worker {victim} lost\n")
+        ok = reap(procs, logs, deadline, expect_rc={victim: 1})
+        fenced = 0
+        for pid, lf in enumerate(logs):
+            if pid == victim:
+                continue
+            lf.seek(0)
+            fenced += 1 if "STALE-FENCED OK" in lf.read() else 0
+        if fenced != args.nprocs - 1:
+            print(f"only {fenced}/{args.nprocs - 1} survivors fenced")
+            ok = False
+        if not ok:
+            print("CLUSTER RECOVERY: FAIL (phase 1)")
+            return 1
+
+        # phase 2: fresh world of survivors re-runs the SAME map set
+        # (lost maps redistribute) and verifies the full result
+        procs, logs = [], []
+        coordinator = f"localhost:{free_port()}"
+        for pid in range(args.nprocs - 1):
+            p, f = spawn(pid, args.nprocs - 1, coordinator, args.devices, 1,
+                         {"SPARKUCX_TPU_NUM_MAPS": str(num_maps)})
+            procs.append(p)
+            logs.append(f)
+            all_logs.append(f)
+        ok = reap(procs, logs, deadline)
+        print("CLUSTER RECOVERY:", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in all_logs:
+            try:
+                f.close()
+                os.unlink(f.name)
+            except OSError:
+                pass
+        import shutil
+        shutil.rmtree(loss_dir, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nprocs", type=int, default=2)
@@ -34,60 +166,24 @@ def main() -> int:
                     help="virtual CPU devices per process")
     ap.add_argument("--slices", type=int, default=1,
                     help=">1 exercises the hierarchical ICI/DCN exchange")
+    ap.add_argument("--recovery", action="store_true",
+                    help="worker-loss drill: kill one member mid-job, "
+                         "fence + re-run on the survivors")
     ap.add_argument("--timeout", type=float, default=480.0)
     args = ap.parse_args()
 
-    coordinator = f"localhost:{free_port()}"
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    worker = os.path.join(repo, "buildlib", "e2e_worker.py")
+    if args.recovery:
+        return run_recovery(args)
 
+    coordinator = f"localhost:{free_port()}"
     procs, logs = [], []
     try:
         for pid in range(args.nprocs):
-            env = dict(os.environ)
-            env.update({
-                "SPARKUCX_TPU_PROC_ID": str(pid),
-                "SPARKUCX_TPU_NPROCS": str(args.nprocs),
-                "SPARKUCX_TPU_COORDINATOR": coordinator,
-                "SPARKUCX_TPU_LOCAL_DEVICES": str(args.devices),
-                "SPARKUCX_TPU_NUM_SLICES": str(args.slices),
-                # never let a worker grab the real TPU (one chip cannot be
-                # shared by N processes — the RDMA-device gate analog,
-                # ref: buildlib/azure-pipelines.yml:39-49 skips without HW)
-                "PALLAS_AXON_POOL_IPS": "",
-                "JAX_PLATFORMS": "cpu",
-                "PYTHONPATH": repo + os.pathsep
-                + os.environ.get("PYTHONPATH", ""),
-            })
-            # per-worker log FILES, not pipes: SPMD workers block as a
-            # group, so one worker stalled on a full stdout pipe would
-            # deadlock the whole cluster
-            logs.append(tempfile.NamedTemporaryFile(
-                mode="w+", suffix=f".worker{pid}.log", delete=False))
-            procs.append(subprocess.Popen(
-                [sys.executable, worker], env=env,
-                stdout=logs[-1], stderr=subprocess.STDOUT, text=True))
-
-        deadline = time.monotonic() + args.timeout
-        ok = True
-        for pid, p in enumerate(procs):
-            remaining = max(1.0, deadline - time.monotonic())
-            try:
-                p.wait(timeout=remaining)
-            except subprocess.TimeoutExpired:
-                p.kill()
-                p.wait()
-                ok = False
-                print(f"--- worker {pid} TIMED OUT ---")
-            logs[pid].flush()
-            logs[pid].seek(0)
-            out = logs[pid].read()
-            if p.returncode == 0:
-                out = "\n".join(out.strip().splitlines()[-8:])
-            # on failure print the FULL log — the temp file is deleted in
-            # the finally block, so this is the only surviving copy
-            print(f"--- worker {pid} (exit {p.returncode}) ---\n{out}")
-            ok = ok and p.returncode == 0
+            p, f = spawn(pid, args.nprocs, coordinator, args.devices,
+                         args.slices)
+            procs.append(p)
+            logs.append(f)
+        ok = reap(procs, logs, time.monotonic() + args.timeout)
         print("CLUSTER E2E:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:

@@ -21,8 +21,18 @@ Reference parity citations appear in docstrings as ``ref: file:line``
 pointing into /root/reference.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from sparkucx_tpu.config import TpuShuffleConf
 
-__all__ = ["TpuShuffleConf", "__version__"]
+
+def connect(conf=None, **kw):
+    """Config-keyed entry point; see :func:`sparkucx_tpu.service.connect`.
+
+    Lazy import: building the service touches JAX, and importers of the
+    bare package (e.g. config-only tooling) must not pay backend init."""
+    from sparkucx_tpu.service import connect as _connect
+    return _connect(conf, **kw)
+
+
+__all__ = ["TpuShuffleConf", "connect", "__version__"]

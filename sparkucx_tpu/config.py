@@ -12,8 +12,9 @@ Key table (reference key -> ours):
 
     spark.shuffle.ucx.driver.host/port      -> spark.shuffle.tpu.coordinator.address
                                                (jax.distributed rendezvous)
-    spark.shuffle.ucx.rkeySize (x2 = 300B)  -> spark.shuffle.tpu.meta.recordSize
-                                               (segment-table slot, bytes)
+    spark.shuffle.ucx.rkeySize (x2 = 300B)  -> (no key: the segment-table slot
+                                               size is derived, meta/segments.py
+                                               record_size(num_partitions))
     spark.shuffle.ucx.rpc.metadata.bufferSize -> spark.shuffle.tpu.meta.bufferSize
     spark.shuffle.ucx.memory.preAllocateBuffers -> spark.shuffle.tpu.memory.preAllocateBuffers
     spark.shuffle.ucx.memory.minBufferSize  -> spark.shuffle.tpu.memory.minBufferSize
@@ -129,18 +130,15 @@ class TpuShuffleConf:
         return self._get("coordinator.address", "localhost:55443")
 
     @property
-    def meta_record_size(self) -> int:
-        """Fixed size of one serialized map-output metadata record.
-
-        Analog of the 300-byte (2 x rkeySize) driver-table slot
-        (ref: UcxShuffleConf.scala:32-40, UcxWorkerWrapper.scala:29-32)."""
-        return self.get_bytes("meta.recordSize", 304)
-
-    @property
     def meta_buffer_size(self) -> int:
-        """Bootstrap/metadata message buffer size
-        (ref: UcxShuffleConf.scala:42-49, default 4k)."""
-        return self.get_bytes("meta.bufferSize", "4k")
+        """Upper bound on one metadata-plane message (the presence bitmap /
+        schema blob a process allgathers in distributed mode). Oversized
+        messages fail loudly before the collective instead of stalling it —
+        the role the fixed 4 KB bootstrap buffer plays in the reference
+        (ref: UcxShuffleConf.scala:42-49, UcxListenerThread.java:34-39).
+        Enforced by TpuShuffleManager._read_distributed; default 64k allows
+        ~8000 map outputs per shuffle."""
+        return self.get_bytes("meta.bufferSize", "64k")
 
     @property
     def min_buffer_size(self) -> int:
@@ -178,6 +176,28 @@ class TpuShuffleConf:
         Plays the role the ODP toggle plays for registration strategy
         (ref: UcxShuffleConf.scala:89)."""
         return self.get_bool("memory.pinned", True)
+
+    @property
+    def spill_threshold(self) -> int:
+        """Staged bytes per map writer before batches spill to disk files
+        (0 disables). The disk story of the reference — map outputs living
+        in sort-shuffle ``data``+``index`` files served from page cache
+        (ref: CommonUcxShuffleBlockResolver.scala:33-57) — becomes an
+        overflow valve here: hot outputs stay in the pinned arena, big ones
+        append to per-writer files and are mmapped back at read time, so
+        staging RSS stays bounded by this threshold instead of the dataset
+        size."""
+        return self.get_bytes("spill.threshold", "256m")
+
+    @property
+    def spill_dir(self) -> str:
+        """Directory for spilled map-output files (the executor local-dir
+        analog). Default: a per-process dir under the system temp dir."""
+        import tempfile
+        return self._get(
+            "spill.dir",
+            os.path.join(tempfile.gettempdir(),
+                         f"sparkucx_tpu_spill_{os.getpid()}"))
 
     # -- TPU-only keys ----------------------------------------------------
     @property
@@ -221,8 +241,11 @@ class TpuShuffleConf:
 
     @property
     def cores_per_process(self) -> int:
-        """(ref: UcxShuffleConf.scala:22-23)."""
-        return self.get_int("coresPerProcess", 1)
+        """Expected concurrent map tasks per process. The manager warns when
+        more writers are live at once — the analog of UcxNode warning when
+        task threads exceed spark.executor.cores (ref: UcxNode.java:85-95,
+        UcxShuffleConf.scala:22-23). Default: the host's CPU count."""
+        return self.get_int("coresPerProcess", os.cpu_count() or 1)
 
     @property
     def connection_timeout_ms(self) -> int:

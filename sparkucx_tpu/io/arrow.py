@@ -112,16 +112,18 @@ def write_batches(manager, handle, map_id: int,
     _require_arrow()
     w = manager.get_writer(handle, map_id)
     recipe: Optional[List[np.dtype]] = None
+    names: Optional[List[str]] = None
     for b in batches:
         keys, values, dtypes = batch_to_kv(b, key_column)
         if not keys.shape[0]:
             continue
+        bnames = [f for f in b.schema.names if f != key_column]
         if recipe is None:
-            recipe = dtypes
-        elif dtypes != recipe:
+            recipe, names = dtypes, bnames
+        elif dtypes != recipe or bnames != names:
             raise ValueError(
                 f"batch schema mismatch within map {map_id}: "
-                f"{dtypes} vs {recipe}")
+                f"{list(zip(bnames, dtypes))} vs {list(zip(names, recipe))}")
         w.write(keys, values)
     # Recipe checks must precede commit: once committed, the output is
     # published to the metadata plane and a blocked reader may decode it —
@@ -129,11 +131,13 @@ def write_batches(manager, handle, map_id: int,
     # reinterpretation on the read side. setdefault keeps the
     # check-then-set atomic under concurrent map tasks.
     if recipe is not None:
-        winner = handle.__dict__.setdefault("_arrow_value_dtypes", recipe)
-        if list(winner) != list(recipe):
+        winner = handle.__dict__.setdefault(
+            "_arrow_value_schema", (names, recipe))
+        if (list(winner[0]), list(winner[1])) != (names, recipe):
             raise ValueError(
                 f"value schema mismatch across map tasks: map {map_id} "
-                f"wrote {recipe}, an earlier task wrote {list(winner)}")
+                f"wrote {list(zip(names, recipe))}, an earlier task wrote "
+                f"{list(zip(*winner))}")
     w.commit(num_partitions or handle.num_partitions)
     return recipe or []
 
@@ -143,10 +147,16 @@ def read_batches(manager, handle, key_column: str = "key",
                  value_dtypes: Optional[Sequence] = None,
                  timeout: Optional[float] = None) -> List["pa.RecordBatch"]:
     """Run the exchange; one RecordBatch per non-empty reduce partition.
-    Column dtypes default to the recipe recorded by write_batches."""
+    Column names and dtypes default to the recipe recorded by
+    write_batches, so batches come back with the schema they went in
+    with."""
     _require_arrow()
-    if value_dtypes is None:
-        value_dtypes = handle.__dict__.get("_arrow_value_dtypes")
+    recorded = handle.__dict__.get("_arrow_value_schema")
+    if recorded is not None:
+        if value_columns is None:
+            value_columns = recorded[0]
+        if value_dtypes is None:
+            value_dtypes = recorded[1]
     res = manager.read(handle, timeout=timeout)
     out = []
     for r, (k, v) in res.partitions():

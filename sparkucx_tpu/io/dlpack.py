@@ -35,9 +35,15 @@ def to_external(arr: jnp.ndarray, consumer: str = "numpy") -> Any:
 
 
 def stage_to_device(host_array: np.ndarray,
-                    device: Optional[jax.Device] = None) -> jnp.ndarray:
+                    device: Optional[Any] = None) -> jnp.ndarray:
     """Pinned-host -> HBM on-ramp: the device_put step the reference's
     mmapped+registered files feed via RDMA (ref:
     CommonUcxShuffleBlockResolver.scala:45-57 — registration makes host
-    bytes DMA-reachable; here device_put performs the DMA)."""
+    bytes DMA-reachable; here device_put performs the DMA).
+
+    ``device`` may be a jax.Device or a Sharding; with a NamedSharding the
+    array lands already laid out across the mesh, so the exchange step
+    consumes it without a resharding copy. The production call sites are
+    shuffle/reader.py and shuffle/hierarchical.py, which stage the packed
+    arena view (TpuShuffleManager._pack_shards) straight into HBM."""
     return jax.device_put(host_array, device)

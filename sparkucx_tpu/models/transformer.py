@@ -167,13 +167,21 @@ def _moe_ffn(lp, x, cfg: TransformerConfig, ep_axis: str, tp_axis: str):
     inv_order = jnp.argsort(order)
     x_sorted = jnp.take(x, order, axis=0)
     counts = jnp.bincount(dest, length=ep).astype(jnp.int32)
-    recv = exchange(x_sorted, counts, ep_axis, cap_out, cfg.impl)
-
-    # deterministic recompute of routing on received rows (router replicated;
-    # NOTE: the tie-break above is position-dependent, so the recompute uses
-    # plain argmax — consistent except on exact ties, where both sides pick
-    # a valid expert; the local-expert mask below drops any stray row)
-    rexpert = jnp.argmax(recv @ lp["router"], axis=-1)
+    # Ship the sender's expert choice losslessly WITH the row (as moe.py's
+    # int8 wire already does): recomputing it receive-side via argmax
+    # diverges whenever a token's top-2 logit gap is below the tie-break
+    # perturbation, and the local-expert mask then silently zeroes that
+    # token's FFN output. Small integers are exact in any float dtype up
+    # to its mantissa range.
+    if cfg.num_experts > 2 ** (jnp.finfo(x.dtype).nmant + 1):
+        raise ValueError(
+            f"num_experts={cfg.num_experts} not exactly representable in "
+            f"{x.dtype}; the expert-id wire column would corrupt routing")
+    xid = jnp.concatenate(
+        [x_sorted, jnp.take(expert, order).astype(x.dtype)[:, None]], axis=1)
+    recv = exchange(xid, counts, ep_axis, cap_out, cfg.impl)
+    rexpert = recv[:, -1].astype(jnp.int32)
+    recv = recv[:, :-1]
     shard = jax.lax.axis_index(ep_axis)
     le = (rexpert - shard * e_local).astype(jnp.int32)
     recv_sizes = jax.lax.all_gather(counts, ep_axis)[:, shard]
@@ -325,13 +333,16 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-2):
     return init, step
 
 
-def make_mesh(n_devices: int, devices=None) -> Mesh:
+def make_mesh(n_devices: int, devices=None,
+              order: tuple = ("ep", "sp", "pp", "tp")) -> Mesh:
     """Factor n devices over (dp, pp, sp, tp, ep), spending one factor of
-    two on each of ep, sp, pp, tp in that order (data plane first), with the
-    remainder on dp — so 8 devices exercise ep/sp/pp and 16+ add tp."""
+    two on each axis in ``order`` (data plane first by default), with the
+    remainder on dp — so 8 devices exercise ep/sp/pp and 16+ add tp.
+    Alternate orders let a small device count light up different axis
+    combinations (e.g. ("ep", "tp") puts 8 devices on ep=2, tp=2, dp=2)."""
     sizes = {ax: 1 for ax in AXES}
     rem = n_devices
-    for ax in ("ep", "sp", "pp", "tp"):
+    for ax in order:
         if rem % 2 == 0:
             sizes[ax] = 2
             rem //= 2

@@ -142,6 +142,19 @@ class TpuNode:
         Returns the new epoch."""
         import jax as _jax
         if devices is None:
+            if self.is_distributed:
+                # Each process probes independently and jax.devices() spans
+                # the cluster: deriving the survivor set locally can diverge
+                # across processes and build inconsistent meshes that wedge
+                # the next collective instead of failing fast. Survivor
+                # agreement lives in the recovery controller
+                # (buildlib/run_cluster.py): it restarts the world with an
+                # explicitly agreed membership and passes it here.
+                raise RuntimeError(
+                    "distributed remesh requires an explicitly agreed "
+                    "device list; probe verdicts are process-local and can "
+                    "diverge. Re-bootstrap with the surviving processes "
+                    "and pass devices=.")
             alive = self.health.probe()
             devices = [d for d in _jax.devices() if alive.get(str(d), True)]
         if not devices:

@@ -180,11 +180,13 @@ def read_shuffle_distributed(
         ovf_global = bool(allgather_blob(
             np.array([1 if mine else 0], dtype=np.int64)).any())
         if not ovf_global:
-            return DistributedReaderResult(
+            res = DistributedReaderResult(
                 R, part_to_shard, shard_ids,
                 _local_shards_of(rows_out, shard_ids, cur.cap_out),
                 _local_shards_of(pcounts, shard_ids, R),
                 val_shape, val_dtype)
+            res.cap_out_used = cur.cap_out
+            return res
         log.info("distributed shuffle overflow at cap_out=%d (attempt %d)",
                  cur.cap_out, attempt)
         cur = cur.grown()

@@ -98,6 +98,30 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
     return jax.jit(sm)
 
 
+def submit_shuffle_hierarchical(
+    mesh: Mesh,
+    dcn_axis: str,
+    ici_axis: str,
+    plan: ShufflePlan,
+    shard_rows: np.ndarray,
+    shard_nvalid: np.ndarray,
+    val_shape,
+    val_dtype,
+    on_done=None,
+):
+    """Dispatch the two-stage exchange without blocking — same
+    submit/poll contract as :func:`shuffle.reader.submit_shuffle`."""
+    from jax.sharding import NamedSharding
+
+    from sparkucx_tpu.shuffle.reader import PendingShuffle
+
+    width = shard_rows.shape[2]
+    return PendingShuffle(
+        lambda p: _build_hier_step(mesh, dcn_axis, ici_axis, p, width),
+        NamedSharding(mesh, P((dcn_axis, ici_axis))), plan,
+        shard_rows, shard_nvalid, val_shape, val_dtype, on_done=on_done)
+
+
 def read_shuffle_hierarchical(
     mesh: Mesh,
     dcn_axis: str,
@@ -110,26 +134,6 @@ def read_shuffle_hierarchical(
 ) -> ShuffleReaderResult:
     """Two-stage exchange with the same overflow-retry contract as the
     flat :func:`sparkucx_tpu.shuffle.reader.read_shuffle`."""
-    Pn = plan.num_shards
-    R = plan.num_partitions
-    width = shard_rows.shape[2]
-    part_to_shard = np.asarray(_blocked_map(R, Pn))
-
-    cur = plan
-    for attempt in range(plan.max_retries + 1):
-        step = _build_hier_step(mesh, dcn_axis, ici_axis, cur, width)
-        rows_flat = jnp.asarray(shard_rows.reshape(-1, width))
-        nvalid = jnp.asarray(shard_nvalid.astype(np.int32).reshape(-1))
-        rows_out, pcounts, total, ovf = step(rows_flat, nvalid)
-        if not np.asarray(ovf).any():
-            return ShuffleReaderResult(
-                R, part_to_shard,
-                np.asarray(rows_out).reshape(Pn, cur.cap_out, width),
-                np.asarray(pcounts).reshape(Pn, R),
-                val_shape, val_dtype)
-        log.info("hierarchical overflow at cap_out=%d (attempt %d); growing",
-                 cur.cap_out, attempt)
-        cur = cur.grown()
-    raise RuntimeError(
-        f"hierarchical shuffle still overflowing after {plan.max_retries} "
-        f"retries (cap_out={cur.cap_out}); extreme skew — repartition")
+    return submit_shuffle_hierarchical(
+        mesh, dcn_axis, ici_axis, plan, shard_rows, shard_nvalid,
+        val_shape, val_dtype).result()

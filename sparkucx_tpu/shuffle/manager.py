@@ -34,7 +34,7 @@ from sparkucx_tpu.shuffle.reader import (
     KEY_WORDS,
     ShuffleReaderResult,
     pack_rows,
-    read_shuffle,
+    submit_shuffle,
     value_words,
 )
 from sparkucx_tpu.shuffle.writer import MapOutputWriter
@@ -73,6 +73,10 @@ class TpuShuffleManager:
         self.node = node or TpuNode.start(conf)
         self.conf = conf or self.node.conf
         self._writers: Dict[int, Dict[int, MapOutputWriter]] = {}
+        # Learned receive capacities keyed by shuffle shape: a skewed
+        # workload pays the overflow-retry recompile once, then every later
+        # shuffle of the same shape starts at the capacity that worked.
+        self._cap_hints: Dict[tuple, int] = {}
         self._lock = threading.Lock()
         self._bind_mesh()
         # Elastic membership: a remesh (node.remesh) bumps the epoch; this
@@ -139,9 +143,35 @@ class TpuShuffleManager:
                 f"mapId {map_id} out of range [0,{handle.num_maps})")
         w = MapOutputWriter(handle.entry, map_id, self.node.pool,
                             partitioner=handle.partitioner,
-                            faults=self.node.faults)
+                            faults=self.node.faults,
+                            spill_dir=self.conf.spill_dir,
+                            spill_threshold=self.conf.spill_threshold)
         with self._lock:
+            # First-commit-wins: a committed map output is immutable. A
+            # speculative or retried map task may run again, but replacing
+            # the committed writer would discard its staged rows while the
+            # metadata table still claims them — read() would then silently
+            # return an incomplete result. (Spark resolves the same race by
+            # keeping the first committed index/data file pair.)
+            prev = self._writers[handle.shuffle_id].get(map_id)
+            if prev is not None and prev.committed:
+                raise RuntimeError(
+                    f"shuffle {handle.shuffle_id} map {map_id} is already "
+                    f"committed; its output is immutable (first commit "
+                    f"wins). unregister_shuffle() to restart the shuffle.")
+            if prev is not None:
+                # failed-task retry: the half-written writer is dead —
+                # return its staged arena blocks before dropping it
+                prev.release()
             self._writers[handle.shuffle_id][map_id] = w
+            live = sum(1 for ws in self._writers.values()
+                       for x in ws.values() if not x.committed)
+        cores = self.conf.cores_per_process
+        if live > cores:
+            log.warning(
+                "%d uncommitted writers live > coresPerProcess=%d; map "
+                "tasks are oversubscribing this process (ref: "
+                "UcxNode.java:85-95 warns the same way)", live, cores)
         return w
 
     # -- the read path ----------------------------------------------------
@@ -152,13 +182,39 @@ class TpuShuffleManager:
 
         Blocks until all map outputs are published, mirroring the metadata
         wait (ref: UcxWorkerWrapper.scala:134-143)."""
-        tracer = self.node.tracer
         self.node.epochs.validate(handle.epoch,
                                   f"shuffle {handle.shuffle_id}")
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
         if self.node.is_distributed:
             return self._read_distributed(handle, timeout)
+        with self.node.metrics.timeit("shuffle.read"):
+            return self._submit_local(handle, timeout).result()
+
+    def submit(self, handle: ShuffleHandle,
+               timeout: Optional[float] = None):
+        """Asynchronous read: plan + pack on the host, DISPATCH the
+        exchange, and return a :class:`shuffle.reader.PendingShuffle`
+        without blocking — so the caller overlaps this shuffle's collective
+        with the next shuffle's pack or any downstream host work (the
+        fetch/compute overlap of the reference's lazy-progress iterator,
+        ref: compat/spark_3_0/UcxShuffleReader.scala:54-98).
+
+        Single-process only: the multi-process read is a collective whose
+        overflow consensus requires every process in the loop — call
+        :meth:`read` there."""
+        self.node.epochs.validate(handle.epoch,
+                                  f"shuffle {handle.shuffle_id}")
+        if self.node.is_distributed:
+            raise NotImplementedError(
+                "submit() is single-process; the multi-process read is a "
+                "collective — every process must call read()")
+        timeout = timeout if timeout is not None \
+            else self.conf.connection_timeout_ms / 1e3
+        return self._submit_local(handle, timeout)
+
+    def _submit_local(self, handle: ShuffleHandle, timeout: float):
+        tracer = self.node.tracer
         if not handle.entry.wait_complete(timeout):
             raise TimeoutError(
                 f"shuffle {handle.shuffle_id}: only "
@@ -181,8 +237,17 @@ class TpuShuffleManager:
                     f"this manager (already unregistered?)")
             writers = dict(self._writers[handle.shuffle_id])
         # completeness is tracked by distinct map id in the metadata table;
-        # an extra uncommitted (half-written) writer must not inject rows
+        # an extra uncommitted (half-written) writer must not inject rows —
+        # and a map whose committed rows are gone must fail loudly, not
+        # shrink the result (the distributed path's bitmap does the same)
         writers = {m: w for m, w in writers.items() if w.committed}
+        missing = sorted(set(range(handle.num_maps)) - set(writers))
+        if missing:
+            raise RuntimeError(
+                f"shuffle {handle.shuffle_id}: metadata table is complete "
+                f"but maps {missing[:8]} have no committed staged rows in "
+                f"this manager — map output lost (writer replaced or "
+                f"released?)")
         shard_outputs, has_vals, val_tail, val_dtype = \
             self._materialize_outputs(
                 writers, Pn, lambda ordinal, map_id: map_id % Pn)
@@ -201,33 +266,84 @@ class TpuShuffleManager:
         with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
             plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
                              partitioner=handle.partitioner)
+            plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
 
         # fuse key+value bytes into one int32 row matrix (bit views, no
         # value casts — jnp would silently truncate int64 with x64 off)
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)
         with tracer.span("shuffle.pack", rows=int(nvalid.sum())):
-            shard_rows = self._pack_shards(shard_outputs, plan.cap_in,
-                                           width, has_vals)
+            shard_rows, stage_buf = self._pack_shards(
+                shard_outputs, plan.cap_in, width, has_vals)
 
-        self.node.faults.check("exchange")
-        with self.node.metrics.timeit("shuffle.read"), \
-                tracer.span("shuffle.exchange",
-                            shuffle_id=handle.shuffle_id,
-                            rows=int(nvalid.sum()), width=width,
-                            hierarchical=self.hierarchical):
-            vt = val_tail if has_vals else None
-            if self.hierarchical:
-                from sparkucx_tpu.shuffle.hierarchical import \
-                    read_shuffle_hierarchical
-                result = read_shuffle_hierarchical(
-                    self.node.mesh, self.conf.mesh_dcn_axis, self.axis,
-                    plan, shard_rows, nvalid, vt, val_dtype)
-            else:
-                result = read_shuffle(self.exchange_mesh, self.axis, plan,
-                                      shard_rows, nvalid, vt, val_dtype)
-        self.node.metrics.inc("shuffle.rows", float(nvalid.sum()))
-        return result
+        def on_done(result):
+            # fires from PendingShuffle.result() — with None on failure —
+            # exactly once; the pack buffer stays pinned until the last
+            # dispatch has staged it
+            self.node.pool.put(stage_buf)
+            if result is not None:
+                self._learn_cap(handle, result, int(nvalid.sum()))
+                self.node.metrics.inc("shuffle.rows", float(nvalid.sum()))
+
+        # anything that fails BEFORE the pending handle owns on_done (the
+        # fault site, compile errors inside the first dispatch) must not
+        # strand the pinned pack buffer
+        try:
+            self.node.faults.check("exchange")
+            with tracer.span("shuffle.exchange",
+                             shuffle_id=handle.shuffle_id,
+                             rows=int(nvalid.sum()), width=width,
+                             hierarchical=self.hierarchical):
+                vt = val_tail if has_vals else None
+                if self.hierarchical:
+                    from sparkucx_tpu.shuffle.hierarchical import \
+                        submit_shuffle_hierarchical
+                    return submit_shuffle_hierarchical(
+                        self.node.mesh, self.conf.mesh_dcn_axis, self.axis,
+                        plan, shard_rows, nvalid, vt, val_dtype,
+                        on_done=on_done)
+                return submit_shuffle(self.exchange_mesh, self.axis, plan,
+                                      shard_rows, nvalid, vt, val_dtype,
+                                      on_done=on_done)
+        except BaseException:
+            self.node.pool.put(stage_buf)
+            raise
+
+    # -- capacity learning -------------------------------------------------
+    @staticmethod
+    def _cap_key(handle: ShuffleHandle) -> tuple:
+        return (handle.num_maps, handle.num_partitions, handle.partitioner)
+
+    def _apply_cap_hint(self, plan: ShufflePlan, handle: ShuffleHandle,
+                        total_rows: int) -> ShufflePlan:
+        """Seed cap_out with the SKEW FACTOR a previous same-shape shuffle
+        settled at (round-1 weak #6: stop paying an overflow-retry
+        recompile per run). The hint is stored volume-normalized — learned
+        cap over the balanced share — so one huge skewed shuffle doesn't
+        permanently inflate every later small shuffle of the same shape."""
+        import dataclasses
+        with self._lock:
+            factor = self._cap_hints.get(self._cap_key(handle))
+        if not factor:
+            return plan
+        balanced = max(1.0, total_rows / max(plan.num_shards, 1))
+        hint = int(np.ceil(balanced * factor / 8.0)) * 8
+        if hint > plan.cap_out:
+            log.debug("seeding cap_out=%d from learned skew factor %.2f "
+                      "(plan computed %d)", hint, factor, plan.cap_out)
+            return dataclasses.replace(plan, cap_out=hint)
+        return plan
+
+    def _learn_cap(self, handle: ShuffleHandle, result,
+                   total_rows: int) -> None:
+        used = getattr(result, "cap_out_used", None)
+        if used and total_rows:
+            balanced = max(1.0, total_rows / max(self.node.num_devices, 1))
+            factor = used / balanced
+            key = self._cap_key(handle)
+            with self._lock:
+                if factor > self._cap_hints.get(key, 0.0):
+                    self._cap_hints[key] = factor
 
     # -- shared staging helpers -------------------------------------------
     @staticmethod
@@ -264,12 +380,22 @@ class TpuShuffleManager:
                             "others have keys only")
         return slot_outputs, has_vals, val_tail, val_dtype
 
-    @staticmethod
-    def _pack_shards(slot_outputs, cap_in, width, has_vals):
+    def _pack_shards(self, slot_outputs, cap_in, width, has_vals):
         """Fuse key+value bytes into one [slots, cap_in, width] int32 row
         matrix (bit views, no value casts — jnp would silently truncate
-        int64 with x64 off)."""
-        rows = np.zeros((len(slot_outputs), cap_in, width), dtype=np.int32)
+        int64 with x64 off).
+
+        The matrix is packed DIRECTLY into a pinned arena block — the one
+        host copy on the read path — and the reader device_puts from that
+        view, so host bytes DMA into HBM without a pageable bounce (the
+        register-once-serve-zero-copy property,
+        ref: CommonUcxShuffleBlockResolver.scala:45-57). Returns
+        (rows_view, arena_buf); the caller releases arena_buf when the
+        exchange is done."""
+        shape = (len(slot_outputs), cap_in, width)
+        buf = self.node.pool.get(max(int(np.prod(shape)) * 4, 1))
+        rows = buf.view().view(np.int32).reshape(shape)
+        rows[:] = 0  # pool blocks are recycled; padding must not leak rows
         for p, outs in enumerate(slot_outputs):
             off = 0
             for keys, values in outs:
@@ -278,7 +404,7 @@ class TpuShuffleManager:
                     rows[p, off:off + n] = pack_rows(
                         keys, values if has_vals else None, width)
                 off += n
-        return rows
+        return rows, buf
 
     # -- the multi-process read path --------------------------------------
     def _read_distributed(self, handle: ShuffleHandle, timeout: float):
@@ -309,6 +435,13 @@ class TpuShuffleManager:
         # exit ride the allgathered values — one process's expired clock
         # makes every process raise together, never leaving a peer blocked
         # in the next collective.
+        limit = self.conf.meta_buffer_size
+        if (handle.num_maps + 1) * 8 > limit:
+            raise ValueError(
+                f"shuffle {handle.shuffle_id}: presence bitmap "
+                f"({(handle.num_maps + 1) * 8} B for {handle.num_maps} "
+                f"maps) exceeds meta.bufferSize={limit}; raise "
+                f"spark.shuffle.tpu.meta.bufferSize")
         deadline = _time.monotonic() + timeout
         while True:
             bitmap = np.zeros(handle.num_maps + 1, dtype=np.int64)
@@ -386,28 +519,35 @@ class TpuShuffleManager:
         with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
             plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
                              partitioner=handle.partitioner)
+            # safe cross-process: every process runs the same collective
+            # read sequence, so learned hints advance in lockstep
+            plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
 
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)
         with tracer.span("shuffle.pack", rows=int(nvalid_local.sum())):
-            local_rows = self._pack_shards(shard_outputs, plan.cap_in,
-                                           width, has_vals)
+            local_rows, stage_buf = self._pack_shards(
+                shard_outputs, plan.cap_in, width, has_vals)
 
-        self.node.faults.check("exchange")
-        with self.node.metrics.timeit("shuffle.read"), \
-                tracer.span("shuffle.exchange",
-                            shuffle_id=handle.shuffle_id,
-                            rows=int(nvalid.sum()), width=width,
-                            hierarchical=self.hierarchical,
-                            distributed=True):
-            vt = val_tail if has_vals else None
-            result = read_shuffle_distributed(
-                self.exchange_mesh, self.axis, plan, local_rows,
-                nvalid_local, shard_ids, vt, val_dtype,
-                hier_mesh=self.node.mesh if self.hierarchical else None,
-                dcn_axis=self.conf.mesh_dcn_axis
-                if self.hierarchical else None)
+        try:
+            self.node.faults.check("exchange")
+            with self.node.metrics.timeit("shuffle.read"), \
+                    tracer.span("shuffle.exchange",
+                                shuffle_id=handle.shuffle_id,
+                                rows=int(nvalid.sum()), width=width,
+                                hierarchical=self.hierarchical,
+                                distributed=True):
+                vt = val_tail if has_vals else None
+                result = read_shuffle_distributed(
+                    self.exchange_mesh, self.axis, plan, local_rows,
+                    nvalid_local, shard_ids, vt, val_dtype,
+                    hier_mesh=self.node.mesh if self.hierarchical else None,
+                    dcn_axis=self.conf.mesh_dcn_axis
+                    if self.hierarchical else None)
+        finally:
+            self.node.pool.put(stage_buf)
         self.node.metrics.inc("shuffle.rows", float(nvalid_local.sum()))
+        self._learn_cap(handle, result, int(nvalid.sum()))
         return result
 
     # -- checkpoint support ----------------------------------------------

@@ -24,10 +24,39 @@ os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# TPU gate (the RDMA-iface gate analog, ref:
+# buildlib/azure-pipelines.yml:39-49 + test.sh get_rdma_device_iface):
+# default = force the CPU backend and run the portable suite on the
+# virtual 8-device mesh; SPARKUCX_TPU_TEST_TPU=1 = keep the real backend
+# and run ONLY the @pytest.mark.tpu tests (native ragged-all-to-all,
+# Pallas compiled kernels) — everything else is skipped, since the
+# portable tests assume 8 devices.
+TPU_MODE = os.environ.get("SPARKUCX_TPU_TEST_TPU", "") == "1"
+if not TPU_MODE:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: needs a real TPU backend (SPARKUCX_TPU_TEST_TPU=1)")
+
+
+def pytest_collection_modifyitems(config, items):
+    if TPU_MODE:
+        skip = pytest.mark.skip(
+            reason="portable-suite test; TPU mode runs @tpu tests only")
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip)
+    else:
+        skip = pytest.mark.skip(
+            reason="needs real TPU (set SPARKUCX_TPU_TEST_TPU=1)")
+        for item in items:
+            if "tpu" in item.keywords:
+                item.add_marker(skip)
 
 
 @pytest.fixture(scope="session")

@@ -19,8 +19,8 @@ def test_parse_bytes():
 def test_defaults():
     conf = TpuShuffleConf(use_env=False)
     assert conf.coordinator_address == "localhost:55443"
-    assert conf.meta_record_size == 304
-    assert conf.meta_buffer_size == 4096
+    assert conf.meta_buffer_size == 64 * 1024
+    assert conf.cores_per_process >= 1
     assert conf.min_buffer_size == 1024
     assert conf.min_allocation_size == 4 * 1024 * 1024
     assert conf.pre_allocate_buffers == {}

@@ -227,3 +227,122 @@ def test_direct_partitioner_rejects_out_of_range(manager):
     with pytest.raises(ValueError, match=r"\[0, 4\)"):
         w.commit(4)
     manager.unregister_shuffle(10)
+
+
+def test_committed_writer_is_immutable(manager, rng):
+    """First-commit-wins: a retried/speculative map task must not replace
+    a committed writer — that would drop the committed rows while the
+    metadata table still counts them (silent data loss)."""
+    h = manager.register_shuffle(11, 2, 4)
+    w0 = manager.get_writer(h, 0)
+    w0.write(np.arange(10, dtype=np.int64))
+    w0.commit(4)
+    # uncommitted writer may be replaced (failed-task retry)
+    manager.get_writer(h, 1)
+    w1b = manager.get_writer(h, 1)
+    with pytest.raises(RuntimeError, match="already committed"):
+        manager.get_writer(h, 0)
+    w1b.write(np.arange(5, dtype=np.int64))
+    w1b.commit(4)
+    total = sum(k.size for _, (k, _) in manager.read(h).partitions())
+    assert total == 15
+    manager.unregister_shuffle(11)
+
+
+def test_capacity_learning_skips_retry(manager):
+    """Second same-shape shuffle starts at the capacity the first one
+    settled at after overflow retries (no overflow on run 2)."""
+    from sparkucx_tpu.shuffle import reader as reader_mod
+
+    R, M, N = 8, 8, 400
+    skewed = np.zeros(N, dtype=np.int64)  # all keys identical -> one shard
+
+    def run(sid):
+        h = manager.register_shuffle(sid, M, R)
+        for m in range(M):
+            w = manager.get_writer(h, m)
+            w.write(skewed)
+            w.commit(R)
+        res = manager.read(h)
+        total = sum(k.size for _, (k, _) in res.partitions())
+        assert total == M * N
+        manager.unregister_shuffle(sid)
+        return res.cap_out_used
+
+    cap1 = run(20)
+    # balanced share would be M*N/8 * factor 2 = 800 < 3200 needed rows;
+    # the first run must have grown
+    assert cap1 is not None and cap1 >= M * N
+    grown = []
+    orig = reader_mod.ShufflePlan.grown
+
+    def spy(self):
+        grown.append(self.cap_out)
+        return orig(self)
+
+    reader_mod.ShufflePlan.grown = spy
+    try:
+        cap2 = run(21)
+    finally:
+        reader_mod.ShufflePlan.grown = orig
+    assert grown == [], "second run should start at the learned capacity"
+    assert cap2 == cap1
+
+
+def test_read_fails_loudly_on_lost_map_output(manager):
+    """Metadata says complete but staged rows are gone -> loud error, not
+    a silently smaller result."""
+    h = manager.register_shuffle(12, 1, 4)
+    w = manager.get_writer(h, 0)
+    w.write(np.arange(8, dtype=np.int64))
+    w.commit(4)
+    # simulate the lost-output state: writer dropped but table published
+    with manager._lock:
+        manager._writers[12].clear()
+    with pytest.raises(RuntimeError, match="no committed staged rows"):
+        manager.read(h)
+    manager.unregister_shuffle(12)
+
+
+def test_submit_poll_and_stream(manager, rng):
+    """Async read: submit() returns before forcing results; partitions are
+    readable per shard; two pipelined shuffles overlap pack with exchange."""
+    R, M, N = 16, 8, 300
+
+    def stage(sid):
+        h = manager.register_shuffle(sid, M, R)
+        for m in range(M):
+            w = manager.get_writer(h, m)
+            w.write(rng.integers(0, 1 << 31, size=N).astype(np.int64))
+            w.commit(R)
+        return h
+
+    hA, hB = stage(30), stage(31)
+    pA = manager.submit(hA)
+    pB = manager.submit(hB)     # packed+dispatched while A is in flight
+    assert isinstance(pA.done(), bool)
+    resA, resB = pA.result(), pB.result()
+    # partition-0 readable without touching other shards
+    k0, _ = resA.partition(0)
+    assert (expected_partition(k0, R) == 0).all()
+    totals = [sum(k.size for _, (k, _) in r.partitions())
+              for r in (resA, resB)]
+    assert totals == [M * N, M * N]
+    # done() is true after result()
+    assert pA.done() and pB.done()
+    manager.unregister_shuffle(30)
+    manager.unregister_shuffle(31)
+
+
+def test_submit_overflow_retries_to_result(manager):
+    """Overflow discovered at result() time still resolves via regrowth."""
+    R, M, N = 8, 4, 200
+    h = manager.register_shuffle(32, M, R)
+    for m in range(M):
+        w = manager.get_writer(h, m)
+        w.write(np.zeros(N, dtype=np.int64))   # max skew: one destination
+        w.commit(R)
+    res = manager.submit(h).result()
+    assert sum(k.size for _, (k, _) in res.partitions()) == M * N
+    assert res.cap_out_used >= M * N
+    manager.unregister_shuffle(32)

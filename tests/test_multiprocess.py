@@ -30,3 +30,17 @@ def test_two_process_hierarchical_cluster():
     # boundary, so the DCN stage of the hierarchical exchange crosses
     # processes — the multi-slice deployment shape
     _run("--slices", "2")
+
+
+def test_worker_loss_recovery():
+    # the elastic drill: victim dies after staging; survivors fence the
+    # stale epoch (StaleEpochError, no hung collective) and the job
+    # re-runs the FULL map set on a fresh 2-process world and verifies
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "buildlib", "run_cluster.py"),
+         "--recovery", "--nprocs", "3", "--devices", "2",
+         "--timeout", "400"],
+        capture_output=True, text=True, timeout=460)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert "CLUSTER RECOVERY: PASS" in proc.stdout
+    assert proc.stdout.count("STALE-FENCED OK") >= 1

@@ -90,3 +90,56 @@ def test_quantize_unbiased_mean():
         outs.append(np.asarray(dequantize_rows(q, s)))
     err = np.abs(np.mean(outs, axis=0) - np.asarray(x))
     assert err.max() < 0.02, err.max()
+
+
+@pytest.mark.parametrize("t", [97, 130, 33])   # prime / non-multiples
+def test_flash_padded_tail_matches_reference(t):
+    """Non-divisible T pads + masks instead of degenerating block sizes
+    (round-1 weak #5: gcd snapped to 1 for prime T)."""
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, t, 16), jnp.float32)
+               for kk in ks)
+    for causal in (False, True):
+        ref = reference_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, block_q=32, block_k=64,
+                              causal=causal, impl="interpret")
+        np.testing.assert_allclose(np.asarray(out), ref,
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_flash_padded_grad_matches_reference():
+    t = 70
+    ks = jax.random.split(jax.random.PRNGKey(6), 3)
+    q, k, v = (jax.random.normal(kk, (1, 2, t, 16), jnp.float32)
+               for kk in ks)
+
+    def lf(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=32, block_k=32,
+                                       causal=True, impl="interpret") ** 2)
+
+    def lr(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_flash_mismatched_blocks_grad():
+    """block_q != block_k exercises the swapped-nest dk/dv kernel tiling."""
+    q, k, v = _qkv(7)
+
+    def lf(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=64, block_k=32,
+                                       causal=True, impl="interpret"))
+
+    def ls(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True))
+
+    gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(ls, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
