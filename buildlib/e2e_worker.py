@@ -11,9 +11,9 @@ from the map id, so every process can reconstruct the full global truth
 locally and verify its partitions without any extra wire.
 
 Recovery mode (SPARKUCX_TPU_RECOVERY_PHASE=1): the worker-loss drill.
-All members stage + commit, then the victim process dies abruptly
-(os._exit — no goodbye, like a lost executor). Survivors learn of the
-loss from the controller's signal file — the role the driver's RPC
+All members stage + commit and report STAGED; the controller then
+SIGKILLs the victim (abrupt loss, no goodbye, like a lost executor).
+Survivors learn of the loss from the controller's signal file — the role the driver's RPC
 error callback plays in the reference (a disconnect surfaces there,
 ref: rpc/RpcConnectionCallback.java:91-98) — bump the epoch, and prove
 the stale handle fails fast with StaleEpochError instead of hanging a
@@ -95,19 +95,27 @@ def main() -> int:
 
     if recovery_phase == "1":
         from sparkucx_tpu.runtime.failures import StaleEpochError
-        from sparkucx_tpu.shuffle.distributed import allgather_blob
 
-        # barrier: everyone has staged before the loss happens
-        allgather_blob(np.zeros(1, dtype=np.int64))
+        # Tell the controller this member finished staging. The controller
+        # SIGKILLs the victim only after every member has staged (no
+        # worker-side barrier collective: a survivor still inside a
+        # collective when the victim vanishes would die IN the collective
+        # instead of reaching the fence check — a race this drill is not
+        # about).
+        print(f"worker {proc_id}: STAGED", flush=True)
+        deadline = time.monotonic() + 300
         if proc_id == victim:
-            print(f"worker {proc_id}: dying abruptly (victim)", flush=True)
-            os._exit(1)
+            # wait to be killed abruptly by the controller (a lost
+            # executor gets no goodbye)
+            while time.monotonic() < deadline:
+                time.sleep(0.1)
+            print("ERROR: victim was never killed", flush=True)
+            os._exit(3)
         # survivor: wait for the controller's loss notification (the
         # driver's disconnect-detection analog)
-        deadline = time.monotonic() + 60
         while not (loss_file and os.path.exists(loss_file)):
             if time.monotonic() > deadline:
-                print("ERROR: no loss signal within 60s", flush=True)
+                print("ERROR: no loss signal within 300s", flush=True)
                 os._exit(3)
             time.sleep(0.1)
         # membership changed -> bump the epoch; the manager drops its

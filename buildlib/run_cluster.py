@@ -60,8 +60,8 @@ def spawn(pid: int, nprocs: int, coordinator: str, devices: int,
 
 def reap(procs, logs, deadline, expect_rc=None) -> bool:
     """Wait for every worker; print tails (full log on failure). When
-    ``expect_rc`` maps pid -> required exit code (e.g. the victim MUST die
-    with 1), mismatches fail the run."""
+    ``expect_rc`` maps pid -> required exit code (e.g. the SIGKILLed victim
+    MUST show -SIGKILL), mismatches fail the run."""
     ok = True
     for pid, p in enumerate(procs):
         remaining = max(1.0, deadline - time.monotonic())
@@ -109,16 +109,37 @@ def run_recovery(args) -> int:
             procs.append(p)
             logs.append(f)
             all_logs.append(f)
-        # the controller notices the death (the driver's RPC-disconnect
+        # wait for every member to finish staging (reported via its log),
+        # then SIGKILL the victim — an abrupt loss, no goodbye. The
+        # controller then notices the death (the driver's RPC-disconnect
         # callback analog, ref: rpc/RpcConnectionCallback.java:91-98) and
-        # signals the survivors
-        while procs[victim].poll() is None:
-            if time.monotonic() > deadline:
-                print("victim never died"); return 1
+        # signals the survivors.
+        # Scan through SEPARATE read handles: Popen(stdout=logf) shares the
+        # file description (and offset) with the child, so seeking the
+        # writer's handle mid-run would corrupt the log.
+        staged = set()
+        while len(staged) < args.nprocs:
+            for pid, lf in enumerate(logs):
+                if pid in staged:
+                    continue
+                with open(lf.name) as rf:
+                    if "STAGED" in rf.read():
+                        staged.add(pid)
+            dead = [pid for pid, p in enumerate(procs)
+                    if pid not in staged and p.poll() is not None]
+            if dead or time.monotonic() > deadline:
+                print(f"staging failed: staged={sorted(staged)} "
+                      f"dead-before-staging={dead}")
+                reap(procs, logs, time.monotonic() + 5)   # dump logs
+                return 1
             time.sleep(0.1)
+        procs[victim].kill()
+        procs[victim].wait()
         with open(loss_file, "w") as f:
             f.write(f"worker {victim} lost\n")
-        ok = reap(procs, logs, deadline, expect_rc={victim: 1})
+        import signal
+        ok = reap(procs, logs, deadline,
+                  expect_rc={victim: -signal.SIGKILL})
         fenced = 0
         for pid, lf in enumerate(logs):
             if pid == victim:

@@ -206,13 +206,25 @@ class TpuShuffleConf:
 
         native = jax.lax.ragged_all_to_all (TPU ICI); dense = padded
         all_to_all (portable); gather = all_gather oracle (tests)."""
-        return self._get("a2a.impl", "auto")
+        v = self._get("a2a.impl", "auto")
+        from sparkucx_tpu.shuffle.alltoall import IMPLS
+        allowed = ("auto",) + IMPLS
+        if v not in allowed:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.impl={v!r}: want one of {allowed}")
+        return v
 
     @property
     def sort_impl(self) -> str:
         """Destination-sort formulation for the exchange hot path:
         auto | argsort | multisort | counting (ops/partition.py)."""
-        return self._get("a2a.sortImpl", "auto")
+        v = self._get("a2a.sortImpl", "auto")
+        from sparkucx_tpu.ops.partition import SORT_METHODS
+        if v not in SORT_METHODS:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.sortImpl={v!r}: want one of "
+                f"{SORT_METHODS}")
+        return v
 
     @property
     def capacity_factor(self) -> float:

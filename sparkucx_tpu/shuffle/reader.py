@@ -253,10 +253,16 @@ class PendingShuffle:
         self._nvalid_host = shard_nvalid
         self._val_shape = val_shape
         self._val_dtype = val_dtype
-        self._on_done = on_done
+        # ownership of on_done transfers only once the first dispatch
+        # succeeds: if _dispatch raises out of __init__ the CALLER still
+        # owns the failure cleanup (it sees the exception), and this
+        # half-built object's __del__ must not fire the callback a second
+        # time (double pool.put of the pinned pack buffer)
+        self._on_done = None
         self._result: Optional[ShuffleReaderResult] = None
         self._attempt = 0
         self._dispatch()
+        self._on_done = on_done
 
     def _dispatch(self) -> None:
         from sparkucx_tpu.io.dlpack import stage_to_device

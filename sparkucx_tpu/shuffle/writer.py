@@ -161,6 +161,7 @@ class MapOutputWriter:
         self._staged_bytes = 0
         self._val_tail: Optional[Tuple[int, ...]] = None
         self._val_dtype = None
+        self._spill_views = None  # cached (keys, values) mmap views
 
     def write(self, keys: np.ndarray,
               values: Optional[np.ndarray] = None) -> None:
@@ -287,10 +288,15 @@ class MapOutputWriter:
         the read path streams them into the pack buffer without a second
         host-RAM copy of the whole output."""
         if self._spill is not None:
-            if self._keys:
-                self._flush_to_disk()
-            self._spill.finish(self._val_tail, self._val_dtype)
-            return self._spill.load()
+            # cache the mapped views: materialize() is called once per
+            # read/submit/export, and re-running finish()+load() each time
+            # would accumulate mmaps/fds until release()
+            if self._keys or self._spill_views is None:
+                if self._keys:
+                    self._flush_to_disk()
+                self._spill.finish(self._val_tail, self._val_dtype)
+                self._spill_views = self._spill.load()
+            return self._spill_views
         if not self._keys:
             return np.zeros(0, dtype=np.int64), None
         keys = np.concatenate(self._keys)
@@ -307,5 +313,6 @@ class MapOutputWriter:
         self._keys.clear()
         self._values.clear()
         if self._spill is not None:
+            self._spill_views = None   # views die with the mappings
             self._spill.close(delete=True)
             self._spill = None
