@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sparkucx_tpu.ops.partition import counts_from_sorted
 from sparkucx_tpu.shuffle.alltoall import (
     exchange, exchange_quantized, ragged_shuffle)
 
@@ -99,7 +100,10 @@ def _moe_shard(params, x, seed, *, cfg: MoEConfig, ep_axis: str,
     order = jnp.argsort(dest, stable=True)
     inv_order = jnp.argsort(order)                      # unsort permutation
     x_sorted = jnp.take(x, order, axis=0)
-    counts = jnp.bincount(dest, length=ep_size).astype(jnp.int32)
+    # counts off the sorted keys, not bincount: XLA:TPU serializes the
+    # colliding scatter-add (ops/partition.counts_from_sorted rationale)
+    counts = counts_from_sorted(jnp.take(dest, order),
+                                ep_size).astype(jnp.int32)
     seed = jnp.asarray(seed, jnp.int32).reshape(())
     if cfg.wire == "int8":
         recv = exchange_quantized(x_sorted, counts, seed * 2, ep_axis,
@@ -137,16 +141,24 @@ def _moe_shard(params, x, seed, *, cfg: MoEConfig, ep_axis: str,
     eorder = jnp.argsort(le_key, stable=True)
     le_sorted = jnp.take(le_key, eorder)
     rows_sorted = jnp.take(recv, eorder, axis=0)
-    ecounts = jnp.bincount(le_sorted, length=e_local + 1)[:e_local]
+    ecounts = counts_from_sorted(le_sorted, e_local)
     excl = jnp.concatenate(
         [jnp.zeros((1,), ecounts.dtype), jnp.cumsum(ecounts)[:-1]])
     le_c = jnp.minimum(le_sorted, e_local - 1)
     within = jnp.arange(cap_out, dtype=jnp.int32) - excl[le_c].astype(jnp.int32)
     fits = (within < cap_e) & (le_sorted < e_local)
     within_c = jnp.clip(within, 0, cap_e - 1)
-    ebuf = jnp.zeros((e_local, cap_e, cfg.d_model), x.dtype)
-    ebuf = ebuf.at[le_c, within_c].add(
-        jnp.where(fits[:, None], rows_sorted, 0.0))
+    # Pack expert buffers by GATHER off the expert-sorted rows (slot
+    # [e, c] pulls row excl[e] + c), not scatter: the clipped overflow
+    # rows would collide, and colliding scatters serialize on TPU.
+    slot = excl[:, None].astype(jnp.int32) \
+        + jnp.arange(cap_e, dtype=jnp.int32)[None, :]     # [e_local, cap_e]
+    slot_valid = jnp.arange(cap_e, dtype=jnp.int32)[None, :] \
+        < jnp.minimum(ecounts, cap_e)[:, None]
+    ebuf = jnp.where(
+        slot_valid[:, :, None],
+        jnp.take(rows_sorted, jnp.clip(slot, 0, cap_out - 1), axis=0),
+        jnp.zeros((), x.dtype))
 
     # -- expert FFN on the MXU: batched per-expert matmuls ----------------
     h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", ebuf, params["w1"]))
@@ -154,7 +166,9 @@ def _moe_shard(params, x, seed, *, cfg: MoEConfig, ep_axis: str,
 
     # -- un-scatter to received order, combine back -----------------------
     out_sorted = jnp.where(fits[:, None], y[le_c, within_c], 0.0)
-    out_recv = jnp.zeros_like(recv).at[eorder].set(out_sorted)
+    # unsort by inverse-permutation GATHER (eorder is a permutation; a
+    # row scatter would serialize on TPU)
+    out_recv = jnp.take(out_sorted, jnp.argsort(eorder), axis=0)
     # reverse exchange: send back what we received (sizes = what each peer
     # sent us); result arrives in our original destination-sorted layout
     if cfg.wire == "int8":

@@ -166,7 +166,11 @@ def _moe_ffn(lp, x, cfg: TransformerConfig, ep_axis: str, tp_axis: str):
     order = jnp.argsort(dest, stable=True)
     inv_order = jnp.argsort(order)
     x_sorted = jnp.take(x, order, axis=0)
-    counts = jnp.bincount(dest, length=ep).astype(jnp.int32)
+    # counts off the sorted keys, not bincount (TPU-serialized scatter;
+    # see ops/partition.counts_from_sorted)
+    from sparkucx_tpu.ops.partition import counts_from_sorted
+    counts = counts_from_sorted(jnp.take(dest, order),
+                                ep).astype(jnp.int32)
     # Ship the sender's expert choice losslessly WITH the row (as moe.py's
     # int8 wire already does): recomputing it receive-side via argmax
     # diverges whenever a token's top-2 logit gap is below the tie-break

@@ -43,6 +43,22 @@ def hash_partition(keys: jnp.ndarray, num_partitions: int) -> jnp.ndarray:
 SORT_METHODS = ("auto", "argsort", "multisort", "counting")
 
 
+def counts_from_sorted(sorted_key: jnp.ndarray, num_bins: int) -> jnp.ndarray:
+    """Bucket counts [num_bins] from an ASCENDING-sorted key vector, as
+    searchsorted differences — (num_bins+1) binary searches, no scatter.
+
+    This exists because ``jnp.bincount`` is a scatter-add, and XLA:TPU
+    serializes scatters with potentially-colliding indices — measured at
+    ~0.5 us per element on v5e, it turned a ~100 ms shuffle step into
+    2.5 s. The hot paths all sort by destination anyway, so the histogram
+    is free off the sorted form. Keys >= num_bins (padding sentinels) fall
+    past the last edge and are not counted."""
+    edges = jnp.searchsorted(
+        sorted_key, jnp.arange(num_bins + 1, dtype=sorted_key.dtype),
+        side="left").astype(jnp.int32)
+    return edges[1:] - edges[:-1]
+
+
 def destination_sort(
     rows: jnp.ndarray,
     dest: jnp.ndarray,
@@ -86,7 +102,6 @@ def destination_sort(
     idx = jnp.arange(cap, dtype=jnp.int32)
     valid = idx < num_valid
     key = jnp.where(valid, dest.astype(jnp.int32), jnp.int32(num_dests))
-    counts = jnp.bincount(key, length=num_dests + 1)[:num_dests]
     if method == "auto":
         if (jax.default_backend() in ("tpu", "gpu") and rows.ndim == 2
                 and rows.shape[1] <= 32):
@@ -102,24 +117,32 @@ def destination_sort(
     if method == "multisort" and rows.ndim != 2:
         method = "argsort"
 
+    # counts come from the sorted key (or the counting ranks), NEVER from
+    # jnp.bincount — see counts_from_sorted for the TPU scatter rationale
     if method == "argsort":
         order = jnp.argsort(key, stable=True)
         sorted_rows = jnp.take(rows, order, axis=0)
+        counts = counts_from_sorted(jnp.take(key, order), num_dests)
     elif method == "multisort":
         ops = (key,) + tuple(rows[:, i] for i in range(rows.shape[1]))
         out = jax.lax.sort(ops, num_keys=1, is_stable=True)
         sorted_rows = jnp.stack(out[1:], axis=1)
+        counts = counts_from_sorted(out[0], num_dests)
     elif method == "counting":
         oh = (key[:, None] == jnp.arange(num_dests + 1,
                                          dtype=jnp.int32)[None, :])
         ranks = jnp.cumsum(oh.astype(jnp.int32), axis=0)
         rank = jnp.take_along_axis(ranks, key[:, None], axis=1)[:, 0] - 1
         counts_full = ranks[-1]                       # [num_dests + 1]
+        counts = counts_full[:num_dests]
         start = jnp.concatenate(
             [jnp.zeros((1,), jnp.int32),
              jnp.cumsum(counts_full)[:-1].astype(jnp.int32)])
         pos = jnp.take(start, key) + rank
-        inv = jnp.zeros((cap,), jnp.int32).at[pos].set(idx)
+        # pos is a permutation: tell the scatter so (unique + in-bounds
+        # lets XLA skip the serializing collision path)
+        inv = jnp.zeros((cap,), jnp.int32).at[pos].set(
+            idx, unique_indices=True, mode="promise_in_bounds")
         sorted_rows = jnp.take(rows, inv, axis=0)
     else:
         raise ValueError(
@@ -154,7 +177,7 @@ def partition_and_pack(
     order = jnp.argsort(sort_key, stable=True)
     send_rows = jnp.take(rows, order, axis=0)
     parts_sorted = jnp.take(jnp.where(valid, part, -1), order)
-    counts = jnp.bincount(sort_key, length=num_devices + 1)[:num_devices]
+    counts = counts_from_sorted(jnp.take(sort_key, order), num_devices)
     return send_rows, counts.astype(jnp.int32), parts_sorted
 
 

@@ -76,36 +76,42 @@ def allgather_blob(blob: np.ndarray) -> np.ndarray:
 
 class DistributedReaderResult(ShuffleReaderResult):
     """Partial, process-local view: only partitions on local shards are
-    readable (the Spark-reducer contract)."""
+    readable (the Spark-reducer contract). Layout is partition-major
+    (reader.py ``_RunIndex``): ``seg_counts`` is [NS, R] shared (flat
+    exchange) or [L, NS, R] with this process's shards only
+    (hierarchical)."""
 
     def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
                  shard_ids: Sequence[int], local_rows: np.ndarray,
-                 local_pcounts: np.ndarray, val_shape, val_dtype):
-        self.num_partitions = num_partitions
-        self._part_to_shard = part_to_shard
+                 seg_counts: np.ndarray, val_shape, val_dtype):
+        super().__init__(num_partitions, part_to_shard, local_rows,
+                         seg_counts, val_shape, val_dtype)
         self._shard_ord = {int(s): i for i, s in enumerate(shard_ids)}
-        self._rows = local_rows          # [L, cap_out, width]
-        self._pcounts = local_pcounts    # [L, R]
-        self._val_shape = val_shape
-        self._val_dtype = val_dtype
-        self._offsets = np.zeros_like(local_pcounts)
-        np.cumsum(local_pcounts[:, :-1], axis=1, out=self._offsets[:, 1:])
 
     def is_local(self, r: int) -> bool:
         return int(self._part_to_shard[r]) in self._shard_ord
 
-    def partition(self, r: int):
-        shard = int(self._part_to_shard[r])
+    def _ordinal(self, shard: int) -> int:
         if shard not in self._shard_ord:
             raise KeyError(
-                f"partition {r} lives on shard {shard}, not on this "
-                f"process (local shards: {sorted(self._shard_ord)})")
-        ordinal = self._shard_ord[shard]
-        start = int(self._offsets[ordinal, r])
-        n = int(self._pcounts[ordinal, r])
-        from sparkucx_tpu.shuffle.reader import unpack_rows
-        return unpack_rows(self._rows[ordinal, start:start + n],
-                           self._val_shape, self._val_dtype)
+                f"shard {shard} is not on this process (local shards: "
+                f"{sorted(self._shard_ord)})")
+        return self._shard_ord[shard]
+
+    def _seg_matrix(self, shard: int) -> np.ndarray:
+        return self._seg if self._seg.ndim == 2 \
+            else self._seg[self._ordinal(shard)]
+
+    def _shard_rows(self, shard: int) -> np.ndarray:
+        return self._rows[self._ordinal(shard)]
+
+    def partition(self, r: int):
+        if not self.is_local(r):
+            raise KeyError(
+                f"partition {r} lives on shard "
+                f"{int(self._part_to_shard[r])}, not on this process "
+                f"(local shards: {sorted(self._shard_ord)})")
+        return super().partition(r)
 
     def partitions(self):
         for r in range(self.num_partitions):
@@ -170,7 +176,7 @@ def read_shuffle_distributed(
             sharding, local_rows.reshape(L * cap_in, width))
         nvalid = jax.make_array_from_process_local_data(
             sharding, local_nvalid.astype(np.int32).reshape(L))
-        rows_out, pcounts, total, ovf = step(payload, nvalid)
+        rows_out, seg, total, ovf = step(payload, nvalid)
         # The retry decision must be identical on every process or the
         # SPMD group diverges. The flat exchange's flag is a mesh-wide
         # psum, but the hierarchical flag (r1|r2) is only uniform within a
@@ -180,11 +186,18 @@ def read_shuffle_distributed(
         ovf_global = bool(allgather_blob(
             np.array([1 if mine else 0], dtype=np.int64)).any())
         if not ovf_global:
+            if hier_mesh is not None:
+                # per-shard [S, R] relay-count matrices, locals only
+                S = hier_mesh.devices.shape[0]
+                seg_host = _local_shards_of(seg, shard_ids, S)
+            else:
+                # replicated [P, R]: any addressable copy is the whole
+                # matrix (np.asarray rejects multi-process arrays)
+                seg_host = np.asarray(seg.addressable_shards[0].data)
             res = DistributedReaderResult(
                 R, part_to_shard, shard_ids,
                 _local_shards_of(rows_out, shard_ids, cur.cap_out),
-                _local_shards_of(pcounts, shard_ids, R),
-                val_shape, val_dtype)
+                seg_host, val_shape, val_dtype)
             res.cap_out_used = cur.cap_out
             return res
         log.info("distributed shuffle overflow at cap_out=%d (attempt %d)",

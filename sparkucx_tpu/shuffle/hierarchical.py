@@ -39,7 +39,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from sparkucx_tpu.ops.partition import destination_sort, hash_partition
 from sparkucx_tpu.shuffle.alltoall import ragged_shuffle
 from sparkucx_tpu.shuffle.plan import ShufflePlan
-from sparkucx_tpu.shuffle.reader import ShuffleReaderResult, _blocked_map
+from sparkucx_tpu.shuffle.reader import (
+    ShuffleReaderResult, _blocked_map, _device_bounds)
 from sparkucx_tpu.utils.logging import get_logger
 
 log = get_logger("shuffle.hierarchical")
@@ -62,6 +63,7 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
     Pn = plan.num_shards
     assert Pn == S * D, (Pn, S, D)
     part_to_dest = _blocked_map(R, Pn)
+    bounds = jnp.asarray(_device_bounds(R, Pn))   # [P+1] partition ranges
 
     def part_fn(key_lo):
         if plan.partitioner == "direct":
@@ -78,19 +80,30 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
         r1 = ragged_shuffle(send1, counts1, ici_axis,
                             out_capacity=plan.cap_out, impl=plan.impl)
 
-        # stage 2 — DCN: recompute destinations, group by slice s' = g // D
-        g2 = jnp.take(part_to_dest, part_fn(r1.data[:, 0]))
-        send2, counts2 = destination_sort(
-            r1.data, g2 // D, r1.total[0], S, method=plan.sort_impl)
+        # stage 2 — DCN: sort by GLOBAL PARTITION id. Every row here is
+        # destined to some (s', d_mine); its global shard g2 = s'*D +
+        # d_mine is monotone in the partition id, so the partition sort
+        # groups by destination slice AND leaves each delivered segment
+        # partition-sorted — no receive-side regrouping (the flat
+        # reader's partition-major design, shuffle/reader.py _build_step).
+        part2 = part_fn(r1.data[:, 0])
+        send2, rcounts2 = destination_sort(
+            r1.data, part2, r1.total[0], R, method=plan.sort_impl)
+        d_mine = jax.lax.axis_index(ici_axis)
+        cum2 = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                jnp.cumsum(rcounts2).astype(jnp.int32)])
+        gs = jnp.arange(S, dtype=jnp.int32) * D + d_mine    # my column's shards
+        counts2 = jnp.take(cum2, jnp.take(bounds, gs + 1)) \
+            - jnp.take(cum2, jnp.take(bounds, gs))          # [S]
         r2 = ragged_shuffle(send2, counts2, dcn_axis,
                             out_capacity=plan.cap_out, impl=plan.impl)
 
-        # receive side: group rows by reduce partition
-        rows_out, pcounts = destination_sort(
-            r2.data, part_fn(r2.data[:, 0]), r2.total[0], R,
-            method=plan.sort_impl)
+        # receivers locate their runs with the relays' per-partition
+        # counts: [S, R] per shard (relays share a device column, so the
+        # dcn all_gather collects exactly this receiver's senders)
+        seg = jax.lax.all_gather(rcounts2, dcn_axis)
         overflow = r1.overflow | r2.overflow
-        return rows_out, pcounts, r2.total, overflow
+        return r2.data, seg, r2.total, overflow
 
     spec = P((dcn_axis, ici_axis))
     sm = jax.shard_map(step, mesh=mesh, in_specs=(spec, spec),
@@ -119,7 +132,8 @@ def submit_shuffle_hierarchical(
     return PendingShuffle(
         lambda p: _build_hier_step(mesh, dcn_axis, ici_axis, p, width),
         NamedSharding(mesh, P((dcn_axis, ici_axis))), plan,
-        shard_rows, shard_nvalid, val_shape, val_dtype, on_done=on_done)
+        shard_rows, shard_nvalid, val_shape, val_dtype, on_done=on_done,
+        per_shard_segs=True)
 
 
 def read_shuffle_hierarchical(

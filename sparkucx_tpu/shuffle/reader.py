@@ -5,8 +5,11 @@ reads driven by a spinning progress thread (call stack at SURVEY.md §3.4).
 The TPU build collapses all of it into ONE jitted SPMD step over the mesh:
 
     stage:   [P, cap_in, W] int32 row matrix staged per shard (host pool)
-    device:  route -> destination sort -> ragged all-to-all -> partition sort
-    fetch:   per-reduce-partition slices, densely packed per shard
+    device:  route -> ONE partition-major sort -> ragged all-to-all
+    fetch:   per-reduce-partition runs, located by prefix sums over the
+             per-sender count matrix (no receive-side sort: the blocked
+             partition->device map is monotone, so partition order IS
+             device order and every delivered segment arrives grouped)
 
 so the reference's headline property — mapper CPU does nothing per fetch —
 becomes "host does nothing per block": no per-block round-trips exist at
@@ -51,15 +54,33 @@ def _blocked_map(num_partitions: int, num_devices: int):
     return blocked_partition_map(num_partitions, num_devices)
 
 
+def _device_bounds(num_partitions: int, num_devices: int) -> np.ndarray:
+    """Static [P+1] partition-range boundaries of the blocked map: device d
+    owns partitions [bounds[d], bounds[d+1])."""
+    p2d = np.asarray(_blocked_map(num_partitions, num_devices))
+    return np.searchsorted(p2d, np.arange(num_devices + 1)).astype(np.int32)
+
+
 @functools.lru_cache(maxsize=64)
 def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
     """Compile the exchange step for one (mesh, plan, row width).
 
     lru_cache keys on the hashable plan — the jit-cache discipline that
-    keeps one compiled program per shape family."""
+    keeps one compiled program per shape family.
+
+    PARTITION-MAJOR design: the send side sorts by GLOBAL reduce-partition
+    id. The blocked partition->device map is monotone, so one sort groups
+    rows by destination device (the all-to-all invariant) AND leaves each
+    delivered segment internally partition-sorted — the receive side needs
+    NO regrouping at all (the old design re-sorted the cap_out-sized
+    receive buffer, the single largest op in the step). ``partition(r)``
+    is then served as one contiguous slice per sender, with offsets
+    computed from the [P, R] per-sender partition-count matrix that each
+    shard already produced for its own rows (all_gathered: tiny, rides the
+    same program)."""
     R = plan.num_partitions
     Pn = plan.num_shards
-    part_to_dest = _blocked_map(R, Pn)
+    bounds = jnp.asarray(_device_bounds(R, Pn))
 
     def part_fn(key_lo):
         # pluggable partitioner (Spark's Partitioner SPI analog): hash for
@@ -71,21 +92,27 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
 
     def step(payload, nvalid):
         # payload [cap_in, width] int32, col 0 = key_lo; nvalid [1]
-        dest = jnp.take(part_to_dest, part_fn(payload[:, 0]))
-        send, counts = destination_sort(payload, dest, nvalid[0], Pn,
-                                        method=plan.sort_impl)
+        part = part_fn(payload[:, 0])
+        send, rcounts = destination_sort(payload, part, nvalid[0], R,
+                                         method=plan.sort_impl)
+        # per-device segment sizes = partition-count sums over each
+        # device's (static) partition range
+        cum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                               jnp.cumsum(rcounts).astype(jnp.int32)])
+        counts = jnp.take(cum, bounds[1:]) - jnp.take(cum, bounds[:-1])
 
         r = ragged_shuffle(send, counts, axis,
                            out_capacity=plan.cap_out, impl=plan.impl)
+        # every receiver needs every sender's per-partition counts to
+        # locate its runs; [P, R] int32 — negligible next to the payload
+        seg = jax.lax.all_gather(rcounts, axis)
+        return r.data, seg, r.total, r.overflow
 
-        # receive side: group rows by partition (recomputed from key_lo)
-        rows_out, pcounts = destination_sort(
-            r.data, part_fn(r.data[:, 0]), r.total[0], R,
-            method=plan.sort_impl)
-        return rows_out, pcounts, r.total, r.overflow
-
+    # check_vma=False: the seg output is an all_gather result — genuinely
+    # replicated, but the static varying-axes check cannot prove it
     sm = jax.shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
-                       out_specs=(P(axis),) * 4)
+                       out_specs=(P(axis), P(), P(axis), P(axis)),
+                       check_vma=False)
     return jax.jit(sm)
 
 
@@ -134,33 +161,85 @@ def unpack_rows(rows: np.ndarray, val_shape: Optional[Tuple[int, ...]],
     return keys, values
 
 
+class _RunIndex:
+    """Per-shard run arithmetic for the partition-major receive layout.
+
+    A shard's receive buffer is the concatenation of one segment per
+    sender, each internally sorted by partition id. Given the per-sender
+    per-partition count matrix M [NS, R] (NS = senders: P for the flat
+    exchange, S relays for the hierarchical one) and the shard's owned
+    partition range [r_lo, r_hi), partition r's rows are NS contiguous
+    runs at
+        run_start[s] = seg_start[s] + within[s, r - r_lo]
+    — pure prefix sums, no receive-side sort ever happened."""
+
+    def __init__(self, M: np.ndarray, r_lo: int, r_hi: int):
+        C = np.asarray(M[:, r_lo:r_hi], dtype=np.int64)
+        self.lens = C                                     # [NS, k]
+        self.within = np.zeros_like(C)
+        np.cumsum(C[:, :-1], axis=1, out=self.within[:, 1:])
+        seg_sizes = C.sum(axis=1)
+        self.seg_start = np.zeros_like(seg_sizes)
+        np.cumsum(seg_sizes[:-1], out=self.seg_start[1:])
+        self.r_lo = r_lo
+
+    def runs(self, r: int):
+        k = r - self.r_lo
+        starts = self.seg_start + self.within[:, k]
+        lens = self.lens[:, k]
+        return [(int(s), int(n)) for s, n in zip(starts, lens) if n]
+
+
 class ShuffleReaderResult:
-    """Host-side view of one completed exchange."""
+    """Host-side view of one completed exchange (partition-major layout —
+    see :class:`_RunIndex` and ``_build_step``)."""
 
     def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
-                 rows: np.ndarray, pcounts: np.ndarray,
+                 rows: np.ndarray, seg_counts: np.ndarray,
                  val_shape: Optional[Tuple[int, ...]], val_dtype):
-        # rows: [P, cap_out, width] int32; pcounts: [P, R]
+        # rows: [P, cap_out, width] int32
+        # seg_counts: [NS, R] (shared by all shards — flat exchange) or
+        #             [P, NS, R] (per shard — hierarchical exchange)
         self.num_partitions = num_partitions
         self._part_to_shard = part_to_shard
         self._rows = rows
-        self._pcounts = pcounts
+        self._seg = seg_counts
         self._val_shape = val_shape
         self._val_dtype = val_dtype
-        self._offsets = np.zeros_like(pcounts)
-        np.cumsum(pcounts[:, :-1], axis=1, out=self._offsets[:, 1:])
+        self._runidx: dict = {}
         # receive capacity the exchange actually ran with (after any
         # overflow retries) — the manager feeds it back as the next plan's
         # starting capacity for this shuffle shape
         self.cap_out_used: Optional[int] = None
 
+    def _seg_matrix(self, shard: int) -> np.ndarray:
+        return self._seg if self._seg.ndim == 2 else self._seg[shard]
+
+    def _runs(self, shard: int) -> _RunIndex:
+        ri = self._runidx.get(shard)
+        if ri is None:
+            r_lo = int(np.searchsorted(self._part_to_shard, shard, "left"))
+            r_hi = int(np.searchsorted(self._part_to_shard, shard, "right"))
+            ri = _RunIndex(self._seg_matrix(shard), r_lo, r_hi)
+            self._runidx[shard] = ri
+        return ri
+
+    def _shard_rows(self, shard: int) -> np.ndarray:
+        return self._rows[shard]
+
     def partition(self, r: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """(keys, values) of reduce partition r, densely packed."""
         shard = int(self._part_to_shard[r])
-        start = int(self._offsets[shard, r])
-        n = int(self._pcounts[shard, r])
-        return unpack_rows(self._rows[shard, start:start + n],
-                           self._val_shape, self._val_dtype)
+        rows = self._shard_rows(shard)
+        runs = self._runs(shard).runs(r)
+        if not runs:
+            block = rows[:0]
+        elif len(runs) == 1:
+            s, n = runs[0]
+            block = rows[s:s + n]
+        else:
+            block = np.concatenate([rows[s:s + n] for s, n in runs])
+        return unpack_rows(block, self._val_shape, self._val_dtype)
 
     def partitions(self):
         for r in range(self.num_partitions):
@@ -178,31 +257,38 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
     engine playing the progress thread."""
 
     def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
-                 rows_dev, pcounts_dev, num_shards: int, cap_out: int,
-                 val_shape, val_dtype):
+                 rows_dev, seg_dev, num_shards: int, cap_out: int,
+                 val_shape, val_dtype, per_shard_segs: bool = False):
         self.num_partitions = num_partitions
         self._part_to_shard = part_to_shard
         self._rows_dev = rows_dev          # jax.Array [P*cap_out, width]
-        self._pcounts_dev = pcounts_dev    # jax.Array [P*R] or [P, R]
+        # seg_dev: replicated [NS, R] (flat) or P(axis)-sharded [P*NS, R]
+        # (hierarchical, per_shard_segs=True)
+        self._seg_dev = seg_dev
+        self._per_shard_segs = per_shard_segs
         self._num_shards = num_shards
         self._cap_out = cap_out
         self._val_shape = val_shape
         self._val_dtype = val_dtype
-        self._pc = None                    # fetched [P, R] counts
-        self._off = None
+        self._seg = None
+        self._runidx: dict = {}
         self._shards: dict = {}            # shard -> np [cap_out, width]
         self.cap_out_used: Optional[int] = cap_out
 
-    def _counts(self):
-        if self._pc is None:
-            pc = np.asarray(self._pcounts_dev).reshape(self._num_shards, -1)
-            self._pcounts_dev = None           # host copy suffices now
-            self._pc = pc
-            self._off = np.zeros_like(pc)
-            np.cumsum(pc[:, :-1], axis=1, out=self._off[:, 1:])
-        return self._pc, self._off
+    def _seg_matrix(self, shard: int) -> np.ndarray:
+        if self._seg is None:
+            if self._per_shard_segs:
+                self._seg = np.asarray(self._seg_dev).reshape(
+                    self._num_shards, -1, self.num_partitions)
+            else:
+                # replicated output: any addressable copy is the whole
+                # matrix (np.asarray would reject a multi-process array)
+                self._seg = np.asarray(
+                    self._seg_dev.addressable_shards[0].data)
+            self._seg_dev = None
+        return super()._seg_matrix(shard)
 
-    def _fetch_shard(self, shard: int) -> np.ndarray:
+    def _shard_rows(self, shard: int) -> np.ndarray:
         got = self._shards.get(shard)
         if got is None:
             for s in self._rows_dev.addressable_shards:
@@ -218,15 +304,6 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
                 # the HBM is free for the next shuffle's exchange
                 self._rows_dev = None
         return got
-
-    def partition(self, r: int):
-        pc, off = self._counts()
-        shard = int(self._part_to_shard[r])
-        rows = self._fetch_shard(shard)
-        start = int(off[shard, r])
-        n = int(pc[shard, r])
-        return unpack_rows(rows[start:start + n],
-                           self._val_shape, self._val_dtype)
 
 
 class PendingShuffle:
@@ -245,10 +322,12 @@ class PendingShuffle:
 
     def __init__(self, build_step, sharding, plan: ShufflePlan,
                  shard_rows: np.ndarray, shard_nvalid: np.ndarray,
-                 val_shape, val_dtype, on_done=None):
+                 val_shape, val_dtype, on_done=None,
+                 per_shard_segs: bool = False):
         self._build_step = build_step
         self._sharding = sharding
         self._plan = plan
+        self._per_shard_segs = per_shard_segs
         self._rows_host = shard_rows
         self._nvalid_host = shard_nvalid
         self._val_shape = val_shape
@@ -306,7 +385,7 @@ class PendingShuffle:
             return self._result
         try:
             while True:
-                rows_out, pcounts, total, ovf = self._out
+                rows_out, seg, total, ovf = self._out
                 if not np.asarray(ovf).any():
                     break
                 if self._attempt >= self._plan.max_retries:
@@ -326,8 +405,9 @@ class PendingShuffle:
         Pn = self._plan.num_shards
         R = self._plan.num_partitions
         self._result = LazyShuffleReaderResult(
-            R, np.asarray(_blocked_map(R, Pn)), rows_out, pcounts,
-            Pn, self._plan.cap_out, self._val_shape, self._val_dtype)
+            R, np.asarray(_blocked_map(R, Pn)), rows_out, seg,
+            Pn, self._plan.cap_out, self._val_shape, self._val_dtype,
+            per_shard_segs=self._per_shard_segs)
         self._out = None
         self._notify(self._result)
         return self._result
