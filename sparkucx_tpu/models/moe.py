@@ -217,7 +217,9 @@ def make_train_step(mesh: Mesh, cfg: MoEConfig, lr: float = 1e-3,
         params = init_params(rng, cfg)
         return params, opt.init(params)
 
-    @jax.jit
+    # donate params + optimizer state: the updated pytrees reuse the same
+    # HBM instead of holding two copies live across the update
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, x, y, step_idx=None):
         # the wire-quantization noise stream must advance every step; by
         # default ride the optimizer's own step counter so plain

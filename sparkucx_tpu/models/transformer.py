@@ -62,6 +62,10 @@ class TransformerConfig:
     capacity_factor: float = 2.0
     impl: str = "auto"         # data-plane implementation for the exchange
     attn: str = "ring"         # ring | ulysses context parallelism
+    remat: bool = True         # rematerialize each layer in backward:
+    # activation HBM drops from O(layers x seq) to one layer boundary per
+    # scan step, the standard FLOPs-for-memory trade on TPU — large models
+    # are HBM-bound long before they are MXU-bound
 
 
 def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
@@ -245,8 +249,16 @@ def _layer(h, lp, cfg: TransformerConfig, sp_axis: str, tp_axis: str,
 
 def _stage(params, h, cfg: TransformerConfig, sp_axis, tp_axis, ep_axis):
     """Apply this pipeline stage's layer stack (scan over local layers)."""
+    layer = functools.partial(_layer, cfg=cfg, sp_axis=sp_axis,
+                              tp_axis=tp_axis, ep_axis=ep_axis)
+    if cfg.remat:
+        # recompute the layer in backward instead of saving activations
+        # (cfg.remat docstring); collectives inside replay uniformly on
+        # every device, so the SPMD structure is unchanged
+        layer = jax.checkpoint(layer)
+
     def body(h, lp):
-        return _layer(h, lp, cfg, sp_axis, tp_axis, ep_axis), None
+        return layer(h, lp), None
     h, _ = jax.lax.scan(body, h, params)
     return h
 
@@ -326,7 +338,9 @@ def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-2):
         params = init_params(rng, cfg)
         return params, opt.init(params)
 
-    @jax.jit
+    # donate params + optimizer state: the updated pytrees reuse the same
+    # HBM instead of holding two copies live across the update
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(loss_fn)(
             params, tokens, targets, mesh, cfg)

@@ -1,0 +1,177 @@
+"""Device-side combine-by-key — the aggregation half of the reduce side.
+
+The reference's reduce side hands fetched blocks to Spark's STOCK
+deserialize -> aggregate -> sort pipeline on the executor CPU
+(ref: compat/spark_2_4/UcxShuffleReader.scala:80-144; SURVEY.md §3.4
+"deserialize → aggregate → sort (stock)"). The TPU build moves the
+aggregation INTO the compiled exchange step, on both sides:
+
+* map-side combine: rows are summed per (partition, key) BEFORE the
+  all-to-all, so the wire carries one row per distinct key per mapper —
+  Spark's map-side combine, but on the accelerator and fused with the
+  destination sort it needs anyway.
+* reduce-side combine: received segments are merged per key AFTER the
+  all-to-all, so device-to-host transfers carry one row per distinct key
+  (for aggregation workloads like WordCount this shrinks D2H by the
+  duplication factor).
+
+Everything is sort + prefix-sum + gather — no scatter (XLA:TPU serializes
+colliding scatters; see ops/partition.counts_from_sorted). The grouping
+sort is BY (partition, key), which is strictly finer than the
+partition-major exchange sort, so combining replaces that sort instead of
+adding one — and its output is key-sorted within each partition, which is
+the reference pipeline's trailing "sort" step for free.
+
+Key ordering: rows carry int64 keys as two int32 words [lo, hi]
+(shuffle/reader.py transport format). Lexicographic (hi signed, lo
+unsigned) compare equals signed int64 compare; the low word is flipped by
+0x8000_0000 so lax.sort's signed int32 compare orders it as unsigned.
+
+Numerics: segment sums are computed as exclusive-prefix-sum differences.
+Integers accumulate exactly (int32 lanes; the store back to a narrower
+declared dtype wraps, matching a cast). Floats accumulate in float32;
+very long prefixes can lose low-order bits versus a per-segment tree sum
+— the documented trade for a scatter-free one-pass formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkucx_tpu.ops.partition import counts_from_sorted
+
+COMBINERS = ("sum",)
+_FLIP = jnp.int32(-0x80000000)  # two's-complement 0x8000_0000
+
+
+def check_combinable(val_tail, val_dtype, op: str) -> None:
+    """Raise unless the declared value schema supports device combining."""
+    if op not in COMBINERS:
+        raise ValueError(f"unknown combiner {op!r}; want one of {COMBINERS}")
+    if val_dtype is None:
+        raise ValueError("combine needs valued rows (keys-only shuffle)")
+    vdt = np.dtype(val_dtype)
+    numeric = np.issubdtype(vdt, np.integer) or np.issubdtype(vdt, np.floating)
+    if not numeric or vdt.itemsize > 4:
+        raise ValueError(
+            f"combine supports numeric value dtypes up to 4 bytes "
+            f"(int8/16/32, float16/32), got {vdt}")
+    nbytes = int(np.prod(val_tail, dtype=np.int64)) * vdt.itemsize
+    if nbytes % 4:
+        raise ValueError(
+            f"combine needs the value row to fill whole transport words; "
+            f"{val_tail} x {vdt} = {nbytes} B (pad the trailing dim)")
+
+
+def _compact_true_positions(flags: jnp.ndarray) -> jnp.ndarray:
+    """Positions of True flags, densely packed first, ascending — via one
+    2-operand sort (the scatter-free compaction primitive).
+
+    Returns [cap] int32; entries past flags.sum() point at trailing False
+    positions (callers bound their reads by the true count)."""
+    cap = flags.shape[0]
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    out = jax.lax.sort((jnp.where(flags, 0, 1).astype(jnp.int32), idx),
+                       num_keys=1, is_stable=True)
+    return out[1]
+
+
+def _words_to_vals(words: jnp.ndarray, vdt: np.dtype) -> jnp.ndarray:
+    """Reinterpret [cap, vw] int32 transport words as the value dtype."""
+    cap, vw = words.shape
+    if vdt.itemsize == 4:
+        return jax.lax.bitcast_convert_type(words, vdt)
+    # smaller lanes: bitcast adds a trailing axis of 4/itemsize
+    out = jax.lax.bitcast_convert_type(words, vdt)
+    return out.reshape(cap, vw * (4 // vdt.itemsize))
+
+
+def _vals_to_words(vals: jnp.ndarray, vdt: np.dtype, vw: int) -> jnp.ndarray:
+    """Inverse of _words_to_vals."""
+    cap = vals.shape[0]
+    if vdt.itemsize == 4:
+        return jax.lax.bitcast_convert_type(vals, jnp.int32)
+    ratio = 4 // vdt.itemsize
+    return jax.lax.bitcast_convert_type(
+        vals.reshape(cap, vw, ratio), jnp.int32)
+
+
+def combine_rows(
+    rows: jnp.ndarray,
+    part: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    num_parts: int,
+    val_words_n: int,
+    val_dtype,
+    op: str = "sum",
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Group rows by (partition, int64 key) and combine values per group.
+
+    rows       — [cap, W] int32 transport rows (cols 0,1 = key lo,hi; the
+                 next ``val_words_n`` cols are the bit-packed value).
+    part       — [cap] int32 partition id per row (from the partitioner).
+    num_valid  — scalar count of real rows.
+    num_parts  — static partition count R.
+    val_words_n— value width in int32 words.
+    val_dtype  — declared numeric dtype (validated by check_combinable).
+
+    Returns (rows_out [cap, W], pcounts [num_parts], n_out [1]):
+    rows_out's first n_out rows are one row per distinct (partition, key),
+    sorted by (partition, key) — partition-major AND key-sorted within
+    each partition; pcounts[r] = distinct keys of partition r. Rows past
+    n_out are zero."""
+    vdt = np.dtype(val_dtype)
+    cap, W = rows.shape
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < num_valid
+
+    # ---- one grouping sort: (partition, key_hi, key_lo-as-unsigned) ----
+    pkey = jnp.where(valid, part.astype(jnp.int32), jnp.int32(num_parts))
+    sort_ops = (pkey,
+                jnp.where(valid, rows[:, 1], 0),
+                jnp.where(valid, rows[:, 0] ^ _FLIP, 0)) \
+        + tuple(rows[:, i] for i in range(W))
+    out = jax.lax.sort(sort_ops, num_keys=3, is_stable=True)
+    spart, srows = out[0], jnp.stack(out[3:], axis=1)
+
+    # ---- segment starts: first valid row, or (partition, key) change ---
+    key_eq = (srows[:, 0] == jnp.roll(srows[:, 0], 1)) \
+        & (srows[:, 1] == jnp.roll(srows[:, 1], 1))
+    part_eq = spart == jnp.roll(spart, 1)
+    is_start = valid & ~(key_eq & part_eq)
+    is_start = is_start.at[0].set(num_valid > 0)
+    n_out = is_start.sum().astype(jnp.int32)
+
+    starts = _compact_true_positions(is_start)            # [cap]
+    j = jnp.arange(cap, dtype=jnp.int32)
+    next_start = jnp.take(starts, jnp.minimum(j + 1, cap - 1))
+    seg_end = jnp.where(j + 1 < n_out, next_start,
+                        num_valid.astype(jnp.int32))      # [cap]
+
+    # ---- per-segment value sums: exclusive-cumsum differences ----------
+    vals = _words_to_vals(srows[:, 2:2 + val_words_n], vdt)
+    acc_dt = jnp.float32 if np.issubdtype(vdt, np.floating) else jnp.int32
+    acc = jnp.where(valid[:, None], vals.astype(acc_dt), 0)
+    excl = jnp.concatenate(
+        [jnp.zeros((1, acc.shape[1]), acc.dtype),
+         jnp.cumsum(acc, axis=0)], axis=0)                # [cap+1, m]
+    seg_sum = (jnp.take(excl, seg_end, axis=0)
+               - jnp.take(excl, starts, axis=0)).astype(vals.dtype)
+
+    # ---- assemble output rows at the compacted positions ---------------
+    live = j < n_out
+    src = jnp.where(live, starts, 0)
+    key_cols = jnp.take(srows[:, :2], src, axis=0)        # [cap, 2]
+    words = _vals_to_words(seg_sum, vdt, val_words_n)
+    rows_out = jnp.concatenate(
+        [key_cols, words,
+         jnp.zeros((cap, W - 2 - val_words_n), jnp.int32)], axis=1)
+    rows_out = jnp.where(live[:, None], rows_out, 0)
+
+    out_part = jnp.where(live, jnp.take(spart, src), jnp.int32(num_parts))
+    pcounts = counts_from_sorted(out_part, num_parts)
+    return rows_out, pcounts, n_out.reshape(1)

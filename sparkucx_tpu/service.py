@@ -69,6 +69,9 @@ class ShuffleService:
         self.manager.stop()
         self.node.close()
 
+    # the name users reach for first; stop() is the Spark-SPI name
+    close = stop
+
     def __enter__(self) -> "ShuffleService":
         return self
 
@@ -101,19 +104,28 @@ class ShuffleService:
 
     # -- reduce side (getReader) ------------------------------------------
     def read(self, handle: ShuffleHandle,
-             timeout: Optional[float] = None):
+             timeout: Optional[float] = None,
+               combine: Optional[str] = None):
         """Full exchange. arrow: list of per-partition RecordBatches;
-        raw: the ShuffleReaderResult partition view."""
+        raw: the ShuffleReaderResult partition view. ``combine="sum"``
+        runs device combine-by-key (manager.read docstring)."""
         if self.io_format == "arrow":
+            if combine:
+                raise ValueError(
+                    "combine rides the raw transport; read the combined "
+                    "result with io.format=raw and convert, or aggregate "
+                    "the returned batches")
             from sparkucx_tpu.io.arrow import read_batches
             return read_batches(self.manager, handle,
                                 key_column=self.key_column, timeout=timeout)
-        return self.manager.read(handle, timeout=timeout)
+        return self.manager.read(handle, timeout=timeout, combine=combine)
 
     def submit(self, handle: ShuffleHandle,
-               timeout: Optional[float] = None):
+               timeout: Optional[float] = None,
+               combine: Optional[str] = None):
         """Asynchronous raw read (shuffle/reader.py PendingShuffle)."""
-        return self.manager.submit(handle, timeout=timeout)
+        return self.manager.submit(handle, timeout=timeout,
+                                   combine=combine)
 
 
 def connect(conf: Optional[Mapping[str, str]] = None, *,

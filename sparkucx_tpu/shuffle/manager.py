@@ -176,23 +176,38 @@ class TpuShuffleManager:
 
     # -- the read path ----------------------------------------------------
     def read(self, handle: ShuffleHandle,
-             timeout: Optional[float] = None) -> ShuffleReaderResult:
+             timeout: Optional[float] = None,
+             combine: Optional[str] = None) -> ShuffleReaderResult:
         """Execute the full exchange for a shuffle and return partitioned
         results (the getReader + fetch-everything path, SURVEY.md §3.4).
 
         Blocks until all map outputs are published, mirroring the metadata
-        wait (ref: UcxWorkerWrapper.scala:134-143)."""
+        wait (ref: UcxWorkerWrapper.scala:134-143).
+
+        ``combine="sum"`` turns on device combine-by-key (ops/aggregate.py)
+        on both sides of the wire: the result holds ONE row per distinct
+        key, key-sorted within each partition — the reference reduce
+        pipeline's stock aggregate+sort (ref: compat/spark_2_4/
+        UcxShuffleReader.scala:80-144) executed on the accelerator, with
+        proportionally less ICI traffic and D2H volume. Needs a numeric
+        value schema."""
         self.node.epochs.validate(handle.epoch,
                                   f"shuffle {handle.shuffle_id}")
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
         if self.node.is_distributed:
+            if combine:
+                raise NotImplementedError(
+                    "combine is single-process for now; aggregate "
+                    "host-side in multi-process mode")
             return self._read_distributed(handle, timeout)
         with self.node.metrics.timeit("shuffle.read"):
-            return self._submit_local(handle, timeout).result()
+            return self._submit_local(handle, timeout,
+                                      combine=combine).result()
 
     def submit(self, handle: ShuffleHandle,
-               timeout: Optional[float] = None):
+               timeout: Optional[float] = None,
+               combine: Optional[str] = None):
         """Asynchronous read: plan + pack on the host, DISPATCH the
         exchange, and return a :class:`shuffle.reader.PendingShuffle`
         without blocking — so the caller overlaps this shuffle's collective
@@ -211,9 +226,10 @@ class TpuShuffleManager:
                 "collective — every process must call read()")
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
-        return self._submit_local(handle, timeout)
+        return self._submit_local(handle, timeout, combine=combine)
 
-    def _submit_local(self, handle: ShuffleHandle, timeout: float):
+    def _submit_local(self, handle: ShuffleHandle, timeout: float,
+                      combine: Optional[str] = None):
         tracer = self.node.tracer
         if not handle.entry.wait_complete(timeout):
             raise TimeoutError(
@@ -267,6 +283,16 @@ class TpuShuffleManager:
             plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
                              partitioner=handle.partitioner)
             plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
+        if combine:
+            import dataclasses
+
+            from sparkucx_tpu.ops.aggregate import check_combinable
+            check_combinable(val_tail if has_vals else None,
+                             val_dtype if has_vals else None, combine)
+            plan = dataclasses.replace(
+                plan, combine=combine,
+                combine_words=value_words(val_tail, val_dtype),
+                combine_dtype=np.dtype(val_dtype).str)
 
         # fuse key+value bytes into one int32 row matrix (bit views, no
         # value casts — jnp would silently truncate int64 with x64 off)
@@ -296,6 +322,11 @@ class TpuShuffleManager:
                              hierarchical=self.hierarchical):
                 vt = val_tail if has_vals else None
                 if self.hierarchical:
+                    if combine:
+                        raise NotImplementedError(
+                            "combine is not yet wired into the two-stage "
+                            "hierarchical exchange; set "
+                            "a2a.hierarchical=false to combine")
                     from sparkucx_tpu.shuffle.hierarchical import \
                         submit_shuffle_hierarchical
                     return submit_shuffle_hierarchical(

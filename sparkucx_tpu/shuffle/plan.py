@@ -41,6 +41,13 @@ class ShufflePlan:
     partitioner: str = "hash"  # hash | direct (keys ARE partition ids)
     max_retries: int = 4
     sort_impl: str = "auto"    # ops/partition.py destination_sort method
+    # device combine-by-key (ops/aggregate.py): None, or a COMBINERS entry
+    # ("sum"). Applied map-side (before the wire) AND reduce-side (before
+    # D2H); needs a numeric value schema, carried here so the jit cache
+    # keys on it.
+    combine: Optional[str] = None
+    combine_words: int = 0     # value width in int32 words (combine only)
+    combine_dtype: str = ""    # np.dtype.str of the value (combine only)
 
     def grown(self) -> "ShufflePlan":
         """Next plan after an overflow: double the receive capacity."""

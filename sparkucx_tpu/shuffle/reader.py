@@ -90,28 +90,55 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
             return jnp.clip(key_lo, 0, R - 1)
         return hash_partition(key_lo, R)
 
-    def step(payload, nvalid):
-        # payload [cap_in, width] int32, col 0 = key_lo; nvalid [1]
-        part = part_fn(payload[:, 0])
-        send, rcounts = destination_sort(payload, part, nvalid[0], R,
-                                         method=plan.sort_impl)
+    def dev_counts(rcounts):
         # per-device segment sizes = partition-count sums over each
         # device's (static) partition range
         cum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                jnp.cumsum(rcounts).astype(jnp.int32)])
-        counts = jnp.take(cum, bounds[1:]) - jnp.take(cum, bounds[:-1])
+        return jnp.take(cum, bounds[1:]) - jnp.take(cum, bounds[:-1])
 
-        r = ragged_shuffle(send, counts, axis,
+    def step(payload, nvalid):
+        # payload [cap_in, width] int32, col 0 = key_lo; nvalid [1]
+        part = part_fn(payload[:, 0])
+        if plan.combine:
+            # map-side combine: one row per distinct (partition, key)
+            # enters the wire. Its grouping sort is (partition, key) —
+            # strictly finer than the partition sort it replaces, so the
+            # send-buffer invariants (device-grouped, partition-sorted
+            # segments) still hold.
+            from sparkucx_tpu.ops.aggregate import combine_rows
+            send, rcounts, _ = combine_rows(
+                payload, part, nvalid[0], R, plan.combine_words,
+                np.dtype(plan.combine_dtype), plan.combine)
+        else:
+            send, rcounts = destination_sort(payload, part, nvalid[0], R,
+                                             method=plan.sort_impl)
+
+        r = ragged_shuffle(send, dev_counts(rcounts), axis,
                            out_capacity=plan.cap_out, impl=plan.impl)
+
+        if plan.combine:
+            # reduce-side combine: merge the per-sender segments' rows by
+            # key before D2H — one run per partition, so the seg matrix is
+            # this shard's OWN combined counts ([1, R] per shard)
+            from sparkucx_tpu.ops.aggregate import combine_rows
+            rows_out, pcounts, n_out = combine_rows(
+                r.data, part_fn(r.data[:, 0]), r.total[0], R,
+                plan.combine_words, np.dtype(plan.combine_dtype),
+                plan.combine)
+            return rows_out, pcounts.reshape(1, R), \
+                n_out.astype(r.total.dtype), r.overflow
         # every receiver needs every sender's per-partition counts to
         # locate its runs; [P, R] int32 — negligible next to the payload
         seg = jax.lax.all_gather(rcounts, axis)
         return r.data, seg, r.total, r.overflow
 
+    seg_spec = P(axis) if plan.combine else P()
+
     # check_vma=False: the seg output is an all_gather result — genuinely
     # replicated, but the static varying-axes check cannot prove it
     sm = jax.shard_map(step, mesh=mesh, in_specs=(P(axis), P(axis)),
-                       out_specs=(P(axis), P(), P(axis), P(axis)),
+                       out_specs=(P(axis), seg_spec, P(axis), P(axis)),
                        check_vma=False)
     return jax.jit(sm)
 
@@ -433,7 +460,10 @@ def submit_shuffle(
     return PendingShuffle(
         lambda p: _build_step(mesh, axis, p, width),
         NamedSharding(mesh, P(axis)), plan, shard_rows, shard_nvalid,
-        val_shape, val_dtype, on_done=on_done)
+        val_shape, val_dtype, on_done=on_done,
+        # combined output is one run per partition: the seg matrix is each
+        # shard's own [1, R] combined counts, sharded like the rows
+        per_shard_segs=bool(plan.combine))
 
 
 def read_shuffle(

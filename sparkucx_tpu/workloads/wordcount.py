@@ -1,7 +1,10 @@
 """WordCount — HiBench bigdata-profile shape (BASELINE.md configs).
 
-Map side emits (word-id, 1) pairs; the shuffle groups by word; reducers
-sum. Counts are verified exactly against a host dictionary."""
+Map side emits (word-id, 1) pairs; the shuffle groups by word; the DEVICE
+sums per key on both sides of the wire (``combine="sum"``,
+ops/aggregate.py) — the map-side-combine + reduce-aggregate pipeline
+Spark runs on executor CPUs, fused into the exchange. Counts are
+verified exactly against a host dictionary."""
 
 from __future__ import annotations
 
@@ -15,7 +18,7 @@ from sparkucx_tpu.shuffle.manager import TpuShuffleManager
 def run_wordcount(manager: TpuShuffleManager, *, num_mappers: int = 8,
                   words_per_mapper: int = 5000, vocab: int = 1000,
                   num_partitions: int = 32, shuffle_id: int = 9003,
-                  seed: int = 0) -> Dict[str, int]:
+                  seed: int = 0, combine: bool = True) -> Dict[str, int]:
     rng = np.random.default_rng(seed)
     h = manager.register_shuffle(shuffle_id, num_mappers, num_partitions)
     try:
@@ -29,9 +32,15 @@ def run_wordcount(manager: TpuShuffleManager, *, num_mappers: int = 8,
             w.commit(num_partitions)
             for x in words:
                 truth[int(x)] = truth.get(int(x), 0) + 1
-        res = manager.read(h)
+        res = manager.read(h, combine="sum" if combine else None)
         got: Dict[int, int] = {}
         for r, (k, v) in res.partitions():
+            if combine and len(set(k.tolist())) != len(k):
+                # explicit raise: a bare assert vanishes under python -O
+                # and the totals check below re-accumulates duplicates,
+                # so it alone would not catch a broken combine
+                raise AssertionError(
+                    f"combined partition {r} has duplicate keys")
             for ki, vi in zip(k, v[:, 0]):
                 got[int(ki)] = got.get(int(ki), 0) + int(vi)
         if got != truth:
