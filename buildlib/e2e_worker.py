@@ -160,10 +160,34 @@ def main() -> int:
     assert (ownership.sum(axis=0) == 1).all(), \
         f"partition ownership not a partition of unity:\n{ownership}"
 
+    # second job: the COLLECTIVE combined read (device combine-by-key on
+    # every process; ops/aggregate.py) — per-key sums vs host truth
+    hc = mgr.register_shuffle(8, num_maps, R)
+    for m in my_maps:
+        w = mgr.get_writer(hc, m)
+        k, _ = map_data(m)
+        k = k % 97                      # heavy duplication across maps
+        w.write(k, np.ones((k.shape[0], 1), dtype=np.int32))
+        w.commit(R)
+    resc = mgr.read(hc, combine="sum")
+    allkc = np.concatenate([map_data(m)[0] % 97 for m in range(num_maps)])
+    partsc = _hash32_np(allkc) % R
+    truth = {}
+    for kk in allkc.tolist():
+        truth[kk] = truth.get(kk, 0) + 1
+    ccheck = 0
+    for r, (gk, gv) in resc.partitions():
+        assert gk.tolist() == sorted(set(allkc[partsc == r].tolist())), \
+            f"combined partition {r} keys wrong on process {proc_id}"
+        for i, kk in enumerate(gk.tolist()):
+            assert int(gv[i, 0]) == truth[kk], \
+                f"combined count wrong for key {kk}"
+        ccheck += 1
+
     mgr.stop()
     node.close()
     print(f"worker {proc_id}/{nprocs}: verified {checked} local "
-          f"partitions of {R} OK", flush=True)
+          f"partitions of {R} OK (+{ccheck} combined)", flush=True)
     return 0
 
 

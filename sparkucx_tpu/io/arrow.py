@@ -149,7 +149,9 @@ def read_batches(manager, handle, key_column: str = "key",
     """Run the exchange; one RecordBatch per non-empty reduce partition.
     Column names and dtypes default to the recipe recorded by
     write_batches, so batches come back with the schema they went in
-    with."""
+    with. (No ``combine`` here: arrow columns ride as 8-byte lossless
+    carriers, and device combine needs <=4-byte value lanes — aggregate
+    via the raw format instead.)"""
     _require_arrow()
     recorded = handle.__dict__.get("_arrow_value_schema")
     if recorded is not None:

@@ -186,13 +186,16 @@ def read_shuffle_distributed(
         ovf_global = bool(allgather_blob(
             np.array([1 if mine else 0], dtype=np.int64)).any())
         if not ovf_global:
-            if hier_mesh is not None:
-                # per-shard [S, R] relay-count matrices, locals only
-                S = hier_mesh.devices.shape[0]
-                seg_host = _local_shards_of(seg, shard_ids, S)
+            if cur.combine or hier_mesh is not None:
+                # SHARDED seg output — collect this process's rows:
+                # [1, R] own combined counts under combine, else [S, R]
+                # relay counts (hierarchical)
+                ns = 1 if cur.combine else hier_mesh.devices.shape[0]
+                seg_host = _local_shards_of(seg, shard_ids, ns)
             else:
-                # replicated [P, R]: any addressable copy is the whole
-                # matrix (np.asarray rejects multi-process arrays)
+                # flat uncombined: replicated [P, R] — any addressable
+                # copy is the whole matrix (np.asarray rejects
+                # multi-process arrays)
                 seg_host = np.asarray(seg.addressable_shards[0].data)
             res = DistributedReaderResult(
                 R, part_to_shard, shard_ids,

@@ -72,23 +72,42 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
 
     def step(payload, nvalid):
         # payload [cap_in, W] int32, col 0 = key_lo; nvalid [1]
+        n0 = nvalid[0]
+        if plan.combine:
+            # map-side combine shrinks BOTH hops; re-sorted by device
+            # index below since partition-major is not d'-major
+            from sparkucx_tpu.ops.aggregate import combine_rows
+            payload, _, n1 = combine_rows(
+                payload, part_fn(payload[:, 0]), n0, R,
+                plan.combine_words, np.dtype(plan.combine_dtype),
+                plan.combine)
+            n0 = n1[0]
         g = jnp.take(part_to_dest, part_fn(payload[:, 0]))  # global shard
 
         # stage 1 — ICI: group by destination device index d' = g % D
         send1, counts1 = destination_sort(
-            payload, g % D, nvalid[0], D, method=plan.sort_impl)
+            payload, g % D, n0, D, method=plan.sort_impl)
         r1 = ragged_shuffle(send1, counts1, ici_axis,
                             out_capacity=plan.cap_out, impl=plan.impl)
 
-        # stage 2 — DCN: sort by GLOBAL PARTITION id. Every row here is
+        # stage 2 — DCN: group by GLOBAL PARTITION id. Every row here is
         # destined to some (s', d_mine); its global shard g2 = s'*D +
         # d_mine is monotone in the partition id, so the partition sort
         # groups by destination slice AND leaves each delivered segment
         # partition-sorted — no receive-side regrouping (the flat
         # reader's partition-major design, shuffle/reader.py _build_step).
+        # With combine on, the relay MERGES same-key rows from its whole
+        # slice first — the rows that shrink here are exactly the ones
+        # that would otherwise cross DCN, the slow fabric.
         part2 = part_fn(r1.data[:, 0])
-        send2, rcounts2 = destination_sort(
-            r1.data, part2, r1.total[0], R, method=plan.sort_impl)
+        if plan.combine:
+            from sparkucx_tpu.ops.aggregate import combine_rows
+            send2, rcounts2, _ = combine_rows(
+                r1.data, part2, r1.total[0], R, plan.combine_words,
+                np.dtype(plan.combine_dtype), plan.combine)
+        else:
+            send2, rcounts2 = destination_sort(
+                r1.data, part2, r1.total[0], R, method=plan.sort_impl)
         d_mine = jax.lax.axis_index(ici_axis)
         cum2 = jnp.concatenate([jnp.zeros((1,), jnp.int32),
                                 jnp.cumsum(rcounts2).astype(jnp.int32)])
@@ -97,12 +116,23 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
             - jnp.take(cum2, jnp.take(bounds, gs))          # [S]
         r2 = ragged_shuffle(send2, counts2, dcn_axis,
                             out_capacity=plan.cap_out, impl=plan.impl)
+        overflow = r1.overflow | r2.overflow
+
+        if plan.combine:
+            # reduce-side merge across relays: one run per partition; the
+            # seg matrix is this shard's own combined counts ([1, R])
+            from sparkucx_tpu.ops.aggregate import combine_rows
+            rows_out, pcounts, n_out = combine_rows(
+                r2.data, part_fn(r2.data[:, 0]), r2.total[0], R,
+                plan.combine_words, np.dtype(plan.combine_dtype),
+                plan.combine)
+            return rows_out, pcounts.reshape(1, R), \
+                n_out.astype(r2.total.dtype), overflow
 
         # receivers locate their runs with the relays' per-partition
         # counts: [S, R] per shard (relays share a device column, so the
         # dcn all_gather collects exactly this receiver's senders)
         seg = jax.lax.all_gather(rcounts2, dcn_axis)
-        overflow = r1.overflow | r2.overflow
         return r2.data, seg, r2.total, overflow
 
     spec = P((dcn_axis, ici_axis))

@@ -196,11 +196,9 @@ class TpuShuffleManager:
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
         if self.node.is_distributed:
-            if combine:
-                raise NotImplementedError(
-                    "combine is single-process for now; aggregate "
-                    "host-side in multi-process mode")
-            return self._read_distributed(handle, timeout)
+            # collective: every process must pass the same combine value
+            # (same SPMD discipline as calling read() at all)
+            return self._read_distributed(handle, timeout, combine=combine)
         with self.node.metrics.timeit("shuffle.read"):
             return self._submit_local(handle, timeout,
                                       combine=combine).result()
@@ -284,15 +282,8 @@ class TpuShuffleManager:
                              partitioner=handle.partitioner)
             plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
         if combine:
-            import dataclasses
-
-            from sparkucx_tpu.ops.aggregate import check_combinable
-            check_combinable(val_tail if has_vals else None,
-                             val_dtype if has_vals else None, combine)
-            plan = dataclasses.replace(
-                plan, combine=combine,
-                combine_words=value_words(val_tail, val_dtype),
-                combine_dtype=np.dtype(val_dtype).str)
+            plan = self._combined_plan(plan, combine, has_vals,
+                                       val_tail, val_dtype)
 
         # fuse key+value bytes into one int32 row matrix (bit views, no
         # value casts — jnp would silently truncate int64 with x64 off)
@@ -322,11 +313,6 @@ class TpuShuffleManager:
                              hierarchical=self.hierarchical):
                 vt = val_tail if has_vals else None
                 if self.hierarchical:
-                    if combine:
-                        raise NotImplementedError(
-                            "combine is not yet wired into the two-stage "
-                            "hierarchical exchange; set "
-                            "a2a.hierarchical=false to combine")
                     from sparkucx_tpu.shuffle.hierarchical import \
                         submit_shuffle_hierarchical
                     return submit_shuffle_hierarchical(
@@ -341,6 +327,21 @@ class TpuShuffleManager:
             raise
 
     # -- capacity learning -------------------------------------------------
+    @staticmethod
+    def _combined_plan(plan: ShufflePlan, combine: str, has_vals: bool,
+                       val_tail, val_dtype) -> ShufflePlan:
+        """Validate and stamp the combine fields onto a plan (shared by
+        the single- and multi-process read paths)."""
+        import dataclasses
+
+        from sparkucx_tpu.ops.aggregate import check_combinable
+        check_combinable(val_tail if has_vals else None,
+                         val_dtype if has_vals else None, combine)
+        return dataclasses.replace(
+            plan, combine=combine,
+            combine_words=value_words(val_tail, val_dtype),
+            combine_dtype=np.dtype(val_dtype).str)
+
     @staticmethod
     def _cap_key(handle: ShuffleHandle) -> tuple:
         return (handle.num_maps, handle.num_partitions, handle.partitioner)
@@ -438,7 +439,8 @@ class TpuShuffleManager:
         return rows, buf
 
     # -- the multi-process read path --------------------------------------
-    def _read_distributed(self, handle: ShuffleHandle, timeout: float):
+    def _read_distributed(self, handle: ShuffleHandle, timeout: float,
+                          combine: Optional[str] = None):
         """COLLECTIVE multi-process read (shuffle/distributed.py). Map
         outputs stay on this process's shards (Spark: outputs live on the
         writing executor's local disk); metadata crosses processes via
@@ -553,6 +555,9 @@ class TpuShuffleManager:
             # safe cross-process: every process runs the same collective
             # read sequence, so learned hints advance in lockstep
             plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
+        if combine:
+            plan = self._combined_plan(plan, combine, has_vals,
+                                       val_tail, val_dtype)
 
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)

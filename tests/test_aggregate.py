@@ -202,20 +202,37 @@ def test_combine_rejected_for_keys_only():
         node.close()
 
 
-def test_combine_rejected_on_hierarchical():
-    """The two-stage exchange has no combine wiring yet; it must refuse
-    loudly — silently returning uncombined rows under a combined-layout
-    seg matrix would corrupt every partition slice."""
+def test_combined_read_hierarchical():
+    """Two-stage ICI/DCN exchange with combine at all three hops: map-side,
+    relay-side (the rows it shrinks are the ones crossing DCN), and
+    receive-side. Same oracle as the flat path."""
     mgr, node = _mgr(**{"spark.shuffle.tpu.mesh.numSlices": "2"})
     try:
         assert mgr.hierarchical, "fixture must select the two-stage path"
-        h = mgr.register_shuffle(52, 1, 4)
-        w = mgr.get_writer(h, 0)
-        k = np.arange(10, dtype=np.int64)
-        w.write(k, np.ones((10, 1), dtype=np.int32))
-        w.commit(4)
-        with pytest.raises(NotImplementedError, match="hierarchical"):
-            mgr.read(h, combine="sum")
+        R = 16
+        h = mgr.register_shuffle(52, 4, R)
+        rng = np.random.default_rng(13)
+        allk, allv = [], []
+        for m in range(4):
+            w = mgr.get_writer(h, m)
+            k = rng.integers(0, 23, size=700).astype(np.int64)  # heavy dups
+            v = np.stack([k, np.ones_like(k)], axis=1).astype(np.int32)
+            w.write(k, v)
+            w.commit(R)
+            allk.append(k)
+            allv.append(v)
+        allk, allv = np.concatenate(allk), np.concatenate(allv)
+        res = mgr.read(h, combine="sum")
+        want = _oracle_sums(allk, allv)
+        parts = _hash32_np(allk) % R
+        seen = 0
+        for r, (gk, gv) in res.partitions():
+            assert gk.tolist() == sorted(set(allk[parts == r].tolist()))
+            for i, k in enumerate(gk.tolist()):
+                np.testing.assert_array_equal(gv[i].astype(np.int64),
+                                              want[k])
+            seen += len(gk)
+        assert seen == len(want)
     finally:
         mgr.stop()
         node.close()
