@@ -145,13 +145,15 @@ def write_batches(manager, handle, map_id: int,
 def read_batches(manager, handle, key_column: str = "key",
                  value_columns: Optional[Sequence[str]] = None,
                  value_dtypes: Optional[Sequence] = None,
-                 timeout: Optional[float] = None) -> List["pa.RecordBatch"]:
+                 timeout: Optional[float] = None,
+                 ordered: bool = False) -> List["pa.RecordBatch"]:
     """Run the exchange; one RecordBatch per non-empty reduce partition.
     Column names and dtypes default to the recipe recorded by
     write_batches, so batches come back with the schema they went in
-    with. (No ``combine`` here: arrow columns ride as 8-byte lossless
-    carriers, and device combine needs <=4-byte value lanes — aggregate
-    via the raw format instead.)"""
+    with. ``ordered=True`` returns key-sorted batches (device sort).
+    (No ``combine`` here: arrow columns ride as 8-byte lossless carriers,
+    and device combine needs <=4-byte value lanes — aggregate via the raw
+    format instead.)"""
     _require_arrow()
     recorded = handle.__dict__.get("_arrow_value_schema")
     if recorded is not None:
@@ -159,7 +161,7 @@ def read_batches(manager, handle, key_column: str = "key",
             value_columns = recorded[0]
         if value_dtypes is None:
             value_dtypes = recorded[1]
-    res = manager.read(handle, timeout=timeout)
+    res = manager.read(handle, timeout=timeout, ordered=ordered)
     out = []
     for r, (k, v) in res.partitions():
         if k.shape[0]:

@@ -67,6 +67,31 @@ def check_combinable(val_tail, val_dtype, op: str) -> None:
             f"{val_tail} x {vdt} = {nbytes} B (pad the trailing dim)")
 
 
+def keysort_rows(
+    rows: jnp.ndarray,
+    part: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    num_parts: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort transport rows by (partition, signed int64 key), padding last.
+
+    Returns (spart [cap], rows_sorted [cap, W], pcounts [num_parts]) —
+    partition-major, key-sorted within each partition (stable, so
+    duplicate keys keep arrival order). The ``ordered`` read path's whole
+    device cost, and the shared head of :func:`combine_rows`."""
+    cap, W = rows.shape
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < num_valid
+    pkey = jnp.where(valid, part.astype(jnp.int32), jnp.int32(num_parts))
+    sort_ops = (pkey,
+                jnp.where(valid, rows[:, 1], 0),
+                jnp.where(valid, rows[:, 0] ^ _FLIP, 0)) \
+        + tuple(rows[:, i] for i in range(W))
+    out = jax.lax.sort(sort_ops, num_keys=3, is_stable=True)
+    spart, srows = out[0], jnp.stack(out[3:], axis=1)
+    return spart, srows, counts_from_sorted(spart, num_parts)
+
+
 def _compact_true_positions(flags: jnp.ndarray) -> jnp.ndarray:
     """Positions of True flags, densely packed first, ascending — via one
     2-operand sort (the scatter-free compaction primitive).
@@ -130,13 +155,7 @@ def combine_rows(
     valid = idx < num_valid
 
     # ---- one grouping sort: (partition, key_hi, key_lo-as-unsigned) ----
-    pkey = jnp.where(valid, part.astype(jnp.int32), jnp.int32(num_parts))
-    sort_ops = (pkey,
-                jnp.where(valid, rows[:, 1], 0),
-                jnp.where(valid, rows[:, 0] ^ _FLIP, 0)) \
-        + tuple(rows[:, i] for i in range(W))
-    out = jax.lax.sort(sort_ops, num_keys=3, is_stable=True)
-    spart, srows = out[0], jnp.stack(out[3:], axis=1)
+    spart, srows, _ = keysort_rows(rows, part, num_valid, num_parts)
 
     # ---- segment starts: first valid row, or (partition, key) change ---
     key_eq = (srows[:, 0] == jnp.roll(srows[:, 0], 1)) \

@@ -181,6 +181,28 @@ def partition_and_pack(
     return send_rows, counts.astype(jnp.int32), parts_sorted
 
 
+def range_partition_words(key_lo: jnp.ndarray, key_hi: jnp.ndarray,
+                          bounds) -> jnp.ndarray:
+    """Device twin of :func:`range_partition` for int64 keys split into
+    transport words (lo, hi int32 — shuffle/reader.py format), x64-free.
+
+    ``bounds`` — host-side sorted int64 split points (tuple/ndarray,
+    static). partition = searchsorted(bounds, key, side='right') =
+    #(b <= key), computed as a broadcast signed-64 compare over the
+    (hi, lo-as-unsigned) word pairs. O(n x R) compares — the fused
+    one-pass form; fine for the few-thousand-partition range."""
+    import numpy as np
+    b = np.asarray(bounds, dtype=np.int64)
+    w = b.view(np.int32).reshape(-1, 2)         # little-endian [R-1, 2]
+    b_lo = jnp.asarray(w[:, 0])[None, :]
+    b_hi = jnp.asarray(w[:, 1])[None, :]
+    flip = jnp.int32(-0x80000000)               # unsigned compare of lo
+    lo = (key_lo ^ flip)[:, None]
+    hi = key_hi[:, None]
+    ge = (hi > b_hi) | ((hi == b_hi) & (lo >= (b_lo ^ flip)))
+    return ge.sum(axis=1).astype(jnp.int32)
+
+
 def range_partition(keys, bounds):
     """keys -> partition via sorted split points (TeraSort-style range
     partitioner: partition r holds keys in [bounds[r-1], bounds[r]) so

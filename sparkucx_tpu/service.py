@@ -58,9 +58,11 @@ class ShuffleService:
     # -- lifecycle (registerShuffle / unregisterShuffle / stop) -----------
     def register_shuffle(self, shuffle_id: int, num_maps: int,
                          num_partitions: int,
-                         partitioner: str = "hash") -> ShuffleHandle:
+                         partitioner: str = "hash",
+                         bounds=None) -> ShuffleHandle:
         return self.manager.register_shuffle(
-            shuffle_id, num_maps, num_partitions, partitioner)
+            shuffle_id, num_maps, num_partitions, partitioner,
+            bounds=bounds)
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         self.manager.unregister_shuffle(shuffle_id)
@@ -105,10 +107,12 @@ class ShuffleService:
     # -- reduce side (getReader) ------------------------------------------
     def read(self, handle: ShuffleHandle,
              timeout: Optional[float] = None,
-               combine: Optional[str] = None):
+             combine: Optional[str] = None,
+             ordered: bool = False):
         """Full exchange. arrow: list of per-partition RecordBatches;
         raw: the ShuffleReaderResult partition view. ``combine="sum"``
-        runs device combine-by-key (manager.read docstring)."""
+        runs device combine-by-key; ``ordered=True`` returns key-sorted
+        partitions (manager.read docstring)."""
         if self.io_format == "arrow":
             if combine:
                 raise ValueError(
@@ -117,15 +121,18 @@ class ShuffleService:
                     "the returned batches")
             from sparkucx_tpu.io.arrow import read_batches
             return read_batches(self.manager, handle,
-                                key_column=self.key_column, timeout=timeout)
-        return self.manager.read(handle, timeout=timeout, combine=combine)
+                                key_column=self.key_column, timeout=timeout,
+                                ordered=ordered)
+        return self.manager.read(handle, timeout=timeout, combine=combine,
+                                 ordered=ordered)
 
     def submit(self, handle: ShuffleHandle,
                timeout: Optional[float] = None,
-               combine: Optional[str] = None):
+               combine: Optional[str] = None,
+               ordered: bool = False):
         """Asynchronous raw read (shuffle/reader.py PendingShuffle)."""
         return self.manager.submit(handle, timeout=timeout,
-                                   combine=combine)
+                                   combine=combine, ordered=ordered)
 
 
 def connect(conf: Optional[Mapping[str, str]] = None, *,

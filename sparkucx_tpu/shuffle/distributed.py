@@ -186,11 +186,12 @@ def read_shuffle_distributed(
         ovf_global = bool(allgather_blob(
             np.array([1 if mine else 0], dtype=np.int64)).any())
         if not ovf_global:
-            if cur.combine or hier_mesh is not None:
+            if cur.combine or cur.ordered or hier_mesh is not None:
                 # SHARDED seg output — collect this process's rows:
-                # [1, R] own combined counts under combine, else [S, R]
+                # [1, R] own counts under combine/ordered, else [S, R]
                 # relay counts (hierarchical)
-                ns = 1 if cur.combine else hier_mesh.devices.shape[0]
+                ns = 1 if (cur.combine or cur.ordered) \
+                    else hier_mesh.devices.shape[0]
                 seg_host = _local_shards_of(seg, shard_ids, ns)
             else:
                 # flat uncombined: replicated [P, R] — any addressable

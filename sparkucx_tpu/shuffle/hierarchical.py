@@ -65,10 +65,13 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
     part_to_dest = _blocked_map(R, Pn)
     bounds = jnp.asarray(_device_bounds(R, Pn))   # [P+1] partition ranges
 
-    def part_fn(key_lo):
+    def part_fn(rows):
         if plan.partitioner == "direct":
-            return jnp.clip(key_lo, 0, R - 1)
-        return hash_partition(key_lo, R)
+            return jnp.clip(rows[:, 0], 0, R - 1)
+        if plan.partitioner == "range":
+            from sparkucx_tpu.ops.partition import range_partition_words
+            return range_partition_words(rows[:, 0], rows[:, 1], plan.bounds)
+        return hash_partition(rows[:, 0], R)
 
     def step(payload, nvalid):
         # payload [cap_in, W] int32, col 0 = key_lo; nvalid [1]
@@ -78,11 +81,11 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
             # index below since partition-major is not d'-major
             from sparkucx_tpu.ops.aggregate import combine_rows
             payload, _, n1 = combine_rows(
-                payload, part_fn(payload[:, 0]), n0, R,
+                payload, part_fn(payload), n0, R,
                 plan.combine_words, np.dtype(plan.combine_dtype),
                 plan.combine)
             n0 = n1[0]
-        g = jnp.take(part_to_dest, part_fn(payload[:, 0]))  # global shard
+        g = jnp.take(part_to_dest, part_fn(payload))  # global shard
 
         # stage 1 — ICI: group by destination device index d' = g % D
         send1, counts1 = destination_sort(
@@ -99,13 +102,16 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
         # With combine on, the relay MERGES same-key rows from its whole
         # slice first — the rows that shrink here are exactly the ones
         # that would otherwise cross DCN, the slow fabric.
-        part2 = part_fn(r1.data[:, 0])
+        part2 = part_fn(r1.data)
         if plan.combine:
             from sparkucx_tpu.ops.aggregate import combine_rows
             send2, rcounts2, _ = combine_rows(
                 r1.data, part2, r1.total[0], R, plan.combine_words,
                 np.dtype(plan.combine_dtype), plan.combine)
         else:
+            # ordered needs no key order at the relay either — the final
+            # stage fully re-sorts; the plain partition sort is cheaper
+            # and byte-identical downstream
             send2, rcounts2 = destination_sort(
                 r1.data, part2, r1.total[0], R, method=plan.sort_impl)
         d_mine = jax.lax.axis_index(ici_axis)
@@ -123,11 +129,16 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
             # seg matrix is this shard's own combined counts ([1, R])
             from sparkucx_tpu.ops.aggregate import combine_rows
             rows_out, pcounts, n_out = combine_rows(
-                r2.data, part_fn(r2.data[:, 0]), r2.total[0], R,
+                r2.data, part_fn(r2.data), r2.total[0], R,
                 plan.combine_words, np.dtype(plan.combine_dtype),
                 plan.combine)
             return rows_out, pcounts.reshape(1, R), \
                 n_out.astype(r2.total.dtype), overflow
+        if plan.ordered:
+            from sparkucx_tpu.ops.aggregate import keysort_rows
+            _, rows_out, pcounts = keysort_rows(
+                r2.data, part_fn(r2.data), r2.total[0], R)
+            return rows_out, pcounts.reshape(1, R), r2.total, overflow
 
         # receivers locate their runs with the relays' per-partition
         # counts: [S, R] per shard (relays share a device column, so the

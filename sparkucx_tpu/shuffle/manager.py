@@ -57,12 +57,19 @@ class ShuffleHandle:
     entry: ShuffleEntry = field(repr=False)
     partitioner: str = "hash"
     epoch: int = 0
+    # sorted int64 split points for partitioner="range" (Spark's
+    # RangePartitioner analog — the caller samples them, like Spark's
+    # reservoir sampling, and every process must pass the same tuple)
+    bounds: Optional[tuple] = None
 
     def __post_init__(self):
         if self.num_maps <= 0 or self.num_partitions <= 0:
             raise ValueError("num_maps and num_partitions must be positive")
-        if self.partitioner not in ("hash", "direct"):
+        if self.partitioner not in ("hash", "direct", "range"):
             raise ValueError(f"unknown partitioner {self.partitioner!r}")
+        if (self.partitioner == "range") != (self.bounds is not None):
+            raise ValueError(
+                "partitioner='range' requires bounds (and only it)")
 
 
 class TpuShuffleManager:
@@ -119,11 +126,24 @@ class TpuShuffleManager:
     # -- lifecycle --------------------------------------------------------
     def register_shuffle(self, shuffle_id: int, num_maps: int,
                          num_partitions: int,
-                         partitioner: str = "hash") -> ShuffleHandle:
+                         partitioner: str = "hash",
+                         bounds=None) -> ShuffleHandle:
         """Allocate the metadata table for a shuffle
         (ref: CommonUcxShuffleManager.scala:39-56). ``partitioner`` is the
         Spark Partitioner-SPI analog: 'hash' groups by key hash; 'direct'
-        treats keys as precomputed partition ids (range partitioning)."""
+        treats keys as precomputed partition ids; 'range' routes the full
+        int64 key through the sorted split points in ``bounds``
+        (device-evaluated — Spark's RangePartitioner)."""
+        if bounds is not None:
+            b = np.asarray(bounds, dtype=np.int64)
+            # validate HERE, not at read time: a malformed bounds tuple
+            # would otherwise publish silently-wrong size rows through the
+            # whole map phase before make_plan finally rejects it
+            if b.shape != (num_partitions - 1,) or (np.diff(b) < 0).any():
+                raise ValueError(
+                    f"range bounds must be {num_partitions - 1} sorted "
+                    f"int64 split points, got shape {b.shape}")
+            bounds = tuple(int(x) for x in b)
         entry = self.node.registry.register(shuffle_id, num_maps,
                                             num_partitions, partitioner)
         with self._lock:
@@ -132,7 +152,8 @@ class TpuShuffleManager:
                  "(table %d B)", shuffle_id, num_maps, num_partitions,
                  len(entry.table))
         return ShuffleHandle(shuffle_id, num_maps, num_partitions, entry,
-                             partitioner, self.node.epochs.current)
+                             partitioner, self.node.epochs.current,
+                             bounds)
 
     def get_writer(self, handle: ShuffleHandle,
                    map_id: int) -> MapOutputWriter:
@@ -145,7 +166,8 @@ class TpuShuffleManager:
                             partitioner=handle.partitioner,
                             faults=self.node.faults,
                             spill_dir=self.conf.spill_dir,
-                            spill_threshold=self.conf.spill_threshold)
+                            spill_threshold=self.conf.spill_threshold,
+                            bounds=handle.bounds)
         with self._lock:
             # First-commit-wins: a committed map output is immutable. A
             # speculative or retried map task may run again, but replacing
@@ -177,7 +199,8 @@ class TpuShuffleManager:
     # -- the read path ----------------------------------------------------
     def read(self, handle: ShuffleHandle,
              timeout: Optional[float] = None,
-             combine: Optional[str] = None) -> ShuffleReaderResult:
+             combine: Optional[str] = None,
+             ordered: bool = False) -> ShuffleReaderResult:
         """Execute the full exchange for a shuffle and return partitioned
         results (the getReader + fetch-everything path, SURVEY.md §3.4).
 
@@ -196,16 +219,18 @@ class TpuShuffleManager:
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
         if self.node.is_distributed:
-            # collective: every process must pass the same combine value
-            # (same SPMD discipline as calling read() at all)
-            return self._read_distributed(handle, timeout, combine=combine)
+            # collective: every process must pass the same combine/ordered
+            # values (same SPMD discipline as calling read() at all)
+            return self._read_distributed(handle, timeout, combine=combine,
+                                          ordered=ordered)
         with self.node.metrics.timeit("shuffle.read"):
-            return self._submit_local(handle, timeout,
-                                      combine=combine).result()
+            return self._submit_local(handle, timeout, combine=combine,
+                                      ordered=ordered).result()
 
     def submit(self, handle: ShuffleHandle,
                timeout: Optional[float] = None,
-               combine: Optional[str] = None):
+               combine: Optional[str] = None,
+               ordered: bool = False):
         """Asynchronous read: plan + pack on the host, DISPATCH the
         exchange, and return a :class:`shuffle.reader.PendingShuffle`
         without blocking — so the caller overlaps this shuffle's collective
@@ -224,10 +249,12 @@ class TpuShuffleManager:
                 "collective — every process must call read()")
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
-        return self._submit_local(handle, timeout, combine=combine)
+        return self._submit_local(handle, timeout, combine=combine,
+                                  ordered=ordered)
 
     def _submit_local(self, handle: ShuffleHandle, timeout: float,
-                      combine: Optional[str] = None):
+                      combine: Optional[str] = None,
+                      ordered: bool = False):
         tracer = self.node.tracer
         if not handle.entry.wait_complete(timeout):
             raise TimeoutError(
@@ -279,11 +306,11 @@ class TpuShuffleManager:
             dtype=np.int64)
         with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
             plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
-                             partitioner=handle.partitioner)
+                             partitioner=handle.partitioner,
+                             bounds=handle.bounds)
             plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
-        if combine:
-            plan = self._combined_plan(plan, combine, has_vals,
-                                       val_tail, val_dtype)
+        plan = self._decorated_plan(plan, combine, ordered, has_vals,
+                                    val_tail, val_dtype)
 
         # fuse key+value bytes into one int32 row matrix (bit views, no
         # value casts — jnp would silently truncate int64 with x64 off)
@@ -328,19 +355,23 @@ class TpuShuffleManager:
 
     # -- capacity learning -------------------------------------------------
     @staticmethod
-    def _combined_plan(plan: ShufflePlan, combine: str, has_vals: bool,
-                       val_tail, val_dtype) -> ShufflePlan:
-        """Validate and stamp the combine fields onto a plan (shared by
-        the single- and multi-process read paths)."""
+    def _decorated_plan(plan: ShufflePlan, combine, ordered: bool,
+                        has_vals: bool, val_tail, val_dtype) -> ShufflePlan:
+        """Validate and stamp the combine/ordered read options onto a
+        plan (shared by the single- and multi-process read paths).
+        combine implies ordered output, so it takes precedence."""
         import dataclasses
-
-        from sparkucx_tpu.ops.aggregate import check_combinable
-        check_combinable(val_tail if has_vals else None,
-                         val_dtype if has_vals else None, combine)
-        return dataclasses.replace(
-            plan, combine=combine,
-            combine_words=value_words(val_tail, val_dtype),
-            combine_dtype=np.dtype(val_dtype).str)
+        if combine:
+            from sparkucx_tpu.ops.aggregate import check_combinable
+            check_combinable(val_tail if has_vals else None,
+                             val_dtype if has_vals else None, combine)
+            return dataclasses.replace(
+                plan, combine=combine,
+                combine_words=value_words(val_tail, val_dtype),
+                combine_dtype=np.dtype(val_dtype).str)
+        if ordered:
+            return dataclasses.replace(plan, ordered=True)
+        return plan
 
     @staticmethod
     def _cap_key(handle: ShuffleHandle) -> tuple:
@@ -440,7 +471,8 @@ class TpuShuffleManager:
 
     # -- the multi-process read path --------------------------------------
     def _read_distributed(self, handle: ShuffleHandle, timeout: float,
-                          combine: Optional[str] = None):
+                          combine: Optional[str] = None,
+                          ordered: bool = False):
         """COLLECTIVE multi-process read (shuffle/distributed.py). Map
         outputs stay on this process's shards (Spark: outputs live on the
         writing executor's local disk); metadata crosses processes via
@@ -551,13 +583,13 @@ class TpuShuffleManager:
         validate_row_sizes(nvalid.reshape(1, -1))
         with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
             plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
-                             partitioner=handle.partitioner)
+                             partitioner=handle.partitioner,
+                             bounds=handle.bounds)
             # safe cross-process: every process runs the same collective
             # read sequence, so learned hints advance in lockstep
             plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
-        if combine:
-            plan = self._combined_plan(plan, combine, has_vals,
-                                       val_tail, val_dtype)
+        plan = self._decorated_plan(plan, combine, ordered, has_vals,
+                                    val_tail, val_dtype)
 
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)

@@ -48,6 +48,15 @@ class ShufflePlan:
     combine: Optional[str] = None
     combine_words: int = 0     # value width in int32 words (combine only)
     combine_dtype: str = ""    # np.dtype.str of the value (combine only)
+    # device key sort: partitions come back key-sorted (signed int64
+    # order) — the "sort" half of the reference reduce pipeline's stock
+    # aggregate+sort, without aggregation (TeraSort's shape). Implied by
+    # combine (combined output is already key-sorted).
+    ordered: bool = False
+    # sorted int64 split points for partitioner="range" (the Spark
+    # RangePartitioner analog, device-evaluated): static, so they are
+    # part of the compiled program and the jit-cache key.
+    bounds: Optional[Tuple[int, ...]] = None
 
     def grown(self) -> "ShufflePlan":
         """Next plan after an overflow: double the receive capacity."""
@@ -61,6 +70,7 @@ def make_plan(
     num_partitions: int,
     conf: Optional[TpuShuffleConf] = None,
     partitioner: str = "hash",
+    bounds=None,
 ) -> ShufflePlan:
     """Derive capacities from per-shard staged row counts.
 
@@ -73,8 +83,17 @@ def make_plan(
     cap_in = _round_up(int(np.max(shard_rows, initial=0)))
     balanced = total / max(num_shards, 1)
     cap_out = _round_up(int(np.ceil(balanced * conf.capacity_factor)))
-    if partitioner not in ("hash", "direct"):
+    if partitioner not in ("hash", "direct", "range"):
         raise ValueError(f"unknown partitioner {partitioner!r}")
+    if (partitioner == "range") != (bounds is not None):
+        raise ValueError("partitioner='range' needs bounds (and only it)")
+    if bounds is not None:
+        b = np.asarray(bounds, dtype=np.int64)
+        if b.shape != (num_partitions - 1,) or (np.diff(b) < 0).any():
+            raise ValueError(
+                f"range bounds must be {num_partitions - 1} sorted int64 "
+                f"split points, got shape {b.shape}")
+        bounds = tuple(int(x) for x in b)
     return ShufflePlan(
         num_shards=num_shards,
         num_partitions=num_partitions,
@@ -83,4 +102,5 @@ def make_plan(
         impl=conf.a2a_impl,
         partitioner=partitioner,
         sort_impl=conf.sort_impl,
+        bounds=bounds,
     )

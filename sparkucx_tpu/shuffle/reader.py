@@ -82,13 +82,17 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
     Pn = plan.num_shards
     bounds = jnp.asarray(_device_bounds(R, Pn))
 
-    def part_fn(key_lo):
+    def part_fn(rows):
         # pluggable partitioner (Spark's Partitioner SPI analog): hash for
-        # key-grouping shuffles, direct for pre-partitioned routing (range
-        # partitioners, TeraSort) where the key IS the partition id
+        # key-grouping shuffles; direct where the key IS the partition id;
+        # range = device-evaluated sorted split points over the full int64
+        # key (Spark's RangePartitioner; ops/partition.py)
         if plan.partitioner == "direct":
-            return jnp.clip(key_lo, 0, R - 1)
-        return hash_partition(key_lo, R)
+            return jnp.clip(rows[:, 0], 0, R - 1)
+        if plan.partitioner == "range":
+            from sparkucx_tpu.ops.partition import range_partition_words
+            return range_partition_words(rows[:, 0], rows[:, 1], plan.bounds)
+        return hash_partition(rows[:, 0], R)
 
     def dev_counts(rcounts):
         # per-device segment sizes = partition-count sums over each
@@ -99,7 +103,7 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
 
     def step(payload, nvalid):
         # payload [cap_in, width] int32, col 0 = key_lo; nvalid [1]
-        part = part_fn(payload[:, 0])
+        part = part_fn(payload)
         if plan.combine:
             # map-side combine: one row per distinct (partition, key)
             # enters the wire. Its grouping sort is (partition, key) —
@@ -111,6 +115,9 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
                 payload, part, nvalid[0], R, plan.combine_words,
                 np.dtype(plan.combine_dtype), plan.combine)
         else:
+            # ordered needs no key order on the SEND side: the receive
+            # stage fully re-sorts, so the plain (cheaper) partition sort
+            # produces byte-identical final output
             send, rcounts = destination_sort(payload, part, nvalid[0], R,
                                              method=plan.sort_impl)
 
@@ -123,17 +130,24 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
             # this shard's OWN combined counts ([1, R] per shard)
             from sparkucx_tpu.ops.aggregate import combine_rows
             rows_out, pcounts, n_out = combine_rows(
-                r.data, part_fn(r.data[:, 0]), r.total[0], R,
+                r.data, part_fn(r.data), r.total[0], R,
                 plan.combine_words, np.dtype(plan.combine_dtype),
                 plan.combine)
             return rows_out, pcounts.reshape(1, R), \
                 n_out.astype(r.total.dtype), r.overflow
+        if plan.ordered:
+            # one (partition, key) sort over the received rows yields
+            # fully key-sorted partitions — one run each ([1, R] seg)
+            from sparkucx_tpu.ops.aggregate import keysort_rows
+            _, rows_out, pcounts = keysort_rows(
+                r.data, part_fn(r.data), r.total[0], R)
+            return rows_out, pcounts.reshape(1, R), r.total, r.overflow
         # every receiver needs every sender's per-partition counts to
         # locate its runs; [P, R] int32 — negligible next to the payload
         seg = jax.lax.all_gather(rcounts, axis)
         return r.data, seg, r.total, r.overflow
 
-    seg_spec = P(axis) if plan.combine else P()
+    seg_spec = P(axis) if (plan.combine or plan.ordered) else P()
 
     # check_vma=False: the seg output is an all_gather result — genuinely
     # replicated, but the static varying-axes check cannot prove it
@@ -461,9 +475,9 @@ def submit_shuffle(
         lambda p: _build_step(mesh, axis, p, width),
         NamedSharding(mesh, P(axis)), plan, shard_rows, shard_nvalid,
         val_shape, val_dtype, on_done=on_done,
-        # combined output is one run per partition: the seg matrix is each
-        # shard's own [1, R] combined counts, sharded like the rows
-        per_shard_segs=bool(plan.combine))
+        # combined/ordered output is one run per partition: the seg matrix
+        # is each shard's own [1, R] counts, sharded like the rows
+        per_shard_segs=bool(plan.combine or plan.ordered))
 
 
 def read_shuffle(

@@ -144,11 +144,12 @@ class MapOutputWriter:
     def __init__(self, entry: ShuffleEntry, map_id: int,
                  pool: HostMemoryPool, partitioner: str = "hash",
                  faults=None, spill_dir: Optional[str] = None,
-                 spill_threshold: int = 0):
+                 spill_threshold: int = 0, bounds=None):
         self.entry = entry
         self.map_id = map_id
         self.pool = pool
         self.partitioner = partitioner
+        self.bounds = bounds  # range split points (partitioner="range")
         self.faults = faults  # runtime.failures.FaultInjector, site "publish"
         self._keys: List[np.ndarray] = []
         self._values: List[np.ndarray] = []
@@ -269,6 +270,12 @@ class MapOutputWriter:
                             f"ids in [0, {num_partitions}); got e.g. "
                             f"{bad.tolist()}")
                     parts = keys.astype(np.int64)
+                elif self.partitioner == "range":
+                    # host twin of ops/partition.range_partition_words —
+                    # searchsorted side='right' over the split points
+                    parts = np.searchsorted(
+                        np.asarray(self.bounds, dtype=np.int64), keys,
+                        side="right").astype(np.int64)
                 else:
                     parts = (_hash32_np(keys)
                              % np.uint32(num_partitions)).astype(np.int64)

@@ -1,0 +1,137 @@
+"""Ordered reads + the device range partitioner.
+
+``ordered=True`` returns key-sorted partitions computed on DEVICE (the
+"sort" half of the reference reduce pipeline's stock aggregate+sort,
+ref: compat/spark_2_4/UcxShuffleReader.scala:80-144, without the
+aggregation); ``partitioner="range"`` evaluates Spark's
+RangePartitioner-style split points inside the compiled step over the
+full int64 key (ops/partition.range_partition_words).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.ops.partition import range_partition_words
+from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+from sparkucx_tpu.shuffle.writer import _hash32_np
+
+
+def _mgr(**extra):
+    from sparkucx_tpu.runtime.node import TpuNode
+    conf = TpuShuffleConf(
+        {"spark.shuffle.tpu.a2a.impl": "dense", **extra}, use_env=False)
+    node = TpuNode.start(conf)
+    return TpuShuffleManager(node, conf), node
+
+
+def test_range_partition_words_matches_searchsorted():
+    rng = np.random.default_rng(0)
+    # keys spanning the signed range, bounds too (incl. exact-bound hits)
+    keys = rng.integers(-(1 << 62), 1 << 62, size=4096).astype(np.int64)
+    bounds = np.sort(rng.integers(-(1 << 62), 1 << 62, size=31)
+                     .astype(np.int64))
+    keys[:31] = bounds  # exact boundary keys: side='right' tie semantics
+    w = keys.view(np.int32).reshape(-1, 2)
+    got = np.asarray(jax.jit(
+        lambda lo, hi: range_partition_words(lo, hi, tuple(bounds)))(
+        jnp.asarray(w[:, 0]), jnp.asarray(w[:, 1])))
+    want = np.searchsorted(bounds, keys, side="right").astype(np.int32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ordered_read_sorted_partitions():
+    mgr, node = _mgr()
+    try:
+        R = 8
+        h = mgr.register_shuffle(61, 3, R)
+        rng = np.random.default_rng(3)
+        allk, allv = [], []
+        for m in range(3):
+            w = mgr.get_writer(h, m)
+            k = rng.integers(-1000, 1000, size=500).astype(np.int64)
+            v = np.stack([k, k * 2], axis=1).astype(np.int32)
+            w.write(k, v)
+            w.commit(R)
+            allk.append(k)
+            allv.append(v)
+        allk, allv = np.concatenate(allk), np.concatenate(allv)
+        parts = _hash32_np(allk) % R
+        res = mgr.read(h, ordered=True)
+        total = 0
+        for r, (gk, gv) in res.partitions():
+            wk = np.sort(allk[parts == r])
+            np.testing.assert_array_equal(gk, wk)   # signed order, dups kept
+            np.testing.assert_array_equal(gv[:, 0], gk.astype(np.int32))
+            total += len(gk)
+        assert total == len(allk)
+    finally:
+        mgr.stop()
+        node.close()
+
+
+def test_ordered_read_hierarchical():
+    mgr, node = _mgr(**{"spark.shuffle.tpu.mesh.numSlices": "2"})
+    try:
+        assert mgr.hierarchical
+        R = 16
+        h = mgr.register_shuffle(62, 4, R)
+        rng = np.random.default_rng(5)
+        allk = []
+        for m in range(4):
+            w = mgr.get_writer(h, m)
+            k = rng.integers(0, 1 << 35, size=400).astype(np.int64)
+            w.write(k)
+            w.commit(R)
+            allk.append(k)
+        allk = np.concatenate(allk)
+        parts = _hash32_np(allk) % R
+        res = mgr.read(h, ordered=True)
+        for r, (gk, _) in res.partitions():
+            np.testing.assert_array_equal(gk, np.sort(allk[parts == r]))
+    finally:
+        mgr.stop()
+        node.close()
+
+
+def test_range_partitioner_requires_bounds():
+    mgr, node = _mgr()
+    try:
+        with pytest.raises(ValueError, match="range"):
+            mgr.register_shuffle(63, 1, 4, partitioner="range")
+        with pytest.raises(ValueError, match="range"):
+            mgr.register_shuffle(64, 1, 4, bounds=(1, 2, 3))
+    finally:
+        mgr.stop()
+        node.close()
+
+
+def test_range_shuffle_end_to_end():
+    """Range routing device-side must agree with the host-published size
+    rows (searchsorted side='right' on both sides)."""
+    mgr, node = _mgr()
+    try:
+        R = 8
+        rng = np.random.default_rng(9)
+        keys = rng.integers(0, 1 << 50, size=3000).astype(np.int64)
+        bounds = np.sort(rng.choice(keys, size=R - 1, replace=False))
+        h = mgr.register_shuffle(65, 2, R, partitioner="range",
+                                 bounds=bounds)
+        for m in range(2):
+            w = mgr.get_writer(h, m)
+            w.write(keys[m::2])
+            w.commit(R)
+        res = mgr.read(h, ordered=True)
+        want_parts = np.searchsorted(bounds, keys, side="right")
+        total = 0
+        for r, (gk, _) in res.partitions():
+            np.testing.assert_array_equal(
+                gk, np.sort(keys[want_parts == r]))
+            total += len(gk)
+        assert total == len(keys)
+    finally:
+        mgr.stop()
+        node.close()

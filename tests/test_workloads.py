@@ -33,9 +33,17 @@ def test_groupby(manager):
     assert out["distinct_keys"] == 100
 
 
-def test_terasort(manager):
+def test_terasort_device_range_sorted(manager):
+    # the fully device-side pipeline: range routing AND per-partition key
+    # sort both happen inside the compiled step (ordered=True)
     out = run_terasort(manager, num_mappers=8, rows_per_mapper=1000,
-                       num_partitions=16)
+                       num_partitions=16, mode="range")
+    assert out["rows"] == 8000
+
+
+def test_terasort_direct_mode(manager):
+    out = run_terasort(manager, num_mappers=8, rows_per_mapper=1000,
+                       num_partitions=16, mode="direct", shuffle_id=9012)
     assert out["rows"] == 8000
 
 
