@@ -294,15 +294,17 @@ def stage_h2d(mon, jax):
 
 
 def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
-                 partitions_per_dev, sort_impl, impl):
+                 partitions_per_dev, sort_impl, impl, read_mode="plain",
+                 key_space=None):
+    import dataclasses
+
     import jax.numpy as jnp
     import numpy as np
     from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
 
-    from sparkucx_tpu.ops.partition import blocked_partition_map, \
-        destination_sort, hash_partition
-    from sparkucx_tpu.shuffle.alltoall import ragged_shuffle
+    from sparkucx_tpu.shuffle.plan import ShufflePlan
+    from sparkucx_tpu.shuffle.reader import step_body
 
     devs = jax.devices()
     nchips = len(devs)
@@ -312,26 +314,30 @@ def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
     cap_out = int(rows * 1.5)
     width = 2 + val_words                       # fused int32 row
     row_bytes = 4 * width
-    part_to_dest = blocked_partition_map(R, nchips)
-
-    def step(payload):
-        # the production hot path (shuffle/reader.py): route on key_lo,
-        # destination sort, one fused exchange, receive-side grouping
-        dest = jnp.take(part_to_dest, hash_partition(payload[:, 0], R))
-        send, counts = destination_sort(
-            payload, dest, payload.shape[0], nchips, method=sort_impl)
-        r = ragged_shuffle(send, counts, "shuffle",
-                           out_capacity=cap_out, impl=impl)
-        rows_out, _ = destination_sort(
-            r.data, hash_partition(r.data[:, 0], R), r.total[0], R,
-            method=sort_impl)
-        return rows_out, r.overflow
+    # the EXACT production pipeline (shuffle/reader.py step_body): route ->
+    # one partition-major sort -> ragged all-to-all; no receive-side sort
+    plan = ShufflePlan(num_shards=nchips, num_partitions=R, cap_in=rows,
+                       cap_out=cap_out, impl=impl, sort_impl=sort_impl)
+    if read_mode == "ordered":
+        plan = dataclasses.replace(plan, ordered=True)
+    elif read_mode == "combine":
+        plan = dataclasses.replace(plan, combine="sum",
+                                   combine_words=val_words,
+                                   combine_dtype="<i4")
+    step = step_body(plan, "shuffle")
 
     def make(k):
         def many(payload):
+            # nvalid is created INSIDE the trace (a literal): a closed-over
+            # concrete jnp array would be lifted to a hidden executable
+            # parameter that jax's C++ fastpath fails to re-supply on the
+            # SECOND call of the same compiled fn ("supplied 1 buffers but
+            # compiled program expected 4")
+            nvalid = jnp.full((1,), rows, jnp.int32)
+
             def body(carry, _):
                 carry = lax.optimization_barrier(carry)
-                out, ovf = step(carry)
+                out, _seg, _total, ovf = step(carry, nvalid)
                 # fold one received row back in: a real cross-iteration
                 # data dependency so XLA cannot hoist or dedupe the steps
                 carry = carry ^ lax.optimization_barrier(
@@ -341,13 +347,19 @@ def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
             return carry[0:1, 0], jnp.any(ovfs).reshape(1)
         return jax.jit(jax.shard_map(
             many, mesh=mesh, in_specs=(P("shuffle"),),
-            out_specs=(P("shuffle"), P("shuffle"))))
+            out_specs=(P("shuffle"), P("shuffle")), check_vma=False))
 
     rng = np.random.default_rng(0)
+    raw = rng.integers(0, 1 << 31, size=(nchips * rows, width),
+                       dtype=np.int64).astype(np.int32)
+    if key_space:
+        # aggregation shape: draw keys from a small vocabulary so combine
+        # actually merges (uniform 2^31 keys are all-distinct — that would
+        # measure pure combine overhead, not the WordCount-style win)
+        raw[:, 0] = raw[:, 0] % key_space
+        raw[:, 1] = 0
     payload = jax.device_put(
-        jnp.asarray(rng.integers(0, 1 << 31, size=(nchips * rows, width),
-                                 dtype=np.int64).astype(np.int32)),
-        jax.sharding.NamedSharding(mesh, P("shuffle")))
+        jnp.asarray(raw), jax.sharding.NamedSharding(mesh, P("shuffle")))
 
     def timed(k):
         fn = make(k)
@@ -382,6 +394,7 @@ def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
         "row_bytes": row_bytes,
         "partitions": R,
         "impl": impl,
+        "read_mode": read_mode,
         "step_ms": round(per_step * 1e3, 3),
         "t_small_ms": round(t_small * 1e3, 3),
         "t_large_ms": round(t_large * 1e3, 3),
@@ -389,7 +402,7 @@ def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
     }
 
 
-def stage_exchange(mon, jax, name, seconds, native_ok, **kw):
+def stage_exchange(mon, jax, name, seconds, native_ok, record=True, **kw):
     mon.begin(name, seconds)
     impl = "native" if native_ok else "dense"
     try:
@@ -397,7 +410,11 @@ def stage_exchange(mon, jax, name, seconds, native_ok, **kw):
     except Exception as e:
         mon.end(name, status="failed", error=str(e)[:300])
         return
-    mon.record_value(info.pop("GBps_per_chip"))
+    gbps = info.pop("GBps_per_chip")
+    if record:
+        mon.record_value(gbps)
+    else:
+        info["GBps_per_chip"] = gbps   # secondary metric: detail only
     mon.end(name, **info)
 
 
@@ -413,6 +430,11 @@ def main() -> None:
     ap.add_argument("--sort-impl", default="auto",
                     help="destination_sort method: auto|argsort|multisort|"
                          "counting (A/B the hot path)")
+    ap.add_argument("--read-mode", default="plain",
+                    choices=("plain", "ordered", "combine"),
+                    help="exchange flavor for the main stages (combine = "
+                         "device combine-by-key, ordered = key-sorted "
+                         "partitions)")
     ap.add_argument("--platform", default="auto",
                     choices=("auto", "tpu", "cpu"),
                     help="cpu forces the CPU backend via jax.config before "
@@ -465,13 +487,22 @@ def main() -> None:
             mon.end("h2d", status="failed", error=str(e)[:200])
 
     common = dict(val_words=args.val_words, sort_impl=args.sort_impl,
-                  partitions_per_dev=8)
+                  partitions_per_dev=8, read_mode=args.read_mode)
     stage_exchange(mon, jax, "exchange_small", 600, native_ok,
                    rows_log2=12, k1=1, k2=3, reps=1, **common)
     if not args.smoke:
         stage_exchange(mon, jax, "exchange_full", 1200, native_ok,
                        rows_log2=args.rows_log2 or 21, k1=2, k2=12,
                        reps=args.reps, **common)
+        if args.read_mode != "combine":
+            # secondary metric (detail only): device combine-by-key rate
+            # on a heavy-duplication aggregation shape (the WordCount
+            # headline); skipped when the main stages already ran combined
+            stage_exchange(mon, jax, "exchange_combine", 900, native_ok,
+                           rows_log2=args.rows_log2 or 21, k1=1, k2=5,
+                           reps=1, record=False,
+                           **{**common, "read_mode": "combine",
+                              "key_space": 100_000})
     elif args.rows_log2 and args.rows_log2 != 12:
         stage_exchange(mon, jax, "exchange_full", 600, native_ok,
                        rows_log2=args.rows_log2, k1=1, k2=3, reps=1,

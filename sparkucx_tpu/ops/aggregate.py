@@ -45,7 +45,10 @@ import numpy as np
 from sparkucx_tpu.ops.partition import counts_from_sorted
 
 COMBINERS = ("sum",)
-_FLIP = jnp.int32(-0x80000000)  # two's-complement 0x8000_0000
+# plain numpy, not jnp: a module-level jnp scalar would initialize the
+# backend at import time AND become a closed-over device constant (the
+# lifted-parameter fastpath hazard — see reader.step_body)
+_FLIP = np.int32(-0x80000000)   # two's-complement 0x8000_0000
 
 
 def check_combinable(val_tail, val_dtype, op: str) -> None:

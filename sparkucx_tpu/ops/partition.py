@@ -228,14 +228,21 @@ def sample_bounds(keys, num_partitions: int):
     return np.quantile(np.asarray(keys), qs).astype(np.asarray(keys).dtype)
 
 
-def blocked_partition_map(num_partitions: int, num_devices: int) -> jnp.ndarray:
+def blocked_partition_map(num_partitions: int, num_devices: int):
     """Default reduce-partition -> device assignment: contiguous blocks,
     remainder spread over the first partitions (Spark's grouping of reduce
-    partitions per executor)."""
+    partitions per executor).
+
+    Returns NUMPY int32, not jnp: callers close over this table inside
+    traced functions, and a concrete jnp array there becomes a lifted
+    executable parameter that jax's C++ fastpath fails to re-supply on
+    repeat calls of the same compiled fn (trace-time numpy inlines as a
+    literal instead). jnp ops accept it directly."""
+    import numpy as np
     base = num_partitions // num_devices
     rem = num_partitions % num_devices
     counts = [base + (1 if d < rem else 0) for d in range(num_devices)]
     out = []
     for d, c in enumerate(counts):
         out.extend([d] * c)
-    return jnp.asarray(out, dtype=jnp.int32)
+    return np.asarray(out, dtype=np.int32)

@@ -62,8 +62,12 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
     R = plan.num_partitions
     Pn = plan.num_shards
     assert Pn == S * D, (Pn, S, D)
-    part_to_dest = _blocked_map(R, Pn)
-    bounds = jnp.asarray(_device_bounds(R, Pn))   # [P+1] partition ranges
+    # numpy constants, not jnp: closed-over concrete jnp arrays become
+    # lifted executable parameters that the C++ fastpath fails to
+    # re-supply on repeat calls when traced inside a caller's scan
+    # (see reader.step_body)
+    part_to_dest = np.asarray(_blocked_map(R, Pn))
+    bounds = _device_bounds(R, Pn)                # [P+1] partition ranges
 
     def part_fn(rows):
         if plan.partitioner == "direct":

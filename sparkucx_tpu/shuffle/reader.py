@@ -61,12 +61,12 @@ def _device_bounds(num_partitions: int, num_devices: int) -> np.ndarray:
     return np.searchsorted(p2d, np.arange(num_devices + 1)).astype(np.int32)
 
 
-@functools.lru_cache(maxsize=64)
-def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
-    """Compile the exchange step for one (mesh, plan, row width).
+def step_body(plan: ShufflePlan, axis: str):
+    """The per-shard exchange step (call under shard_map over ``axis``).
 
-    lru_cache keys on the hashable plan — the jit-cache discipline that
-    keeps one compiled program per shape family.
+    Exposed separately from :func:`_build_step` so bench.py measures the
+    EXACT production pipeline (inside its own scan harness) rather than a
+    re-implementation that could drift.
 
     PARTITION-MAJOR design: the send side sorts by GLOBAL reduce-partition
     id. The blocked partition->device map is monotone, so one sort groups
@@ -80,7 +80,11 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
     same program)."""
     R = plan.num_partitions
     Pn = plan.num_shards
-    bounds = jnp.asarray(_device_bounds(R, Pn))
+    # numpy, NOT jnp: a closed-over concrete jnp array becomes a lifted
+    # executable parameter, which jax's C++ fastpath fails to re-supply on
+    # repeat calls when the step is traced inside a caller's scan (bench);
+    # a numpy constant inlines as a literal at trace time
+    bounds = _device_bounds(R, Pn)
 
     def part_fn(rows):
         # pluggable partitioner (Spark's Partitioner SPI analog): hash for
@@ -147,6 +151,17 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
         seg = jax.lax.all_gather(rcounts, axis)
         return r.data, seg, r.total, r.overflow
 
+    return step
+
+
+@functools.lru_cache(maxsize=64)
+def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
+    """Compile the exchange step for one (mesh, plan, row width).
+
+    lru_cache keys on the hashable plan — the jit-cache discipline that
+    keeps one compiled program per shape family. The pipeline itself is
+    :func:`step_body`."""
+    step = step_body(plan, axis)
     seg_spec = P(axis) if (plan.combine or plan.ordered) else P()
 
     # check_vma=False: the seg output is an all_gather result — genuinely
