@@ -66,6 +66,10 @@ class TransformerConfig:
     # activation HBM drops from O(layers x seq) to one layer boundary per
     # scan step, the standard FLOPs-for-memory trade on TPU — large models
     # are HBM-bound long before they are MXU-bound
+    compute_dtype: str = "float32"  # "bfloat16" = mixed precision: master
+    # params and the optimizer stay f32; activations and matmuls run in
+    # bf16 (the MXU's native width — 2x HBM bandwidth and MXU throughput),
+    # and the loss/softmax runs in f32 for stable reductions
 
 
 def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
@@ -134,15 +138,19 @@ def _ring_attn(q, k, v, sp_axis: str):
         v_nxt = jax.lax.ppermute(v_blk, sp_axis, perm)
         return (k_nxt, v_nxt, o, m, l), None
 
-    o0 = jnp.zeros_like(q)
-    m0 = jnp.full(q.shape[:-1], NEG_INF, q.dtype)
-    l0 = jnp.zeros(q.shape[:-1], q.dtype)
+    # Online-softmax accumulators in f32 regardless of compute dtype: the
+    # running denominator l sums thousands of exp terms, and bf16's 8
+    # mantissa bits silently drop any term below ~l/256 (q/k/v stay in
+    # compute dtype — bf16 dots accumulate in f32 on the MXU anyway)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
     (k_l, v_l, o, m, l), _ = jax.lax.scan(
         step, (k, v, o0, m0, l0), jnp.arange(p - 1))
     src = jax.lax.rem(idx + 1, p)
     bias = make_block_bias(t, t, idx * t, src * t, True)
     o, m, l = _block_update(q, k_l, v_l, o, m, l, bias, scale)
-    return _finalize(o, m, l)
+    return _finalize(o, m, l).astype(q.dtype)
 
 
 def _moe_ffn(lp, x, cfg: TransformerConfig, ep_axis: str, tp_axis: str):
@@ -154,7 +162,12 @@ def _moe_ffn(lp, x, cfg: TransformerConfig, ep_axis: str, tp_axis: str):
     e_local = cfg.num_experts // ep
     cap_out = max(8, int(n * cfg.capacity_factor))
 
-    logits = x @ lp["router"]                           # [n, E] (replicated)
+    # Routing decisions in f32 even under bf16 compute: the 1e-7 tie-break
+    # is below one bf16 ulp of any logit above ~1e-5 (it would round away
+    # and tied tokens would pile onto the lowest expert index), and the
+    # softmax denominator wants f32 anyway.
+    logits = (x.astype(jnp.float32)
+              @ lp["router"].astype(jnp.float32))       # [n, E] (replicated)
     probs = jax.nn.softmax(logits, axis=-1)
     # Deterministic tie-break that spreads equal logits uniformly over
     # experts. Without it, the pipeline's bubble lanes (all-zero activations)
@@ -163,8 +176,9 @@ def _moe_ffn(lp, x, cfg: TransformerConfig, ep_axis: str, tp_axis: str):
     E = cfg.num_experts
     tie = ((jnp.arange(n, dtype=jnp.int32)[:, None]
             + 31 * jnp.arange(E, dtype=jnp.int32)[None, :]) % E)
-    expert = jnp.argmax(logits + tie.astype(x.dtype) * 1e-7, axis=-1)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    expert = jnp.argmax(logits + tie.astype(jnp.float32) * 1e-7, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None],
+                               axis=1)[:, 0].astype(x.dtype)
 
     dest = (expert // e_local).astype(jnp.int32)
     order = jnp.argsort(dest, stable=True)
@@ -276,6 +290,19 @@ def _forward_shard(params, tokens, cfg: TransformerConfig):
     b, t = tokens.shape
     mb = b // M
 
+    # mixed precision: cast params + activations once at the boundary;
+    # master copies stay f32 in the optimizer (cfg.compute_dtype). The
+    # unembed is EXCLUDED: the logit matmul runs on genuine f32 master
+    # weights (a bf16 round-trip there would quantize both the logits and,
+    # through the astype VJP, their gradients)
+    cdt = jnp.dtype(cfg.compute_dtype)
+    params = {
+        k: (jax.tree_util.tree_map(
+            lambda p: p.astype(cdt)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, v)
+            if k != "unembed" else v)
+        for k, v in params.items()}
+
     h_all = jnp.take(params["embed"], tokens, axis=0)    # [b, t, D]
     h_mb = h_all.reshape(M, mb, t, cfg.d_model)
 
@@ -309,7 +336,10 @@ def _forward_shard(params, tokens, cfg: TransformerConfig):
     out_mb = jax.lax.psum(
         jnp.where(stage == S - 1, out_mb, jnp.zeros_like(out_mb)), pp)
     h_out = out_mb.reshape(b, t, cfg.d_model)
-    return h_out @ params["unembed"]                     # [b, t, V]
+    # unembed + everything downstream (softmax/loss) in f32: bf16 logits
+    # destabilize the log-sum-exp reduction (unembed is still the f32
+    # master copy — excluded from the boundary cast above)
+    return h_out.astype(jnp.float32) @ params["unembed"]  # [b, t, V]
 
 
 def forward(params, tokens, mesh: Mesh, cfg: TransformerConfig):
