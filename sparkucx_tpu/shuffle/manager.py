@@ -8,7 +8,7 @@ CommonUcxShuffleManager.scala:39-91):
   -------------                       ----
   registerShuffle(id, deps)        -> register_shuffle(id, num_maps, R)
   getWriter(handle, mapId)         -> get_writer(handle, map_id)
-  getReader(handle, partitions)    -> read(handle) / read_partition(...)
+  getReader(handle, partitions)    -> read(handle) / read_partitions(h, s, e)
   unregisterShuffle(id)            -> unregister_shuffle(id)
   stop()                           -> stop()
 
@@ -226,6 +226,32 @@ class TpuShuffleManager:
         with self.node.metrics.timeit("shuffle.read"):
             return self._submit_local(handle, timeout, combine=combine,
                                       ordered=ordered).result()
+
+    def read_partitions(self, handle: ShuffleHandle, start: int, end: int,
+                        timeout: Optional[float] = None,
+                        combine: Optional[str] = None,
+                        ordered: bool = False):
+        """Iterator of (r, (keys, values)) for reduce partitions
+        [start, end) — the reference SPI's partition-range getReader
+        (ref: compat/spark_3_0/UcxShuffleManager.scala:53-60 passes
+        startPartition/endPartition through to the reader). The exchange
+        itself is still ONE collective (the whole reduce side is one
+        batch); the range selects which host-side views to materialize —
+        in distributed mode, non-local partitions in the range are
+        skipped (the reducer contract)."""
+        # validate + run the collective EAGERLY, then hand out a generator
+        # over the result: a generator body would defer both to first
+        # next(), so bad ranges would escape try/except and a distributed
+        # caller that never iterates would leave peers hung in the
+        # all-to-all
+        if not (0 <= start <= end <= handle.num_partitions):
+            raise IndexError(
+                f"partition range [{start}, {end}) out of "
+                f"[0, {handle.num_partitions}]")
+        res = self.read(handle, timeout=timeout, combine=combine,
+                        ordered=ordered)
+        return ((r, res.partition(r)) for r in range(start, end)
+                if res.is_local(r))
 
     def submit(self, handle: ShuffleHandle,
                timeout: Optional[float] = None,

@@ -283,6 +283,11 @@ class ShuffleReaderResult:
     def _shard_rows(self, shard: int) -> np.ndarray:
         return self._rows[shard]
 
+    def is_local(self, r: int) -> bool:
+        """True when partition r is readable from this process (always, in
+        single-process mode; the distributed subclass restricts it)."""
+        return True
+
     def partition(self, r: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """(keys, values) of reduce partition r, densely packed."""
         shard = int(self._part_to_shard[r])

@@ -346,3 +346,23 @@ def test_submit_overflow_retries_to_result(manager):
     assert sum(k.size for _, (k, _) in res.partitions()) == M * N
     assert res.cap_out_used >= M * N
     manager.unregister_shuffle(32)
+
+
+def test_read_partitions_range(manager):
+    """Partition-range getReader analog: only [start, end) materializes."""
+    h = manager.register_shuffle(77, 2, 8)
+    rng = np.random.default_rng(1)
+    for m in range(2):
+        w = manager.get_writer(h, m)
+        k = rng.integers(0, 100, size=300).astype(np.int64)
+        w.write(k, np.stack([k, k], axis=1).astype(np.int32))
+        w.commit(8)
+    got = dict(manager.read_partitions(h, 2, 5))
+    assert sorted(got) == [2, 3, 4]
+    full = manager.read(h)
+    for r in (2, 3, 4):
+        np.testing.assert_array_equal(
+            np.sort(got[r][0]), np.sort(full.partition(r)[0]))
+    with pytest.raises(IndexError):
+        list(manager.read_partitions(h, 5, 9))
+    manager.unregister_shuffle(77)
