@@ -173,13 +173,23 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
 
 
 def pack_rows(keys: np.ndarray, values: Optional[np.ndarray],
-              width: int) -> np.ndarray:
+              width: int, out: Optional[np.ndarray] = None) -> np.ndarray:
     """Host-side fuse: int64 keys + arbitrary fixed-width values into an
-    int32 row matrix via bit views (never value casts)."""
+    int32 row matrix via bit views (never value casts).
+
+    ``out`` — optional [n, width] int32 destination (e.g. a pinned-arena
+    view): rows are written IN PLACE, skipping the temp allocation and the
+    second copy — the pack stage is host-memcpy-bound at spill scale."""
     n = keys.shape[0]
-    out = np.zeros((n, width), dtype=np.int32)
+    if out is None:
+        out = np.zeros((n, width), dtype=np.int32)
+        fresh = True
+    else:
+        assert out.shape == (n, width) and out.dtype == np.int32
+        fresh = False
     out[:, :KEY_WORDS] = np.ascontiguousarray(
         keys.astype(np.int64, copy=False)).view(np.int32).reshape(n, 2)
+    filled = KEY_WORDS
     if values is not None and n:
         vb = np.ascontiguousarray(values).view(np.uint8).reshape(n, -1)
         pad = (-vb.shape[1]) % 4
@@ -188,6 +198,9 @@ def pack_rows(keys: np.ndarray, values: Optional[np.ndarray],
                 [vb, np.zeros((n, pad), np.uint8)], axis=1)
         vw = vb.shape[1] // 4
         out[:, KEY_WORDS:KEY_WORDS + vw] = vb.view(np.int32).reshape(n, vw)
+        filled += vw
+    if not fresh and filled < width:
+        out[:, filled:] = 0   # recycled destination: clear slack columns
     return out
 
 
