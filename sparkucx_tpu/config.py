@@ -79,6 +79,56 @@ class TpuShuffleConf:
                     if _norm(key) not in self._index:
                         self._conf[key] = v
                         self._index[_norm(key)] = key
+        self.validate()
+
+    # All typed properties below, by name — validate() touches each so a
+    # malformed VALUE fails at construction, not deep inside a shuffle.
+    _TYPED_PROPS = (
+        "coordinator_address", "meta_buffer_size", "min_buffer_size",
+        "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
+        "spill_threshold", "spill_dir", "a2a_impl", "sort_impl",
+        "capacity_factor", "mesh_ici_axis", "mesh_dcn_axis", "num_slices",
+        "num_processes", "cores_per_process", "connection_timeout_ms")
+    # Namespace keys consumed OUTSIDE config.py (grep-verified), plus the
+    # prefix families. A spark.shuffle.tpu.* key matching none of these is
+    # a probable typo and gets a warning (not an error: a host engine may
+    # legitimately pass a newer/older key surface through — the reference
+    # rides inside SparkConf, which never rejects keys).
+    _EXTERNAL_KEYS = (
+        "a2a.hierarchical", "io.format", "io.keyColumn",
+        "trace.enabled", "trace.device", "trace.capacity",
+        "failure.maxAttempts", "failure.backoffMs", "fault.seed")
+    _KEY_FAMILIES = ("fault.",)
+
+    def validate(self) -> None:
+        """Fail fast on malformed values; warn on unknown namespace keys.
+
+        The reference defers every parse to first use (UcxShuffleConf is
+        lazy SparkConf sugar), which surfaces a typo'd size string only
+        mid-shuffle; here construction is the checkpoint."""
+        # touching every typed property both validates its value and, via
+        # the _seen_shorts hook in _get, collects the property-owned key
+        # names — no hand-maintained duplicate of the key surface
+        self._seen_shorts: set = set()
+        for name in self._TYPED_PROPS:
+            try:
+                getattr(self, name)
+            except ValueError as e:
+                raise ValueError(f"conf key for {name!r}: {e}") from e
+        known = {_norm(PREFIX + s)
+                 for s in set(self._EXTERNAL_KEYS) | self._seen_shorts}
+        self._seen_shorts = None
+        for key in self._conf:
+            if not key.startswith(PREFIX):
+                continue
+            short = key[len(PREFIX):]
+            if any(short.startswith(f) for f in self._KEY_FAMILIES):
+                continue
+            if _norm(key) not in known:
+                from sparkucx_tpu.utils.logging import get_logger
+                get_logger("config").warning(
+                    "unknown conf key %s (typo? known short keys: see "
+                    "TpuShuffleConf docstring)", key)
 
     # -- raw access -------------------------------------------------------
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
@@ -100,6 +150,8 @@ class TpuShuffleConf:
 
     # -- typed getters ----------------------------------------------------
     def _get(self, short: str, default) -> str:
+        if getattr(self, "_seen_shorts", None) is not None:
+            self._seen_shorts.add(short)   # validate() key-surface census
         full = PREFIX + short
         if full in self._conf:
             return self._conf[full]
@@ -115,7 +167,17 @@ class TpuShuffleConf:
         return float(self._get(short, default))
 
     def get_bool(self, short: str, default: bool) -> bool:
-        return str(self._get(short, default)).strip().lower() in ("1", "true", "yes", "on")
+        v = str(self._get(short, default)).strip().lower()
+        if v in ("1", "true", "yes", "on"):
+            return True
+        if v in ("0", "false", "no", "off"):
+            return False
+        # 'ture' silently meaning False would disable e.g. pinned arenas
+        # with no trace — exactly the mid-run surprise validate() exists
+        # to prevent
+        raise ValueError(
+            f"conf key {PREFIX}{short}={v!r} is not a boolean "
+            f"(want true/false/1/0/yes/no/on/off)")
 
     def get_bytes(self, short: str, default) -> int:
         return parse_bytes(self._get(short, default))

@@ -15,8 +15,9 @@ aggregation INTO the compiled exchange step, on both sides:
   (for aggregation workloads like WordCount this shrinks D2H by the
   duplication factor).
 
-Everything is sort + prefix-sum + gather — no scatter (XLA:TPU serializes
-colliding scatters; see ops/partition.counts_from_sorted). The grouping
+Everything is sort + prefix-sum — no scatter (XLA:TPU serializes colliding
+scatters; see ops/partition.counts_from_sorted) and no gather (a [2M]-row
+gather costs ~55 ms on v5e; carried sort operands are nearly free). The grouping
 sort is BY (partition, key), which is strictly finer than the
 partition-major exchange sort, so combining replaces that sort instead of
 adding one — and its output is key-sorted within each partition, which is
@@ -27,11 +28,13 @@ Key ordering: rows carry int64 keys as two int32 words [lo, hi]
 unsigned) compare equals signed int64 compare; the low word is flipped by
 0x8000_0000 so lax.sort's signed int32 compare orders it as unsigned.
 
-Numerics: segment sums are computed as exclusive-prefix-sum differences.
-Integers accumulate exactly (int32 lanes; the store back to a narrower
-declared dtype wraps, matching a cast). Floats accumulate in float32;
-very long prefixes can lose low-order bits versus a per-segment tree sum
-— the documented trade for a scatter-free one-pass formulation.
+Numerics: segment sums are computed as prefix-sum differences (inclusive
+prefix sums carried to segment-end rows, then first-differenced).
+Integers accumulate exactly (int32 lanes wrap mod 2^32, so differences
+stay exact; the store back to a narrower declared dtype wraps, matching
+a cast). Floats accumulate in float32; very long prefixes can lose
+low-order bits versus a per-segment tree sum — the documented trade for
+a scatter-free, gather-free one-pass formulation.
 """
 
 from __future__ import annotations
@@ -95,19 +98,6 @@ def keysort_rows(
     return spart, srows, counts_from_sorted(spart, num_parts)
 
 
-def _compact_true_positions(flags: jnp.ndarray) -> jnp.ndarray:
-    """Positions of True flags, densely packed first, ascending — via one
-    2-operand sort (the scatter-free compaction primitive).
-
-    Returns [cap] int32; entries past flags.sum() point at trailing False
-    positions (callers bound their reads by the true count)."""
-    cap = flags.shape[0]
-    idx = jnp.arange(cap, dtype=jnp.int32)
-    out = jax.lax.sort((jnp.where(flags, 0, 1).astype(jnp.int32), idx),
-                       num_keys=1, is_stable=True)
-    return out[1]
-
-
 def _words_to_vals(words: jnp.ndarray, vdt: np.dtype) -> jnp.ndarray:
     """Reinterpret [cap, vw] int32 transport words as the value dtype."""
     cap, vw = words.shape
@@ -160,40 +150,51 @@ def combine_rows(
     # ---- one grouping sort: (partition, key_hi, key_lo-as-unsigned) ----
     spart, srows, _ = keysort_rows(rows, part, num_valid, num_parts)
 
-    # ---- segment starts: first valid row, or (partition, key) change ---
+    # ---- segment ENDS: last valid row, or row before a (part, key)
+    # change. Ends (not starts) are the anchor because the inclusive
+    # prefix sum AT an end row, differenced against the previous end's,
+    # IS the segment sum — consecutive in sorted order, no index gather.
     key_eq = (srows[:, 0] == jnp.roll(srows[:, 0], 1)) \
         & (srows[:, 1] == jnp.roll(srows[:, 1], 1))
     part_eq = spart == jnp.roll(spart, 1)
     is_start = valid & ~(key_eq & part_eq)
     is_start = is_start.at[0].set(num_valid > 0)
     n_out = is_start.sum().astype(jnp.int32)
+    is_end = valid & (jnp.roll(is_start, -1) | (idx == num_valid - 1))
 
-    starts = _compact_true_positions(is_start)            # [cap]
-    j = jnp.arange(cap, dtype=jnp.int32)
-    next_start = jnp.take(starts, jnp.minimum(j + 1, cap - 1))
-    seg_end = jnp.where(j + 1 < n_out, next_start,
-                        num_valid.astype(jnp.int32))      # [cap]
-
-    # ---- per-segment value sums: exclusive-cumsum differences ----------
+    # ---- inclusive prefix sums of the (masked) values -------------------
     vals = _words_to_vals(srows[:, 2:2 + val_words_n], vdt)
     acc_dt = jnp.float32 if np.issubdtype(vdt, np.floating) else jnp.int32
     acc = jnp.where(valid[:, None], vals.astype(acc_dt), 0)
-    excl = jnp.concatenate(
-        [jnp.zeros((1, acc.shape[1]), acc.dtype),
-         jnp.cumsum(acc, axis=0)], axis=0)                # [cap+1, m]
-    seg_sum = (jnp.take(excl, seg_end, axis=0)
-               - jnp.take(excl, starts, axis=0)).astype(vals.dtype)
+    incl = jnp.cumsum(acc, axis=0)                        # [cap, m]
 
-    # ---- assemble output rows at the compacted positions ---------------
-    live = j < n_out
-    src = jnp.where(live, starts, 0)
-    key_cols = jnp.take(srows[:, :2], src, axis=0)        # [cap, 2]
+    # ---- compact end rows to the front, CARRYING their columns ----------
+    # One stable 1-key sort moves every segment-end row (keys, partition,
+    # prefix-sum lanes) to the front in (partition, key) order. Round-2
+    # lesson from the v5e: a [2M]-row gather costs ~55 ms while a carried
+    # multisort operand is nearly free — the previous formulation did FOUR
+    # such gathers (seg_end, starts, key_cols, spart) and spent 287 ms at
+    # 2M rows; this one does zero.
+    flag = jnp.where(is_end, 0, 1).astype(jnp.int32)
+    sort_ops = (flag, srows[:, 0], srows[:, 1], spart) \
+        + tuple(incl[:, t] for t in range(incl.shape[1]))
+    out = jax.lax.sort(sort_ops, num_keys=1, is_stable=True)
+    klo, khi, epart = out[1], out[2], out[3]
+    ends_incl = jnp.stack(out[4:], axis=1)                # [cap, m]
+
+    # ---- segment sums = first differences of end-row prefix sums --------
+    live = idx < n_out
+    prev = jnp.concatenate(
+        [jnp.zeros((1, ends_incl.shape[1]), ends_incl.dtype),
+         ends_incl[:-1]], axis=0)
+    seg_sum = jnp.where(live[:, None], ends_incl - prev, 0).astype(vals.dtype)
+
     words = _vals_to_words(seg_sum, vdt, val_words_n)
     rows_out = jnp.concatenate(
-        [key_cols, words,
+        [jnp.stack([klo, khi], axis=1), words,
          jnp.zeros((cap, W - 2 - val_words_n), jnp.int32)], axis=1)
     rows_out = jnp.where(live[:, None], rows_out, 0)
 
-    out_part = jnp.where(live, jnp.take(spart, src), jnp.int32(num_parts))
+    out_part = jnp.where(live, epart, jnp.int32(num_parts))
     pcounts = counts_from_sorted(out_part, num_parts)
     return rows_out, pcounts, n_out.reshape(1)

@@ -106,7 +106,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, mrun, lrun, *,
         o_ref[0] = (acc[...] / denom[:, None]).astype(o_ref.dtype)
         # logsumexp row for the backward recomputation; 0 for dead rows
         lse = jnp.where(l <= 0.0, 0.0, mrun[:, 0] + jnp.log(denom))
-        lse_ref[0] = lse
+        lse_ref[0, 0] = lse
 
 
 def _fwd_pallas(q, k, v, bq, bk, causal, scale, interpret, t_real):
@@ -117,8 +117,12 @@ def _fwd_pallas(q, k, v, bq, bk, causal, scale, interpret, t_real):
         nk=nk, t_real=t_real)
     return pl.pallas_call(
         kernel,
+        # lse rides as [BH, 1, T]: a 2-D [BH, T] output would need block
+        # (1, bq), whose sublane dim (1) violates Mosaic's (8, 128) tiling
+        # rule; with the unit middle axis the block's last two dims are
+        # (1, bq) where 1 == the array dim — the allowed "equal" escape
         out_shape=(jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-                   jax.ShapeDtypeStruct((BH, T), jnp.float32)),
+                   jax.ShapeDtypeStruct((BH, 1, T), jnp.float32)),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -126,7 +130,7 @@ def _fwd_pallas(q, k, v, bq, bk, causal, scale, interpret, t_real):
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=(pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-                   pl.BlockSpec((1, bq), lambda b, i, j: (b, i))),
+                   pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32),
                         pltpu.VMEM((bq, 1), jnp.float32)],
@@ -166,10 +170,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, dq_ref, dqa, *,
         mask = col < t_real
         if causal:
             mask &= col <= row
-        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)
         dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dlt_ref[0][:, None]) * scale
+        ds = p * (dp - dlt_ref[0, 0][:, None]) * scale
         dqa[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -210,13 +214,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref,
         mask = col < t_real
         if causal:
             mask &= col <= row
-        p = jnp.where(mask, jnp.exp(s - lse_ref[0][:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)
         dva[...] += jax.lax.dot_general(            # p^T @ g
             p, g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dlt_ref[0][:, None]) * scale
+        ds = p * (dp - dlt_ref[0, 0][:, None]) * scale
         dka[...] += jax.lax.dot_general(            # ds^T @ q
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -241,8 +245,8 @@ def _bwd_pallas(q, k, v, g, lse, delta, bq, bk, causal, scale, interpret,
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
@@ -259,8 +263,8 @@ def _bwd_pallas(q, k, v, g, lse, delta, bq, bk, causal, scale, interpret,
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, j)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, j)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, j)),
         ],
         out_specs=(pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
                    pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0))),
@@ -298,6 +302,14 @@ def _flash_call(q, k, v, block_q, block_k, causal, scale, interpret):
     bq = max(8, _pow2_floor(min(block_q, T)))
     bk = max(8, _pow2_floor(min(block_k, T)))
     tp = _round_up(T, max(bq, bk))
+    # Mosaic lane rule: the lse block's last dim (bq) must be divisible by
+    # 128 or equal the (padded) array dim. Small sequences collapse to one
+    # block; mid sizes clamp the q block up to 128.
+    if tp <= 128:
+        bq = bk = tp = _round_up(T, 8)
+    elif bq < 128:
+        bq = 128
+        tp = _round_up(T, max(bq, bk))
     qf = _pad_t(q.reshape(B * H, T, D), tp)
     kf = _pad_t(k.reshape(B * H, T, D), tp)
     vf = _pad_t(v.reshape(B * H, T, D), tp)
@@ -324,8 +336,9 @@ def _flash_fwd(q, k, v, block_q, block_k, causal, scale, interpret):
 def _flash_bwd(block_q, block_k, causal, scale, interpret, res, g):
     qf, kf, vf, out, lse, (B, H, T, D, bq, bk, tp) = res
     gf = _pad_t(g.reshape(B * H, T, D).astype(jnp.float32), tp)
-    # delta = rowsum(dO * O): cheap elementwise+reduce, stays in XLA
-    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)   # [BH, Tp]
+    # delta = rowsum(dO * O): cheap elementwise+reduce, stays in XLA.
+    # [BH, 1, Tp] to match the kernels' 3-D lse/delta block layout.
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)[:, None, :]
     dq, dk, dv = _bwd_pallas(qf, kf, vf, gf.astype(qf.dtype), lse, delta,
                              bq, bk, causal, scale, interpret, T)
     trim = lambda x: x[:, :T].reshape(B, H, T, D)

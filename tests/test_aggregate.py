@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.ops.aggregate import (
-    check_combinable, combine_rows, _compact_true_positions)
+    check_combinable, combine_rows)
 from sparkucx_tpu.shuffle.manager import TpuShuffleManager
 from sparkucx_tpu.shuffle.reader import pack_rows, value_words
 from sparkucx_tpu.shuffle.writer import _hash32_np
@@ -31,12 +31,6 @@ def _oracle_sums(keys, vals):
             out[k] = v.astype(np.int64) if \
                 np.issubdtype(v.dtype, np.integer) else v.copy()
     return out
-
-
-def test_compact_true_positions():
-    flags = jnp.asarray([False, True, False, True, True, False])
-    pos = np.asarray(_compact_true_positions(flags))
-    assert pos[:3].tolist() == [1, 3, 4]
 
 
 @pytest.mark.parametrize("vdtype,vtail", [

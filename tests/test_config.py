@@ -66,3 +66,35 @@ def test_env_camelcase_key(monkeypatch):
     assert TpuShuffleConf().capacity_factor == 1.25
     monkeypatch.setenv("SPARKUCX_TPU_MEMORY_MIN_BUFFER_SIZE", "2k")
     assert TpuShuffleConf().min_buffer_size == 2048
+
+
+def test_construction_rejects_malformed_values():
+    # fail-fast: a typo'd VALUE surfaces at construction, not mid-shuffle
+    with pytest.raises(ValueError, match="12qq"):
+        TpuShuffleConf({"spark.shuffle.tpu.memory.minBufferSize": "12qq"},
+                       use_env=False)
+    with pytest.raises(ValueError, match="capacity_factor"):
+        TpuShuffleConf({"spark.shuffle.tpu.a2a.capacityFactor": "abc"},
+                       use_env=False)
+
+
+def test_unknown_namespace_key_warns_not_raises(caplog, monkeypatch):
+    import logging
+    # the package root logger sets propagate=False; caplog captures via the
+    # real root's handler, so re-enable propagation for this test — AFTER
+    # forcing _configure(), which would otherwise reset the flag on the
+    # first in-test get_logger call and make this test order-dependent
+    from sparkucx_tpu.utils.logging import get_logger
+    get_logger("config")
+    monkeypatch.setattr(logging.getLogger("sparkucx_tpu"), "propagate", True)
+    with caplog.at_level(logging.WARNING, logger="sparkucx_tpu.config"):
+        TpuShuffleConf({"spark.shuffle.tpu.memory.minBufferSiz": "1k"},
+                       use_env=False)
+    assert any("unknown conf key" in r.message for r in caplog.records)
+    # foreign namespaces and the fault.* family pass silently
+    caplog.clear()
+    with caplog.at_level(logging.WARNING, logger="sparkucx_tpu.config"):
+        TpuShuffleConf({"spark.other.key": "x",
+                        "spark.shuffle.tpu.fault.exchange.failRate": "0.5"},
+                       use_env=False)
+    assert not [r for r in caplog.records if "unknown conf key" in r.message]
