@@ -97,8 +97,8 @@ class TpuShuffleConf:
     _EXTERNAL_KEYS = (
         "a2a.hierarchical", "io.format", "io.keyColumn",
         "trace.enabled", "trace.device", "trace.capacity",
-        "failure.maxAttempts", "failure.backoffMs", "fault.seed")
-    _KEY_FAMILIES = ("fault.",)
+        "failure.maxAttempts", "failure.backoffMs")
+    _KEY_FAMILIES = ("fault.",)   # covers fault.seed + per-site arming keys
 
     def validate(self) -> None:
         """Fail fast on malformed values; warn on unknown namespace keys.

@@ -4,9 +4,10 @@ The reference inherits its map-side partitioning entirely from Spark's
 SortShuffleManager (records hash-partitioned and sorted into per-reduce
 runs in the data file, ref: CommonUcxShuffleManager.scala:22 and the
 index-file layout consumed at OnOffsetsFetchCallback.java:44-52). Here the
-same work is expressed as array ops that XLA fuses: a mixing hash, a stable
-destination sort, and segment counts — producing exactly the
-destination-sorted send buffer + size row that
+same work is expressed as array ops that XLA fuses: a mixing hash, a
+destination-grouping sort (see :func:`destination_sort` for the per-method
+order contract — the TPU default is deliberately unstable), and segment
+counts — producing exactly the destination-grouped send buffer + size row that
 :func:`sparkucx_tpu.shuffle.alltoall.ragged_shuffle` consumes.
 
 Everything is static-shape: callers pass padded row buffers with a validity
@@ -66,14 +67,20 @@ def destination_sort(
     num_dests: int,
     method: str = "auto",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Stable-sort padded rows by destination; padding sorts last.
+    """Sort padded rows by destination; padding sorts last.
 
     rows      — [cap, ...] record buffer (leading row axis).
     dest      — [cap] destination id per row (ignored for padding).
     num_valid — scalar count of real rows (rows[num_valid:] are padding).
     num_dests — static destination count.
-    method    — hot-path formulation; all are bit-identical in output, they
-                differ only in how they map to the hardware:
+    method    — hot-path formulation. All methods agree on the grouping
+                contract — identical counts, identical per-destination row
+                MULTISETS — but intra-destination ORDER is method-defined:
+                argsort/counting preserve arrival order (stable),
+                multisort is unstable (deterministic, but reordered) for
+                a ~40% sort-cost win on TPU. The data plane only relies on
+                the grouping, exactly like the reference, whose blocks
+                arrive in network-delivery order:
         ``argsort``   — argsort the [cap] key then row-gather. The gather
                         moves whole padded lane tiles per row.
         ``multisort`` — one multi-operand ``lax.sort`` carrying every row
@@ -85,13 +92,14 @@ def destination_sort(
                         small destination counts.
         ``auto``      — backend-measured default (bench.py --sort-impl A/Bs
                         these; v5e 2M x 10-int32 rows, 8 dests: multisort
-                        8.5 ms vs argsort 56 ms vs counting 96 ms; XLA:CPU
-                        1M rows: counting 139 ms vs argsort 358 ms vs
-                        multisort 1557 ms): TPU/GPU -> multisort for 2-D
-                        rows (the sort network carries the columns, no
-                        row-gather of padded lane tiles); CPU -> counting
-                        for small dest counts. Falls back to argsort where
-                        the preferred form doesn't apply. Override via
+                        13.3 ms unstable / 22.1 ms stable vs argsort
+                        56+55 ms vs counting 96 ms; XLA:CPU 1M rows:
+                        counting 139 ms vs argsort 358 ms vs multisort
+                        1557 ms): TPU/GPU -> multisort for 2-D rows (the
+                        sort network carries the columns, no row-gather of
+                        padded lane tiles); CPU -> counting for small dest
+                        counts. Falls back to argsort where the preferred
+                        form doesn't apply. Override via
                         ``spark.shuffle.tpu.a2a.sortImpl``.
 
     Returns (sorted_rows [cap, ...], counts [num_dests]) where sorted_rows
@@ -125,7 +133,15 @@ def destination_sort(
         counts = counts_from_sorted(jnp.take(key, order), num_dests)
     elif method == "multisort":
         ops = (key,) + tuple(rows[:, i] for i in range(rows.shape[1]))
-        out = jax.lax.sort(ops, num_keys=1, is_stable=True)
+        # is_stable=False: measured on v5e at 2M x 10-int32 rows, the
+        # stability machinery is ~40% of the whole sort (22.1 ms stable vs
+        # 13.3 ms unstable — XLA:TPU's sort cost tracks effective key
+        # width, and stability widens the key by an implicit index). The
+        # shuffle contract never promises intra-partition arrival order —
+        # the reference's blocks land in whatever order the network
+        # delivers them (ref: reducer/OnBlocksFetchCallback.java:45-53) —
+        # so the weaker (still deterministic) order is the honest one.
+        out = jax.lax.sort(ops, num_keys=1, is_stable=False)
         sorted_rows = jnp.stack(out[1:], axis=1)
         counts = counts_from_sorted(out[0], num_dests)
     elif method == "counting":

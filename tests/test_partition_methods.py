@@ -1,9 +1,12 @@
-"""All destination_sort formulations must produce identical output.
+"""All destination_sort formulations must satisfy the grouping contract.
 
-The hot path exposes three mathematically identical groupings that map to
-the hardware differently (ops/partition.py); conf key
-``spark.shuffle.tpu.a2a.sortImpl`` flips between them after measuring.
-Correctness must not depend on the choice.
+The hot path exposes three groupings that map to the hardware differently
+(ops/partition.py); conf key ``spark.shuffle.tpu.a2a.sortImpl`` flips
+between them after measuring. The contract: identical counts and identical
+per-destination row MULTISETS. Intra-destination order is method-defined
+(multisort is deliberately unstable — the shuffle never promises arrival
+order, and stability costs ~40% of the TPU sort), so rows are compared
+per-destination-segment as sorted multisets, not positionally.
 """
 
 import jax
@@ -38,11 +41,27 @@ def test_methods_identical(method, num_dests, cap, nvalid):
                                       method=method))(rows, dest)
     np.testing.assert_array_equal(np.asarray(got_counts),
                                   np.asarray(want_counts))
-    # compare only the valid prefix: the padding tail's ORDER is
-    # unspecified (argsort keeps input order, counting scatters), but its
-    # rows beyond nvalid are never read by the data plane
-    np.testing.assert_array_equal(np.asarray(got_rows)[:nvalid],
-                                  np.asarray(want_rows)[:nvalid])
+    # compare each destination's segment as a sorted multiset (the
+    # grouping contract); rows beyond nvalid are padding the data plane
+    # never reads
+    got, want = np.asarray(got_rows), np.asarray(want_rows)
+    counts = np.asarray(want_counts)
+
+    def rowsort(seg):  # lexicographic ROW sort — true multiset compare
+        return seg[np.lexsort(seg.T[::-1])] if len(seg) else seg
+
+    start = 0
+    for d in range(num_dests):
+        seg_g, seg_w = got[start:start + counts[d]], want[start:start + counts[d]]
+        if method != "multisort":
+            # argsort/counting document STABLE order (arrival order within
+            # each destination) — pin it positionally; argsort is the
+            # reference here so this checks counting against it
+            np.testing.assert_array_equal(seg_g, seg_w, err_msg=f"dest {d}")
+        np.testing.assert_array_equal(rowsort(seg_g), rowsort(seg_w),
+                                      err_msg=f"dest {d}")
+        start += counts[d]
+    assert start == nvalid
 
 
 def test_counting_falls_back_for_many_dests():
