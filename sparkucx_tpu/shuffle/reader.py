@@ -120,8 +120,10 @@ def step_body(plan: ShufflePlan, axis: str):
                 np.dtype(plan.combine_dtype), plan.combine)
         else:
             # ordered needs no key order on the SEND side: the receive
-            # stage fully re-sorts, so the plain (cheaper) partition sort
-            # produces byte-identical final output
+            # stage fully re-sorts by (partition, key). Tie order among
+            # EQUAL keys is unspecified either way (keysort_rows is
+            # unstable), so the plain (cheaper) partition sort here loses
+            # nothing — the ordered contract is key order, not tie order.
             send, rcounts = destination_sort(payload, part, nvalid[0], R,
                                              method=plan.sort_impl)
 

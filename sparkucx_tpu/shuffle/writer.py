@@ -224,6 +224,11 @@ class MapOutputWriter:
     def _flush_to_disk(self) -> None:
         """Move staged arena batches to the spill files and return the
         arena blocks to the pool (the writer's RSS valve)."""
+        if self.faults is not None:
+            # armed via spark.shuffle.tpu.fault.spill.* — disk-full /
+            # IO-error drills for the spill valve, same surface as
+            # publish/fetch/exchange
+            self.faults.check("spill")
         if self._spill is None:
             self._spill = SpillFiles(self._spill_dir, self.entry.shuffle_id,
                                      self.map_id)

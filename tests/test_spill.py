@@ -98,3 +98,21 @@ def test_spill_mixed_schema_rejected(spill_manager, rng):
     with pytest.raises(ValueError, match="with and without"):
         w.write(np.arange(8, dtype=np.int64))
     m.unregister_shuffle(4)
+
+
+def test_spill_fault_site_armed(spill_manager, rng):
+    """The spill valve is a fault site: an armed spill.* knob fires
+    InjectedFault on the first flush (the disk-full drill), and the
+    writer surfaces it instead of silently keeping bytes in the arena."""
+    from sparkucx_tpu.runtime.failures import InjectedFault
+    m = spill_manager(extra={
+        "spark.shuffle.tpu.fault.spill.failCount": "1"})
+    h = m.register_shuffle(9, 1, 4)
+    w = m.get_writer(h, 0)
+    keys = rng.integers(0, 1 << 31, size=2000).astype(np.int64)
+    with pytest.raises(InjectedFault):
+        w.write(keys)                        # 16 kB > 4 kB threshold
+    # the injector is one-shot (failCount=1): the retry path works
+    w2 = m.get_writer(h, 0)
+    w2.write(keys)
+    w2.commit(4)
