@@ -14,6 +14,7 @@ from typing import Dict, Set, Tuple
 import numpy as np
 
 from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+from sparkucx_tpu.workloads.graphs import random_digraph
 
 
 def _shuffle_pairs(manager: TpuShuffleManager, shuffle_id: int,
@@ -43,9 +44,7 @@ def run_tc(manager: TpuShuffleManager, *, num_vertices: int = 40,
     """Returns {'edges', 'closure', 'iterations'}; verified against a
     numpy Floyd-Warshall-style oracle."""
     rng = np.random.default_rng(seed)
-    edges = np.unique(
-        rng.integers(0, num_vertices, size=(num_edges, 2)), axis=0)
-    edges = edges[edges[:, 0] != edges[:, 1]].astype(np.int64)
+    edges = random_digraph(rng, num_vertices, num_edges)
 
     closure: Set[Tuple[int, int]] = {tuple(e) for e in edges}
     sid = 8000

@@ -10,6 +10,7 @@ from sparkucx_tpu.runtime.node import TpuNode
 from sparkucx_tpu.shuffle.manager import TpuShuffleManager
 from sparkucx_tpu.workloads.als import run_als
 from sparkucx_tpu.workloads.groupby import run_groupby
+from sparkucx_tpu.workloads.pagerank import run_pagerank
 from sparkucx_tpu.workloads.tc import run_tc
 from sparkucx_tpu.workloads.terasort import run_terasort
 from sparkucx_tpu.workloads.wordcount import run_wordcount
@@ -94,3 +95,12 @@ def test_skewed_repartition_join(manager):
     assert out["output_rows"] > 0
     # the generator's whole point: hot partitions well above balanced
     assert out["skew_ratio"] > 2.0, out
+
+
+def test_pagerank_device_combine(manager):
+    # iterative same-shape shuffles with device combine-by-key each round;
+    # oracle check lives inside run_pagerank (raises on drift)
+    out = run_pagerank(manager, num_vertices=48, num_edges=300,
+                       num_partitions=8, num_mappers=4, iterations=8)
+    assert out["vertices"] == 48 and out["iterations"] == 8
+    assert out["max_err"] < 1e-3
