@@ -184,10 +184,37 @@ def main() -> int:
                 f"combined count wrong for key {kk}"
         ccheck += 1
 
+    # third job: ordered read over the RANGE partitioner — the TeraSort
+    # shape, distributed: each process's local partitions come back
+    # key-sorted, and partition ranges tile the keyspace so the global
+    # concatenation is fully sorted
+    # R-1 INTERIOR split points: every one of the R ranges holds a slice
+    # of [0, key_space), so no partition verifies only the empty case
+    bounds = np.linspace(0, key_space, R + 1)[1:-1].astype(np.int64)
+    ho = mgr.register_shuffle(9, num_maps, R, partitioner="range",
+                              bounds=bounds)
+    for m in my_maps:
+        w = mgr.get_writer(ho, m)
+        k, _ = map_data(m)
+        w.write(k)
+        w.commit(R)
+    reso = mgr.read(ho, ordered=True)
+    allko = np.concatenate([map_data(m)[0] for m in range(num_maps)])
+    edges = np.concatenate([[-(1 << 63)], bounds, [(1 << 63) - 1]])
+    ocheck = 0
+    for r, (gk, _) in reso.partitions():
+        assert list(gk) == sorted(gk), \
+            f"ordered partition {r} not sorted on process {proc_id}"
+        want = np.sort(allko[(allko >= edges[r]) & (allko < edges[r + 1])])
+        assert gk.tolist() == want.tolist(), \
+            f"ordered partition {r} contents wrong on process {proc_id}"
+        ocheck += 1
+
     mgr.stop()
     node.close()
     print(f"worker {proc_id}/{nprocs}: verified {checked} local "
-          f"partitions of {R} OK (+{ccheck} combined)", flush=True)
+          f"partitions of {R} OK (+{ccheck} combined, {ocheck} ordered)",
+          flush=True)
     return 0
 
 

@@ -155,3 +155,56 @@ def test_send_side_overflow_flagged(mesh8, rng, impl):
     buffers = rng.normal(size=(PDEV, cap_in)).astype(np.float32)
     _, _, _, ovf = run_shuffle(mesh8, buffers, sizes, impl, out_capacity=64)
     assert np.asarray(ovf).reshape(PDEV).all()
+
+
+def test_local_fastpath_single_shard(rng):
+    """On a 1-shard axis under impl='auto', ragged_shuffle takes the local
+    move (no collective in the compiled HLO) and matches the explicit
+    impls bit-for-bit: packed rows, zero tail, same overflow flag."""
+    from jax.sharding import Mesh
+
+    mesh1 = Mesh(np.array(jax.devices()[:1]).reshape(1), ("shuffle",))
+    cap, W, out_cap = 64, 3, 96
+    rows = rng.integers(0, 1 << 30, size=(cap, W)).astype(np.int32)
+    n = 41
+    sizes = np.array([n], np.int32)
+
+    def run(impl):
+        def f(data, sz):
+            r = ragged_shuffle(data, sz, "shuffle",
+                               out_capacity=out_cap, impl=impl)
+            return r.data, r.recv_sizes, r.total, r.overflow
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh1, in_specs=(P("shuffle"), P("shuffle")),
+            out_specs=(P("shuffle"),) * 4)), f
+
+    jf_auto, f_auto = run("auto")
+    got = jf_auto(jnp.asarray(rows), jnp.asarray(sizes))
+    want = run("dense")[0](jnp.asarray(rows), jnp.asarray(sizes))
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    # rows land packed from 0, zero past total
+    np.testing.assert_array_equal(np.asarray(got[0])[:n], rows[:n])
+    assert not np.asarray(got[0])[n:].any()
+    assert int(np.asarray(got[2])[0]) == n
+    assert not bool(np.asarray(got[3])[0])
+    # the compiled program contains NO collective — the local move
+    hlo = jax.jit(jax.shard_map(
+        f_auto, mesh=mesh1, in_specs=(P("shuffle"), P("shuffle")),
+        out_specs=(P("shuffle"),) * 4)).lower(
+            jax.ShapeDtypeStruct((cap, W), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32)).compile().as_text()
+    assert "all-to-all" not in hlo and "ragged-all-to-all" not in hlo
+
+    # overflow: total exceeding out_capacity flags, never truncates silently
+    big = np.array([out_cap + 1], np.int32)
+    cap2 = out_cap + 8
+    rows2 = rng.integers(0, 1 << 30, size=(cap2, W)).astype(np.int32)
+    def f2(data, sz):
+        r = ragged_shuffle(data, sz, "shuffle",
+                           out_capacity=out_cap, impl="auto")
+        return r.overflow
+    ovf = jax.jit(jax.shard_map(
+        f2, mesh=mesh1, in_specs=(P("shuffle"), P("shuffle")),
+        out_specs=P("shuffle")))(jnp.asarray(rows2), jnp.asarray(big))
+    assert bool(np.asarray(ovf)[0])
