@@ -108,3 +108,21 @@ def manager_factory(mesh8):
     for m, node in created:
         m.stop()
         node.close()
+
+
+@pytest.fixture(scope="module")
+def dense_manager():
+    """Module-scoped manager on the dense (portable) impl — the shared
+    lifecycle for suites that run many jobs against one manager
+    (test_workloads, test_fuzz_e2e)."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    m = TpuShuffleManager(node, conf)
+    yield m
+    m.stop()
+    node.close()

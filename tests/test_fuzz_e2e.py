@@ -1,84 +1,124 @@
 """Randomized end-to-end sweep: arbitrary shapes/schemas/read modes
 through the full manager lifecycle vs a host oracle.
 
-The targeted suites pin each feature; this sweep composes them randomly
-(the reference's only safety net at this altitude is running real Spark
-jobs, ref: buildlib/test.sh:162-172 — here the job generator is seeded
-and shrunk to the failing seed by construction)."""
+The targeted suites pin each feature; this sweep composes them randomly —
+key spaces with heavy duplication, every value schema, plain/ordered/
+combined reads, hash and range partitioners, zero-batch writers. (The
+reference's only safety net at this altitude is running real Spark jobs,
+ref: buildlib/test.sh:162-172 — here the job generator is seeded, so a
+failure names its seed.)"""
 
 import numpy as np
 import pytest
-
-from sparkucx_tpu.config import TpuShuffleConf
-from sparkucx_tpu.runtime.node import TpuNode
-from sparkucx_tpu.shuffle.manager import TpuShuffleManager
-
-
-@pytest.fixture(scope="module")
-def manager():
-    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense"},
-                          use_env=False)
-    node = TpuNode.start(conf)
-    m = TpuShuffleManager(node, conf)
-    yield m
-    m.stop()
-    node.close()
-
 
 VAL_SCHEMAS = ((None, None), (np.int32, ()), (np.int32, (3,)),
                (np.float32, (2,)), (np.int16, (5,)), (np.uint8, (4,)),
                (np.int64, (1,)))
 
 
-@pytest.mark.parametrize("seed", range(12))
+@pytest.fixture(scope="module")
+def manager(dense_manager):
+    return dense_manager
+
+
+@pytest.mark.parametrize("seed", range(16))
 def test_random_job_roundtrip(manager, seed):
     rng = np.random.default_rng(seed)
     M = int(rng.integers(1, 7))
     R = int(rng.integers(1, 20))
     vdt, vtail = VAL_SCHEMAS[int(rng.integers(0, len(VAL_SCHEMAS)))]
-    ordered = bool(rng.integers(0, 2))
-    h = manager.register_shuffle(40_000 + seed, M, R)
+    # ~half the seeds draw from a tiny key space: duplicate keys across
+    # rows AND maps exercise grouping/tie paths singletons never touch
+    key_lo, key_hi = ((0, 37) if rng.integers(0, 2)
+                      else (-(1 << 62), 1 << 62))
+    # read mode: plain / ordered / (value schemas only) device combine
+    combinable = (vdt is not None and np.dtype(vdt).itemsize <= 4
+                  and int(np.prod(vtail or (1,),
+                                  dtype=np.int64))
+                  * np.dtype(vdt).itemsize % 4 == 0)
+    mode = int(rng.integers(0, 3 if combinable else 2))
+    # partitioner: hash, or range over sorted split points
+    use_range = bool(rng.integers(0, 2))
+    reg_kw = {}
+    if use_range:
+        splits = np.sort(rng.integers(key_lo, key_hi,
+                                      size=max(R - 1, 1))[:R - 1])
+        reg_kw = {"partitioner": "range",
+                  "bounds": splits.astype(np.int64)}
 
-    oracle = {}
-    total = 0
-    for m in range(M):
-        w = manager.get_writer(h, m)
-        nbatches = int(rng.integers(0, 4))
-        for _ in range(nbatches):
-            n = int(rng.integers(0, 200))
-            keys = rng.integers(-(1 << 62), 1 << 62, size=n)
-            if vdt is None:
-                vals = None
-            elif np.issubdtype(vdt, np.floating):
-                vals = rng.normal(size=(n,) + vtail).astype(vdt)
-            else:
-                info = np.iinfo(vdt)
-                vals = rng.integers(info.min, info.max, size=(n,) + vtail)\
-                    .astype(vdt)
-            w.write(keys, vals)
-            for i, k in enumerate(keys):
-                rec = tuple(np.asarray(vals[i]).ravel().tolist()) \
-                    if vals is not None else ()
-                oracle.setdefault(int(k), []).append(rec)
-            total += n
-        w.commit(R)
+    sid = 40_000 + seed
+    h = manager.register_shuffle(sid, M, R, **reg_kw)
+    try:
+        oracle = {}
+        total = 0
+        for m in range(M):
+            w = manager.get_writer(h, m)
+            for _ in range(int(rng.integers(0, 4))):
+                n = int(rng.integers(0, 200))
+                keys = rng.integers(key_lo, key_hi, size=n)
+                if vdt is None:
+                    vals = None
+                elif np.issubdtype(vdt, np.floating):
+                    vals = rng.normal(size=(n,) + vtail).astype(vdt)
+                else:
+                    info = np.iinfo(vdt)
+                    vals = rng.integers(info.min, info.max,
+                                        size=(n,) + vtail).astype(vdt)
+                w.write(keys, vals)
+                for i, k in enumerate(keys):
+                    rec = tuple(np.asarray(vals[i]).ravel().tolist()) \
+                        if vals is not None else ()
+                    oracle.setdefault(int(k), []).append(rec)
+                total += n
+            w.commit(R)
 
-    res = manager.read(h, ordered=ordered)
-    got = {}
-    nrows = 0
-    prev_r = -1
-    for r, (ks, vs) in res.partitions():
-        assert r > prev_r
-        prev_r = r
-        if ordered:
-            assert list(ks) == sorted(ks), f"seed {seed}: partition {r}"
-        for i, k in enumerate(ks):
-            rec = tuple(np.asarray(vs[i]).ravel().tolist()) \
-                if vs is not None else ()
-            got.setdefault(int(k), []).append(rec)
-        nrows += len(ks)
-    assert nrows == total, f"seed {seed}: rows {nrows} != {total}"
-    assert set(got) == set(oracle), f"seed {seed}: key sets differ"
-    for k in oracle:
-        assert sorted(got[k]) == sorted(oracle[k]), f"seed {seed}, key {k}"
-    manager.unregister_shuffle(40_000 + seed)
+        if mode == 2:
+            res = manager.read(h, combine="sum")
+            acc_dt = (np.float64 if np.issubdtype(vdt, np.floating)
+                      else np.int64)
+            want = {k: np.sum(np.asarray(v, dtype=acc_dt), axis=0)
+                    for k, v in oracle.items()}
+            seen = set()
+            for r, (ks, vs) in res.partitions():
+                assert list(ks) == sorted(ks), f"seed {seed} part {r}"
+                for i, k in enumerate(ks):
+                    k = int(k)
+                    assert k not in seen, f"seed {seed}: dup key {k}"
+                    seen.add(k)
+                    got_v = np.asarray(vs[i], dtype=np.float64).ravel()
+                    # device sums wrap/round in the declared dtype
+                    want_v = np.asarray(want[k], dtype=acc_dt)\
+                        .astype(vdt).astype(np.float64).ravel()
+                    np.testing.assert_allclose(
+                        got_v, want_v, rtol=1e-4, atol=1e-4,
+                        err_msg=f"seed {seed}, key {k}")
+            assert seen == set(oracle), f"seed {seed}: key sets differ"
+            return
+
+        res = manager.read(h, ordered=(mode == 1))
+        got = {}
+        nrows = 0
+        prev_r = -1
+        prev_last = None
+        for r, (ks, vs) in res.partitions():
+            assert r > prev_r
+            prev_r = r
+            if mode == 1:
+                assert list(ks) == sorted(ks), f"seed {seed}: part {r}"
+                if use_range and len(ks):
+                    # range partitions tile the keyspace in order
+                    if prev_last is not None:
+                        assert ks[0] >= prev_last, f"seed {seed}: part {r}"
+                    prev_last = ks[-1]
+            for i, k in enumerate(ks):
+                rec = tuple(np.asarray(vs[i]).ravel().tolist()) \
+                    if vs is not None else ()
+                got.setdefault(int(k), []).append(rec)
+            nrows += len(ks)
+        assert nrows == total, f"seed {seed}: rows {nrows} != {total}"
+        assert set(got) == set(oracle), f"seed {seed}: key sets differ"
+        for k in oracle:
+            assert sorted(got[k]) == sorted(oracle[k]), \
+                f"seed {seed}, key {k}"
+    finally:
+        manager.unregister_shuffle(sid)

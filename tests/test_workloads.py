@@ -17,14 +17,8 @@ from sparkucx_tpu.workloads.wordcount import run_wordcount
 
 
 @pytest.fixture(scope="module")
-def manager(request):
-    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense"},
-                          use_env=False)
-    node = TpuNode.start(conf)
-    m = TpuShuffleManager(node, conf)
-    yield m
-    m.stop()
-    node.close()
+def manager(dense_manager):
+    return dense_manager
 
 
 def test_groupby(manager):
