@@ -131,6 +131,13 @@ def step_body(plan: ShufflePlan, axis: str):
                            out_capacity=plan.cap_out, impl=plan.impl)
 
         if plan.combine:
+            if Pn == 1:
+                # single shard: there is exactly one sender, so the
+                # map-side combine above already produced ONE row per
+                # (partition, key), key-sorted — a receive-side merge
+                # would re-sort the (1.5x larger) receive buffer to merge
+                # nothing. rcounts IS the per-partition output counts.
+                return r.data, rcounts.reshape(1, R), r.total, r.overflow
             # reduce-side combine: merge the per-sender segments' rows by
             # key before D2H — one run per partition, so the seg matrix is
             # this shard's OWN combined counts ([1, R] per shard)

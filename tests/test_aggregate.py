@@ -230,3 +230,66 @@ def test_combined_read_hierarchical():
     finally:
         mgr.stop()
         node.close()
+
+
+def test_combined_read_single_shard_skips_receive_merge():
+    """On a 1-shard exchange the step returns the map-side combine's rows
+    directly (there is nothing to merge); results must match the same
+    job's multi-shard oracle semantics, and the compiled HLO must contain
+    exactly ONE grouping sort chain (no second combine)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from sparkucx_tpu.shuffle.plan import ShufflePlan
+    from sparkucx_tpu.shuffle.reader import (pack_rows, step_body,
+                                             unpack_rows)
+
+    R, n = 8, 500
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 29, size=n)                 # heavy duplication
+    vals = rng.integers(-50, 50, size=(n, 2)).astype(np.int32)
+    width = 2 + 2
+    rows = pack_rows(keys.astype(np.int64), vals, width)
+    cap = 512
+    payload = np.zeros((cap, width), np.int32)
+    payload[:n] = rows
+
+    plan = ShufflePlan(num_shards=1, num_partitions=R, cap_in=cap,
+                       cap_out=768, impl="auto", combine="sum",
+                       combine_words=2, combine_dtype="<i4")
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("x",))
+    step = jax.jit(jax.shard_map(
+        step_body(plan, "x"), mesh=mesh1, in_specs=(P("x"), P("x")),
+        out_specs=(P("x"), P("x"), P("x"), P("x")), check_vma=False))
+    out_rows, seg, total, ovf = step(
+        jnp.asarray(payload), jnp.asarray(np.array([n], np.int32)))
+    assert not bool(np.asarray(ovf)[0])
+
+    want = {}
+    for k, v in zip(keys.tolist(), vals):
+        want[k] = want.get(k, 0) + v.astype(np.int64)
+    got_k, got_v = unpack_rows(
+        np.asarray(out_rows)[:int(np.asarray(total)[0])], (2,), np.int32)
+    assert len(got_k) == len(want)
+    from sparkucx_tpu.ops.partition import hash32
+    import jax.numpy as _jnp
+    parts = np.asarray(hash32(_jnp.asarray(got_k)) % np.uint32(R))
+    assert (np.diff(parts) >= 0).all(), "rows not partition-major"
+    for k, v in zip(got_k.tolist(), got_v):
+        np.testing.assert_array_equal(v.astype(np.int64), want[k])
+    # seg matrix row must equal per-partition combined counts
+    pc = np.asarray(seg).reshape(R)
+    counts = np.bincount(parts, minlength=R)
+    np.testing.assert_array_equal(pc, counts)
+    # exactly one combine chain: the map-side grouping + compaction sorts
+    # only (a receive-side merge would add two more "stablehlo.sort" ops)
+    txt = jax.jit(jax.shard_map(
+        step_body(plan, "x"), mesh=mesh1, in_specs=(P("x"), P("x")),
+        out_specs=(P("x"), P("x"), P("x"), P("x")),
+        check_vma=False)).lower(
+        jax.ShapeDtypeStruct((cap, width), jnp.int32),
+        jax.ShapeDtypeStruct((1,), jnp.int32)).as_text()
+    nsorts = txt.count("stablehlo.sort")
+    assert 0 < nsorts <= 2, \
+        f"expected 1-2 sorts (grouping + compaction), got {nsorts}"

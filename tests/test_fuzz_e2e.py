@@ -27,16 +27,15 @@ def test_random_job_roundtrip(manager, seed):
     M = int(rng.integers(1, 7))
     R = int(rng.integers(1, 20))
     vdt, vtail = VAL_SCHEMAS[int(rng.integers(0, len(VAL_SCHEMAS)))]
-    # ~half the seeds draw from a tiny key space: duplicate keys across
-    # rows AND maps exercise grouping/tie paths singletons never touch
-    key_lo, key_hi = ((0, 37) if rng.integers(0, 2)
-                      else (-(1 << 62), 1 << 62))
-    # read mode: plain / ordered / (value schemas only) device combine
+    # mode x key-space are STRATIFIED over the seed (not independently
+    # drawn) so every combination occurs — in particular combine WITH a
+    # tiny duplicate-heavy key space, where cross-row summation is real
+    key_lo, key_hi = ((0, 37) if seed % 2 else (-(1 << 62), 1 << 62))
     combinable = (vdt is not None and np.dtype(vdt).itemsize <= 4
                   and int(np.prod(vtail or (1,),
                                   dtype=np.int64))
                   * np.dtype(vdt).itemsize % 4 == 0)
-    mode = int(rng.integers(0, 3 if combinable else 2))
+    mode = (seed // 2) % 3 if combinable else seed % 2
     # partitioner: hash, or range over sorted split points
     use_range = bool(rng.integers(0, 2))
     reg_kw = {}
@@ -70,6 +69,14 @@ def test_random_job_roundtrip(manager, seed):
                         if vals is not None else ()
                     oracle.setdefault(int(k), []).append(rec)
                 total += n
+            if m == 0 and total == 0 and vdt is not None:
+                # combine needs a declared value schema, which the manager
+                # infers from non-empty writes — force one row
+                w.write(np.array([1], np.int64),
+                        np.ones((1,) + vtail, dtype=vdt))
+                oracle.setdefault(1, []).append(
+                    tuple(np.ones(int(np.prod(vtail or (1,)))).tolist()))
+                total += 1
             w.commit(R)
 
         if mode == 2:

@@ -5,9 +5,6 @@ cover the BASELINE.md benchmark configs."""
 import numpy as np
 import pytest
 
-from sparkucx_tpu.config import TpuShuffleConf
-from sparkucx_tpu.runtime.node import TpuNode
-from sparkucx_tpu.shuffle.manager import TpuShuffleManager
 from sparkucx_tpu.workloads.als import run_als
 from sparkucx_tpu.workloads.groupby import run_groupby
 from sparkucx_tpu.workloads.pagerank import run_pagerank
