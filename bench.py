@@ -545,6 +545,14 @@ def main() -> None:
                            reps=1, record=False,
                            **{**common, "read_mode": "combine",
                               "key_space": 100_000})
+        if args.read_mode == "plain":
+            # secondary metric (detail only): ordered (key-sorted
+            # partitions) rate — the TeraSort mode the BASELINE.md
+            # methodology is named after
+            stage_exchange(mon, jax, "exchange_ordered", 900, native_ok,
+                           rows_log2=args.rows_log2 or 21, k1=1, k2=5,
+                           reps=1, record=False,
+                           **{**common, "read_mode": "ordered"})
     elif args.rows_log2 and args.rows_log2 != 12:
         stage_exchange(mon, jax, "exchange_full", 600, native_ok,
                        rows_log2=args.rows_log2, k1=1, k2=3, reps=1,

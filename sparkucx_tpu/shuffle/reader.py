@@ -118,6 +118,13 @@ def step_body(plan: ShufflePlan, axis: str):
             send, rcounts, _ = combine_rows(
                 payload, part, nvalid[0], R, plan.combine_words,
                 np.dtype(plan.combine_dtype), plan.combine)
+        elif plan.ordered and Pn == 1:
+            # single shard: ONE sender means delivered rows keep send
+            # order, so doing the (partition, key) sort on the send side
+            # (cap_in rows) replaces the receive-side re-sort of the
+            # capacityFactor-larger receive buffer
+            from sparkucx_tpu.ops.aggregate import keysort_rows
+            _, send, rcounts = keysort_rows(payload, part, nvalid[0], R)
         else:
             # ordered needs no key order on the SEND side: the receive
             # stage fully re-sorts by (partition, key). Tie order among
@@ -149,6 +156,9 @@ def step_body(plan: ShufflePlan, axis: str):
             return rows_out, pcounts.reshape(1, R), \
                 n_out.astype(r.total.dtype), r.overflow
         if plan.ordered:
+            if Pn == 1:
+                # already (partition, key)-sorted on the send side above
+                return r.data, rcounts.reshape(1, R), r.total, r.overflow
             # one (partition, key) sort over the received rows yields
             # fully key-sorted partitions — one run each ([1, R] seg)
             from sparkucx_tpu.ops.aggregate import keysort_rows

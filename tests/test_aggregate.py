@@ -259,10 +259,10 @@ def test_combined_read_single_shard_skips_receive_merge():
                        cap_out=768, impl="auto", combine="sum",
                        combine_words=2, combine_dtype="<i4")
     mesh1 = Mesh(np.array(jax.devices()[:1]), ("x",))
-    step = jax.jit(jax.shard_map(
+    jitted = jax.jit(jax.shard_map(
         step_body(plan, "x"), mesh=mesh1, in_specs=(P("x"), P("x")),
         out_specs=(P("x"), P("x"), P("x"), P("x")), check_vma=False))
-    out_rows, seg, total, ovf = step(
+    out_rows, seg, total, ovf = jitted(
         jnp.asarray(payload), jnp.asarray(np.array([n], np.int32)))
     assert not bool(np.asarray(ovf)[0])
 
@@ -284,10 +284,7 @@ def test_combined_read_single_shard_skips_receive_merge():
     np.testing.assert_array_equal(pc, counts)
     # exactly one combine chain: the map-side grouping + compaction sorts
     # only (a receive-side merge would add two more "stablehlo.sort" ops)
-    txt = jax.jit(jax.shard_map(
-        step_body(plan, "x"), mesh=mesh1, in_specs=(P("x"), P("x")),
-        out_specs=(P("x"), P("x"), P("x"), P("x")),
-        check_vma=False)).lower(
+    txt = jitted.lower(
         jax.ShapeDtypeStruct((cap, width), jnp.int32),
         jax.ShapeDtypeStruct((1,), jnp.int32)).as_text()
     nsorts = txt.count("stablehlo.sort")

@@ -31,11 +31,15 @@ def test_random_job_roundtrip(manager, seed):
     # drawn) so every combination occurs — in particular combine WITH a
     # tiny duplicate-heavy key space, where cross-row summation is real
     key_lo, key_hi = ((0, 37) if seed % 2 else (-(1 << 62), 1 << 62))
+    mode = (seed // 2) % 3
     combinable = (vdt is not None and np.dtype(vdt).itemsize <= 4
                   and int(np.prod(vtail or (1,),
                                   dtype=np.int64))
                   * np.dtype(vdt).itemsize % 4 == 0)
-    mode = (seed // 2) % 3 if combinable else seed % 2
+    if mode == 2 and not combinable:
+        # a combine-slot seed must not silently demote to plain when the
+        # schema draw is uncombinable — swap in a combinable schema
+        vdt, vtail = np.int32, (2,)
     # partitioner: hash, or range over sorted split points
     use_range = bool(rng.integers(0, 2))
     reg_kw = {}

@@ -135,3 +135,50 @@ def test_range_shuffle_end_to_end():
     finally:
         mgr.stop()
         node.close()
+
+
+def test_ordered_single_shard_sorts_on_send():
+    """On a 1-shard exchange the (partition, key) sort happens once on
+    the SEND side (cap_in rows) and the receive stage adds no sort of the
+    capacityFactor-larger buffer: output is key-sorted per partition and
+    the compiled HLO carries exactly one sort."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from sparkucx_tpu.ops.partition import hash32
+    from sparkucx_tpu.shuffle.plan import ShufflePlan
+    from sparkucx_tpu.shuffle.reader import (pack_rows, step_body,
+                                             unpack_rows)
+
+    R, n, cap = 8, 400, 512
+    rng = np.random.default_rng(5)
+    keys = rng.integers(-(1 << 40), 1 << 40, size=n)
+    rows = pack_rows(keys.astype(np.int64), None, 2)
+    payload = np.zeros((cap, 2), np.int32)
+    payload[:n] = rows
+
+    plan = ShufflePlan(num_shards=1, num_partitions=R, cap_in=cap,
+                       cap_out=768, impl="auto", ordered=True)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("x",))
+    jitted = jax.jit(jax.shard_map(
+        step_body(plan, "x"), mesh=mesh1, in_specs=(P("x"), P("x")),
+        out_specs=(P("x"), P("x"), P("x"), P("x")), check_vma=False))
+    out_rows, seg, total, ovf = jitted(
+        jnp.asarray(payload), jnp.asarray(np.array([n], np.int32)))
+    assert not bool(np.asarray(ovf)[0])
+    got_k, _ = unpack_rows(
+        np.asarray(out_rows)[:int(np.asarray(total)[0])], None, None)
+    parts = np.asarray(hash32(jnp.asarray(got_k)) % np.uint32(R))
+    assert (np.diff(parts) >= 0).all(), "not partition-major"
+    for r in range(R):
+        seg_keys = got_k[parts == r]
+        assert list(seg_keys) == sorted(seg_keys), f"partition {r}"
+    assert sorted(got_k.tolist()) == sorted(keys.tolist())
+    np.testing.assert_array_equal(
+        np.asarray(seg).reshape(R), np.bincount(parts, minlength=R))
+    txt = jitted.lower(
+        jax.ShapeDtypeStruct((cap, 2), jnp.int32),
+        jax.ShapeDtypeStruct((1,), jnp.int32)).as_text()
+    nsorts = txt.count("stablehlo.sort")
+    assert nsorts == 1, f"expected exactly one sort, got {nsorts}"
