@@ -210,11 +210,37 @@ def main() -> int:
             f"ordered partition {r} contents wrong on process {proc_id}"
         ocheck += 1
 
+    # fourth job: PIPELINED distributed submits — two shuffles dispatched
+    # back-to-back (collective submit contract: same order everywhere),
+    # the second's pack overlapping the first's exchange; results
+    # consumed afterwards and verified against the plain job's truth
+    hp1 = mgr.register_shuffle(10, num_maps, R)
+    hp2 = mgr.register_shuffle(11, num_maps, R)
+    for hh in (hp1, hp2):
+        for m in my_maps:
+            w = mgr.get_writer(hh, m)
+            k, v = map_data(m)
+            w.write(k, v)
+            w.commit(R)
+    p1 = mgr.submit(hp1)
+    p2 = mgr.submit(hp2)          # dispatched before p1's result is read
+    pcheck = 0
+    for pending in (p1, p2):
+        resp = pending.result()
+        for r, (gk, gv) in resp.partitions():
+            wk = allk[parts == r]
+            got = sorted(zip(gk.tolist(), map(tuple, gv.tolist())))
+            want = sorted(zip(wk.tolist(),
+                              map(tuple, allv[parts == r].tolist())))
+            assert got == want, \
+                f"pipelined partition {r} mismatch on process {proc_id}"
+            pcheck += 1
+
     mgr.stop()
     node.close()
     print(f"worker {proc_id}/{nprocs}: verified {checked} local "
-          f"partitions of {R} OK (+{ccheck} combined, {ocheck} ordered)",
-          flush=True)
+          f"partitions of {R} OK (+{ccheck} combined, {ocheck} ordered, "
+          f"{pcheck} pipelined)", flush=True)
     return 0
 
 

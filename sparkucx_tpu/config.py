@@ -198,7 +198,7 @@ class TpuShuffleConf:
         messages fail loudly before the collective instead of stalling it —
         the role the fixed 4 KB bootstrap buffer plays in the reference
         (ref: UcxShuffleConf.scala:42-49, UcxListenerThread.java:34-39).
-        Enforced by TpuShuffleManager._read_distributed; default 64k allows
+        Enforced by TpuShuffleManager._submit_distributed; default 64k allows
         ~8000 map outputs per shuffle."""
         return self.get_bytes("meta.bufferSize", "64k")
 

@@ -41,7 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkucx_tpu.shuffle.plan import ShufflePlan
 from sparkucx_tpu.shuffle.reader import (
-    ShuffleReaderResult, _blocked_map, _build_step)
+    PendingExchangeBase, ShuffleReaderResult, _blocked_map, _build_step)
 from sparkucx_tpu.utils.logging import get_logger
 
 log = get_logger("shuffle.distributed")
@@ -155,58 +155,132 @@ def read_shuffle_distributed(
                    flat single collective, so each row crosses the slow
                    DCN links exactly once (shuffle/hierarchical.py)
     """
-    Pn = plan.num_shards
-    R = plan.num_partitions
-    L, cap_in, width = local_rows.shape
-    part_to_shard = np.asarray(_blocked_map(R, Pn))
-    if hier_mesh is not None:
-        from sparkucx_tpu.shuffle.hierarchical import _build_hier_step
-        spec = P((dcn_axis, axis))
-        sharding = NamedSharding(hier_mesh, spec)
-    else:
-        sharding = NamedSharding(mesh, P(axis))
+    return submit_shuffle_distributed(
+        mesh, axis, plan, local_rows, local_nvalid, shard_ids,
+        val_shape, val_dtype, hier_mesh=hier_mesh,
+        dcn_axis=dcn_axis).result()
 
-    cur = plan
-    for attempt in range(plan.max_retries + 1):
+
+class PendingDistributedShuffle(PendingExchangeBase):
+    """Future-like handle for an in-flight MULTI-PROCESS exchange.
+
+    Collective contract: every process must call submit (which dispatches
+    the SPMD step) and, later, ``result()`` — in the same order relative
+    to other collectives. Between the two calls each process is free to
+    pack the next shuffle or run any host work: XLA dispatch is already
+    asynchronous, so the collective rides the wire meanwhile (the
+    per-executor fetch/compute overlap of the reference's non-blocking
+    ``ucp_get`` storm, ref: UcxShuffleClient.java (3.0):95-127).
+
+    ``done()`` is a LOCAL, non-collective poll (this process's outputs
+    computed); the overflow verdict and any retry live in ``result()``,
+    because they require the cross-process allgather. Lifecycle
+    (exactly-once on_done, abandonment release, result caching) comes
+    from :class:`sparkucx_tpu.shuffle.reader.PendingExchangeBase`."""
+
+    def __init__(self, mesh, axis, plan, local_rows, local_nvalid,
+                 shard_ids, val_shape, val_dtype, hier_mesh, dcn_axis,
+                 on_done=None):
+        self._mesh, self._axis = mesh, axis
+        self._plan = plan
+        self._local_rows, self._local_nvalid = local_rows, local_nvalid
+        self._shard_ids = list(shard_ids)
+        self._val_shape, self._val_dtype = val_shape, val_dtype
+        self._hier_mesh, self._dcn_axis = hier_mesh, dcn_axis
+        L, cap_in, width = local_rows.shape
+        self._L, self._cap_in, self._width = L, cap_in, width
         if hier_mesh is not None:
-            step = _build_hier_step(hier_mesh, dcn_axis, axis, cur, width)
+            self._sharding = NamedSharding(hier_mesh, P((dcn_axis, axis)))
         else:
-            step = _build_step(mesh, axis, cur, width)
+            self._sharding = NamedSharding(mesh, P(axis))
+        self._result = None
+        self._attempt = 0
+        self._on_done = None
+        self._dispatch()
+        self._on_done = on_done
+
+    def _dispatch(self):
+        cur = self._plan
+        if self._hier_mesh is not None:
+            from sparkucx_tpu.shuffle.hierarchical import _build_hier_step
+            step = _build_hier_step(self._hier_mesh, self._dcn_axis,
+                                    self._axis, cur, self._width)
+        else:
+            step = _build_step(self._mesh, self._axis, cur, self._width)
         payload = jax.make_array_from_process_local_data(
-            sharding, local_rows.reshape(L * cap_in, width))
+            self._sharding,
+            self._local_rows.reshape(self._L * self._cap_in, self._width))
         nvalid = jax.make_array_from_process_local_data(
-            sharding, local_nvalid.astype(np.int32).reshape(L))
-        rows_out, seg, total, ovf = step(payload, nvalid)
-        # The retry decision must be identical on every process or the
-        # SPMD group diverges. The flat exchange's flag is a mesh-wide
-        # psum, but the hierarchical flag (r1|r2) is only uniform within a
-        # slice — so allgather the local verdicts and OR them globally.
-        mine = any(bool(np.asarray(s.data).any())
-                   for s in ovf.addressable_shards)
-        ovf_global = bool(allgather_blob(
-            np.array([1 if mine else 0], dtype=np.int64)).any())
-        if not ovf_global:
-            if cur.combine or cur.ordered or hier_mesh is not None:
-                # SHARDED seg output — collect this process's rows:
-                # [1, R] own counts under combine/ordered, else [S, R]
-                # relay counts (hierarchical)
-                ns = 1 if (cur.combine or cur.ordered) \
-                    else hier_mesh.devices.shape[0]
-                seg_host = _local_shards_of(seg, shard_ids, ns)
-            else:
-                # flat uncombined: replicated [P, R] — any addressable
-                # copy is the whole matrix (np.asarray rejects
-                # multi-process arrays)
-                seg_host = np.asarray(seg.addressable_shards[0].data)
-            res = DistributedReaderResult(
-                R, part_to_shard, shard_ids,
-                _local_shards_of(rows_out, shard_ids, cur.cap_out),
-                seg_host, val_shape, val_dtype)
-            res.cap_out_used = cur.cap_out
-            return res
-        log.info("distributed shuffle overflow at cap_out=%d (attempt %d)",
-                 cur.cap_out, attempt)
-        cur = cur.grown()
-    raise RuntimeError(
-        f"shuffle still overflowing after {plan.max_retries} retries "
-        f"(cap_out={cur.cap_out}); extreme skew — repartition the data")
+            self._sharding,
+            self._local_nvalid.astype(np.int32).reshape(self._L))
+        self._out = step(payload, nvalid)
+
+    def _result_inner(self):
+        # COLLECTIVE: every process must reach result() — it allgathers
+        # the overflow verdict and retries in lockstep.
+        R = self._plan.num_partitions
+        Pn = self._plan.num_shards
+        part_to_shard = np.asarray(_blocked_map(R, Pn))
+        while True:
+            cur = self._plan
+            rows_out, seg, total, ovf = self._out
+            # The retry decision must be identical on every process or
+            # the SPMD group diverges. The flat exchange's flag is a
+            # mesh-wide psum, but the hierarchical flag (r1|r2) is only
+            # uniform within a slice — so allgather the local verdicts
+            # and OR them globally.
+            mine = any(bool(np.asarray(s.data).any())
+                       for s in ovf.addressable_shards)
+            ovf_global = bool(allgather_blob(
+                np.array([1 if mine else 0], dtype=np.int64)).any())
+            if not ovf_global:
+                if cur.combine or cur.ordered or self._hier_mesh is not None:
+                    # SHARDED seg output — collect this process's rows:
+                    # [1, R] own counts under combine/ordered, else
+                    # [S, R] relay counts (hierarchical)
+                    ns = 1 if (cur.combine or cur.ordered) \
+                        else self._hier_mesh.devices.shape[0]
+                    seg_host = _local_shards_of(seg, self._shard_ids, ns)
+                else:
+                    # flat uncombined: replicated [P, R] — any addressable
+                    # copy is the whole matrix (np.asarray rejects
+                    # multi-process arrays)
+                    seg_host = np.asarray(seg.addressable_shards[0].data)
+                res = DistributedReaderResult(
+                    R, part_to_shard, self._shard_ids,
+                    _local_shards_of(rows_out, self._shard_ids,
+                                     cur.cap_out),
+                    seg_host, self._val_shape, self._val_dtype)
+                res.cap_out_used = cur.cap_out
+                return res
+            if self._attempt >= self._plan.max_retries:
+                raise RuntimeError(
+                    f"shuffle still overflowing after "
+                    f"{self._plan.max_retries} retries "
+                    f"(cap_out={cur.cap_out}); extreme skew — repartition "
+                    f"the data")
+            log.info("distributed shuffle overflow at cap_out=%d "
+                     "(attempt %d)", cur.cap_out, self._attempt)
+            self._plan = cur.grown()
+            self._attempt += 1
+            self._dispatch()
+
+
+def submit_shuffle_distributed(
+    mesh: Mesh,
+    axis: str,
+    plan: ShufflePlan,
+    local_rows: np.ndarray,
+    local_nvalid: np.ndarray,
+    shard_ids: Sequence[int],
+    val_shape: Optional[Tuple[int, ...]],
+    val_dtype,
+    hier_mesh: Optional[Mesh] = None,
+    dcn_axis: Optional[str] = None,
+    on_done=None,
+) -> PendingDistributedShuffle:
+    """Dispatch the multi-process exchange without blocking (collective:
+    see :class:`PendingDistributedShuffle`)."""
+    return PendingDistributedShuffle(
+        mesh, axis, plan, local_rows, local_nvalid, shard_ids,
+        val_shape, val_dtype, hier_mesh, dcn_axis, on_done=on_done)

@@ -221,8 +221,10 @@ class TpuShuffleManager:
         if self.node.is_distributed:
             # collective: every process must pass the same combine/ordered
             # values (same SPMD discipline as calling read() at all)
-            return self._read_distributed(handle, timeout, combine=combine,
-                                          ordered=ordered)
+            with self.node.metrics.timeit("shuffle.read"):
+                return self._submit_distributed(
+                    handle, timeout, combine=combine,
+                    ordered=ordered).result()
         with self.node.metrics.timeit("shuffle.read"):
             return self._submit_local(handle, timeout, combine=combine,
                                       ordered=ordered).result()
@@ -264,17 +266,18 @@ class TpuShuffleManager:
         fetch/compute overlap of the reference's lazy-progress iterator,
         ref: compat/spark_3_0/UcxShuffleReader.scala:54-98).
 
-        Single-process only: the multi-process read is a collective whose
-        overflow consensus requires every process in the loop — call
-        :meth:`read` there."""
+        Multi-process: submit() is COLLECTIVE, like read() — every
+        process must call submit() and later result() in the same order.
+        done() stays a local poll; the overflow consensus (and any retry)
+        runs inside result(), where all processes are present."""
         self.node.epochs.validate(handle.epoch,
                                   f"shuffle {handle.shuffle_id}")
-        if self.node.is_distributed:
-            raise NotImplementedError(
-                "submit() is single-process; the multi-process read is a "
-                "collective — every process must call read()")
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
+        if self.node.is_distributed:
+            return self._submit_distributed(handle, timeout,
+                                            combine=combine,
+                                            ordered=ordered)
         return self._submit_local(handle, timeout, combine=combine,
                                   ordered=ordered)
 
@@ -355,12 +358,19 @@ class TpuShuffleManager:
                 self._learn_cap(handle, result, int(nvalid.sum()))
                 self.node.metrics.inc("shuffle.rows", float(nvalid.sum()))
 
-        # anything that fails BEFORE the pending handle owns on_done (the
-        # fault site, compile errors inside the first dispatch) must not
-        # strand the pinned pack buffer
+        # Buffer ownership: until a pending handle exists, failures here
+        # (the fault site, compile errors inside the first dispatch) must
+        # release the pinned pack buffer; once the handle is armed it is
+        # the SOLE owner (its exactly-once on_done releases), so a late
+        # exception — e.g. out of the span __exit__ — must NOT also put,
+        # or two shuffles would end up sharing one arena block.
+        pending = None
         try:
             self.node.faults.check("exchange")
-            with tracer.span("shuffle.exchange",
+            # span covers DISPATCH only — the exchange itself completes
+            # asynchronously inside result() (read() wraps that wait in
+            # metrics "shuffle.read")
+            with tracer.span("shuffle.dispatch",
                              shuffle_id=handle.shuffle_id,
                              rows=int(nvalid.sum()), width=width,
                              hierarchical=self.hierarchical):
@@ -368,15 +378,19 @@ class TpuShuffleManager:
                 if self.hierarchical:
                     from sparkucx_tpu.shuffle.hierarchical import \
                         submit_shuffle_hierarchical
-                    return submit_shuffle_hierarchical(
+                    pending = submit_shuffle_hierarchical(
                         self.node.mesh, self.conf.mesh_dcn_axis, self.axis,
                         plan, shard_rows, nvalid, vt, val_dtype,
                         on_done=on_done)
-                return submit_shuffle(self.exchange_mesh, self.axis, plan,
-                                      shard_rows, nvalid, vt, val_dtype,
-                                      on_done=on_done)
+                else:
+                    pending = submit_shuffle(
+                        self.exchange_mesh, self.axis, plan,
+                        shard_rows, nvalid, vt, val_dtype,
+                        on_done=on_done)
+            return pending
         except BaseException:
-            self.node.pool.put(stage_buf)
+            if pending is None:
+                self.node.pool.put(stage_buf)
             raise
 
     # -- capacity learning -------------------------------------------------
@@ -521,10 +535,12 @@ class TpuShuffleManager:
         return rows, buf
 
     # -- the multi-process read path --------------------------------------
-    def _read_distributed(self, handle: ShuffleHandle, timeout: float,
-                          combine: Optional[str] = None,
-                          ordered: bool = False):
-        """COLLECTIVE multi-process read (shuffle/distributed.py). Map
+    def _submit_distributed(self, handle: ShuffleHandle, timeout: float,
+                            combine: Optional[str] = None,
+                            ordered: bool = False):
+        """COLLECTIVE multi-process submit (shuffle/distributed.py);
+        returns a PendingDistributedShuffle — result() is the other half
+        of the collective. Map
         outputs stay on this process's shards (Spark: outputs live on the
         writing executor's local disk); metadata crosses processes via
         allgather; the exchange is the same jitted SPMD step over the
@@ -534,7 +550,7 @@ class TpuShuffleManager:
         import time as _time
 
         from sparkucx_tpu.shuffle.distributed import (
-            allgather_blob, allgather_sizes, read_shuffle_distributed)
+            allgather_blob, allgather_sizes, submit_shuffle_distributed)
 
         tracer = self.node.tracer
         shard_ids = self.node.local_shard_ids
@@ -648,26 +664,39 @@ class TpuShuffleManager:
             local_rows, stage_buf = self._pack_shards(
                 shard_outputs, plan.cap_in, width, has_vals)
 
+        def on_done(result):
+            # fires from PendingDistributedShuffle.result() — with None on
+            # failure — exactly once; the pack buffer stays pinned until
+            # the last dispatch has staged it
+            self.node.pool.put(stage_buf)
+            if result is not None:
+                self._learn_cap(handle, result, int(nvalid.sum()))
+                self.node.metrics.inc("shuffle.rows",
+                                      float(nvalid_local.sum()))
+
+        # same ownership rule as the local path: the armed handle is the
+        # sole releaser of the pack buffer
+        pending = None
         try:
             self.node.faults.check("exchange")
-            with self.node.metrics.timeit("shuffle.read"), \
-                    tracer.span("shuffle.exchange",
-                                shuffle_id=handle.shuffle_id,
-                                rows=int(nvalid.sum()), width=width,
-                                hierarchical=self.hierarchical,
-                                distributed=True):
+            with tracer.span("shuffle.dispatch",
+                             shuffle_id=handle.shuffle_id,
+                             rows=int(nvalid.sum()), width=width,
+                             hierarchical=self.hierarchical,
+                             distributed=True):
                 vt = val_tail if has_vals else None
-                result = read_shuffle_distributed(
+                pending = submit_shuffle_distributed(
                     self.exchange_mesh, self.axis, plan, local_rows,
                     nvalid_local, shard_ids, vt, val_dtype,
                     hier_mesh=self.node.mesh if self.hierarchical else None,
                     dcn_axis=self.conf.mesh_dcn_axis
-                    if self.hierarchical else None)
-        finally:
-            self.node.pool.put(stage_buf)
-        self.node.metrics.inc("shuffle.rows", float(nvalid_local.sum()))
-        self._learn_cap(handle, result, int(nvalid.sum()))
-        return result
+                    if self.hierarchical else None,
+                    on_done=on_done)
+            return pending
+        except BaseException:
+            if pending is None:
+                self.node.pool.put(stage_buf)
+            raise
 
     # -- checkpoint support ----------------------------------------------
     def live_shuffles(self):

@@ -399,7 +399,59 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
         return got
 
 
-class PendingShuffle:
+class PendingExchangeBase:
+    """Shared lifecycle for future-like exchange handles (single- and
+    multi-process — shuffle/distributed.py subclasses this).
+
+    Subclass contract: ``__init__`` must set ``_result = None``,
+    ``_attempt = 0``, ``_on_done = None``, run the first ``_dispatch()``
+    (which sets ``self._out``), and only THEN arm ``_on_done`` — so a
+    dispatch failure inside ``__init__`` leaves cleanup with the caller
+    and this half-built object's ``__del__`` cannot fire the callback a
+    second time (double pool.put of the pinned pack buffer). Subclasses
+    implement ``_dispatch()`` and ``_result_inner()`` (the overflow-retry
+    loop returning the reader result)."""
+
+    def done(self) -> bool:
+        """True once the current attempt's outputs are computed on device
+        (local poll; result() then blocks only on D2H / consensus)."""
+        if self._result is not None:
+            return True
+        try:
+            return all(bool(x.is_ready()) for x in self._out)
+        except AttributeError:  # backend array without is_ready
+            return True
+
+    def _notify(self, result) -> None:
+        """Fire on_done exactly once — with the result, or None on failure
+        (so the owner can release the pinned pack buffer either way)."""
+        if self._on_done is not None:
+            cb, self._on_done = self._on_done, None
+            cb(result)
+
+    def __del__(self):
+        # a submitted-then-abandoned handle must still return the pinned
+        # pack buffer to the pool
+        try:
+            self._notify(None)
+        except Exception:
+            pass
+
+    def result(self):
+        if self._result is not None:
+            return self._result
+        try:
+            res = self._result_inner()
+        except Exception:
+            self._notify(None)
+            raise
+        self._result = res
+        self._out = None
+        self._notify(res)
+        return res
+
+
+class PendingShuffle(PendingExchangeBase):
     """Future-like handle for an in-flight exchange — the submit/poll
     split the reference gets from its non-blocking ``ucp_get`` storm +
     lazy-progress iterator (ref: UcxShuffleClient.java (3.0):95-127,
@@ -425,11 +477,6 @@ class PendingShuffle:
         self._nvalid_host = shard_nvalid
         self._val_shape = val_shape
         self._val_dtype = val_dtype
-        # ownership of on_done transfers only once the first dispatch
-        # succeeds: if _dispatch raises out of __init__ the CALLER still
-        # owns the failure cleanup (it sees the exception), and this
-        # half-built object's __del__ must not fire the callback a second
-        # time (double pool.put of the pinned pack buffer)
         self._on_done = None
         self._result: Optional[ShuffleReaderResult] = None
         self._attempt = 0
@@ -448,62 +495,28 @@ class PendingShuffle:
             self._nvalid_host.astype(np.int32).reshape(-1), self._sharding)
         self._out = step(rows_flat, nvalid)
 
-    def done(self) -> bool:
-        """True once the current attempt's outputs are computed on device
-        (result() will not block on the exchange itself, only on D2H)."""
-        if self._result is not None:
-            return True
-        try:
-            return all(bool(x.is_ready()) for x in self._out)
-        except AttributeError:  # backend array without is_ready
-            return True
-
-    def _notify(self, result) -> None:
-        """Fire on_done exactly once — with the result, or None on failure
-        (so the owner can release the pinned pack buffer either way)."""
-        if self._on_done is not None:
-            cb, self._on_done = self._on_done, None
-            cb(result)
-
-    def __del__(self):
-        # a submitted-then-abandoned handle must still return the pinned
-        # pack buffer to the pool
-        try:
-            self._notify(None)
-        except Exception:
-            pass
-
-    def result(self) -> ShuffleReaderResult:
-        if self._result is not None:
-            return self._result
-        try:
-            while True:
-                rows_out, seg, total, ovf = self._out
-                if not np.asarray(ovf).any():
-                    break
-                if self._attempt >= self._plan.max_retries:
-                    raise RuntimeError(
-                        f"shuffle still overflowing after "
-                        f"{self._plan.max_retries} retries "
-                        f"(cap_out={self._plan.cap_out}); extreme skew — "
-                        f"repartition the data")
-                log.info("shuffle overflow at cap_out=%d (attempt %d); "
-                         "growing", self._plan.cap_out, self._attempt)
-                self._plan = self._plan.grown()
-                self._attempt += 1
-                self._dispatch()
-        except Exception:
-            self._notify(None)
-            raise
+    def _result_inner(self) -> ShuffleReaderResult:
+        while True:
+            rows_out, seg, total, ovf = self._out
+            if not np.asarray(ovf).any():
+                break
+            if self._attempt >= self._plan.max_retries:
+                raise RuntimeError(
+                    f"shuffle still overflowing after "
+                    f"{self._plan.max_retries} retries "
+                    f"(cap_out={self._plan.cap_out}); extreme skew — "
+                    f"repartition the data")
+            log.info("shuffle overflow at cap_out=%d (attempt %d); "
+                     "growing", self._plan.cap_out, self._attempt)
+            self._plan = self._plan.grown()
+            self._attempt += 1
+            self._dispatch()
         Pn = self._plan.num_shards
         R = self._plan.num_partitions
-        self._result = LazyShuffleReaderResult(
+        return LazyShuffleReaderResult(
             R, np.asarray(_blocked_map(R, Pn)), rows_out, seg,
             Pn, self._plan.cap_out, self._val_shape, self._val_dtype,
             per_shard_segs=self._per_shard_segs)
-        self._out = None
-        self._notify(self._result)
-        return self._result
 
 
 def submit_shuffle(

@@ -167,7 +167,7 @@ def test_manager_uses_hierarchical_on_2d_mesh(rng):
             k, _ = res.partition(r)
             assert sorted(k.tolist()) == sorted(ak[parts == r].tolist())
         mgr.unregister_shuffle(930)
-        span = [s for s in node.tracer.spans("shuffle.exchange")]
+        span = [s for s in node.tracer.spans("shuffle.dispatch")]
         # tracer disabled by default -> no spans; flag lives on manager
         mgr.stop()
     finally:

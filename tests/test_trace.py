@@ -159,7 +159,7 @@ def test_shuffle_read_emits_spans(manager_factory):
             w.commit(h.num_partitions)
         mgr.read(h)
         names = {s.name for s in tracer.spans()}
-        assert {"shuffle.plan", "shuffle.pack", "shuffle.exchange",
+        assert {"shuffle.plan", "shuffle.pack", "shuffle.dispatch",
                 "shuffle.publish"} <= names
         pub = tracer.spans("shuffle.publish")
         assert len(pub) == 4
