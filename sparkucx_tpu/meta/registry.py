@@ -35,11 +35,15 @@ class ShuffleEntry:
     request completion)."""
 
     def __init__(self, shuffle_id: int, num_maps: int, num_partitions: int,
-                 partitioner: str = "hash"):
+                 partitioner: str = "hash", bounds=None):
         self.shuffle_id = shuffle_id
         self.num_maps = num_maps
         self.num_partitions = num_partitions
         self.partitioner = partitioner
+        # range split points — part of the registration (the entry is the
+        # single source of truth for re-registration, e.g. checkpoint
+        # restore; a range shuffle without its bounds is unreadable)
+        self.bounds = tuple(bounds) if bounds is not None else None
         self.slot = record_size(num_partitions)
         self.table = bytearray(self.slot * num_maps)
         self._present = np.zeros(num_maps, dtype=bool)
@@ -102,11 +106,12 @@ class ShuffleRegistry:
 
     def register(self, shuffle_id: int, num_maps: int,
                  num_partitions: int,
-                 partitioner: str = "hash") -> ShuffleEntry:
+                 partitioner: str = "hash", bounds=None) -> ShuffleEntry:
         with self._lock:
             if shuffle_id in self._entries:
                 raise ValueError(f"shuffle {shuffle_id} already registered")
-            e = ShuffleEntry(shuffle_id, num_maps, num_partitions, partitioner)
+            e = ShuffleEntry(shuffle_id, num_maps, num_partitions,
+                             partitioner, bounds)
             self._entries[shuffle_id] = e
             return e
 

@@ -112,6 +112,8 @@ def snapshot_shuffles(manager, directory: str) -> int:
             "num_partitions": np.int64(entry.num_partitions),
             "partitioner": np.bytes_(entry.partitioner.encode()),
         }
+        if entry.bounds is not None:
+            payload["bounds"] = np.asarray(entry.bounds, dtype=np.int64)
         for map_id, (keys, values, committed) in staged.items():
             payload[f"keys_{map_id}"] = keys
             payload[f"committed_{map_id}"] = np.bool_(committed)
@@ -147,8 +149,10 @@ def restore_shuffles(manager, directory: str) -> Dict[int, Any]:
             num_maps = int(z["num_maps"])
             num_partitions = int(z["num_partitions"])
             partitioner = bytes(z["partitioner"]).decode()
+            bounds = z["bounds"] if "bounds" in z else None
             h = manager.register_shuffle(sid, num_maps, num_partitions,
-                                         partitioner=partitioner)
+                                         partitioner=partitioner,
+                                         bounds=bounds)
             for map_id in range(num_maps):
                 kname = f"keys_{map_id}"
                 if kname not in z:

@@ -145,7 +145,8 @@ class TpuShuffleManager:
                     f"int64 split points, got shape {b.shape}")
             bounds = tuple(int(x) for x in b)
         entry = self.node.registry.register(shuffle_id, num_maps,
-                                            num_partitions, partitioner)
+                                            num_partitions, partitioner,
+                                            bounds)
         with self._lock:
             self._writers[shuffle_id] = {}
         log.info("registered shuffle %d: %d maps x %d partitions "

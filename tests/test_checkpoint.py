@@ -186,3 +186,34 @@ def test_restore_version_guard(manager_factory, tmp_path, rng):
     _np.savez_compressed(path, **data)
     with pytest.raises(ValueError, match="version 99"):
         restore_shuffles(mgr, snap)
+
+
+def test_snapshot_preserves_range_bounds(manager_factory, rng, tmp_path):
+    """A range-partitioned shuffle must restore with its split points —
+    without them the handle cannot be rebuilt (register requires bounds)
+    and routing would be undefined."""
+    from sparkucx_tpu.runtime.checkpoint import (restore_shuffles,
+                                                 snapshot_shuffles)
+    m1 = manager_factory()
+    bounds = np.array([-100, 0, 100], dtype=np.int64)
+    h = m1.register_shuffle(77, 2, 4, partitioner="range", bounds=bounds)
+    allk = []
+    for mid in range(2):
+        w = m1.get_writer(h, mid)
+        k = rng.integers(-500, 500, size=300).astype(np.int64)
+        w.write(k)
+        w.commit(4)
+        allk.extend(k.tolist())
+    snapdir = str(tmp_path / "snap")
+    assert snapshot_shuffles(m1, snapdir) == 1
+
+    m2 = manager_factory()
+    handles = restore_shuffles(m2, snapdir)
+    h2 = handles[77]
+    assert h2.partitioner == "range"
+    assert tuple(h2.bounds) == tuple(bounds.tolist())
+    res = m2.read(h2, ordered=True)
+    cat = []
+    for r, (ks, _) in res.partitions():
+        cat.extend(ks.tolist())
+    assert cat == sorted(allk)
