@@ -135,10 +135,32 @@ def restore_shuffles(manager, directory: str) -> Dict[int, Any]:
     Returns ``{shuffle_id: ShuffleHandle}`` so callers can read restored
     shuffles through the public API directly."""
     handles: Dict[int, Any] = {}
+    failures = []
     for name in sorted(os.listdir(directory)):
         m = re.fullmatch(r"shuffle_(\d+)\.npz", name)
         if not m:
             continue
+        try:
+            _restore_one(manager, directory, name, handles)
+        except Exception as e:
+            # one unrestorable snapshot (corrupt file, legacy range
+            # snapshot without bounds) must not abandon the rest of the
+            # directory mid-loop with half the shuffles registered and no
+            # handles returned — restore what restores, then report
+            failures.append((name, e))
+    if failures:
+        detail = "; ".join(f"{n}: {e}" for n, e in failures)
+        raise RuntimeError(
+            f"restored {len(handles)} shuffles but {len(failures)} "
+            f"failed ({detail}); the restored ones remain registered "
+            f"and readable via their ids")
+    log.info("restore: %d shuffles <- %s", len(handles), directory)
+    return handles
+
+
+def _restore_one(manager, directory: str, name: str,
+                 handles: Dict[int, Any]) -> None:
+    if True:
         with np.load(os.path.join(directory, name)) as z:
             version = int(z["version"])
             if version > _SNAP_VERSION:
@@ -166,5 +188,3 @@ def restore_shuffles(manager, directory: str) -> Dict[int, Any]:
                 if bool(z[f"committed_{map_id}"]):
                     w.commit(num_partitions)
             handles[sid] = h
-    log.info("restore: %d shuffles <- %s", len(handles), directory)
-    return handles

@@ -84,6 +84,9 @@ class TpuShuffleManager:
         # workload pays the overflow-retry recompile once, then every later
         # shuffle of the same shape starts at the capacity that worked.
         self._cap_hints: Dict[tuple, int] = {}
+        # writers dropped by the LAST epoch bump, kept alive one more
+        # epoch so in-flight reads fail instead of seeing reused buffers
+        self._graveyard: list = []
         self._lock = threading.Lock()
         self._bind_mesh()
         # Elastic membership: a remesh (node.remesh) bumps the epoch; this
@@ -117,7 +120,20 @@ class TpuShuffleManager:
     def _on_epoch_bump(self, epoch: int) -> None:
         self._bind_mesh()
         with self._lock:
+            dropped = list(self._writers.values())
             self._writers.clear()
+            # DEFERRED release: a read that passed epoch validation just
+            # before this bump may still be copying staged arena arrays /
+            # spill mmap views — releasing now would hand its buffers to
+            # the next shuffle mid-copy (use-after-free). Such a read is
+            # doomed (its mesh is gone) but must fail, not corrupt. The
+            # previous epoch's graveyard is older than any read that
+            # could still be running, so release IT; today's dropped
+            # writers wait one epoch (or until stop()).
+            to_free, self._graveyard = self._graveyard, dropped
+        for ws in to_free:
+            for w in ws.values():
+                w.release()
         log.warning("manager rebound to epoch %d: mesh %s, shuffle state "
                     "dropped — re-register and re-run live shuffles",
                     epoch, dict(zip(self.node.mesh.axis_names,
@@ -144,6 +160,12 @@ class TpuShuffleManager:
                     f"range bounds must be {num_partitions - 1} sorted "
                     f"int64 split points, got shape {b.shape}")
             bounds = tuple(int(x) for x in b)
+        # every ShuffleHandle invariant must hold BEFORE touching the
+        # registry: a post-registration validation failure would leak a
+        # dead entry that blocks the corrected retry ("already registered")
+        if (partitioner == "range") != (bounds is not None):
+            raise ValueError(
+                "partitioner='range' requires bounds (and only it)")
         entry = self.node.registry.register(shuffle_id, num_maps,
                                             num_partitions, partitioner,
                                             bounds)
@@ -734,5 +756,9 @@ class TpuShuffleManager:
         self.node.epochs.remove_listener(self._on_epoch_bump)
         with self._lock:
             ids = list(self._writers.keys())
+            graveyard, self._graveyard = self._graveyard, []
+        for ws in graveyard:
+            for w in ws.values():
+                w.release()
         for sid in ids:
             self.unregister_shuffle(sid)

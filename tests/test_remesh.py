@@ -51,3 +51,34 @@ def test_remesh_rejects_empty():
             node.remesh(devices=[])
     finally:
         node.close()
+
+
+def test_epoch_bump_releases_writer_buffers(manager_factory, rng, tmp_path):
+    """A remesh drops shuffle state; the dropped writers' pinned arena
+    blocks must return to the pool and their spill files must be deleted
+    (the unregister path always did this; the epoch path leaked)."""
+    import os
+
+    m = manager_factory({
+        "spark.shuffle.tpu.spill.threshold": "4k",
+        "spark.shuffle.tpu.spill.dir": str(tmp_path)})
+    h = m.register_shuffle(88, 2, 4)
+    w = m.get_writer(h, 0)
+    w.write(rng.integers(0, 1 << 30, size=3000).astype(np.int64))  # spills
+    w.commit(4)
+    # second writer stays BELOW the threshold: its rows remain pinned
+    # arena blocks, so the pool half of the release is really exercised
+    # (the spilled writer's blocks already went back at flush time)
+    w2 = m.get_writer(h, 1)
+    w2.write(rng.integers(0, 1 << 30, size=64).astype(np.int64))
+    w2.commit(4)
+    in_use_before = m.node.pool.stats()["in_use"]
+    assert in_use_before > 0, "fixture must hold live arena blocks"
+    spilled = [f for f in os.listdir(tmp_path) if "88" in f]
+    assert spilled, "fixture must actually spill"
+
+    m.node.epochs.bump("test remesh")          # -> graveyard (deferred)
+    m.node.epochs.bump("second remesh")        # -> released
+    assert m.node.pool.stats()["in_use"] < in_use_before
+    assert not [f for f in os.listdir(tmp_path) if "88" in f], \
+        "spill files must be deleted within one epoch of the bump"
