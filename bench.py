@@ -61,6 +61,7 @@ import subprocess
 import sys
 import threading
 import time
+from typing import Optional
 
 BASELINE_GBPS = 3.0
 METRIC = "shuffle_read_GBps_per_chip"
@@ -113,6 +114,9 @@ class StageMonitor:
             if result is not None:
                 detail = result.setdefault("detail", {})
                 detail["tpu_wedged_at"] = stage
+                with self.lock:
+                    if "init_probes" in self.extra:
+                        detail["init_probes"] = self.extra["init_probes"]
                 prior = _best_recorded_tpu_run()
                 if prior:
                     # measured-on-hardware context for the reader: the CPU
@@ -130,8 +134,11 @@ class StageMonitor:
 
     def end(self, name, status="ok", **info):
         with self.lock:
+            # _t0 is None when a stage fails before begin() (e.g. the
+            # init probe loop raises) — the record still deserves a row
             rec = {"status": status,
-                   "seconds": round(time.monotonic() - self._t0, 2)}
+                   "seconds": round(time.monotonic() - self._t0, 2)
+                   if self._t0 is not None else None}
             rec.update(info)
             self.stages[name] = rec
             self._stage = self._deadline = None
@@ -211,11 +218,106 @@ def _run_fallback(cmd):
 # stages
 # ---------------------------------------------------------------------------
 
-def stage_init(mon, platform):
+def _tpu_probe_once(deadline_s: int) -> dict:
+    """One backend bring-up probe in a SELF-WATCHDOGGED subprocess.
+
+    The probe imports jax and lists devices with its own in-process
+    watchdog that os._exit(3)s on deadline — never an external
+    kill-timeout, which is exactly what wedges the axon tunnel for every
+    later process (bench_runs/NOTES_r2.md). The parent only waits; the
+    grace kill below is a last resort for a probe whose watchdog thread
+    itself died, by which point the tunnel is already gone."""
+    code = (
+        "import os, sys, threading, json\n"
+        f"t = threading.Timer({deadline_s}, lambda: os._exit(3))\n"
+        "t.daemon = True\n"
+        "t.start()\n"
+        "import jax\n"
+        "d = jax.devices()\n"
+        "print(json.dumps({'backend': jax.default_backend(),"
+        " 'devices': len(d)}), flush=True)\n"
+        "os._exit(0)\n"
+    )
+    t0 = time.monotonic()
+    rec = {}
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=deadline_s + 60)
+        rec["rc"] = proc.returncode
+        lines = proc.stdout.strip().splitlines()
+        if proc.returncode == 0 and lines:
+            try:
+                rec.update(json.loads(lines[-1]))
+            except json.JSONDecodeError:
+                rec["rc"] = -2
+        elif proc.returncode != 0:
+            rec["stderr"] = (proc.stderr or "")[-200:]
+    except subprocess.TimeoutExpired:
+        rec["rc"] = -1   # watchdog never fired; parent grace-kill
+    rec["seconds"] = round(time.monotonic() - t0, 1)
+    return rec
+
+
+def _tpu_expected() -> bool:
+    """Whether this machine should present a TPU backend: the axon
+    sitecustomize force-registers the tunneled plugin when its pool env is
+    set. Without this check, a probe that silently falls back to CPU
+    (plugin init failed fast instead of wedging) would end the retry
+    window on its first attempt — the exact forfeit the window prevents."""
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def probe_backend_with_backoff(mon, window_s: int,
+                               probe_deadline_s: int = 240) -> bool:
+    """Retry backend bring-up probes across ``window_s`` with exponential
+    backoff (round-2 verdict: one 300 s attempt then CPU fallback forfeits
+    the official TPU number even though the tunnel recovers in-round).
+    Returns True once a probe sees a live backend — where a TPU is
+    expected (see _tpu_expected), only backend == "tpu" counts; a CPU-only
+    machine accepts its first healthy probe. Every attempt is recorded in
+    the final JSON under detail.init_probes."""
+    probes = []
+    mon.extra["init_probes"] = probes
+    need_tpu = _tpu_expected()
+    t0 = time.monotonic()
+    sleep_s = 60
+    while True:
+        rec = _tpu_probe_once(probe_deadline_s)
+        probes.append(rec)
+        if rec.get("rc") == 0 and \
+                (not need_tpu or rec.get("backend") == "tpu"):
+            return True
+        remaining = window_s - (time.monotonic() - t0)
+        if remaining <= sleep_s:
+            return False
+        print(f"# tpu probe rc={rec.get('rc')} "
+              f"backend={rec.get('backend')} after {rec['seconds']}s; "
+              f"retrying in {sleep_s}s ({int(remaining)}s left in window)",
+              file=sys.stderr, flush=True)
+        time.sleep(sleep_s)
+        sleep_s = min(sleep_s * 2, 600)
+
+
+def stage_init(mon, platform, retry_window_s: Optional[int] = None):
     """Backend bring-up under the first deadline. The jax IMPORT is inside
     the guarded window too: with the axon sitecustomize present, plugin
     discovery can touch the tunnel before jax.devices() ever runs, and an
-    unguarded wedge there would reproduce round 1's zero-signal failure."""
+    unguarded wedge there would reproduce round 1's zero-signal failure.
+
+    For TPU platforms the import is preceded by subprocess probes with
+    retry/backoff (see probe_backend_with_backoff): a wedged tunnel often
+    recovers within the bench's run window, and the parent must not touch
+    jax before a probe confirms the backend is healthy — an in-process
+    wedge is unrecoverable."""
+    if platform != "cpu":
+        window = retry_window_s if retry_window_s is not None else int(
+            os.environ.get("SPARKUCX_BENCH_INIT_RETRY_S", "2700"))
+        if not probe_backend_with_backoff(mon, window):
+            probes = mon.extra.get("init_probes", [])
+            raise RuntimeError(
+                f"backend never came up across {len(probes)} probes over "
+                f"{window}s (last rc={probes[-1].get('rc') if probes else '?'})")
     mon.begin("init", 300)
     import jax
     if platform == "cpu":
@@ -484,6 +586,10 @@ def main() -> None:
                          "the axon sitecustomize present)")
     ap.add_argument("--no-fallback", action="store_true",
                     help="do not retry on CPU if TPU init wedges")
+    ap.add_argument("--init-retry-s", type=int, default=None,
+                    help="total window for TPU bring-up probes with "
+                         "backoff (default env SPARKUCX_BENCH_INIT_RETRY_S "
+                         "or 2700); the tunnel often recovers in-round")
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -503,13 +609,19 @@ def main() -> None:
     # a FAST failure (exception, not wedge) must also end in the one JSON
     # line — the monitor only covers deadline expiry
     try:
-        jax, devs = stage_init(mon, args.platform)
+        jax, devs = stage_init(mon, args.platform, args.init_retry_s)
     except Exception as e:
         mon.end("init", status="failed", error=str(e)[:300])
         if fallback:
             result = _run_fallback(fallback)
             if result is not None:
-                result.setdefault("detail", {})["tpu_failed"] = str(e)[:200]
+                detail = result.setdefault("detail", {})
+                detail["tpu_failed"] = str(e)[:200]
+                if "init_probes" in mon.extra:
+                    detail["init_probes"] = mon.extra["init_probes"]
+                prior = _best_recorded_tpu_run()
+                if prior:
+                    detail["last_recorded_tpu_run"] = prior
                 print(json.dumps(result), flush=True)
                 sys.exit(0 if result.get("value", 0) > 0 else 2)
         mon.finish()

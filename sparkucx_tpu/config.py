@@ -87,8 +87,9 @@ class TpuShuffleConf:
         "coordinator_address", "meta_buffer_size", "min_buffer_size",
         "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
         "spill_threshold", "spill_dir", "a2a_impl", "sort_impl",
-        "capacity_factor", "mesh_ici_axis", "mesh_dcn_axis", "num_slices",
-        "num_processes", "cores_per_process", "connection_timeout_ms")
+        "capacity_factor", "max_bytes_in_flight", "mesh_ici_axis",
+        "mesh_dcn_axis", "num_slices", "num_processes",
+        "cores_per_process", "connection_timeout_ms")
     # Namespace keys consumed OUTSIDE config.py (grep-verified), plus the
     # prefix families. A spark.shuffle.tpu.* key matching none of these is
     # a probable typo and gets a warning (not an error: a host engine may
@@ -294,6 +295,17 @@ class TpuShuffleConf:
 
         The static-shape answer to ragged skew (SURVEY.md §7 hard part (a))."""
         return float(self._get("a2a.capacityFactor", 2.0))
+
+    @property
+    def max_bytes_in_flight(self) -> int:
+        """Cap on the combined footprint (pinned pack buffers + estimated
+        HBM send/receive buffers) of simultaneously in-flight submitted
+        exchanges; 0 = unlimited. ``submit()`` blocks until enough earlier
+        exchanges complete — the admission-control role Spark's
+        ShuffleBlockFetcherIterator plays with maxBytesInFlight
+        (ref: UcxShuffleReader.scala:56-70). A single exchange larger than
+        the cap is always admitted alone (never deadlocks)."""
+        return self.get_bytes("a2a.maxBytesInFlight", 0)
 
     @property
     def mesh_ici_axis(self) -> str:

@@ -150,31 +150,36 @@ def restore_shuffles(manager, directory: str) -> Dict[int, Any]:
             failures.append((name, e))
     if failures:
         detail = "; ".join(f"{n}: {e}" for n, e in failures)
-        raise RuntimeError(
+        err = RuntimeError(
             f"restored {len(handles)} shuffles but {len(failures)} "
-            f"failed ({detail}); the restored ones remain registered "
-            f"and readable via their ids")
+            f"failed ({detail}); the restored ones remain registered — "
+            f"their handles ride on this exception as .handles")
+        # callers cannot rebuild a handle from a bare id (no manager API
+        # for that), so the partial-success handles must travel with the
+        # error or the restored shuffles are unreachable
+        err.handles = handles
+        raise err
     log.info("restore: %d shuffles <- %s", len(handles), directory)
     return handles
 
 
 def _restore_one(manager, directory: str, name: str,
                  handles: Dict[int, Any]) -> None:
-    if True:
-        with np.load(os.path.join(directory, name)) as z:
-            version = int(z["version"])
-            if version > _SNAP_VERSION:
-                raise ValueError(
-                    f"{name}: snapshot version {version} is newer than "
-                    f"supported {_SNAP_VERSION}")
-            sid = int(z["shuffle_id"])
-            num_maps = int(z["num_maps"])
-            num_partitions = int(z["num_partitions"])
-            partitioner = bytes(z["partitioner"]).decode()
-            bounds = z["bounds"] if "bounds" in z else None
-            h = manager.register_shuffle(sid, num_maps, num_partitions,
-                                         partitioner=partitioner,
-                                         bounds=bounds)
+    with np.load(os.path.join(directory, name)) as z:
+        version = int(z["version"])
+        if version > _SNAP_VERSION:
+            raise ValueError(
+                f"{name}: snapshot version {version} is newer than "
+                f"supported {_SNAP_VERSION}")
+        sid = int(z["shuffle_id"])
+        num_maps = int(z["num_maps"])
+        num_partitions = int(z["num_partitions"])
+        partitioner = bytes(z["partitioner"]).decode()
+        bounds = z["bounds"] if "bounds" in z else None
+        h = manager.register_shuffle(sid, num_maps, num_partitions,
+                                     partitioner=partitioner,
+                                     bounds=bounds)
+        try:
             for map_id in range(num_maps):
                 kname = f"keys_{map_id}"
                 if kname not in z:
@@ -187,4 +192,12 @@ def _restore_one(manager, directory: str, name: str,
                     w.write(keys, values)
                 if bool(z[f"committed_{map_id}"]):
                     w.commit(num_partitions)
-            handles[sid] = h
+        except Exception:
+            # a snapshot that fails AFTER registration (corrupt array,
+            # write/commit refusal) must not stay registered: a retry of
+            # restore_shuffles would hit 'already registered', and a read
+            # of the half-restored shuffle would block on maps that will
+            # never publish
+            manager.unregister_shuffle(sid)
+            raise
+        handles[sid] = h

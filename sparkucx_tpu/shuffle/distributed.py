@@ -180,7 +180,7 @@ class PendingDistributedShuffle(PendingExchangeBase):
 
     def __init__(self, mesh, axis, plan, local_rows, local_nvalid,
                  shard_ids, val_shape, val_dtype, hier_mesh, dcn_axis,
-                 on_done=None):
+                 on_done=None, admit=None):
         self._mesh, self._axis = mesh, axis
         self._plan = plan
         self._local_rows, self._local_nvalid = local_rows, local_nvalid
@@ -196,7 +196,10 @@ class PendingDistributedShuffle(PendingExchangeBase):
         self._result = None
         self._attempt = 0
         self._on_done = None
-        self._dispatch()
+        # the defer decision is deterministic across processes (same plan,
+        # same footprint arithmetic, same submit/result order), so queued
+        # dispatches stay in SPMD lockstep
+        self._initial_dispatch(admit)
         self._on_done = on_done
 
     def _dispatch(self):
@@ -278,9 +281,11 @@ def submit_shuffle_distributed(
     hier_mesh: Optional[Mesh] = None,
     dcn_axis: Optional[str] = None,
     on_done=None,
+    admit=None,
 ) -> PendingDistributedShuffle:
     """Dispatch the multi-process exchange without blocking (collective:
     see :class:`PendingDistributedShuffle`)."""
     return PendingDistributedShuffle(
         mesh, axis, plan, local_rows, local_nvalid, shard_ids,
-        val_shape, val_dtype, hier_mesh, dcn_axis, on_done=on_done)
+        val_shape, val_dtype, hier_mesh, dcn_axis, on_done=on_done,
+        admit=admit)

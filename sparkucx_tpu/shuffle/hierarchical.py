@@ -166,6 +166,7 @@ def submit_shuffle_hierarchical(
     val_shape,
     val_dtype,
     on_done=None,
+    admit=None,
 ):
     """Dispatch the two-stage exchange without blocking — same
     submit/poll contract as :func:`shuffle.reader.submit_shuffle`."""
@@ -178,7 +179,7 @@ def submit_shuffle_hierarchical(
         lambda p: _build_hier_step(mesh, dcn_axis, ici_axis, p, width),
         NamedSharding(mesh, P((dcn_axis, ici_axis))), plan,
         shard_rows, shard_nvalid, val_shape, val_dtype, on_done=on_done,
-        per_shard_segs=True)
+        admit=admit, per_shard_segs=True)
 
 
 def read_shuffle_hierarchical(

@@ -84,10 +84,26 @@ class TpuShuffleManager:
         # workload pays the overflow-retry recompile once, then every later
         # shuffle of the same shape starts at the capacity that worked.
         self._cap_hints: Dict[tuple, int] = {}
-        # writers dropped by the LAST epoch bump, kept alive one more
-        # epoch so in-flight reads fail instead of seeing reused buffers
-        self._graveyard: list = []
+        # writers dropped by an epoch bump, kept alive until no read that
+        # could still touch their buffers remains (see _on_epoch_bump)
+        self._graveyard: list = []          # [(dropped_at_gen, writers)]
+        # In-flight reads by the manager GENERATION they registered under.
+        # The generation (not the node epoch) keys the guard because it is
+        # mutated under the same lock that clears _writers — the node
+        # epoch increments before the bump listener runs, so epoch-keyed
+        # tracking would let a read register "post-bump" yet still
+        # snapshot pre-bump writers.
+        self._gen = 0
+        self._active_reads: Dict[int, int] = {}
         self._lock = threading.Lock()
+        # Admission control (a2a.maxBytesInFlight): combined footprint of
+        # in-flight submitted exchanges; submit() blocks past the cap
+        # (ref: UcxShuffleReader.scala:56-70 — Spark's
+        # ShuffleBlockFetcherIterator throttles inflight bytes the same way)
+        self._inflight_bytes = 0
+        self._inflight_cv = threading.Condition(self._lock)
+        self._admit_queue: list = []   # FIFO tickets of deferred exchanges
+        self._admit_ticket = 0
         self._bind_mesh()
         # Elastic membership: a remesh (node.remesh) bumps the epoch; this
         # manager rebinds to the new mesh and drops writer state for the
@@ -126,18 +142,65 @@ class TpuShuffleManager:
             # before this bump may still be copying staged arena arrays /
             # spill mmap views — releasing now would hand its buffers to
             # the next shuffle mid-copy (use-after-free). Such a read is
-            # doomed (its mesh is gone) but must fail, not corrupt. The
-            # previous epoch's graveyard is older than any read that
-            # could still be running, so release IT; today's dropped
-            # writers wait one epoch (or until stop()).
-            to_free, self._graveyard = self._graveyard, dropped
-        for ws in to_free:
-            for w in ws.values():
-                w.release()
+            # doomed (its mesh is gone) but must fail, not corrupt. Each
+            # dropped batch is tagged with the generation of the clear and
+            # released only when NO read registered before the clear
+            # remains in flight (round-2 advisor: a fixed one-epoch
+            # deferral still raced a slow read under two quick remeshes).
+            self._gen += 1
+            if dropped:
+                self._graveyard.append((self._gen, dropped))
+            to_free = self._collect_free_graveyard_locked()
+        self._release_writer_batches(to_free)
         log.warning("manager rebound to epoch %d: mesh %s, shuffle state "
                     "dropped — re-register and re-run live shuffles",
                     epoch, dict(zip(self.node.mesh.axis_names,
                                     self.node.mesh.devices.shape)))
+
+    # -- in-flight read tracking (graveyard release condition) -------------
+    def _collect_free_graveyard_locked(self) -> list:
+        """Split off graveyard batches no in-flight read can reach. A read
+        registered at generation G snapshotted _writers at G or later, so
+        a batch cleared out at generation g_drop <= G was already gone
+        before the read looked — only reads with G < g_drop can hold
+        views into it. Caller holds the lock."""
+        oldest = min(self._active_reads, default=None)
+        free, keep = [], []
+        for dropped_at, ws in self._graveyard:
+            if oldest is None or oldest >= dropped_at:
+                free.append(ws)
+            else:
+                keep.append((dropped_at, ws))
+        self._graveyard = keep
+        return free
+
+    @staticmethod
+    def _release_writer_batches(batches: list) -> None:
+        """Each batch is one bump's drop: a list of per-shuffle writer
+        dicts ({map_id: writer})."""
+        for batch in batches:
+            for ws in batch:
+                for w in ws.values():
+                    w.release()
+
+    def _read_started(self) -> int:
+        with self._lock:
+            g = self._gen
+            self._active_reads[g] = self._active_reads.get(g, 0) + 1
+        return g
+
+    def _read_finished(self, start_gen: int) -> None:
+        with self._lock:
+            n = self._active_reads.get(start_gen, 0) - 1
+            if n > 0:
+                self._active_reads[start_gen] = n
+            else:
+                self._active_reads.pop(start_gen, None)
+            to_free = self._collect_free_graveyard_locked()
+            # same underlying lock as the admission cv — wake stop()'s
+            # read-drain wait too
+            self._inflight_cv.notify_all()
+        self._release_writer_batches(to_free)
 
     # -- lifecycle --------------------------------------------------------
     def register_shuffle(self, shuffle_id: int, num_maps: int,
@@ -218,6 +281,228 @@ class TpuShuffleManager:
                 "tasks are oversubscribing this process (ref: "
                 "UcxNode.java:85-95 warns the same way)", live, cores)
         return w
+
+    # -- admission control -------------------------------------------------
+    @staticmethod
+    def _exchange_footprint(plan: ShufflePlan, width: int,
+                            stage_bytes: int) -> int:
+        """Approximate bytes a pending exchange holds until result(): the
+        pinned pack buffer plus the device send+receive row matrices.
+        Deliberately an estimate — the cap is backpressure, not a ledger."""
+        device = (plan.cap_in + plan.cap_out) * width * 4 * plan.num_shards
+        return int(stage_bytes) + int(device)
+
+    def _fits_inflight_locked(self, nbytes: int, ticket=None) -> bool:
+        """Capacity check under the lock. FIFO fairness: a submit-time
+        attempt (ticket=None) must also yield to any already-deferred
+        exchange, or a later submit would steal capacity freed for an
+        earlier queued one and starve it (Spark's fetch iterator defers
+        requests FIFO for the same reason). The admitted-alone rule keeps
+        a bigger-than-cap exchange from deadlocking itself."""
+        cap = self.conf.max_bytes_in_flight
+        if ticket is None and self._admit_queue:
+            return False
+        if ticket is not None and (not self._admit_queue
+                                   or self._admit_queue[0] != ticket):
+            return False
+        return self._inflight_bytes == 0 or \
+            self._inflight_bytes + nbytes <= cap
+
+    def _release_inflight(self, nbytes: int) -> None:
+        if nbytes <= 0:
+            return
+        with self._inflight_cv:
+            self._inflight_bytes -= nbytes
+            self._inflight_cv.notify_all()
+
+    def _make_admitter(self, plan: ShufflePlan, width: int,
+                       stage_bytes: int, timeout: Optional[float]):
+        """(admit, release) pair for one exchange; ``admit(block)`` is
+        handed to the pending handle (None when the cap is off), and
+        ``release()`` is idempotent — safe from the exactly-once on_done
+        AND the not-yet-armed failure path.
+
+        ``timeout=None`` — wait without a deadline (the distributed path:
+        a local wall-clock TimeoutError could fire on one process while a
+        peer proceeds into the collective, diverging the SPMD group; with
+        the documented resolve-in-order discipline capacity is guaranteed
+        to free, so indefinite blocking is the collective-safe choice —
+        the same contract as result() itself)."""
+        if self.conf.max_bytes_in_flight <= 0:
+            return None, lambda: None
+        nbytes = self._exchange_footprint(plan, width, stage_bytes)
+        state = {"reserved": 0, "ticket": None}
+
+        def admit(block: bool) -> bool:
+            import time as _time
+            with self._inflight_cv:
+                if not block:
+                    if self._fits_inflight_locked(nbytes):
+                        self._inflight_bytes += nbytes
+                        state["reserved"] = nbytes
+                        return True
+                    # queue FIFO; dispatch happens in result()
+                    ticket = self._admit_ticket
+                    self._admit_ticket += 1
+                    self._admit_queue.append(ticket)
+                    state["ticket"] = ticket
+                    log.info("submit deferred by maxBytesInFlight=%d "
+                             "(in flight %d B, requesting %d B, queue "
+                             "depth %d)", self.conf.max_bytes_in_flight,
+                             self._inflight_bytes, nbytes,
+                             len(self._admit_queue))
+                    return False
+                ticket = state["ticket"]
+                deadline = None if timeout is None \
+                    else _time.monotonic() + timeout
+                while not self._fits_inflight_locked(nbytes, ticket):
+                    if deadline is not None:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0:
+                            raise TimeoutError(
+                                f"deferred exchange waited {timeout}s: "
+                                f"{self._inflight_bytes} B in flight "
+                                f"exceeds a2a.maxBytesInFlight="
+                                f"{self.conf.max_bytes_in_flight} and no "
+                                f"exchange completed — resolve earlier "
+                                f"submits or raise the cap")
+                        self._inflight_cv.wait(min(remaining, 1.0))
+                    else:
+                        self._inflight_cv.wait(1.0)
+                self._admit_queue.remove(ticket)
+                state["ticket"] = None
+                self._inflight_bytes += nbytes
+                state["reserved"] = nbytes
+                self._inflight_cv.notify_all()
+                return True
+
+        def release() -> None:
+            with self._inflight_cv:
+                if state["ticket"] is not None:
+                    # abandoned while queued: unblock those behind it
+                    try:
+                        self._admit_queue.remove(state["ticket"])
+                    except ValueError:
+                        pass
+                    state["ticket"] = None
+                    self._inflight_cv.notify_all()
+            n, state["reserved"] = state["reserved"], 0
+            self._release_inflight(n)
+
+        return admit, release
+
+    # -- warmup (the preconnect analog) -----------------------------------
+    def warmup(self, handle: ShuffleHandle,
+               rows_per_map=None, rows_per_shard=None,
+               val_shape=None, val_dtype=None,
+               combine: Optional[str] = None,
+               ordered: bool = False) -> ShufflePlan:
+        """Pre-trace + compile (and once-execute on empty inputs) the
+        exchange step a later ``read()``/``submit()`` of this handle will
+        dispatch — while map tasks are still running. The reference
+        overlaps connection setup with the map publish the same way
+        (``preconnect()`` dials every peer while the metadata put is in
+        flight, ref: UcxWorkerWrapper.scala:125-127,
+        CommonUcxShuffleBlockResolver.scala:100); here the cost being
+        hidden is XLA trace+compile, which otherwise lands in-band on the
+        first read of each (mesh, plan, width) family.
+
+        ``rows_per_map``   — expected rows per map output (int or
+                             [num_maps]); grouped onto shards exactly like
+                             the single-process read (map_id % P).
+        ``rows_per_shard`` — alternative: expected staged rows per shard
+                             directly ([P]); required in distributed mode,
+                             where map→shard placement is process-local.
+        ``val_shape``/``val_dtype`` — the value schema the writers will
+        stage (None = keys-only), ``combine``/``ordered`` — the read
+        options; together these determine the compiled program.
+
+        The warmed program is reused iff the read-time plan matches —
+        same expected row distribution, schema and options. A mismatch is
+        harmless: the read compiles its own program (correctness never
+        depends on warmup). Multi-process: warmup executes a collective,
+        so EVERY process must call it with the same arguments (the same
+        SPMD discipline as read()). Returns the warmed plan."""
+        self.node.epochs.validate(handle.epoch,
+                                  f"warmup shuffle {handle.shuffle_id}")
+        Pn = self.node.num_devices
+        if (rows_per_map is None) == (rows_per_shard is None):
+            raise ValueError(
+                "pass exactly one of rows_per_map / rows_per_shard")
+        if rows_per_map is not None:
+            if self.node.is_distributed:
+                raise ValueError(
+                    "distributed warmup needs rows_per_shard: map->shard "
+                    "placement is process-local (ordinal over local "
+                    "shards), so per-map counts do not determine the "
+                    "global plan")
+            per_map = np.broadcast_to(
+                np.asarray(rows_per_map, dtype=np.int64),
+                (handle.num_maps,))
+            nvalid = np.zeros(Pn, dtype=np.int64)
+            for map_id in range(handle.num_maps):
+                nvalid[map_id % Pn] += per_map[map_id]
+        else:
+            nvalid = np.asarray(rows_per_shard, dtype=np.int64)
+            if nvalid.shape != (Pn,):
+                raise ValueError(
+                    f"rows_per_shard must be [{Pn}], got {nvalid.shape}")
+
+        has_vals = val_dtype is not None
+        val_tail = tuple(val_shape) if val_shape is not None else ()
+        plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
+                         partitioner=handle.partitioner,
+                         bounds=handle.bounds)
+        plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
+        plan = self._decorated_plan(plan, combine, ordered, has_vals,
+                                    val_tail if has_vals else None,
+                                    val_dtype)
+        width = KEY_WORDS + (value_words(val_tail, val_dtype)
+                             if has_vals else 0)
+        with self.node.tracer.span("shuffle.warmup",
+                                   shuffle_id=handle.shuffle_id,
+                                   cap_in=plan.cap_in,
+                                   cap_out=plan.cap_out, width=width):
+            self._warm_step(plan, width)
+        return plan
+
+    def _warm_step(self, plan: ShufflePlan, width: int) -> None:
+        """Compile + once-execute the step for (plan, width) on EMPTY
+        inputs (nvalid=0 moves nothing), populating the jit cache the
+        first real dispatch will hit. Executing (not just lowering) is
+        deliberate: AOT ``lower().compile()`` results do not seed the jit
+        call cache, so the first call would compile again."""
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as PSpec
+        from sparkucx_tpu.io.dlpack import stage_to_device
+
+        if self.hierarchical:
+            from sparkucx_tpu.shuffle.hierarchical import _build_hier_step
+            step = _build_hier_step(self.node.mesh,
+                                    self.conf.mesh_dcn_axis, self.axis,
+                                    plan, width)
+            sharding = NamedSharding(
+                self.node.mesh,
+                PSpec((self.conf.mesh_dcn_axis, self.axis)))
+        else:
+            from sparkucx_tpu.shuffle.reader import _build_step
+            step = _build_step(self.exchange_mesh, self.axis, plan, width)
+            sharding = NamedSharding(self.exchange_mesh, PSpec(self.axis))
+        if self.node.is_distributed:
+            # only local shards are addressable: assemble the global array
+            # from process-local zero blocks, like the real dispatch
+            L = len(self.node.local_shard_ids)
+            payload = _jax.make_array_from_process_local_data(
+                sharding, np.zeros((L * plan.cap_in, width), np.int32))
+            nvalid = _jax.make_array_from_process_local_data(
+                sharding, np.zeros(L, np.int32))
+        else:
+            Pn = plan.num_shards
+            payload = stage_to_device(
+                np.zeros((Pn * plan.cap_in, width), np.int32), sharding)
+            nvalid = stage_to_device(np.zeros(Pn, np.int32), sharding)
+        out = step(payload, nvalid)
+        _jax.block_until_ready(out)
 
     # -- the read path ----------------------------------------------------
     def read(self, handle: ShuffleHandle,
@@ -322,61 +607,83 @@ class TpuShuffleManager:
         # Collect staged outputs, grouped round-robin onto mesh shards the
         # way multiple map tasks colocate on one executor. Keys and values
         # travel as aligned pairs per map output.
+        #
+        # In-flight-read guard: from the writers snapshot through the end
+        # of pack, this read walks writer-owned memory (spill mmap views,
+        # arena-staged batches); a concurrent remesh must park those
+        # writers in the graveyard until this window closes, no matter how
+        # many bumps arrive meanwhile. Registration precedes the snapshot
+        # (same lock as the bump's clear), so any batch dropped after
+        # registration is provably held. After pack, the read holds only
+        # the pinned stage_buf (owned by on_done) and device arrays.
         Pn = self.node.num_devices
-        with self._lock:
-            if handle.shuffle_id not in self._writers:
+        read_gen = self._read_started()
+        try:
+            with self._lock:
+                if handle.shuffle_id not in self._writers:
+                    raise RuntimeError(
+                        f"shuffle {handle.shuffle_id} is not registered "
+                        f"with this manager (already unregistered?)")
+                writers = dict(self._writers[handle.shuffle_id])
+            # completeness is tracked by distinct map id in the metadata
+            # table; an extra uncommitted (half-written) writer must not
+            # inject rows — and a map whose committed rows are gone must
+            # fail loudly, not shrink the result (the distributed path's
+            # bitmap does the same)
+            writers = {m: w for m, w in writers.items() if w.committed}
+            missing = sorted(set(range(handle.num_maps)) - set(writers))
+            if missing:
                 raise RuntimeError(
-                    f"shuffle {handle.shuffle_id} is not registered with "
-                    f"this manager (already unregistered?)")
-            writers = dict(self._writers[handle.shuffle_id])
-        # completeness is tracked by distinct map id in the metadata table;
-        # an extra uncommitted (half-written) writer must not inject rows —
-        # and a map whose committed rows are gone must fail loudly, not
-        # shrink the result (the distributed path's bitmap does the same)
-        writers = {m: w for m, w in writers.items() if w.committed}
-        missing = sorted(set(range(handle.num_maps)) - set(writers))
-        if missing:
-            raise RuntimeError(
-                f"shuffle {handle.shuffle_id}: metadata table is complete "
-                f"but maps {missing[:8]} have no committed staged rows in "
-                f"this manager — map output lost (writer replaced or "
-                f"released?)")
-        shard_outputs, has_vals, val_tail, val_dtype = \
-            self._materialize_outputs(
-                writers, Pn, lambda ordinal, map_id: map_id % Pn)
+                    f"shuffle {handle.shuffle_id}: metadata table is "
+                    f"complete but maps {missing[:8]} have no committed "
+                    f"staged rows in this manager — map output lost "
+                    f"(writer replaced or released?)")
+            shard_outputs, has_vals, val_tail, val_dtype = \
+                self._materialize_outputs(
+                    writers, Pn, lambda ordinal, map_id: map_id % Pn)
 
-        # int32-range guard on what actually feeds the plan arithmetic:
-        # the per-DEVICE aggregated transfer matrix, not the raw [M, R]
-        from sparkucx_tpu.ops.partition import blocked_partition_map
-        map_to_dev = np.arange(handle.num_maps) % Pn
-        red_to_dev = np.asarray(
-            blocked_partition_map(handle.num_partitions, Pn))
-        validate_row_sizes(table.device_matrix(map_to_dev, red_to_dev, Pn))
+            # int32-range guard on what actually feeds the plan arithmetic:
+            # the per-DEVICE aggregated transfer matrix, not the raw [M, R]
+            from sparkucx_tpu.ops.partition import blocked_partition_map
+            map_to_dev = np.arange(handle.num_maps) % Pn
+            red_to_dev = np.asarray(
+                blocked_partition_map(handle.num_partitions, Pn))
+            validate_row_sizes(table.device_matrix(map_to_dev, red_to_dev,
+                                                   Pn))
 
-        nvalid = np.array(
-            [sum(k.shape[0] for k, _ in outs) for outs in shard_outputs],
-            dtype=np.int64)
-        with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
-            plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
-                             partitioner=handle.partitioner,
-                             bounds=handle.bounds)
-            plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
-        plan = self._decorated_plan(plan, combine, ordered, has_vals,
-                                    val_tail, val_dtype)
+            nvalid = np.array(
+                [sum(k.shape[0] for k, _ in outs) for outs in shard_outputs],
+                dtype=np.int64)
+            with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
+                plan = make_plan(nvalid, Pn, handle.num_partitions,
+                                 self.conf, partitioner=handle.partitioner,
+                                 bounds=handle.bounds)
+                plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
+            plan = self._decorated_plan(plan, combine, ordered, has_vals,
+                                        val_tail, val_dtype)
 
-        # fuse key+value bytes into one int32 row matrix (bit views, no
-        # value casts — jnp would silently truncate int64 with x64 off)
-        width = KEY_WORDS + (value_words(val_tail, val_dtype)
-                             if has_vals else 0)
-        with tracer.span("shuffle.pack", rows=int(nvalid.sum())):
-            shard_rows, stage_buf = self._pack_shards(
-                shard_outputs, plan.cap_in, width, has_vals)
+            # fuse key+value bytes into one int32 row matrix (bit views, no
+            # value casts — jnp would silently truncate int64 with x64 off)
+            width = KEY_WORDS + (value_words(val_tail, val_dtype)
+                                 if has_vals else 0)
+            with tracer.span("shuffle.pack", rows=int(nvalid.sum())):
+                shard_rows, stage_buf = self._pack_shards(
+                    shard_outputs, plan.cap_in, width, has_vals)
+        finally:
+            self._read_finished(read_gen)
+
+        # Admission control: a non-blocking reservation happens inside the
+        # pending handle's first dispatch; over the cap, the exchange
+        # queues and dispatches in result() once capacity frees
+        admit, release_admitted = self._make_admitter(
+            plan, width, stage_buf.requested, timeout)
 
         def on_done(result):
             # fires from PendingShuffle.result() — with None on failure —
             # exactly once; the pack buffer stays pinned until the last
             # dispatch has staged it
             self.node.pool.put(stage_buf)
+            release_admitted()
             if result is not None:
                 self._learn_cap(handle, result, int(nvalid.sum()))
                 self.node.metrics.inc("shuffle.rows", float(nvalid.sum()))
@@ -404,16 +711,17 @@ class TpuShuffleManager:
                     pending = submit_shuffle_hierarchical(
                         self.node.mesh, self.conf.mesh_dcn_axis, self.axis,
                         plan, shard_rows, nvalid, vt, val_dtype,
-                        on_done=on_done)
+                        on_done=on_done, admit=admit)
                 else:
                     pending = submit_shuffle(
                         self.exchange_mesh, self.axis, plan,
                         shard_rows, nvalid, vt, val_dtype,
-                        on_done=on_done)
+                        on_done=on_done, admit=admit)
             return pending
         except BaseException:
             if pending is None:
                 self.node.pool.put(stage_buf)
+                release_admitted()
             raise
 
     # -- capacity learning -------------------------------------------------
@@ -624,14 +932,45 @@ class TpuShuffleManager:
             with self._lock:
                 writers = dict(self._writers.get(handle.shuffle_id, {}))
 
-        # only committed outputs enter the exchange; an uncommitted
-        # (half-written) writer for an already-satisfied map id must not
-        # inject partial rows
-        writers = {m: w for m, w in writers.items() if w.committed}
+        committed_ids = sorted(m for m, w in writers.items() if w.committed)
 
         # Local materialize + schema summary (maps round-robin over LOCAL
         # shards: outputs stay on the writing process, like Spark's
-        # executor-local shuffle files).
+        # executor-local shuffle files). Same in-flight-read guard as the
+        # local path: writer-owned memory is only touched through the end
+        # of pack. The snapshot is retaken UNDER the guard — the barrier
+        # loop's snapshot predates registration, so a remesh in between
+        # could otherwise hand us already-released writers.
+        read_gen = self._read_started()
+        try:
+            with self._lock:
+                writers = {
+                    m: w for m, w in
+                    self._writers.get(handle.shuffle_id, {}).items()
+                    if w.committed}
+            # The stale-snapshot verdict must ride a collective: raising
+            # on one process while peers proceed into the schema
+            # allgather would hang them (the barrier loop above rides its
+            # timeout bit through the allgather for exactly this reason)
+            changed = int(sorted(writers) != committed_ids)
+            from sparkucx_tpu.shuffle.distributed import allgather_blob
+            if allgather_blob(np.array([changed], dtype=np.int64)).any():
+                raise RuntimeError(
+                    f"shuffle {handle.shuffle_id}: committed map outputs "
+                    f"changed between the completeness barrier and "
+                    f"staging on at least one process (remesh or "
+                    f"unregister raced this read)")
+            return self._submit_distributed_staged(
+                handle, writers, L, Pn, shard_ids, combine, ordered,
+                tracer)
+        finally:
+            self._read_finished(read_gen)
+
+    def _submit_distributed_staged(self, handle, writers, L, Pn, shard_ids,
+                                   combine, ordered, tracer):
+        from sparkucx_tpu.shuffle.distributed import (
+            allgather_blob, allgather_sizes, submit_shuffle_distributed)
+
         shard_outputs, has_vals, val_tail, val_dtype = \
             self._materialize_outputs(
                 writers, L, lambda ordinal, map_id: ordinal % L)
@@ -687,11 +1026,22 @@ class TpuShuffleManager:
             local_rows, stage_buf = self._pack_shards(
                 shard_outputs, plan.cap_in, width, has_vals)
 
+        # Admission control — the footprint arithmetic is identical on
+        # every process (plan and width agree cluster-wide), so the
+        # processes defer and dispatch in lockstep given the SPMD
+        # submit/result ordering the collective contract already requires.
+        # timeout=None: a local-clock TimeoutError on one process while a
+        # peer proceeds into the collective would diverge the SPMD group
+        # (see _make_admitter)
+        admit, release_admitted = self._make_admitter(
+            plan, width, stage_buf.requested, None)
+
         def on_done(result):
             # fires from PendingDistributedShuffle.result() — with None on
             # failure — exactly once; the pack buffer stays pinned until
             # the last dispatch has staged it
             self.node.pool.put(stage_buf)
+            release_admitted()
             if result is not None:
                 self._learn_cap(handle, result, int(nvalid.sum()))
                 self.node.metrics.inc("shuffle.rows",
@@ -714,11 +1064,12 @@ class TpuShuffleManager:
                     hier_mesh=self.node.mesh if self.hierarchical else None,
                     dcn_axis=self.conf.mesh_dcn_axis
                     if self.hierarchical else None,
-                    on_done=on_done)
+                    on_done=on_done, admit=admit)
             return pending
         except BaseException:
             if pending is None:
                 self.node.pool.put(stage_buf)
+                release_admitted()
             raise
 
     # -- checkpoint support ----------------------------------------------
@@ -731,34 +1082,70 @@ class TpuShuffleManager:
         """{map_id: (keys, values, committed)} staged state for
         runtime.checkpoint.snapshot_shuffles (shape + partitioner come
         from the registry entry — the single source of truth)."""
-        with self._lock:
-            if shuffle_id not in self._writers:
-                raise KeyError(f"shuffle {shuffle_id} not registered")
-            writers = dict(self._writers[shuffle_id])
-        staged = {}
-        for map_id, w in writers.items():
-            keys, values = w.materialize()
-            staged[map_id] = (keys, values, w.committed)
-        return staged
+        # snapshot walks writer-owned memory (spill mmap views) — hold the
+        # in-flight-read guard so a concurrent remesh defers their release
+        # (registered BEFORE the snapshot, like the read paths)
+        read_gen = self._read_started()
+        try:
+            with self._lock:
+                if shuffle_id not in self._writers:
+                    raise KeyError(f"shuffle {shuffle_id} not registered")
+                writers = dict(self._writers[shuffle_id])
+            staged = {}
+            for map_id, w in writers.items():
+                keys, values = w.materialize()
+                # spill materialize returns mmap VIEWS that die with the
+                # writer; copy so the snapshot owns its bytes
+                staged[map_id] = (np.array(keys, copy=True),
+                                  None if values is None
+                                  else np.array(values, copy=True),
+                                  w.committed)
+            return staged
+        finally:
+            self._read_finished(read_gen)
 
     # -- teardown ---------------------------------------------------------
     def unregister_shuffle(self, shuffle_id: int) -> None:
         """Release table + staged buffers
-        (ref: CommonUcxShuffleManager.scala:73-77)."""
+        (ref: CommonUcxShuffleManager.scala:73-77).
+
+        The dropped writers go through the same in-flight-read guard as a
+        remesh drop: a read between its writers snapshot and the end of
+        pack may still be walking these buffers, and an inline release
+        here would be the exact use-after-free the graveyard exists to
+        prevent. With no read in flight they free immediately."""
         with self._lock:
             writers = self._writers.pop(shuffle_id, {})
-        for w in writers.values():
-            w.release()
+            self._gen += 1
+            if writers:
+                self._graveyard.append((self._gen, [writers]))
+            to_free = self._collect_free_graveyard_locked()
+        self._release_writer_batches(to_free)
         self.node.registry.unregister(shuffle_id)
 
-    def stop(self) -> None:
-        """Tear everything down (ref: CommonUcxShuffleManager.scala:82-91)."""
+    def stop(self, drain_timeout: float = 10.0) -> None:
+        """Tear everything down (ref: CommonUcxShuffleManager.scala:82-91).
+
+        Parked graveyard batches may still be walked by an in-flight
+        read's materialize→pack window — drain those reads (bounded) so
+        shutdown does not re-create the use-after-free the graveyard
+        prevents. A read that outlives the drain window gets a warning
+        and its buffers are released anyway (shutdown must terminate)."""
+        import time as _time
         self.node.epochs.remove_listener(self._on_epoch_bump)
-        with self._lock:
+        deadline = _time.monotonic() + drain_timeout
+        with self._inflight_cv:
+            while self._active_reads:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    log.warning(
+                        "stop(): %d reads still in flight after %.0fs "
+                        "drain; releasing their buffers anyway",
+                        sum(self._active_reads.values()), drain_timeout)
+                    break
+                self._inflight_cv.wait(min(remaining, 1.0))
             ids = list(self._writers.keys())
             graveyard, self._graveyard = self._graveyard, []
-        for ws in graveyard:
-            for w in ws.values():
-                w.release()
+        self._release_writer_batches([ws for _, ws in graveyard])
         for sid in ids:
             self.unregister_shuffle(sid)

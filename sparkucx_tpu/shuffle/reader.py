@@ -404,19 +404,43 @@ class PendingExchangeBase:
     multi-process — shuffle/distributed.py subclasses this).
 
     Subclass contract: ``__init__`` must set ``_result = None``,
-    ``_attempt = 0``, ``_on_done = None``, run the first ``_dispatch()``
-    (which sets ``self._out``), and only THEN arm ``_on_done`` — so a
-    dispatch failure inside ``__init__`` leaves cleanup with the caller
-    and this half-built object's ``__del__`` cannot fire the callback a
-    second time (double pool.put of the pinned pack buffer). Subclasses
+    ``_attempt = 0``, ``_on_done = None``, run the first dispatch via
+    ``_initial_dispatch(admit)`` (which sets ``self._out`` — or defers,
+    see below), and only THEN arm ``_on_done`` — so a dispatch failure
+    inside ``__init__`` leaves cleanup with the caller and this
+    half-built object's ``__del__`` cannot fire the callback a second
+    time (double pool.put of the pinned pack buffer). Subclasses
     implement ``_dispatch()`` and ``_result_inner()`` (the overflow-retry
-    loop returning the reader result)."""
+    loop returning the reader result).
+
+    Admission control: ``admit`` is None (no cap) or a callable
+    ``admit(block: bool) -> bool`` from the manager's maxBytesInFlight
+    accounting. When the submit-time non-blocking attempt fails, the
+    exchange QUEUES — ``done()`` stays False and the dispatch happens
+    inside ``result()`` once earlier exchanges release capacity (the
+    deferred-request model of Spark's ShuffleBlockFetcherIterator,
+    ref: UcxShuffleReader.scala:56-70 — a blocking submit would deadlock
+    a single-threaded caller that resolves handles in order)."""
+
+    def _initial_dispatch(self, admit) -> None:
+        self._admit_cb = None
+        self._dead = False
+        self._out = None
+        if admit is None or admit(False):
+            self._dispatch()
+        else:
+            self._admit_cb = admit   # deferred: dispatch in result()
 
     def done(self) -> bool:
         """True once the current attempt's outputs are computed on device
-        (local poll; result() then blocks only on D2H / consensus)."""
-        if self._result is not None:
+        (local poll; result() then blocks only on D2H / consensus).
+        A handle whose result() failed reports done (completed
+        exceptionally, the Future convention); retrying raises."""
+        if self._result is not None or getattr(self, "_dead", False):
             return True
+        if getattr(self, "_admit_cb", None) is not None \
+                or self._out is None:
+            return False             # queued behind maxBytesInFlight
         try:
             return all(bool(x.is_ready()) for x in self._out)
         except AttributeError:  # backend array without is_ready
@@ -440,9 +464,23 @@ class PendingExchangeBase:
     def result(self):
         if self._result is not None:
             return self._result
+        if getattr(self, "_dead", False):
+            raise RuntimeError(
+                "exchange handle is dead: a previous result() failed and "
+                "its buffers were released — re-submit the shuffle")
         try:
+            if getattr(self, "_admit_cb", None) is not None:
+                # queued submit: wait for capacity, then run the deferred
+                # first dispatch (raises TimeoutError if nothing frees)
+                admit, self._admit_cb = self._admit_cb, None
+                admit(True)
+                self._dispatch()
             res = self._result_inner()
         except Exception:
+            # on_done fires exactly once and releases the pinned pack
+            # buffer, so the handle cannot be retried — mark it dead for a
+            # clear error instead of an AttributeError on stale state
+            self._dead = True
             self._notify(None)
             raise
         self._result = res
@@ -468,7 +506,7 @@ class PendingShuffle(PendingExchangeBase):
     def __init__(self, build_step, sharding, plan: ShufflePlan,
                  shard_rows: np.ndarray, shard_nvalid: np.ndarray,
                  val_shape, val_dtype, on_done=None,
-                 per_shard_segs: bool = False):
+                 per_shard_segs: bool = False, admit=None):
         self._build_step = build_step
         self._sharding = sharding
         self._plan = plan
@@ -480,7 +518,7 @@ class PendingShuffle(PendingExchangeBase):
         self._on_done = None
         self._result: Optional[ShuffleReaderResult] = None
         self._attempt = 0
-        self._dispatch()
+        self._initial_dispatch(admit)
         self._on_done = on_done
 
     def _dispatch(self) -> None:
@@ -528,6 +566,7 @@ def submit_shuffle(
     val_shape: Optional[Tuple[int, ...]],
     val_dtype,
     on_done=None,
+    admit=None,
 ) -> PendingShuffle:
     """Dispatch the exchange without blocking (see :class:`PendingShuffle`).
 
@@ -539,7 +578,7 @@ def submit_shuffle(
     return PendingShuffle(
         lambda p: _build_step(mesh, axis, p, width),
         NamedSharding(mesh, P(axis)), plan, shard_rows, shard_nvalid,
-        val_shape, val_dtype, on_done=on_done,
+        val_shape, val_dtype, on_done=on_done, admit=admit,
         # combined/ordered output is one run per partition: the seg matrix
         # is each shard's own [1, R] counts, sharded like the rows
         per_shard_segs=bool(plan.combine or plan.ordered))

@@ -218,3 +218,46 @@ def test_snapshot_preserves_range_bounds(manager_factory, rng, tmp_path):
     for r, (ks, _) in res.partitions():
         cat.extend(ks.tolist())
     assert cat == sorted(allk)
+
+
+def test_restore_failure_unregisters_and_carries_handles(
+        manager_factory, tmp_path, rng):
+    """A snapshot that fails AFTER register_shuffle succeeds must not stay
+    half-registered (retry would hit 'already registered'; reads would
+    block on maps that never publish) — and the shuffles that DID restore
+    must remain reachable via the exception's .handles (round-2 advisor:
+    the manager exposes no handle-by-id API)."""
+    mgr = manager_factory()
+    for sid in (930, 931):
+        h = mgr.register_shuffle(sid, 1, 2)
+        w = mgr.get_writer(h, 0)
+        w.write(rng.integers(0, 10, size=4).astype(np.int64))
+        w.commit(2)
+    snap = str(tmp_path / "snap_partial")
+    assert snapshot_shuffles(mgr, snap) == 2
+    mgr.unregister_shuffle(930)
+    mgr.unregister_shuffle(931)
+
+    # corrupt 931's staged keys to 2-D: register_shuffle succeeds, then
+    # writer.write raises — the post-registration failure mode
+    import os
+    path = os.path.join(snap, "shuffle_931.npz")
+    data = dict(np.load(path))
+    data["keys_0"] = data["keys_0"].reshape(2, 2)
+    np.savez_compressed(path, **data)
+
+    with pytest.raises(RuntimeError, match="1 failed") as ei:
+        restore_shuffles(mgr, snap)
+    # the restored shuffle's handle rides on the exception
+    assert sorted(ei.value.handles) == [930]
+    assert mgr.read(ei.value.handles[930]).partition(0)[0].shape[0] >= 0
+
+    # the FAILED shuffle left no partial registration: fixing the file and
+    # retrying it restores cleanly (no 'already registered')
+    data["keys_0"] = data["keys_0"].reshape(-1)
+    np.savez_compressed(path, **data)
+    os.unlink(os.path.join(snap, "shuffle_930.npz"))
+    handles = restore_shuffles(mgr, snap)
+    assert sorted(handles) == [931]
+    mgr.unregister_shuffle(930)
+    mgr.unregister_shuffle(931)

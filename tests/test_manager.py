@@ -366,3 +366,162 @@ def test_read_partitions_range(manager):
     with pytest.raises(IndexError):
         list(manager.read_partitions(h, 5, 9))
     manager.unregister_shuffle(77)
+
+
+def test_warmup_precompiles_the_read_step(manager, rng):
+    """warmup(handle) must leave the exchange step compiled so the first
+    real read() is a jit-cache hit — the preconnect analog (ref:
+    UcxWorkerWrapper.scala:125-127: dial every peer while the map publish
+    is in flight, so the first fetch pays no setup)."""
+    from sparkucx_tpu.shuffle import reader as reader_mod
+
+    h = manager.register_shuffle(97, num_maps=4, num_partitions=16)
+    plan = manager.warmup(h, rows_per_map=100)
+    width = 2  # keys-only
+    step = reader_mod._build_step(manager.exchange_mesh, manager.axis,
+                                  plan, width)
+    assert step._cache_size() == 1, "warmup must have executed the step"
+
+    for mid in range(4):
+        w = manager.get_writer(h, mid)
+        w.write(rng.integers(0, 1 << 40, size=100).astype(np.int64))
+        w.commit(16)
+    res = manager.read(h)
+    assert sum(res.partition(r)[0].shape[0]
+               for r in range(16)) == 400
+    # same lru entry, no new compile: the read's plan matched the warmed
+    # plan and hit the warmed executable
+    step_after = reader_mod._build_step(manager.exchange_mesh,
+                                        manager.axis, plan, width)
+    assert step_after is step
+    assert step._cache_size() == 1, \
+        "first read after warmup must not compile a second program"
+
+
+def test_warmup_argument_validation(manager):
+    h = manager.register_shuffle(98, num_maps=2, num_partitions=4)
+    with pytest.raises(ValueError, match="exactly one"):
+        manager.warmup(h)
+    with pytest.raises(ValueError, match="exactly one"):
+        manager.warmup(h, rows_per_map=10, rows_per_shard=[1] * 8)
+    with pytest.raises(ValueError, match="rows_per_shard must be"):
+        manager.warmup(h, rows_per_shard=[1, 2])
+
+
+def test_max_bytes_in_flight_queues_and_completes(mesh8, rng):
+    """Three pipelined submits under a cap that fits roughly one exchange:
+    later submits queue (done() False, no dispatch) and complete when
+    earlier results release capacity — Spark's maxBytesInFlight throttle
+    (ref: UcxShuffleReader.scala:56-70), as a deferred-dispatch queue
+    because a blocking submit would deadlock the single-threaded caller
+    that resolves handles in order."""
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+        # roughly one exchange's footprint at this shape: cap_in ~ 1000
+        # rows x 2 words x 4 B x 8 shards plus pack buffer + cap_out
+        "spark.shuffle.tpu.a2a.maxBytesInFlight": "200k",
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    m = TpuShuffleManager(node, conf)
+    try:
+        pendings, expected = [], {}
+        for sid in range(3):
+            h = m.register_shuffle(sid, 2, 8)
+            keys = rng.integers(0, 1 << 40, size=2000).astype(np.int64)
+            expected[sid] = np.sort(keys)
+            for mid in range(2):
+                w = m.get_writer(h, mid)
+                w.write(keys[mid * 1000:(mid + 1) * 1000])
+                w.commit(8)
+            pendings.append(m.submit(h))
+        # at least one later submit must have been deferred by the cap
+        assert any(not p.done() for p in pendings[1:]), \
+            "cap of ~1 exchange must defer at least one of 3 submits"
+        assert m._inflight_bytes > 0
+        for sid, p in enumerate(pendings):
+            res = p.result()
+            got = np.sort(np.concatenate(
+                [res.partition(r)[0] for r in range(8)]))
+            np.testing.assert_array_equal(got, expected[sid])
+        assert m._inflight_bytes == 0, "all reservations must be released"
+    finally:
+        m.stop()
+        node.close()
+
+
+def test_max_bytes_in_flight_single_big_exchange_admitted(mesh8, rng):
+    """An exchange larger than the cap must still run (admitted alone) —
+    the cap is backpressure, not a hard rejection."""
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+        "spark.shuffle.tpu.a2a.maxBytesInFlight": "1k",
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    m = TpuShuffleManager(node, conf)
+    try:
+        h = m.register_shuffle(7, 1, 4)
+        keys = rng.integers(0, 1 << 40, size=5000).astype(np.int64)
+        w = m.get_writer(h, 0)
+        w.write(keys)
+        w.commit(4)
+        res = m.read(h)
+        got = np.sort(np.concatenate(
+            [res.partition(r)[0] for r in range(4)]))
+        np.testing.assert_array_equal(got, np.sort(keys))
+    finally:
+        m.stop()
+        node.close()
+
+
+def test_max_bytes_in_flight_fifo_no_starvation(mesh8, rng):
+    """A later submit must NOT steal capacity freed for an earlier
+    deferred exchange: resolve-in-submit-order always completes without
+    timeouts (the FIFO deferral of Spark's fetch iterator)."""
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+        "spark.shuffle.tpu.a2a.maxBytesInFlight": "200k",
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    m = TpuShuffleManager(node, conf)
+    try:
+        def make(sid):
+            h = m.register_shuffle(sid, 1, 8)
+            keys = rng.integers(0, 1 << 40, size=2000).astype(np.int64)
+            w = m.get_writer(h, 0)
+            w.write(keys)
+            w.commit(8)
+            return keys, m.submit(h)
+
+        ka, pa = make(0)
+        kb, pb = make(1)          # deferred (cap fits ~one exchange)
+        assert not pb.done()
+        ra = pa.result()          # frees capacity...
+        kc, pc = make(2)          # ...which C must NOT steal from B
+        assert not pc.done()
+        for keys, p in ((ka, None), (kb, pb), (kc, pc)):
+            res = ra if p is None else p.result()
+            got = np.sort(np.concatenate(
+                [res.partition(r)[0] for r in range(8)]))
+            np.testing.assert_array_equal(got, np.sort(keys))
+        assert m._inflight_bytes == 0 and not m._admit_queue
+    finally:
+        m.stop()
+        node.close()
+
+
+def test_unregister_deferred_while_read_in_flight(manager, rng):
+    """unregister_shuffle during a read's materialize->pack window must
+    park the writers in the graveyard, not release them inline (same
+    use-after-free as the remesh path)."""
+    h = manager.register_shuffle(60, 1, 4)
+    w = manager.get_writer(h, 0)
+    w.write(rng.integers(0, 1 << 30, size=64).astype(np.int64))
+    w.commit(4)
+    in_use = manager.node.pool.stats()["in_use"]
+    assert in_use > 0
+    g = manager._read_started()           # a read is mid-materialize
+    manager.unregister_shuffle(60)
+    assert manager.node.pool.stats()["in_use"] == in_use, \
+        "buffers must survive until the in-flight read finishes"
+    manager._read_finished(g)
+    assert manager.node.pool.stats()["in_use"] < in_use

@@ -82,3 +82,53 @@ def test_epoch_bump_releases_writer_buffers(manager_factory, rng, tmp_path):
     assert m.node.pool.stats()["in_use"] < in_use_before
     assert not [f for f in os.listdir(tmp_path) if "88" in f], \
         "spill files must be deleted within one epoch of the bump"
+
+
+def test_graveyard_held_while_read_in_flight(manager_factory, rng,
+                                             tmp_path):
+    """Two remeshes in quick succession must NOT release a dropped
+    writer's buffers while a read that started before the first bump is
+    still walking them (round-2 advisor: the fixed one-epoch deferral
+    still raced a slow read). Release happens when the last such read
+    finishes."""
+    import os
+
+    m = manager_factory({
+        "spark.shuffle.tpu.spill.threshold": "4k",
+        "spark.shuffle.tpu.spill.dir": str(tmp_path)})
+    h = m.register_shuffle(90, 2, 4)
+    w = m.get_writer(h, 0)
+    w.write(rng.integers(0, 1 << 30, size=3000).astype(np.int64))  # spills
+    w.commit(4)
+    w2 = m.get_writer(h, 1)
+    w2.write(rng.integers(0, 1 << 30, size=64).astype(np.int64))   # arena
+    w2.commit(4)
+    in_use_before = m.node.pool.stats()["in_use"]
+    assert in_use_before > 0
+
+    g = m._read_started()                       # a read is mid-materialize
+    m.node.epochs.bump("first remesh")
+    m.node.epochs.bump("second remesh")
+    # both bumps done — the batch is still parked (the old code freed it
+    # at the second bump)
+    assert m.node.pool.stats()["in_use"] == in_use_before
+    assert [f for f in os.listdir(tmp_path) if "90" in f], \
+        "spill files must survive while the read is in flight"
+
+    m._read_finished(g)                         # read window closes
+    assert m.node.pool.stats()["in_use"] < in_use_before
+    assert not [f for f in os.listdir(tmp_path) if "90" in f]
+
+
+def test_graveyard_freed_immediately_when_idle(manager_factory, rng):
+    """With no read in flight, a bump releases dropped writers at the
+    bump itself — no deferral needed."""
+    m = manager_factory()
+    h = m.register_shuffle(91, 1, 4)
+    w = m.get_writer(h, 0)
+    w.write(rng.integers(0, 1 << 30, size=64).astype(np.int64))
+    w.commit(4)
+    in_use_before = m.node.pool.stats()["in_use"]
+    assert in_use_before > 0
+    m.node.epochs.bump("remesh")
+    assert m.node.pool.stats()["in_use"] < in_use_before
