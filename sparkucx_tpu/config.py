@@ -97,6 +97,7 @@ class TpuShuffleConf:
     # rides inside SparkConf, which never rejects keys).
     _EXTERNAL_KEYS = (
         "a2a.hierarchical", "io.format", "io.keyColumn",
+        "io.stringMaxBytes",
         "trace.enabled", "trace.device", "trace.capacity",
         "failure.maxAttempts", "failure.backoffMs")
     _KEY_FAMILIES = ("fault.",)   # covers fault.seed + per-site arming keys

@@ -4,7 +4,12 @@ BASELINE.json's north star has fetched bytes land back as Arrow columnar
 batches for the host framework's reducers (the Spark-RAPIDS-style columnar
 interop config). This module converts between Arrow RecordBatches and the
 writer/reader surfaces: a batch's key column routes the shuffle, the
-remaining fixed-width columns ride as the fused value payload."""
+remaining columns ride as the fused value payload — numeric columns as
+lossless int64 carriers, string/binary columns as length-prefixed padded
+varlen byte lanes (io/varlen.py), so a TPC-DS string column shuffles the
+way the reference moves any serialized bytes (ref: reducer/compat/
+spark_3_0/OnOffsetsFetchCallback.java:44-66 — blocks are opaque byte
+ranges)."""
 
 from __future__ import annotations
 
@@ -23,6 +28,14 @@ except Exception:  # pragma: no cover - pyarrow is in the image
 def _require_arrow() -> None:
     if not HAVE_ARROW:
         raise RuntimeError("pyarrow is not available in this environment")
+
+
+# recipe entry for a varlen column: (kind, declared max payload bytes,
+# int64 carrier lanes) — kind "utf8" reconstructs a pa.string() column,
+# "binary" a pa.binary() column. Numeric entries stay plain np.dtype.
+def _varlen_lanes(max_bytes: int) -> int:
+    from sparkucx_tpu.io.varlen import varbytes_width
+    return (varbytes_width(max_bytes) + 7) // 8
 
 
 def _widen_bits(arr: np.ndarray) -> np.ndarray:
@@ -46,13 +59,40 @@ def _narrow_bits(carrier: np.ndarray, dtype: np.dtype) -> np.ndarray:
     return np.ascontiguousarray(carrier).view(np.float64).astype(dtype)
 
 
-def batch_to_kv(batch: "pa.RecordBatch", key_column: str,
-                ) -> Tuple[np.ndarray, Optional[np.ndarray], List[np.dtype]]:
-    """RecordBatch -> (keys int64, values [n, ncols] int64 carrier, dtypes).
+def _encode_varlen_col(col: "pa.Array", name: str,
+                       max_bytes: int) -> Tuple[np.ndarray, tuple]:
+    """String/binary column -> [n, lanes] int64 varlen carrier + recipe."""
+    from sparkucx_tpu.io.varlen import pack_varbytes
+    if col.null_count:
+        raise ValueError(
+            f"column {name!r} has {col.null_count} nulls; varlen shuffle "
+            f"carries exact bytes — fill or drop nulls first")
+    kind = "utf8" if pa.types.is_string(col.type) \
+        or pa.types.is_large_string(col.type) else "binary"
+    items = col.to_pylist()
+    packed = pack_varbytes(items, max_bytes)          # [n, 4+pad4(max)]
+    lanes = _varlen_lanes(max_bytes)
+    padded = np.zeros((packed.shape[0], lanes * 8), np.uint8)
+    padded[:, :packed.shape[1]] = packed
+    return padded.view(np.int64), (kind, int(max_bytes), lanes)
 
-    Fixed-width numeric columns only (the columnar-shuffle contract).
-    Each value column rides as a lossless int64 carrier; ``dtypes`` is the
-    per-column recipe :func:`kv_to_batch` uses to reconstruct exactly."""
+
+def _is_varlen_type(t) -> bool:
+    return (pa.types.is_string(t) or pa.types.is_large_string(t)
+            or pa.types.is_binary(t) or pa.types.is_large_binary(t))
+
+
+def batch_to_kv(batch: "pa.RecordBatch", key_column: str,
+                string_max_bytes: int = 64,
+                ) -> Tuple[np.ndarray, Optional[np.ndarray], List]:
+    """RecordBatch -> (keys int64, values [n, lanes] int64 carrier,
+    recipe).
+
+    Numeric value columns ride as one lossless int64 carrier lane each;
+    string/binary columns as ``_varlen_lanes(string_max_bytes)`` lanes of
+    length-prefixed padded bytes (never truncated — an over-long record
+    raises). ``recipe`` is the per-column reconstruction spec
+    :func:`kv_to_batch` uses to rebuild the exact schema."""
     _require_arrow()
     names = [f for f in batch.schema.names if f != key_column]
     if key_column not in batch.schema.names:
@@ -63,12 +103,23 @@ def batch_to_kv(batch: "pa.RecordBatch", key_column: str,
     keys = keys.astype(np.int64, copy=False)
     if not names:
         return keys, None, []
-    cols, dtypes = [], []
+    cols, recipe = [], []
     for name in names:
-        arr = batch.column(name).to_numpy(zero_copy_only=False)
-        cols.append(_widen_bits(arr))
-        dtypes.append(arr.dtype)
-    return keys, np.stack(cols, axis=1), dtypes
+        col = batch.column(name)
+        if _is_varlen_type(col.type):
+            lanes, entry = _encode_varlen_col(col, name, string_max_bytes)
+            cols.append(lanes)
+            recipe.append(entry)
+        else:
+            arr = col.to_numpy(zero_copy_only=False)
+            cols.append(_widen_bits(arr).reshape(-1, 1))
+            recipe.append(arr.dtype)
+    return keys, np.concatenate(cols, axis=1), recipe
+
+
+def _lanes_of(entry) -> int:
+    """int64 carrier lanes one recipe entry consumes."""
+    return entry[2] if isinstance(entry, tuple) else 1
 
 
 def kv_to_batch(keys: np.ndarray, values: Optional[np.ndarray],
@@ -76,45 +127,76 @@ def kv_to_batch(keys: np.ndarray, values: Optional[np.ndarray],
                 value_columns: Optional[Sequence[str]] = None,
                 value_dtypes: Optional[Sequence] = None,
                 ) -> "pa.RecordBatch":
-    """(keys, int64-carrier values, dtypes) -> RecordBatch; exact inverse
-    of batch_to_kv. Without ``value_dtypes``, columns come back int64."""
+    """(keys, int64-carrier values, recipe) -> RecordBatch; exact inverse
+    of batch_to_kv. ``value_dtypes`` entries are np.dtype (numeric, one
+    lane) or ("utf8"|"binary", max_bytes, lanes) varlen specs. Without
+    ``value_dtypes``, every lane comes back as an int64 column."""
+    from sparkucx_tpu.io.varlen import unpack_varbytes, varbytes_width
     _require_arrow()
     arrays = [pa.array(np.ascontiguousarray(keys))]
     names = [key_column]
     if values is not None:
-        ncols = values.shape[1] if values.ndim > 1 else 1
-        vals2d = values.reshape(len(keys), ncols) if len(keys) else \
-            values.reshape(0, ncols)
+        nlanes = values.shape[1] if values.ndim > 1 else 1
+        vals2d = values.reshape(len(keys), nlanes) if len(keys) else \
+            values.reshape(0, nlanes)
+        if value_dtypes is None:
+            value_dtypes = [np.int64] * nlanes
+        value_dtypes = list(value_dtypes)
+        need = sum(_lanes_of(e) for e in value_dtypes)
+        if need != nlanes:
+            raise ValueError(
+                f"recipe consumes {need} carrier lanes but values have "
+                f"{nlanes}")
         value_columns = list(value_columns or
-                             [f"v{i}" for i in range(ncols)])
-        if len(value_columns) != ncols:
+                             [f"v{i}" for i in range(len(value_dtypes))])
+        if len(value_columns) != len(value_dtypes):
             raise ValueError(
-                f"{len(value_columns)} names for {ncols} value columns")
-        value_dtypes = list(value_dtypes or [np.int64] * ncols)
-        if len(value_dtypes) != ncols:
-            raise ValueError(
-                f"{len(value_dtypes)} dtypes for {ncols} value columns")
-        for i, name in enumerate(value_columns):
-            col = _narrow_bits(
-                np.ascontiguousarray(vals2d[:, i]).astype(np.int64),
-                value_dtypes[i])
-            arrays.append(pa.array(col))
+                f"{len(value_columns)} names for {len(value_dtypes)} "
+                f"value columns")
+        lane = 0
+        for name, entry in zip(value_columns, value_dtypes):
+            w = _lanes_of(entry)
+            block = vals2d[:, lane:lane + w]
+            lane += w
+            if isinstance(entry, tuple):
+                kind, max_bytes, _ = entry
+                # explicit byte width, not -1: reshape cannot infer an
+                # axis for a zero-row partition
+                raw = np.ascontiguousarray(
+                    block.astype(np.int64)).view(np.uint8).reshape(
+                        len(keys), w * 8)[:, :varbytes_width(max_bytes)]
+                items = unpack_varbytes(raw)
+                if kind == "utf8":
+                    arrays.append(pa.array(
+                        [b.decode("utf-8") for b in items],
+                        type=pa.string()))
+                else:
+                    arrays.append(pa.array(items, type=pa.binary()))
+            else:
+                col = _narrow_bits(
+                    np.ascontiguousarray(block[:, 0]).astype(np.int64),
+                    entry)
+                arrays.append(pa.array(col))
             names.append(name)
     return pa.RecordBatch.from_arrays(arrays, names=names)
 
 
 def write_batches(manager, handle, map_id: int,
                   batches: Sequence["pa.RecordBatch"], key_column: str,
-                  num_partitions: Optional[int] = None) -> List[np.dtype]:
+                  num_partitions: Optional[int] = None,
+                  string_max_bytes: int = 64) -> List:
     """Stage Arrow batches into one map output and commit. Returns the
-    value-column dtype recipe (also stashed on the handle for
-    read_batches)."""
+    value-column recipe (also stashed on the handle for read_batches).
+    ``string_max_bytes`` is the declared per-record ceiling for string/
+    binary columns (part of the schema: every map task of a shuffle must
+    pass the same value or the recipe check fails loudly)."""
     _require_arrow()
     w = manager.get_writer(handle, map_id)
-    recipe: Optional[List[np.dtype]] = None
+    recipe: Optional[List] = None
     names: Optional[List[str]] = None
     for b in batches:
-        keys, values, dtypes = batch_to_kv(b, key_column)
+        keys, values, dtypes = batch_to_kv(b, key_column,
+                                           string_max_bytes)
         if not keys.shape[0]:
             continue
         bnames = [f for f in b.schema.names if f != key_column]

@@ -1,0 +1,162 @@
+"""Variable-length / opaque-byte payloads over the fixed-width transport.
+
+The reference shuffles *arbitrary serialized record bytes*: a block is
+whatever byte range Spark's serializer wrote, located by index-file
+offsets — the transport never interprets it
+(ref: reducer/compat/spark_3_0/OnOffsetsFetchCallback.java:44-66,
+CommonUcxShuffleBlockResolver.scala:45-57 mmaps whatever was serialized).
+The TPU exchange, by contrast, is an XLA collective and needs STATIC
+shapes (SURVEY.md §7 hard part (a)) — so opaque bytes ride as
+length-prefixed, padded byte rows:
+
+    [ len : int32 LE | payload bytes | zero pad to a fixed width ]
+
+packed little-endian into the int32 value lanes of the normal transport
+row. The pad ceiling is per-shuffle (the declared record-size bound, the
+moral analog of Spark's max record size for serialized shuffle); skew in
+record length costs pad bytes on the wire, not correctness. The length
+prefix — not a sentinel — delimits, so NUL bytes and empty payloads
+round-trip exactly.
+
+Keys stay int64 (the transport's routing type). For string keys (real
+WordCount, TPC-DS varchar joins), :func:`hash_bytes64` derives a
+deterministic 64-bit key from the bytes (FNV-1a); the bytes themselves
+ride as (part of) the value payload so the reduce side can recover the
+exact key. A 64-bit collision merges two distinct keys — probability
+~n^2/2^65, negligible at any realistic cardinality, and detectable
+because the carried bytes disagree.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple, Union
+
+import numpy as np
+
+Item = Union[bytes, bytearray, str]
+
+
+def _as_bytes_list(items: Sequence[Item]) -> List[bytes]:
+    out = []
+    for x in items:
+        if isinstance(x, str):
+            out.append(x.encode("utf-8"))
+        elif isinstance(x, (bytes, bytearray, np.bytes_)):
+            out.append(bytes(x))
+        else:
+            raise TypeError(
+                f"varbytes items must be bytes/str, got {type(x).__name__}")
+    return out
+
+
+def varbytes_width(max_bytes: int) -> int:
+    """Total uint8 row width for a payload ceiling: 4-byte length prefix
+    plus the payload padded up to a multiple of 4 (whole transport
+    words)."""
+    if max_bytes < 0:
+        raise ValueError("max_bytes must be >= 0")
+    return 4 + ((int(max_bytes) + 3) // 4) * 4
+
+
+def varbytes_words(max_bytes: int) -> int:
+    """Value width in int32 transport words for a payload ceiling."""
+    return varbytes_width(max_bytes) // 4
+
+
+def pack_varbytes(items: Sequence[Item], max_bytes: int) -> np.ndarray:
+    """Encode items as [n, varbytes_width(max_bytes)] uint8 rows.
+
+    Raises when any item exceeds ``max_bytes`` — silent truncation would
+    corrupt records, which the reference's byte-range transport can never
+    do."""
+    data = _as_bytes_list(items)
+    width = varbytes_width(max_bytes)
+    out = np.zeros((len(data), width), dtype=np.uint8)
+    for i, b in enumerate(data):
+        n = len(b)
+        if n > max_bytes:
+            raise ValueError(
+                f"item {i} is {n} B > declared max_bytes={max_bytes}; "
+                f"raise the ceiling (records are never truncated)")
+        out[i, :4] = np.frombuffer(
+            np.int32(n).tobytes(), dtype=np.uint8)
+        if n:
+            out[i, 4:4 + n] = np.frombuffer(b, dtype=np.uint8)
+    return out
+
+
+def unpack_varbytes(rows: np.ndarray) -> List[bytes]:
+    """Decode [n, width] uint8 (or int32-viewed) varbytes rows."""
+    rows = np.ascontiguousarray(rows)
+    if rows.dtype != np.uint8:
+        rows = rows.view(np.uint8).reshape(rows.shape[0], -1)
+    if rows.ndim != 2 or rows.shape[1] < 4:
+        raise ValueError(f"varbytes rows must be [n, >=4], got {rows.shape}")
+    lens = rows[:, :4].copy().view(np.int32).reshape(-1)
+    limit = rows.shape[1] - 4
+    out = []
+    for i, n in enumerate(lens):
+        n = int(n)
+        if n < 0 or n > limit:
+            raise ValueError(
+                f"row {i}: corrupt varbytes length {n} (row width {limit})")
+        out.append(rows[i, 4:4 + n].tobytes())
+    return out
+
+
+_FNV_OFFSET = np.uint64(0xCBF29CE484222325)
+_FNV_PRIME = np.uint64(0x100000001B3)
+
+
+def hash_bytes64(items: Sequence[Item]) -> np.ndarray:
+    """Deterministic FNV-1a 64-bit hash per item -> int64 keys.
+
+    Vectorized across rows (one masked update per byte position), so
+    hashing a million short words is a handful of numpy passes, not a
+    Python loop per byte. Identical across hosts — the same requirement
+    the routing hash has (ops/partition.hash32)."""
+    data = _as_bytes_list(items)
+    n = len(data)
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    lens = np.fromiter((len(b) for b in data), dtype=np.int64, count=n)
+    width = max(1, int(lens.max()))
+    mat = np.zeros((n, width), dtype=np.uint8)
+    for i, b in enumerate(data):
+        if b:
+            mat[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+    h = np.full(n, _FNV_OFFSET, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        for j in range(width):
+            active = j < lens
+            hj = (h ^ mat[:, j].astype(np.uint64)) * _FNV_PRIME
+            h = np.where(active, hj, h)
+    return h.view(np.int64)
+
+
+def pack_counted_varbytes(items: Sequence[Item], counts: np.ndarray,
+                          max_bytes: int) -> Tuple[np.ndarray, int]:
+    """WordCount-shaped value rows: [count : int32 | varbytes(item)] as an
+    [n, 1 + varbytes_words] INT32 matrix (one homogeneous combine-capable
+    dtype). The count lane is summed by the device combiner; the byte
+    lanes are CARRIED (all rows of one key hold the same bytes, so any
+    representative survives — plan.combine_sum_words=1).
+
+    Returns (values int32 [n, w], sum_words=1)."""
+    counts = np.asarray(counts, dtype=np.int32)
+    vb = pack_varbytes(items, max_bytes)
+    if counts.shape != (vb.shape[0],):
+        raise ValueError(
+            f"counts shape {counts.shape} != items {vb.shape[0]}")
+    words = vb.view(np.int32).reshape(vb.shape[0], -1)
+    return np.concatenate([counts.reshape(-1, 1), words], axis=1), 1
+
+
+def unpack_counted_varbytes(values: np.ndarray
+                            ) -> Tuple[np.ndarray, List[bytes]]:
+    """Inverse of pack_counted_varbytes: (counts int64, items)."""
+    values = np.ascontiguousarray(values)
+    if values.dtype != np.int32:
+        raise ValueError(f"expected int32 value rows, got {values.dtype}")
+    counts = values[:, 0].astype(np.int64)
+    return counts, unpack_varbytes(values[:, 1:])

@@ -130,6 +130,7 @@ def combine_rows(
     val_words_n: int,
     val_dtype,
     op: str = "sum",
+    sum_words: int = 0,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Group rows by (partition, int64 key) and combine values per group.
 
@@ -140,6 +141,14 @@ def combine_rows(
     num_parts  — static partition count R.
     val_words_n— value width in int32 words.
     val_dtype  — declared numeric dtype (validated by check_combinable).
+    sum_words  — transport words (from the value's start) the combiner
+                 SUMS; the remaining ``val_words_n - sum_words`` words are
+                 CARRIED — one representative per key survives, byte-
+                 identical. 0 means sum everything (the default). Carried
+                 lanes hold per-key-constant payloads, e.g. the
+                 length-prefixed word bytes of a text WordCount
+                 (io/varlen.py pack_counted_varbytes): equal within a key
+                 by construction, so any representative is THE value.
 
     Returns (rows_out [cap, W], pcounts [num_parts], n_out [1]):
     rows_out's first n_out rows are one row per distinct (partition, key),
@@ -147,6 +156,14 @@ def combine_rows(
     each partition; pcounts[r] = distinct keys of partition r. Rows past
     n_out are zero."""
     vdt = np.dtype(val_dtype)
+    if sum_words > val_words_n:
+        # same check _decorated_plan applies — a silent clamp here would
+        # sum carried payload bytes on a caller bug, corrupting records
+        raise ValueError(
+            f"sum_words={sum_words} > value width {val_words_n} words")
+    if sum_words <= 0:
+        sum_words = val_words_n
+    carry_n = val_words_n - sum_words
     cap, W = rows.shape
     idx = jnp.arange(cap, dtype=jnp.int32)
     valid = idx < num_valid
@@ -166,25 +183,29 @@ def combine_rows(
     n_out = is_start.sum().astype(jnp.int32)
     is_end = valid & (jnp.roll(is_start, -1) | (idx == num_valid - 1))
 
-    # ---- inclusive prefix sums of the (masked) values -------------------
-    vals = _words_to_vals(srows[:, 2:2 + val_words_n], vdt)
+    # ---- inclusive prefix sums of the (masked) summed lanes -------------
+    vals = _words_to_vals(srows[:, 2:2 + sum_words], vdt)
     acc_dt = jnp.float32 if np.issubdtype(vdt, np.floating) else jnp.int32
     acc = jnp.where(valid[:, None], vals.astype(acc_dt), 0)
     incl = jnp.cumsum(acc, axis=0)                        # [cap, m]
 
     # ---- compact end rows to the front, CARRYING their columns ----------
     # One stable 1-key sort moves every segment-end row (keys, partition,
-    # prefix-sum lanes) to the front in (partition, key) order. Round-2
-    # lesson from the v5e: a [2M]-row gather costs ~55 ms while a carried
-    # multisort operand is nearly free — the previous formulation did FOUR
-    # such gathers (seg_end, starts, key_cols, spart) and spent 287 ms at
-    # 2M rows; this one does zero.
+    # prefix-sum lanes, carried payload words) to the front in
+    # (partition, key) order. Round-2 lesson from the v5e: a [2M]-row
+    # gather costs ~55 ms while a carried multisort operand is nearly
+    # free — the previous formulation did FOUR such gathers (seg_end,
+    # starts, key_cols, spart) and spent 287 ms at 2M rows; this one does
+    # zero. Carried value lanes ride the same sort: the end row IS the
+    # representative, no differencing.
     flag = jnp.where(is_end, 0, 1).astype(jnp.int32)
+    m = incl.shape[1]
     sort_ops = (flag, srows[:, 0], srows[:, 1], spart) \
-        + tuple(incl[:, t] for t in range(incl.shape[1]))
+        + tuple(incl[:, t] for t in range(m)) \
+        + tuple(srows[:, 2 + sum_words + t] for t in range(carry_n))
     out = jax.lax.sort(sort_ops, num_keys=1, is_stable=True)
     klo, khi, epart = out[1], out[2], out[3]
-    ends_incl = jnp.stack(out[4:], axis=1)                # [cap, m]
+    ends_incl = jnp.stack(out[4:4 + m], axis=1)           # [cap, m]
 
     # ---- segment sums = first differences of end-row prefix sums --------
     live = idx < n_out
@@ -193,10 +214,13 @@ def combine_rows(
          ends_incl[:-1]], axis=0)
     seg_sum = jnp.where(live[:, None], ends_incl - prev, 0).astype(vals.dtype)
 
-    words = _vals_to_words(seg_sum, vdt, val_words_n)
-    rows_out = jnp.concatenate(
-        [jnp.stack([klo, khi], axis=1), words,
-         jnp.zeros((cap, W - 2 - val_words_n), jnp.int32)], axis=1)
+    pieces = [jnp.stack([klo, khi], axis=1),
+              _vals_to_words(seg_sum, vdt, sum_words)]
+    if carry_n:
+        pieces.append(jnp.stack(out[4 + m:], axis=1))     # [cap, carry_n]
+    if W - 2 - val_words_n:
+        pieces.append(jnp.zeros((cap, W - 2 - val_words_n), jnp.int32))
+    rows_out = jnp.concatenate(pieces, axis=1)
     rows_out = jnp.where(live[:, None], rows_out, 0)
 
     out_part = jnp.where(live, epart, jnp.int32(num_parts))

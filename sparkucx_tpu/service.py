@@ -49,6 +49,11 @@ class ShuffleService:
             raise ValueError(
                 f"unknown io.format {self.io_format!r}; want {IO_FORMATS}")
         self.key_column = conf.get("spark.shuffle.tpu.io.keyColumn", "key")
+        # declared per-record ceiling for string/binary Arrow columns
+        # (varlen transport pad width — io/varlen.py); part of the shuffle
+        # schema, so it is a conf key, not a per-call argument
+        self.string_max_bytes = int(conf.get(
+            "spark.shuffle.tpu.io.stringMaxBytes", "64"))
         self.node = TpuNode.start(conf, distributed=distributed,
                                   process_id=process_id)
         self.manager = TpuShuffleManager(self.node, conf)
@@ -93,7 +98,8 @@ class ShuffleService:
             from sparkucx_tpu.io.arrow import write_batches
             batches = data if isinstance(data, (list, tuple)) else [data]
             write_batches(self.manager, handle, map_id, batches,
-                          self.key_column)
+                          self.key_column,
+                          string_max_bytes=self.string_max_bytes)
             return
         w = self.manager.get_writer(handle, map_id)
         w.write(np.asarray(data), values)
@@ -108,11 +114,16 @@ class ShuffleService:
     def read(self, handle: ShuffleHandle,
              timeout: Optional[float] = None,
              combine: Optional[str] = None,
-             ordered: bool = False):
+             ordered: bool = False,
+             combine_sum_words: int = 0):
         """Full exchange. arrow: list of per-partition RecordBatches;
         raw: the ShuffleReaderResult partition view. ``combine="sum"``
         runs device combine-by-key; ``ordered=True`` returns key-sorted
-        partitions (manager.read docstring)."""
+        partitions; ``combine_sum_words`` > 0 sums only that many leading
+        value words and carries the rest per key — REQUIRED when the
+        value row holds a varlen payload next to the summed lane
+        (io/varlen.py pack_counted_varbytes), or the combiner would sum
+        the payload bytes (manager.read docstring)."""
         if self.io_format == "arrow":
             if combine:
                 raise ValueError(
@@ -124,15 +135,18 @@ class ShuffleService:
                                 key_column=self.key_column, timeout=timeout,
                                 ordered=ordered)
         return self.manager.read(handle, timeout=timeout, combine=combine,
-                                 ordered=ordered)
+                                 ordered=ordered,
+                                 combine_sum_words=combine_sum_words)
 
     def submit(self, handle: ShuffleHandle,
                timeout: Optional[float] = None,
                combine: Optional[str] = None,
-               ordered: bool = False):
+               ordered: bool = False,
+               combine_sum_words: int = 0):
         """Asynchronous raw read (shuffle/reader.py PendingShuffle)."""
         return self.manager.submit(handle, timeout=timeout,
-                                   combine=combine, ordered=ordered)
+                                   combine=combine, ordered=ordered,
+                                   combine_sum_words=combine_sum_words)
 
 
 def connect(conf: Optional[Mapping[str, str]] = None, *,

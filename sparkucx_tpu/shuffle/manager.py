@@ -508,7 +508,8 @@ class TpuShuffleManager:
     def read(self, handle: ShuffleHandle,
              timeout: Optional[float] = None,
              combine: Optional[str] = None,
-             ordered: bool = False) -> ShuffleReaderResult:
+             ordered: bool = False,
+             combine_sum_words: int = 0) -> ShuffleReaderResult:
         """Execute the full exchange for a shuffle and return partitioned
         results (the getReader + fetch-everything path, SURVEY.md §3.4).
 
@@ -531,11 +532,12 @@ class TpuShuffleManager:
             # values (same SPMD discipline as calling read() at all)
             with self.node.metrics.timeit("shuffle.read"):
                 return self._submit_distributed(
-                    handle, timeout, combine=combine,
-                    ordered=ordered).result()
+                    handle, timeout, combine=combine, ordered=ordered,
+                    combine_sum_words=combine_sum_words).result()
         with self.node.metrics.timeit("shuffle.read"):
-            return self._submit_local(handle, timeout, combine=combine,
-                                      ordered=ordered).result()
+            return self._submit_local(
+                handle, timeout, combine=combine, ordered=ordered,
+                combine_sum_words=combine_sum_words).result()
 
     def read_partitions(self, handle: ShuffleHandle, start: int, end: int,
                         timeout: Optional[float] = None,
@@ -566,7 +568,8 @@ class TpuShuffleManager:
     def submit(self, handle: ShuffleHandle,
                timeout: Optional[float] = None,
                combine: Optional[str] = None,
-               ordered: bool = False):
+               ordered: bool = False,
+               combine_sum_words: int = 0):
         """Asynchronous read: plan + pack on the host, DISPATCH the
         exchange, and return a :class:`shuffle.reader.PendingShuffle`
         without blocking — so the caller overlaps this shuffle's collective
@@ -583,15 +586,17 @@ class TpuShuffleManager:
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
         if self.node.is_distributed:
-            return self._submit_distributed(handle, timeout,
-                                            combine=combine,
-                                            ordered=ordered)
-        return self._submit_local(handle, timeout, combine=combine,
-                                  ordered=ordered)
+            return self._submit_distributed(
+                handle, timeout, combine=combine, ordered=ordered,
+                combine_sum_words=combine_sum_words)
+        return self._submit_local(
+            handle, timeout, combine=combine, ordered=ordered,
+            combine_sum_words=combine_sum_words)
 
     def _submit_local(self, handle: ShuffleHandle, timeout: float,
                       combine: Optional[str] = None,
-                      ordered: bool = False):
+                      ordered: bool = False,
+                      combine_sum_words: int = 0):
         tracer = self.node.tracer
         if not handle.entry.wait_complete(timeout):
             raise TimeoutError(
@@ -660,7 +665,8 @@ class TpuShuffleManager:
                                  bounds=handle.bounds)
                 plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
             plan = self._decorated_plan(plan, combine, ordered, has_vals,
-                                        val_tail, val_dtype)
+                                        val_tail, val_dtype,
+                                        combine_sum_words)
 
             # fuse key+value bytes into one int32 row matrix (bit views, no
             # value casts — jnp would silently truncate int64 with x64 off)
@@ -727,19 +733,29 @@ class TpuShuffleManager:
     # -- capacity learning -------------------------------------------------
     @staticmethod
     def _decorated_plan(plan: ShufflePlan, combine, ordered: bool,
-                        has_vals: bool, val_tail, val_dtype) -> ShufflePlan:
+                        has_vals: bool, val_tail, val_dtype,
+                        combine_sum_words: int = 0) -> ShufflePlan:
         """Validate and stamp the combine/ordered read options onto a
         plan (shared by the single- and multi-process read paths).
-        combine implies ordered output, so it takes precedence."""
+        combine implies ordered output, so it takes precedence.
+        ``combine_sum_words`` > 0 sums only that many leading transport
+        words of the value row and CARRIES the rest per key (varlen
+        payloads — io/varlen.py)."""
         import dataclasses
         if combine:
             from sparkucx_tpu.ops.aggregate import check_combinable
             check_combinable(val_tail if has_vals else None,
                              val_dtype if has_vals else None, combine)
+            vw = value_words(val_tail, val_dtype)
+            if combine_sum_words < 0 or combine_sum_words > vw:
+                raise ValueError(
+                    f"combine_sum_words={combine_sum_words} out of "
+                    f"[0, {vw}] for this value schema")
             return dataclasses.replace(
                 plan, combine=combine,
-                combine_words=value_words(val_tail, val_dtype),
-                combine_dtype=np.dtype(val_dtype).str)
+                combine_words=vw,
+                combine_dtype=np.dtype(val_dtype).str,
+                combine_sum_words=combine_sum_words)
         if ordered:
             return dataclasses.replace(plan, ordered=True)
         return plan
@@ -868,7 +884,8 @@ class TpuShuffleManager:
     # -- the multi-process read path --------------------------------------
     def _submit_distributed(self, handle: ShuffleHandle, timeout: float,
                             combine: Optional[str] = None,
-                            ordered: bool = False):
+                            ordered: bool = False,
+                            combine_sum_words: int = 0):
         """COLLECTIVE multi-process submit (shuffle/distributed.py);
         returns a PendingDistributedShuffle — result() is the other half
         of the collective. Map
@@ -962,12 +979,13 @@ class TpuShuffleManager:
                     f"unregister raced this read)")
             return self._submit_distributed_staged(
                 handle, writers, L, Pn, shard_ids, combine, ordered,
-                tracer)
+                tracer, combine_sum_words)
         finally:
             self._read_finished(read_gen)
 
     def _submit_distributed_staged(self, handle, writers, L, Pn, shard_ids,
-                                   combine, ordered, tracer):
+                                   combine, ordered, tracer,
+                                   combine_sum_words: int = 0):
         from sparkucx_tpu.shuffle.distributed import (
             allgather_blob, allgather_sizes, submit_shuffle_distributed)
 
@@ -1018,7 +1036,7 @@ class TpuShuffleManager:
             # read sequence, so learned hints advance in lockstep
             plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
         plan = self._decorated_plan(plan, combine, ordered, has_vals,
-                                    val_tail, val_dtype)
+                                    val_tail, val_dtype, combine_sum_words)
 
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)

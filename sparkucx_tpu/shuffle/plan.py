@@ -48,6 +48,10 @@ class ShufflePlan:
     combine: Optional[str] = None
     combine_words: int = 0     # value width in int32 words (combine only)
     combine_dtype: str = ""    # np.dtype.str of the value (combine only)
+    # transport words the combiner SUMS; the rest of the value row is
+    # CARRIED per key (per-key-constant payload, e.g. varlen record
+    # bytes — io/varlen.py). 0 = sum the whole value row.
+    combine_sum_words: int = 0
     # device key sort: partitions come back key-sorted (signed int64
     # order) — the "sort" half of the reference reduce pipeline's stock
     # aggregate+sort, without aggregation (TeraSort's shape). Implied by

@@ -117,7 +117,8 @@ def step_body(plan: ShufflePlan, axis: str):
             from sparkucx_tpu.ops.aggregate import combine_rows
             send, rcounts, _ = combine_rows(
                 payload, part, nvalid[0], R, plan.combine_words,
-                np.dtype(plan.combine_dtype), plan.combine)
+                np.dtype(plan.combine_dtype), plan.combine,
+                sum_words=plan.combine_sum_words)
         elif plan.ordered and Pn == 1:
             # single shard: ONE sender means delivered rows keep send
             # order, so doing the (partition, key) sort on the send side
@@ -152,7 +153,7 @@ def step_body(plan: ShufflePlan, axis: str):
             rows_out, pcounts, n_out = combine_rows(
                 r.data, part_fn(r.data), r.total[0], R,
                 plan.combine_words, np.dtype(plan.combine_dtype),
-                plan.combine)
+                plan.combine, sum_words=plan.combine_sum_words)
             return rows_out, pcounts.reshape(1, R), \
                 n_out.astype(r.total.dtype), r.overflow
         if plan.ordered:
