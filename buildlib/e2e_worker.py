@@ -65,7 +65,13 @@ def main() -> int:
         # exchange runs across processes (shuffle/hierarchical.py)
         "spark.shuffle.tpu.mesh.numSlices": str(num_slices),
     }, use_env=False)
-    node = TpuNode.start(conf, distributed=True, process_id=proc_id)
+    try:
+        node = TpuNode.start(conf, distributed=True, process_id=proc_id)
+    except Exception as e:
+        # distinct marker + exit code so the harness can classify a
+        # bootstrap flake (and retry it) separately from workload bugs
+        print(f"worker {proc_id}: RENDEZVOUS FAILED: {e!r}", flush=True)
+        return 5
     mgr = TpuShuffleManager(node, conf)
 
     # NUM_MAPS override lets the recovery re-run execute the ORIGINAL

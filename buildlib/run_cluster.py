@@ -58,6 +58,23 @@ def spawn(pid: int, nprocs: int, coordinator: str, devices: int,
     return proc, logf
 
 
+def rendezvous_failed(logs) -> bool:
+    """True when any worker log carries the classified bootstrap-flake
+    marker (e2e_worker prints 'RENDEZVOUS FAILED' and exits 5; the node
+    logs the same). The harness retries ONLY this failure mode — a
+    measured mitigation of the known load-sensitive back-to-back
+    jax.distributed rendezvous, not a blanket re-run that would mask
+    workload bugs."""
+    for lf in logs:
+        try:
+            with open(lf.name) as rf:
+                if "RENDEZVOUS FAILED" in rf.read():
+                    return True
+        except OSError:
+            pass
+    return False
+
+
 def reap(procs, logs, deadline, expect_rc=None) -> bool:
     """Wait for every worker; print tails (full log on failure). When
     ``expect_rc`` maps pid -> required exit code (e.g. the SIGKILLed victim
@@ -154,16 +171,31 @@ def run_recovery(args) -> int:
             return 1
 
         # phase 2: fresh world of survivors re-runs the SAME map set
-        # (lost maps redistribute) and verifies the full result
-        procs, logs = [], []
-        coordinator = f"localhost:{free_port()}"
-        for pid in range(args.nprocs - 1):
-            p, f = spawn(pid, args.nprocs - 1, coordinator, args.devices, 1,
-                         {"SPARKUCX_TPU_NUM_MAPS": str(num_maps)})
-            procs.append(p)
-            logs.append(f)
-            all_logs.append(f)
-        ok = reap(procs, logs, deadline)
+        # (lost maps redistribute) and verifies the full result. The
+        # second back-to-back rendezvous is the known load-sensitive
+        # site — a classified bootstrap flake retries once on a fresh
+        # port; anything else fails outright.
+        for attempt in range(2):
+            procs, logs = [], []
+            coordinator = f"localhost:{free_port()}"
+            for pid in range(args.nprocs - 1):
+                p, f = spawn(pid, args.nprocs - 1, coordinator,
+                             args.devices, 1,
+                             {"SPARKUCX_TPU_NUM_MAPS": str(num_maps)})
+                procs.append(p)
+                logs.append(f)
+                all_logs.append(f)
+            # fresh budget per attempt: a first attempt that hung to the
+            # shared deadline would leave the retry ~1 s and guarantee
+            # its failure — exactly the flake the retry exists to absorb
+            ok = reap(procs, logs, time.monotonic() + args.timeout)
+            if ok or attempt == 1 or not rendezvous_failed(logs):
+                break
+            print("phase-2 bootstrap flake (RENDEZVOUS FAILED in a "
+                  "worker log); retrying once on a fresh port")
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
         print("CLUSTER RECOVERY:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:
@@ -196,22 +228,32 @@ def main() -> int:
     if args.recovery:
         return run_recovery(args)
 
-    coordinator = f"localhost:{free_port()}"
-    procs, logs = [], []
+    procs, all_logs = [], []
     try:
-        for pid in range(args.nprocs):
-            p, f = spawn(pid, args.nprocs, coordinator, args.devices,
-                         args.slices)
-            procs.append(p)
-            logs.append(f)
-        ok = reap(procs, logs, time.monotonic() + args.timeout)
+        for attempt in range(2):
+            coordinator = f"localhost:{free_port()}"
+            procs, logs = [], []
+            for pid in range(args.nprocs):
+                p, f = spawn(pid, args.nprocs, coordinator, args.devices,
+                             args.slices)
+                procs.append(p)
+                logs.append(f)
+                all_logs.append(f)
+            ok = reap(procs, logs, time.monotonic() + args.timeout)
+            if ok or attempt == 1 or not rendezvous_failed(logs):
+                break
+            print("bootstrap flake (RENDEZVOUS FAILED in a worker log); "
+                  "retrying once on a fresh port")
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
         print("CLUSTER E2E:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:
         for p in procs:           # trap-EXIT cleanup (test.sh:185)
             if p.poll() is None:
                 p.kill()
-        for f in logs:
+        for f in all_logs:
             try:
                 f.close()
                 os.unlink(f.name)

@@ -63,12 +63,31 @@ class TpuNode:
         if self.is_distributed:
             # Multi-host: rendezvous at the coordinator like executors
             # dialing the driver sockaddr (UcxNode.java:130-134).
-            jax.distributed.initialize(
-                coordinator_address=conf.coordinator_address,
-                num_processes=conf.num_processes,
-                process_id=process_id)
-            log.info("jax.distributed up: process %d/%d via %s",
-                     process_id, conf.num_processes, conf.coordinator_address)
+            import time as _time
+            t0 = _time.monotonic()
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=conf.coordinator_address,
+                    num_processes=conf.num_processes,
+                    process_id=process_id)
+            except Exception as e:
+                # The observed intermittent is HERE (back-to-back worlds,
+                # load-sensitive; <10%). Classify it loudly so harnesses
+                # retry THIS failure mode specifically instead of masking
+                # every failure with a blanket re-run.
+                log.error(
+                    "RENDEZVOUS FAILED after %.1fs: coordinator=%s "
+                    "process %d/%d: %r", _time.monotonic() - t0,
+                    conf.coordinator_address, process_id,
+                    conf.num_processes, e)
+                raise RuntimeError(
+                    f"RENDEZVOUS FAILED after "
+                    f"{_time.monotonic() - t0:.1f}s (coordinator "
+                    f"{conf.coordinator_address}, process {process_id}/"
+                    f"{conf.num_processes}): {e!r}") from e
+            log.info("jax.distributed up: process %d/%d via %s in %.2fs",
+                     process_id, conf.num_processes,
+                     conf.coordinator_address, _time.monotonic() - t0)
         self.mesh = make_shuffle_mesh(conf=conf)
         self.pool = HostMemoryPool(conf)
         self.registry = ShuffleRegistry()

@@ -41,7 +41,7 @@ class ShuffleService:
     engine never handles numpy row tuples unless it asked for ``raw``."""
 
     def __init__(self, conf: TpuShuffleConf, distributed: bool = False,
-                 process_id: int = 0):
+                 process_id: int = 0, metrics_reporter=None):
         self.conf = conf
         self.io_format = conf.get(
             "spark.shuffle.tpu.io.format", "arrow").strip().lower()
@@ -57,6 +57,14 @@ class ShuffleService:
         self.node = TpuNode.start(conf, distributed=distributed,
                                   process_id=process_id)
         self.manager = TpuShuffleManager(self.node, conf)
+        # Host-engine metrics seam: fn(name, value) observes every
+        # counter increment live — shuffle.read.ms (fetch wait),
+        # shuffle.rows, shuffle.bytes, shuffle.retries — the role of
+        # Spark's ShuffleReadMetricsReporter
+        # (ref: compat/spark_3_0/UcxShuffleReader.scala:111-116).
+        self._metrics_reporter = metrics_reporter
+        if metrics_reporter is not None:
+            self.node.metrics.add_reporter(metrics_reporter)
         log.info("ShuffleService up: io=%s, %d devices",
                  self.io_format, self.node.num_devices)
 
@@ -73,6 +81,9 @@ class ShuffleService:
         self.manager.unregister_shuffle(shuffle_id)
 
     def stop(self) -> None:
+        if self._metrics_reporter is not None:
+            self.node.metrics.remove_reporter(self._metrics_reporter)
+            self._metrics_reporter = None
         self.manager.stop()
         self.node.close()
 
@@ -152,7 +163,8 @@ class ShuffleService:
 def connect(conf: Optional[Mapping[str, str]] = None, *,
             distributed: bool = False,
             process_id: int = 0,
-            use_env: bool = True) -> ShuffleService:
+            use_env: bool = True,
+            metrics_reporter=None) -> ShuffleService:
     """Build the framework purely from configuration — the zero-code
     adoption path (ref: README.md:44-48: the reference is enabled by
     setting ``spark.shuffle.manager`` and the IO plugin class key, nothing
@@ -163,8 +175,14 @@ def connect(conf: Optional[Mapping[str, str]] = None, *,
     ``use_env=False``. ``distributed=True`` additionally runs the
     jax.distributed bootstrap using the conf's coordinator address —
     matching the reference's driver-rendezvous flow
-    (ref: UcxNode.java:111-145)."""
+    (ref: UcxNode.java:111-145).
+
+    ``metrics_reporter`` — optional ``fn(name, value)`` observing every
+    shuffle metric increment (read wait ms, rows, bytes, retry counts) —
+    the embedding engine's ShuffleReadMetricsReporter seam
+    (ref: UcxShuffleReader.scala:111-116)."""
     tconf = conf if isinstance(conf, TpuShuffleConf) \
         else TpuShuffleConf(conf, use_env=use_env)
     return ShuffleService(tconf, distributed=distributed,
-                          process_id=process_id)
+                          process_id=process_id,
+                          metrics_reporter=metrics_reporter)

@@ -684,6 +684,13 @@ class TpuShuffleManager:
         admit, release_admitted = self._make_admitter(
             plan, width, stage_buf.requested, timeout)
 
+        # weakref, not a strong reference: on_done is held BY the pending
+        # handle, so a strong handle_box->pending edge would be a cycle
+        # that defers the __del__-based abandoned-handle release (pinned
+        # buffer + admitted bytes) from refcounting to cyclic GC
+        import weakref
+        handle_box = {}
+
         def on_done(result):
             # fires from PendingShuffle.result() — with None on failure —
             # exactly once; the pack buffer stays pinned until the last
@@ -693,6 +700,15 @@ class TpuShuffleManager:
             if result is not None:
                 self._learn_cap(handle, result, int(nvalid.sum()))
                 self.node.metrics.inc("shuffle.rows", float(nvalid.sum()))
+                self.node.metrics.inc("shuffle.bytes",
+                                      float(nvalid.sum()) * width * 4)
+            ref = handle_box.get("pending")
+            p = ref() if ref is not None else None
+            if p is not None and getattr(p, "_attempt", 0):
+                # overflow retries this read paid (capacity growth) — the
+                # reporter-visible retry counter
+                self.node.metrics.inc("shuffle.retries",
+                                      float(p._attempt))
 
         # Buffer ownership: until a pending handle exists, failures here
         # (the fault site, compile errors inside the first dispatch) must
@@ -723,6 +739,7 @@ class TpuShuffleManager:
                         self.exchange_mesh, self.axis, plan,
                         shard_rows, nvalid, vt, val_dtype,
                         on_done=on_done, admit=admit)
+            handle_box["pending"] = weakref.ref(pending)
             return pending
         except BaseException:
             if pending is None:
@@ -1054,6 +1071,10 @@ class TpuShuffleManager:
         admit, release_admitted = self._make_admitter(
             plan, width, stage_buf.requested, None)
 
+        # weakref: same cycle-avoidance as the local path
+        import weakref
+        handle_box = {}
+
         def on_done(result):
             # fires from PendingDistributedShuffle.result() — with None on
             # failure — exactly once; the pack buffer stays pinned until
@@ -1064,6 +1085,13 @@ class TpuShuffleManager:
                 self._learn_cap(handle, result, int(nvalid.sum()))
                 self.node.metrics.inc("shuffle.rows",
                                       float(nvalid_local.sum()))
+                self.node.metrics.inc("shuffle.bytes",
+                                      float(nvalid_local.sum()) * width * 4)
+            ref = handle_box.get("pending")
+            p = ref() if ref is not None else None
+            if p is not None and getattr(p, "_attempt", 0):
+                self.node.metrics.inc("shuffle.retries",
+                                      float(p._attempt))
 
         # same ownership rule as the local path: the armed handle is the
         # sole releaser of the pack buffer
@@ -1083,6 +1111,7 @@ class TpuShuffleManager:
                     dcn_axis=self.conf.mesh_dcn_axis
                     if self.hierarchical else None,
                     on_done=on_done, admit=admit)
+            handle_box["pending"] = weakref.ref(pending)
             return pending
         except BaseException:
             if pending is None:

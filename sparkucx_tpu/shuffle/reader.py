@@ -455,9 +455,20 @@ class PendingExchangeBase:
             cb(result)
 
     def __del__(self):
-        # a submitted-then-abandoned handle must still return the pinned
-        # pack buffer to the pool
+        # A submitted-then-abandoned handle must still return the pinned
+        # pack buffer to the pool — but only after the in-flight dispatch
+        # has finished consuming it: on_done recycles the buffer, and the
+        # async device_put/step may still be reading that host memory
+        # (result() is safe because it blocks on the outputs first; this
+        # path must do the same or the pool hands the bytes to the next
+        # shuffle mid-DMA).
         try:
+            if self._result is None and getattr(self, "_out", None):
+                for x in self._out:
+                    try:
+                        x.block_until_ready()
+                    except Exception:
+                        break
             self._notify(None)
         except Exception:
             pass

@@ -35,15 +35,46 @@ class Metrics:
 
     Role of Spark's ShuffleReadMetricsReporter integration
     (ref: UcxShuffleReader.scala:111-116): incFetchWaitTime, incRecordsRead
-    become plain named counters here."""
+    become plain named counters here.
+
+    Reporters: a host engine embedding the framework can observe every
+    increment live — ``add_reporter(fn)`` with ``fn(name, value)`` — the
+    push-style seam Spark's reporter object provides. Reporter failures
+    are swallowed (logged once per reporter): observability must never
+    fail a shuffle."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
+        self._reporters = []
+        self._broken = set()
+
+    def add_reporter(self, fn) -> None:
+        """Attach fn(name: str, value: float), called on every inc()."""
+        with self._lock:
+            self._reporters.append(fn)
+
+    def remove_reporter(self, fn) -> None:
+        with self._lock:
+            try:
+                self._reporters.remove(fn)
+            except ValueError:
+                pass
 
     def inc(self, name: str, value: float = 1.0) -> None:
         with self._lock:
             self._counters[name] += value
+            reporters = list(self._reporters)
+        for fn in reporters:
+            try:
+                fn(name, value)
+            except Exception:
+                if id(fn) not in self._broken:
+                    self._broken.add(id(fn))
+                    from sparkucx_tpu.utils.logging import get_logger
+                    get_logger("metrics").exception(
+                        "metrics reporter %r raised; further failures "
+                        "from it are silenced", fn)
 
     def get(self, name: str) -> float:
         with self._lock:

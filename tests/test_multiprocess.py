@@ -13,10 +13,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(*extra):
+    # outer timeout budgets TWO harness attempts (run_cluster retries a
+    # classified rendezvous flake once with a fresh --timeout window)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "buildlib", "run_cluster.py"),
          "--nprocs", "2", "--devices", "4", "--timeout", "400", *extra],
-        capture_output=True, text=True, timeout=460)
+        capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
     assert "CLUSTER E2E: PASS" in proc.stdout
 
@@ -36,23 +38,21 @@ def test_worker_loss_recovery():
     # the elastic drill: victim dies after staging; survivors fence the
     # stale epoch (StaleEpochError, no hung collective) and the job
     # re-runs the FULL map set on a fresh 2-process world and verifies.
-    # One bounded retry: the drill stands up two real jax.distributed
-    # worlds back to back, and the rendezvous is occasionally (<10%)
-    # load-sensitive; a genuine regression fails both attempts and the
-    # first failure's output is still surfaced.
-    first = None
-    for attempt in range(2):
-        proc = subprocess.run(
-            [sys.executable,
-             os.path.join(REPO, "buildlib", "run_cluster.py"),
-             "--recovery", "--nprocs", "3", "--devices", "2",
-             "--timeout", "400"],
-            capture_output=True, text=True, timeout=460)
-        ok = (proc.returncode == 0
-              and "CLUSTER RECOVERY: PASS" in proc.stdout
-              and proc.stdout.count("STALE-FENCED OK") >= 1)
-        if ok:
-            return
-        first = first or (proc.stdout[-3000:] + proc.stderr[-2000:])
-    raise AssertionError(f"recovery drill failed twice; first failure:\n"
-                         f"{first}")
+    # The known intermittent here — the second back-to-back
+    # jax.distributed rendezvous is load-sensitive (<10%) — is now
+    # CLASSIFIED (workers print 'RENDEZVOUS FAILED', exit 5) and retried
+    # by the harness itself on a fresh port (run_cluster.py
+    # rendezvous_failed); any other failure mode fails this test on the
+    # first attempt instead of being masked by a blanket re-run.
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "buildlib", "run_cluster.py"),
+         "--recovery", "--nprocs", "3", "--devices", "2",
+         "--timeout", "400"],
+        # budget: phase 1 + up to two phase-2 attempts, each with a
+        # fresh --timeout window
+        capture_output=True, text=True, timeout=1300)
+    assert proc.returncode == 0, \
+        proc.stdout[-3000:] + proc.stderr[-2000:]
+    assert "CLUSTER RECOVERY: PASS" in proc.stdout
+    assert proc.stdout.count("STALE-FENCED OK") >= 1

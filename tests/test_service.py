@@ -80,3 +80,40 @@ def test_connect_conf_only_no_internal_imports(base_conf):
         assert svc.manager.conf.a2a_impl == "dense"
     finally:
         svc.stop()
+
+
+def test_metrics_reporter_hook(mesh8, rng):
+    """connect(metrics_reporter=fn) surfaces read wait / rows / bytes to
+    the embedding engine — the ShuffleReadMetricsReporter seam
+    (ref: compat/spark_3_0/UcxShuffleReader.scala:111-116). A broken
+    reporter must not fail the shuffle."""
+    import sparkucx_tpu
+
+    seen = {}
+
+    def reporter(name, value):
+        seen[name] = seen.get(name, 0.0) + value
+
+    calls = {"n": 0}
+
+    def broken(name, value):
+        calls["n"] += 1
+        raise RuntimeError("reporter bug")
+
+    svc = sparkucx_tpu.connect({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+        "spark.shuffle.tpu.io.format": "raw"}, use_env=False,
+        metrics_reporter=reporter)
+    with svc:
+        svc.node.metrics.add_reporter(broken)
+        h = svc.register_shuffle(5, 1, 4)
+        keys = rng.integers(0, 1000, size=256).astype(np.int64)
+        svc.write(h, 0, keys)
+        res = svc.read(h)
+        total = sum(res.partition(r)[0].shape[0] for r in range(4))
+        assert total == 256
+    assert seen.get("shuffle.rows") == 256
+    assert seen.get("shuffle.bytes") == 256 * 8      # 2 key words x 4 B
+    assert seen.get("shuffle.read.count") == 1
+    assert seen.get("shuffle.read.ms", 0) > 0
+    assert calls["n"] >= 1, "broken reporter was still invoked"
