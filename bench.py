@@ -612,10 +612,37 @@ def stage_native_aot(mon):
     """AOT-compile the n=8 native exchange step against an unattached TPU
     topology — the multi-peer lowering proof (VERDICT r2 missing #2; the
     reference CI's multi-process-over-shm analog,
-    ref: buildlib/test.sh:147-166)."""
+    ref: buildlib/test.sh:147-166).
+
+    Runs in a SUBPROCESS with the axon plugin disabled
+    (PALLAS_AXON_POOL_IPS cleared, JAX_PLATFORMS=cpu): the topology
+    compile uses the LOCAL libtpu, so the proof lands even when the
+    tunnel is wedged — measured working on this machine with the tunnel
+    down."""
     mon.begin("native_aot", 300)
-    from sparkucx_tpu.shuffle.aot import aot_compile_native_step
-    rep = aot_compile_native_step(8)
+    env = dict(os.environ)
+    env.update({"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu"})
+    env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__)) \
+        + os.pathsep + env.get("PYTHONPATH", "")
+    code = ("import json, os, threading\n"
+            "threading.Timer(240, lambda: os._exit(3)).start()\n"
+            "from sparkucx_tpu.shuffle.aot import aot_compile_native_step\n"
+            "print(json.dumps(aot_compile_native_step(8)), flush=True)\n"
+            "os._exit(0)\n")
+    rep = {}
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], env=env,
+                              capture_output=True, text=True, timeout=290)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                rep = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        if not rep:
+            rep = {"error": (proc.stderr or "no output")[-200:]}
+    except Exception as e:
+        rep = {"error": str(e)[:200]}
     status = "ok" if rep.pop("ok", False) else "failed"
     mon.end("native_aot", status=status, **rep)
 
@@ -717,12 +744,13 @@ def main() -> None:
             stage_h2d(mon, jax)
         except Exception as e:
             mon.end("h2d", status="failed", error=str(e)[:200])
-        # multi-peer AOT lowering proof (needs the TPU compiler; records
-        # "failed" with the reason where the topology API is absent)
-        try:
-            stage_native_aot(mon)
-        except Exception as e:
-            mon.end("native_aot", status="failed", error=str(e)[:200])
+    # multi-peer AOT lowering proof — subprocess against local libtpu,
+    # works regardless of backend/tunnel state (records "failed" with the
+    # reason where libtpu/the topology API is absent, e.g. plain CI)
+    try:
+        stage_native_aot(mon)
+    except Exception as e:
+        mon.end("native_aot", status="failed", error=str(e)[:200])
 
     common = dict(val_words=args.val_words, sort_impl=args.sort_impl,
                   partitions_per_dev=8, read_mode=args.read_mode)

@@ -1,0 +1,154 @@
+"""Round-3 on-chip micro experiments — run when the tunnel is healthy.
+
+Each experiment prints one JSON line and is independently try/excepted, so
+a wedge mid-ladder still leaves the earlier measurements on stdout. An
+in-process watchdog hard-exits (NEVER wrap this in an external
+kill-timeout: that wedges the axon tunnel for every later process —
+bench_runs/NOTES_r2.md).
+
+Targets (VERDICT r3 #1): locate the ~23 ms n=1 ragged-all-to-all cost,
+A/B the combine compaction variants, and record the landed unstable-sort
+plain-step number.
+
+Usage:  python bench_runs/micro_r3.py [--watchdog 900]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def emit(name, **kw):
+    print(json.dumps({"exp": name, **kw}), flush=True)
+
+
+def timed(fn, *args, reps=5):
+    import numpy as np
+    fn(*args)  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _ = np.asarray(out[0] if isinstance(out, tuple) else out)
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--watchdog", type=int, default=900)
+    ap.add_argument("--rows-log2", type=int, default=21)
+    args = ap.parse_args()
+    threading.Timer(args.watchdog, lambda: os._exit(3)).start()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    emit("init", backend=jax.default_backend(), devices=len(jax.devices()))
+
+    rows = 1 << args.rows_log2
+    W = 10
+    rng = np.random.default_rng(0)
+    payload_np = rng.integers(0, 1 << 31, size=(rows, W),
+                              dtype=np.int64).astype(np.int32)
+    payload = jax.device_put(jnp.asarray(payload_np))
+    nbytes = rows * W * 4
+
+    # ---- 1. n=1 ragged_all_to_all cost, segment-count sweep -------------
+    # Locates the measured ~23 ms for 80 MB: per-segment bookkeeping vs a
+    # fixed op overhead vs a bandwidth problem.
+    try:
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+        for nseg in (1, 8, 64, 512):
+            seg = rows // nseg
+
+            def step(data):
+                def inner(d):
+                    out = jnp.zeros_like(d)
+                    offs = jnp.arange(nseg, dtype=jnp.int32) * seg
+                    sizes = jnp.full((nseg,), seg, jnp.int32)
+                    return jax.lax.ragged_all_to_all(
+                        d, out, offs, sizes, offs, sizes, axis_name="x")
+                return jax.jit(jax.shard_map(
+                    inner, mesh=mesh, in_specs=(P("x"),),
+                    out_specs=P("x")))(data)
+
+            ms = timed(step, payload)
+            emit("a2a_n1_segments", nseg=nseg, ms=round(ms, 3),
+                 GBps=round(nbytes / ms / 1e6, 2))
+    except Exception as e:
+        emit("a2a_n1_segments", error=str(e)[:200])
+
+    # ---- 2. local-move formulation at the same shape --------------------
+    try:
+        def local_move(d):
+            return jax.jit(lambda x: jnp.roll(x, 1, axis=0))(d)
+        ms = timed(local_move, payload)
+        emit("local_roll_copy", ms=round(ms, 3),
+             GBps=round(nbytes / ms / 1e6, 2))
+    except Exception as e:
+        emit("local_roll_copy", error=str(e)[:200])
+
+    # ---- 3. combine compaction A/B at 2M rows ---------------------------
+    try:
+        from sparkucx_tpu.ops.aggregate import combine_rows
+        part_np = rng.integers(0, 64, size=rows).astype(np.int32)
+        keys_small = rng.integers(0, 100_000, size=rows, dtype=np.int64)
+        rows_np = payload_np.copy()
+        rows_np[:, :2] = keys_small.view(np.int32).reshape(-1, 2)
+        rows_dev = jax.device_put(jnp.asarray(rows_np))
+        part_dev = jax.device_put(jnp.asarray(part_np))
+        for comp in ("stable", "unstable"):
+            fn = jax.jit(lambda r, p, c=comp: combine_rows(
+                r, p, jnp.int32(rows), 64, W - 2, np.int32, "sum",
+                compaction=c))
+            ms = timed(fn, rows_dev, part_dev)
+            emit("combine_compaction", variant=comp, ms=round(ms, 3),
+                 GBps=round(nbytes / ms / 1e6, 2))
+    except Exception as e:
+        emit("combine_compaction", error=str(e)[:300])
+
+    # ---- 4. the SHIPPED plain step at n=1: native vs auto ---------------
+    try:
+        from sparkucx_tpu.shuffle.plan import ShufflePlan
+        from sparkucx_tpu.shuffle.reader import step_body
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()[:1]), ("shuffle",))
+        for impl in ("auto", "native"):
+            plan = ShufflePlan(num_shards=1, num_partitions=8,
+                               cap_in=rows, cap_out=int(rows * 1.5),
+                               impl=impl)
+            step = step_body(plan, "shuffle")
+            fn = jax.jit(jax.shard_map(
+                step, mesh=mesh, in_specs=(P("shuffle"), P("shuffle")),
+                out_specs=(P("shuffle"), P(), P("shuffle"), P("shuffle")),
+                check_vma=False))
+            nv = jnp.full((1,), rows, jnp.int32)
+            ms = timed(lambda d: fn(d, nv), payload)
+            emit("plain_step_n1", impl=impl, ms=round(ms, 3),
+                 GBps=round(nbytes / ms / 1e6, 2))
+    except Exception as e:
+        emit("plain_step_n1", error=str(e)[:300])
+
+    # ---- 5. AOT n=8 multi-peer lowering proof ---------------------------
+    try:
+        from sparkucx_tpu.shuffle.aot import aot_compile_native_step
+        emit("native_aot_n8", **aot_compile_native_step(8))
+    except Exception as e:
+        emit("native_aot_n8", error=str(e)[:300])
+
+    emit("done")
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -68,10 +68,17 @@ def main() -> int:
     try:
         node = TpuNode.start(conf, distributed=True, process_id=proc_id)
     except Exception as e:
-        # distinct marker + exit code so the harness can classify a
-        # bootstrap flake (and retry it) separately from workload bugs
-        print(f"worker {proc_id}: RENDEZVOUS FAILED: {e!r}", flush=True)
-        return 5
+        # Only the CLASSIFIED rendezvous failure (node.py tags it) gets
+        # the marker + exit 5 the harness retries; any other bootstrap
+        # bug (mesh construction, pool init) is deterministic and must
+        # fail the run outright, not burn a retry window.
+        if "RENDEZVOUS FAILED" in str(e):
+            print(f"worker {proc_id}: RENDEZVOUS FAILED: {e!r}",
+                  flush=True)
+            return 5
+        print(f"worker {proc_id}: bootstrap failed (non-rendezvous): "
+              f"{e!r}", flush=True)
+        return 1
     mgr = TpuShuffleManager(node, conf)
 
     # NUM_MAPS override lets the recovery re-run execute the ORIGINAL

@@ -131,6 +131,7 @@ def combine_rows(
     val_dtype,
     op: str = "sum",
     sum_words: int = 0,
+    compaction: str = "stable",
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Group rows by (partition, int64 key) and combine values per group.
 
@@ -149,6 +150,18 @@ def combine_rows(
                  length-prefixed word bytes of a text WordCount
                  (io/varlen.py pack_counted_varbytes): equal within a key
                  by construction, so any representative is THE value.
+    compaction — the end-row compaction sort formulation, bit-identical
+                 results either way (property-tested):
+                 ``stable``   — 1-key (flag) stable sort; relies on
+                                stability to keep the (part, key) order
+                                from the grouping sort.
+                 ``unstable`` — 4-key (flag, part, key_hi, key_lo)
+                                unstable sort; end rows are unique per
+                                (part, key), so explicit keys restore the
+                                exact same order without paying the
+                                stability machinery (~40% of TPU sort
+                                cost per the round-2 A/B — the candidate
+                                for the 101 ms combine laggard).
 
     Returns (rows_out [cap, W], pcounts [num_parts], n_out [1]):
     rows_out's first n_out rows are one row per distinct (partition, key),
@@ -200,12 +213,32 @@ def combine_rows(
     # representative, no differencing.
     flag = jnp.where(is_end, 0, 1).astype(jnp.int32)
     m = incl.shape[1]
-    sort_ops = (flag, srows[:, 0], srows[:, 1], spart) \
-        + tuple(incl[:, t] for t in range(m)) \
-        + tuple(srows[:, 2 + sum_words + t] for t in range(carry_n))
-    out = jax.lax.sort(sort_ops, num_keys=1, is_stable=True)
-    klo, khi, epart = out[1], out[2], out[3]
-    ends_incl = jnp.stack(out[4:4 + m], axis=1)           # [cap, m]
+    if compaction == "unstable":
+        # explicit (flag, part, key) keys — end rows are unique per
+        # (part, key), so the unstable order equals the stable one; the
+        # lo word is flipped for unsigned compare (module docstring).
+        # Dead (flag=1) rows land in arbitrary order past n_out, where
+        # every lane is masked to zero below.
+        sort_ops = (flag, spart, srows[:, 1],
+                    srows[:, 0] ^ jnp.int32(_FLIP)) \
+            + (srows[:, 0],) \
+            + tuple(incl[:, t] for t in range(m)) \
+            + tuple(srows[:, 2 + sum_words + t] for t in range(carry_n))
+        out = jax.lax.sort(sort_ops, num_keys=4, is_stable=False)
+        epart, khi, klo = out[1], out[2], out[4]
+        ends_incl = jnp.stack(out[5:5 + m], axis=1)       # [cap, m]
+        carry_start = 5 + m
+    elif compaction == "stable":
+        sort_ops = (flag, srows[:, 0], srows[:, 1], spart) \
+            + tuple(incl[:, t] for t in range(m)) \
+            + tuple(srows[:, 2 + sum_words + t] for t in range(carry_n))
+        out = jax.lax.sort(sort_ops, num_keys=1, is_stable=True)
+        klo, khi, epart = out[1], out[2], out[3]
+        ends_incl = jnp.stack(out[4:4 + m], axis=1)       # [cap, m]
+        carry_start = 4 + m
+    else:
+        raise ValueError(
+            f"unknown compaction {compaction!r}; want stable|unstable")
 
     # ---- segment sums = first differences of end-row prefix sums --------
     live = idx < n_out
@@ -217,7 +250,7 @@ def combine_rows(
     pieces = [jnp.stack([klo, khi], axis=1),
               _vals_to_words(seg_sum, vdt, sum_words)]
     if carry_n:
-        pieces.append(jnp.stack(out[4 + m:], axis=1))     # [cap, carry_n]
+        pieces.append(jnp.stack(out[carry_start:], axis=1))  # [cap, carry_n]
     if W - 2 - val_words_n:
         pieces.append(jnp.zeros((cap, W - 2 - val_words_n), jnp.int32))
     rows_out = jnp.concatenate(pieces, axis=1)

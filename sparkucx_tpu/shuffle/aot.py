@@ -46,6 +46,12 @@ def aot_compile_native_step(
     XLA:TPU optimization at n>1, so the multi-peer offset plumbing
     produces a compilable collective — the strongest validation available
     without multi-chip hardware (VERDICT r2 missing #2)."""
+    import os
+    # compile-only topology work grabs the libtpu single-process lockfile;
+    # without this, an AOT proof racing any other libtpu user (another
+    # bench stage, a concurrent test) ABORTs on /tmp/libtpu_lockfile
+    os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "true")
+
     import jax
     import jax.numpy as jnp
     from jax.experimental import topologies
@@ -105,15 +111,23 @@ def aot_compile_native_step(
         report.update(ok=False, error=f"compile: {str(e)[:300]}")
         return report
     report["hlo_post_opt_ragged"] = "ragged-all-to-all" in txt
-    # the collective must span ALL n shards: count the largest
-    # replica_groups list attached to a ragged-all-to-all line
+    # the collective must span ALL n shards: parse the largest replica
+    # group attached to a ragged-all-to-all line, in BOTH textual forms
+    # XLA emits — braced lists '{{0,1,...,7}}' and iota-v2
+    # '[G,K]<=[N]' (G groups of K members)
     groups_n = 0
     for line in txt.splitlines():
-        if "ragged-all-to-all" in line and "replica_groups" in line:
-            inner = line.split("replica_groups=")[1]
-            ids = inner.split("}")[0].strip("{").replace("{", "")
-            groups_n = max(groups_n,
-                           len([x for x in ids.split(",") if x.strip()]))
+        if "ragged-all-to-all" not in line or "replica_groups" not in line:
+            continue
+        inner = line.split("replica_groups=")[1]
+        if inner.startswith("["):
+            dims = inner[1:].split("]")[0].split(",")
+            if "<=" in inner.split("]")[1][:3] and len(dims) == 2:
+                groups_n = max(groups_n, int(dims[1].strip()))
+            continue
+        ids = inner.split("}")[0].strip("{").replace("{", "")
+        groups_n = max(groups_n,
+                       len([x for x in ids.split(",") if x.strip()]))
     report["replica_groups_n"] = groups_n
     report["ok"] = bool(report["hlo_post_opt_ragged"]
                         and groups_n == n_devices)

@@ -20,6 +20,7 @@ embeds the driver table's {address, rkey}
 from __future__ import annotations
 
 import threading
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, Optional
 
@@ -684,31 +685,9 @@ class TpuShuffleManager:
         admit, release_admitted = self._make_admitter(
             plan, width, stage_buf.requested, timeout)
 
-        # weakref, not a strong reference: on_done is held BY the pending
-        # handle, so a strong handle_box->pending edge would be a cycle
-        # that defers the __del__-based abandoned-handle release (pinned
-        # buffer + admitted bytes) from refcounting to cyclic GC
-        import weakref
-        handle_box = {}
-
-        def on_done(result):
-            # fires from PendingShuffle.result() — with None on failure —
-            # exactly once; the pack buffer stays pinned until the last
-            # dispatch has staged it
-            self.node.pool.put(stage_buf)
-            release_admitted()
-            if result is not None:
-                self._learn_cap(handle, result, int(nvalid.sum()))
-                self.node.metrics.inc("shuffle.rows", float(nvalid.sum()))
-                self.node.metrics.inc("shuffle.bytes",
-                                      float(nvalid.sum()) * width * 4)
-            ref = handle_box.get("pending")
-            p = ref() if ref is not None else None
-            if p is not None and getattr(p, "_attempt", 0):
-                # overflow retries this read paid (capacity growth) — the
-                # reporter-visible retry counter
-                self.node.metrics.inc("shuffle.retries",
-                                      float(p._attempt))
+        on_done, arm = self._arm_read_callbacks(
+            stage_buf, release_admitted, handle,
+            int(nvalid.sum()), int(nvalid.sum()), width)
 
         # Buffer ownership: until a pending handle exists, failures here
         # (the fault site, compile errors inside the first dispatch) must
@@ -739,13 +718,45 @@ class TpuShuffleManager:
                         self.exchange_mesh, self.axis, plan,
                         shard_rows, nvalid, vt, val_dtype,
                         on_done=on_done, admit=admit)
-            handle_box["pending"] = weakref.ref(pending)
+            arm(pending)
             return pending
         except BaseException:
             if pending is None:
                 self.node.pool.put(stage_buf)
                 release_admitted()
             raise
+
+    def _arm_read_callbacks(self, stage_buf, release_admitted, handle,
+                            global_rows: int, local_rows: int, width: int):
+        """(on_done, arm) pair shared by the local and distributed submit
+        paths: exactly-once pinned-buffer + admission release, capacity
+        learning, and the reporter counters (rows/bytes local to this
+        process; retries read from the pending handle). ``arm(pending)``
+        records a WEAK reference — a strong one would cycle through
+        on_done back to the pending and defer the __del__-based
+        abandoned-handle release from refcounting to cyclic GC."""
+        handle_box = {}
+
+        def on_done(result):
+            self.node.pool.put(stage_buf)
+            release_admitted()
+            if result is not None:
+                self._learn_cap(handle, result, global_rows)
+                self.node.metrics.inc("shuffle.rows", float(local_rows))
+                self.node.metrics.inc("shuffle.bytes",
+                                      float(local_rows) * width * 4)
+            ref = handle_box.get("pending")
+            pend = ref() if ref is not None else None
+            if pend is not None and getattr(pend, "_attempt", 0):
+                # overflow retries this read paid (capacity growth) — the
+                # reporter-visible retry counter
+                self.node.metrics.inc("shuffle.retries",
+                                      float(pend._attempt))
+
+        def arm(pending):
+            handle_box["pending"] = weakref.ref(pending)
+
+        return on_done, arm
 
     # -- capacity learning -------------------------------------------------
     @staticmethod
@@ -1071,27 +1082,9 @@ class TpuShuffleManager:
         admit, release_admitted = self._make_admitter(
             plan, width, stage_buf.requested, None)
 
-        # weakref: same cycle-avoidance as the local path
-        import weakref
-        handle_box = {}
-
-        def on_done(result):
-            # fires from PendingDistributedShuffle.result() — with None on
-            # failure — exactly once; the pack buffer stays pinned until
-            # the last dispatch has staged it
-            self.node.pool.put(stage_buf)
-            release_admitted()
-            if result is not None:
-                self._learn_cap(handle, result, int(nvalid.sum()))
-                self.node.metrics.inc("shuffle.rows",
-                                      float(nvalid_local.sum()))
-                self.node.metrics.inc("shuffle.bytes",
-                                      float(nvalid_local.sum()) * width * 4)
-            ref = handle_box.get("pending")
-            p = ref() if ref is not None else None
-            if p is not None and getattr(p, "_attempt", 0):
-                self.node.metrics.inc("shuffle.retries",
-                                      float(p._attempt))
+        on_done, arm = self._arm_read_callbacks(
+            stage_buf, release_admitted, handle,
+            int(nvalid.sum()), int(nvalid_local.sum()), width)
 
         # same ownership rule as the local path: the armed handle is the
         # sole releaser of the pack buffer
@@ -1111,7 +1104,7 @@ class TpuShuffleManager:
                     dcn_axis=self.conf.mesh_dcn_axis
                     if self.hierarchical else None,
                     on_done=on_done, admit=admit)
-            handle_box["pending"] = weakref.ref(pending)
+            arm(pending)
             return pending
         except BaseException:
             if pending is None:

@@ -463,7 +463,11 @@ class PendingExchangeBase:
         # path must do the same or the pool hands the bytes to the next
         # shuffle mid-DMA).
         try:
-            if self._result is None and getattr(self, "_out", None):
+            if self._result is None and not getattr(self, "_dead", False) \
+                    and getattr(self, "_out", None):
+                # never block on a DEAD handle's outputs: a failed
+                # distributed exchange's collective outputs may never
+                # complete (peer gone) — blocking would hang GC/shutdown
                 for x in self._out:
                     try:
                         x.block_until_ready()
@@ -491,8 +495,11 @@ class PendingExchangeBase:
         except Exception:
             # on_done fires exactly once and releases the pinned pack
             # buffer, so the handle cannot be retried — mark it dead for a
-            # clear error instead of an AttributeError on stale state
+            # clear error instead of an AttributeError on stale state.
+            # _out is dropped too: __del__ must not find (and block on)
+            # outputs of a failed collective.
             self._dead = True
+            self._out = None
             self._notify(None)
             raise
         self._result = res
