@@ -71,17 +71,18 @@ def main():
         for nseg in (1, 8, 64, 512):
             seg = rows // nseg
 
-            def step(data):
-                def inner(d):
-                    out = jnp.zeros_like(d)
-                    offs = jnp.arange(nseg, dtype=jnp.int32) * seg
-                    sizes = jnp.full((nseg,), seg, jnp.int32)
-                    return jax.lax.ragged_all_to_all(
-                        d, out, offs, sizes, offs, sizes, axis_name="x")
-                return jax.jit(jax.shard_map(
-                    inner, mesh=mesh, in_specs=(P("x"),),
-                    out_specs=P("x")))(data)
+            def inner(d, nseg=nseg, seg=seg):
+                out = jnp.zeros_like(d)
+                offs = jnp.arange(nseg, dtype=jnp.int32) * seg
+                sizes = jnp.full((nseg,), seg, jnp.int32)
+                return jax.lax.ragged_all_to_all(
+                    d, out, offs, sizes, offs, sizes, axis_name="x")
 
+            # jit hoisted OUT of the timed callable: rebuilding the
+            # wrapper per rep would retrace every call and measure
+            # tracing, not the op
+            step = jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=(P("x"),), out_specs=P("x")))
             ms = timed(step, payload)
             emit("a2a_n1_segments", nseg=nseg, ms=round(ms, 3),
                  GBps=round(nbytes / ms / 1e6, 2))
@@ -90,8 +91,7 @@ def main():
 
     # ---- 2. local-move formulation at the same shape --------------------
     try:
-        def local_move(d):
-            return jax.jit(lambda x: jnp.roll(x, 1, axis=0))(d)
+        local_move = jax.jit(lambda x: jnp.roll(x, 1, axis=0))
         ms = timed(local_move, payload)
         emit("local_roll_copy", ms=round(ms, 3),
              GBps=round(nbytes / ms / 1e6, 2))

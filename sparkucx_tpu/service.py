@@ -136,15 +136,11 @@ class ShuffleService:
         (io/varlen.py pack_counted_varbytes), or the combiner would sum
         the payload bytes (manager.read docstring)."""
         if self.io_format == "arrow":
-            if combine:
-                raise ValueError(
-                    "combine rides the raw transport; read the combined "
-                    "result with io.format=raw and convert, or aggregate "
-                    "the returned batches")
             from sparkucx_tpu.io.arrow import read_batches
             return read_batches(self.manager, handle,
                                 key_column=self.key_column, timeout=timeout,
-                                ordered=ordered)
+                                ordered=ordered, combine=combine,
+                                combine_sum_words=combine_sum_words)
         return self.manager.read(handle, timeout=timeout, combine=combine,
                                  ordered=ordered,
                                  combine_sum_words=combine_sum_words)
