@@ -139,6 +139,33 @@ def main():
     except Exception as e:
         emit("plain_step_n1", error=str(e)[:300])
 
+    # ---- 4b. sort-key width: int32 vs int8 destination key --------------
+    # XLA:TPU sort cost tracks PROVABLE key width (NOTES_r2); an explicit
+    # int8 key (destinations < 127 always, in practice) may buy what the
+    # unstable-sort change bought. Measured here before touching the
+    # production default.
+    try:
+        from sparkucx_tpu.ops.partition import counts_from_sorted
+        part8 = (rng.integers(0, 8, size=rows)).astype(np.int32)
+        part_dev2 = jax.device_put(jnp.asarray(part8))
+
+        def sort_with_key(dtype):
+            def fn(r, p):
+                key = p.astype(dtype)
+                ops = (key,) + tuple(r[:, i] for i in range(W))
+                out = jax.lax.sort(ops, num_keys=1, is_stable=False)
+                return jnp.stack(out[1:], axis=1), \
+                    counts_from_sorted(out[0].astype(jnp.int32), 8)
+            return jax.jit(fn)
+
+        for dt, name in ((jnp.int32, "int32"), (jnp.int8, "int8")):
+            fn = sort_with_key(dt)
+            ms = timed(fn, payload, part_dev2)
+            emit("sort_key_width", key_dtype=name, ms=round(ms, 3),
+                 GBps=round(nbytes / ms / 1e6, 2))
+    except Exception as e:
+        emit("sort_key_width", error=str(e)[:200])
+
     # ---- 5. AOT n=8 multi-peer lowering proof ---------------------------
     try:
         from sparkucx_tpu.shuffle.aot import aot_compile_native_step
