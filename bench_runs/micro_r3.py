@@ -166,6 +166,35 @@ def main():
     except Exception as e:
         emit("sort_key_width", error=str(e)[:200])
 
+    # ---- 4c. first-party Pallas remote-DMA a2a vs XLA ragged a2a, n=1 ---
+    # The stock op costs ~23 ms for 80 MB on one device (bookkeeping, not
+    # wire); the Pallas kernel is P one-sided DMAs — if the gap is the
+    # op's overhead, this shows it directly.
+    try:
+        from jax.sharding import Mesh, PartitionSpec as P
+        from sparkucx_tpu.ops.pallas.ragged_a2a import (
+            align_rows, chunk_rows_for, pallas_ragged_all_to_all)
+        chunkr = chunk_rows_for(W)
+        cap = int(align_rows(rows, chunkr) + chunkr)
+        padded = np.zeros((cap, W), np.int32)
+        padded[:rows] = payload_np
+        mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+
+        def pstep(d, sz):
+            return pallas_ragged_all_to_all(
+                d, sz[0], "x", out_capacity=cap, num_devices=1)
+
+        fn = jax.jit(jax.shard_map(
+            pstep, mesh=mesh, in_specs=(P("x"), P("x")),
+            out_specs=(P("x"),) * 4, check_vma=False))
+        sz = jnp.full((1, 1), rows, jnp.int32)
+        pd = jax.device_put(jnp.asarray(padded))
+        ms = timed(lambda d: fn(d, sz), pd)
+        emit("pallas_a2a_n1", ms=round(ms, 3),
+             GBps=round(nbytes / ms / 1e6, 2))
+    except Exception as e:
+        emit("pallas_a2a_n1", error=str(e)[:300])
+
     # ---- 5. AOT n=8 multi-peer lowering proof ---------------------------
     try:
         from sparkucx_tpu.shuffle.aot import aot_compile_native_step

@@ -1,0 +1,176 @@
+"""First-party Pallas remote-DMA ragged all-to-all (ops/pallas/ragged_a2a).
+
+Validated entirely off-fleet: Pallas TPU INTERPRET mode simulates the
+cross-device DMAs (with race detection) on the CPU mesh against a numpy
+oracle; the Mosaic lowering is proven by AOT compilation against an
+unattached v5e topology (same pattern as shuffle/aot.py)."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.ops.pallas.ragged_a2a import (
+    align_rows,
+    build_aligned_send_np,
+    chunk_rows_for,
+    pallas_ragged_all_to_all,
+)
+
+
+def test_chunk_rows():
+    assert chunk_rows_for(1) == 128
+    assert chunk_rows_for(2) == 64
+    assert chunk_rows_for(10) == 64      # 64*10 = 640 = 5*128
+    assert chunk_rows_for(128) == 1
+    assert chunk_rows_for(3) == 128
+
+
+def _run_interpret(n, width, sizes, seed=0):
+    """Run the kernel in interpret mode on an n-device CPU submesh and
+    check every (sender, receiver) segment against the oracle."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    chunk = chunk_rows_for(width)
+    rng = np.random.default_rng(seed)
+    cap_in = max(int(align_rows(int(a.sum()), chunk) + n * chunk)
+                 for a in sizes)
+    cap_out = int(align_rows(int(sizes.sum(axis=0).max()), chunk)
+                  + n * chunk)
+
+    segs = {}   # (i, j) -> payload rows
+    send_bufs = []
+    for i in range(n):
+        blocks = []
+        for j in range(n):
+            seg = rng.integers(0, 1 << 30,
+                               size=(int(sizes[i, j]), width)).astype(
+                np.int32)
+            segs[(i, j)] = seg
+            blocks.append(seg)
+        send_bufs.append(build_aligned_send_np(blocks, width, cap_in))
+    data = np.stack(send_bufs)                       # [n, cap_in, W]
+
+    mesh = Mesh(np.array(jax.devices()[:n]), ("x",))
+
+    def step(rows, sz):
+        return pallas_ragged_all_to_all(
+            rows, sz[0], "x", out_capacity=cap_out, num_devices=n,
+            interpret=True)
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(P("x"), P("x")),
+        out_specs=(P("x"),) * 4, check_vma=False))
+    out, recv, recv_off, total = fn(
+        jnp.asarray(data.reshape(n * cap_in, width)),
+        jnp.asarray(sizes.astype(np.int32)))
+    out = np.asarray(out).reshape(n, cap_out, width)
+    recv = np.asarray(recv).reshape(n, n)
+    recv_off = np.asarray(recv_off).reshape(n, n)
+    for q in range(n):
+        assert recv[q].tolist() == sizes[:, q].tolist()
+        for p in range(n):
+            got = out[q, recv_off[q, p]: recv_off[q, p] + sizes[p, q]]
+            np.testing.assert_array_equal(
+                got, segs[(p, q)],
+                err_msg=f"segment {p}->{q} corrupted")
+
+
+# NOTE: every interpret test runs over the FULL backend mesh — a submesh
+# under TPU interpret mode deadlocks its global barrier machinery (the
+# simulator tracks all backend devices).
+def test_interpret_oracle_even(mesh8):
+    sizes = np.full((8, 8), 65, np.int32)
+    _run_interpret(8, 10, sizes)
+
+
+def test_interpret_oracle_skewed(mesh8):
+    rng = np.random.default_rng(3)
+    sizes = rng.integers(0, 200, size=(8, 8)).astype(np.int32)
+    sizes[0, 1] = 0                      # empty segment
+    sizes[2, 2] = 777                    # heavy self-segment
+    _run_interpret(8, 10, sizes, seed=4)
+
+
+def test_interpret_oracle_width1(mesh8):
+    rng = np.random.default_rng(5)
+    sizes = rng.integers(1, 50, size=(8, 8)).astype(np.int32)
+    _run_interpret(8, 1, sizes, seed=6)
+
+
+def test_interpret_oracle_eight_devices(mesh8):
+    rng = np.random.default_rng(7)
+    sizes = rng.integers(0, 80, size=(8, 8)).astype(np.int32)
+    _run_interpret(8, 10, sizes, seed=8)
+
+
+def test_mosaic_aot_lowering_v5e(mesh8):
+    """The Mosaic lowering proof: compile the kernel at n=8 against an
+    unattached v5e topology (no devices needed). Skips where libtpu /
+    topology support is absent."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    try:
+        import os
+        os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "true")
+        from jax.experimental import topologies
+        topo = topologies.get_topology_desc("v5e:2x4", platform="tpu")
+    except Exception as e:
+        pytest.skip(f"no TPU topology support here: {e}")
+    n, width = 8, 10
+    chunk = chunk_rows_for(width)
+    cap_in = cap_out = int(align_rows(4096, chunk) + n * chunk)
+    tmesh = Mesh(np.array(topo.devices), ("x",))
+    sh = NamedSharding(tmesh, P("x"))
+
+    def step(rows, sz):
+        return pallas_ragged_all_to_all(
+            rows, sz[0], "x", out_capacity=cap_out, num_devices=n)
+
+    fn = jax.jit(jax.shard_map(
+        step, mesh=tmesh, in_specs=(P("x"), P("x")),
+        out_specs=(P("x"),) * 4, check_vma=False))
+    compiled = fn.lower(
+        jax.ShapeDtypeStruct((n * cap_in, width), jnp.int32, sharding=sh),
+        jax.ShapeDtypeStruct((n, n), jnp.int32, sharding=sh)).compile()
+    # the kernel must survive into post-optimization HLO as the TPU
+    # custom call — an elided/constant-folded kernel is not a proof
+    txt = compiled.as_text().lower()
+    assert "custom-call" in txt and "tpu_custom_call" in txt, \
+        "pallas kernel missing from post-opt HLO"
+
+
+def test_overflow_skips_exchange_meshwide(mesh8):
+    """Under-provisioned out_capacity must SKIP the exchange everywhere
+    (total_aligned == -1, zero recv sizes) — a one-sided DMA past a
+    receiver's buffer would be silent remote HBM corruption.
+
+    Sizes stay TINY: the TPU interpreter's on_wait DMA scheduler
+    busy-spins (no sleep) while draining big transfer windows, and a
+    uniformly-large 8x8 exchange livelocks it — an interpreter
+    limitation, not a kernel property (the oracle tests cover realistic
+    skew below that threshold; the real lowering is proven by the AOT
+    test)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n, width = 8, 10
+    chunk = chunk_rows_for(width)
+    sizes = np.full((n, n), 1, np.int32)           # needs 8*chunk rows
+    cap_in = int(align_rows(n * chunk, chunk))
+    cap_out = chunk                                 # way too small
+    data = np.zeros((n, cap_in, width), np.int32)
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    fn = jax.jit(jax.shard_map(
+        lambda r, s: pallas_ragged_all_to_all(
+            r, s[0], "x", out_capacity=cap_out, num_devices=n,
+            interpret=True),
+        mesh=mesh, in_specs=(P("x"), P("x")), out_specs=(P("x"),) * 4,
+        check_vma=False))
+    out, recv, roff, total = fn(
+        jnp.asarray(data.reshape(n * cap_in, width)), jnp.asarray(sizes))
+    assert (np.asarray(total) == -1).all()
+    assert (np.asarray(recv) == 0).all()
