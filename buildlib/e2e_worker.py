@@ -249,11 +249,64 @@ def main() -> int:
                 f"pipelined partition {r} mismatch on process {proc_id}"
             pcheck += 1
 
+    # fifth job: TEXT WordCount across processes — string keys hash to
+    # 64-bit routing keys, word bytes ride as carried varlen payload,
+    # device combine sums the count lane (the round-3 opaque-byte
+    # capability exercised on the REAL multi-process exchange)
+    from sparkucx_tpu.io.varlen import (hash_bytes64,
+                                        pack_counted_varbytes,
+                                        unpack_counted_rows)
+    vocab = ["alpha", "beta", "gamma", "delta", "naïve", "Straße",
+             "x"] + [f"w{i:03d}" for i in range(60)]
+    hv = mgr.register_shuffle(12, num_maps, R)
+    truth_txt = {}
+    for m in range(num_maps):
+        rngm = np.random.default_rng(5000 + m)
+        idx = rngm.integers(0, len(vocab), size=400)
+        words = [vocab[i] for i in idx]
+        for wd in words:
+            truth_txt[wd] = truth_txt.get(wd, 0) + 1
+        if m in my_maps:
+            vals, sum_words = pack_counted_varbytes(
+                words, np.ones(len(words), np.int32), 16)
+            w = mgr.get_writer(hv, m)
+            w.write(hash_bytes64(words), vals)
+            w.commit(R)
+    sum_words = 1  # pack_counted_varbytes contract
+    resv = mgr.read(hv, combine="sum", combine_sum_words=sum_words)
+    got_txt = {}
+    vcheck = 0
+    for r, (ks, vs) in resv.partitions():
+        if not ks.shape[0]:
+            continue
+        counts, items = unpack_counted_rows(ks.shape[0], vs)
+        for it, c in zip(items, counts.tolist()):
+            wd = it.decode("utf-8")
+            assert wd not in got_txt, f"dup combined word {wd!r}"
+            got_txt[wd] = c
+        vcheck += 1
+    # each process sees only its partitions; allgather the partial counts
+    # and verify the global dictionary on every process. Counts ride
+    # indexed by the (deterministic, identical-everywhere) vocabulary —
+    # NOT by raw 64-bit hashes: allgather_blob goes through jnp, which
+    # silently truncates int64 to 32 bits with x64 off (the transport
+    # itself avoids that with bit-split words; the harness must too).
+    word_ix = {wd: i for i, wd in enumerate(sorted(truth_txt))}
+    blob = np.zeros(len(word_ix), dtype=np.int64)
+    for wd, c in got_txt.items():
+        assert wd in word_ix, f"unexpected word {wd!r}"
+        blob[word_ix[wd]] = c
+    merged = allgather_blob(blob).sum(axis=0)
+    want_vec = np.array([truth_txt[wd] for wd in sorted(truth_txt)],
+                        dtype=np.int64)
+    assert merged.tolist() == want_vec.tolist(), \
+        "distributed text wordcount mismatch"
+
     mgr.stop()
     node.close()
     print(f"worker {proc_id}/{nprocs}: verified {checked} local "
           f"partitions of {R} OK (+{ccheck} combined, {ocheck} ordered, "
-          f"{pcheck} pipelined)", flush=True)
+          f"{pcheck} pipelined, {vcheck} varlen)", flush=True)
     return 0
 
 

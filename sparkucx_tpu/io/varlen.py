@@ -160,3 +160,12 @@ def unpack_counted_varbytes(values: np.ndarray
         raise ValueError(f"expected int32 value rows, got {values.dtype}")
     counts = values[:, 0].astype(np.int64)
     return counts, unpack_varbytes(values[:, 1:])
+
+
+def unpack_counted_rows(n_rows: int, values: np.ndarray
+                        ) -> Tuple[np.ndarray, List[bytes]]:
+    """:func:`unpack_counted_varbytes` for values as they come back from
+    a shuffle read — reinterprets the [n, ...] value block as int32 rows
+    first (one place for the view dance instead of every call site)."""
+    rows = np.ascontiguousarray(values).reshape(n_rows, -1).view(np.int32)
+    return unpack_counted_varbytes(rows)

@@ -98,8 +98,9 @@ def aligned_plan(sizes: jnp.ndarray, axis_name: str, width: int
     total_aligned = recv_sz.sum().astype(jnp.int32)
     real_recv = all_raw[:, me].astype(jnp.int32)                  # [P]
     max_recv_total = all_sz.sum(axis=0).max().astype(jnp.int32)
+    max_send_total = all_sz.sum(axis=1).max().astype(jnp.int32)
     return (in_off, a_sizes, out_off, recv_sz, recv_off, total_aligned,
-            real_recv, max_recv_total)
+            real_recv, max_recv_total, max_send_total)
 
 
 def _kernel(in_off, in_sz, out_off, recv_sz, x_ref, o_ref,
@@ -199,14 +200,17 @@ def pallas_ragged_all_to_all(
     m_out = out_capacity * width // LANES
 
     (in_off, in_sz, out_off, recv_sz_al, recv_off, total_al,
-     real_recv, max_recv_total) = aligned_plan(sizes, axis_name, width)
-    # Capacity guard: a one-sided write past a receiver's out buffer is a
-    # SILENT remote HBM corruption, so on ANY device overflowing
-    # out_capacity every device zeroes its plan (no DMAs, no waits — the
-    # predicate is derived from the shared size matrix, so the skip is
-    # consistent mesh-wide) and the caller retries bigger, exactly the
-    # native path's overflow contract (shuffle/alltoall._a2a_native).
-    overflow = max_recv_total > out_capacity
+     real_recv, max_recv_total, max_send_total) = aligned_plan(
+        sizes, axis_name, width)
+    # Capacity guard, BOTH sides: a one-sided write past a receiver's out
+    # buffer is silent remote HBM corruption, and a send whose aligned
+    # segments overrun cap_in would DMA garbage from past the send buffer
+    # into peers' valid segments. On ANY device overflowing, every device
+    # zeroes its plan (no DMAs, no waits — the predicate derives from the
+    # shared size matrix, so the skip is consistent mesh-wide) and the
+    # caller retries bigger, exactly the native path's overflow contract
+    # (shuffle/alltoall._a2a_native).
+    overflow = (max_recv_total > out_capacity) | (max_send_total > cap_in)
     z = jnp.where(overflow, 0, 1).astype(jnp.int32)
     in_sz = in_sz * z
     recv_sz_al = recv_sz_al * z

@@ -68,7 +68,7 @@ def run_wordcount_text(manager: TpuShuffleManager, *, num_mappers: int = 4,
     reduce side recovers exact (word, count) pairs. Verified against a
     host dictionary of real string keys."""
     from sparkucx_tpu.io.varlen import (hash_bytes64, pack_counted_varbytes,
-                                        unpack_counted_varbytes)
+                                        unpack_counted_rows)
     rng = np.random.default_rng(seed)
     # a realistic vocabulary: zipf-weighted words of varied length,
     # including unicode and single-letter words
@@ -95,9 +95,7 @@ def run_wordcount_text(manager: TpuShuffleManager, *, num_mappers: int = 4,
         for r, (k, v) in res.partitions():
             if v is None or not k.shape[0]:
                 continue
-            counts, words_b = unpack_counted_varbytes(
-                np.ascontiguousarray(v).reshape(k.shape[0], -1)
-                .view(np.int32))
+            counts, words_b = unpack_counted_rows(k.shape[0], v)
             for c, wb in zip(counts, words_b):
                 wd = wb.decode("utf-8")
                 got[wd] = got.get(wd, 0) + int(c)

@@ -174,3 +174,30 @@ def test_overflow_skips_exchange_meshwide(mesh8):
         jnp.asarray(data.reshape(n * cap_in, width)), jnp.asarray(sizes))
     assert (np.asarray(total) == -1).all()
     assert (np.asarray(recv) == 0).all()
+
+
+def test_send_overflow_skips_exchange_meshwide(mesh8):
+    """Sizes claiming more rows than cap_in holds must also skip the
+    exchange mesh-wide: an aligned send overrun would DMA garbage from
+    past the send buffer into peers' valid segments."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    n, width = 8, 10
+    chunk = chunk_rows_for(width)
+    sizes = np.full((n, n), 2 * chunk, np.int32)   # aligned = 16*chunk
+    cap_in = 4 * chunk                              # too small
+    cap_out = int(align_rows(n * 2 * chunk, chunk))
+    data = np.zeros((n, cap_in, width), np.int32)
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    fn = jax.jit(jax.shard_map(
+        lambda r, s: pallas_ragged_all_to_all(
+            r, s[0], "x", out_capacity=cap_out, num_devices=n,
+            interpret=True),
+        mesh=mesh, in_specs=(P("x"), P("x")), out_specs=(P("x"),) * 4,
+        check_vma=False))
+    out, recv, roff, total = fn(
+        jnp.asarray(data.reshape(n * cap_in, width)), jnp.asarray(sizes))
+    assert (np.asarray(total) == -1).all()
+    assert (np.asarray(recv) == 0).all()
