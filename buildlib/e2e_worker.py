@@ -97,6 +97,22 @@ def main() -> int:
         vals = np.repeat(keys[:, None], 2, axis=1).astype(np.int32)
         return keys, vals
 
+    # Overlap trace+compile with the map phase (the preconnect analog,
+    # ref: UcxWorkerWrapper.scala:125-127): warmup is a COLLECTIVE, so
+    # every process calls it with identical arguments before staging.
+    # Rows-per-shard prediction: maps round-robin over each process's
+    # local shards; with num_maps spread over nprocs processes of L
+    # shards each, a shard holds ceil-share of its process's maps.
+    L = len(node.local_shard_ids)
+    per_shard = np.zeros(node.num_devices, dtype=np.int64)
+    for p in range(nprocs):
+        p_maps = [m for m in range(num_maps) if m % nprocs == p]
+        base = p * L    # processes own contiguous shard blocks in mesh order
+        for ordinal, _m in enumerate(p_maps):
+            per_shard[base + ordinal % L] += pairs_per_map
+    mgr.warmup(h, rows_per_shard=per_shard,
+               val_shape=(2,), val_dtype=np.int32)
+
     # each process writes ITS map tasks (maps round-robin over processes,
     # like tasks over executors)
     my_maps = [m for m in range(num_maps) if m % nprocs == proc_id]

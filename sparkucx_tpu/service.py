@@ -121,6 +121,12 @@ class ShuffleService:
         arrow callers convert with io.arrow.batch_to_kv)."""
         return self.manager.get_writer(handle, map_id)
 
+    def warmup(self, handle: ShuffleHandle, **kw):
+        """Pre-compile the exchange for a handle's expected shape while
+        map tasks run — the preconnect analog (manager.warmup docstring;
+        ref: UcxWorkerWrapper.scala:125-127)."""
+        return self.manager.warmup(handle, **kw)
+
     # -- reduce side (getReader) ------------------------------------------
     def read(self, handle: ShuffleHandle,
              timeout: Optional[float] = None,
