@@ -98,29 +98,47 @@ def main() -> int:
         return keys, vals
 
     # Overlap trace+compile with the map phase (the preconnect analog,
-    # ref: UcxWorkerWrapper.scala:125-127): warmup is a COLLECTIVE, so
-    # every process calls it with identical arguments before staging.
-    # Rows-per-shard prediction: maps round-robin over each process's
-    # local shards; with num_maps spread over nprocs processes of L
-    # shards each, a shard holds ceil-share of its process's maps.
+    # ref: UcxWorkerWrapper.scala:125-127): warmup runs on a BACKGROUND
+    # thread while the main thread stages map outputs (host-only numpy
+    # work — no device op races the warmup collective), joined before
+    # read() so the collective ordering stays SPMD-uniform. Every process
+    # spawns it at the same point with identical arguments.
+    #
+    # Rows-per-shard prediction: make_plan consumes only max() and sum()
+    # of this vector, both placement-invariant — so each process's map
+    # count is spread over L abstract slots with NO assumption about
+    # where its shards sit in the global mesh order.
+    import threading
+
     L = len(node.local_shard_ids)
     per_shard = np.zeros(node.num_devices, dtype=np.int64)
     for p in range(nprocs):
-        p_maps = [m for m in range(num_maps) if m % nprocs == p]
-        base = p * L    # processes own contiguous shard blocks in mesh order
-        for ordinal, _m in enumerate(p_maps):
-            per_shard[base + ordinal % L] += pairs_per_map
-    mgr.warmup(h, rows_per_shard=per_shard,
-               val_shape=(2,), val_dtype=np.int32)
+        n_p = len(range(p, num_maps, nprocs))
+        for ordinal in range(n_p):
+            per_shard[(p * L + ordinal % L) % node.num_devices] += \
+                pairs_per_map
+    warm_err = []
+
+    def _warm():
+        try:
+            mgr.warmup(h, rows_per_shard=per_shard,
+                       val_shape=(2,), val_dtype=np.int32)
+        except Exception as e:   # surfaced after join, not swallowed
+            warm_err.append(e)
+    warm_thread = threading.Thread(target=_warm)
+    warm_thread.start()
 
     # each process writes ITS map tasks (maps round-robin over processes,
-    # like tasks over executors)
+    # like tasks over executors) — overlapping the warmup compile
     my_maps = [m for m in range(num_maps) if m % nprocs == proc_id]
     for m in my_maps:
         w = mgr.get_writer(h, m)
         k, v = map_data(m)
         w.write(k, v)
         w.commit(R)
+    warm_thread.join()
+    if warm_err:
+        raise warm_err[0]
 
     if recovery_phase == "1":
         from sparkucx_tpu.runtime.failures import StaleEpochError
