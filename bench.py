@@ -678,7 +678,7 @@ def main() -> None:
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--sort-impl", default="auto",
                     help="destination_sort method: auto|argsort|multisort|"
-                         "counting (A/B the hot path)")
+                         "multisort8|counting (A/B the hot path)")
     ap.add_argument("--read-mode", default="plain",
                     choices=("plain", "ordered", "combine"),
                     help="exchange flavor for the main stages (combine = "

@@ -41,7 +41,7 @@ def hash_partition(keys: jnp.ndarray, num_partitions: int) -> jnp.ndarray:
     return (hash32(keys) % jnp.uint32(num_partitions)).astype(jnp.int32)
 
 
-SORT_METHODS = ("auto", "argsort", "multisort", "counting")
+SORT_METHODS = ("auto", "argsort", "multisort", "multisort8", "counting")
 
 
 def counts_from_sorted(sorted_key: jnp.ndarray, num_bins: int) -> jnp.ndarray:
@@ -86,6 +86,12 @@ def destination_sort(
         ``multisort`` — one multi-operand ``lax.sort`` carrying every row
                         column through the sort network; no gather at all.
                         Needs 2-D rows.
+        ``multisort8``— multisort with the key narrowed to int8 (sort
+                        cost tracks provable key width). Eligible when
+                        every key value incl. the padding sentinel fits
+                        int8 (num_dests < 127) and rows are 2-D; falls
+                        back to argsort otherwise. Same unstable
+                        grouping contract as multisort.
         ``counting``  — counting sort: one-hot cumsum ranks (no comparison
                         sort), then a single row-gather via the inverse
                         permutation. O(cap x num_dests) scratch — only for
@@ -122,6 +128,18 @@ def destination_sort(
             method = "argsort"
     if method == "counting" and num_dests > 64:
         method = "argsort"  # O(cap x D) scratch would dwarf the payload
+    if method == "multisort8":
+        # multisort with the key narrowed to int8: XLA:TPU sort cost
+        # tracks PROVABLE key width (NOTES_r2 measured stability — an
+        # implicit index widening — at ~40% of sort cost), so an
+        # explicitly 1-byte destination key is the next width lever.
+        # Valid only when every key value (incl. the padding sentinel
+        # num_dests) fits int8; conf-selectable for on-chip A/B
+        # (bench --sort-impl multisort8).
+        narrow = num_dests < 127 and rows.ndim == 2
+        method = "multisort" if narrow else "argsort"
+    else:
+        narrow = False
     if method == "multisort" and rows.ndim != 2:
         method = "argsort"
 
@@ -132,6 +150,8 @@ def destination_sort(
         sorted_rows = jnp.take(rows, order, axis=0)
         counts = counts_from_sorted(jnp.take(key, order), num_dests)
     elif method == "multisort":
+        if narrow:
+            key = key.astype(jnp.int8)
         ops = (key,) + tuple(rows[:, i] for i in range(rows.shape[1]))
         # is_stable=False: measured on v5e at 2M x 10-int32 rows, the
         # stability machinery is ~40% of the whole sort (22.1 ms stable vs
