@@ -281,7 +281,8 @@ class TpuShuffleConf:
     @property
     def sort_impl(self) -> str:
         """Destination-sort formulation for the exchange hot path:
-        auto | argsort | multisort | counting (ops/partition.py)."""
+        auto | argsort | multisort | multisort8 | counting
+        (ops/partition.py)."""
         v = self._get("a2a.sortImpl", "auto")
         from sparkucx_tpu.ops.partition import SORT_METHODS
         if v not in SORT_METHODS:

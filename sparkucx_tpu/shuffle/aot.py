@@ -85,11 +85,18 @@ def aot_compile_native_step(
         return report
     mesh = topologies.make_mesh(topo, (n_devices,), ("shuffle",))
 
+    # sort_impl pinned to the TPU formulation: inside an AOT compile the
+    # tracing process's default backend is usually CPU, and "auto" keys
+    # on THAT — it would silently compile the counting-sort (scatter)
+    # path the chip never runs (verified by HLO census: auto under a CPU
+    # host put a 2M-row scatter in the "TPU" program; pinned multisort
+    # puts zero)
     plan = ShufflePlan(num_shards=n_devices,
                        num_partitions=4 * n_devices,
                        cap_in=rows_per_shard,
                        cap_out=2 * rows_per_shard,
-                       impl="native")
+                       impl="native",
+                       sort_impl="multisort")
     step = step_body(plan, "shuffle")
     sm = jax.shard_map(
         step, mesh=mesh,
