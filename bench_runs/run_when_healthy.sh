@@ -1,0 +1,35 @@
+#!/bin/bash
+# One healthy-tunnel window -> maximum measurement throughput.
+# Runs the round-3 experiment ladder, then the official bench with the
+# A/B levers, saving every artifact under bench_runs/. NOTHING here
+# wraps TPU work in an external kill-timeout (NOTES_r2: that wedges the
+# tunnel); every python below has its own in-process watchdog.
+set -u
+cd "$(dirname "$0")/.."
+TS=$(date +%H%M%S)
+
+echo "== probe =="
+python - <<'EOF' || exit 3
+from bench import _tpu_probe_once
+import sys
+rec = _tpu_probe_once(240)
+print(rec)
+sys.exit(0 if rec.get("rc") == 0 and rec.get("backend") == "tpu" else 3)
+EOF
+
+echo "== micro ladder =="
+python bench_runs/micro_r3.py --watchdog 1500 \
+    | tee "bench_runs/r3_micro_${TS}.jsonl"
+
+echo "== official ladder (auto sort) =="
+python bench.py --no-fallback --init-retry-s 60 \
+    | tail -1 | tee "bench_runs/r3_tpu_${TS}_auto.json"
+
+echo "== A/B: multisort8 =="
+python bench.py --no-fallback --init-retry-s 60 --sort-impl multisort8 \
+    | tail -1 | tee "bench_runs/r3_tpu_${TS}_ms8.json"
+
+echo "== TPU-gated suite =="
+SPARKUCX_TPU_TEST_TPU=1 python -m pytest tests/test_tpu_native.py -q
+
+echo "== done — commit the artifacts =="
