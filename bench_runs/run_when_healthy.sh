@@ -4,7 +4,7 @@
 # A/B levers, saving every artifact under bench_runs/. NOTHING here
 # wraps TPU work in an external kill-timeout (NOTES_r2: that wedges the
 # tunnel); every python below has its own in-process watchdog.
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 TS=$(date +%H%M%S)
 
@@ -21,13 +21,23 @@ echo "== micro ladder =="
 python bench_runs/micro_r3.py --watchdog 1500 \
     | tee "bench_runs/r3_micro_${TS}.jsonl"
 
+run_bench() {  # label, extra args... — junk must not look like a result
+    local label=$1; shift
+    local out="bench_runs/r3_tpu_${TS}_${label}.json"
+    if python bench.py --no-fallback --init-retry-s 60 "$@" \
+            | tail -1 | tee "$out"; then
+        echo "saved $out"
+    else
+        mv "$out" "$out.FAILED" 2>/dev/null
+        echo "bench ($label) FAILED — artifact renamed to $out.FAILED"
+    fi
+}
+
 echo "== official ladder (auto sort) =="
-python bench.py --no-fallback --init-retry-s 60 \
-    | tail -1 | tee "bench_runs/r3_tpu_${TS}_auto.json"
+run_bench auto
 
 echo "== A/B: multisort8 =="
-python bench.py --no-fallback --init-retry-s 60 --sort-impl multisort8 \
-    | tail -1 | tee "bench_runs/r3_tpu_${TS}_ms8.json"
+run_bench ms8 --sort-impl multisort8
 
 echo "== TPU-gated suite =="
 SPARKUCX_TPU_TEST_TPU=1 python -m pytest tests/test_tpu_native.py -q

@@ -29,20 +29,21 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
-def _compile() -> bool:
+def _compile(dst: str = _SO) -> bool:
     # Build to a per-process temp name and rename into place: concurrent
     # executor processes on one host (the normal deployment,
     # ref: buildlib/test.sh:25-31 runs 2+ workers per node) must not race
     # g++ writes to the shared .so path.
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+    tmp = f"{dst}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", tmp, _SRC]
     try:
         os.makedirs(_BUILD_DIR, exist_ok=True)
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if proc.returncode != 0:
             log.warning("native build failed:\n%s", proc.stderr)
             return False
-        os.replace(tmp, _SO)
+        os.replace(tmp, dst)
     except (OSError, subprocess.TimeoutExpired) as e:
         log.warning("native build unavailable: %s", e)
         return False
@@ -74,6 +75,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sxt_mmap.restype = p
     lib.sxt_munmap.argtypes = [p, u64]
     lib.sxt_munmap.restype = ctypes.c_int
+    lib.sxt_pack_rows.argtypes = [p, p, p, u64, u64, u64, ctypes.c_int]
+    lib.sxt_pack_rows.restype = ctypes.c_int
     return lib
 
 
@@ -96,6 +99,30 @@ def load() -> Optional[ctypes.CDLL]:
             return None
         try:
             _lib = _bind(ctypes.CDLL(_SO))
+        except AttributeError:
+            # A cached .so from an older source LACKS a newly added
+            # symbol (mtime preserved by rsync/archive extraction defeats
+            # the staleness check). Rebuild — but dlopen dedupes by
+            # PATHNAME, so re-loading _SO would return the stale handle:
+            # bind the rebuilt library from a unique path, then rename it
+            # over the shared one for other processes.
+            log.warning("native .so missing a symbol; rebuilding")
+            reload_path = f"{_SO}.{os.getpid()}.reload"
+            try:
+                if _compile(reload_path):
+                    _lib = _bind(ctypes.CDLL(reload_path))
+                    os.replace(reload_path, _SO)
+                else:
+                    _load_failed = True
+            except (OSError, AttributeError) as e:
+                log.warning("native reload failed: %s", e)
+                _load_failed = True
+            finally:
+                if os.path.exists(reload_path):
+                    try:
+                        os.remove(reload_path)
+                    except OSError:
+                        pass
         except OSError as e:
             log.warning("native load failed: %s", e)
             _load_failed = True

@@ -19,6 +19,7 @@
 #include <cstring>
 #include <deque>
 #include <mutex>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -210,5 +211,56 @@ void* sxt_mmap(const char* path, uint64_t* len_out, int writable) {
 }
 
 int sxt_munmap(void* p, uint64_t len) { return munmap(p, len); }
+
+// ---- transport-row pack ---------------------------------------------------
+// Fuse int64 keys + raw value bytes into [n, width_words] int32 rows:
+// per row, 8 B key || val_bytes payload || zero pad to the row end. The
+// numpy formulation does two big STRIDED stores (keys plane, values
+// plane) at ~2.9 GB/s on this host vs a ~14.5 GB/s flat-copy ceiling;
+// row-wise sequential writes with a small thread fan-out close most of
+// that gap. Semantics are bit-identical to shuffle/reader.pack_rows
+// (pinned by test), including zeroed slack for recycled buffers.
+
+static void pack_range(const uint8_t* keys, const uint8_t* vals,
+                       uint8_t* out, uint64_t row_bytes, uint64_t val_bytes,
+                       uint64_t lo, uint64_t hi) {
+  const uint64_t pad = row_bytes - 8 - val_bytes;
+  for (uint64_t i = lo; i < hi; ++i) {
+    uint8_t* row = out + i * row_bytes;
+    std::memcpy(row, keys + i * 8, 8);
+    if (val_bytes) std::memcpy(row + 8, vals + i * val_bytes, val_bytes);
+    if (pad) std::memset(row + 8 + val_bytes, 0, pad);
+  }
+}
+
+extern "C" int sxt_pack_rows(const void* keys, const void* vals, void* out,
+                             uint64_t n, uint64_t width_words,
+                             uint64_t val_bytes, int nthreads) {
+  const uint64_t row_bytes = width_words * 4;
+  if (row_bytes < 8 + val_bytes) return -1;
+  if (val_bytes > 0 && vals == nullptr) return -2;
+  const uint8_t* k = static_cast<const uint8_t*>(keys);
+  const uint8_t* v = static_cast<const uint8_t*>(vals);
+  uint8_t* o = static_cast<uint8_t*>(out);
+  if (nthreads <= 1 || n * row_bytes < (8u << 20)) {
+    // gate on TOTAL bytes, matching the caller's one-thread-per-8MiB
+    // heuristic — a few wide rows deserve threads as much as many
+    // narrow ones
+    pack_range(k, v, o, row_bytes, val_bytes, 0, n);
+    return 0;
+  }
+  if (nthreads > 16) nthreads = 16;
+  std::vector<std::thread> ts;
+  ts.reserve(nthreads);
+  const uint64_t step = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    uint64_t lo = t * step;
+    uint64_t hi = lo + step < n ? lo + step : n;
+    if (lo >= hi) break;
+    ts.emplace_back(pack_range, k, v, o, row_bytes, val_bytes, lo, hi);
+  }
+  for (auto& th : ts) th.join();
+  return 0;
+}
 
 }  // extern "C"

@@ -874,16 +874,20 @@ class TpuShuffleManager:
         buf = self.node.pool.get(max(int(np.prod(shape)) * 4, 1))
         rows = buf.view().view(np.int32).reshape(shape)
 
-        def fill(p):
+        def fill(p, pack_threads=None):
             # slots write disjoint rows[p] planes, so this parallelizes
             # cleanly; numpy copies release the GIL (measured ~1.5 GB/s
-            # single-threaded — the host-side bottleneck at spill scale)
+            # single-threaded — the host-side bottleneck at spill scale).
+            # pack_threads=1 when THIS loop is already fanned out, so the
+            # native pack doesn't oversubscribe workers x its own threads
+            # on a memory-bound copy
             off = 0
             for keys, values in slot_outputs[p]:
                 n = keys.shape[0]
                 if n:
                     pack_rows(keys, values if has_vals else None, width,
-                              out=rows[p, off:off + n])
+                              out=rows[p, off:off + n],
+                              nthreads=pack_threads)
                 off += n
             # zero only the padding tail: pool blocks are recycled and
             # stale bytes must not leak rows, but re-zeroing the filled
@@ -898,7 +902,8 @@ class TpuShuffleManager:
             if workers > 1 and rows.nbytes >= (16 << 20):
                 from concurrent.futures import ThreadPoolExecutor
                 with ThreadPoolExecutor(max_workers=workers) as ex:
-                    list(ex.map(fill, range(len(slot_outputs))))
+                    list(ex.map(lambda p: fill(p, pack_threads=1),
+                                range(len(slot_outputs))))
             else:
                 for p in range(len(slot_outputs)):
                     fill(p)

@@ -31,6 +31,7 @@ provisioning worst-case HBM up front.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -193,13 +194,20 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
 
 
 def pack_rows(keys: np.ndarray, values: Optional[np.ndarray],
-              width: int, out: Optional[np.ndarray] = None) -> np.ndarray:
+              width: int, out: Optional[np.ndarray] = None,
+              nthreads: Optional[int] = None) -> np.ndarray:
     """Host-side fuse: int64 keys + arbitrary fixed-width values into an
     int32 row matrix via bit views (never value casts).
 
     ``out`` — optional [n, width] int32 destination (e.g. a pinned-arena
     view): rows are written IN PLACE, skipping the temp allocation and the
-    second copy — the pack stage is host-memcpy-bound at spill scale."""
+    second copy — the pack stage is host-memcpy-bound at spill scale.
+
+    Fast path: the native ``sxt_pack_rows`` (C++, row-wise sequential
+    writes, threaded) when the library is available and the inputs are
+    contiguous — the numpy formulation's two big strided plane-stores run
+    at ~2.9 GB/s on the build host vs a ~14.5 GB/s flat-copy ceiling.
+    Bit-identical output either way (pinned by test)."""
     n = keys.shape[0]
     if out is None:
         out = np.zeros((n, width), dtype=np.int32)
@@ -207,6 +215,8 @@ def pack_rows(keys: np.ndarray, values: Optional[np.ndarray],
     else:
         assert out.shape == (n, width) and out.dtype == np.int32
         fresh = False
+    if n and _native_pack(keys, values, width, out, nthreads):
+        return out
     out[:, :KEY_WORDS] = np.ascontiguousarray(
         keys.astype(np.int64, copy=False)).view(np.int32).reshape(n, 2)
     filled = KEY_WORDS
@@ -222,6 +232,44 @@ def pack_rows(keys: np.ndarray, values: Optional[np.ndarray],
     if not fresh and filled < width:
         out[:, filled:] = 0   # recycled destination: clear slack columns
     return out
+
+
+def _native_pack(keys: np.ndarray, values: Optional[np.ndarray],
+                 width: int, out: np.ndarray,
+                 nthreads: Optional[int] = None) -> bool:
+    """Try the C++ row-wise pack; False -> caller runs the numpy path.
+
+    The native kernel writes the WHOLE row (key, payload, zero pad), so
+    recycled-destination slack is covered; it requires contiguous int64
+    keys, contiguous values, and the value bytes to fit the row.
+    ``nthreads`` overrides the one-thread-per-8MiB heuristic — callers
+    already running inside their OWN thread fan-out (manager._pack_shards)
+    pass 1 so a big spill doesn't oversubscribe workers x native threads
+    on a memory-bound copy."""
+    if os.environ.get("SPARKUCX_TPU_NO_NATIVE") == "1":
+        return False
+    from sparkucx_tpu import native
+    lib = native.load()
+    if lib is None or not out.flags.c_contiguous:
+        return False
+    n = keys.shape[0]
+    if keys.dtype != np.int64 or not keys.flags.c_contiguous:
+        keys = np.ascontiguousarray(keys, dtype=np.int64)
+    if values is not None:
+        if not values.flags.c_contiguous:
+            values = np.ascontiguousarray(values)
+        val_bytes = values.nbytes // n
+        vptr = values.ctypes.data
+    else:
+        val_bytes = 0
+        vptr = None
+    if width * 4 < 8 + val_bytes:
+        return False
+    if nthreads is None:
+        nthreads = min(os.cpu_count() or 1, max(1, out.nbytes >> 23))
+    rc = lib.sxt_pack_rows(keys.ctypes.data, vptr, out.ctypes.data,
+                           n, width, val_bytes, nthreads)
+    return rc == 0
 
 
 def value_words(val_shape: Tuple[int, ...], val_dtype) -> int:
