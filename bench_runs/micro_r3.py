@@ -166,6 +166,25 @@ def main():
     except Exception as e:
         emit("sort_key_width", error=str(e)[:200])
 
+    # ---- 4b2. aligned sort vs plain sort: the pallas layout's price -----
+    try:
+        from sparkucx_tpu.ops.partition import (destination_sort,
+                                                destination_sort_aligned)
+        from sparkucx_tpu.ops.pallas.ragged_a2a import chunk_rows_for
+        part8b = (rng.integers(0, 8, size=rows)).astype(np.int32)
+        pdev = jax.device_put(jnp.asarray(part8b))
+        chunkr = chunk_rows_for(W)
+        plain = jax.jit(lambda r, p: destination_sort(
+            r, p, jnp.int32(rows), 8, method="multisort"))
+        aligned = jax.jit(lambda r, p: destination_sort_aligned(
+            r, p, jnp.int32(rows), 8, chunkr))
+        for name, fn in (("plain", plain), ("aligned", aligned)):
+            ms = timed(fn, payload, pdev)
+            emit("sort_aligned_vs_plain", variant=name, ms=round(ms, 3),
+                 GBps=round(nbytes / ms / 1e6, 2))
+    except Exception as e:
+        emit("sort_aligned_vs_plain", error=str(e)[:200])
+
     # ---- 4c. first-party Pallas remote-DMA a2a vs XLA ragged a2a, n=1 ---
     # The stock op costs ~23 ms for 80 MB on one device (bookkeeping, not
     # wire); the Pallas kernel is P one-sided DMAs — if the gap is the

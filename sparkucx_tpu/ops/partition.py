@@ -186,6 +186,73 @@ def destination_sort(
     return sorted_rows, counts.astype(jnp.int32)
 
 
+def destination_sort_aligned(
+    rows: jnp.ndarray,
+    dest: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    num_dests: int,
+    chunk: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Destination-grouped send buffer with every segment padded to a
+    CHUNK-row multiple — the layout the Pallas remote-DMA transport
+    requires (ops/pallas/ragged_a2a.py: Mosaic DMA slices must be
+    128-lane aligned, so segments start and end on chunk boundaries).
+
+    The alignment is created BY THE SORT, not by a scatter/gather
+    afterwards (round-2: a [2M]-row gather costs ~55 ms on v5e): the
+    buffer is extended with ``num_dests * chunk`` dummy rows whose
+    destinations are computed from a cheap key-only pre-sort's histogram
+    (1-operand sort ≈ 1.2 ms at 2M rows), such that destination j gets
+    exactly ``(-counts[j]) % chunk`` dummies; one multisort over
+    ``(dest, is_dummy)`` then lands every segment chunk-aligned with its
+    dummies at the segment tail.
+
+    Returns (sorted_rows [cap + num_dests*chunk, ...], counts [D] REAL
+    rows per destination, aligned_off [D] chunk-aligned segment starts).
+    Dummy rows are ZERO. Unused dummies (and padding) sort past the last
+    segment. Always the multisort formulation (the dummy-placement trick
+    rides the carried sort network; 2-D rows required) — there is no
+    argsort/counting variant of the aligned layout."""
+    cap = rows.shape[0]
+    if rows.ndim != 2:
+        raise ValueError("aligned sort needs 2-D rows (multisort form)")
+    pad_rows = num_dests * chunk
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < num_valid
+    key = jnp.where(valid, dest.astype(jnp.int32), jnp.int32(num_dests))
+
+    # real counts BEFORE the grouping sort, via a cheap key-only sort
+    (skey,) = jax.lax.sort((key,), num_keys=1, is_stable=False)
+    counts = counts_from_sorted(skey, num_dests)
+    pad_per = (-counts) % chunk                           # [D]
+
+    # dummy block j holds `chunk` candidate slots for destination j; the
+    # first pad_per[j] are armed, the rest go to the sentinel
+    slot = jnp.arange(pad_rows, dtype=jnp.int32)
+    blk = slot // chunk
+    within = slot % chunk
+    dummy_dest = jnp.where(within < pad_per[blk], blk,
+                           jnp.int32(num_dests))
+
+    # one grouping sort over (dest, is_dummy) — encoded as a single key
+    # dest*2 + flag so real rows precede their destination's dummies;
+    # sentinel rows (padding + unused dummies) sort last either way
+    k_real = key * 2
+    k_dummy = dummy_dest * 2 + 1
+    k2 = jnp.concatenate([k_real, k_dummy])
+    rows_ext = jnp.concatenate(
+        [rows, jnp.zeros((pad_rows,) + rows.shape[1:], rows.dtype)])
+    ops = (k2,) + tuple(rows_ext[:, i] for i in range(rows.shape[1]))
+    out = jax.lax.sort(ops, num_keys=1, is_stable=False)
+    sorted_rows = jnp.stack(out[1:], axis=1)
+
+    aligned_sizes = counts + pad_per                      # chunk multiples
+    aligned_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(aligned_sizes)[:-1].astype(jnp.int32)])
+    return sorted_rows, counts.astype(jnp.int32), aligned_off
+
+
 def partition_and_pack(
     keys: jnp.ndarray,
     rows: jnp.ndarray,

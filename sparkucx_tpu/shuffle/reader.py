@@ -256,6 +256,11 @@ def _native_pack(keys: np.ndarray, values: Optional[np.ndarray],
     if keys.dtype != np.int64 or not keys.flags.c_contiguous:
         keys = np.ascontiguousarray(keys, dtype=np.int64)
     if values is not None:
+        # malformed values (row count mismatch, indivisible byte total)
+        # must fall through to the numpy path's LOUD reshape error — a
+        # floor-divided val_bytes here would silently mis-pack
+        if values.shape[0] != n or values.nbytes % n:
+            return False
         if not values.flags.c_contiguous:
             values = np.ascontiguousarray(values)
         val_bytes = values.nbytes // n

@@ -129,8 +129,13 @@ class TestNativePack:
 
     def _both(self, keys, values, width, monkeypatch, recycled=False):
         import numpy as np
+        import pytest
 
+        from sparkucx_tpu import native
         from sparkucx_tpu.shuffle.reader import pack_rows
+        if native.load() is None:
+            # absence must be VISIBLE, not a numpy-vs-numpy green
+            pytest.skip("native library unavailable")
         n = keys.shape[0]
         fill = 7 if recycled else 0
         a = np.full((n, width), fill, np.int32)
