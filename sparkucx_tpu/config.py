@@ -272,7 +272,9 @@ class TpuShuffleConf:
         all_to_all (portable); gather = all_gather oracle (tests)."""
         v = self._get("a2a.impl", "auto")
         from sparkucx_tpu.shuffle.alltoall import IMPLS
-        allowed = ("auto",) + IMPLS
+        # 'pallas' = the first-party remote-DMA transport (plain flat
+        # reads; shuffle/reader._pallas_step_body)
+        allowed = ("auto",) + IMPLS + ("pallas",)
         if v not in allowed:
             raise ValueError(
                 f"spark.shuffle.tpu.a2a.impl={v!r}: want one of {allowed}")

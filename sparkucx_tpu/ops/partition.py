@@ -186,6 +186,23 @@ def destination_sort(
     return sorted_rows, counts.astype(jnp.int32)
 
 
+
+def _aligned_multisort(rows: jnp.ndarray, real_key2: jnp.ndarray,
+                       dummy_key2: jnp.ndarray) -> jnp.ndarray:
+    """Shared core of the aligned sorts: extend ``rows`` with zero dummy
+    rows, multisort by the doubled keys (real = 2k, dummy = 2k+1 — so
+    dummies land at their group's tail), return the sorted rows. The
+    subtle chunk-alignment machinery (armed dummy blocks, sentinel
+    placement) lives in the two thin wrappers that compute the keys."""
+    pad_rows = dummy_key2.shape[0]
+    rows_ext = jnp.concatenate(
+        [rows, jnp.zeros((pad_rows,) + rows.shape[1:], rows.dtype)])
+    k2 = jnp.concatenate([real_key2, dummy_key2])
+    ops = (k2,) + tuple(rows_ext[:, i] for i in range(rows.shape[1]))
+    out = jax.lax.sort(ops, num_keys=1, is_stable=False)
+    return jnp.stack(out[1:], axis=1)
+
+
 def destination_sort_aligned(
     rows: jnp.ndarray,
     dest: jnp.ndarray,
@@ -234,23 +251,77 @@ def destination_sort_aligned(
     dummy_dest = jnp.where(within < pad_per[blk], blk,
                            jnp.int32(num_dests))
 
-    # one grouping sort over (dest, is_dummy) — encoded as a single key
-    # dest*2 + flag so real rows precede their destination's dummies;
-    # sentinel rows (padding + unused dummies) sort last either way
-    k_real = key * 2
-    k_dummy = dummy_dest * 2 + 1
-    k2 = jnp.concatenate([k_real, k_dummy])
-    rows_ext = jnp.concatenate(
-        [rows, jnp.zeros((pad_rows,) + rows.shape[1:], rows.dtype)])
-    ops = (k2,) + tuple(rows_ext[:, i] for i in range(rows.shape[1]))
-    out = jax.lax.sort(ops, num_keys=1, is_stable=False)
-    sorted_rows = jnp.stack(out[1:], axis=1)
+    # one grouping sort over (dest, is_dummy): real rows precede their
+    # destination's dummies; sentinel rows (padding + unused dummies)
+    # sort last either way
+    sorted_rows = _aligned_multisort(rows, key * 2, dummy_dest * 2 + 1)
 
     aligned_sizes = counts + pad_per                      # chunk multiples
     aligned_off = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32),
          jnp.cumsum(aligned_sizes)[:-1].astype(jnp.int32)])
     return sorted_rows, counts.astype(jnp.int32), aligned_off
+
+
+def partition_major_sort_aligned(
+    rows: jnp.ndarray,
+    part: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    num_parts: int,
+    dev_bounds,
+    chunk: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Partition-major send buffer with DEVICE segments padded to CHUNK
+    multiples — :func:`destination_sort_aligned`'s layout, but keeping
+    rows sorted by global reduce-partition id INSIDE each device segment
+    (the no-receive-side-regrouping invariant of the partition-major
+    design, shuffle/reader.py step_body) so the Pallas transport's
+    aligned segments still deliver partition-sorted runs.
+
+    ``dev_bounds`` — static [P+1] numpy partition-range boundaries
+    (reader._device_bounds): device d owns partitions
+    [dev_bounds[d], dev_bounds[d+1]).
+
+    Sort key: real row -> part*2; dummy row of device d ->
+    (last partition of d)*2 + 1 — dummies land at their device segment's
+    tail, after every real row, before the next device's partitions.
+    Returns (sorted_rows [cap + P*chunk, ...], rcounts [R] REAL rows per
+    partition, dev_counts [P] REAL rows per device)."""
+    import numpy as np
+    cap = rows.shape[0]
+    if rows.ndim != 2:
+        raise ValueError("aligned sort needs 2-D rows (multisort form)")
+    bounds = np.asarray(dev_bounds)
+    P = bounds.shape[0] - 1
+    pad_rows = P * chunk
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < num_valid
+    pkey = jnp.where(valid, part.astype(jnp.int32), jnp.int32(num_parts))
+
+    # per-partition histogram from a key-only pre-sort (cheap: 1 operand)
+    (skey,) = jax.lax.sort((pkey,), num_keys=1, is_stable=False)
+    rcounts = counts_from_sorted(skey, num_parts)
+    cum = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                           jnp.cumsum(rcounts).astype(jnp.int32)])
+    dev_counts = jnp.take(cum, jnp.asarray(bounds[1:])) \
+        - jnp.take(cum, jnp.asarray(bounds[:-1]))        # [P]
+    pad_per = (-dev_counts) % chunk
+
+    # dummy block d: first pad_per[d] slots armed with key
+    # (last partition of d)*2 + 1; rest go to the global sentinel
+    last_part = np.maximum(bounds[1:] - 1, bounds[:-1])  # [P] static
+    slot = jnp.arange(pad_rows, dtype=jnp.int32)
+    blk = slot // chunk
+    within = slot % chunk
+    sentinel = jnp.int32(2 * num_parts + 1)
+    dummy_key = jnp.where(within < pad_per[blk],
+                          jnp.asarray(last_part, jnp.int32)[blk] * 2 + 1,
+                          sentinel)
+
+    sorted_rows = _aligned_multisort(
+        rows, jnp.where(valid, pkey * 2, sentinel), dummy_key)
+    return sorted_rows, rcounts.astype(jnp.int32), \
+        dev_counts.astype(jnp.int32)
 
 
 def partition_and_pack(

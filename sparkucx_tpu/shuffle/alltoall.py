@@ -334,6 +334,13 @@ def ragged_shuffle(data: jnp.ndarray, local_sizes: jnp.ndarray, axis_name: str,
     """
     if data.ndim < 1:
         raise ValueError("data must have a leading row axis")
+    if impl == "pallas":
+        raise ValueError(
+            "impl='pallas' (the first-party remote-DMA transport) is "
+            "integrated at the reader level — its chunk-aligned segment "
+            "layout cannot ride ragged_shuffle's dense contract; use "
+            "TpuShuffleManager.read with spark.shuffle.tpu.a2a.impl="
+            "pallas (plain flat reads)")
     if impl == "auto" and local_sizes.shape[0] == 1:
         # one shard on this axis — no peer exists; 'auto' means "best
         # transport", so take the local move (see _a2a_local). An EXPLICIT

@@ -706,7 +706,19 @@ class TpuShuffleManager:
                              rows=int(nvalid.sum()), width=width,
                              hierarchical=self.hierarchical):
                 vt = val_tail if has_vals else None
-                if self.hierarchical:
+                if self.hierarchical and plan.impl == "pallas":
+                    # the pallas transport is flat-only: run it over the
+                    # flattened alias mesh (correct on a single process;
+                    # the two-stage DCN-once optimization is native/dense
+                    # territory)
+                    log.info("a2a.impl=pallas on a multi-slice mesh: "
+                             "using the flat exchange over %d devices",
+                             self.exchange_mesh.devices.size)
+                    pending = submit_shuffle(
+                        self.exchange_mesh, self.axis, plan,
+                        shard_rows, nvalid, vt, val_dtype,
+                        on_done=on_done, admit=admit)
+                elif self.hierarchical:
                     from sparkucx_tpu.shuffle.hierarchical import \
                         submit_shuffle_hierarchical
                     pending = submit_shuffle_hierarchical(
@@ -933,6 +945,11 @@ class TpuShuffleManager:
         from sparkucx_tpu.shuffle.distributed import (
             allgather_blob, allgather_sizes, submit_shuffle_distributed)
 
+        if self.conf.a2a_impl == "pallas":
+            raise NotImplementedError(
+                "impl='pallas' is single-process for now (the interpret "
+                "validation path cannot span processes); use "
+                "native/dense for multi-process reads")
         tracer = self.node.tracer
         shard_ids = self.node.local_shard_ids
         L = len(shard_ids)

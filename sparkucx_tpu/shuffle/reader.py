@@ -62,6 +62,20 @@ def _device_bounds(num_partitions: int, num_devices: int) -> np.ndarray:
     return np.searchsorted(p2d, np.arange(num_devices + 1)).astype(np.int32)
 
 
+def _make_part_fn(plan: ShufflePlan, R: int):
+    """The pluggable partitioner (Spark's Partitioner SPI analog),
+    shared by the flat, hierarchical, and pallas step bodies."""
+    def part_fn(rows):
+        if plan.partitioner == "direct":
+            return jnp.clip(rows[:, 0], 0, R - 1)
+        if plan.partitioner == "range":
+            from sparkucx_tpu.ops.partition import range_partition_words
+            return range_partition_words(rows[:, 0], rows[:, 1],
+                                         plan.bounds)
+        return hash_partition(rows[:, 0], R)
+    return part_fn
+
+
 def step_body(plan: ShufflePlan, axis: str):
     """The per-shard exchange step (call under shard_map over ``axis``).
 
@@ -81,23 +95,20 @@ def step_body(plan: ShufflePlan, axis: str):
     same program)."""
     R = plan.num_partitions
     Pn = plan.num_shards
+    if plan.impl == "pallas":
+        # the first-party remote-DMA transport (plain reads only) — its
+        # chunk-aligned layout needs its own sort and run arithmetic
+        if plan.combine or plan.ordered:
+            raise ValueError(
+                "impl='pallas' supports plain reads; use native/dense "
+                "for combine/ordered")
+        return _pallas_step_body(plan, axis)
     # numpy, NOT jnp: a closed-over concrete jnp array becomes a lifted
     # executable parameter, which jax's C++ fastpath fails to re-supply on
     # repeat calls when the step is traced inside a caller's scan (bench);
     # a numpy constant inlines as a literal at trace time
     bounds = _device_bounds(R, Pn)
-
-    def part_fn(rows):
-        # pluggable partitioner (Spark's Partitioner SPI analog): hash for
-        # key-grouping shuffles; direct where the key IS the partition id;
-        # range = device-evaluated sorted split points over the full int64
-        # key (Spark's RangePartitioner; ops/partition.py)
-        if plan.partitioner == "direct":
-            return jnp.clip(rows[:, 0], 0, R - 1)
-        if plan.partitioner == "range":
-            from sparkucx_tpu.ops.partition import range_partition_words
-            return range_partition_words(rows[:, 0], rows[:, 1], plan.bounds)
-        return hash_partition(rows[:, 0], R)
+    part_fn = _make_part_fn(plan, R)
 
     def dev_counts(rcounts):
         # per-device segment sizes = partition-count sums over each
@@ -171,6 +182,51 @@ def step_body(plan: ShufflePlan, axis: str):
         # locate its runs; [P, R] int32 — negligible next to the payload
         seg = jax.lax.all_gather(rcounts, axis)
         return r.data, seg, r.total, r.overflow
+
+    return step
+
+
+def _pallas_step_body(plan: ShufflePlan, axis: str):
+    """Plain exchange over the first-party Pallas remote-DMA collective
+    (ops/pallas/ragged_a2a.py) — the UCX-analog data plane end to end.
+
+    Layout: partition-major with DEVICE segments padded to chunk
+    multiples (ops/partition.partition_major_sort_aligned), so delivered
+    segments are still internally partition-sorted and readers locate
+    runs by prefix sums — just with ALIGNED segment starts
+    (_RunIndex(align_chunk=...)). On the CPU backend the kernel runs in
+    interpret mode automatically (tests); on TPU it compiles."""
+    R = plan.num_partitions
+    Pn = plan.num_shards
+    bounds = _device_bounds(R, Pn)
+    part_fn = _make_part_fn(plan, R)
+
+    from sparkucx_tpu.ops.pallas.ragged_a2a import (
+        align_rows, chunk_rows_for, pallas_ragged_all_to_all)
+    from sparkucx_tpu.ops.partition import partition_major_sort_aligned
+
+    def step(payload, nvalid):
+        width = payload.shape[1]
+        chunk = chunk_rows_for(width)
+        part = part_fn(payload)
+        srows, rcounts, dev_counts = partition_major_sort_aligned(
+            payload, part, nvalid[0], R, bounds, chunk)
+        # the kernel requires chunk-multiple buffer capacities; the
+        # trailing pad rows are never read (aligned send regions are
+        # bounded by align(cap_in) + P*chunk)
+        pad = (-srows.shape[0]) % chunk
+        if pad:
+            srows = jnp.concatenate(
+                [srows, jnp.zeros((pad, width), srows.dtype)])
+        cap_eff = int(align_rows(plan.cap_out, chunk)) + Pn * chunk
+        interpret = jax.default_backend() == "cpu"
+        out, recv_real, _recv_off, total_al = pallas_ragged_all_to_all(
+            srows, dev_counts, axis, out_capacity=cap_eff,
+            num_devices=Pn, interpret=interpret)
+        ovf = (total_al < 0)
+        seg = jax.lax.all_gather(rcounts, axis)          # [P, R] real
+        total = recv_real.sum().astype(jnp.int32).reshape(1)
+        return out, seg, total, ovf
 
     return step
 
@@ -315,12 +371,18 @@ class _RunIndex:
         run_start[s] = seg_start[s] + within[s, r - r_lo]
     — pure prefix sums, no receive-side sort ever happened."""
 
-    def __init__(self, M: np.ndarray, r_lo: int, r_hi: int):
+    def __init__(self, M: np.ndarray, r_lo: int, r_hi: int,
+                 align_chunk: int = 0):
         C = np.asarray(M[:, r_lo:r_hi], dtype=np.int64)
         self.lens = C                                     # [NS, k]
         self.within = np.zeros_like(C)
         np.cumsum(C[:, :-1], axis=1, out=self.within[:, 1:])
         seg_sizes = C.sum(axis=1)
+        if align_chunk:
+            # pallas transport: segments land at CHUNK-aligned starts
+            # (dummy-row tails travel with them); runs inside a segment
+            # are still dense prefix sums
+            seg_sizes = -(-seg_sizes // align_chunk) * align_chunk
         self.seg_start = np.zeros_like(seg_sizes)
         np.cumsum(seg_sizes[:-1], out=self.seg_start[1:])
         self.r_lo = r_lo
@@ -338,16 +400,20 @@ class ShuffleReaderResult:
 
     def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
                  rows: np.ndarray, seg_counts: np.ndarray,
-                 val_shape: Optional[Tuple[int, ...]], val_dtype):
+                 val_shape: Optional[Tuple[int, ...]], val_dtype,
+                 align_chunk: int = 0):
         # rows: [P, cap_out, width] int32
         # seg_counts: [NS, R] (shared by all shards — flat exchange) or
         #             [P, NS, R] (per shard — hierarchical exchange)
+        # align_chunk: >0 for the pallas transport's chunk-aligned
+        #             segment layout (see _RunIndex)
         self.num_partitions = num_partitions
         self._part_to_shard = part_to_shard
         self._rows = rows
         self._seg = seg_counts
         self._val_shape = val_shape
         self._val_dtype = val_dtype
+        self._align_chunk = align_chunk
         self._runidx: dict = {}
         # receive capacity the exchange actually ran with (after any
         # overflow retries) — the manager feeds it back as the next plan's
@@ -362,7 +428,8 @@ class ShuffleReaderResult:
         if ri is None:
             r_lo = int(np.searchsorted(self._part_to_shard, shard, "left"))
             r_hi = int(np.searchsorted(self._part_to_shard, shard, "right"))
-            ri = _RunIndex(self._seg_matrix(shard), r_lo, r_hi)
+            ri = _RunIndex(self._seg_matrix(shard), r_lo, r_hi,
+                           getattr(self, "_align_chunk", 0))
             self._runidx[shard] = ri
         return ri
 
@@ -405,8 +472,10 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
 
     def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
                  rows_dev, seg_dev, num_shards: int, cap_out: int,
-                 val_shape, val_dtype, per_shard_segs: bool = False):
+                 val_shape, val_dtype, per_shard_segs: bool = False,
+                 align_chunk: int = 0):
         self.num_partitions = num_partitions
+        self._align_chunk = align_chunk
         self._part_to_shard = part_to_shard
         self._rows_dev = rows_dev          # jax.Array [P*cap_out, width]
         # seg_dev: replicated [NS, R] (flat) or P(axis)-sharded [P*NS, R]
@@ -623,10 +692,23 @@ class PendingShuffle(PendingExchangeBase):
             self._dispatch()
         Pn = self._plan.num_shards
         R = self._plan.num_partitions
-        return LazyShuffleReaderResult(
+        # cap per shard derives from the OUTPUT (the pallas transport
+        # rounds cap_out up to its chunk-aligned effective capacity)
+        cap_shard = rows_out.shape[0] // Pn
+        align_chunk = 0
+        if self._plan.impl == "pallas":
+            from sparkucx_tpu.ops.pallas.ragged_a2a import chunk_rows_for
+            align_chunk = chunk_rows_for(self._rows_host.shape[2])
+        res = LazyShuffleReaderResult(
             R, np.asarray(_blocked_map(R, Pn)), rows_out, seg,
-            Pn, self._plan.cap_out, self._val_shape, self._val_dtype,
-            per_shard_segs=self._per_shard_segs)
+            Pn, cap_shard, self._val_shape, self._val_dtype,
+            per_shard_segs=self._per_shard_segs, align_chunk=align_chunk)
+        # report the PLAN capacity, not the chunk-inflated buffer size:
+        # cap_out_used feeds the manager's learned-cap hint, and the
+        # inflated value would ratchet every same-shape pallas read into
+        # a bigger plan (and a recompile) forever
+        res.cap_out_used = self._plan.cap_out
+        return res
 
 
 def submit_shuffle(

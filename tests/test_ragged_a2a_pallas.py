@@ -201,3 +201,80 @@ def test_send_overflow_skips_exchange_meshwide(mesh8):
         jnp.asarray(data.reshape(n * cap_in, width)), jnp.asarray(sizes))
     assert (np.asarray(total) == -1).all()
     assert (np.asarray(recv) == 0).all()
+
+
+# -- end-to-end: the pallas transport through the MANAGER -----------------
+@pytest.fixture()
+def pallas_manager(mesh8):
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "pallas"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    m = TpuShuffleManager(node, conf)
+    yield m
+    m.stop()
+    node.close()
+
+
+def test_manager_read_over_pallas_transport(pallas_manager, rng):
+    """Full lifecycle over the first-party remote-DMA collective:
+    register -> write -> read(handle) with a2a.impl=pallas — partitions
+    intact vs the host oracle (interpret mode on the CPU mesh)."""
+    from sparkucx_tpu.shuffle.writer import _hash32_np
+
+    m = pallas_manager
+    R = 16
+    h = m.register_shuffle(700, 4, R)
+    allk, allv = [], []
+    for mid in range(4):
+        k = rng.integers(0, 1 << 40, size=300).astype(np.int64)
+        v = rng.integers(0, 1 << 30, size=(300, 2)).astype(np.int32)
+        w = m.get_writer(h, mid)
+        w.write(k, v)
+        w.commit(R)
+        allk.append(k)
+        allv.append(v)
+    allk = np.concatenate(allk)
+    allv = np.concatenate(allv)
+    parts = _hash32_np(allk) % R
+    res = m.read(h)
+    for r in range(R):
+        gk, gv = res.partition(r)
+        want_k = allk[parts == r]
+        got = sorted(zip(gk.tolist(), map(tuple, gv.tolist())))
+        want = sorted(zip(want_k.tolist(),
+                          map(tuple, allv[parts == r].tolist())))
+        assert got == want, f"partition {r}"
+    m.unregister_shuffle(700)
+
+
+def test_manager_pallas_overflow_retry(pallas_manager, rng):
+    """A skewed shuffle that overflows the first plan must retry with a
+    grown capacity through the pallas transport's mesh-wide skip."""
+    m = pallas_manager
+    R = 8
+    h = m.register_shuffle(701, 1, R)
+    # all keys hash to few partitions -> one device overflows the
+    # balanced-share cap and the kernel skips -> reader grows and retries
+    k = np.full(4000, 12345, np.int64)
+    w = m.get_writer(h, 0)
+    w.write(k)
+    w.commit(R)
+    res = m.read(h)
+    total = sum(res.partition(r)[0].shape[0] for r in range(R))
+    assert total == 4000
+    m.unregister_shuffle(701)
+
+
+def test_manager_pallas_rejects_combine(pallas_manager, rng):
+    m = pallas_manager
+    h = m.register_shuffle(702, 1, 4)
+    w = m.get_writer(h, 0)
+    w.write(rng.integers(0, 50, size=100).astype(np.int64),
+            np.ones((100, 1), np.int32))
+    w.commit(4)
+    with pytest.raises(ValueError, match="plain reads"):
+        m.read(h, combine="sum")
+    m.unregister_shuffle(702)
