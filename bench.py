@@ -647,13 +647,15 @@ def stage_native_aot(mon):
     mon.end("native_aot", status=status, **rep)
 
 
-def stage_exchange(mon, jax, name, seconds, native_ok, record=True, **kw):
+def stage_exchange(mon, jax, name, seconds, native_ok, record=True,
+                   force_impl=None, **kw):
     mon.begin(name, seconds)
     # measure what ships: 'auto' resolves to the collective on a multi-chip
     # axis and to the local-transport move on a 1-chip axis (the UCX
     # shm-for-local-peers analog); the native-lowering proof is the
-    # dedicated 'native' stage above, which passes impl='native' explicitly
-    impl = "auto" if native_ok else "dense"
+    # dedicated 'native' stage above, which passes impl='native' explicitly.
+    # --a2a-impl overrides for A/B (incl. the pallas transport).
+    impl = force_impl or ("auto" if native_ok else "dense")
     try:
         info = exchange_run(jax, impl=impl, **kw)
     except Exception as e:
@@ -676,6 +678,12 @@ def main() -> None:
     ap.add_argument("--rows-log2", type=int, default=None)
     ap.add_argument("--val-words", type=int, default=8)
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--a2a-impl", default=None,
+                    choices=("native", "dense", "gather", "pallas"),
+                    help="force the exchange implementation for the "
+                         "exchange stages (default: auto -> the "
+                         "backend's best; pallas = the first-party "
+                         "remote-DMA transport)")
     ap.add_argument("--sort-impl", default="auto",
                     help="destination_sort method: auto|argsort|multisort|"
                          "multisort8|counting (A/B the hot path)")
@@ -752,8 +760,22 @@ def main() -> None:
     except Exception as e:
         mon.end("native_aot", status="failed", error=str(e)[:200])
 
+    if args.a2a_impl == "pallas" and args.read_mode != "plain":
+        # fail the ARGUMENTS, not the primary stage mid-run: the pallas
+        # transport is plain-reads-only (reader.step_body rejects it)
+        print("--a2a-impl pallas supports --read-mode plain only",
+              file=sys.stderr, flush=True)
+        sys.exit(2)
+    if args.a2a_impl == "pallas" and jax.default_backend() == "cpu":
+        # the pallas transport only INTERPRETS on CPU — python-per-DMA
+        # simulation inside the scan harness would run for hours and
+        # measure nothing; the flag exists for the chip
+        print("# --a2a-impl pallas requires a TPU backend (CPU would "
+              "interpret); dropping to auto", file=sys.stderr, flush=True)
+        args.a2a_impl = None
     common = dict(val_words=args.val_words, sort_impl=args.sort_impl,
-                  partitions_per_dev=8, read_mode=args.read_mode)
+                  partitions_per_dev=8, read_mode=args.read_mode,
+                  force_impl=args.a2a_impl)
     # k1=32/k2=288: at ~0.2 ms/step on the chip the differenced window is
     # ~50 ms — well above tunneled-dispatch jitter, so the small-shape
     # number stops collapsing to degenerate_timing (round-2 artifact
@@ -764,16 +786,17 @@ def main() -> None:
         stage_exchange(mon, jax, "exchange_full", 1200, native_ok,
                        rows_log2=args.rows_log2 or 21, k1=2, k2=12,
                        reps=args.reps, **common)
-        if args.read_mode != "combine":
+        if args.read_mode != "combine" and args.a2a_impl != "pallas":
             # secondary metric (detail only): device combine-by-key rate
             # on a heavy-duplication aggregation shape (the WordCount
             # headline); skipped when the main stages already ran combined
+            # (and under --a2a-impl pallas, which is plain-reads-only)
             stage_exchange(mon, jax, "exchange_combine", 900, native_ok,
                            rows_log2=args.rows_log2 or 21, k1=1, k2=5,
                            reps=1, record=False,
                            **{**common, "read_mode": "combine",
                               "key_space": 100_000})
-        if args.read_mode == "plain":
+        if args.read_mode == "plain" and args.a2a_impl != "pallas":
             # secondary metric (detail only): ordered (key-sorted
             # partitions) rate — the TeraSort mode the BASELINE.md
             # methodology is named after

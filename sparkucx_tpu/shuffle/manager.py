@@ -477,7 +477,11 @@ class TpuShuffleManager:
         from jax.sharding import NamedSharding, PartitionSpec as PSpec
         from sparkucx_tpu.io.dlpack import stage_to_device
 
-        if self.hierarchical:
+        if self.node.is_distributed and plan.impl == "pallas":
+            raise NotImplementedError(
+                "impl='pallas' is single-process for now — warmup "
+                "follows read()'s restriction")
+        if self.hierarchical and plan.impl != "pallas":
             from sparkucx_tpu.shuffle.hierarchical import _build_hier_step
             step = _build_hier_step(self.node.mesh,
                                     self.conf.mesh_dcn_axis, self.axis,
@@ -486,6 +490,8 @@ class TpuShuffleManager:
                 self.node.mesh,
                 PSpec((self.conf.mesh_dcn_axis, self.axis)))
         else:
+            # pallas on a multi-slice mesh warms the FLAT step — the one
+            # read() actually dispatches via its flat fallback
             from sparkucx_tpu.shuffle.reader import _build_step
             step = _build_step(self.exchange_mesh, self.axis, plan, width)
             sharding = NamedSharding(self.exchange_mesh, PSpec(self.axis))

@@ -429,7 +429,7 @@ class ShuffleReaderResult:
             r_lo = int(np.searchsorted(self._part_to_shard, shard, "left"))
             r_hi = int(np.searchsorted(self._part_to_shard, shard, "right"))
             ri = _RunIndex(self._seg_matrix(shard), r_lo, r_hi,
-                           getattr(self, "_align_chunk", 0))
+                           self._align_chunk)
             self._runidx[shard] = ri
         return ri
 

@@ -278,3 +278,35 @@ def test_manager_pallas_rejects_combine(pallas_manager, rng):
     with pytest.raises(ValueError, match="plain reads"):
         m.read(h, combine="sum")
     m.unregister_shuffle(702)
+
+
+def test_manager_pallas_multislice_flat_fallback(mesh8, rng):
+    """Multi-slice mesh + a2a.impl=pallas: warmup AND read both take the
+    flat alias-mesh path (the transport is flat-only) and agree on the
+    compiled program."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "pallas",
+                           "spark.shuffle.tpu.mesh.numSlices": "2"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    try:
+        m = TpuShuffleManager(node, conf)
+        assert m.hierarchical
+        h = m.register_shuffle(710, 2, 8)
+        m.warmup(h, rows_per_map=200)          # must not crash
+        allk = []
+        for mid in range(2):
+            k = rng.integers(0, 1 << 40, size=200).astype(np.int64)
+            allk.append(k)
+            w = m.get_writer(h, mid)
+            w.write(k)
+            w.commit(8)
+        res = m.read(h)
+        got = np.sort(np.concatenate(
+            [res.partition(r)[0] for r in range(8)]))
+        np.testing.assert_array_equal(got, np.sort(np.concatenate(allk)))
+        m.stop()
+    finally:
+        node.close()
