@@ -310,3 +310,47 @@ def test_manager_pallas_multislice_flat_fallback(mesh8, rng):
         m.stop()
     finally:
         node.close()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_pallas_transport_fuzz(pallas_manager, seed):
+    """Small randomized jobs over the pallas transport: shapes, schemas,
+    empty writers, R<P and R>P partition counts — vs the host oracle."""
+    rng = np.random.default_rng(3000 + seed)
+    M = int(rng.integers(1, 4))
+    R = int(rng.integers(1, 20))            # covers R < 8 devices too
+    has_vals = bool(rng.integers(0, 2))
+    vw = int(rng.integers(1, 4))
+    m = pallas_manager
+    sid = 720 + seed
+    h = m.register_shuffle(sid, M, R)
+    oracle = {}
+    total = 0
+    for mid in range(M):
+        w = m.get_writer(h, mid)
+        n = int(rng.integers(0, 300))
+        k = rng.integers(-(1 << 60), 1 << 60, size=n, dtype=np.int64)
+        v = rng.integers(0, 1 << 30, size=(n, vw)).astype(np.int32) \
+            if has_vals else None
+        if n:
+            w.write(k, v)
+        for i, kk in enumerate(k.tolist()):
+            rec = tuple(v[i].tolist()) if v is not None else ()
+            oracle.setdefault(kk, []).append(rec)
+        total += n
+        w.commit(R)
+    res = m.read(h)
+    got = {}
+    nrows = 0
+    for r in range(R):
+        ks, vs = res.partition(r)
+        for i, kk in enumerate(ks.tolist()):
+            rec = tuple(np.asarray(vs[i]).ravel().tolist()) \
+                if vs is not None else ()
+            got.setdefault(kk, []).append(rec)
+        nrows += ks.shape[0]
+    assert nrows == total, f"seed {seed}: {nrows} != {total}"
+    assert set(got) == set(oracle), f"seed {seed}"
+    for kk in oracle:
+        assert sorted(got[kk]) == sorted(oracle[kk]), f"seed {seed} {kk}"
+    m.unregister_shuffle(sid)
