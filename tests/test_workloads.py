@@ -95,3 +95,12 @@ def test_pagerank_device_combine(manager):
                        num_partitions=8, num_mappers=4, iterations=8)
     assert out["vertices"] == 48 and out["iterations"] == 8
     assert out["max_err"] < 1e-3
+
+
+def test_join_varchar(manager):
+    """String-keyed repartition join (the TPC-DS q64/q95 varchar shape):
+    exact key bytes ride the shuffle; output matches the host oracle."""
+    from sparkucx_tpu.workloads.join import run_join_varchar
+    out = run_join_varchar(manager)
+    assert out["output_rows"] > 0
+    assert out["distinct_keys"] > 100
