@@ -210,6 +210,19 @@ def test_local_fastpath_single_shard(rng):
     assert bool(np.asarray(ovf)[0])
 
 
+def test_native_multipeer_aot_proof_v5e16(mesh8):
+    """Same proof at the BASELINE north-star topology itself (v5e-16):
+    the production step lowers at n=16 with all 16 replicas."""
+    import pytest as _pytest
+
+    from sparkucx_tpu.shuffle.aot import aot_compile_native_step
+    rep = aot_compile_native_step(16, topology_name="v5e:4x4")
+    if "topology" not in rep:
+        _pytest.skip(f"no TPU topology support here: {rep.get('error')}")
+    assert rep["ok"], rep
+    assert rep["replica_groups_n"] == 16
+
+
 def test_native_multipeer_aot_proof(mesh8):
     """Multi-peer lowering proof without hardware: AOT-compile the n=8
     native exchange step against an unattached v5e topology via the
