@@ -39,6 +39,9 @@ run_bench auto
 echo "== A/B: multisort8 =="
 run_bench ms8 --sort-impl multisort8
 
+echo "== A/B: first-party pallas transport =="
+run_bench pallas --a2a-impl pallas
+
 echo "== TPU-gated suite =="
 SPARKUCX_TPU_TEST_TPU=1 python -m pytest tests/test_tpu_native.py -q
 
