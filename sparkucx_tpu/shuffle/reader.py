@@ -442,18 +442,29 @@ class ShuffleReaderResult:
         return True
 
     def partition(self, r: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
-        """(keys, values) of reduce partition r, densely packed."""
-        shard = int(self._part_to_shard[r])
-        rows = self._shard_rows(shard)
-        runs = self._runs(shard).runs(r)
-        if not runs:
-            block = rows[:0]
-        elif len(runs) == 1:
-            s, n = runs[0]
-            block = rows[s:s + n]
-        else:
-            block = np.concatenate([rows[s:s + n] for s, n in runs])
-        return unpack_rows(block, self._val_shape, self._val_dtype)
+        """(keys, values) of reduce partition r, densely packed.
+
+        Traced as a ``shuffle.fetch`` span (bytes + partition id): the
+        per-block-fetch latency record the reference logs on every
+        completion (ref: reducer/OnBlocksFetchCallback.java:55-56) — the
+        tracer's summary() aggregates it to the p50/p99 BASELINE.md asks
+        for. For the lazy subclass the first fetch of a shard carries its
+        D2H wait, later fetches are host slicing — exactly the
+        block-arrival distribution the reference measures."""
+        from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+        with GLOBAL_TRACER.span("shuffle.fetch", partition=r) as sp:
+            shard = int(self._part_to_shard[r])
+            rows = self._shard_rows(shard)
+            runs = self._runs(shard).runs(r)
+            if not runs:
+                block = rows[:0]
+            elif len(runs) == 1:
+                s, n = runs[0]
+                block = rows[s:s + n]
+            else:
+                block = np.concatenate([rows[s:s + n] for s, n in runs])
+            sp.set(bytes=int(block.nbytes))
+            return unpack_rows(block, self._val_shape, self._val_dtype)
 
     def partitions(self):
         for r in range(self.num_partitions):
