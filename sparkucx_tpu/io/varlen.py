@@ -23,8 +23,13 @@ WordCount, TPC-DS varchar joins), :func:`hash_bytes64` derives a
 deterministic 64-bit key from the bytes (FNV-1a); the bytes themselves
 ride as (part of) the value payload so the reduce side can recover the
 exact key. A 64-bit collision merges two distinct keys — probability
-~n^2/2^65, negligible at any realistic cardinality, and detectable
-because the carried bytes disagree.
+~n^2/2^65, negligible at any realistic cardinality. On a plain
+(non-combined) read the collision is detectable after the fact: the
+colliding rows carry their differing original bytes. Under device
+combine the merge is SILENT — the combiner keeps one representative's
+carried bytes and sums the counts; no code path compares the bytes.
+Callers for whom a ~2^-65-per-pair silent merge is unacceptable should
+read uncombined and aggregate host-side by exact bytes.
 """
 
 from __future__ import annotations

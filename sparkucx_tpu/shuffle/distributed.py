@@ -255,6 +255,14 @@ class PendingDistributedShuffle(PendingExchangeBase):
                                      cur.cap_out),
                     seg_host, self._val_shape, self._val_dtype)
                 res.cap_out_used = cur.cap_out
+                if not (cur.combine or cur.ordered
+                        or self._hier_mesh is not None):
+                    # flat plain: the replicated [P, R] seg carries true
+                    # delivered counts, identical on every process — the
+                    # manager's hint decay stays in SPMD lockstep
+                    from sparkucx_tpu.shuffle.reader import max_recv_rows
+                    res.recv_rows_needed = max_recv_rows(
+                        seg_host, part_to_shard, Pn)
                 return res
             if self._attempt >= self._plan.max_retries:
                 raise RuntimeError(

@@ -61,6 +61,13 @@ class ShufflePlan:
     # RangePartitioner analog, device-evaluated): static, so they are
     # part of the compiled program and the jit-cache key.
     bounds: Optional[Tuple[int, ...]] = None
+    # impl='pallas' only: None resolves interpret mode from the default
+    # backend AT TRACE TIME (CPU tests interpret, TPU compiles); pin it
+    # explicitly when tracing for a backend other than the host's — the
+    # same backend-keyed-trace hazard aot.py pins sort_impl against (an
+    # AOT compile from a CPU host would otherwise bake the interpreter
+    # into the TPU program).
+    pallas_interpret: Optional[bool] = None
 
     def grown(self) -> "ShufflePlan":
         """Next plan after an overflow: double the receive capacity."""

@@ -286,7 +286,11 @@ def test_capacity_learning_skips_retry(manager):
     finally:
         reader_mod.ShufflePlan.grown = orig
     assert grown == [], "second run should start at the learned capacity"
-    assert cap2 == cap1
+    # the learned hint tracks the observed requirement (needed x 1.15
+    # headroom), not the power-of-two capacity the retries settled at —
+    # big enough to skip every retry, small enough not to carry the
+    # doubling ladder's slack forever
+    assert M * N <= cap2 <= int(M * N * 1.3)
 
 
 def test_read_fails_loudly_on_lost_map_output(manager):
@@ -525,3 +529,58 @@ def test_unregister_deferred_while_read_in_flight(manager, rng):
         "buffers must survive until the in-flight read finishes"
     manager._read_finished(g)
     assert manager.node.pool.stats()["in_use"] < in_use
+
+
+def test_cap_hint_decays_after_skew_spike(manager, rng):
+    """One pathological skewed run must not inflate every later same-shape
+    plan forever (round-3 verdict weak #5): the learned skew-factor hint
+    decays toward the observed per-run requirement within a few runs."""
+    R, M, N = 16, 8, 400
+
+    def run(keys_fn, sid):
+        h = manager.register_shuffle(sid, M, R)
+        for m in range(M):
+            w = manager.get_writer(h, m)
+            w.write(keys_fn(m))
+            w.commit(R)
+        res = manager.read(h)
+        for r in range(R):
+            res.partition(r)
+        manager.unregister_shuffle(sid)
+        return h
+
+    # spike: every key identical -> one shard receives everything
+    h = run(lambda m: np.zeros(N, dtype=np.int64), 900)
+    key = manager._cap_key(h)
+    spike = manager._cap_hints[key]
+    assert spike > 2.0, f"skew spike not recorded: {spike}"
+
+    prev = spike
+    for i in range(5):
+        run(lambda m: rng.integers(0, 1 << 31, size=N).astype(np.int64),
+            901 + i)
+        cur = manager._cap_hints[key]
+        assert cur <= prev + 1e-9, "hint ratcheted up on a balanced run"
+        prev = cur
+    assert prev < spike / 2, (
+        f"hint failed to decay: spike {spike:.2f} -> {prev:.2f}")
+
+
+def test_cap_hint_keeps_headroom_for_sustained_skew(manager):
+    """Decay must not strip a genuinely skewed workload's headroom: the
+    same skewed run repeated keeps a hint near its requirement."""
+    R, M, N = 16, 8, 400
+    h = None
+    for i in range(4):
+        h = manager.register_shuffle(930 + i, M, R)
+        for m in range(M):
+            w = manager.get_writer(h, m)
+            w.write(np.zeros(N, dtype=np.int64))
+            w.commit(R)
+        res = manager.read(h)
+        res.partition(0)
+        manager.unregister_shuffle(930 + i)
+    factor = manager._cap_hints[manager._cap_key(h)]
+    # all rows land on one shard: requirement = M*N over balanced share
+    # N, x1.15 headroom
+    assert factor > 0.9 * (M * 1.15)
