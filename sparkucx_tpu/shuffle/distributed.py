@@ -41,7 +41,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkucx_tpu.shuffle.plan import ShufflePlan
 from sparkucx_tpu.shuffle.reader import (
-    PendingExchangeBase, ShuffleReaderResult, _blocked_map, _build_step)
+    PendingExchangeBase, ShuffleReaderResult, _blocked_map, _build_step,
+    max_recv_rows)
 from sparkucx_tpu.utils.logging import get_logger
 
 log = get_logger("shuffle.distributed")
@@ -260,7 +261,6 @@ class PendingDistributedShuffle(PendingExchangeBase):
                     # flat plain: the replicated [P, R] seg carries true
                     # delivered counts, identical on every process — the
                     # manager's hint decay stays in SPMD lockstep
-                    from sparkucx_tpu.shuffle.reader import max_recv_rows
                     res.recv_rows_needed = max_recv_rows(
                         seg_host, part_to_shard, Pn)
                 return res
