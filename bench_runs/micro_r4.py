@@ -1,0 +1,237 @@
+"""Round-4 on-chip micro experiments — SCAN-DIFFERENCED.
+
+micro_r3.py timed each op by pulling its FULL output device-to-host per
+rep; through the axon tunnel that D2H leg (~80 MB at tens of MB/s) costs
+seconds and swamps every op under test — the r4 ladder run proved it:
+`local_roll_copy` (a plain HBM copy) "measured" 2.3 s. This version uses
+bench.py's methodology: iterate the op INSIDE one compiled program
+(lax.scan with an optimization_barrier-enforced data dependency), force
+completion with a SCALAR D2H, and difference two scan lengths so the
+fixed dispatch/transfer overhead cancels:
+
+    per_op = (t(k2) - t(k1)) / (k2 - k1)
+
+Every experiment prints one JSON line and is independently try/excepted;
+an in-process watchdog hard-exits (never wrap this in an external
+kill-timeout: that wedges the tunnel — bench_runs/NOTES_r2.md).
+
+Usage:  python bench_runs/micro_r4.py [--watchdog 2400] [--rows-log2 21]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+K1, K2, REPS = 2, 12, 3
+
+
+def emit(name, **kw):
+    print(json.dumps({"exp": name, **kw}), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--watchdog", type=int, default=2400)
+    ap.add_argument("--rows-log2", type=int, default=21)
+    ap.add_argument("--platform", default="auto", choices=("auto", "cpu"),
+                    help="cpu flips the backend via jax.config (the axon "
+                         "sitecustomize overrides JAX_PLATFORMS, so the "
+                         "env alone cannot keep this off the chip)")
+    args = ap.parse_args()
+    threading.Timer(args.watchdog, lambda: os._exit(3)).start()
+
+    import jax
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    emit("init", backend=jax.default_backend(), devices=len(jax.devices()))
+
+    rows = 1 << args.rows_log2
+    W = 10
+    rng = np.random.default_rng(0)
+    payload_np = rng.integers(0, 1 << 31, size=(rows, W),
+                              dtype=np.int64).astype(np.int32)
+    nbytes = rows * W * 4
+
+    def diff_time(step, x0, extra=(), k1=K1, k2=K2, reps=REPS):
+        """step(carry, *extra) -> carry' (same shape/dtype). Returns
+        (ms_per_step, degenerate)."""
+        def make(k):
+            def many(x, *ex):
+                def body(c, _):
+                    c = lax.optimization_barrier(c)
+                    return step(c, *ex), ()
+                c, _ = lax.scan(body, x, None, length=k)
+                return c.reshape(-1)[0:1]          # scalar probe D2H
+            return jax.jit(many)
+
+        def timed(k):
+            fn = make(k)
+            np.asarray(fn(x0, *extra))             # compile + warm
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                out = fn(x0, *extra)
+                _ = np.asarray(out)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t1, t2 = timed(k1), timed(k2)
+        if t2 <= t1:
+            return t2 / k2 * 1e3, True
+        return (t2 - t1) / (k2 - k1) * 1e3, False
+
+    def report(name, ms, degenerate, **kw):
+        emit(name, ms=round(ms, 3), GBps=round(nbytes / ms / 1e6, 2),
+             degenerate=degenerate, **kw)
+
+    payload = jax.device_put(jnp.asarray(payload_np))
+
+    # ---- 0. the floor: one flat HBM copy --------------------------------
+    try:
+        ms, deg = diff_time(lambda x: jnp.roll(x, 1, axis=0), payload)
+        report("local_roll_copy", ms, deg)
+    except Exception as e:
+        emit("local_roll_copy", error=str(e)[:200])
+
+    # ---- 1. n=1 ragged_all_to_all, segment-count sweep ------------------
+    try:
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh1 = Mesh(np.array(jax.devices()[:1]), ("x",))
+        for nseg in (1, 8, 64, 512):
+            seg = rows // nseg
+
+            def inner(d, nseg=nseg, seg=seg):
+                out = jnp.zeros_like(d)
+                offs = jnp.arange(nseg, dtype=jnp.int32) * seg
+                sizes = jnp.full((nseg,), seg, jnp.int32)
+                return jax.lax.ragged_all_to_all(
+                    d, out, offs, sizes, offs, sizes, axis_name="x")
+
+            def step(x, inner=inner):
+                sm = jax.shard_map(inner, mesh=mesh1, in_specs=(P("x"),),
+                                   out_specs=P("x"))
+                return sm(x)
+
+            ms, deg = diff_time(step, payload)
+            report("a2a_n1_segments", ms, deg, nseg=nseg)
+    except Exception as e:
+        emit("a2a_n1_segments", error=str(e)[:300])
+
+    # ---- 2. destination_sort method A/B (the hot-path sort) -------------
+    try:
+        from sparkucx_tpu.ops.partition import destination_sort
+        part_np = (payload_np[:, 0] % 64).astype(np.int32)
+        part = jax.device_put(jnp.asarray(part_np))
+        for method in ("argsort", "multisort", "multisort8", "counting"):
+            def step(x, p, method=method):
+                srt, _ = destination_sort(x, p, jnp.int32(rows), 64,
+                                          method=method)
+                # fold one sorted row back so iterations can't dedupe;
+                # XOR preserves dtype/shape and re-scrambles the keys
+                return x ^ srt[0:1, :]
+            try:
+                ms, deg = diff_time(step, payload, extra=(part,))
+                report("dest_sort", ms, deg, method=method)
+            except Exception as e:
+                emit("dest_sort", method=method, error=str(e)[:200])
+    except Exception as e:
+        emit("dest_sort", error=str(e)[:300])
+
+    # ---- 3. combine compaction A/B at 2M rows ---------------------------
+    try:
+        from sparkucx_tpu.ops.aggregate import combine_rows
+        part64 = jax.device_put(jnp.asarray(
+            rng.integers(0, 64, size=rows).astype(np.int32)))
+        keys_small = rng.integers(0, 100_000, size=rows, dtype=np.int64)
+        rows_np = payload_np.copy()
+        rows_np[:, :2] = keys_small.view(np.int32).reshape(-1, 2)
+        rows_dev = jax.device_put(jnp.asarray(rows_np))
+        for comp in ("stable", "unstable"):
+            def step(x, p, c=comp):
+                out, _, _ = combine_rows(x, p, jnp.int32(rows), 64,
+                                         W - 2, np.int32, "sum",
+                                         compaction=c)
+                return x ^ out[0:1, :]
+            ms, deg = diff_time(step, rows_dev, extra=(part64,))
+            report("combine_compaction", ms, deg, variant=comp)
+    except Exception as e:
+        emit("combine_compaction", error=str(e)[:300])
+
+    # ---- 4. the SHIPPED plain step at n=1, impl/sort A/B ----------------
+    try:
+        from jax.sharding import Mesh, PartitionSpec as P
+        from sparkucx_tpu.shuffle.plan import ShufflePlan
+        from sparkucx_tpu.shuffle.reader import step_body
+        mesh1 = Mesh(np.array(jax.devices()[:1]), ("shuffle",))
+        variants = (("auto", "auto"), ("native", "auto"),
+                    ("auto", "multisort8"), ("pallas", "auto"))
+        for impl, sort_impl in variants:
+            plan = ShufflePlan(num_shards=1, num_partitions=8,
+                               cap_in=rows, cap_out=int(rows * 1.5),
+                               impl=impl, sort_impl=sort_impl)
+            body = step_body(plan, "shuffle")
+
+            def step(x, body=body):
+                def inner(d, nv):
+                    out, _seg, _tot, _ovf = body(d, nv)
+                    return d ^ out[0:1, :].astype(d.dtype)
+                sm = jax.shard_map(
+                    inner, mesh=mesh1,
+                    in_specs=(P("shuffle"), P("shuffle")),
+                    out_specs=P("shuffle"), check_vma=False)
+                return sm(x, jnp.full((1,), rows, jnp.int32))
+
+            try:
+                ms, deg = diff_time(step, payload)
+                report("plain_step_n1", ms, deg, impl=impl,
+                       sort_impl=sort_impl)
+            except Exception as e:
+                emit("plain_step_n1", impl=impl, sort_impl=sort_impl,
+                     error=str(e)[:300])
+    except Exception as e:
+        emit("plain_step_n1", error=str(e)[:300])
+
+    # ---- 5. first-party pallas remote-DMA a2a vs the stock op, n=1 ------
+    try:
+        from jax.sharding import Mesh, PartitionSpec as P
+        from sparkucx_tpu.ops.pallas.ragged_a2a import (
+            align_rows, chunk_rows_for, pallas_ragged_all_to_all)
+        chunkr = chunk_rows_for(W)
+        cap = int(align_rows(rows, chunkr) + chunkr)
+        padded = np.zeros((cap, W), np.int32)
+        padded[:rows] = payload_np
+        mesh1 = Mesh(np.array(jax.devices()[:1]), ("x",))
+        pd = jax.device_put(jnp.asarray(padded))
+
+        def step(x):
+            def inner(d, sz):
+                out, _, _, _ = pallas_ragged_all_to_all(
+                    d, sz[0], "x", out_capacity=cap, num_devices=1)
+                return d ^ out[0:1, :]
+            sm = jax.shard_map(inner, mesh=mesh1,
+                               in_specs=(P("x"), P("x")),
+                               out_specs=P("x"), check_vma=False)
+            return sm(x, jnp.full((1, 1), rows, jnp.int32))
+
+        ms, deg = diff_time(step, pd)
+        report("pallas_a2a_n1", ms, deg)
+    except Exception as e:
+        emit("pallas_a2a_n1", error=str(e)[:300])
+
+    emit("done")
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()
