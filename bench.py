@@ -844,12 +844,6 @@ def main() -> None:
     except Exception as e:
         mon.end("native_aot", status="failed", error=str(e)[:200])
 
-    if args.a2a_impl == "pallas" and args.read_mode != "plain":
-        # fail the ARGUMENTS, not the primary stage mid-run: the pallas
-        # transport is plain-reads-only (reader.step_body rejects it)
-        print("--a2a-impl pallas supports --read-mode plain only",
-              file=sys.stderr, flush=True)
-        sys.exit(2)
     if args.a2a_impl == "pallas" and jax.default_backend() == "cpu":
         # the pallas transport only INTERPRETS on CPU — python-per-DMA
         # simulation inside the scan harness would run for hours and
@@ -870,17 +864,16 @@ def main() -> None:
         stage_exchange(mon, jax, "exchange_full", 1200, native_ok,
                        rows_log2=args.rows_log2 or 21, k1=2, k2=12,
                        reps=args.reps, **common)
-        if args.read_mode != "combine" and args.a2a_impl != "pallas":
+        if args.read_mode != "combine":
             # secondary metric (detail only): device combine-by-key rate
             # on a heavy-duplication aggregation shape (the WordCount
             # headline); skipped when the main stages already ran combined
-            # (and under --a2a-impl pallas, which is plain-reads-only)
             stage_exchange(mon, jax, "exchange_combine", 900, native_ok,
                            rows_log2=args.rows_log2 or 21, k1=1, k2=5,
                            reps=1, record=False,
                            **{**common, "read_mode": "combine",
                               "key_space": 100_000})
-        if args.read_mode == "plain" and args.a2a_impl != "pallas":
+        if args.read_mode == "plain":
             # secondary metric (detail only): ordered (key-sorted
             # partitions) rate — the TeraSort mode the BASELINE.md
             # methodology is named after

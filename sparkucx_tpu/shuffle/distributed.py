@@ -84,9 +84,11 @@ class DistributedReaderResult(ShuffleReaderResult):
 
     def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
                  shard_ids: Sequence[int], local_rows: np.ndarray,
-                 seg_counts: np.ndarray, val_shape, val_dtype):
+                 seg_counts: np.ndarray, val_shape, val_dtype,
+                 align_chunk: int = 0):
         super().__init__(num_partitions, part_to_shard, local_rows,
-                         seg_counts, val_shape, val_dtype)
+                         seg_counts, val_shape, val_dtype,
+                         align_chunk=align_chunk)
         self._shard_ord = {int(s): i for i, s in enumerate(shard_ids)}
 
     def is_local(self, r: int) -> bool:
@@ -250,11 +252,24 @@ class PendingDistributedShuffle(PendingExchangeBase):
                     # copy is the whole matrix (np.asarray rejects
                     # multi-process arrays)
                     seg_host = np.asarray(seg.addressable_shards[0].data)
+                # per-shard capacity from the OUTPUT, not the plan: the
+                # pallas transport's buffers are chunk-inflated
+                # (cap_eff = align(cap_out) + P*chunk), so slicing by
+                # cur.cap_out would misattribute shards (reader.py's
+                # single-process _result_inner derives it the same way)
+                cap_shard = rows_out.shape[0] // Pn
+                align_chunk = 0
+                if cur.impl == "pallas" and not (cur.combine
+                                                 or cur.ordered):
+                    from sparkucx_tpu.ops.pallas.ragged_a2a import \
+                        chunk_rows_for
+                    align_chunk = chunk_rows_for(self._width)
                 res = DistributedReaderResult(
                     R, part_to_shard, self._shard_ids,
                     _local_shards_of(rows_out, self._shard_ids,
-                                     cur.cap_out),
-                    seg_host, self._val_shape, self._val_dtype)
+                                     cap_shard),
+                    seg_host, self._val_shape, self._val_dtype,
+                    align_chunk=align_chunk)
                 res.cap_out_used = cur.cap_out
                 if not (cur.combine or cur.ordered
                         or self._hier_mesh is not None):

@@ -970,11 +970,19 @@ class TpuShuffleManager:
         from sparkucx_tpu.shuffle.distributed import (
             allgather_blob, allgather_sizes, submit_shuffle_distributed)
 
-        if self.conf.a2a_impl == "pallas":
+        import jax
+        if self.conf.a2a_impl == "pallas" and \
+                jax.default_backend() != "tpu":
+            # The kernel itself is process-agnostic — remote DMA targets
+            # mesh-logical device ids, and the n=8 AOT proof lowers the
+            # multi-peer program (bench_runs/r3_aot_proof.json). What
+            # cannot span processes is the CPU INTERPRET validation path
+            # (python-simulated DMA inside one process), so multi-process
+            # pallas is gated to real TPU backends rather than forbidden.
             raise NotImplementedError(
-                "impl='pallas' is single-process for now (the interpret "
-                "validation path cannot span processes); use "
-                "native/dense for multi-process reads")
+                "impl='pallas' multi-process requires a TPU backend: the "
+                "CPU interpret path cannot simulate cross-process DMA; "
+                "use native/dense for multi-process CPU reads")
         tracer = self.node.tracer
         shard_ids = self.node.local_shard_ids
         L = len(shard_ids)
@@ -1149,12 +1157,21 @@ class TpuShuffleManager:
                              hierarchical=self.hierarchical,
                              distributed=True):
                 vt = val_tail if has_vals else None
+                # flat-only transport: pallas on a multi-slice mesh rides
+                # the flattened alias mesh, same as the local path
+                # (manager.py _submit_local); the two-stage DCN-once
+                # exchange is native/dense territory
+                hier = self.hierarchical and plan.impl != "pallas"
+                if self.hierarchical and not hier:
+                    log.info("a2a.impl=pallas on a multi-slice mesh "
+                             "(distributed): using the flat exchange "
+                             "over %d devices",
+                             self.exchange_mesh.devices.size)
                 pending = submit_shuffle_distributed(
                     self.exchange_mesh, self.axis, plan, local_rows,
                     nvalid_local, shard_ids, vt, val_dtype,
-                    hier_mesh=self.node.mesh if self.hierarchical else None,
-                    dcn_axis=self.conf.mesh_dcn_axis
-                    if self.hierarchical else None,
+                    hier_mesh=self.node.mesh if hier else None,
+                    dcn_axis=self.conf.mesh_dcn_axis if hier else None,
                     on_done=on_done, admit=admit)
             arm(pending)
             return pending

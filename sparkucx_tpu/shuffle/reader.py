@@ -96,12 +96,9 @@ def step_body(plan: ShufflePlan, axis: str):
     R = plan.num_partitions
     Pn = plan.num_shards
     if plan.impl == "pallas":
-        # the first-party remote-DMA transport (plain reads only) — its
-        # chunk-aligned layout needs its own sort and run arithmetic
-        if plan.combine or plan.ordered:
-            raise ValueError(
-                "impl='pallas' supports plain reads; use native/dense "
-                "for combine/ordered")
+        # the first-party remote-DMA transport — its chunk-aligned layout
+        # needs its own sort and run arithmetic (plain), or a receive-side
+        # densify pass (combine/ordered)
         return _pallas_step_body(plan, axis)
     # numpy, NOT jnp: a closed-over concrete jnp array becomes a lifted
     # executable parameter, which jax's C++ fastpath fails to re-supply on
@@ -187,15 +184,30 @@ def step_body(plan: ShufflePlan, axis: str):
 
 
 def _pallas_step_body(plan: ShufflePlan, axis: str):
-    """Plain exchange over the first-party Pallas remote-DMA collective
-    (ops/pallas/ragged_a2a.py) — the UCX-analog data plane end to end.
+    """Exchange over the first-party Pallas remote-DMA collective
+    (ops/pallas/ragged_a2a.py) — the UCX-analog data plane end to end,
+    serving every read shape the native transport serves (the reference's
+    data plane is shape-agnostic: blocks are opaque byte ranges,
+    ref: compat/spark_3_0/UcxShuffleClient.java:95-127).
 
-    Layout: partition-major with DEVICE segments padded to chunk
-    multiples (ops/partition.partition_major_sort_aligned), so delivered
-    segments are still internally partition-sorted and readers locate
-    runs by prefix sums — just with ALIGNED segment starts
-    (_RunIndex(align_chunk=...)). On the CPU backend the kernel runs in
-    interpret mode automatically (tests); on TPU it compiles."""
+    Plain: partition-major with DEVICE segments padded to chunk multiples
+    (ops/partition.partition_major_sort_aligned), so delivered segments
+    are still internally partition-sorted and readers locate runs by
+    prefix sums — just with ALIGNED segment starts
+    (_RunIndex(align_chunk=...)).
+
+    Combine/ordered: the aligned receive buffer's pad rows are masked to
+    a SENTINEL partition id (derived from recv_off/real_recv — pure plan
+    arithmetic, no extra collective), then one receive-side
+    combine/keysort densifies: sentinel rows sort past every real
+    partition, pcounts count only real partitions, and the output is the
+    native path's dense [1, R]-seg contract (align_chunk=0 downstream).
+    Map-side combine still runs BEFORE the wire, so the traffic-cut
+    property survives; its combined rows are re-laid-out by the aligned
+    sort (one extra sort of the combined buffer).
+
+    On the CPU backend the kernel runs in interpret mode automatically
+    (tests); on TPU it compiles (see plan.pallas_interpret to pin)."""
     R = plan.num_partitions
     Pn = plan.num_shards
     bounds = _device_bounds(R, Pn)
@@ -209,8 +221,20 @@ def _pallas_step_body(plan: ShufflePlan, axis: str):
         width = payload.shape[1]
         chunk = chunk_rows_for(width)
         part = part_fn(payload)
-        srows, rcounts, dev_counts = partition_major_sort_aligned(
-            payload, part, nvalid[0], R, bounds, chunk)
+        if plan.combine:
+            # map-side combine first — one row per distinct (partition,
+            # key) enters the wire, same as the native path — then the
+            # aligned re-layout of the (smaller) combined buffer
+            from sparkucx_tpu.ops.aggregate import combine_rows
+            comb, _, n_c = combine_rows(
+                payload, part, nvalid[0], R, plan.combine_words,
+                np.dtype(plan.combine_dtype), plan.combine,
+                sum_words=plan.combine_sum_words)
+            srows, rcounts, dev_counts = partition_major_sort_aligned(
+                comb, part_fn(comb), n_c[0], R, bounds, chunk)
+        else:
+            srows, rcounts, dev_counts = partition_major_sort_aligned(
+                payload, part, nvalid[0], R, bounds, chunk)
         # the kernel requires chunk-multiple buffer capacities; the
         # trailing pad rows are never read (aligned send regions are
         # bounded by align(cap_in) + P*chunk)
@@ -226,13 +250,39 @@ def _pallas_step_body(plan: ShufflePlan, axis: str):
         interpret = (jax.default_backend() == "cpu"
                      if plan.pallas_interpret is None
                      else plan.pallas_interpret)
-        out, recv_real, _recv_off, total_al = pallas_ragged_all_to_all(
+        out, recv_real, recv_off, total_al = pallas_ragged_all_to_all(
             srows, dev_counts, axis, out_capacity=cap_eff,
             num_devices=Pn, interpret=interpret)
         ovf = (total_al < 0)
-        seg = jax.lax.all_gather(rcounts, axis)          # [P, R] real
-        total = recv_real.sum().astype(jnp.int32).reshape(1)
-        return out, seg, total, ovf
+        if not (plan.combine or plan.ordered):
+            seg = jax.lax.all_gather(rcounts, axis)      # [P, R] real
+            total = recv_real.sum().astype(jnp.int32).reshape(1)
+            return out, seg, total, ovf
+
+        # combine/ordered: mask the aligned layout's pad rows to the
+        # sentinel partition R, then densify on the receive side. Row k
+        # belongs to the segment whose aligned start precedes it; it is
+        # real iff it sits inside that segment's REAL prefix.
+        idx = jnp.arange(cap_eff, dtype=jnp.int32)
+        seg_i = jnp.clip(
+            jnp.searchsorted(recv_off, idx, side="right") - 1, 0, Pn - 1)
+        valid = (idx - jnp.take(recv_off, seg_i)) \
+            < jnp.take(recv_real, seg_i)
+        pkey = jnp.where(valid, part_fn(out), jnp.int32(R))
+        if plan.combine:
+            from sparkucx_tpu.ops.aggregate import combine_rows
+            rows_out, pcounts, _ = combine_rows(
+                out, pkey, jnp.int32(cap_eff), R, plan.combine_words,
+                np.dtype(plan.combine_dtype), plan.combine,
+                sum_words=plan.combine_sum_words)
+        else:
+            from sparkucx_tpu.ops.aggregate import keysort_rows
+            _, rows_out, pcounts = keysort_rows(
+                out, pkey, jnp.int32(cap_eff), R)
+        # total from pcounts, not the sort's group count: the sentinel
+        # partition's groups must not inflate the reported row count
+        total = pcounts.sum().astype(jnp.int32).reshape(1)
+        return rows_out, pcounts.reshape(1, R), total, ovf
 
     return step
 
@@ -733,7 +783,10 @@ class PendingShuffle(PendingExchangeBase):
         # rounds cap_out up to its chunk-aligned effective capacity)
         cap_shard = rows_out.shape[0] // Pn
         align_chunk = 0
-        if self._plan.impl == "pallas":
+        if self._plan.impl == "pallas" and not (self._plan.combine
+                                                or self._plan.ordered):
+            # plain pallas delivers the chunk-aligned layout; combine/
+            # ordered densify on device and use the normal [1, R] contract
             from sparkucx_tpu.ops.pallas.ragged_a2a import chunk_rows_for
             align_chunk = chunk_rows_for(self._rows_host.shape[2])
         res = LazyShuffleReaderResult(
