@@ -187,3 +187,68 @@ def test_arrow_native_carrier_roundtrip_plain(manager):
             seen += 1
     assert seen == len(truth)
     manager.unregister_shuffle(72)
+
+
+# -- foreign-tensor ingest (the GPU->TPU DLPack seam) ----------------------
+def test_ingest_foreign_torch_cpu_tensor():
+    """A torch tensor ingests via the zero-copy DLPack path (CPU->CPU)."""
+    torch = pytest.importorskip("torch")
+    from sparkucx_tpu.io.dlpack import ingest_foreign
+    t = torch.arange(24, dtype=torch.int32).reshape(4, 6)
+    out = ingest_foreign(t)
+    np.testing.assert_array_equal(np.asarray(out), t.numpy())
+
+
+def test_ingest_foreign_fallback_bounce():
+    """A producer whose capsule the backend rejects must bounce through
+    its host materialization, not fail: simulated by a wrapper whose
+    __dlpack__ raises (the cross-PCIe-domain case) but which exposes
+    .cpu()."""
+    torch = pytest.importorskip("torch")
+    from sparkucx_tpu.io.dlpack import ingest_foreign
+
+    class ForeignDevice:
+        def __init__(self, t):
+            self._t = t
+
+        def __dlpack__(self, **kw):
+            raise RuntimeError("cross-device capsule rejected")
+
+        def __dlpack_device__(self):
+            return (2, 0)   # kDLCUDA
+
+        def cpu(self):
+            return self._t
+
+    t = torch.arange(12, dtype=torch.float32).reshape(3, 4) * 1.5
+    out = ingest_foreign(ForeignDevice(t))
+    np.testing.assert_array_equal(np.asarray(out), t.numpy())
+
+
+def test_ingest_foreign_pinned_pool_bounce():
+    """The bounce path lands in a pinned arena block when a pool is
+    given, and returns the block to the pool afterwards."""
+    from sparkucx_tpu.io.dlpack import ingest_foreign
+    from sparkucx_tpu.runtime.memory import HostMemoryPool
+
+    class HostOnly:
+        def __init__(self, arr):
+            self._a = arr
+
+        def __array__(self, dtype=None):
+            return self._a if dtype is None else self._a.astype(dtype)
+
+    pool = HostMemoryPool()
+    try:
+        arr = np.arange(1024, dtype=np.int32).reshape(32, 32)
+        out = ingest_foreign(HostOnly(arr), pool=pool)
+        np.testing.assert_array_equal(np.asarray(out), arr)
+        assert pool.stats()["in_use"] == 0
+    finally:
+        pool.close()
+
+
+def test_ingest_foreign_rejects_opaque():
+    from sparkucx_tpu.io.dlpack import ingest_foreign
+    with pytest.raises(TypeError, match="cannot ingest"):
+        ingest_foreign(object())

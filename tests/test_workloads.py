@@ -104,3 +104,25 @@ def test_join_varchar(manager):
     out = run_join_varchar(manager)
     assert out["output_rows"] > 0
     assert out["distinct_keys"] > 100
+
+
+def test_q23_semijoin_aggregation(manager):
+    """TPC-DS q23 shape (BASELINE.md config row 3): aggregate a fact
+    table into a frequent-item filter set (exchange 1, device combine),
+    then semi-join a second fact table against it partition-locally
+    (exchange 2) and aggregate the survivors — all host-oracle verified
+    inside run_q23."""
+    from sparkucx_tpu.workloads.q23 import run_q23
+    out = run_q23(manager, shuffle_id=9300)
+    assert out["frequent_items"] > 0
+    assert 0 < out["surviving_rows"] <= 6000
+    assert out["surviving_qty"] > 0
+
+
+def test_q23_empty_frequent_set_guard(manager):
+    """A threshold nothing clears must fail the degenerate-set guard, not
+    silently return zeros."""
+    import pytest
+    from sparkucx_tpu.workloads.q23 import run_q23
+    with pytest.raises(AssertionError, match="degenerate"):
+        run_q23(manager, shuffle_id=9310, frequency_threshold=10_000_000)
