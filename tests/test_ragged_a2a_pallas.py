@@ -411,3 +411,55 @@ def test_pallas_transport_fuzz(pallas_manager, seed):
     for kk in oracle:
         assert sorted(got[kk]) == sorted(oracle[kk]), f"seed {seed} {kk}"
     m.unregister_shuffle(sid)
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_pallas_combine_ordered_fuzz(pallas_manager, seed):
+    """Randomized combine/ordered jobs over the pallas transport: the
+    sentinel-masked densify path vs the host oracle, across shapes,
+    duplicate-heavy key spaces, empty writers, and R around the device
+    count."""
+    rng = np.random.default_rng(4000 + seed)
+    M = int(rng.integers(1, 4))
+    R = int(rng.integers(1, 20))
+    vw = int(rng.integers(1, 4))
+    mode = ("combine", "ordered")[seed % 2]
+    m = pallas_manager
+    sid = 760 + seed
+    h = m.register_shuffle(sid, M, R)
+    oracle = {}
+    for mid in range(M):
+        w = m.get_writer(h, mid)
+        n = int(rng.integers(0, 400))
+        # small key space: combine actually merges
+        k = rng.integers(0, 80, size=n).astype(np.int64)
+        v = rng.integers(0, 1 << 20, size=(n, vw)).astype(np.int32)
+        if n:
+            w.write(k, v)
+        for i, kk in enumerate(k.tolist()):
+            if mode == "combine":
+                acc = oracle.setdefault(kk, [0] * vw)
+                for t in range(vw):
+                    acc[t] += int(v[i, t])
+            else:
+                oracle.setdefault(kk, []).append(tuple(v[i].tolist()))
+        w.commit(R)
+    res = m.read(h, combine="sum") if mode == "combine" \
+        else m.read(h, ordered=True)
+    got = {}
+    for r in range(R):
+        gk, gv = res.partition(r)
+        if gk.size > 1:
+            assert (np.diff(gk) >= 0).all(), f"partition {r} not sorted"
+        for i, kk in enumerate(gk.tolist()):
+            if mode == "combine":
+                assert kk not in got, f"key {kk} not merged"
+                got[kk] = list(map(int, gv[i]))
+            else:
+                got.setdefault(kk, []).append(tuple(gv[i].tolist()))
+    if mode == "combine":
+        assert got == oracle
+    else:
+        assert {k: sorted(v) for k, v in got.items()} \
+            == {k: sorted(v) for k, v in oracle.items()}
+    m.unregister_shuffle(sid)
