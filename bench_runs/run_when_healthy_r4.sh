@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-4 second measurement window: probe until the tunnel heals (it
+# wedged right after the ms8 official run), then capture the remaining
+# queue. NOTHING here wraps TPU work in an external kill-timeout
+# (NOTES_r2: that wedges the tunnel); every python self-watchdogs.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+TS=$(date +%H%M%S)
+
+echo "== probe until healthy (up to ~5h) =="
+healthy=0
+for i in $(seq 1 60); do
+    if python - <<'EOF'
+from bench import _tpu_probe_once
+import sys
+rec = _tpu_probe_once(240)
+print(rec, flush=True)
+sys.exit(0 if rec.get("rc") == 0 and rec.get("backend") == "tpu" else 3)
+EOF
+    then healthy=1; break; fi
+    echo "# probe $i unhealthy; sleeping 300s"
+    sleep 300
+done
+if [ "$healthy" != 1 ]; then
+    echo "== tunnel never healed; giving up =="
+    exit 3
+fi
+
+echo "== micro ladder r4 (scan-differenced; int8 suspects LAST) =="
+python bench_runs/micro_r4.py --watchdog 2400 \
+    | tee "bench_runs/r4_micro_${TS}.jsonl"
+
+run_bench() {  # label, extra args...
+    local label=$1; shift
+    local out="bench_runs/r4_tpu_${TS}_${label}.json"
+    if python bench.py --no-fallback --init-retry-s 60 "$@" \
+            | tail -1 | tee "$out"; then
+        echo "saved $out"
+    else
+        mv "$out" "$out.FAILED" 2>/dev/null
+        echo "bench ($label) FAILED — artifact renamed"
+    fi
+}
+
+echo "== official: pallas transport A/B (never captured on-chip) =="
+run_bench pallas --a2a-impl pallas
+
+echo "== official: ms8 at a bounded shape (the wedge question) =="
+run_bench ms8r20 --sort-impl multisort8 --rows-log2 20
+
+echo "== done — commit the artifacts =="
