@@ -87,6 +87,7 @@ class TpuShuffleConf:
         "coordinator_address", "meta_buffer_size", "min_buffer_size",
         "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
         "spill_threshold", "spill_dir", "a2a_impl", "sort_impl",
+        "combine_compaction",
         "capacity_factor", "max_bytes_in_flight", "mesh_ici_axis",
         "mesh_dcn_axis", "num_slices", "num_processes",
         "cores_per_process", "connection_timeout_ms")
@@ -291,6 +292,18 @@ class TpuShuffleConf:
             raise ValueError(
                 f"spark.shuffle.tpu.a2a.sortImpl={v!r}: want one of "
                 f"{SORT_METHODS}")
+        return v
+
+    @property
+    def combine_compaction(self) -> str:
+        """combine_rows end-row compaction formulation: stable | unstable
+        (ops/aggregate.py — bit-identical results, different sort cost;
+        the on-chip A/B lever for the combine path's laggard)."""
+        v = self._get("a2a.combineCompaction", "stable")
+        if v not in ("stable", "unstable"):
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.combineCompaction={v!r}: want "
+                f"stable|unstable")
         return v
 
     @property

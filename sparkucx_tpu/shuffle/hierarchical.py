@@ -87,7 +87,8 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
             payload, _, n1 = combine_rows(
                 payload, part_fn(payload), n0, R,
                 plan.combine_words, np.dtype(plan.combine_dtype),
-                plan.combine, sum_words=plan.combine_sum_words)
+                plan.combine, sum_words=plan.combine_sum_words,
+                compaction=plan.combine_compaction)
             n0 = n1[0]
         g = jnp.take(part_to_dest, part_fn(payload))  # global shard
 
@@ -112,7 +113,8 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
             send2, rcounts2, _ = combine_rows(
                 r1.data, part2, r1.total[0], R, plan.combine_words,
                 np.dtype(plan.combine_dtype), plan.combine,
-                sum_words=plan.combine_sum_words)
+                sum_words=plan.combine_sum_words,
+                compaction=plan.combine_compaction)
         else:
             # ordered needs no key order at the relay either — the final
             # stage fully re-sorts; the plain partition sort is cheaper
@@ -136,7 +138,8 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
             rows_out, pcounts, n_out = combine_rows(
                 r2.data, part_fn(r2.data), r2.total[0], R,
                 plan.combine_words, np.dtype(plan.combine_dtype),
-                plan.combine, sum_words=plan.combine_sum_words)
+                plan.combine, sum_words=plan.combine_sum_words,
+                compaction=plan.combine_compaction)
             return rows_out, pcounts.reshape(1, R), \
                 n_out.astype(r2.total.dtype), overflow
         if plan.ordered:

@@ -52,6 +52,10 @@ class ShufflePlan:
     # CARRIED per key (per-key-constant payload, e.g. varlen record
     # bytes — io/varlen.py). 0 = sum the whole value row.
     combine_sum_words: int = 0
+    # combine_rows end-row compaction formulation (stable | unstable) —
+    # bit-identical output, different TPU sort cost; conf-selectable for
+    # the on-chip A/B (a2a.combineCompaction).
+    combine_compaction: str = "stable"
     # device key sort: partitions come back key-sorted (signed int64
     # order) — the "sort" half of the reference reduce pipeline's stock
     # aggregate+sort, without aggregation (TeraSort's shape). Implied by
@@ -113,5 +117,6 @@ def make_plan(
         impl=conf.a2a_impl,
         partitioner=partitioner,
         sort_impl=conf.sort_impl,
+        combine_compaction=conf.combine_compaction,
         bounds=bounds,
     )

@@ -127,7 +127,8 @@ def step_body(plan: ShufflePlan, axis: str):
             send, rcounts, _ = combine_rows(
                 payload, part, nvalid[0], R, plan.combine_words,
                 np.dtype(plan.combine_dtype), plan.combine,
-                sum_words=plan.combine_sum_words)
+                sum_words=plan.combine_sum_words,
+                compaction=plan.combine_compaction)
         elif plan.ordered and Pn == 1:
             # single shard: ONE sender means delivered rows keep send
             # order, so doing the (partition, key) sort on the send side
@@ -162,7 +163,8 @@ def step_body(plan: ShufflePlan, axis: str):
             rows_out, pcounts, n_out = combine_rows(
                 r.data, part_fn(r.data), r.total[0], R,
                 plan.combine_words, np.dtype(plan.combine_dtype),
-                plan.combine, sum_words=plan.combine_sum_words)
+                plan.combine, sum_words=plan.combine_sum_words,
+                compaction=plan.combine_compaction)
             return rows_out, pcounts.reshape(1, R), \
                 n_out.astype(r.total.dtype), r.overflow
         if plan.ordered:
@@ -229,7 +231,8 @@ def _pallas_step_body(plan: ShufflePlan, axis: str):
             comb, _, n_c = combine_rows(
                 payload, part, nvalid[0], R, plan.combine_words,
                 np.dtype(plan.combine_dtype), plan.combine,
-                sum_words=plan.combine_sum_words)
+                sum_words=plan.combine_sum_words,
+                compaction=plan.combine_compaction)
             srows, rcounts, dev_counts = partition_major_sort_aligned(
                 comb, part_fn(comb), n_c[0], R, bounds, chunk)
         else:
@@ -274,7 +277,8 @@ def _pallas_step_body(plan: ShufflePlan, axis: str):
             rows_out, pcounts, _ = combine_rows(
                 out, pkey, jnp.int32(cap_eff), R, plan.combine_words,
                 np.dtype(plan.combine_dtype), plan.combine,
-                sum_words=plan.combine_sum_words)
+                sum_words=plan.combine_sum_words,
+                compaction=plan.combine_compaction)
         else:
             from sparkucx_tpu.ops.aggregate import keysort_rows
             _, rows_out, pcounts = keysort_rows(

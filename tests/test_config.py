@@ -98,3 +98,19 @@ def test_unknown_namespace_key_warns_not_raises(caplog, monkeypatch):
                         "spark.shuffle.tpu.fault.exchange.failRate": "0.5"},
                        use_env=False)
     assert not [r for r in caplog.records if "unknown conf key" in r.message]
+
+
+def test_combine_compaction_conf_threads_to_plan():
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.shuffle.plan import make_plan
+    import numpy as np
+    conf = TpuShuffleConf(
+        {"spark.shuffle.tpu.a2a.combineCompaction": "unstable",
+         "spark.shuffle.tpu.a2a.impl": "dense"}, use_env=False)
+    plan = make_plan(np.array([10, 10]), 2, 4, conf)
+    assert plan.combine_compaction == "unstable"
+    import pytest
+    with pytest.raises(ValueError, match="combineCompaction"):
+        TpuShuffleConf(
+            {"spark.shuffle.tpu.a2a.combineCompaction": "bogus"},
+            use_env=False)

@@ -584,3 +584,28 @@ def test_cap_hint_keeps_headroom_for_sustained_skew(manager):
     # all rows land on one shard: requirement = M*N over balanced share
     # N, x1.15 headroom
     assert factor > 0.9 * (M * 1.15)
+
+
+def test_combine_unstable_compaction_e2e(manager_factory, rng):
+    """conf a2a.combineCompaction=unstable rides the whole manager
+    combine path and produces the same sums as the host oracle (the
+    bit-identical-variants property, end to end)."""
+    m = manager_factory(
+        {"spark.shuffle.tpu.a2a.combineCompaction": "unstable"})
+    h = m.register_shuffle(950, 2, 8)
+    oracle = {}
+    for mid in range(2):
+        k = rng.integers(0, 50, size=500).astype(np.int64)
+        v = rng.integers(0, 100, size=(500, 1)).astype(np.int32)
+        w = m.get_writer(h, mid)
+        w.write(k, v)
+        w.commit(8)
+        for kk, vv in zip(k.tolist(), v[:, 0].tolist()):
+            oracle[kk] = oracle.get(kk, 0) + vv
+    res = m.read(h, combine="sum")
+    got = {}
+    for r in range(8):
+        kk, vv = res.partition(r)
+        got.update(dict(zip(kk.tolist(), vv[:, 0].tolist())))
+    assert got == oracle
+    m.unregister_shuffle(950)
