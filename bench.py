@@ -868,9 +868,12 @@ def main() -> None:
             # secondary metric (detail only): device combine-by-key rate
             # on a heavy-duplication aggregation shape (the WordCount
             # headline); skipped when the main stages already ran combined
+            # k1=2/k2=10, reps=2: the r4 auto capture's 1/5-step windows
+            # left ordered degenerate (t_small > t_large on one rep) —
+            # at ~30 ms/step the widened window is ~240 ms of signal
             stage_exchange(mon, jax, "exchange_combine", 900, native_ok,
-                           rows_log2=args.rows_log2 or 21, k1=1, k2=5,
-                           reps=1, record=False,
+                           rows_log2=args.rows_log2 or 21, k1=2, k2=10,
+                           reps=2, record=False,
                            **{**common, "read_mode": "combine",
                               "key_space": 100_000})
         if args.read_mode == "plain":
@@ -878,8 +881,8 @@ def main() -> None:
             # partitions) rate — the TeraSort mode the BASELINE.md
             # methodology is named after
             stage_exchange(mon, jax, "exchange_ordered", 900, native_ok,
-                           rows_log2=args.rows_log2 or 21, k1=1, k2=5,
-                           reps=1, record=False,
+                           rows_log2=args.rows_log2 or 21, k1=2, k2=10,
+                           reps=2, record=False,
                            **{**common, "read_mode": "ordered"})
         # end-to-end rate through the production manager (secondary
         # metric: pack + H2D + exchange + first-partition D2H)

@@ -463,3 +463,16 @@ def test_pallas_combine_ordered_fuzz(pallas_manager, seed):
         assert {k: sorted(v) for k, v in got.items()} \
             == {k: sorted(v) for k, v in oracle.items()}
     m.unregister_shuffle(sid)
+
+
+def test_pallas_step_aot_lowering_v5e(mesh8):
+    """The FULL pallas step (aligned sort + kernel + seg all_gather)
+    AOT-compiles at n=8 against an unattached v5e topology with
+    plan.pallas_interpret=False pinned — proof the production path (not
+    just the raw kernel) lowers multi-peer, and that the interpret pin
+    keeps the interpreter out of the chip's program."""
+    from sparkucx_tpu.shuffle.aot import aot_compile_pallas_step
+    rep = aot_compile_pallas_step(8)
+    if "topology" not in rep:
+        pytest.skip(f"no TPU topology support here: {rep.get('error')}")
+    assert rep["ok"], rep
