@@ -708,8 +708,16 @@ def stage_native_aot(mon):
         + os.pathsep + env.get("PYTHONPATH", "")
     code = ("import json, os, threading\n"
             "threading.Timer(240, lambda: os._exit(3)).start()\n"
-            "from sparkucx_tpu.shuffle.aot import aot_compile_native_step\n"
-            "print(json.dumps(aot_compile_native_step(8)), flush=True)\n"
+            "from sparkucx_tpu.shuffle.aot import (\n"
+            "    aot_compile_native_step, aot_compile_pallas_step)\n"
+            "rep = aot_compile_native_step(8)\n"
+            "try:\n"
+            "    p = aot_compile_pallas_step(8)\n"
+            "    rep['pallas_step_ok'] = p.get('ok', False)\n"
+            "except Exception as e:\n"
+            "    rep['pallas_step_ok'] = False\n"
+            "    rep['pallas_step_error'] = str(e)[:150]\n"
+            "print(json.dumps(rep), flush=True)\n"
             "os._exit(0)\n")
     rep = {}
     try:
