@@ -87,7 +87,7 @@ class TpuShuffleConf:
         "coordinator_address", "meta_buffer_size", "min_buffer_size",
         "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
         "spill_threshold", "spill_dir", "a2a_impl", "sort_impl",
-        "combine_compaction",
+        "combine_compaction", "fetch_granularity",
         "capacity_factor", "max_bytes_in_flight", "mesh_ici_axis",
         "mesh_dcn_axis", "num_slices", "num_processes",
         "cores_per_process", "connection_timeout_ms")
@@ -292,6 +292,20 @@ class TpuShuffleConf:
             raise ValueError(
                 f"spark.shuffle.tpu.a2a.sortImpl={v!r}: want one of "
                 f"{SORT_METHODS}")
+        return v
+
+    @property
+    def fetch_granularity(self) -> str:
+        """Lazy-result D2H granularity: ``shard`` (default — first touch
+        of a shard pulls its whole receive buffer) or ``partition``
+        (each fetch device-slices only that partition's runs — the
+        reference's per-block fetch; right for slow D2H links or sparse
+        partition reads)."""
+        v = self._get("io.fetchGranularity", "shard")
+        if v not in ("shard", "partition"):
+            raise ValueError(
+                f"spark.shuffle.tpu.io.fetchGranularity={v!r}: want "
+                f"shard|partition")
         return v
 
     @property

@@ -759,6 +759,10 @@ class TpuShuffleManager:
             self.node.pool.put(stage_buf)
             release_admitted()
             if result is not None:
+                if hasattr(result, "fetch_granularity"):
+                    # lazy results honor io.fetchGranularity (per-block
+                    # device-sliced D2H vs whole-shard pulls)
+                    result.fetch_granularity = self.conf.fetch_granularity
                 self._learn_cap(handle, result, global_rows)
                 self.node.metrics.inc("shuffle.rows", float(local_rows))
                 self.node.metrics.inc("shuffle.bytes",

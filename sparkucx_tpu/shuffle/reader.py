@@ -521,6 +521,17 @@ class ShuffleReaderResult:
         single-process mode; the distributed subclass restricts it)."""
         return True
 
+    def _partition_block(self, r: int, shard: int) -> np.ndarray:
+        """Dense [n, width] rows of partition r (host array)."""
+        rows = self._shard_rows(shard)
+        runs = self._runs(shard).runs(r)
+        if not runs:
+            return rows[:0]
+        if len(runs) == 1:
+            s, n = runs[0]
+            return rows[s:s + n]
+        return np.concatenate([rows[s:s + n] for s, n in runs])
+
     def partition(self, r: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """(keys, values) of reduce partition r, densely packed.
 
@@ -534,15 +545,7 @@ class ShuffleReaderResult:
         from sparkucx_tpu.utils.trace import GLOBAL_TRACER
         with GLOBAL_TRACER.span("shuffle.fetch", partition=r) as sp:
             shard = int(self._part_to_shard[r])
-            rows = self._shard_rows(shard)
-            runs = self._runs(shard).runs(r)
-            if not runs:
-                block = rows[:0]
-            elif len(runs) == 1:
-                s, n = runs[0]
-                block = rows[s:s + n]
-            else:
-                block = np.concatenate([rows[s:s + n] for s, n in runs])
+            block = self._partition_block(r, shard)
             sp.set(bytes=int(block.nbytes))
             return unpack_rows(block, self._val_shape, self._val_dtype)
 
@@ -559,7 +562,20 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
     the reference's deliver-blocks-as-they-arrive iterator
     (ref: compat/spark_3_0/UcxShuffleReader.scala:56-98,
     reducer/OnBlocksFetchCallback.java:45-53), with XLA's async transfer
-    engine playing the progress thread."""
+    engine playing the progress thread.
+
+    ``fetch_granularity`` — "shard" (default): first touch of a shard
+    pulls its whole receive buffer D2H, later partitions are host
+    slicing. "partition": each fetch device-slices ONLY the requested
+    partition's runs and transfers those bytes — the reference's
+    per-BLOCK fetch granularity (conf ``io.fetchGranularity``). Right
+    when the D2H link is slow or the consumer reads a sparse partition
+    subset; the whole-shard pull amortizes better when every partition
+    gets read over a fast link. Fetched blocks are cached host-side
+    (re-reads never re-transfer), and once EVERY partition has been
+    fetched the device buffers are dropped so the HBM is free for the
+    next shuffle — the same release discipline as shard mode. A shard
+    already host-materialized keeps the host path."""
 
     def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
                  rows_dev, seg_dev, num_shards: int, cap_out: int,
@@ -581,6 +597,9 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
         self._runidx: dict = {}
         self._shards: dict = {}            # shard -> np [cap_out, width]
         self.cap_out_used: Optional[int] = cap_out
+        self.recv_rows_needed: Optional[int] = None
+        self.fetch_granularity: str = "shard"
+        self._part_cache: dict = {}        # r -> np [n, width] block
 
     def _seg_matrix(self, shard: int) -> np.ndarray:
         if self._seg is None:
@@ -595,22 +614,68 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
             self._seg_dev = None
         return super()._seg_matrix(shard)
 
+    def _shard_dev(self, shard: int):
+        """This shard's single-device [cap_out, width] array, or None
+        once the device buffers were dropped."""
+        if self._rows_dev is None:
+            return None
+        for s in self._rows_dev.addressable_shards:
+            start = s.index[0].start or 0
+            if start // self._cap_out == shard:
+                return s.data
+        return None
+
     def _shard_rows(self, shard: int) -> np.ndarray:
         got = self._shards.get(shard)
         if got is None:
-            for s in self._rows_dev.addressable_shards:
-                start = s.index[0].start or 0
-                if start // self._cap_out == shard:
-                    got = np.asarray(s.data)
-                    break
-            else:
+            dev = self._shard_dev(shard)
+            if dev is None:
                 raise KeyError(f"shard {shard} not addressable here")
+            got = np.asarray(dev)
             self._shards[shard] = got
             if len(self._shards) == self._num_shards:
                 # every shard is host-side; drop the device buffers so
                 # the HBM is free for the next shuffle's exchange
                 self._rows_dev = None
         return got
+
+    def _partition_block(self, r: int, shard: int) -> np.ndarray:
+        if self.fetch_granularity != "partition" \
+                or shard in self._shards:
+            return super()._partition_block(r, shard)
+        got = self._part_cache.get(r)
+        if got is not None:
+            return got
+        dev = self._shard_dev(shard)
+        if dev is None:
+            return super()._partition_block(r, shard)
+        runs = self._runs(shard).runs(r)
+        if not runs:
+            block = np.zeros((0, dev.shape[1]), np.int32)
+        else:
+            # Device-slice ONLY this partition's runs and transfer those
+            # bytes — the reference's per-BLOCK fetch. Run lengths are
+            # bucketed to powers of two so at most log2(cap_out) slice
+            # programs ever compile (a per-exact-shape slice would pay
+            # one compile round-trip per distinct run length — ruinous
+            # on a tunneled backend, the very link this mode exists for).
+            import jax as _jax
+            cap = dev.shape[0]
+            blocks = []
+            for s, n in runs:
+                bucket = min(cap, 1 << max(0, (n - 1).bit_length()))
+                start = min(s, cap - bucket)
+                sl = _jax.lax.dynamic_slice_in_dim(dev, start, bucket,
+                                                   axis=0)
+                blocks.append(np.asarray(sl)[s - start:s - start + n])
+            block = blocks[0] if len(blocks) == 1 \
+                else np.concatenate(blocks)
+        self._part_cache[r] = block
+        if len(self._part_cache) == self.num_partitions:
+            # every partition is host-side (cached blocks) — drop the
+            # device buffers, same HBM-release point as shard mode
+            self._rows_dev = None
+        return block
 
 
 class PendingExchangeBase:

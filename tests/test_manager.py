@@ -609,3 +609,60 @@ def test_combine_unstable_compaction_e2e(manager_factory, rng):
         got.update(dict(zip(kk.tolist(), vv[:, 0].tolist())))
     assert got == oracle
     m.unregister_shuffle(950)
+
+
+def test_fetch_granularity_partition(manager_factory, rng):
+    """io.fetchGranularity=partition: every partition fetch device-
+    slices only its runs (no whole-shard pull), and the data is
+    bit-identical to the shard-granularity read."""
+    m = manager_factory(
+        {"spark.shuffle.tpu.io.fetchGranularity": "partition"})
+    R, M = 16, 4
+    h = m.register_shuffle(960, M, R)
+    allk = []
+    for mid in range(M):
+        k = rng.integers(0, 1 << 40, size=400).astype(np.int64)
+        w = m.get_writer(h, mid)
+        w.write(k, (k & 0x7FFF)[:, None].astype(np.int32))
+        w.commit(R)
+        allk.append(k)
+    res = m.read(h)
+    assert getattr(res, "fetch_granularity", None) == "partition"
+    got = []
+    for r in range(R):
+        k, v = res.partition(r)
+        assert (v[:, 0] == (k & 0x7FFF)).all()
+        got.append(k)
+    assert res._shards == {}, "partition mode must not pull whole shards"
+    np.testing.assert_array_equal(
+        np.sort(np.concatenate(got)), np.sort(np.concatenate(allk)))
+    m.unregister_shuffle(960)
+
+
+def test_fetch_granularity_conf_rejects_bogus():
+    with pytest.raises(ValueError, match="fetchGranularity"):
+        TpuShuffleConf(
+            {"spark.shuffle.tpu.io.fetchGranularity": "block"},
+            use_env=False)
+
+
+def test_fetch_granularity_partition_releases_device_buffers(
+        manager_factory, rng):
+    """Partition mode caches fetched blocks and drops the device buffers
+    once every partition has been fetched (the HBM-release discipline of
+    shard mode), and re-reads come from the cache."""
+    m = manager_factory(
+        {"spark.shuffle.tpu.io.fetchGranularity": "partition"})
+    R = 8
+    h = m.register_shuffle(961, 1, R)
+    k = rng.integers(0, 1 << 40, size=500).astype(np.int64)
+    w = m.get_writer(h, 0)
+    w.write(k)
+    w.commit(R)
+    res = m.read(h)
+    first = [res.partition(r)[0] for r in range(R)]
+    assert res._rows_dev is None, "device buffers retained after full scan"
+    again = [res.partition(r)[0] for r in range(R)]  # cache, no device
+    for a, b in zip(first, again):
+        np.testing.assert_array_equal(a, b)
+    m.unregister_shuffle(961)
