@@ -628,8 +628,13 @@ def stage_e2e(mon, jax, rows_log2, val_words):
     # trace.enabled: every res.partition() records a shuffle.fetch span,
     # so the stage can report the p50/p99 BLOCK-FETCH latency that is the
     # other half of the BASELINE.md metric (round-3 missing #2; ref:
-    # reducer/OnBlocksFetchCallback.java:55-56 logs it per completion)
-    conf = TpuShuffleConf({"spark.shuffle.tpu.trace.enabled": "1"},
+    # reducer/OnBlocksFetchCallback.java:55-56 logs it per completion).
+    # fetchGranularity=partition: each fetch transfers only its own
+    # block, so the percentiles measure true per-block D2H (the
+    # reference's unit) instead of one whole-shard pull + host slicing.
+    conf = TpuShuffleConf({"spark.shuffle.tpu.trace.enabled": "1",
+                           "spark.shuffle.tpu.io.fetchGranularity":
+                           "partition"},
                           use_env=False)
     node = TpuNode.start(conf)
     mgr = TpuShuffleManager(node, conf)
