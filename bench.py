@@ -867,12 +867,12 @@ def main() -> None:
     common = dict(val_words=args.val_words, sort_impl=args.sort_impl,
                   partitions_per_dev=8, read_mode=args.read_mode,
                   force_impl=args.a2a_impl)
-    # k1=32/k2=288: at ~0.2 ms/step on the chip the differenced window is
-    # ~50 ms — well above tunneled-dispatch jitter, so the small-shape
-    # number stops collapsing to degenerate_timing (round-2 artifact
-    # carried a junk 23 ms small-step estimate from k2=3)
+    # k1=64/k2=1024: the r4 auto capture went degenerate at 32/288 —
+    # with the landed sort levers the small-shape step is ~0.01-0.26 ms,
+    # so the window must be ~1000 steps to clear tunneled-dispatch
+    # jitter (~5 ms) at the fast end while staying <0.5 s per call
     stage_exchange(mon, jax, "exchange_small", 600, native_ok,
-                   rows_log2=12, k1=32, k2=288, reps=2, **common)
+                   rows_log2=12, k1=64, k2=1024, reps=2, **common)
     if not args.smoke:
         stage_exchange(mon, jax, "exchange_full", 1200, native_ok,
                        rows_log2=args.rows_log2 or 21, k1=2, k2=12,
