@@ -499,7 +499,7 @@ def stage_h2d(mon, jax):
 
 def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
                  partitions_per_dev, sort_impl, impl, read_mode="plain",
-                 key_space=None):
+                 key_space=None, sort_strips=1):
     import dataclasses
 
     import jax.numpy as jnp
@@ -521,7 +521,8 @@ def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
     # the EXACT production pipeline (shuffle/reader.py step_body): route ->
     # one partition-major sort -> ragged all-to-all; no receive-side sort
     plan = ShufflePlan(num_shards=nchips, num_partitions=R, cap_in=rows,
-                       cap_out=cap_out, impl=impl, sort_impl=sort_impl)
+                       cap_out=cap_out, impl=impl, sort_impl=sort_impl,
+                       sort_strips=sort_strips)
     if read_mode == "ordered":
         plan = dataclasses.replace(plan, ordered=True)
     elif read_mode == "combine":
@@ -599,6 +600,7 @@ def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
         "partitions": R,
         "impl": impl,
         "read_mode": read_mode,
+        "sort_strips": sort_strips,
         "step_ms": round(per_step * 1e3, 3),
         "t_small_ms": round(t_small * 1e3, 3),
         "t_large_ms": round(t_large * 1e3, 3),
@@ -782,6 +784,11 @@ def main() -> None:
     ap.add_argument("--sort-impl", default="auto",
                     help="destination_sort method: auto|argsort|multisort|"
                          "multisort8|counting (A/B the hot path)")
+    ap.add_argument("--sort-strips", type=int, default=1,
+                    help="single-shard plain path: destination-sort in N "
+                         "independent strips (batched shallower sort "
+                         "network; served as N virtual senders). 1 = one "
+                         "flat sort (A/B the n=1 sort denominator)")
     ap.add_argument("--read-mode", default="plain",
                     choices=("plain", "ordered", "combine"),
                     help="exchange flavor for the main stages (combine = "
@@ -866,7 +873,7 @@ def main() -> None:
         args.a2a_impl = None
     common = dict(val_words=args.val_words, sort_impl=args.sort_impl,
                   partitions_per_dev=8, read_mode=args.read_mode,
-                  force_impl=args.a2a_impl)
+                  force_impl=args.a2a_impl, sort_strips=args.sort_strips)
     # k1=64/k2=1024: the r4 auto capture went degenerate at 32/288 —
     # with the landed sort levers the small-shape step is ~0.01-0.26 ms,
     # so the window must be ~1000 steps to clear tunneled-dispatch

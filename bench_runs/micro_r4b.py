@@ -1,0 +1,122 @@
+"""Round-4 follow-up on-chip micro: STRIP-SORT sweep.
+
+The window-3 ladder (r4_window3.log) confirms the plain step at n=1 is
+sort-bound (the a2a leg is a ~0.9 ms local copy; the multisort is ~13 ms
+at 2M x 10-int32 rows). Sort-network depth scales ~log^2(n), so S
+independent sorts of n/S rows cost ~log^2(n/S) each — and XLA batches
+them perfectly (lax.sort over the trailing axis of [S, n/S] operands,
+one vectorized sort network). The reader's run index already serves
+multi-run partitions (the [P, R] seg-matrix contract from P senders), so
+S strips can ride the same contract as S virtual senders at n=1 — IF the
+batched sort is actually faster on silicon. Depth math says 2M flat =
+21^2 = 441 stages vs 64 strips of 32K = 15^2 = 225: a potential ~2x on
+the step denominator. This ladder measures it (scan-differenced, scalar
+D2H — bench.py methodology; see micro_r4.py header for why).
+
+Also sweeps the KEY-WIDTH lever jointly (int32 vs int8 key) since the
+two multiply.
+
+Usage: python bench_runs/micro_r4b.py [--watchdog 1800] [--rows-log2 21]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+K1, K2, REPS = 2, 12, 3
+
+
+def emit(name, **kw):
+    print(json.dumps({"exp": name, **kw}), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--watchdog", type=int, default=1800)
+    ap.add_argument("--rows-log2", type=int, default=21)
+    args = ap.parse_args()
+    threading.Timer(args.watchdog, lambda: os._exit(3)).start()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    emit("init", backend=jax.default_backend(), devices=len(jax.devices()))
+
+    rows = 1 << args.rows_log2
+    W = 10
+    D = 64                      # destination count (bench partitions)
+    rng = np.random.default_rng(0)
+    payload_np = rng.integers(0, 1 << 31, size=(rows, W),
+                              dtype=np.int64).astype(np.int32)
+    key_np = (payload_np[:, 0] % D).astype(np.int32)
+    nbytes = rows * W * 4
+
+    def diff_time(step, *xs, k1=K1, k2=K2, reps=REPS):
+        def make(k):
+            def many(*arrs):
+                def body(c, _):
+                    c = lax.optimization_barrier(c)
+                    return step(*c), ()
+                c, _ = lax.scan(body, arrs, None, length=k)
+                return c[0].reshape(-1)[0:1]
+            return jax.jit(many)
+
+        def timed(k):
+            fn = make(k)
+            np.asarray(fn(*xs))
+            best = float("inf")
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                out = fn(*xs)
+                _ = np.asarray(out)
+                best = min(best, time.perf_counter() - t0)
+            return best
+
+        t1, t2 = timed(k1), timed(k2)
+        if t2 <= t1:
+            return t2 / k2 * 1e3, True
+        return (t2 - t1) / (k2 - k1) * 1e3, False
+
+    def report(name, ms, degenerate, **kw):
+        emit(name, ms=round(ms, 3), GBps=round(nbytes / ms / 1e6, 2),
+             degenerate=degenerate, **kw)
+
+    # step(rows [S, M, W], key [S, M]) -> (rows', key'): batched
+    # multisort carrying all W columns, key re-scrambled afterwards so
+    # scan iterations can't collapse.  S=1 is the flat baseline.
+    def make_step(S, key_dtype):
+        def step(r3, k2d):
+            ops = (k2d.astype(key_dtype),) + tuple(
+                r3[..., j] for j in range(W))
+            srt = lax.sort(ops, dimension=-1, num_keys=1, is_stable=False)
+            r_out = jnp.stack(srt[1:], axis=-1)
+            k_out = (k2d ^ srt[1][:, ::-1].astype(jnp.int32)) % D
+            return r_out, k_out
+        return step
+
+    for S in (1, 8, 16, 32, 64, 128, 256):
+        M = rows // S
+        r3 = jax.device_put(jnp.asarray(payload_np.reshape(S, M, W)))
+        k2d = jax.device_put(jnp.asarray(key_np.reshape(S, M)))
+        for key_dtype, label in ((jnp.int32, "i32"), (jnp.int8, "i8")):
+            try:
+                ms, deg = diff_time(make_step(S, key_dtype), r3, k2d)
+                report("strip_sort", ms, deg, S=S, key=label)
+            except Exception as e:
+                emit("strip_sort", S=S, key=label, error=str(e)[:200])
+
+    emit("done")
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

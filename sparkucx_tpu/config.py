@@ -87,7 +87,7 @@ class TpuShuffleConf:
         "coordinator_address", "meta_buffer_size", "min_buffer_size",
         "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
         "spill_threshold", "spill_dir", "a2a_impl", "sort_impl",
-        "combine_compaction", "fetch_granularity",
+        "sort_strips", "combine_compaction", "fetch_granularity",
         "capacity_factor", "max_bytes_in_flight", "mesh_ici_axis",
         "mesh_dcn_axis", "num_slices", "num_processes",
         "cores_per_process", "connection_timeout_ms")
@@ -292,6 +292,19 @@ class TpuShuffleConf:
             raise ValueError(
                 f"spark.shuffle.tpu.a2a.sortImpl={v!r}: want one of "
                 f"{SORT_METHODS}")
+        return v
+
+    @property
+    def sort_strips(self) -> int:
+        """Single-shard plain exchanges: destination-sort in this many
+        independent strips (one batched sort network — depth
+        ~log^2(cap/strips) instead of ~log^2(cap)), served as virtual
+        senders by the reader's run index. 1 = one flat sort
+        (ops/partition.destination_sort_strips)."""
+        v = int(self._get("a2a.sortStrips", 1))
+        if not 1 <= v <= 4096:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.sortStrips={v}: want 1..4096")
         return v
 
     @property

@@ -60,6 +60,23 @@ def counts_from_sorted(sorted_key: jnp.ndarray, num_bins: int) -> jnp.ndarray:
     return edges[1:] - edges[:-1]
 
 
+def _sentinel_key(dest: jnp.ndarray, num_valid: jnp.ndarray,
+                  num_dests: int, cap: int) -> jnp.ndarray:
+    """int32 grouping key: destination for real rows, the ``num_dests``
+    sentinel for padding (valid rows are the prefix ``[:num_valid]``) —
+    padding sorts past every real destination. Shared by the flat and
+    strip sorts so the sentinel convention cannot drift."""
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    return jnp.where(idx < num_valid, dest.astype(jnp.int32),
+                     jnp.int32(num_dests))
+
+
+def _int8_key_ok(num_dests: int) -> bool:
+    """int8-key narrowing eligibility (the multisort8 lever): every key
+    value INCLUDING the padding sentinel ``num_dests`` must fit int8."""
+    return num_dests < 127
+
+
 def destination_sort(
     rows: jnp.ndarray,
     dest: jnp.ndarray,
@@ -114,8 +131,7 @@ def destination_sort(
     shard's row of the segment table)."""
     cap = rows.shape[0]
     idx = jnp.arange(cap, dtype=jnp.int32)
-    valid = idx < num_valid
-    key = jnp.where(valid, dest.astype(jnp.int32), jnp.int32(num_dests))
+    key = _sentinel_key(dest, num_valid, num_dests, cap)
     if method == "auto":
         if (jax.default_backend() in ("tpu", "gpu") and rows.ndim == 2
                 and rows.shape[1] <= 32):
@@ -136,7 +152,7 @@ def destination_sort(
         # Valid only when every key value (incl. the padding sentinel
         # num_dests) fits int8; conf-selectable for on-chip A/B
         # (bench --sort-impl multisort8).
-        narrow = num_dests < 127 and rows.ndim == 2
+        narrow = _int8_key_ok(num_dests) and rows.ndim == 2
         method = "multisort" if narrow else "argsort"
     else:
         narrow = False
@@ -184,6 +200,65 @@ def destination_sort(
         raise ValueError(
             f"unknown sort method {method!r}; want one of {SORT_METHODS}")
     return sorted_rows, counts.astype(jnp.int32)
+
+
+def destination_sort_strips(
+    rows: jnp.ndarray,
+    dest: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    num_dests: int,
+    strips: int,
+    key_impl: str = "auto",
+) -> Tuple[jnp.ndarray, jnp.ndarray, int]:
+    """Destination-group in S INDEPENDENT strips — one batched sort.
+
+    Sort-network depth scales ~log^2(n), so S sorts of n/S rows cost
+    ~log^2(n/S) each, and XLA batches them into ONE vectorized network
+    (``lax.sort`` over the trailing axis of [S, n/S] operands): at 2M
+    rows the depth ratio alone is 441/225 ~ 2x. The price is that each
+    destination's rows land as S runs instead of one — but the receive
+    layout already serves MULTI-RUN partitions (one run per sender,
+    reader._RunIndex), so strips simply ride that contract as S virtual
+    senders. The reference's reducers likewise assemble a partition from
+    many per-mapper blocks, never from one contiguous range
+    (ref: reducer/OnBlocksFetchCallback.java:36-43).
+
+    Valid rows are a prefix (rows[:num_valid]), so strips fill front to
+    back: full strips, then at most one partial, then empty ones — which
+    is exactly the layout ``_RunIndex(align_chunk=strip_rows)`` indexes
+    (every non-empty strip occupies one strip_rows-sized region; empty
+    trailing strips contribute nothing).
+
+    ``key_impl`` — 'multisort8' narrows the carried key to int8 when
+    every value (incl. the sentinel) fits, same lever as
+    :func:`destination_sort`; any other value keeps int32.
+
+    Returns (sorted_rows [S*strip_rows, W], counts [S, num_dests],
+    strip_rows). Padding sorts to each strip's tail."""
+    cap = rows.shape[0]
+    if rows.ndim != 2:
+        raise ValueError("strip sort needs 2-D rows (multisort form)")
+    S = max(1, min(int(strips), cap))
+    M = -(-cap // S)
+    pad = S * M - cap
+    W = rows.shape[1]
+    if pad:
+        rows = jnp.concatenate(
+            [rows, jnp.zeros((pad, W), rows.dtype)])
+        dest = jnp.concatenate(
+            [dest, jnp.zeros((pad,), dest.dtype)])
+    key = _sentinel_key(dest, num_valid, num_dests, S * M)
+    if key_impl == "multisort8" and _int8_key_ok(num_dests):
+        key = key.astype(jnp.int8)
+    k2 = key.reshape(S, M)
+    r3 = rows.reshape(S, M, W)
+    ops = (k2,) + tuple(r3[..., j] for j in range(W))
+    out = jax.lax.sort(ops, dimension=-1, num_keys=1, is_stable=False)
+    sorted_rows = jnp.stack(out[1:], axis=-1).reshape(S * M, W)
+    counts = jax.vmap(
+        lambda sk: counts_from_sorted(sk, num_dests))(
+            out[0].astype(jnp.int32))
+    return sorted_rows, counts.astype(jnp.int32), M
 
 
 

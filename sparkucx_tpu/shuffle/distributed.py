@@ -264,6 +264,10 @@ class PendingDistributedShuffle(PendingExchangeBase):
                     from sparkucx_tpu.ops.pallas.ragged_a2a import \
                         chunk_rows_for
                     align_chunk = chunk_rows_for(self._width)
+                elif cur.strips_active():
+                    # degenerate 1-shard cluster: step_body takes the
+                    # strip fast path (see reader.py resolve)
+                    align_chunk = cur.strip_rows()
                 res = DistributedReaderResult(
                     R, part_to_shard, self._shard_ids,
                     _local_shards_of(rows_out, self._shard_ids,

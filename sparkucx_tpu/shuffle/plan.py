@@ -41,6 +41,14 @@ class ShufflePlan:
     partitioner: str = "hash"  # hash | direct (keys ARE partition ids)
     max_retries: int = 4
     sort_impl: str = "auto"    # ops/partition.py destination_sort method
+    # single-shard plain exchanges only: destination-sort in this many
+    # independent strips (ops/partition.destination_sort_strips — one
+    # batched sort network of depth ~log^2(cap_in/strips) instead of
+    # ~log^2(cap_in)), served back as `strips` virtual senders by the
+    # reader's run index. 1 = one flat sort. Ignored off the single-shard
+    # plain path (combine/ordered have their own sort semantics; the
+    # multi-shard collective needs device-contiguous send segments).
+    sort_strips: int = 1
     # device combine-by-key (ops/aggregate.py): None, or a COMBINERS entry
     # ("sum"). Applied map-side (before the wire) AND reduce-side (before
     # D2H); needs a numeric value schema, carried here so the jit cache
@@ -77,6 +85,25 @@ class ShufflePlan:
         """Next plan after an overflow: double the receive capacity."""
         import dataclasses
         return dataclasses.replace(self, cap_out=self.cap_out * 2)
+
+    def strips_active(self) -> bool:
+        """True when the single-shard strip-sorted plain path runs —
+        THE activation predicate, shared by the step that writes the
+        layout (reader.step_body) and the resolves that index it
+        (reader/distributed align_chunk): one source, no desync."""
+        return (self.num_shards == 1 and self.sort_strips > 1
+                and not (self.combine or self.ordered)
+                and self.impl != "pallas")
+
+    def strip_rows(self) -> int:
+        """Rows per strip region in the strip-sorted layout (the
+        ``align_chunk`` of the result's run index) — the sorted buffer is
+        ``sort_strips * strip_rows()`` rows. Meaningful only when
+        :meth:`strips_active`. The step statically checks its payload cap
+        equals ``cap_in``, so this host-side derivation and the sort's
+        runtime one provably agree."""
+        s = max(1, min(int(self.sort_strips), self.cap_in))
+        return -(-self.cap_in // s)
 
 
 def make_plan(
@@ -117,6 +144,7 @@ def make_plan(
         impl=conf.a2a_impl,
         partitioner=partitioner,
         sort_impl=conf.sort_impl,
+        sort_strips=conf.sort_strips,
         combine_compaction=conf.combine_compaction,
         bounds=bounds,
     )

@@ -117,6 +117,29 @@ def step_body(plan: ShufflePlan, axis: str):
     def step(payload, nvalid):
         # payload [cap_in, width] int32, col 0 = key_lo; nvalid [1]
         part = part_fn(payload)
+        if plan.strips_active():
+            # single shard, plain: no wire move is needed (the send
+            # buffer IS the delivered buffer), so the whole step is the
+            # sort — and S independent strip sorts batch into ONE
+            # shallower sort network (~log^2(cap/S) depth vs ~log^2(cap);
+            # ops/partition.destination_sort_strips). The reader serves
+            # each partition as S runs via the same multi-sender run
+            # index the flat exchange uses (_RunIndex with
+            # align_chunk=plan.strip_rows()); no overflow is possible
+            # (rows never leave their strip region).
+            from sparkucx_tpu.ops.partition import destination_sort_strips
+            if payload.shape[0] != plan.cap_in:
+                # static trace-time guard: plan.strip_rows() (the resolve
+                # side's align_chunk) derives M from cap_in; the sort
+                # derives it from this cap — they must be the same number
+                raise ValueError(
+                    f"strip path: payload cap {payload.shape[0]} != "
+                    f"plan.cap_in {plan.cap_in}")
+            send, seg, _m = destination_sort_strips(
+                payload, part, nvalid[0], R, plan.sort_strips,
+                key_impl=plan.sort_impl)
+            return (send, seg, nvalid.astype(jnp.int32),
+                    jnp.zeros((1,), jnp.bool_))
         if plan.combine:
             # map-side combine: one row per distinct (partition, key)
             # enters the wire. Its grouping sort is (partition, key) —
@@ -858,6 +881,12 @@ class PendingShuffle(PendingExchangeBase):
             # ordered densify on device and use the normal [1, R] contract
             from sparkucx_tpu.ops.pallas.ragged_a2a import chunk_rows_for
             align_chunk = chunk_rows_for(self._rows_host.shape[2])
+        elif self._plan.strips_active():
+            # strip-sorted single-shard layout: each of the S virtual
+            # senders occupies one strip_rows-sized region (step_body's
+            # strip fast path); the [S, R] seg matrix indexes it with
+            # strip-aligned segment starts
+            align_chunk = self._plan.strip_rows()
         res = LazyShuffleReaderResult(
             R, np.asarray(_blocked_map(R, Pn)), rows_out, seg,
             Pn, cap_shard, self._val_shape, self._val_dtype,
