@@ -128,23 +128,32 @@ def main():
     except Exception as e:
         emit("a2a_n1_segments", error=str(e)[:300])
 
-    # ---- 3. combine compaction A/B at 2M rows ---------------------------
-    try:
-        from sparkucx_tpu.ops.aggregate import combine_rows
+    # ---- 3. combine compaction at 2M rows (STABLE only here) ------------
+    # the 'unstable' variant HUNG the 03:16 window for 25+ min (watchdog
+    # kill; r4_window3.log) — it joins the int8 suspects at the very end
+    def _combine_inputs():
         part64 = jax.device_put(jnp.asarray(
             rng.integers(0, 64, size=rows).astype(np.int32)))
         keys_small = rng.integers(0, 100_000, size=rows, dtype=np.int64)
         rows_np = payload_np.copy()
         rows_np[:, :2] = keys_small.view(np.int32).reshape(-1, 2)
-        rows_dev = jax.device_put(jnp.asarray(rows_np))
-        for comp in ("stable", "unstable"):
-            def step(x, p, c=comp):
-                out, _, _ = combine_rows(x, p, jnp.int32(rows), 64,
-                                         W - 2, np.int32, "sum",
-                                         compaction=c)
-                return x ^ out[0:1, :]
-            ms, deg = diff_time(step, rows_dev, extra=(part64,))
-            report("combine_compaction", ms, deg, variant=comp)
+        return jax.device_put(jnp.asarray(rows_np)), part64
+
+    def _combine_step(comp):
+        from sparkucx_tpu.ops.aggregate import combine_rows
+
+        def step(x, p, c=comp):
+            out, _, _ = combine_rows(x, p, jnp.int32(rows), 64,
+                                     W - 2, np.int32, "sum",
+                                     compaction=c)
+            return x ^ out[0:1, :]
+        return step
+
+    try:
+        rows_dev, part64 = _combine_inputs()
+        ms, deg = diff_time(_combine_step("stable"), rows_dev,
+                            extra=(part64,))
+        report("combine_compaction", ms, deg, variant="stable")
     except Exception as e:
         emit("combine_compaction", error=str(e)[:300])
 
@@ -258,6 +267,15 @@ def main():
     except Exception as e:
         emit("plain_step_n1", impl="auto", sort_impl="multisort8",
              error=str(e)[:300])
+
+    # combine 'unstable' compaction: the 03:16 window's wedge — DEAD LAST
+    try:
+        rows_dev, part64 = _combine_inputs()
+        ms, deg = diff_time(_combine_step("unstable"), rows_dev,
+                            extra=(part64,))
+        report("combine_compaction", ms, deg, variant="unstable")
+    except Exception as e:
+        emit("combine_compaction", variant="unstable", error=str(e)[:300])
 
     emit("done")
     os._exit(0)

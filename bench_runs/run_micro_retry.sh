@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-4 window-3 third stage: re-run the micro_r4 ladder tail that the
+# combine-unstable wedge cost (plain_step impl/sort A/B, pallas_a2a_n1,
+# dest_sort 4-method) — the wedge suspect now runs DEAD LAST. Chained
+# after run_strips_ab.sh. No external kill-timeouts (NOTES_r2).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+TS=$(date +%H%M%S)
+
+echo "== wait for the strips A/B queue to drain =="
+while pgrep -f run_strips_ab.sh > /dev/null; do sleep 60; done
+
+echo "== probe until healthy (up to ~3h) =="
+healthy=0
+for i in $(seq 1 36); do
+    if python - <<'EOF'
+from bench import _tpu_probe_once
+import sys
+rec = _tpu_probe_once(240)
+print(rec, flush=True)
+sys.exit(0 if rec.get("rc") == 0 and rec.get("backend") == "tpu" else 3)
+EOF
+    then healthy=1; break; fi
+    echo "# probe $i unhealthy; sleeping 300s"
+    sleep 300
+done
+if [ "$healthy" != 1 ]; then
+    echo "== tunnel never healed; giving up =="
+    exit 3
+fi
+
+echo "== micro ladder r4 retry (wedge suspect dead last) =="
+python bench_runs/micro_r4.py --watchdog 2400 \
+    | tee "bench_runs/r4_micro_retry_${TS}.jsonl"
+
+echo "== done — commit the artifacts =="

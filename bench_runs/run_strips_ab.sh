@@ -1,0 +1,73 @@
+#!/bin/bash
+# Round-4 window-3 follow-up: after the staged queue (run_when_healthy_r4)
+# drains, measure the strip-sort lever on-chip and A/B it through the
+# official bench. NOTHING here wraps TPU work in an external kill-timeout
+# (NOTES_r2: that wedges the tunnel); every python self-watchdogs.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+TS=$(date +%H%M%S)
+
+echo "== wait for the staged queue to drain =="
+while pgrep -f run_when_healthy_r4.sh > /dev/null; do sleep 60; done
+
+echo "== probe until healthy (up to ~4h) =="
+healthy=0
+for i in $(seq 1 48); do
+    if python - <<'EOF'
+from bench import _tpu_probe_once
+import sys
+rec = _tpu_probe_once(240)
+print(rec, flush=True)
+sys.exit(0 if rec.get("rc") == 0 and rec.get("backend") == "tpu" else 3)
+EOF
+    then healthy=1; break; fi
+    echo "# probe $i unhealthy; sleeping 300s"
+    sleep 300
+done
+if [ "$healthy" != 1 ]; then
+    echo "== tunnel never healed; giving up =="
+    exit 3
+fi
+
+echo "== strip-sort micro sweep (i32 first, i8 suspects last) =="
+python bench_runs/micro_r4b.py --watchdog 1800 \
+    | tee "bench_runs/r4_strips_${TS}.jsonl"
+
+BEST_S=$(python - "bench_runs/r4_strips_${TS}.jsonl" <<'EOF'
+import json, sys
+best, best_ms = 1, None
+for line in open(sys.argv[1]):
+    try:
+        d = json.loads(line)
+    except ValueError:
+        continue
+    if d.get("exp") == "strip_sort" and d.get("key") == "i32" \
+            and not d.get("degenerate") and "ms" in d:
+        if best_ms is None or d["ms"] < best_ms:
+            best, best_ms = d["S"], d["ms"]
+print(best)
+EOF
+)
+echo "== best strip count (i32): ${BEST_S} =="
+
+run_bench() {  # label, extra args...
+    local label=$1; shift
+    local out="bench_runs/r4_tpu_${TS}_${label}.json"
+    if python bench.py --no-fallback --init-retry-s 60 "$@" \
+            | tail -1 | tee "$out"; then
+        echo "saved $out"
+    else
+        mv "$out" "$out.FAILED" 2>/dev/null
+        echo "bench ($label) FAILED — artifact renamed"
+    fi
+}
+
+if [ "${BEST_S}" != 1 ]; then
+    echo "== official bench with the strip lever =="
+    run_bench "strips${BEST_S}" --sort-strips "${BEST_S}"
+fi
+
+echo "== official default run (exchange_small widened-window check) =="
+run_bench default
+
+echo "== done — commit the artifacts =="
