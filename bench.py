@@ -784,11 +784,27 @@ def main() -> None:
     ap.add_argument("--sort-impl", default="auto",
                     help="destination_sort method: auto|argsort|multisort|"
                          "multisort8|counting (A/B the hot path)")
-    ap.add_argument("--sort-strips", type=int, default=1,
+    def _strips_arg(v):
+        # validate at PARSE time: a bad value must not cost the window a
+        # full TPU bring-up before dying without the one JSON line
+        if v == "auto":
+            return v
+        try:
+            n = int(v)
+        except ValueError:
+            raise argparse.ArgumentTypeError(
+                f"--sort-strips wants an int or 'auto', got {v!r}")
+        if not 1 <= n <= 4096:
+            raise argparse.ArgumentTypeError(
+                f"--sort-strips out of range 1..4096: {n}")
+        return n
+
+    ap.add_argument("--sort-strips", default="auto", type=_strips_arg,
                     help="single-shard plain path: destination-sort in N "
                          "independent strips (batched shallower sort "
                          "network; served as N virtual senders). 1 = one "
-                         "flat sort (A/B the n=1 sort denominator)")
+                         "flat sort; auto = the backend's measured "
+                         "default (A/B the n=1 sort denominator)")
     ap.add_argument("--read-mode", default="plain",
                     choices=("plain", "ordered", "combine"),
                     help="exchange flavor for the main stages (combine = "
@@ -871,9 +887,11 @@ def main() -> None:
         print("# --a2a-impl pallas requires a TPU backend (CPU would "
               "interpret); dropping to auto", file=sys.stderr, flush=True)
         args.a2a_impl = None
+    from sparkucx_tpu.shuffle.plan import _resolve_strips
+    strips = _resolve_strips(args.sort_strips, len(devs))
     common = dict(val_words=args.val_words, sort_impl=args.sort_impl,
                   partitions_per_dev=8, read_mode=args.read_mode,
-                  force_impl=args.a2a_impl, sort_strips=args.sort_strips)
+                  force_impl=args.a2a_impl, sort_strips=strips)
     # k1=64/k2=1024: the r4 auto capture went degenerate at 32/288 —
     # with the landed sort levers the small-shape step is ~0.01-0.26 ms,
     # so the window must be ~1000 steps to clear tunneled-dispatch

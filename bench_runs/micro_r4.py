@@ -155,7 +155,7 @@ def main():
                             extra=(part64,))
         report("combine_compaction", ms, deg, variant="stable")
     except Exception as e:
-        emit("combine_compaction", error=str(e)[:300])
+        emit("combine_compaction", variant="stable", error=str(e)[:300])
 
     # ---- 4. the SHIPPED plain step at n=1, impl/sort A/B ----------------
     # NOTE the int8 variants run LAST across the whole ladder: the ms8

@@ -295,16 +295,22 @@ class TpuShuffleConf:
         return v
 
     @property
-    def sort_strips(self) -> int:
+    def sort_strips(self):
         """Single-shard plain exchanges: destination-sort in this many
         independent strips (one batched sort network — depth
         ~log^2(cap/strips) instead of ~log^2(cap)), served as virtual
-        senders by the reader's run index. 1 = one flat sort
-        (ops/partition.destination_sort_strips)."""
-        v = int(self._get("a2a.sortStrips", 1))
+        senders by the reader's run index. 1 = one flat sort; 'auto' =
+        the backend's measured default, resolved at plan time
+        (ops/partition.destination_sort_strips,
+        shuffle/plan.default_sort_strips)."""
+        raw = self._get("a2a.sortStrips", "auto")
+        if raw == "auto":
+            return "auto"
+        v = int(raw)
         if not 1 <= v <= 4096:
             raise ValueError(
-                f"spark.shuffle.tpu.a2a.sortStrips={v}: want 1..4096")
+                f"spark.shuffle.tpu.a2a.sortStrips={v}: want 1..4096 "
+                f"or 'auto'")
         return v
 
     @property

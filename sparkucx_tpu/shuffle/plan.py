@@ -106,6 +106,32 @@ class ShufflePlan:
         return -(-self.cap_in // s)
 
 
+# Measured-best strip counts for the single-shard plain path, by backend
+# (ops/partition.destination_sort_strips; see bench_runs/NOTES_r4.md for
+# the on-chip sweep). Empty entry / unknown backend = 1 (flat sort).
+# Kept as data so a new measurement is a one-line change with a citation.
+_MEASURED_STRIPS: dict = {}
+
+
+def default_sort_strips(backend: str, num_shards: int) -> int:
+    """Resolve ``a2a.sortStrips=auto``: the measured-best strip count for
+    this backend on a single-shard axis, else 1 (the lever only exists on
+    the 1-shard plain path — ShufflePlan.strips_active)."""
+    if num_shards != 1:
+        return 1
+    return int(_MEASURED_STRIPS.get(backend, 1))
+
+
+def _resolve_strips(conf_val, num_shards: int) -> int:
+    """'auto' -> backend-measured default; anything else is already an
+    int (conf validation). jax imported lazily: plan.py stays importable
+    without touching a backend."""
+    if conf_val != "auto":
+        return int(conf_val)
+    import jax
+    return default_sort_strips(jax.default_backend(), num_shards)
+
+
 def make_plan(
     shard_rows: np.ndarray,
     num_shards: int,
@@ -144,7 +170,7 @@ def make_plan(
         impl=conf.a2a_impl,
         partitioner=partitioner,
         sort_impl=conf.sort_impl,
-        sort_strips=conf.sort_strips,
+        sort_strips=_resolve_strips(conf.sort_strips, num_shards),
         combine_compaction=conf.combine_compaction,
         bounds=bounds,
     )
