@@ -716,14 +716,25 @@ def stage_native_aot(mon):
     code = ("import json, os, threading\n"
             "threading.Timer(240, lambda: os._exit(3)).start()\n"
             "from sparkucx_tpu.shuffle.aot import (\n"
-            "    aot_compile_native_step, aot_compile_pallas_step)\n"
+            "    aot_compile_native_step, aot_compile_pallas_step,\n"
+            "    aot_compile_strip_step)\n"
             "rep = aot_compile_native_step(8)\n"
             "try:\n"
             "    p = aot_compile_pallas_step(8)\n"
             "    rep['pallas_step_ok'] = p.get('ok', False)\n"
+            "    if not rep['pallas_step_ok'] and p.get('error'):\n"
+            "        rep['pallas_step_error'] = p['error'][:150]\n"
             "except Exception as e:\n"
             "    rep['pallas_step_ok'] = False\n"
             "    rep['pallas_step_error'] = str(e)[:150]\n"
+            "try:\n"
+            "    s = aot_compile_strip_step()\n"
+            "    rep['strip_step_ok'] = s.get('ok', False)\n"
+            "    if not rep['strip_step_ok'] and s.get('error'):\n"
+            "        rep['strip_step_error'] = s['error'][:150]\n"
+            "except Exception as e:\n"
+            "    rep['strip_step_ok'] = False\n"
+            "    rep['strip_step_error'] = str(e)[:150]\n"
             "print(json.dumps(rep), flush=True)\n"
             "os._exit(0)\n")
     rep = {}
@@ -789,14 +800,16 @@ def main() -> None:
         # full TPU bring-up before dying without the one JSON line
         if v == "auto":
             return v
+        from sparkucx_tpu.shuffle.plan import STRIPS_RANGE
         try:
             n = int(v)
         except ValueError:
             raise argparse.ArgumentTypeError(
                 f"--sort-strips wants an int or 'auto', got {v!r}")
-        if not 1 <= n <= 4096:
+        if not STRIPS_RANGE[0] <= n <= STRIPS_RANGE[1]:
             raise argparse.ArgumentTypeError(
-                f"--sort-strips out of range 1..4096: {n}")
+                f"--sort-strips out of range "
+                f"{STRIPS_RANGE[0]}..{STRIPS_RANGE[1]}: {n}")
         return n
 
     ap.add_argument("--sort-strips", default="auto", type=_strips_arg,
@@ -887,8 +900,8 @@ def main() -> None:
         print("# --a2a-impl pallas requires a TPU backend (CPU would "
               "interpret); dropping to auto", file=sys.stderr, flush=True)
         args.a2a_impl = None
-    from sparkucx_tpu.shuffle.plan import _resolve_strips
-    strips = _resolve_strips(args.sort_strips, len(devs))
+    from sparkucx_tpu.shuffle.plan import resolve_sort_strips
+    strips = resolve_sort_strips(args.sort_strips, len(devs))
     common = dict(val_words=args.val_words, sort_impl=args.sort_impl,
                   partitions_per_dev=8, read_mode=args.read_mode,
                   force_impl=args.a2a_impl, sort_strips=strips)

@@ -306,11 +306,12 @@ class TpuShuffleConf:
         raw = self._get("a2a.sortStrips", "auto")
         if raw == "auto":
             return "auto"
+        from sparkucx_tpu.shuffle.plan import STRIPS_RANGE
         v = int(raw)
-        if not 1 <= v <= 4096:
+        if not STRIPS_RANGE[0] <= v <= STRIPS_RANGE[1]:
             raise ValueError(
-                f"spark.shuffle.tpu.a2a.sortStrips={v}: want 1..4096 "
-                f"or 'auto'")
+                f"spark.shuffle.tpu.a2a.sortStrips={v}: want "
+                f"{STRIPS_RANGE[0]}..{STRIPS_RANGE[1]} or 'auto'")
         return v
 
     @property

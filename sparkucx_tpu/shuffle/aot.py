@@ -29,6 +29,26 @@ TOPOLOGY_CANDIDATES: Tuple[Tuple[str, dict], ...] = (
 )
 
 
+def _resolve_topology(report: dict, topology_name: Optional[str]):
+    """Try the topology candidates most-specific first; return the
+    topology desc or None (report['error'] set). Shared by every AOT
+    proof so the name-spelling fallbacks cannot drift apart."""
+    from jax.experimental import topologies
+    cands = ([(topology_name, {})] if topology_name
+             else list(TOPOLOGY_CANDIDATES))
+    errors = []
+    for name, kwargs in cands:
+        try:
+            topo = topologies.get_topology_desc(
+                name, platform="tpu", **kwargs)
+            report["topology"] = name or str(kwargs)
+            return topo
+        except Exception as e:  # libtpu absent / unknown name spelling
+            errors.append(f"{name or kwargs}: {str(e)[:120]}")
+    report.update(ok=False, error="; ".join(errors))
+    return None
+
+
 def aot_compile_native_step(
     n_devices: int = 8,
     rows_per_shard: int = 1024,
@@ -61,20 +81,8 @@ def aot_compile_native_step(
     from sparkucx_tpu.shuffle.reader import step_body
 
     report: dict = {"devices": n_devices}
-    cands = ([(topology_name, {})] if topology_name
-             else list(TOPOLOGY_CANDIDATES))
-    topo = None
-    errors = []
-    for name, kwargs in cands:
-        try:
-            topo = topologies.get_topology_desc(
-                name, platform="tpu", **kwargs)
-            report["topology"] = name or str(kwargs)
-            break
-        except Exception as e:  # libtpu absent / unknown name spelling
-            errors.append(f"{name or kwargs}: {str(e)[:120]}")
+    topo = _resolve_topology(report, topology_name)
     if topo is None:
-        report.update(ok=False, error="; ".join(errors))
         return report
 
     devs = list(topo.devices)
@@ -168,20 +176,8 @@ def aot_compile_pallas_step(
     from sparkucx_tpu.shuffle.reader import step_body
 
     report: dict = {"devices": n_devices}
-    cands = ([(topology_name, {})] if topology_name
-             else list(TOPOLOGY_CANDIDATES))
-    topo = None
-    errors = []
-    for name, kwargs in cands:
-        try:
-            topo = topologies.get_topology_desc(
-                name, platform="tpu", **kwargs)
-            report["topology"] = name or str(kwargs)
-            break
-        except Exception as e:
-            errors.append(f"{name or kwargs}: {str(e)[:120]}")
+    topo = _resolve_topology(report, topology_name)
     if topo is None:
-        report.update(ok=False, error="; ".join(errors))
         return report
     mesh = topologies.make_mesh(topo, (n_devices,), ("shuffle",))
 
@@ -213,4 +209,73 @@ def aot_compile_pallas_step(
     # an interpreter-baked trace would have no custom call at all
     report["hlo_tpu_custom_call"] = "tpu_custom_call" in txt
     report["ok"] = report["hlo_tpu_custom_call"]
+    return report
+
+
+def aot_compile_strip_step(
+    strips: int = 64,
+    rows: int = 1 << 21,
+    width: int = 10,
+    topology_name: Optional[str] = None,
+) -> dict:
+    """Compile the single-shard STRIP-sorted plain step (a2a.sortStrips,
+    reader.step_body fast path) against one chip of an unattached TPU
+    topology — proof the batched-strip sort program lowers for the chip
+    at the full bench shape even when the tunnel is down.
+
+    The load-bearing bits: the program compiles, carries NO collective
+    (n=1 strips path is pure sort — no ragged-all-to-all, no
+    all-gather), and NO scatter (the counting-sort hazard the n=8 proof
+    pins sort_impl against; histograms are searchsorted differences).
+    Returns {"ok", "topology", "strips", "hlo_sort",
+    "hlo_no_collective", "hlo_no_scatter", "error"?}."""
+    import os
+    os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "true")
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.shuffle.plan import ShufflePlan
+    from sparkucx_tpu.shuffle.reader import step_body
+
+    report: dict = {"strips": strips, "rows": rows}
+    topo = _resolve_topology(report, topology_name)
+    if topo is None:
+        return report
+    mesh = Mesh(np.array(list(topo.devices))[:1], ("shuffle",))
+
+    plan = ShufflePlan(num_shards=1, num_partitions=64,
+                       cap_in=rows, cap_out=rows,
+                       impl="native", sort_impl="multisort",
+                       sort_strips=strips)
+    assert plan.strips_active()
+    step = step_body(plan, "shuffle")
+    sm = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(P("shuffle"), P("shuffle")),
+        out_specs=(P("shuffle"), P(), P("shuffle"), P("shuffle")),
+        check_vma=False)
+    sharding = NamedSharding(mesh, P("shuffle"))
+    args = (
+        jax.ShapeDtypeStruct((rows, width), jnp.int32,
+                             sharding=sharding),
+        jax.ShapeDtypeStruct((1,), jnp.int32, sharding=sharding),
+    )
+    try:
+        txt = jax.jit(sm).lower(*args).compile().as_text().lower()
+    except Exception as e:
+        report.update(ok=False, error=f"compile: {str(e)[:300]}")
+        return report
+    import re
+    report["hlo_sort"] = " sort" in txt or "sort(" in txt
+    report["hlo_no_collective"] = ("all-to-all" not in txt
+                                   and "all-gather" not in txt)
+    # match scatter INSTRUCTIONS (the serializing colliding-index op),
+    # not custom-call names: the batched searchsorted legitimately emits
+    # a tiny "GatherScatterIndicesBitpacked" gather-index helper
+    report["hlo_no_scatter"] = not re.search(r"=\s*[^=\n]*\bscatter\(",
+                                             txt)
+    report["ok"] = bool(report["hlo_sort"] and report["hlo_no_collective"]
+                        and report["hlo_no_scatter"])
     return report

@@ -112,6 +112,10 @@ class ShufflePlan:
 # Kept as data so a new measurement is a one-line change with a citation.
 _MEASURED_STRIPS: dict = {}
 
+# Valid a2a.sortStrips bounds — ONE constant shared by conf validation
+# and bench's parse-time check so the two cannot drift.
+STRIPS_RANGE = (1, 4096)
+
 
 def default_sort_strips(backend: str, num_shards: int) -> int:
     """Resolve ``a2a.sortStrips=auto``: the measured-best strip count for
@@ -122,10 +126,12 @@ def default_sort_strips(backend: str, num_shards: int) -> int:
     return int(_MEASURED_STRIPS.get(backend, 1))
 
 
-def _resolve_strips(conf_val, num_shards: int) -> int:
+def resolve_sort_strips(conf_val, num_shards: int) -> int:
     """'auto' -> backend-measured default; anything else is already an
     int (conf validation). jax imported lazily: plan.py stays importable
-    without touching a backend."""
+    without touching a backend. Public: bench.py resolves its
+    --sort-strips flag through this same path so the bench measures
+    exactly what production make_plan would run."""
     if conf_val != "auto":
         return int(conf_val)
     import jax
@@ -170,7 +176,7 @@ def make_plan(
         impl=conf.a2a_impl,
         partitioner=partitioner,
         sort_impl=conf.sort_impl,
-        sort_strips=_resolve_strips(conf.sort_strips, num_shards),
+        sort_strips=resolve_sort_strips(conf.sort_strips, num_shards),
         combine_compaction=conf.combine_compaction,
         bounds=bounds,
     )

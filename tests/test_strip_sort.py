@@ -197,6 +197,19 @@ def test_manager_e2e_strips(rng):
         node.close()
 
 
+def test_strip_step_aot_proof():
+    """The strip-sorted step lowers for the v5e chip via the local
+    libtpu (no tunnel needed): pure sort — no collective, no scatter
+    (bench_runs/r4_aot_strip_step.json carries the full-shape run).
+    Skips where libtpu/topology construction is unavailable."""
+    from sparkucx_tpu.shuffle.aot import aot_compile_strip_step
+    rep = aot_compile_strip_step(strips=16, rows=1 << 16)
+    if "topology" not in rep:
+        pytest.skip(f"no TPU topology support here: {rep.get('error')}")
+    assert rep["ok"], rep
+    assert rep["hlo_no_collective"] and rep["hlo_no_scatter"]
+
+
 def test_strips_noop_on_multi_shard(rng):
     """sort_strips must be ignored off the 1-shard path: the 8-device
     exchange still returns the flat [P, R] seg contract."""
