@@ -33,4 +33,22 @@ echo "== micro ladder r4 retry (wedge suspect dead last) =="
 python bench_runs/micro_r4.py --watchdog 2400 \
     | tee "bench_runs/r4_micro_retry_${TS}.jsonl"
 
+run_bench() {  # label, extra args...
+    local label=$1; shift
+    local out="bench_runs/r4_tpu_${TS}_${label}.json"
+    if python bench.py --no-fallback --init-retry-s 60 "$@" \
+            | tail -1 | tee "$out"; then
+        echo "saved $out"
+    else
+        mv "$out" "$out.FAILED" 2>/dev/null
+        echo "bench ($label) FAILED — artifact renamed"
+    fi
+}
+
+echo "== official: pallas transport A/B (third attempt) =="
+run_bench pallas --a2a-impl pallas
+
+echo "== official: ms8 at a bounded shape (wedge suspect LAST) =="
+run_bench ms8r20 --sort-impl multisort8 --rows-log2 20
+
 echo "== done — commit the artifacts =="
