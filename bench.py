@@ -214,7 +214,8 @@ def _best_recorded_tpu_run():
     """Best prior ON-CHIP result recorded under bench_runs/ (builder-run
     artifacts committed with the repo), or None. Attached to the fallback
     JSON so a wedged-tunnel round still points at measured TPU numbers."""
-    best = None
+    best_full = None    # headline shape: exchange_full ok at >=1M rows
+    best_any = None     # any recorded on-chip value (small shapes too)
     rundir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_runs")
     try:
@@ -235,11 +236,48 @@ def _best_recorded_tpu_run():
             # one malformed artifact must not crash the wedged-tunnel
             # fallback after the CPU result was already computed
             continue
-        if val > 0 and (best is None or val > best["value"]):
-            best = {"value": val, "unit": rec.get("unit", "GB/s"),
-                    "vs_baseline": rec.get("vs_baseline"),
-                    "artifact": f"bench_runs/{name}"}
-    return best
+        # the full-shape rate comes from the exchange_full STAGE, never
+        # the top-level value: that value is a max over all recording
+        # stages, and a 4K-row exchange_small rate (observed 14.8 GB/s
+        # vs 6.46 full-shape, r3_tpu_010056_ms8.json) would otherwise
+        # masquerade as the contract number. Malformed stage metadata
+        # only disqualifies the headline, not the any-shape fallback.
+        full_val = 0.0
+        try:
+            full = stages.get("exchange_full", {})
+            if (full.get("status") == "ok"
+                    and int(full.get("rows_per_chip") or 0) >= 1 << 21
+                    and not full.get("degenerate_timing")):
+                full_val = float(full.get("GBps_per_chip") or 0)
+                if full_val <= 0 and float(full.get("step_ms") or 0) > 0:
+                    # older artifacts dropped the stage rate when it was
+                    # recorded top-level; reconstruct it from the step
+                    full_val = (int(full["rows_per_chip"])
+                                * int(full["row_bytes"])
+                                / (float(full["step_ms"]) * 1e6))
+        except Exception:
+            full_val = 0.0
+        if val <= 0:
+            continue
+        entry = {"value": val, "unit": rec.get("unit", "GB/s"),
+                 "vs_baseline": rec.get("vs_baseline"),
+                 "artifact": f"bench_runs/{name}"}
+        if best_any is None or val > best_any["value"]:
+            best_any = entry
+        if full_val > 0 and (best_full is None
+                             or full_val > best_full["value"]):
+            best_full = {"value": round(full_val, 3),
+                         "unit": rec.get("unit", "GB/s"),
+                         "vs_baseline": round(full_val / BASELINE_GBPS, 3),
+                         "artifact": f"bench_runs/{name}"}
+    # the HEADLINE pointer is the full-shape number (a 4K-row step's rate
+    # is not comparable to the 2M-row contract); a higher small-shape
+    # value rides along as context instead of displacing it
+    if best_full is None:
+        return best_any
+    if best_any and best_any["value"] > best_full["value"]:
+        best_full = dict(best_full, small_shape_best=best_any)
+    return best_full
 
 
 def _run_fallback(cmd):
@@ -772,8 +810,10 @@ def stage_exchange(mon, jax, name, seconds, native_ok, record=True,
     gbps = info.pop("GBps_per_chip")
     if record:
         mon.record_value(gbps)
-    else:
-        info["GBps_per_chip"] = gbps   # secondary metric: detail only
+    # keep the per-stage rate in the detail either way: the top-level
+    # value is a max over stages, so _best_recorded_tpu_run needs the
+    # stage's OWN rate to rank full-shape runs without small-shape bleed
+    info["GBps_per_chip"] = gbps
     mon.end(name, **info)
 
 
