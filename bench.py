@@ -214,7 +214,7 @@ def _best_recorded_tpu_run():
     """Best prior ON-CHIP result recorded under bench_runs/ (builder-run
     artifacts committed with the repo), or None. Attached to the fallback
     JSON so a wedged-tunnel round still points at measured TPU numbers."""
-    best_full = None    # headline shape: exchange_full ok at >=1M rows
+    best_full = None    # headline: exchange_full ok at >=2M rows (1<<21)
     best_any = None     # any recorded on-chip value (small shapes too)
     rundir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "bench_runs")
@@ -271,12 +271,14 @@ def _best_recorded_tpu_run():
                          "vs_baseline": round(full_val / BASELINE_GBPS, 3),
                          "artifact": f"bench_runs/{name}"}
     # the HEADLINE pointer is the full-shape number (a 4K-row step's rate
-    # is not comparable to the 2M-row contract); a higher small-shape
-    # value rides along as context instead of displacing it
+    # is not comparable to the 2M-row contract); a higher value from any
+    # other shape/stage rides along as context instead of displacing it
+    # (it may be a small-shape rate OR a disqualified full-shape one —
+    # the artifact it names carries the specifics)
     if best_full is None:
         return best_any
     if best_any and best_any["value"] > best_full["value"]:
-        best_full = dict(best_full, small_shape_best=best_any)
+        best_full = dict(best_full, best_any_shape=best_any)
     return best_full
 
 
@@ -807,13 +809,12 @@ def stage_exchange(mon, jax, name, seconds, native_ok, record=True,
     except Exception as e:
         mon.end(name, status="failed", error=str(e)[:300])
         return
-    gbps = info.pop("GBps_per_chip")
+    # the stage rate stays in the detail either way: the top-level value
+    # is a max over stages, so _best_recorded_tpu_run needs the stage's
+    # OWN rate to rank full-shape runs without small-shape bleed
+    gbps = info["GBps_per_chip"]
     if record:
         mon.record_value(gbps)
-    # keep the per-stage rate in the detail either way: the top-level
-    # value is a max over stages, so _best_recorded_tpu_run needs the
-    # stage's OWN rate to rank full-shape runs without small-shape bleed
-    info["GBps_per_chip"] = gbps
     mon.end(name, **info)
 
 
