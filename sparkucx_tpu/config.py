@@ -96,12 +96,33 @@ class TpuShuffleConf:
     # a probable typo and gets a warning (not an error: a host engine may
     # legitimately pass a newer/older key surface through — the reference
     # rides inside SparkConf, which never rejects keys).
-    _EXTERNAL_KEYS = (
-        "a2a.hierarchical", "io.format", "io.keyColumn",
-        "io.stringMaxBytes",
-        "trace.enabled", "trace.device", "trace.capacity",
-        "failure.maxAttempts", "failure.backoffMs")
-    _KEY_FAMILIES = ("fault.",)   # covers fault.seed + per-site arming keys
+    # ONE hand-maintained structure: keys (with their short descriptions)
+    # consumed outside config.py; their full docs live at the use sites.
+    # _EXTERNAL_KEYS and _KEY_FAMILIES derive from it, so adding a key
+    # here both silences the unknown-key warning AND lists it in the
+    # self-describing table — no second copy to drift.
+    _EXTERNAL_KEY_DOCS = {
+        "a2a.hierarchical": "force the two-stage ICI/DCN exchange on a "
+                            "multi-slice mesh (shuffle/hierarchical.py)",
+        "io.format": "shuffle payload codec: raw | arrow | varlen "
+                     "(service.py connect)",
+        "io.keyColumn": "arrow format: which column is the shuffle key "
+                        "(io/arrow.py)",
+        "io.stringMaxBytes": "varlen format: per-string byte cap "
+                             "(io/varlen.py)",
+        "trace.enabled": "turn on the span tracer (utils/trace.py)",
+        "trace.device": "also record device-time spans",
+        "trace.capacity": "tracer ring-buffer size",
+        "failure.maxAttempts": "read-retry budget after device loss "
+                               "(runtime/failures.py)",
+        "failure.backoffMs": "backoff between failure-recovery attempts",
+        "fault.*": "deterministic fault injection: fault.seed + per-site "
+                   "arming keys (runtime/failures.FaultInjector)",
+    }
+    _EXTERNAL_KEYS = tuple(k for k in _EXTERNAL_KEY_DOCS
+                           if not k.endswith("*"))
+    _KEY_FAMILIES = tuple(k[:-1] for k in _EXTERNAL_KEY_DOCS
+                          if k.endswith("*"))  # "fault.*" -> "fault."
 
     def validate(self) -> None:
         """Fail fast on malformed values; warn on unknown namespace keys.
@@ -132,6 +153,42 @@ class TpuShuffleConf:
                 get_logger("config").warning(
                     "unknown conf key %s (typo? known short keys: see "
                     "TpuShuffleConf docstring)", key)
+
+    @classmethod
+    def describe_keys(cls):
+        """One row per conf key — {key, default, property, doc} —
+        generated from the LIVE property surface (the same _get hook
+        validate() uses), so the table cannot drift from the code. The
+        reference self-describes its key surface the same way, through
+        ConfigBuilder doc strings (ref: UcxShuffleConf.scala:25-89)."""
+        conf = cls({}, use_env=False)
+        rows = []
+        for name in cls._TYPED_PROPS:
+            captured = []
+            real_get = conf._get
+
+            def capture(short, default, _c=captured, _g=real_get):
+                _c.append((short, default))
+                return _g(short, default)
+
+            conf.__dict__["_get"] = capture
+            try:
+                getattr(conf, name)
+            except Exception:
+                pass
+            finally:
+                del conf.__dict__["_get"]
+            doc = (getattr(cls, name).__doc__ or "").strip()
+            doc = " ".join(doc.split("\n\n")[0].split())
+            for short, default in captured:
+                rows.append({"key": PREFIX + short,
+                             "default": str(default),
+                             "property": name,
+                             "doc": doc})
+        for short, doc in cls._EXTERNAL_KEY_DOCS.items():
+            rows.append({"key": PREFIX + short, "default": "",
+                         "property": "", "doc": doc})
+        return rows
 
     # -- raw access -------------------------------------------------------
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
@@ -360,10 +417,13 @@ class TpuShuffleConf:
 
     @property
     def mesh_ici_axis(self) -> str:
+        """Mesh axis name for the intra-slice (ICI) shuffle axis."""
         return self._get("mesh.iciAxis", "shuffle")
 
     @property
     def mesh_dcn_axis(self) -> str:
+        """Mesh axis name for the cross-slice (DCN) axis of a
+        multi-slice mesh."""
         return self._get("mesh.dcnAxis", "dcn")
 
     @property
@@ -392,3 +452,14 @@ class TpuShuffleConf:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"TpuShuffleConf({dict(self.items())})"
+
+
+def _print_key_table() -> None:  # pragma: no cover - exercised via CLI
+    rows = TpuShuffleConf.describe_keys()
+    w = max(len(r["key"]) for r in rows)
+    dw = max(len(r["default"]) for r in rows)
+    print(f"{'key':<{w}}  {'default':<{dw}}  description")
+    print("-" * (w + dw + 60))
+    for r in rows:
+        print(f"{r['key']:<{w}}  {r['default']:<{dw}}  {r['doc']}")
+

@@ -114,3 +114,29 @@ def test_combine_compaction_conf_threads_to_plan():
         TpuShuffleConf(
             {"spark.shuffle.tpu.a2a.combineCompaction": "bogus"},
             use_env=False)
+
+
+def test_describe_keys_covers_live_surface():
+    """The self-describing key table (python -m sparkucx_tpu.config) is
+    generated from the live property surface: every typed property
+    contributes >=1 documented key, external keys ride along, and no doc
+    is empty — the reference's self-describing ConfigBuilder surface
+    (ref: UcxShuffleConf.scala:25-89)."""
+    from sparkucx_tpu.config import PREFIX, TpuShuffleConf
+    rows = TpuShuffleConf.describe_keys()
+    by_prop = {r["property"] for r in rows if r["property"]}
+    assert by_prop == set(TpuShuffleConf._TYPED_PROPS)
+    keys = {r["key"] for r in rows}
+    assert f"{PREFIX}a2a.sortStrips" in keys
+    assert f"{PREFIX}fault.*" in keys
+    for r in rows:
+        assert r["key"].startswith(PREFIX)
+        assert r["doc"].strip(), f"undocumented conf key {r['key']}"
+    # table printing works end to end
+    import io
+    from contextlib import redirect_stdout
+    from sparkucx_tpu.config import _print_key_table
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        _print_key_table()
+    assert "a2a.sortStrips" in buf.getvalue()
