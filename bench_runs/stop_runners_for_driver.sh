@@ -1,0 +1,23 @@
+#!/bin/bash
+# Drain guard for the driver's end-of-round bench capture: at the given
+# UTC time, SIGTERM the chained runner SHELLS (run_strips_ab.sh /
+# run_micro_retry.sh) so no NEW TPU stage launches — but never their
+# in-flight python children: killing a client mid-compile wedges the
+# tunnel (NOTES_r2), and every child self-watchdogs (<=40 min), so the
+# chip drains on its own well before the driver runs bench.py.
+set -u
+STOP_AT_EPOCH=${1:?usage: stop_runners_for_driver.sh <epoch-seconds>}
+now=$(date +%s)
+wait_s=$((STOP_AT_EPOCH - now))
+if [ "$wait_s" -gt 0 ]; then
+    echo "draining runners in ${wait_s}s ($(date -u -d @${STOP_AT_EPOCH} 2>/dev/null || true))"
+    sleep "$wait_s"
+fi
+for script in run_strips_ab.sh run_micro_retry.sh run_when_healthy_r4.sh; do
+    pids=$(pgrep -f "bash .*${script}" || true)
+    if [ -n "$pids" ]; then
+        echo "terminating $script shell(s): $pids (children drain on own watchdogs)"
+        kill $pids 2>/dev/null || true
+    fi
+done
+echo "drain guard done at $(date -u)"
