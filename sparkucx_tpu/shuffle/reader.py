@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import os
+import time
 from typing import Optional, Tuple
 
 import jax
@@ -576,6 +577,16 @@ class ShuffleReaderResult:
         for r in range(self.num_partitions):
             yield r, self.partition(r)
 
+    def partitions_ready(self, poll_s: float = 0.002):
+        """Yield every (r, (keys, values)) exactly once, in ARRIVAL
+        order where the layout supports it — the reference's
+        deliver-blocks-as-they-arrive iterator (reducers consume
+        whichever block completes first,
+        ref: compat/spark_3_0/UcxShuffleReader.scala:56-98,
+        reducer/OnBlocksFetchCallback.java:45-53). On a host-resident
+        result everything is already 'arrived': index order."""
+        yield from self.partitions()
+
 
 class LazyShuffleReaderResult(ShuffleReaderResult):
     """Result view over ON-DEVICE arrays with per-shard streaming D2H.
@@ -661,6 +672,42 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
                 # the HBM is free for the next shuffle's exchange
                 self._rows_dev = None
         return got
+
+    def partitions_ready(self, poll_s: float = 0.002):
+        """Arrival-order iteration: shards whose transfer already
+        completed yield their partitions first (polled via the array's
+        non-blocking ``is_ready``), so a slow shard never head-of-line
+        blocks the consumer — the reference's reducers likewise consume
+        whichever remote's blocks complete first
+        (ref: reducer/OnBlocksFetchCallback.java:45-53). Partition
+        granularity transfers on demand (arrival order has no meaning
+        there): index order."""
+        if self._rows_dev is None or self.fetch_granularity == "partition":
+            yield from self.partitions()
+            return
+        pending = {}
+        for s in range(self._num_shards):
+            # already-host shards are trivially ready (dev=None marker)
+            pending[s] = None if s in self._shards else self._shard_dev(s)
+        while pending:
+            progressed = False
+            for s, dev in list(pending.items()):
+                try:
+                    ready = dev is None or bool(dev.is_ready())
+                except AttributeError:   # no readiness API: don't stall
+                    ready = True
+                if ready:
+                    del pending[s]
+                    progressed = True
+                    # blocked map is sorted (same invariant _runs uses)
+                    r_lo = int(np.searchsorted(self._part_to_shard, s,
+                                               "left"))
+                    r_hi = int(np.searchsorted(self._part_to_shard, s,
+                                               "right"))
+                    for r in range(r_lo, r_hi):
+                        yield r, self.partition(r)
+            if pending and not progressed:
+                time.sleep(poll_s)
 
     def _partition_block(self, r: int, shard: int) -> np.ndarray:
         if self.fetch_granularity != "partition" \

@@ -666,3 +666,63 @@ def test_fetch_granularity_partition_releases_device_buffers(
     for a, b in zip(first, again):
         np.testing.assert_array_equal(a, b)
     m.unregister_shuffle(961)
+
+
+def test_partitions_ready_arrival_order(manager_factory, rng):
+    """partitions_ready(): a slow shard must not head-of-line block —
+    partitions of already-transferred shards come first; every
+    partition still arrives exactly once with correct content (the
+    reference's deliver-blocks-as-they-arrive iterator,
+    ref: OnBlocksFetchCallback.java:45-53)."""
+    m = manager_factory()
+    R, M = 16, 4
+    h = m.register_shuffle(975, M, R)
+    allk = []
+    for mid in range(M):
+        k = rng.integers(0, 1 << 31, size=200).astype(np.int64)
+        w = m.get_writer(h, mid)
+        w.write(k)
+        w.commit(R)
+        allk.append(k)
+    res = m.read(h)
+
+    # wrap shard 0's device array: is_ready() stays False until shard
+    # 1's rows were consumed, proving the iterator reorders around it
+    consumed = []
+
+    class _SlowDev:
+        def __init__(self, real):
+            self._real = real
+            self.shape = real.shape
+
+        def is_ready(self):
+            return 1 in consumed
+
+        def __array__(self, dtype=None, copy=None):
+            return np.asarray(self._real)
+
+    real_shard_dev = res._shard_dev
+
+    def patched(shard):
+        dev = real_shard_dev(shard)
+        if shard == 0 and dev is not None:
+            return _SlowDev(dev)
+        return dev
+
+    res._shard_dev = patched
+    order = []
+    got = {}
+    for r, (k, v) in res.partitions_ready(poll_s=0.001):
+        shard = int(res._part_to_shard[r])
+        if shard not in consumed:
+            consumed.append(shard)
+        order.append(r)
+        got[r] = k
+    assert sorted(order) == list(range(R)), "every partition exactly once"
+    slow_rs = np.nonzero(np.asarray(res._part_to_shard) == 0)[0].tolist()
+    assert order[-len(slow_rs):] == slow_rs, \
+        f"slow shard 0's partitions must arrive last, got {order}"
+    all_sorted = np.sort(np.concatenate([got[r] for r in range(R)]))
+    np.testing.assert_array_equal(
+        all_sorted, np.sort(np.concatenate(allk)))
+    m.unregister_shuffle(975)
