@@ -68,25 +68,88 @@ def varbytes_words(max_bytes: int) -> int:
     return varbytes_width(max_bytes) // 4
 
 
+def _native_varbytes_call(fn_name: str, src: np.ndarray,
+                          starts: np.ndarray, dst: np.ndarray,
+                          n: int, width: int) -> bool:
+    """Invoke sxt_pack_varbytes / sxt_unpack_varbytes; False -> caller
+    runs the numpy path (library unavailable or the call refused)."""
+    import ctypes
+    import os
+    if os.environ.get("SPARKUCX_TPU_NO_NATIVE") == "1":
+        return False
+    from sparkucx_tpu import native
+    lib = native.load()
+    if lib is None:
+        return False
+    assert starts.dtype == np.int64 and starts.flags.c_contiguous
+    fn = getattr(lib, fn_name)
+    rc = fn(src.ctypes.data if src.size else None,
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            dst.ctypes.data, n, width, os.cpu_count() or 1)
+    return rc == 0
+
+
+def _blob_starts(data: List[bytes]) -> Tuple[np.ndarray, np.ndarray,
+                                             np.ndarray]:
+    """(blob uint8 [total], starts int64 [n+1], lens int64 [n]) — the
+    Arrow-style layout both the numpy scatter and the native kernels
+    consume. The b"".join runs at C speed; no per-item numpy work."""
+    n = len(data)
+    lens = np.fromiter(map(len, data), dtype=np.int64, count=n)
+    starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=starts[1:])
+    blob = (np.frombuffer(b"".join(data), dtype=np.uint8)
+            if starts[-1] else np.zeros(0, np.uint8))
+    return blob, starts, lens
+
+
+def _scatter_to_rows(blob: np.ndarray, starts: np.ndarray,
+                     lens: np.ndarray, out: np.ndarray,
+                     col_base: int) -> None:
+    """One fancy-indexed scatter: blob byte k lands at
+    ``out[row(k), col_base + (k - starts[row])]`` — the shared numpy
+    fallback of the native row-wise kernels."""
+    total = int(starts[-1])
+    if not total:
+        return
+    n = lens.shape[0]
+    row_ix = np.repeat(np.arange(n, dtype=np.int64), lens)
+    col_ix = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1], lens)
+    out[row_ix, col_base + col_ix] = blob
+
+
 def pack_varbytes(items: Sequence[Item], max_bytes: int) -> np.ndarray:
     """Encode items as [n, varbytes_width(max_bytes)] uint8 rows.
 
     Raises when any item exceeds ``max_bytes`` — silent truncation would
     corrupt records, which the reference's byte-range transport can never
-    do."""
+    do.
+
+    Hot path: one blob + prefix offsets (C-speed join), then the native
+    threaded row-wise pack (``sxt_pack_varbytes`` — the varlen sibling
+    of the fixed-row ``sxt_pack_rows``); numpy fallback is a single
+    fancy-indexed scatter (``np.repeat`` maps blob byte k to its
+    (row, col) slot — measured 4.2x the old per-item loop at 200k short
+    strings). Bit-identical either way (pinned by test)."""
     data = _as_bytes_list(items)
     width = varbytes_width(max_bytes)
-    out = np.zeros((len(data), width), dtype=np.uint8)
-    for i, b in enumerate(data):
-        n = len(b)
-        if n > max_bytes:
-            raise ValueError(
-                f"item {i} is {n} B > declared max_bytes={max_bytes}; "
-                f"raise the ceiling (records are never truncated)")
-        out[i, :4] = np.frombuffer(
-            np.int32(n).tobytes(), dtype=np.uint8)
-        if n:
-            out[i, 4:4 + n] = np.frombuffer(b, dtype=np.uint8)
+    n = len(data)
+    if n == 0:
+        return np.zeros((0, width), dtype=np.uint8)
+    blob, starts, lens = _blob_starts(data)
+    if lens.max(initial=0) > max_bytes:
+        i = int(np.argmax(lens))
+        raise ValueError(
+            f"item {i} is {int(lens[i])} B > declared "
+            f"max_bytes={max_bytes}; raise the ceiling (records are "
+            f"never truncated)")
+    out = np.empty((n, width), dtype=np.uint8)
+    if _native_varbytes_call("sxt_pack_varbytes", blob, starts, out,
+                             n, width):
+        return out
+    out[:] = 0
+    out[:, :4] = lens.astype("<i4").view(np.uint8).reshape(n, 4)
+    _scatter_to_rows(blob, starts, lens, out, col_base=4)
     return out
 
 
@@ -97,16 +160,33 @@ def unpack_varbytes(rows: np.ndarray) -> List[bytes]:
         rows = rows.view(np.uint8).reshape(rows.shape[0], -1)
     if rows.ndim != 2 or rows.shape[1] < 4:
         raise ValueError(f"varbytes rows must be [n, >=4], got {rows.shape}")
-    lens = rows[:, :4].copy().view(np.int32).reshape(-1)
+    lens = rows[:, :4].copy().view(np.int32).reshape(-1).astype(np.int64)
     limit = rows.shape[1] - 4
-    out = []
-    for i, n in enumerate(lens):
-        n = int(n)
-        if n < 0 or n > limit:
-            raise ValueError(
-                f"row {i}: corrupt varbytes length {n} (row width {limit})")
-        out.append(rows[i, 4:4 + n].tobytes())
-    return out
+    bad = (lens < 0) | (lens > limit)
+    if bad.any():
+        i = int(np.argmax(bad))
+        raise ValueError(
+            f"row {i}: corrupt varbytes length {int(lens[i])} "
+            f"(row width {limit})")
+    # gather every row's live bytes into one blob (native threaded
+    # memcpy, or one numpy fancy-index), then per-item bytes() slicing
+    # off it — the list materialization is the only per-item work left
+    n = rows.shape[0]
+    total = int(lens.sum())
+    if n == 0 or total == 0:
+        return [b""] * n if n else []
+    starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=starts[1:])
+    blob_arr = np.empty(total, dtype=np.uint8)
+    # rows is already C-contiguous (ascontiguousarray at entry)
+    if not _native_varbytes_call("sxt_unpack_varbytes", rows, starts,
+                                 blob_arr, n, rows.shape[1]):
+        row_ix = np.repeat(np.arange(n, dtype=np.int64), lens)
+        col_ix = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1],
+                                                              lens)
+        blob_arr = rows[row_ix, 4 + col_ix]
+    blob = blob_arr.tobytes()
+    return [blob[int(s):int(e)] for s, e in zip(starts[:-1], starts[1:])]
 
 
 _FNV_OFFSET = np.uint64(0xCBF29CE484222325)
@@ -124,12 +204,10 @@ def hash_bytes64(items: Sequence[Item]) -> np.ndarray:
     n = len(data)
     if n == 0:
         return np.zeros(0, dtype=np.int64)
-    lens = np.fromiter((len(b) for b in data), dtype=np.int64, count=n)
-    width = max(1, int(lens.max()))
+    blob, starts, lens = _blob_starts(data)
+    width = max(1, int(lens.max(initial=0)))
     mat = np.zeros((n, width), dtype=np.uint8)
-    for i, b in enumerate(data):
-        if b:
-            mat[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+    _scatter_to_rows(blob, starts, lens, mat, col_base=0)
     h = np.full(n, _FNV_OFFSET, dtype=np.uint64)
     with np.errstate(over="ignore"):
         for j in range(width):

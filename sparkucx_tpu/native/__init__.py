@@ -77,6 +77,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sxt_munmap.restype = ctypes.c_int
     lib.sxt_pack_rows.argtypes = [p, p, p, u64, u64, u64, ctypes.c_int]
     lib.sxt_pack_rows.restype = ctypes.c_int
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.sxt_pack_varbytes.argtypes = [p, i64p, p, u64, u64, ctypes.c_int]
+    lib.sxt_pack_varbytes.restype = ctypes.c_int
+    lib.sxt_unpack_varbytes.argtypes = [p, i64p, p, u64, u64, ctypes.c_int]
+    lib.sxt_unpack_varbytes.restype = ctypes.c_int
     return lib
 
 

@@ -298,3 +298,32 @@ def test_service_raw_combine_sum_words(mesh8):
                 np.ascontiguousarray(v))
             got.update(dict(zip(items, counts.tolist())))
         assert got == {b"x": 3, b"yy": 2, b"zzz": 1}
+
+
+class TestNativeVarbytes:
+    """Native sxt_pack_varbytes/sxt_unpack_varbytes vs the numpy path —
+    bit-identical (the same contract TestNativePack pins for the
+    fixed-row sibling)."""
+
+    def test_native_matches_numpy_bit_identical(self, rng, monkeypatch):
+        from sparkucx_tpu import native
+        if native.load() is None:
+            import pytest
+            pytest.skip("native library unavailable")
+        items = [bytes(rng.integers(0, 256, size=int(l)).astype(np.uint8))
+                 for l in rng.integers(0, 64, size=5000)]
+        # edges: empty, NULs, one byte, and EXACTLY max_bytes (zero pad
+        # tail — the native `len > width - 4` check at its limit)
+        items += [b"", b"\x00" * 63, b"x", b"\xff" * 64]
+        native_rows = pack_varbytes(items, 64)
+        monkeypatch.setenv("SPARKUCX_TPU_NO_NATIVE", "1")
+        numpy_rows = pack_varbytes(items, 64)
+        np.testing.assert_array_equal(native_rows, numpy_rows)
+        assert unpack_varbytes(numpy_rows) == items
+        monkeypatch.delenv("SPARKUCX_TPU_NO_NATIVE")
+        assert unpack_varbytes(native_rows) == items
+
+    def test_native_oversize_still_raises(self, rng):
+        import pytest
+        with pytest.raises(ValueError, match="never truncated"):
+            pack_varbytes([b"x" * 100], 64)
