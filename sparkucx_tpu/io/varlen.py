@@ -68,6 +68,16 @@ def varbytes_words(max_bytes: int) -> int:
     return varbytes_width(max_bytes) // 4
 
 
+def _native_lib():
+    """The gated native library, or None — ONE place owns the
+    SPARKUCX_TPU_NO_NATIVE check and load for every varlen kernel."""
+    import os
+    if os.environ.get("SPARKUCX_TPU_NO_NATIVE") == "1":
+        return None
+    from sparkucx_tpu import native
+    return native.load()
+
+
 def _native_varbytes_call(fn_name: str, src: np.ndarray,
                           starts: np.ndarray, dst: np.ndarray,
                           n: int, width: int) -> bool:
@@ -75,10 +85,7 @@ def _native_varbytes_call(fn_name: str, src: np.ndarray,
     runs the numpy path (library unavailable or the call refused)."""
     import ctypes
     import os
-    if os.environ.get("SPARKUCX_TPU_NO_NATIVE") == "1":
-        return False
-    from sparkucx_tpu import native
-    lib = native.load()
+    lib = _native_lib()
     if lib is None:
         return False
     assert starts.dtype == np.int64 and starts.flags.c_contiguous
@@ -103,18 +110,27 @@ def _blob_starts(data: List[bytes]) -> Tuple[np.ndarray, np.ndarray,
     return blob, starts, lens
 
 
+def _gather_indices(starts: np.ndarray,
+                    lens: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """(row_ix, col_ix) mapping blob byte k to its row and in-row
+    column — the ONE copy of the index math both the scatter (pack) and
+    gather (unpack) fallbacks use."""
+    n = lens.shape[0]
+    total = int(starts[-1])
+    row_ix = np.repeat(np.arange(n, dtype=np.int64), lens)
+    col_ix = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1], lens)
+    return row_ix, col_ix
+
+
 def _scatter_to_rows(blob: np.ndarray, starts: np.ndarray,
                      lens: np.ndarray, out: np.ndarray,
                      col_base: int) -> None:
     """One fancy-indexed scatter: blob byte k lands at
     ``out[row(k), col_base + (k - starts[row])]`` — the shared numpy
     fallback of the native row-wise kernels."""
-    total = int(starts[-1])
-    if not total:
+    if not int(starts[-1]):
         return
-    n = lens.shape[0]
-    row_ix = np.repeat(np.arange(n, dtype=np.int64), lens)
-    col_ix = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1], lens)
+    row_ix, col_ix = _gather_indices(starts, lens)
     out[row_ix, col_base + col_ix] = blob
 
 
@@ -160,7 +176,9 @@ def unpack_varbytes(rows: np.ndarray) -> List[bytes]:
         rows = rows.view(np.uint8).reshape(rows.shape[0], -1)
     if rows.ndim != 2 or rows.shape[1] < 4:
         raise ValueError(f"varbytes rows must be [n, >=4], got {rows.shape}")
-    lens = rows[:, :4].copy().view(np.int32).reshape(-1).astype(np.int64)
+    # explicit LE read — the wire contract, matching both pack paths
+    lens = rows[:, :4].copy().view(np.dtype("<i4")).reshape(-1) \
+        .astype(np.int64)
     limit = rows.shape[1] - 4
     bad = (lens < 0) | (lens > limit)
     if bad.any():
@@ -181,9 +199,7 @@ def unpack_varbytes(rows: np.ndarray) -> List[bytes]:
     # rows is already C-contiguous (ascontiguousarray at entry)
     if not _native_varbytes_call("sxt_unpack_varbytes", rows, starts,
                                  blob_arr, n, rows.shape[1]):
-        row_ix = np.repeat(np.arange(n, dtype=np.int64), lens)
-        col_ix = np.arange(total, dtype=np.int64) - np.repeat(starts[:-1],
-                                                              lens)
+        row_ix, col_ix = _gather_indices(starts, lens)
         blob_arr = rows[row_ix, 4 + col_ix]
     blob = blob_arr.tobytes()
     return [blob[int(s):int(e)] for s, e in zip(starts[:-1], starts[1:])]
@@ -205,6 +221,18 @@ def hash_bytes64(items: Sequence[Item]) -> np.ndarray:
     if n == 0:
         return np.zeros(0, dtype=np.int64)
     blob, starts, lens = _blob_starts(data)
+    out = np.empty(n, dtype=np.int64)
+    import ctypes
+    import os
+    lib = _native_lib()
+    if lib is not None:
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        rc = lib.sxt_hash_varbytes(
+            blob.ctypes.data if blob.size else None,
+            starts.ctypes.data_as(i64p),
+            out.ctypes.data_as(i64p), n, os.cpu_count() or 1)
+        if rc == 0:
+            return out
     width = max(1, int(lens.max(initial=0)))
     mat = np.zeros((n, width), dtype=np.uint8)
     _scatter_to_rows(blob, starts, lens, mat, col_base=0)

@@ -82,6 +82,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.sxt_pack_varbytes.restype = ctypes.c_int
     lib.sxt_unpack_varbytes.argtypes = [p, i64p, p, u64, u64, ctypes.c_int]
     lib.sxt_unpack_varbytes.restype = ctypes.c_int
+    lib.sxt_hash_varbytes.argtypes = [p, i64p, i64p, u64, ctypes.c_int]
+    lib.sxt_hash_varbytes.restype = ctypes.c_int
     return lib
 
 

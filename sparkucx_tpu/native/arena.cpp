@@ -282,8 +282,13 @@ static void vb_pack_range(const uint8_t* blob, const int64_t* starts,
       err->store(-1);
       len = 0;
     }
-    const int32_t l32 = static_cast<int32_t>(len);
-    std::memcpy(row, &l32, 4);
+    // explicit little-endian length prefix — the wire contract
+    // (io/varlen.py docstring) must hold regardless of host endianness
+    const uint32_t l32 = static_cast<uint32_t>(len);
+    row[0] = static_cast<uint8_t>(l32);
+    row[1] = static_cast<uint8_t>(l32 >> 8);
+    row[2] = static_cast<uint8_t>(l32 >> 16);
+    row[3] = static_cast<uint8_t>(l32 >> 24);
     if (len) std::memcpy(row + 4, blob + starts[i], static_cast<size_t>(len));
     const uint64_t tail = width - 4 - static_cast<uint64_t>(len);
     if (tail) std::memset(row + 4 + len, 0, tail);
@@ -346,6 +351,24 @@ int sxt_unpack_varbytes(const void* rows, const int64_t* starts,
   uint8_t* b = static_cast<uint8_t*>(blob_out);
   vb_fan_out(n, n * width, nthreads, [&](uint64_t lo, uint64_t hi) {
     vb_unpack_range(r, starts, b, width, lo, hi);
+  });
+  return 0;
+}
+
+// FNV-1a 64-bit per item over (blob, starts) — the routing/grouping hash
+// of io/varlen.hash_bytes64, byte-for-byte the same algorithm (pinned by
+// test): h = 0xCBF29CE484222325; h = (h ^ byte) * 0x100000001B3.
+int sxt_hash_varbytes(const void* blob, const int64_t* starts,
+                      int64_t* hashes_out, uint64_t n, int nthreads) {
+  const uint8_t* b = static_cast<const uint8_t*>(blob);
+  const uint64_t total = n ? static_cast<uint64_t>(starts[n]) : 0;
+  vb_fan_out(n, total, nthreads, [&](uint64_t lo, uint64_t hi) {
+    for (uint64_t i = lo; i < hi; ++i) {
+      uint64_t h = 0xCBF29CE484222325ull;
+      for (int64_t k = starts[i]; k < starts[i + 1]; ++k)
+        h = (h ^ b[k]) * 0x100000001B3ull;
+      hashes_out[i] = static_cast<int64_t>(h);
+    }
   });
   return 0;
 }

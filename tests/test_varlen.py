@@ -327,3 +327,16 @@ class TestNativeVarbytes:
         import pytest
         with pytest.raises(ValueError, match="never truncated"):
             pack_varbytes([b"x" * 100], 64)
+
+    def test_native_hash_matches_numpy(self, rng, monkeypatch):
+        from sparkucx_tpu import native
+        if native.load() is None:
+            import pytest
+            pytest.skip("native library unavailable")
+        items = [bytes(rng.integers(0, 256, size=int(l)).astype(np.uint8))
+                 for l in rng.integers(0, 48, size=3000)]
+        items += [b"", b"\x00", b"\xff" * 200]   # incl. > short widths
+        h_native = hash_bytes64(items)
+        monkeypatch.setenv("SPARKUCX_TPU_NO_NATIVE", "1")
+        h_numpy = hash_bytes64(items)
+        np.testing.assert_array_equal(h_native, h_numpy)
