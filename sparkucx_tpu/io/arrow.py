@@ -59,18 +59,42 @@ def _narrow_bits(carrier: np.ndarray, dtype: np.dtype) -> np.ndarray:
     return np.ascontiguousarray(carrier).view(np.float64).astype(dtype)
 
 
+def _arrow_blob_starts(col: "pa.Array"):
+    """(blob uint8, starts int64 [n+1], lens int64 [n]) VIEWS over an
+    Arrow string/binary array's own (offsets, data) buffers — the
+    columnar layout IS the varlen codec's input layout, so encoding
+    skips ``to_pylist`` and every per-item Python object entirely.
+    Handles sliced arrays (col.offset) by re-basing to starts[0] == 0."""
+    bufs = col.buffers()                      # [validity, offsets, data]
+    if len(col) == 0 or bufs[1] is None:
+        # zero-length arrays may legally carry a NULL offsets buffer
+        # (C-data-interface producers do) — encode as the empty column
+        return (np.zeros(0, np.uint8), np.zeros(1, np.int64),
+                np.zeros(0, np.int64))
+    off_dt = np.int64 if (pa.types.is_large_string(col.type)
+                          or pa.types.is_large_binary(col.type)) \
+        else np.int32
+    offsets = np.frombuffer(bufs[1], dtype=off_dt)[
+        col.offset:col.offset + len(col) + 1].astype(np.int64)
+    data = (np.frombuffer(bufs[2], dtype=np.uint8)
+            if bufs[2] is not None else np.zeros(0, np.uint8))
+    blob = data[int(offsets[0]):int(offsets[-1])]
+    starts = offsets - offsets[0]
+    return blob, starts, np.diff(offsets)
+
+
 def _encode_varlen_col(col: "pa.Array", name: str,
                        max_bytes: int) -> Tuple[np.ndarray, tuple]:
     """String/binary column -> [n, lanes] int64 varlen carrier + recipe."""
-    from sparkucx_tpu.io.varlen import pack_varbytes
+    from sparkucx_tpu.io.varlen import pack_varbytes_blob
     if col.null_count:
         raise ValueError(
             f"column {name!r} has {col.null_count} nulls; varlen shuffle "
             f"carries exact bytes — fill or drop nulls first")
     kind = "utf8" if pa.types.is_string(col.type) \
         or pa.types.is_large_string(col.type) else "binary"
-    items = col.to_pylist()
-    packed = pack_varbytes(items, max_bytes)          # [n, 4+pad4(max)]
+    blob, starts, lens = _arrow_blob_starts(col)
+    packed = pack_varbytes_blob(blob, starts, lens, max_bytes)
     lanes = _varlen_lanes(max_bytes)
     padded = np.zeros((packed.shape[0], lanes * 8), np.uint8)
     padded[:, :packed.shape[1]] = packed

@@ -34,7 +34,7 @@ read uncombined and aggregate host-side by exact bytes.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple, Union
+from typing import List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -80,9 +80,12 @@ def _native_lib():
 
 def _native_varbytes_call(fn_name: str, src: np.ndarray,
                           starts: np.ndarray, dst: np.ndarray,
-                          n: int, width: int) -> bool:
-    """Invoke sxt_pack_varbytes / sxt_unpack_varbytes; False -> caller
-    runs the numpy path (library unavailable or the call refused)."""
+                          n: int, width: Optional[int] = None) -> bool:
+    """Invoke one of the (blob, starts) native kernels —
+    sxt_pack_varbytes / sxt_unpack_varbytes (``width`` set) /
+    sxt_hash_varbytes (``width`` None); False -> caller runs the numpy
+    path (library unavailable or the call refused). ONE copy of the
+    env-gate, null-blob-pointer, thread-count and rc marshalling."""
     import ctypes
     import os
     lib = _native_lib()
@@ -90,10 +93,16 @@ def _native_varbytes_call(fn_name: str, src: np.ndarray,
         return False
     assert starts.dtype == np.int64 and starts.flags.c_contiguous
     fn = getattr(lib, fn_name)
-    rc = fn(src.ctypes.data if src.size else None,
-            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            dst.ctypes.data, n, width, os.cpu_count() or 1)
-    return rc == 0
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    args = [src.ctypes.data if src.size else None,
+            starts.ctypes.data_as(i64p),
+            dst.ctypes.data_as(i64p) if dst.dtype == np.int64
+            else dst.ctypes.data,
+            n]
+    if width is not None:
+        args.append(width)
+    args.append(os.cpu_count() or 1)
+    return fn(*args) == 0
 
 
 def _blob_starts(data: List[bytes]) -> Tuple[np.ndarray, np.ndarray,
@@ -148,17 +157,32 @@ def pack_varbytes(items: Sequence[Item], max_bytes: int) -> np.ndarray:
     (row, col) slot — measured 4.2x the old per-item loop at 200k short
     strings). Bit-identical either way (pinned by test)."""
     data = _as_bytes_list(items)
+    if not data:
+        return np.zeros((0, varbytes_width(max_bytes)), dtype=np.uint8)
+    blob, starts, lens = _blob_starts(data)
+    return pack_varbytes_blob(blob, starts, lens, max_bytes)
+
+
+def pack_varbytes_blob(blob: np.ndarray, starts: np.ndarray,
+                       lens: np.ndarray, max_bytes: int) -> np.ndarray:
+    """Core of :func:`pack_varbytes` over the (blob, starts, lens)
+    layout directly — the zero-copy entry for callers that already hold
+    it (Arrow string/binary columns store exactly these buffers,
+    io/arrow._encode_varlen_col). Contract: ``starts[0] == 0``,
+    ``len(blob) == starts[-1]``, ``lens == np.diff(starts)`` (a sliced
+    Arrow array must be re-based by the caller)."""
     width = varbytes_width(max_bytes)
-    n = len(data)
+    n = lens.shape[0]
     if n == 0:
         return np.zeros((0, width), dtype=np.uint8)
-    blob, starts, lens = _blob_starts(data)
     if lens.max(initial=0) > max_bytes:
         i = int(np.argmax(lens))
         raise ValueError(
             f"item {i} is {int(lens[i])} B > declared "
             f"max_bytes={max_bytes}; raise the ceiling (records are "
             f"never truncated)")
+    blob = np.ascontiguousarray(blob)
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
     out = np.empty((n, width), dtype=np.uint8)
     if _native_varbytes_call("sxt_pack_varbytes", blob, starts, out,
                              n, width):
@@ -222,17 +246,8 @@ def hash_bytes64(items: Sequence[Item]) -> np.ndarray:
         return np.zeros(0, dtype=np.int64)
     blob, starts, lens = _blob_starts(data)
     out = np.empty(n, dtype=np.int64)
-    import ctypes
-    import os
-    lib = _native_lib()
-    if lib is not None:
-        i64p = ctypes.POINTER(ctypes.c_int64)
-        rc = lib.sxt_hash_varbytes(
-            blob.ctypes.data if blob.size else None,
-            starts.ctypes.data_as(i64p),
-            out.ctypes.data_as(i64p), n, os.cpu_count() or 1)
-        if rc == 0:
-            return out
+    if _native_varbytes_call("sxt_hash_varbytes", blob, starts, out, n):
+        return out
     width = max(1, int(lens.max(initial=0)))
     mat = np.zeros((n, width), dtype=np.uint8)
     _scatter_to_rows(blob, starts, lens, mat, col_base=0)
