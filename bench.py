@@ -756,26 +756,25 @@ def stage_native_aot(mon):
     code = ("import json, os, threading\n"
             "threading.Timer(240, lambda: os._exit(3)).start()\n"
             "from sparkucx_tpu.shuffle.aot import (\n"
-            "    aot_compile_native_step, aot_compile_pallas_step,\n"
-            "    aot_compile_strip_step)\n"
+            "    aot_compile_hier_step, aot_compile_native_step,\n"
+            "    aot_compile_pallas_step, aot_compile_strip_step)\n"
             "rep = aot_compile_native_step(8)\n"
-            "try:\n"
-            "    p = aot_compile_pallas_step(8)\n"
-            "    rep['pallas_step_ok'] = p.get('ok', False)\n"
-            "    if not rep['pallas_step_ok'] and p.get('error'):\n"
-            "        rep['pallas_step_error'] = p['error'][:150]\n"
-            "except Exception as e:\n"
-            "    rep['pallas_step_ok'] = False\n"
-            "    rep['pallas_step_error'] = str(e)[:150]\n"
-            "try:\n"
-            "    s = aot_compile_strip_step()\n"
-            "    rep['strip_step_ok'] = s.get('ok', False)\n"
-            "    if not rep['strip_step_ok'] and s.get('error'):\n"
-            "        rep['strip_step_error'] = s['error'][:150]\n"
-            "except Exception as e:\n"
-            "    rep['strip_step_ok'] = False\n"
-            "    rep['strip_step_error'] = str(e)[:150]\n"
             "print(json.dumps(rep), flush=True)\n"
+            "# one JSON line after EVERY proof: the parent takes the\n"
+            "# LAST parseable line, so a watchdog kill mid-ladder keeps\n"
+            "# the proofs already computed instead of discarding all\n"
+            "for label, fn in (('pallas_step', aot_compile_pallas_step),\n"
+            "                  ('strip_step', aot_compile_strip_step),\n"
+            "                  ('hier_step', aot_compile_hier_step)):\n"
+            "    try:\n"
+            "        r = fn()\n"
+            "        rep[label + '_ok'] = r.get('ok', False)\n"
+            "        if not rep[label + '_ok'] and r.get('error'):\n"
+            "            rep[label + '_error'] = r['error'][:150]\n"
+            "    except Exception as e:\n"
+            "        rep[label + '_ok'] = False\n"
+            "        rep[label + '_error'] = str(e)[:150]\n"
+            "    print(json.dumps(rep), flush=True)\n"
             "os._exit(0)\n")
     rep = {}
     try:

@@ -126,23 +126,10 @@ def aot_compile_native_step(
         report.update(ok=False, error=f"compile: {str(e)[:300]}")
         return report
     report["hlo_post_opt_ragged"] = "ragged-all-to-all" in txt
-    # the collective must span ALL n shards: parse the largest replica
-    # group attached to a ragged-all-to-all line, in BOTH textual forms
-    # XLA emits — braced lists '{{0,1,...,7}}' and iota-v2
-    # '[G,K]<=[N]' (G groups of K members)
-    groups_n = 0
-    for line in txt.splitlines():
-        if "ragged-all-to-all" not in line or "replica_groups" not in line:
-            continue
-        inner = line.split("replica_groups=")[1]
-        if inner.startswith("["):
-            dims = inner[1:].split("]")[0].split(",")
-            if "<=" in inner.split("]")[1][:3] and len(dims) == 2:
-                groups_n = max(groups_n, int(dims[1].strip()))
-            continue
-        ids = inner.split("}")[0].strip("{").replace("{", "")
-        groups_n = max(groups_n,
-                       len([x for x in ids.split(",") if x.strip()]))
+    # the collective must span ALL n shards: the largest replica group
+    # attached to any ragged-all-to-all line (_ragged_group_sizes
+    # handles both textual forms XLA emits)
+    groups_n = max(_ragged_group_sizes(txt), default=0)
     report["replica_groups_n"] = groups_n
     report["ok"] = bool(report["hlo_post_opt_ragged"]
                         and groups_n == n_devices)
@@ -209,6 +196,88 @@ def aot_compile_pallas_step(
     # an interpreter-baked trace would have no custom call at all
     report["hlo_tpu_custom_call"] = "tpu_custom_call" in txt
     report["ok"] = report["hlo_tpu_custom_call"]
+    return report
+
+
+def _ragged_group_sizes(txt: str):
+    """Distinct replica-group sizes attached to ragged-all-to-all lines
+    in post-opt HLO, both textual forms ('{{0,1,..}}' braces and iota-v2
+    '[G,K]<=[N]')."""
+    sizes = set()
+    for line in txt.splitlines():
+        if "ragged-all-to-all" not in line or "replica_groups" not in line:
+            continue
+        inner = line.split("replica_groups=")[1]
+        if inner.startswith("["):
+            dims = inner[1:].split("]")[0].split(",")
+            if "<=" in inner.split("]")[1][:3] and len(dims) == 2:
+                sizes.add(int(dims[1].strip()))
+            continue
+        ids = inner.split("}")[0].strip("{").replace("{", "")
+        sizes.add(len([x for x in ids.split(",") if x.strip()]))
+    return sizes
+
+
+def aot_compile_hier_step(
+    slices: int = 2,
+    per_slice: int = 4,
+    rows_per_shard: int = 1024,
+    width: int = 10,
+    topology_name: Optional[str] = None,
+) -> dict:
+    """Compile the two-stage hierarchical (ICI, DCN) exchange
+    (shuffle/hierarchical._build_hier_step) against an unattached TPU
+    topology reshaped (slices, per_slice) — the multi-slice lowering
+    proof closing the distributed-backend evidence gap the flat n=8
+    proof leaves (VERDICT r3 §2.6 partial): BOTH collectives must
+    survive post-opt HLO, the ICI stage spanning ``per_slice`` replicas
+    and the DCN stage spanning ``slices``.
+
+    Returns {"ok", "topology", "group_sizes", "error"?}."""
+    import os
+    os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "true")
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sparkucx_tpu.shuffle.hierarchical import _build_hier_step
+    from sparkucx_tpu.shuffle.plan import ShufflePlan
+
+    n = slices * per_slice
+    report: dict = {"devices": n, "slices": slices}
+    topo = _resolve_topology(report, topology_name)
+    if topo is None:
+        return report
+    if len(list(topo.devices)) < n:
+        report.update(ok=False,
+                      error=f"topology exposes {len(list(topo.devices))} "
+                            f"devices, need {n}")
+        return report
+
+    plan = ShufflePlan(num_shards=n, num_partitions=4 * n,
+                       cap_in=rows_per_shard,
+                       cap_out=2 * rows_per_shard,
+                       impl="native", sort_impl="multisort")
+    try:
+        mesh = topologies.make_mesh(topo, (slices, per_slice),
+                                    ("dcn", "ici"))
+        fn = _build_hier_step(mesh, "dcn", "ici", plan, width)
+        sharding = NamedSharding(mesh, P(("dcn", "ici")))
+        args = (
+            jax.ShapeDtypeStruct((n * rows_per_shard, width), jnp.int32,
+                                 sharding=sharding),
+            jax.ShapeDtypeStruct((n,), jnp.int32, sharding=sharding),
+        )
+        txt = fn.lower(*args).compile().as_text()
+    except Exception as e:
+        report.update(ok=False, error=f"compile: {str(e)[:300]}")
+        return report
+    sizes = _ragged_group_sizes(txt)
+    report["group_sizes"] = sorted(sizes)
+    # both stages present: ICI groups of per_slice, DCN groups of slices
+    report["ok"] = per_slice in sizes and slices in sizes
     return report
 
 

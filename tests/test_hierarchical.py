@@ -188,3 +188,20 @@ def test_manager_hierarchical_optout(rng):
         mgr.stop()
     finally:
         node.close()
+
+
+def test_hier_step_aot_proof():
+    """The two-stage (ICI, DCN) exchange lowers for TPU at a 2x4
+    topology via the local libtpu: BOTH collectives survive post-opt
+    HLO — ICI groups of 4, DCN groups of 2 (the multi-slice half of the
+    distributed-backend evidence; artifact
+    bench_runs/r4_aot_hier_step.json). Skips where libtpu/topology
+    support is unavailable."""
+    import pytest as _pytest
+
+    from sparkucx_tpu.shuffle.aot import aot_compile_hier_step
+    rep = aot_compile_hier_step()
+    if "topology" not in rep:
+        _pytest.skip(f"no TPU topology support here: {rep.get('error')}")
+    assert rep["ok"], rep
+    assert set(rep["group_sizes"]) >= {2, 4}
