@@ -126,3 +126,10 @@ def dense_manager():
     yield m
     m.stop()
     node.close()
+
+
+# soak lever shared by the randomized sweeps (test_fuzz_e2e,
+# test_strip_sort): SPARKUCX_FUZZ_SEEDS=200 widens them (CI default 16)
+import os as _os
+
+FUZZ_SEEDS = int(_os.environ.get("SPARKUCX_FUZZ_SEEDS", "16"))

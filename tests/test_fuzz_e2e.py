@@ -15,13 +15,15 @@ VAL_SCHEMAS = ((None, None), (np.int32, ()), (np.int32, (3,)),
                (np.float32, (2,)), (np.int16, (5,)), (np.uint8, (4,)),
                (np.int64, (1,)))
 
+from tests.conftest import FUZZ_SEEDS
+
 
 @pytest.fixture(scope="module")
 def manager(dense_manager):
     return dense_manager
 
 
-@pytest.mark.parametrize("seed", range(16))
+@pytest.mark.parametrize("seed", range(FUZZ_SEEDS))
 def test_random_job_roundtrip(manager, seed):
     rng = np.random.default_rng(seed)
     M = int(rng.integers(1, 7))
