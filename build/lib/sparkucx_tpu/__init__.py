@@ -1,0 +1,38 @@
+"""sparkucx_tpu — a TPU-native shuffle-transport framework.
+
+A brand-new, TPU-first re-design of the capability set of SparkUCX (the UCX
+RDMA shuffle plugin for Apache Spark, see ``/root/reference``): a
+data-parallel all-to-all repartitioning engine whose data plane is
+hardware-offloaded (ICI/DCN collectives via ``jax.lax.ragged_all_to_all``
+under ``shard_map`` instead of one-sided ``ucp_get`` RDMA reads) and whose
+control plane is a compact per-map-output segment table (instead of a
+driver-hosted ``{address, rkey}`` metadata buffer).
+
+Layer map (mirrors SURVEY.md §1, TPU-native):
+
+    L0  XLA / ICI / DCN           (hardware + compiler, external)
+    L1  runtime/  + native/       core runtime: process node, host arenas
+    L2  meta/     + parallel/     segment tables, meshes, collectives
+    L3  shuffle/  + ops/          the data plane: plan, a2a, writer, reader
+    L4  shuffle/manager.py + io/  framework API: register/write/read lifecycle
+    L5  config.py                 cross-cutting config (spark.shuffle.tpu.*)
+
+Reference parity citations appear in docstrings as ``ref: file:line``
+pointing into /root/reference.
+"""
+
+__version__ = "0.2.0"
+
+from sparkucx_tpu.config import TpuShuffleConf
+
+
+def connect(conf=None, **kw):
+    """Config-keyed entry point; see :func:`sparkucx_tpu.service.connect`.
+
+    Lazy import: building the service touches JAX, and importers of the
+    bare package (e.g. config-only tooling) must not pay backend init."""
+    from sparkucx_tpu.service import connect as _connect
+    return _connect(conf, **kw)
+
+
+__all__ = ["TpuShuffleConf", "connect", "__version__"]

@@ -1,0 +1,9 @@
+"""``python -m sparkucx_tpu`` — print the self-describing conf-key
+table (the reference's UcxShuffleConf documents its key surface the
+same way, through ConfigBuilder doc strings,
+ref: UcxShuffleConf.scala:25-89)."""
+
+from sparkucx_tpu.config import _print_key_table
+
+if __name__ == "__main__":
+    _print_key_table()

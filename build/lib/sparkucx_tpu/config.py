@@ -1,0 +1,465 @@
+"""Config system — the ``spark.shuffle.tpu.*`` key surface.
+
+TPU-native analog of the reference's ``UcxShuffleConf``
+(ref: src/main/scala/org/apache/spark/shuffle/UcxShuffleConf.scala:17-90),
+which extends SparkConf with the ``spark.shuffle.ucx.*`` namespace. We keep
+the same *shape* of surface — a typed view over a flat string key/value map,
+byte-size parsing, warm-up maps — but the keys describe TPU resources
+(host staging arenas, mesh axes, collective implementation) instead of UCX
+registration parameters.
+
+Key table (reference key -> ours):
+
+    spark.shuffle.ucx.driver.host/port      -> spark.shuffle.tpu.coordinator.address
+                                               (jax.distributed rendezvous)
+    spark.shuffle.ucx.rkeySize (x2 = 300B)  -> (no key: the segment-table slot
+                                               size is derived, meta/segments.py
+                                               record_size(num_partitions))
+    spark.shuffle.ucx.rpc.metadata.bufferSize -> spark.shuffle.tpu.meta.bufferSize
+    spark.shuffle.ucx.memory.preAllocateBuffers -> spark.shuffle.tpu.memory.preAllocateBuffers
+    spark.shuffle.ucx.memory.minBufferSize  -> spark.shuffle.tpu.memory.minBufferSize
+    spark.shuffle.ucx.memory.minAllocationSize -> spark.shuffle.tpu.memory.minAllocationSize
+    spark.shuffle.ucx.memory.useOdp         -> spark.shuffle.tpu.memory.pinned
+    (new, TPU-only)                            spark.shuffle.tpu.mesh.*, .a2a.impl,
+                                               .a2a.capacityFactor, .dcn.*
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Iterator, Mapping, Optional, Tuple
+
+_SIZE_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kKmMgGtT]?)i?[bB]?\s*$")
+_SIZE_MULT = {"": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_bytes(text: str | int) -> int:
+    """Parse '4m', '1k', '300', '2GiB' into a byte count.
+
+    Mirrors SparkConf.getSizeAsBytes used throughout the reference conf
+    (ref: UcxShuffleConf.scala:36-49)."""
+    if isinstance(text, int):
+        return text
+    m = _SIZE_RE.match(str(text))
+    if not m:
+        raise ValueError(f"cannot parse byte size: {text!r}")
+    value, unit = m.groups()
+    return int(float(value) * _SIZE_MULT[unit.lower()])
+
+
+PREFIX = "spark.shuffle.tpu."
+
+
+def _norm(key: str) -> str:
+    """Case/punctuation-insensitive key form, so SPARKUCX_TPU_MIN_BUFFER_SIZE,
+    'memory.minBufferSize' and 'memory.minbuffersize' all collide."""
+    return key.lower().replace(".", "").replace("_", "")
+
+
+class TpuShuffleConf:
+    """Typed view over a flat ``spark.shuffle.tpu.*`` key/value map.
+
+    Construction accepts any mapping (e.g. a SparkConf dump, a dict of CLI
+    overrides) plus ``SPARKUCX_TPU_*`` environment variables
+    (``SPARKUCX_TPU_A2A_IMPL=dense`` -> ``spark.shuffle.tpu.a2a.impl=dense``).
+    """
+
+    def __init__(self, conf: Optional[Mapping[str, str]] = None, use_env: bool = True):
+        self._conf: Dict[str, str] = {}
+        self._index: Dict[str, str] = {}  # _norm(key) -> key, explicit conf wins
+        if conf:
+            for k, v in conf.items():
+                self._conf[str(k)] = str(v)
+                self._index[_norm(str(k))] = str(k)
+        if use_env:
+            for k, v in os.environ.items():
+                if k.startswith("SPARKUCX_TPU_"):
+                    key = PREFIX + k[len("SPARKUCX_TPU_"):].lower().replace("_", ".")
+                    if _norm(key) not in self._index:
+                        self._conf[key] = v
+                        self._index[_norm(key)] = key
+        self.validate()
+
+    # All typed properties below, by name — validate() touches each so a
+    # malformed VALUE fails at construction, not deep inside a shuffle.
+    _TYPED_PROPS = (
+        "coordinator_address", "meta_buffer_size", "min_buffer_size",
+        "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
+        "spill_threshold", "spill_dir", "a2a_impl", "sort_impl",
+        "sort_strips", "combine_compaction", "fetch_granularity",
+        "capacity_factor", "max_bytes_in_flight", "mesh_ici_axis",
+        "mesh_dcn_axis", "num_slices", "num_processes",
+        "cores_per_process", "connection_timeout_ms")
+    # Namespace keys consumed OUTSIDE config.py (grep-verified), plus the
+    # prefix families. A spark.shuffle.tpu.* key matching none of these is
+    # a probable typo and gets a warning (not an error: a host engine may
+    # legitimately pass a newer/older key surface through — the reference
+    # rides inside SparkConf, which never rejects keys).
+    # ONE hand-maintained structure: keys (with their short descriptions)
+    # consumed outside config.py; their full docs live at the use sites.
+    # _EXTERNAL_KEYS and _KEY_FAMILIES derive from it, so adding a key
+    # here both silences the unknown-key warning AND lists it in the
+    # self-describing table — no second copy to drift.
+    _EXTERNAL_KEY_DOCS = {
+        "a2a.hierarchical": "force the two-stage ICI/DCN exchange on a "
+                            "multi-slice mesh (shuffle/hierarchical.py)",
+        "io.format": "shuffle payload codec: raw | arrow | varlen "
+                     "(service.py connect)",
+        "io.keyColumn": "arrow format: which column is the shuffle key "
+                        "(io/arrow.py)",
+        "io.stringMaxBytes": "varlen format: per-string byte cap "
+                             "(io/varlen.py)",
+        "trace.enabled": "turn on the span tracer (utils/trace.py)",
+        "trace.device": "also record device-time spans",
+        "trace.capacity": "tracer ring-buffer size",
+        "failure.maxAttempts": "read-retry budget after device loss "
+                               "(runtime/failures.py)",
+        "failure.backoffMs": "backoff between failure-recovery attempts",
+        "fault.*": "deterministic fault injection: fault.seed + per-site "
+                   "arming keys (runtime/failures.FaultInjector)",
+    }
+    _EXTERNAL_KEYS = tuple(k for k in _EXTERNAL_KEY_DOCS
+                           if not k.endswith("*"))
+    _KEY_FAMILIES = tuple(k[:-1] for k in _EXTERNAL_KEY_DOCS
+                          if k.endswith("*"))  # "fault.*" -> "fault."
+
+    def validate(self) -> None:
+        """Fail fast on malformed values; warn on unknown namespace keys.
+
+        The reference defers every parse to first use (UcxShuffleConf is
+        lazy SparkConf sugar), which surfaces a typo'd size string only
+        mid-shuffle; here construction is the checkpoint."""
+        # touching every typed property both validates its value and, via
+        # the _seen_shorts hook in _get, collects the property-owned key
+        # names — no hand-maintained duplicate of the key surface
+        self._seen_shorts: set = set()
+        for name in self._TYPED_PROPS:
+            try:
+                getattr(self, name)
+            except ValueError as e:
+                raise ValueError(f"conf key for {name!r}: {e}") from e
+        known = {_norm(PREFIX + s)
+                 for s in set(self._EXTERNAL_KEYS) | self._seen_shorts}
+        self._seen_shorts = None
+        for key in self._conf:
+            if not key.startswith(PREFIX):
+                continue
+            short = key[len(PREFIX):]
+            if any(short.startswith(f) for f in self._KEY_FAMILIES):
+                continue
+            if _norm(key) not in known:
+                from sparkucx_tpu.utils.logging import get_logger
+                get_logger("config").warning(
+                    "unknown conf key %s (typo? known short keys: see "
+                    "TpuShuffleConf docstring)", key)
+
+    @classmethod
+    def describe_keys(cls):
+        """One row per conf key — {key, default, property, doc} —
+        generated from the LIVE property surface (the same _get hook
+        validate() uses), so the table cannot drift from the code. The
+        reference self-describes its key surface the same way, through
+        ConfigBuilder doc strings (ref: UcxShuffleConf.scala:25-89)."""
+        conf = cls({}, use_env=False)
+        rows = []
+        for name in cls._TYPED_PROPS:
+            captured = []
+            real_get = conf._get
+
+            def capture(short, default, _c=captured, _g=real_get):
+                _c.append((short, default))
+                return _g(short, default)
+
+            conf.__dict__["_get"] = capture
+            try:
+                getattr(conf, name)
+            except Exception:
+                pass
+            finally:
+                del conf.__dict__["_get"]
+            doc = (getattr(cls, name).__doc__ or "").strip()
+            doc = " ".join(doc.split("\n\n")[0].split())
+            for short, default in captured:
+                rows.append({"key": PREFIX + short,
+                             "default": str(default),
+                             "property": name,
+                             "doc": doc})
+        for short, doc in cls._EXTERNAL_KEY_DOCS.items():
+            rows.append({"key": PREFIX + short, "default": "",
+                         "property": "", "doc": doc})
+        return rows
+
+    # -- raw access -------------------------------------------------------
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._conf.get(key, default)
+
+    def set(self, key: str, value) -> "TpuShuffleConf":
+        # Case/punctuation-insensitive: writing through any spelling updates
+        # the canonical entry rather than shadowing it.
+        canonical = self._index.get(_norm(key), key)
+        self._conf[canonical] = str(value)
+        self._index[_norm(key)] = canonical
+        return self
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._conf
+
+    def items(self) -> Iterator[Tuple[str, str]]:
+        return iter(sorted(self._conf.items()))
+
+    # -- typed getters ----------------------------------------------------
+    def _get(self, short: str, default) -> str:
+        if getattr(self, "_seen_shorts", None) is not None:
+            self._seen_shorts.add(short)   # validate() key-surface census
+        full = PREFIX + short
+        if full in self._conf:
+            return self._conf[full]
+        hit = self._index.get(_norm(full))
+        if hit is not None:
+            return self._conf[hit]
+        return str(default)
+
+    def get_int(self, short: str, default: int) -> int:
+        return int(self._get(short, default))
+
+    def get_float(self, short: str, default: float) -> float:
+        return float(self._get(short, default))
+
+    def get_bool(self, short: str, default: bool) -> bool:
+        v = str(self._get(short, default)).strip().lower()
+        if v in ("1", "true", "yes", "on"):
+            return True
+        if v in ("0", "false", "no", "off"):
+            return False
+        # 'ture' silently meaning False would disable e.g. pinned arenas
+        # with no trace — exactly the mid-run surprise validate() exists
+        # to prevent
+        raise ValueError(
+            f"conf key {PREFIX}{short}={v!r} is not a boolean "
+            f"(want true/false/1/0/yes/no/on/off)")
+
+    def get_bytes(self, short: str, default) -> int:
+        return parse_bytes(self._get(short, default))
+
+    # -- the key surface --------------------------------------------------
+    @property
+    def coordinator_address(self) -> str:
+        """Rendezvous address for jax.distributed / multi-host bootstrap.
+
+        Analog of the driver sockaddr the reference listens on
+        (ref: UcxShuffleConf.scala:25-28, UcxNode.java:98-104)."""
+        return self._get("coordinator.address", "localhost:55443")
+
+    @property
+    def meta_buffer_size(self) -> int:
+        """Upper bound on one metadata-plane message (the presence bitmap /
+        schema blob a process allgathers in distributed mode). Oversized
+        messages fail loudly before the collective instead of stalling it —
+        the role the fixed 4 KB bootstrap buffer plays in the reference
+        (ref: UcxShuffleConf.scala:42-49, UcxListenerThread.java:34-39).
+        Enforced by TpuShuffleManager._submit_distributed; default 64k allows
+        ~8000 map outputs per shuffle."""
+        return self.get_bytes("meta.bufferSize", "64k")
+
+    @property
+    def min_buffer_size(self) -> int:
+        """Size-class floor for the host arena
+        (ref: UcxShuffleConf.scala:66-72, default 1k)."""
+        return self.get_bytes("memory.minBufferSize", "1k")
+
+    @property
+    def min_allocation_size(self) -> int:
+        """Minimum slab carved from the OS, shared by small size classes
+        (ref: UcxShuffleConf.scala:74-81, default 4m)."""
+        return self.get_bytes("memory.minAllocationSize", "4m")
+
+    @property
+    def pre_allocate_buffers(self) -> Dict[int, int]:
+        """Warm-up map 'size:count,size:count' parsed to {bytes: count}
+        (ref: UcxShuffleConf.scala:52-64, MemoryPool.java:170-177)."""
+        spec = self._get("memory.preAllocateBuffers", "")
+        out: Dict[int, int] = {}
+        if spec:
+            for part in spec.split(","):
+                try:
+                    size, count = part.split(":")
+                    out[parse_bytes(size)] = int(count)
+                except ValueError as e:
+                    raise ValueError(
+                        f"preAllocateBuffers entry {part!r} is not 'size:count'"
+                    ) from e
+        return out
+
+    @property
+    def pinned_memory(self) -> bool:
+        """Whether host staging arenas should request pinned pages.
+
+        Plays the role the ODP toggle plays for registration strategy
+        (ref: UcxShuffleConf.scala:89)."""
+        return self.get_bool("memory.pinned", True)
+
+    @property
+    def spill_threshold(self) -> int:
+        """Staged bytes per map writer before batches spill to disk files
+        (0 disables). The disk story of the reference — map outputs living
+        in sort-shuffle ``data``+``index`` files served from page cache
+        (ref: CommonUcxShuffleBlockResolver.scala:33-57) — becomes an
+        overflow valve here: hot outputs stay in the pinned arena, big ones
+        append to per-writer files and are mmapped back at read time, so
+        staging RSS stays bounded by this threshold instead of the dataset
+        size."""
+        return self.get_bytes("spill.threshold", "256m")
+
+    @property
+    def spill_dir(self) -> str:
+        """Directory for spilled map-output files (the executor local-dir
+        analog). Default: a per-process dir under the system temp dir."""
+        import tempfile
+        return self._get(
+            "spill.dir",
+            os.path.join(tempfile.gettempdir(),
+                         f"sparkucx_tpu_spill_{os.getpid()}"))
+
+    # -- TPU-only keys ----------------------------------------------------
+    @property
+    def a2a_impl(self) -> str:
+        """Collective implementation: auto | native | dense | gather.
+
+        native = jax.lax.ragged_all_to_all (TPU ICI); dense = padded
+        all_to_all (portable); gather = all_gather oracle (tests)."""
+        v = self._get("a2a.impl", "auto")
+        from sparkucx_tpu.shuffle.alltoall import IMPLS
+        # 'pallas' = the first-party remote-DMA transport (plain flat
+        # reads; shuffle/reader._pallas_step_body)
+        allowed = ("auto",) + IMPLS + ("pallas",)
+        if v not in allowed:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.impl={v!r}: want one of {allowed}")
+        return v
+
+    @property
+    def sort_impl(self) -> str:
+        """Destination-sort formulation for the exchange hot path:
+        auto | argsort | multisort | multisort8 | counting
+        (ops/partition.py)."""
+        v = self._get("a2a.sortImpl", "auto")
+        from sparkucx_tpu.ops.partition import SORT_METHODS
+        if v not in SORT_METHODS:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.sortImpl={v!r}: want one of "
+                f"{SORT_METHODS}")
+        return v
+
+    @property
+    def sort_strips(self):
+        """Single-shard plain exchanges: destination-sort in this many
+        independent strips (one batched sort network — depth
+        ~log^2(cap/strips) instead of ~log^2(cap)), served as virtual
+        senders by the reader's run index. 1 = one flat sort; 'auto' =
+        the backend's measured default, resolved at plan time
+        (ops/partition.destination_sort_strips,
+        shuffle/plan.default_sort_strips)."""
+        raw = self._get("a2a.sortStrips", "auto")
+        if raw == "auto":
+            return "auto"
+        from sparkucx_tpu.shuffle.plan import STRIPS_RANGE
+        v = int(raw)
+        if not STRIPS_RANGE[0] <= v <= STRIPS_RANGE[1]:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.sortStrips={v}: want "
+                f"{STRIPS_RANGE[0]}..{STRIPS_RANGE[1]} or 'auto'")
+        return v
+
+    @property
+    def fetch_granularity(self) -> str:
+        """Lazy-result D2H granularity: ``shard`` (default — first touch
+        of a shard pulls its whole receive buffer) or ``partition``
+        (each fetch device-slices only that partition's runs — the
+        reference's per-block fetch; right for slow D2H links or sparse
+        partition reads)."""
+        v = self._get("io.fetchGranularity", "shard")
+        if v not in ("shard", "partition"):
+            raise ValueError(
+                f"spark.shuffle.tpu.io.fetchGranularity={v!r}: want "
+                f"shard|partition")
+        return v
+
+    @property
+    def combine_compaction(self) -> str:
+        """combine_rows end-row compaction formulation: stable | unstable
+        (ops/aggregate.py — bit-identical results, different sort cost;
+        the on-chip A/B lever for the combine path's laggard)."""
+        v = self._get("a2a.combineCompaction", "stable")
+        if v not in ("stable", "unstable"):
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.combineCompaction={v!r}: want "
+                f"stable|unstable")
+        return v
+
+    @property
+    def capacity_factor(self) -> float:
+        """Output-buffer headroom multiplier over perfectly-balanced size.
+
+        The static-shape answer to ragged skew (SURVEY.md §7 hard part (a))."""
+        return float(self._get("a2a.capacityFactor", 2.0))
+
+    @property
+    def max_bytes_in_flight(self) -> int:
+        """Cap on the combined footprint (pinned pack buffers + estimated
+        HBM send/receive buffers) of simultaneously in-flight submitted
+        exchanges; 0 = unlimited. ``submit()`` blocks until enough earlier
+        exchanges complete — the admission-control role Spark's
+        ShuffleBlockFetcherIterator plays with maxBytesInFlight
+        (ref: UcxShuffleReader.scala:56-70). A single exchange larger than
+        the cap is always admitted alone (never deadlocks)."""
+        return self.get_bytes("a2a.maxBytesInFlight", 0)
+
+    @property
+    def mesh_ici_axis(self) -> str:
+        """Mesh axis name for the intra-slice (ICI) shuffle axis."""
+        return self._get("mesh.iciAxis", "shuffle")
+
+    @property
+    def mesh_dcn_axis(self) -> str:
+        """Mesh axis name for the cross-slice (DCN) axis of a
+        multi-slice mesh."""
+        return self._get("mesh.dcnAxis", "dcn")
+
+    @property
+    def num_slices(self) -> int:
+        """Number of TPU slices (DCN-connected). 1 = single slice."""
+        return self.get_int("mesh.numSlices", 1)
+
+    @property
+    def num_processes(self) -> int:
+        """Processes in the cluster (ref: UcxShuffleConf.scala:20-21)."""
+        return self.get_int("numProcesses", 1)
+
+    @property
+    def cores_per_process(self) -> int:
+        """Expected concurrent map tasks per process. The manager warns when
+        more writers are live at once — the analog of UcxNode warning when
+        task threads exceed spark.executor.cores (ref: UcxNode.java:85-95,
+        UcxShuffleConf.scala:22-23). Default: the host's CPU count."""
+        return self.get_int("coresPerProcess", os.cpu_count() or 1)
+
+    @property
+    def connection_timeout_ms(self) -> int:
+        """Peer/metadata wait timeout (ref: UcxWorkerWrapper.scala:133-140,
+        spark.network.timeout)."""
+        return self.get_int("network.timeoutMs", 120_000)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"TpuShuffleConf({dict(self.items())})"
+
+
+def _print_key_table() -> None:  # pragma: no cover - exercised via CLI
+    rows = TpuShuffleConf.describe_keys()
+    w = max(len(r["key"]) for r in rows)
+    dw = max(len(r["default"]) for r in rows)
+    print(f"{'key':<{w}}  {'default':<{dw}}  description")
+    print("-" * (w + dw + 60))
+    for r in rows:
+        print(f"{r['key']:<{w}}  {r['default']:<{dw}}  {r['doc']}")
+

@@ -1,0 +1,340 @@
+"""Arrow columnar ingress/egress.
+
+BASELINE.json's north star has fetched bytes land back as Arrow columnar
+batches for the host framework's reducers (the Spark-RAPIDS-style columnar
+interop config). This module converts between Arrow RecordBatches and the
+writer/reader surfaces: a batch's key column routes the shuffle, the
+remaining columns ride as the fused value payload — numeric columns as
+lossless int64 carriers, string/binary columns as length-prefixed padded
+varlen byte lanes (io/varlen.py), so a TPC-DS string column shuffles the
+way the reference moves any serialized bytes (ref: reducer/compat/
+spark_3_0/OnOffsetsFetchCallback.java:44-66 — blocks are opaque byte
+ranges)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import pyarrow as pa
+    HAVE_ARROW = True
+except Exception:  # pragma: no cover - pyarrow is in the image
+    pa = None
+    HAVE_ARROW = False
+
+
+def _require_arrow() -> None:
+    if not HAVE_ARROW:
+        raise RuntimeError("pyarrow is not available in this environment")
+
+
+# recipe entry for a varlen column: (kind, declared max payload bytes,
+# int64 carrier lanes) — kind "utf8" reconstructs a pa.string() column,
+# "binary" a pa.binary() column. Numeric entries stay plain np.dtype.
+def _varlen_lanes(max_bytes: int) -> int:
+    from sparkucx_tpu.io.varlen import varbytes_width
+    return (varbytes_width(max_bytes) + 7) // 8
+
+
+def _widen_bits(arr: np.ndarray) -> np.ndarray:
+    """Column -> int64 carrier, losslessly: integers widen by value (exact
+    for every width <= 64), floats widen to float64 by value (exact from
+    float32/16) and then reinterpret as bits. Never a lossy cast."""
+    if np.issubdtype(arr.dtype, np.integer):
+        return arr.astype(np.int64)
+    if np.issubdtype(arr.dtype, np.floating):
+        return np.ascontiguousarray(
+            arr.astype(np.float64)).view(np.int64)
+    raise TypeError(
+        f"column dtype {arr.dtype} is not fixed-width numeric; only "
+        f"numeric columns shuffle columnarly")
+
+
+def _narrow_bits(carrier: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    dtype = np.dtype(dtype)
+    if np.issubdtype(dtype, np.integer):
+        return carrier.astype(dtype)
+    return np.ascontiguousarray(carrier).view(np.float64).astype(dtype)
+
+
+def _arrow_blob_starts(col: "pa.Array"):
+    """(blob uint8, starts int64 [n+1], lens int64 [n]) VIEWS over an
+    Arrow string/binary array's own (offsets, data) buffers — the
+    columnar layout IS the varlen codec's input layout, so encoding
+    skips ``to_pylist`` and every per-item Python object entirely.
+    Handles sliced arrays (col.offset) by re-basing to starts[0] == 0."""
+    bufs = col.buffers()                      # [validity, offsets, data]
+    if len(col) == 0 or bufs[1] is None:
+        # zero-length arrays may legally carry a NULL offsets buffer
+        # (C-data-interface producers do) — encode as the empty column
+        return (np.zeros(0, np.uint8), np.zeros(1, np.int64),
+                np.zeros(0, np.int64))
+    off_dt = np.int64 if (pa.types.is_large_string(col.type)
+                          or pa.types.is_large_binary(col.type)) \
+        else np.int32
+    offsets = np.frombuffer(bufs[1], dtype=off_dt)[
+        col.offset:col.offset + len(col) + 1].astype(np.int64)
+    data = (np.frombuffer(bufs[2], dtype=np.uint8)
+            if bufs[2] is not None else np.zeros(0, np.uint8))
+    blob = data[int(offsets[0]):int(offsets[-1])]
+    starts = offsets - offsets[0]
+    return blob, starts, np.diff(offsets)
+
+
+def _encode_varlen_col(col: "pa.Array", name: str,
+                       max_bytes: int) -> Tuple[np.ndarray, tuple]:
+    """String/binary column -> [n, lanes] int64 varlen carrier + recipe."""
+    from sparkucx_tpu.io.varlen import pack_varbytes_blob
+    if col.null_count:
+        raise ValueError(
+            f"column {name!r} has {col.null_count} nulls; varlen shuffle "
+            f"carries exact bytes — fill or drop nulls first")
+    kind = "utf8" if pa.types.is_string(col.type) \
+        or pa.types.is_large_string(col.type) else "binary"
+    blob, starts, lens = _arrow_blob_starts(col)
+    packed = pack_varbytes_blob(blob, starts, lens, max_bytes)
+    lanes = _varlen_lanes(max_bytes)
+    padded = np.zeros((packed.shape[0], lanes * 8), np.uint8)
+    padded[:, :packed.shape[1]] = packed
+    return padded.view(np.int64), (kind, int(max_bytes), lanes)
+
+
+def _is_varlen_type(t) -> bool:
+    return (pa.types.is_string(t) or pa.types.is_large_string(t)
+            or pa.types.is_binary(t) or pa.types.is_large_binary(t))
+
+
+def batch_to_kv(batch: "pa.RecordBatch", key_column: str,
+                string_max_bytes: int = 64,
+                ) -> Tuple[np.ndarray, Optional[np.ndarray], List]:
+    """RecordBatch -> (keys int64, values [n, lanes] int64 carrier,
+    recipe).
+
+    Numeric value columns ride as one lossless int64 carrier lane each;
+    string/binary columns as ``_varlen_lanes(string_max_bytes)`` lanes of
+    length-prefixed padded bytes (never truncated — an over-long record
+    raises). ``recipe`` is the per-column reconstruction spec
+    :func:`kv_to_batch` uses to rebuild the exact schema."""
+    _require_arrow()
+    names = [f for f in batch.schema.names if f != key_column]
+    if key_column not in batch.schema.names:
+        raise KeyError(f"key column {key_column!r} not in batch")
+    keys = batch.column(key_column).to_numpy(zero_copy_only=False)
+    if not np.issubdtype(keys.dtype, np.integer):
+        raise TypeError(f"key column must be integer, got {keys.dtype}")
+    keys = keys.astype(np.int64, copy=False)
+    if not names:
+        return keys, None, []
+    arrs = {name: batch.column(name) for name in names}
+    # Uniform 4-byte numeric schema -> NATIVE carrier: the columns ride
+    # in their own dtype (still lossless) instead of widened int64
+    # lanes, which makes the shuffle device-COMBINABLE (<=4-byte lanes,
+    # ops/aggregate.check_combinable) — the columnar aggregation path
+    # (round-2 verdict weak #8: arrow callers had no device
+    # combine-by-key).
+    np_arrs = {}
+    native = False
+    if names and all(not _is_varlen_type(arrs[n].type) for n in names):
+        for name in names:
+            np_arrs[name] = arrs[name].to_numpy(zero_copy_only=False)
+        d0 = np_arrs[names[0]].dtype
+        native = d0 in (np.dtype(np.int32), np.dtype(np.float32)) and \
+            all(np_arrs[n].dtype == d0 for n in names)
+    if native:
+        vals = np.stack([np_arrs[n] for n in names], axis=1)
+        return keys, vals, [vals.dtype] * len(names)
+    cols, recipe = [], []
+    for name in names:
+        col = arrs[name]
+        if _is_varlen_type(col.type):
+            lanes, entry = _encode_varlen_col(col, name, string_max_bytes)
+            cols.append(lanes)
+            recipe.append(entry)
+        else:
+            arr = np_arrs.get(name)
+            if arr is None:
+                arr = col.to_numpy(zero_copy_only=False)
+            cols.append(_widen_bits(arr).reshape(-1, 1))
+            recipe.append(arr.dtype)
+    return keys, np.concatenate(cols, axis=1), recipe
+
+
+def _lanes_of(entry) -> int:
+    """int64 carrier lanes one recipe entry consumes."""
+    return entry[2] if isinstance(entry, tuple) else 1
+
+
+def kv_to_batch(keys: np.ndarray, values: Optional[np.ndarray],
+                key_column: str = "key",
+                value_columns: Optional[Sequence[str]] = None,
+                value_dtypes: Optional[Sequence] = None,
+                ) -> "pa.RecordBatch":
+    """(keys, int64-carrier values, recipe) -> RecordBatch; exact inverse
+    of batch_to_kv. ``value_dtypes`` entries are np.dtype (numeric, one
+    lane) or ("utf8"|"binary", max_bytes, lanes) varlen specs. Without
+    ``value_dtypes``, every lane comes back as an int64 column."""
+    from sparkucx_tpu.io.varlen import unpack_varbytes, varbytes_width
+    _require_arrow()
+    arrays = [pa.array(np.ascontiguousarray(keys))]
+    names = [key_column]
+    if values is not None:
+        nlanes = values.shape[1] if values.ndim > 1 else 1
+        vals2d = values.reshape(len(keys), nlanes) if len(keys) else \
+            values.reshape(0, nlanes)
+        if vals2d.dtype != np.int64:
+            # NATIVE carrier (uniform 4-byte schema, see batch_to_kv):
+            # columns come back in their own dtype, one per lane
+            value_columns = list(value_columns or
+                                 [f"v{i}" for i in range(nlanes)])
+            if len(value_columns) != nlanes:
+                raise ValueError(
+                    f"{len(value_columns)} names for {nlanes} native "
+                    f"value columns")
+            for i, name in enumerate(value_columns):
+                arrays.append(pa.array(np.ascontiguousarray(
+                    vals2d[:, i])))
+                names.append(name)
+            return pa.RecordBatch.from_arrays(arrays, names=names)
+        if value_dtypes is None:
+            value_dtypes = [np.int64] * nlanes
+        value_dtypes = list(value_dtypes)
+        need = sum(_lanes_of(e) for e in value_dtypes)
+        if need != nlanes:
+            raise ValueError(
+                f"recipe consumes {need} carrier lanes but values have "
+                f"{nlanes}")
+        value_columns = list(value_columns or
+                             [f"v{i}" for i in range(len(value_dtypes))])
+        if len(value_columns) != len(value_dtypes):
+            raise ValueError(
+                f"{len(value_columns)} names for {len(value_dtypes)} "
+                f"value columns")
+        lane = 0
+        for name, entry in zip(value_columns, value_dtypes):
+            w = _lanes_of(entry)
+            block = vals2d[:, lane:lane + w]
+            lane += w
+            if isinstance(entry, tuple):
+                kind, max_bytes, _ = entry
+                # explicit byte width, not -1: reshape cannot infer an
+                # axis for a zero-row partition
+                raw = np.ascontiguousarray(
+                    block.astype(np.int64)).view(np.uint8).reshape(
+                        len(keys), w * 8)[:, :varbytes_width(max_bytes)]
+                items = unpack_varbytes(raw)
+                if kind == "utf8":
+                    arrays.append(pa.array(
+                        [b.decode("utf-8") for b in items],
+                        type=pa.string()))
+                else:
+                    arrays.append(pa.array(items, type=pa.binary()))
+            else:
+                col = _narrow_bits(
+                    np.ascontiguousarray(block[:, 0]).astype(np.int64),
+                    entry)
+                arrays.append(pa.array(col))
+            names.append(name)
+    return pa.RecordBatch.from_arrays(arrays, names=names)
+
+
+def write_batches(manager, handle, map_id: int,
+                  batches: Sequence["pa.RecordBatch"], key_column: str,
+                  num_partitions: Optional[int] = None,
+                  string_max_bytes: int = 64) -> List:
+    """Stage Arrow batches into one map output and commit. Returns the
+    value-column recipe (also stashed on the handle for read_batches).
+    ``string_max_bytes`` is the declared per-record ceiling for string/
+    binary columns (part of the schema: every map task of a shuffle must
+    pass the same value or the recipe check fails loudly)."""
+    _require_arrow()
+    w = manager.get_writer(handle, map_id)
+    recipe: Optional[List] = None
+    names: Optional[List[str]] = None
+    for b in batches:
+        keys, values, dtypes = batch_to_kv(b, key_column,
+                                           string_max_bytes)
+        if not keys.shape[0]:
+            continue
+        bnames = [f for f in b.schema.names if f != key_column]
+        if recipe is None:
+            recipe, names = dtypes, bnames
+        elif dtypes != recipe or bnames != names:
+            raise ValueError(
+                f"batch schema mismatch within map {map_id}: "
+                f"{list(zip(bnames, dtypes))} vs {list(zip(names, recipe))}")
+        w.write(keys, values)
+    # Recipe checks must precede commit: once committed, the output is
+    # published to the metadata plane and a blocked reader may decode it —
+    # a mismatch found later would already be a silent bit
+    # reinterpretation on the read side. setdefault keeps the
+    # check-then-set atomic under concurrent map tasks.
+    if recipe is not None:
+        winner = handle.__dict__.setdefault(
+            "_arrow_value_schema", (names, recipe))
+        if (list(winner[0]), list(winner[1])) != (names, recipe):
+            raise ValueError(
+                f"value schema mismatch across map tasks: map {map_id} "
+                f"wrote {list(zip(names, recipe))}, an earlier task wrote "
+                f"{list(zip(*winner))}")
+    w.commit(num_partitions or handle.num_partitions)
+    return recipe or []
+
+
+def read_batches(manager, handle, key_column: str = "key",
+                 value_columns: Optional[Sequence[str]] = None,
+                 value_dtypes: Optional[Sequence] = None,
+                 timeout: Optional[float] = None,
+                 ordered: bool = False,
+                 combine: Optional[str] = None,
+                 combine_sum_words: int = 0) -> List["pa.RecordBatch"]:
+    """Run the exchange; one RecordBatch per non-empty reduce partition.
+    Column names and dtypes default to the recipe recorded by
+    write_batches, so batches come back with the schema they went in
+    with. ``ordered=True`` returns key-sorted batches (device sort).
+
+    ``combine="sum"`` runs device combine-by-key — available when the
+    batch schema rode the NATIVE carrier (all value columns one 4-byte
+    numeric dtype; batch_to_kv picks that automatically): the returned
+    batches then hold one row per distinct key with per-column sums,
+    key-sorted. Widened (mixed/8-byte/string) schemas raise with the
+    reason — an 8-byte carrier cannot combine on device
+    (ops/aggregate.check_combinable)."""
+    _require_arrow()
+    recorded = handle.__dict__.get("_arrow_value_schema")
+    if recorded is not None:
+        if value_columns is None:
+            value_columns = recorded[0]
+        if value_dtypes is None:
+            value_dtypes = recorded[1]
+    if combine:
+        # Pre-check only when the recipe is KNOWN here (this process
+        # wrote, or the caller passed value_dtypes): a known-widened
+        # schema gets a clear error naming the carrier. With no local
+        # recipe (a pure-reader process), defer to manager.read's
+        # check_combinable, which validates the registered value schema —
+        # the authoritative check either way.
+        dts = list(value_dtypes or [])
+        if dts:
+            native = all(
+                not isinstance(e, tuple)
+                and np.dtype(e) in (np.dtype(np.int32),
+                                    np.dtype(np.float32))
+                for e in dts) and len({np.dtype(e) for e in dts
+                                       if not isinstance(e, tuple)}) == 1
+            if not native:
+                raise ValueError(
+                    f"combine needs the native 4-byte carrier (all value "
+                    f"columns one int32/float32 dtype); this shuffle's "
+                    f"schema is {dts} — widened carriers are 8-byte and "
+                    f"cannot combine on device")
+    res = manager.read(handle, timeout=timeout, ordered=ordered,
+                       combine=combine,
+                       combine_sum_words=combine_sum_words)
+    out = []
+    for r, (k, v) in res.partitions():
+        if k.shape[0]:
+            out.append(kv_to_batch(k, v, key_column, value_columns,
+                                   value_dtypes))
+    return out

@@ -1,0 +1,136 @@
+"""Shuffle registry — the driver metadata table, host side.
+
+The reference's driver allocates one registered buffer per shuffle
+(``numMaps x 300 B``), mappers one-sided-``put`` their record into slot
+``mapId x 300`` at commit time, and reducers block until records they need
+have arrived (ref: CommonUcxShuffleManager.scala:39-56,
+CommonUcxShuffleBlockResolver.scala:91-103, UcxWorkerWrapper.scala:129-152
+wait/notify). This module is that table as an in-process, thread-safe
+store: slot-addressed publication of packed records, completion waiting
+with timeout, and per-shuffle teardown. In multi-host deployments the same
+byte image travels over the jax.distributed KV store
+(:mod:`sparkucx_tpu.runtime.node`)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from sparkucx_tpu.meta.segments import (
+    SegmentTable,
+    pack_record,
+    record_size,
+    unpack_record,
+)
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("meta.registry")
+
+
+class ShuffleEntry:
+    """One shuffle's metadata table: numMaps fixed-size slots + arrival
+    tracking (the wait/notify the reference does on workerAdresses and on
+    request completion)."""
+
+    def __init__(self, shuffle_id: int, num_maps: int, num_partitions: int,
+                 partitioner: str = "hash", bounds=None):
+        self.shuffle_id = shuffle_id
+        self.num_maps = num_maps
+        self.num_partitions = num_partitions
+        self.partitioner = partitioner
+        # range split points — part of the registration (the entry is the
+        # single source of truth for re-registration, e.g. checkpoint
+        # restore; a range shuffle without its bounds is unreadable)
+        self.bounds = tuple(bounds) if bounds is not None else None
+        self.slot = record_size(num_partitions)
+        self.table = bytearray(self.slot * num_maps)
+        self._present = np.zeros(num_maps, dtype=bool)
+        self._cv = threading.Condition()
+
+    def publish(self, map_id: int, sizes: np.ndarray) -> None:
+        """Mapper commit: write slot mapId (the putNonBlocking analog,
+        ref: CommonUcxShuffleBlockResolver.scala:91-98)."""
+        if not (0 <= map_id < self.num_maps):
+            raise IndexError(f"mapId {map_id} out of range [0,{self.num_maps})")
+        if len(sizes) != self.num_partitions:
+            raise ValueError(
+                f"sizes row has {len(sizes)} partitions, expected "
+                f"{self.num_partitions}")
+        rec = pack_record(map_id, np.asarray(sizes, dtype=np.uint64))
+        with self._cv:
+            self.table[map_id * self.slot:(map_id + 1) * self.slot] = rec
+            self._present[map_id] = True
+            self._cv.notify_all()
+
+    def wait_complete(self, timeout: Optional[float] = None) -> bool:
+        """Block until all map outputs are published (reducers' metadata
+        wait, ref: UcxWorkerWrapper.scala:134-143)."""
+        with self._cv:
+            return self._cv.wait_for(self._present.all, timeout=timeout)
+
+    @property
+    def num_present(self) -> int:
+        with self._cv:
+            return int(self._present.sum())
+
+    def fetch_table(self) -> SegmentTable:
+        """Reducer side: snapshot the whole table in one read (the single
+        ucp_get of the driver buffer, ref: UcxWorkerWrapper.scala:176-196)."""
+        with self._cv:
+            if not self._present.all():
+                missing = np.flatnonzero(~self._present)[:8].tolist()
+                raise RuntimeError(
+                    f"shuffle {self.shuffle_id}: map outputs missing "
+                    f"(e.g. {missing}); wait_complete() first")
+            return SegmentTable.unpack(
+                bytes(self.table), self.num_maps, self.num_partitions)
+
+    def fetch_record(self, map_id: int) -> np.ndarray:
+        with self._cv:
+            if not self._present[map_id]:
+                raise RuntimeError(f"mapId {map_id} not yet published")
+            _, sizes = unpack_record(
+                bytes(self.table[map_id * self.slot:(map_id + 1) * self.slot]))
+            return sizes
+
+
+class ShuffleRegistry:
+    """All live shuffles in this process (the manager's shuffleIdToHandle /
+    fileMappings maps, ref: CommonUcxShuffleManager.scala:27-33)."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[int, ShuffleEntry] = {}
+        self._lock = threading.Lock()
+
+    def register(self, shuffle_id: int, num_maps: int,
+                 num_partitions: int,
+                 partitioner: str = "hash", bounds=None) -> ShuffleEntry:
+        with self._lock:
+            if shuffle_id in self._entries:
+                raise ValueError(f"shuffle {shuffle_id} already registered")
+            e = ShuffleEntry(shuffle_id, num_maps, num_partitions,
+                             partitioner, bounds)
+            self._entries[shuffle_id] = e
+            return e
+
+    def get(self, shuffle_id: int) -> ShuffleEntry:
+        with self._lock:
+            try:
+                return self._entries[shuffle_id]
+            except KeyError:
+                raise KeyError(f"shuffle {shuffle_id} not registered") from None
+
+    def unregister(self, shuffle_id: int) -> None:
+        """Per-shuffle teardown (ref: CommonUcxShuffleManager.scala:73-77)."""
+        with self._lock:
+            self._entries.pop(shuffle_id, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -1,0 +1,241 @@
+"""Flagship model: expert-parallel MoE riding the shuffle data plane.
+
+SURVEY.md §2.6: the reference's shuffle primitive *is* an MoE-style ragged
+dispatch — R reducers pulling ragged segments from M mappers is exactly E
+experts pulling ragged token segments from P token shards. This module
+demonstrates (and stress-tests) that claim: the expert dispatch AND combine
+are the framework's own :func:`sparkucx_tpu.shuffle.alltoall.exchange`
+collective, differentiable end-to-end, so a training step drives the whole
+data plane — hash-free routing (router logits instead of key hashes) but
+the identical segment-table/exchange machinery.
+
+Parallelism: mesh axes ``(dp, ep)`` — tokens sharded over both, experts
+sharded over ``ep`` and replicated over ``dp``; dispatch crosses only the
+``ep`` axis (each data-parallel row dispatches within itself), so gradient
+psum over ``dp`` is handled by shard_map's replicated-input transpose.
+
+Token overflow per expert follows standard MoE capacity semantics: tokens
+beyond an expert's capacity are dropped (contribute zero). Exchange-level
+capacity overflow NaN-poisons activations (see alltoall.exchange): a
+collapsed router that overflows recv_capacity turns the loss NaN loudly
+instead of silently zeroing the batch; raise ``capacity_factor`` to fix.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sparkucx_tpu.ops.partition import counts_from_sorted
+from sparkucx_tpu.shuffle.alltoall import (
+    exchange, exchange_quantized, ragged_shuffle)
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 64
+    d_hidden: int = 128
+    num_experts: int = 8
+    tokens_per_shard: int = 64     # static per-(dp,ep)-shard token count
+    capacity_factor: float = 2.0   # exchange + expert capacity headroom
+    impl: str = "auto"             # data-plane implementation
+    wire: str = "f32"              # f32 | int8 (wire-quantized dispatch:
+                                   # 4x fewer ICI bytes, STE gradients)
+
+    @property
+    def recv_capacity(self) -> int:
+        return max(8, int(self.tokens_per_shard * self.capacity_factor))
+
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> Dict[str, jnp.ndarray]:
+    """Global (unsharded) parameter pytree."""
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    s = cfg.d_model ** -0.5
+    return {
+        "router": jax.random.normal(k1, (cfg.d_model, cfg.num_experts)) * s,
+        "w1": jax.random.normal(
+            k2, (cfg.num_experts, cfg.d_model, cfg.d_hidden)) * s,
+        "w2": jax.random.normal(
+            k3, (cfg.num_experts, cfg.d_hidden, cfg.d_model))
+        * cfg.d_hidden ** -0.5,
+        "wout": jax.random.normal(k4, (cfg.d_model, cfg.d_model)) * s,
+    }
+
+
+def param_specs(cfg: MoEConfig, dp: str = "dp", ep: str = "ep"):
+    """shard_map in_specs for the param pytree: experts sharded over ep,
+    everything else replicated."""
+    return {
+        "router": P(),
+        "w1": P(ep),
+        "w2": P(ep),
+        "wout": P(),
+    }
+
+
+def _moe_shard(params, x, seed, *, cfg: MoEConfig, ep_axis: str,
+               ep_size: int):
+    """Per-shard forward: route -> dispatch (exchange) -> expert FFN ->
+    combine (exchange back) -> unsort. x: [T, D] local tokens; ``seed`` —
+    [1] int32 step counter feeding the wire-quantization noise stream."""
+    T = cfg.tokens_per_shard
+    E = cfg.num_experts
+    e_local = E // ep_size
+    cap_out = cfg.recv_capacity
+
+    # -- route (top-1) ----------------------------------------------------
+    logits = x @ params["router"]                       # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(logits, axis=-1)                # [T]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    # -- dispatch over ep: destination shard owns expert block -----------
+    dest = (expert // e_local).astype(jnp.int32)        # [T]
+    order = jnp.argsort(dest, stable=True)
+    inv_order = jnp.argsort(order)                      # unsort permutation
+    x_sorted = jnp.take(x, order, axis=0)
+    # counts off the sorted keys, not bincount: XLA:TPU serializes the
+    # colliding scatter-add (ops/partition.counts_from_sorted rationale)
+    counts = counts_from_sorted(jnp.take(dest, order),
+                                ep_size).astype(jnp.int32)
+    seed = jnp.asarray(seed, jnp.int32).reshape(())
+    if cfg.wire == "int8":
+        recv = exchange_quantized(x_sorted, counts, seed * 2, ep_axis,
+                                  cap_out, cfg.impl)
+    else:
+        recv = exchange(x_sorted, counts, ep_axis, cap_out, cfg.impl)
+
+    # -- local expert assignment of received tokens ----------------------
+    shard_id = jax.lax.axis_index(ep_axis)
+    if cfg.wire == "int8":
+        # lossy wire: the expert id must travel WITH the token as lossless
+        # integer rows (its own small exchange) — recomputing argmax on
+        # dequantized rows would disagree with the sender whenever the
+        # quantization noise perturbs near-tied logits, silently zeroing
+        # tokens. Its recv_sizes doubles as the reverse-exchange size row.
+        expert_sorted = jnp.take(expert.astype(jnp.int32), order)
+        rid = ragged_shuffle(expert_sorted[:, None], counts, ep_axis,
+                             out_capacity=cap_out, impl=cfg.impl)
+        rexpert = rid.data[:, 0]
+        recv_sizes = rid.recv_sizes
+    else:
+        # exact wire: recomputing routing on received rows is provably
+        # identical (router replicated, rows bit-exact) — no extra
+        # collective needed, just the tiny count all_gather
+        rexpert = jnp.argmax(recv @ params["router"], axis=-1)
+        recv_sizes = jax.lax.all_gather(counts, ep_axis)[:, shard_id]
+    le = rexpert - shard_id * e_local                   # local expert id
+    my_recv = recv_sizes.sum()
+    j = jnp.arange(cap_out, dtype=jnp.int32)
+    rvalid = j < my_recv
+
+    # -- group by local expert, capacity-bounded scatter ------------------
+    cap_e = max(8, int(cap_out * cfg.capacity_factor / max(e_local, 1)))
+    le_key = jnp.where(rvalid, le.astype(jnp.int32), jnp.int32(e_local))
+    eorder = jnp.argsort(le_key, stable=True)
+    le_sorted = jnp.take(le_key, eorder)
+    rows_sorted = jnp.take(recv, eorder, axis=0)
+    ecounts = counts_from_sorted(le_sorted, e_local)
+    excl = jnp.concatenate(
+        [jnp.zeros((1,), ecounts.dtype), jnp.cumsum(ecounts)[:-1]])
+    le_c = jnp.minimum(le_sorted, e_local - 1)
+    within = jnp.arange(cap_out, dtype=jnp.int32) - excl[le_c].astype(jnp.int32)
+    fits = (within < cap_e) & (le_sorted < e_local)
+    within_c = jnp.clip(within, 0, cap_e - 1)
+    # Pack expert buffers by GATHER off the expert-sorted rows (slot
+    # [e, c] pulls row excl[e] + c), not scatter: the clipped overflow
+    # rows would collide, and colliding scatters serialize on TPU.
+    slot = excl[:, None].astype(jnp.int32) \
+        + jnp.arange(cap_e, dtype=jnp.int32)[None, :]     # [e_local, cap_e]
+    slot_valid = jnp.arange(cap_e, dtype=jnp.int32)[None, :] \
+        < jnp.minimum(ecounts, cap_e)[:, None]
+    ebuf = jnp.where(
+        slot_valid[:, :, None],
+        jnp.take(rows_sorted, jnp.clip(slot, 0, cap_out - 1), axis=0),
+        jnp.zeros((), x.dtype))
+
+    # -- expert FFN on the MXU: batched per-expert matmuls ----------------
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", ebuf, params["w1"]))
+    y = jnp.einsum("ech,ehd->ecd", h, params["w2"])     # [e_local,cap_e,D]
+
+    # -- un-scatter to received order, combine back -----------------------
+    out_sorted = jnp.where(fits[:, None], y[le_c, within_c], 0.0)
+    # unsort by inverse-permutation GATHER (eorder is a permutation; a
+    # row scatter would serialize on TPU)
+    out_recv = jnp.take(out_sorted, jnp.argsort(eorder), axis=0)
+    # reverse exchange: send back what we received (sizes = what each peer
+    # sent us); result arrives in our original destination-sorted layout
+    if cfg.wire == "int8":
+        back = exchange_quantized(out_recv, recv_sizes.astype(jnp.int32),
+                                  seed * 2 + 1, ep_axis, T, cfg.impl)
+    else:
+        back = exchange(out_recv, recv_sizes.astype(jnp.int32), ep_axis,
+                        T, cfg.impl)                    # [T, D]
+    combined = jnp.take(back, inv_order, axis=0)        # original order
+    out = combined * gate[:, None]
+    return out @ params["wout"]
+
+
+def forward(params, x, mesh: Mesh, cfg: MoEConfig,
+            dp_axis: str = "dp", ep_axis: str = "ep", seed=0):
+    """Full-model forward under shard_map. x: [B, D] global tokens,
+    B = dp*ep*tokens_per_shard. ``seed``: step counter for the wire-
+    quantization noise stream (ignored for f32 wire)."""
+    ep_size = dict(zip(mesh.axis_names, mesh.devices.shape))[ep_axis]
+    fn = functools.partial(_moe_shard, cfg=cfg, ep_axis=ep_axis,
+                           ep_size=ep_size)
+    sm = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs(cfg, dp_axis, ep_axis), P((dp_axis, ep_axis)),
+                  P()),
+        out_specs=P((dp_axis, ep_axis)))
+    return sm(params, x, jnp.asarray(seed, jnp.int32).reshape(1))
+
+
+def loss_fn(params, x, y, mesh, cfg, dp_axis="dp", ep_axis="ep", seed=0):
+    pred = forward(params, x, mesh, cfg, dp_axis, ep_axis, seed)
+    return jnp.mean((pred - y) ** 2)
+
+
+def make_train_step(mesh: Mesh, cfg: MoEConfig, lr: float = 1e-3,
+                    dp_axis: str = "dp", ep_axis: str = "ep"):
+    """Jitted full training step (fwd + bwd through both exchanges + SGD).
+
+    The gradient of the dispatch/combine collectives flows through the
+    custom VJP in shuffle/alltoall.py — the transposed exchange."""
+
+    import optax
+    opt = optax.adam(lr)
+
+    def init(rng):
+        params = init_params(rng, cfg)
+        return params, opt.init(params)
+
+    # donate params + optimizer state: the updated pytrees reuse the same
+    # HBM instead of holding two copies live across the update
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, x, y, step_idx=None):
+        # the wire-quantization noise stream must advance every step; by
+        # default ride the optimizer's own step counter so plain
+        # step(params, opt_state, x, y) callers get fresh noise for free
+        if step_idx is None:
+            # a NamedTuple state with a `count` FIELD (e.g. ScaleByAdamState)
+            # — plain tuples also have a .count (the method), so test fields
+            def has_count(s):
+                return "count" in getattr(s, "_fields", ())
+            counts = [s.count for s in jax.tree_util.tree_leaves(
+                opt_state, is_leaf=has_count) if has_count(s)]
+            step_idx = counts[0] if counts else 0
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, x, y, mesh, cfg, dp_axis, ep_axis, step_idx)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init, step

@@ -1,0 +1,401 @@
+"""Flagship long-context transformer — every parallelism axis at once.
+
+The reference is a shuffle transport; its capability ceiling is "move ragged
+partitions between all peers with zero per-block host work" (SURVEY.md §0).
+This model is the framework's end-to-end demonstration that the same data
+plane carries a full 5-axis distributed training step:
+
+  ``dp``  data parallelism        — batch sharded; grads psum'd by shard_map's
+                                    replicated-param transpose
+  ``pp``  pipeline parallelism    — layers sharded into stages; activations
+                                    stream stage-to-stage with ``ppermute``
+                                    over a GPipe-style microbatch tick loop
+  ``sp``  sequence/context        — ring attention streams KV shards around
+                                    the ICI ring (parallel/ring.py)
+  ``tp``  tensor parallelism      — Megatron-style: attention heads and the
+                                    expert hidden dim column-sharded, one
+                                    psum after each second matmul
+  ``ep``  expert parallelism      — MoE dispatch/combine are the framework's
+                                    own differentiable ragged exchange
+                                    (shuffle/alltoall.py), the very collective
+                                    that replaces the reference's ucp_get
+                                    storm (reducer/compat/spark_3_0/
+                                    UcxShuffleClient.java:95-127)
+
+Tokens are sharded over ``(dp, ep)`` jointly outside MoE layers (standard
+expert parallelism: the expert group is a slice of the data-parallel world);
+activations are replicated over ``tp`` and ``pp``-resident per stage.
+
+Everything is static-shape, scan-based, jittable — one compiled XLA program
+per training step.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sparkucx_tpu.ops.attention import NEG_INF, _block_update, _finalize, \
+    make_block_bias
+from sparkucx_tpu.shuffle.alltoall import exchange
+
+AXES = ("dp", "pp", "sp", "tp", "ep")
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 32
+    num_heads: int = 4
+    head_dim: int = 8
+    d_ff: int = 64
+    num_layers: int = 2
+    num_experts: int = 4
+    seq_len: int = 64          # global sequence length
+    microbatches: int = 2      # GPipe microbatches per local batch
+    capacity_factor: float = 2.0
+    impl: str = "auto"         # data-plane implementation for the exchange
+    attn: str = "ring"         # ring | ulysses context parallelism
+    remat: bool = True         # rematerialize each layer in backward:
+    # activation HBM drops from O(layers x seq) to one layer boundary per
+    # scan step, the standard FLOPs-for-memory trade on TPU — large models
+    # are HBM-bound long before they are MXU-bound
+    compute_dtype: str = "float32"  # "bfloat16" = mixed precision: master
+    # params and the optimizer stay f32; activations and matmuls run in
+    # bf16 (the MXU's native width — 2x HBM bandwidth and MXU throughput),
+    # and the loss/softmax runs in f32 for stable reductions
+
+
+def mesh_axis_sizes(mesh: Mesh) -> Dict[str, int]:
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    for ax in AXES:
+        sizes.setdefault(ax, 1)
+    return sizes
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> Dict[str, jnp.ndarray]:
+    """Global (unsharded) parameter pytree; leading axis = layer for
+    everything inside the pipeline."""
+    L, D, H, Dh = cfg.num_layers, cfg.d_model, cfg.num_heads, cfg.head_dim
+    E, F, V = cfg.num_experts, cfg.d_ff, cfg.vocab
+    ks = jax.random.split(rng, 8)
+    s = D ** -0.5
+    return {
+        "embed": jax.random.normal(ks[0], (V, D)) * 1.0,
+        "unembed": jax.random.normal(ks[1], (D, V)) * s,
+        "ln1": jnp.ones((L, D)),
+        "ln2": jnp.ones((L, D)),
+        "wqkv": jax.random.normal(ks[2], (L, 3, D, H, Dh)) * s,
+        "wo": jax.random.normal(ks[3], (L, H, Dh, D)) * (H * Dh) ** -0.5,
+        "router": jax.random.normal(ks[4], (L, D, E)) * s,
+        "w1e": jax.random.normal(ks[5], (L, E, D, F)) * s,
+        "w2e": jax.random.normal(ks[6], (L, E, F, D)) * F ** -0.5,
+    }
+
+
+def param_specs() -> Dict[str, P]:
+    """shard_map in_specs: layers over pp, heads/ff over tp, experts over ep."""
+    return {
+        "embed": P(),
+        "unembed": P(),
+        "ln1": P("pp"),
+        "ln2": P("pp"),
+        "wqkv": P("pp", None, None, "tp", None),
+        "wo": P("pp", "tp", None, None),
+        "router": P("pp"),
+        "w1e": P("pp", "ep", None, "tp"),
+        "w2e": P("pp", "ep", "tp", None),
+    }
+
+
+def _rms_norm(x, scale):
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+def _ring_attn(q, k, v, sp_axis: str):
+    """Causal ring attention on local [mb, h, t, d] shards over ``sp_axis``
+    (the per-shard body of parallel/ring.py, inlined so it composes inside
+    the pipeline scan)."""
+    p = jax.lax.axis_size(sp_axis)
+    idx = jax.lax.axis_index(sp_axis)
+    t = q.shape[2]
+    scale = q.shape[-1] ** -0.5
+    perm = [(j, (j + 1) % p) for j in range(p)]
+
+    def step(carry, s):
+        k_blk, v_blk, o, m, l = carry
+        src = jax.lax.rem(idx - s + p, p)
+        bias = make_block_bias(t, t, idx * t, src * t, True)
+        o, m, l = _block_update(q, k_blk, v_blk, o, m, l, bias, scale)
+        k_nxt = jax.lax.ppermute(k_blk, sp_axis, perm)
+        v_nxt = jax.lax.ppermute(v_blk, sp_axis, perm)
+        return (k_nxt, v_nxt, o, m, l), None
+
+    # Online-softmax accumulators in f32 regardless of compute dtype: the
+    # running denominator l sums thousands of exp terms, and bf16's 8
+    # mantissa bits silently drop any term below ~l/256 (q/k/v stay in
+    # compute dtype — bf16 dots accumulate in f32 on the MXU anyway)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    m0 = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    (k_l, v_l, o, m, l), _ = jax.lax.scan(
+        step, (k, v, o0, m0, l0), jnp.arange(p - 1))
+    src = jax.lax.rem(idx + 1, p)
+    bias = make_block_bias(t, t, idx * t, src * t, True)
+    o, m, l = _block_update(q, k_l, v_l, o, m, l, bias, scale)
+    return _finalize(o, m, l).astype(q.dtype)
+
+
+def _moe_ffn(lp, x, cfg: TransformerConfig, ep_axis: str, tp_axis: str):
+    """Expert FFN on local tokens x: [n, D]. Dispatch/combine over ``ep``
+    via the framework exchange; expert hidden dim sharded over ``tp`` with
+    one psum after w2 (so expert weights are (ep, tp)-2D-sharded)."""
+    n, D = x.shape
+    ep = jax.lax.axis_size(ep_axis)
+    e_local = cfg.num_experts // ep
+    cap_out = max(8, int(n * cfg.capacity_factor))
+
+    # Routing decisions in f32 even under bf16 compute: the 1e-7 tie-break
+    # is below one bf16 ulp of any logit above ~1e-5 (it would round away
+    # and tied tokens would pile onto the lowest expert index), and the
+    # softmax denominator wants f32 anyway.
+    logits = (x.astype(jnp.float32)
+              @ lp["router"].astype(jnp.float32))       # [n, E] (replicated)
+    probs = jax.nn.softmax(logits, axis=-1)
+    # Deterministic tie-break that spreads equal logits uniformly over
+    # experts. Without it, the pipeline's bubble lanes (all-zero activations)
+    # route every token to expert 0, overflow the exchange, and the NaN
+    # poison leaks into weight grads through 0-cotangent bubble paths.
+    E = cfg.num_experts
+    tie = ((jnp.arange(n, dtype=jnp.int32)[:, None]
+            + 31 * jnp.arange(E, dtype=jnp.int32)[None, :]) % E)
+    expert = jnp.argmax(logits + tie.astype(jnp.float32) * 1e-7, axis=-1)
+    gate = jnp.take_along_axis(probs, expert[:, None],
+                               axis=1)[:, 0].astype(x.dtype)
+
+    dest = (expert // e_local).astype(jnp.int32)
+    order = jnp.argsort(dest, stable=True)
+    inv_order = jnp.argsort(order)
+    x_sorted = jnp.take(x, order, axis=0)
+    # counts off the sorted keys, not bincount (TPU-serialized scatter;
+    # see ops/partition.counts_from_sorted)
+    from sparkucx_tpu.ops.partition import counts_from_sorted
+    counts = counts_from_sorted(jnp.take(dest, order),
+                                ep).astype(jnp.int32)
+    # Ship the sender's expert choice losslessly WITH the row (as moe.py's
+    # int8 wire already does): recomputing it receive-side via argmax
+    # diverges whenever a token's top-2 logit gap is below the tie-break
+    # perturbation, and the local-expert mask then silently zeroes that
+    # token's FFN output. Small integers are exact in any float dtype up
+    # to its mantissa range.
+    if cfg.num_experts > 2 ** (jnp.finfo(x.dtype).nmant + 1):
+        raise ValueError(
+            f"num_experts={cfg.num_experts} not exactly representable in "
+            f"{x.dtype}; the expert-id wire column would corrupt routing")
+    xid = jnp.concatenate(
+        [x_sorted, jnp.take(expert, order).astype(x.dtype)[:, None]], axis=1)
+    recv = exchange(xid, counts, ep_axis, cap_out, cfg.impl)
+    rexpert = recv[:, -1].astype(jnp.int32)
+    recv = recv[:, :-1]
+    shard = jax.lax.axis_index(ep_axis)
+    le = (rexpert - shard * e_local).astype(jnp.int32)
+    recv_sizes = jax.lax.all_gather(counts, ep_axis)[:, shard]
+    my_recv = recv_sizes.sum()
+    rvalid = jnp.arange(cap_out, dtype=jnp.int32) < my_recv
+
+    # one-hot expert batching keeps the MXU busy without scatters: tiny
+    # e_local in tests, and at scale XLA turns the einsum into a gather-free
+    # grouped matmul over [e_local, cap, D]
+    oh = (le[:, None] == jnp.arange(e_local, dtype=jnp.int32)[None, :])
+    oh = (oh & rvalid[:, None]).astype(recv.dtype)       # [cap, e_local]
+    xe = jnp.einsum("ce,cd->ecd", oh, recv)              # [e_local, cap, D]
+    h = jax.nn.gelu(jnp.einsum("ecd,edf->ecf", xe, lp["w1e"]))
+    ye = jnp.einsum("ecf,efd->ecd", h, lp["w2e"])        # partial over tp
+    y = jnp.einsum("ce,ecd->cd", oh, ye)                 # [cap, D]
+    y = jax.lax.psum(y, tp_axis)
+
+    back = exchange(y, recv_sizes.astype(jnp.int32), ep_axis, n, cfg.impl)
+    combined = jnp.take(back, inv_order, axis=0)
+    return combined * gate[:, None]
+
+
+def _ulysses_attn(q, k, v, sp_axis: str):
+    """Causal Ulysses attention on local [mb, h, t, d] shards — delegates
+    to the flash-based per-shard body in parallel/ulysses.py (blockwise,
+    O(t) memory), which reshards heads<->sequence with two all-to-alls.
+    Needs local heads divisible by the sp size."""
+    from sparkucx_tpu.parallel.ulysses import _ulysses_sharded
+    p = jax.lax.axis_size(sp_axis)
+    if p > 1 and q.shape[1] % p != 0:
+        raise ValueError(
+            f"ulysses attention needs local heads {q.shape[1]} divisible "
+            f"by sp={p}; use attn='ring' for small head counts")
+    return _ulysses_sharded(q, k, v, axis=sp_axis, causal=True, scale=None,
+                            block_q=256, block_k=512, impl="auto")
+
+
+def _layer(h, lp, cfg: TransformerConfig, sp_axis: str, tp_axis: str,
+           ep_axis: str):
+    """One transformer layer on local [mb, t, D] activations."""
+    mb, t, D = h.shape
+    x = _rms_norm(h, lp["ln1"])
+    q = jnp.einsum("mtd,dhk->mhtk", x, lp["wqkv"][0])
+    k = jnp.einsum("mtd,dhk->mhtk", x, lp["wqkv"][1])
+    v = jnp.einsum("mtd,dhk->mhtk", x, lp["wqkv"][2])
+    if cfg.attn == "ulysses":
+        attn = _ulysses_attn(q, k, v, sp_axis)           # [mb, hl, t, dh]
+    else:
+        attn = _ring_attn(q, k, v, sp_axis)              # [mb, hl, t, dh]
+    proj = jnp.einsum("mhtk,hkd->mtd", attn, lp["wo"])
+    h = h + jax.lax.psum(proj, tp_axis)
+
+    x = _rms_norm(h, lp["ln2"])
+    y = _moe_ffn(lp, x.reshape(mb * t, D), cfg, ep_axis, tp_axis)
+    return h + y.reshape(mb, t, D)
+
+
+def _stage(params, h, cfg: TransformerConfig, sp_axis, tp_axis, ep_axis):
+    """Apply this pipeline stage's layer stack (scan over local layers)."""
+    layer = functools.partial(_layer, cfg=cfg, sp_axis=sp_axis,
+                              tp_axis=tp_axis, ep_axis=ep_axis)
+    if cfg.remat:
+        # recompute the layer in backward instead of saving activations
+        # (cfg.remat docstring); collectives inside replay uniformly on
+        # every device, so the SPMD structure is unchanged
+        layer = jax.checkpoint(layer)
+
+    def body(h, lp):
+        return layer(h, lp), None
+    h, _ = jax.lax.scan(body, h, params)
+    return h
+
+
+def _forward_shard(params, tokens, cfg: TransformerConfig):
+    """Per-device training-forward body under shard_map over AXES.
+
+    ``tokens``: [b, t] local token ids (batch over dp×ep, seq over sp;
+    replicated over pp and tp). Returns local logits [b, t, V] (valid on
+    every device — the last stage's output is psum-broadcast over pp)."""
+    dp, pp, sp, tp, ep = AXES
+    S = jax.lax.axis_size(pp)
+    stage = jax.lax.axis_index(pp)
+    M = cfg.microbatches
+    b, t = tokens.shape
+    mb = b // M
+
+    # mixed precision: cast params + activations once at the boundary;
+    # master copies stay f32 in the optimizer (cfg.compute_dtype). The
+    # unembed is EXCLUDED: the logit matmul runs on genuine f32 master
+    # weights (a bf16 round-trip there would quantize both the logits and,
+    # through the astype VJP, their gradients)
+    cdt = jnp.dtype(cfg.compute_dtype)
+    params = {
+        k: (jax.tree_util.tree_map(
+            lambda p: p.astype(cdt)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, v)
+            if k != "unembed" else v)
+        for k, v in params.items()}
+
+    h_all = jnp.take(params["embed"], tokens, axis=0)    # [b, t, D]
+    h_mb = h_all.reshape(M, mb, t, cfg.d_model)
+
+    stage_params = {k: params[k] for k in
+                    ("ln1", "ln2", "wqkv", "wo", "router", "w1e", "w2e")}
+
+    nticks = M + S - 1
+    fwd_perm = [(j, (j + 1) % S) for j in range(S)]
+
+    def tick(carry, i):
+        recv, out_mb = carry
+        # stage 0 ingests microbatch i (clamped; masked when i >= M)
+        inj = h_mb[jnp.minimum(i, M - 1)]
+        inp = jnp.where(stage == 0, inj, recv)
+        out = _stage(stage_params, inp, cfg, sp, tp, ep)
+        # last stage banks microbatch i - (S-1) when it is live
+        oidx = i - (S - 1)
+        live = (oidx >= 0) & (oidx < M)
+        out_mb = jnp.where(
+            live & (stage == S - 1),
+            out_mb.at[jnp.clip(oidx, 0, M - 1)].set(out), out_mb)
+        recv = jax.lax.ppermute(out, pp, fwd_perm)
+        return (recv, out_mb), None
+
+    out0 = jnp.zeros_like(h_mb)
+    (_, out_mb), _ = jax.lax.scan(
+        tick, (jnp.zeros_like(h_mb[0]), out0), jnp.arange(nticks))
+
+    # broadcast the last stage's result to all pp members so the loss (and
+    # its gradient path) is uniform SPMD
+    out_mb = jax.lax.psum(
+        jnp.where(stage == S - 1, out_mb, jnp.zeros_like(out_mb)), pp)
+    h_out = out_mb.reshape(b, t, cfg.d_model)
+    # unembed + everything downstream (softmax/loss) in f32: bf16 logits
+    # destabilize the log-sum-exp reduction (unembed is still the f32
+    # master copy — excluded from the boundary cast above)
+    return h_out.astype(jnp.float32) @ params["unembed"]  # [b, t, V]
+
+
+def forward(params, tokens, mesh: Mesh, cfg: TransformerConfig):
+    """Global-view forward: tokens [B, T] -> logits [B, T, V]."""
+    fn = functools.partial(_forward_shard, cfg=cfg)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs(), P(("dp", "ep"), "sp")),
+        out_specs=P(("dp", "ep"), "sp"), check_vma=False,
+    )(params, tokens)
+
+
+def loss_fn(params, tokens, targets, mesh, cfg):
+    logits = forward(params, tokens, mesh, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(mesh: Mesh, cfg: TransformerConfig, lr: float = 1e-2):
+    """(init, step): jitted full 5-axis-parallel training step."""
+    import optax
+    opt = optax.adam(lr)
+
+    def init(rng):
+        params = init_params(rng, cfg)
+        return params, opt.init(params)
+
+    # donate params + optimizer state: the updated pytrees reuse the same
+    # HBM instead of holding two copies live across the update
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            params, tokens, targets, mesh, cfg)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init, step
+
+
+def make_mesh(n_devices: int, devices=None,
+              order: tuple = ("ep", "sp", "pp", "tp")) -> Mesh:
+    """Factor n devices over (dp, pp, sp, tp, ep), spending one factor of
+    two on each axis in ``order`` (data plane first by default), with the
+    remainder on dp — so 8 devices exercise ep/sp/pp and 16+ add tp.
+    Alternate orders let a small device count light up different axis
+    combinations (e.g. ("ep", "tp") puts 8 devices on ep=2, tp=2, dp=2)."""
+    sizes = {ax: 1 for ax in AXES}
+    rem = n_devices
+    for ax in order:
+        if rem % 2 == 0:
+            sizes[ax] = 2
+            rem //= 2
+    sizes["dp"] = rem  # leftover factor (including odd) rides the dp axis
+    if devices is None:
+        devices = jax.devices()[:n_devices]
+    arr = np.array(devices).reshape([sizes[ax] for ax in AXES])
+    return Mesh(arr, AXES)

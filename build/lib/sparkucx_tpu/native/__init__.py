@@ -1,0 +1,136 @@
+"""Build-on-demand ctypes loader for the native arena library.
+
+The reference reaches native code through the jucx JNI jar on the classpath
+(ref: pom.xml:70-74, README.md:37-38); here the native piece is first-party
+C++ compiled once into ``_build/libsxt_arena.so`` and loaded with ctypes
+(pybind11 is not available in the image). Set ``SPARKUCX_TPU_NO_NATIVE=1``
+to force the pure-Python fallback in :mod:`sparkucx_tpu.runtime.memory`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "arena.cpp")
+_BUILD_DIR = os.path.join(_DIR, "_build")
+_SO = os.path.join(_BUILD_DIR, "libsxt_arena.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _compile(dst: str = _SO) -> bool:
+    # Build to a per-process temp name and rename into place: concurrent
+    # executor processes on one host (the normal deployment,
+    # ref: buildlib/test.sh:25-31 runs 2+ workers per node) must not race
+    # g++ writes to the shared .so path.
+    tmp = f"{dst}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           "-o", tmp, _SRC]
+    try:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            log.warning("native build failed:\n%s", proc.stderr)
+            return False
+        os.replace(tmp, dst)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.warning("native build unavailable: %s", e)
+        return False
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64, p = ctypes.c_uint64, ctypes.c_void_p
+    lib.sxt_arena_create.argtypes = [u64, u64, ctypes.c_int]
+    lib.sxt_arena_create.restype = p
+    lib.sxt_arena_destroy.argtypes = [p]
+    lib.sxt_get.argtypes = [p, u64]
+    lib.sxt_get.restype = p
+    lib.sxt_ref.argtypes = [p, p]
+    lib.sxt_ref.restype = ctypes.c_int
+    lib.sxt_unref.argtypes = [p, p]
+    lib.sxt_unref.restype = ctypes.c_int
+    lib.sxt_block_size.argtypes = [p, p]
+    lib.sxt_block_size.restype = u64
+    lib.sxt_preallocate.argtypes = [p, u64, u64]
+    lib.sxt_stats.argtypes = [p, ctypes.POINTER(u64)]
+    lib.sxt_mmap.argtypes = [ctypes.c_char_p, ctypes.POINTER(u64), ctypes.c_int]
+    lib.sxt_mmap.restype = p
+    lib.sxt_munmap.argtypes = [p, u64]
+    lib.sxt_munmap.restype = ctypes.c_int
+    lib.sxt_pack_rows.argtypes = [p, p, p, u64, u64, u64, ctypes.c_int]
+    lib.sxt_pack_rows.restype = ctypes.c_int
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.sxt_pack_varbytes.argtypes = [p, i64p, p, u64, u64, ctypes.c_int]
+    lib.sxt_pack_varbytes.restype = ctypes.c_int
+    lib.sxt_unpack_varbytes.argtypes = [p, i64p, p, u64, u64, ctypes.c_int]
+    lib.sxt_unpack_varbytes.restype = ctypes.c_int
+    lib.sxt_hash_varbytes.argtypes = [p, i64p, i64p, u64, ctypes.c_int]
+    lib.sxt_hash_varbytes.restype = ctypes.c_int
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Return the native library, compiling it on first use; None if
+    unavailable (caller falls back to pure Python)."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if os.environ.get("SPARKUCX_TPU_NO_NATIVE") == "1":
+            _load_failed = True
+            return None
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if stale and not _compile():
+            _load_failed = True
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except AttributeError:
+            # A cached .so from an older source LACKS a newly added
+            # symbol (mtime preserved by rsync/archive extraction defeats
+            # the staleness check). Rebuild — but dlopen dedupes by
+            # PATHNAME, so re-loading _SO would return the stale handle:
+            # bind the rebuilt library from a unique path, then rename it
+            # over the shared one for other processes.
+            log.warning("native .so missing a symbol; rebuilding")
+            reload_path = f"{_SO}.{os.getpid()}.reload"
+            try:
+                if _compile(reload_path):
+                    _lib = _bind(ctypes.CDLL(reload_path))
+                    os.replace(reload_path, _SO)
+                else:
+                    _load_failed = True
+            except (OSError, AttributeError) as e:
+                log.warning("native reload failed: %s", e)
+                _load_failed = True
+            finally:
+                if os.path.exists(reload_path):
+                    try:
+                        os.remove(reload_path)
+                    except OSError:
+                        pass
+        except OSError as e:
+            log.warning("native load failed: %s", e)
+            _load_failed = True
+    return _lib

@@ -1,0 +1,261 @@
+"""Device-side combine-by-key — the aggregation half of the reduce side.
+
+The reference's reduce side hands fetched blocks to Spark's STOCK
+deserialize -> aggregate -> sort pipeline on the executor CPU
+(ref: compat/spark_2_4/UcxShuffleReader.scala:80-144; SURVEY.md §3.4
+"deserialize → aggregate → sort (stock)"). The TPU build moves the
+aggregation INTO the compiled exchange step, on both sides:
+
+* map-side combine: rows are summed per (partition, key) BEFORE the
+  all-to-all, so the wire carries one row per distinct key per mapper —
+  Spark's map-side combine, but on the accelerator and fused with the
+  destination sort it needs anyway.
+* reduce-side combine: received segments are merged per key AFTER the
+  all-to-all, so device-to-host transfers carry one row per distinct key
+  (for aggregation workloads like WordCount this shrinks D2H by the
+  duplication factor).
+
+Everything is sort + prefix-sum — no scatter (XLA:TPU serializes colliding
+scatters; see ops/partition.counts_from_sorted) and no gather (a [2M]-row
+gather costs ~55 ms on v5e; carried sort operands are nearly free). The grouping
+sort is BY (partition, key), which is strictly finer than the
+partition-major exchange sort, so combining replaces that sort instead of
+adding one — and its output is key-sorted within each partition, which is
+the reference pipeline's trailing "sort" step for free.
+
+Key ordering: rows carry int64 keys as two int32 words [lo, hi]
+(shuffle/reader.py transport format). Lexicographic (hi signed, lo
+unsigned) compare equals signed int64 compare; the low word is flipped by
+0x8000_0000 so lax.sort's signed int32 compare orders it as unsigned.
+
+Numerics: segment sums are computed as prefix-sum differences (inclusive
+prefix sums carried to segment-end rows, then first-differenced).
+Integers accumulate exactly (int32 lanes wrap mod 2^32, so differences
+stay exact; the store back to a narrower declared dtype wraps, matching
+a cast). Floats accumulate in float32; very long prefixes can lose
+low-order bits versus a per-segment tree sum — the documented trade for
+a scatter-free, gather-free one-pass formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sparkucx_tpu.ops.partition import counts_from_sorted
+
+COMBINERS = ("sum",)
+# plain numpy, not jnp: a module-level jnp scalar would initialize the
+# backend at import time AND become a closed-over device constant (the
+# lifted-parameter fastpath hazard — see reader.step_body)
+_FLIP = np.int32(-0x80000000)   # two's-complement 0x8000_0000
+
+
+def check_combinable(val_tail, val_dtype, op: str) -> None:
+    """Raise unless the declared value schema supports device combining."""
+    if op not in COMBINERS:
+        raise ValueError(f"unknown combiner {op!r}; want one of {COMBINERS}")
+    if val_dtype is None:
+        raise ValueError("combine needs valued rows (keys-only shuffle)")
+    vdt = np.dtype(val_dtype)
+    numeric = np.issubdtype(vdt, np.integer) or np.issubdtype(vdt, np.floating)
+    if not numeric or vdt.itemsize > 4:
+        raise ValueError(
+            f"combine supports numeric value dtypes up to 4 bytes "
+            f"(int8/16/32, float16/32), got {vdt}")
+    nbytes = int(np.prod(val_tail, dtype=np.int64)) * vdt.itemsize
+    if nbytes % 4:
+        raise ValueError(
+            f"combine needs the value row to fill whole transport words; "
+            f"{val_tail} x {vdt} = {nbytes} B (pad the trailing dim)")
+
+
+def keysort_rows(
+    rows: jnp.ndarray,
+    part: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    num_parts: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort transport rows by (partition, signed int64 key), padding last.
+
+    Returns (spart [cap], rows_sorted [cap, W], pcounts [num_parts]) —
+    partition-major, key-sorted within each partition. Unstable: rows
+    with EQUAL (partition, key) land in deterministic but unspecified
+    relative order — Spark's sortByKey promises no tie order either, the
+    combiner's sum is commutative, and stability costs ~40% of the TPU
+    sort (the implicit tie-break index widens the effective key). The
+    ``ordered`` read path's whole device cost, and the shared head of
+    :func:`combine_rows`."""
+    cap, W = rows.shape
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < num_valid
+    pkey = jnp.where(valid, part.astype(jnp.int32), jnp.int32(num_parts))
+    sort_ops = (pkey,
+                jnp.where(valid, rows[:, 1], 0),
+                jnp.where(valid, rows[:, 0] ^ _FLIP, 0)) \
+        + tuple(rows[:, i] for i in range(W))
+    out = jax.lax.sort(sort_ops, num_keys=3, is_stable=False)
+    spart, srows = out[0], jnp.stack(out[3:], axis=1)
+    return spart, srows, counts_from_sorted(spart, num_parts)
+
+
+def _words_to_vals(words: jnp.ndarray, vdt: np.dtype) -> jnp.ndarray:
+    """Reinterpret [cap, vw] int32 transport words as the value dtype."""
+    cap, vw = words.shape
+    if vdt.itemsize == 4:
+        return jax.lax.bitcast_convert_type(words, vdt)
+    # smaller lanes: bitcast adds a trailing axis of 4/itemsize
+    out = jax.lax.bitcast_convert_type(words, vdt)
+    return out.reshape(cap, vw * (4 // vdt.itemsize))
+
+
+def _vals_to_words(vals: jnp.ndarray, vdt: np.dtype, vw: int) -> jnp.ndarray:
+    """Inverse of _words_to_vals."""
+    cap = vals.shape[0]
+    if vdt.itemsize == 4:
+        return jax.lax.bitcast_convert_type(vals, jnp.int32)
+    ratio = 4 // vdt.itemsize
+    return jax.lax.bitcast_convert_type(
+        vals.reshape(cap, vw, ratio), jnp.int32)
+
+
+def combine_rows(
+    rows: jnp.ndarray,
+    part: jnp.ndarray,
+    num_valid: jnp.ndarray,
+    num_parts: int,
+    val_words_n: int,
+    val_dtype,
+    op: str = "sum",
+    sum_words: int = 0,
+    compaction: str = "stable",
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Group rows by (partition, int64 key) and combine values per group.
+
+    rows       — [cap, W] int32 transport rows (cols 0,1 = key lo,hi; the
+                 next ``val_words_n`` cols are the bit-packed value).
+    part       — [cap] int32 partition id per row (from the partitioner).
+    num_valid  — scalar count of real rows.
+    num_parts  — static partition count R.
+    val_words_n— value width in int32 words.
+    val_dtype  — declared numeric dtype (validated by check_combinable).
+    sum_words  — transport words (from the value's start) the combiner
+                 SUMS; the remaining ``val_words_n - sum_words`` words are
+                 CARRIED — one representative per key survives, byte-
+                 identical. 0 means sum everything (the default). Carried
+                 lanes hold per-key-constant payloads, e.g. the
+                 length-prefixed word bytes of a text WordCount
+                 (io/varlen.py pack_counted_varbytes): equal within a key
+                 by construction, so any representative is THE value.
+    compaction — the end-row compaction sort formulation, bit-identical
+                 results either way (property-tested):
+                 ``stable``   — 1-key (flag) stable sort; relies on
+                                stability to keep the (part, key) order
+                                from the grouping sort.
+                 ``unstable`` — 4-key (flag, part, key_hi, key_lo)
+                                unstable sort; end rows are unique per
+                                (part, key), so explicit keys restore the
+                                exact same order without paying the
+                                stability machinery (~40% of TPU sort
+                                cost per the round-2 A/B — the candidate
+                                for the 101 ms combine laggard).
+
+    Returns (rows_out [cap, W], pcounts [num_parts], n_out [1]):
+    rows_out's first n_out rows are one row per distinct (partition, key),
+    sorted by (partition, key) — partition-major AND key-sorted within
+    each partition; pcounts[r] = distinct keys of partition r. Rows past
+    n_out are zero."""
+    vdt = np.dtype(val_dtype)
+    if sum_words > val_words_n:
+        # same check _decorated_plan applies — a silent clamp here would
+        # sum carried payload bytes on a caller bug, corrupting records
+        raise ValueError(
+            f"sum_words={sum_words} > value width {val_words_n} words")
+    if sum_words <= 0:
+        sum_words = val_words_n
+    carry_n = val_words_n - sum_words
+    cap, W = rows.shape
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < num_valid
+
+    # ---- one grouping sort: (partition, key_hi, key_lo-as-unsigned) ----
+    spart, srows, _ = keysort_rows(rows, part, num_valid, num_parts)
+
+    # ---- segment ENDS: last valid row, or row before a (part, key)
+    # change. Ends (not starts) are the anchor because the inclusive
+    # prefix sum AT an end row, differenced against the previous end's,
+    # IS the segment sum — consecutive in sorted order, no index gather.
+    key_eq = (srows[:, 0] == jnp.roll(srows[:, 0], 1)) \
+        & (srows[:, 1] == jnp.roll(srows[:, 1], 1))
+    part_eq = spart == jnp.roll(spart, 1)
+    is_start = valid & ~(key_eq & part_eq)
+    is_start = is_start.at[0].set(num_valid > 0)
+    n_out = is_start.sum().astype(jnp.int32)
+    is_end = valid & (jnp.roll(is_start, -1) | (idx == num_valid - 1))
+
+    # ---- inclusive prefix sums of the (masked) summed lanes -------------
+    vals = _words_to_vals(srows[:, 2:2 + sum_words], vdt)
+    acc_dt = jnp.float32 if np.issubdtype(vdt, np.floating) else jnp.int32
+    acc = jnp.where(valid[:, None], vals.astype(acc_dt), 0)
+    incl = jnp.cumsum(acc, axis=0)                        # [cap, m]
+
+    # ---- compact end rows to the front, CARRYING their columns ----------
+    # One stable 1-key sort moves every segment-end row (keys, partition,
+    # prefix-sum lanes, carried payload words) to the front in
+    # (partition, key) order. Round-2 lesson from the v5e: a [2M]-row
+    # gather costs ~55 ms while a carried multisort operand is nearly
+    # free — the previous formulation did FOUR such gathers (seg_end,
+    # starts, key_cols, spart) and spent 287 ms at 2M rows; this one does
+    # zero. Carried value lanes ride the same sort: the end row IS the
+    # representative, no differencing.
+    flag = jnp.where(is_end, 0, 1).astype(jnp.int32)
+    m = incl.shape[1]
+    if compaction == "unstable":
+        # explicit (flag, part, key) keys — end rows are unique per
+        # (part, key), so the unstable order equals the stable one; the
+        # lo word is flipped for unsigned compare (module docstring).
+        # Dead (flag=1) rows land in arbitrary order past n_out, where
+        # every lane is masked to zero below.
+        sort_ops = (flag, spart, srows[:, 1],
+                    srows[:, 0] ^ jnp.int32(_FLIP)) \
+            + (srows[:, 0],) \
+            + tuple(incl[:, t] for t in range(m)) \
+            + tuple(srows[:, 2 + sum_words + t] for t in range(carry_n))
+        out = jax.lax.sort(sort_ops, num_keys=4, is_stable=False)
+        epart, khi, klo = out[1], out[2], out[4]
+        ends_incl = jnp.stack(out[5:5 + m], axis=1)       # [cap, m]
+        carry_start = 5 + m
+    elif compaction == "stable":
+        sort_ops = (flag, srows[:, 0], srows[:, 1], spart) \
+            + tuple(incl[:, t] for t in range(m)) \
+            + tuple(srows[:, 2 + sum_words + t] for t in range(carry_n))
+        out = jax.lax.sort(sort_ops, num_keys=1, is_stable=True)
+        klo, khi, epart = out[1], out[2], out[3]
+        ends_incl = jnp.stack(out[4:4 + m], axis=1)       # [cap, m]
+        carry_start = 4 + m
+    else:
+        raise ValueError(
+            f"unknown compaction {compaction!r}; want stable|unstable")
+
+    # ---- segment sums = first differences of end-row prefix sums --------
+    live = idx < n_out
+    prev = jnp.concatenate(
+        [jnp.zeros((1, ends_incl.shape[1]), ends_incl.dtype),
+         ends_incl[:-1]], axis=0)
+    seg_sum = jnp.where(live[:, None], ends_incl - prev, 0).astype(vals.dtype)
+
+    pieces = [jnp.stack([klo, khi], axis=1),
+              _vals_to_words(seg_sum, vdt, sum_words)]
+    if carry_n:
+        pieces.append(jnp.stack(out[carry_start:], axis=1))  # [cap, carry_n]
+    if W - 2 - val_words_n:
+        pieces.append(jnp.zeros((cap, W - 2 - val_words_n), jnp.int32))
+    rows_out = jnp.concatenate(pieces, axis=1)
+    rows_out = jnp.where(live[:, None], rows_out, 0)
+
+    out_part = jnp.where(live, epart, jnp.int32(num_parts))
+    pcounts = counts_from_sorted(out_part, num_parts)
+    return rows_out, pcounts, n_out.reshape(1)

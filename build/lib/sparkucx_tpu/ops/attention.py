@@ -1,0 +1,129 @@
+"""Attention core ops — the compute kernel under the context-parallel layer.
+
+The reference has no attention code (SURVEY.md §2.6: SP/CP absent); its
+scaling primitive is the ragged all-to-all over index-file offsets. The TPU
+framework makes long-context a first-class capability on top of the same
+machinery: :mod:`sparkucx_tpu.parallel.ring` streams KV blocks around the
+ICI ring (ppermute), :mod:`sparkucx_tpu.parallel.ulysses` reshards
+sequence<->heads with all-to-all — both reduce to this module's blockwise
+online-softmax attention for the per-block math.
+
+Conventions: tensors are ``[batch, num_heads, seq, head_dim]`` (B, H, T, D);
+softmax scale defaults to ``D ** -0.5``; masks use additive ``-inf``-style
+big-negative bias. Everything is jit/scan-friendly: static shapes, no
+data-dependent Python control flow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # finite "-inf": keeps exp()/where() NaN-free under masking
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False,
+                        scale: Optional[float] = None) -> jax.Array:
+    """Plain O(T^2)-memory softmax attention; the test oracle."""
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = logits.shape[-2], logits.shape[-1]
+        row = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        logits = jnp.where(col <= row + (tk - tq), logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _block_update(q, k_blk, v_blk, o, m, l, bias, scale):
+    """One online-softmax accumulation step (the flash-attention recurrence).
+
+    ``o``: [B,H,Tq,D] unnormalised accumulator, ``m``: [B,H,Tq] running max,
+    ``l``: [B,H,Tq] running denominator. ``bias``: [Tq, Tk] additive mask
+    for this block (0 or NEG_INF entries). Fully-masked rows stay NaN-free:
+    m stays NEG_INF, the correction factor is forced to 1 and the block
+    contribution to 0.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale  # [B,H,Tq,Tk]
+    if bias is not None:
+        s = s + bias[None, None, :, :]
+    m_blk = jnp.max(s, axis=-1)                          # [B,H,Tq]
+    m_new = jnp.maximum(m, m_blk)
+    # rows with no live key anywhere so far: keep everything at zero
+    dead = m_new <= NEG_INF / 2
+    m_safe = jnp.where(dead, 0.0, m_new)
+    alpha = jnp.where(dead, 1.0, jnp.exp(m - m_safe))    # rescale old state
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(dead[..., None], 0.0, p)
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v_blk)
+    return o_new, m_new, l_new
+
+
+def _finalize(o, m, l):
+    """Normalise the accumulator; fully-masked rows yield zeros."""
+    denom = jnp.where(l <= 0.0, 1.0, l)
+    return o / denom[..., None]
+
+
+def blockwise_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        block_k: int = 512, causal: bool = False,
+                        scale: Optional[float] = None,
+                        q_offset: int = 0) -> jax.Array:
+    """Memory-efficient attention: stream K/V in blocks with online softmax.
+
+    Differentiable (pure lax.scan — XLA rematerialises the blocks), static
+    shapes throughout; ``q_offset`` is the global position of ``q``'s first
+    row, which makes the same routine serve the sharded callers.
+    """
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    B, H, Tk, D = k.shape
+    Tq = q.shape[2]
+    block_k = min(block_k, Tk)
+    if Tk % block_k != 0:
+        raise ValueError(f"seq len {Tk} not divisible by block_k {block_k}")
+    nblk = Tk // block_k
+    kb = k.reshape(B, H, nblk, block_k, D).transpose(2, 0, 1, 3, 4)
+    vb = v.reshape(B, H, nblk, block_k, D).transpose(2, 0, 1, 3, 4)
+
+    row = q_offset + jax.lax.broadcasted_iota(jnp.int32, (Tq, block_k), 0)
+    col0 = jax.lax.broadcasted_iota(jnp.int32, (Tq, block_k), 1)
+
+    def step(carry, inp):
+        o, m, l = carry
+        blk_idx, k_blk, v_blk = inp
+        bias = None
+        if causal:
+            col = blk_idx * block_k + col0
+            bias = jnp.where(col <= row, 0.0, NEG_INF)
+        o, m, l = _block_update(q, k_blk, v_blk, o, m, l, bias, scale)
+        return (o, m, l), None
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full(q.shape[:-1], NEG_INF, q.dtype)
+    l0 = jnp.zeros(q.shape[:-1], q.dtype)
+    (o, m, l), _ = jax.lax.scan(
+        step, (o0, m0, l0), (jnp.arange(nblk), kb, vb))
+    return _finalize(o, m, l)
+
+
+def make_block_bias(tq: int, tk: int, q_offset, k_offset,
+                    causal: bool) -> Optional[jax.Array]:
+    """[tq, tk] additive bias for a (q-block, kv-block) pair at global
+    offsets; offsets may be traced scalars (ring step indices)."""
+    if not causal:
+        return None
+    row = q_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+    col = k_offset + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+    return jnp.where(col <= row, 0.0, NEG_INF)
+
+
+__all__ = [
+    "NEG_INF", "reference_attention", "blockwise_attention",
+    "make_block_bias", "_block_update", "_finalize",
+]

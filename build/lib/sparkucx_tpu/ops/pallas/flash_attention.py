@@ -1,0 +1,373 @@
+"""Pallas flash-attention kernels — the MXU hot path for the attention ops.
+
+The reference has no compute kernels (its native layer is the external UCX
+C library, SURVEY.md §0); this framework's equivalent of "drop to native
+for the hot path" is a Pallas kernel feeding the MXU.
+
+Design (VMEM-bounded at any sequence length):
+
+* The grid is ``(B*H, T/block_q, T/block_k)``; the LAST grid axis iterates
+  sequentially on TPU, so the online-softmax state (accumulator, running
+  max, running sum) lives in VMEM scratch carried across K/V steps —
+  initialized at the first K block, finalized (normalize + write O and the
+  logsumexp row) at the last. Each step touches only a ``[block_q, D]`` Q
+  tile and ``[block_k, D]`` K/V tiles: VMEM use is O(block · D)
+  regardless of T, unlike a whole-sequence K/V BlockSpec (the round-1
+  kernel's flaw — 2·T·D·4 bytes blows VMEM past T≈8K).
+* Non-divisible T pads up to the block lcm; padded key columns are masked
+  to -inf, padded query rows produce zeros and are sliced off. No
+  gcd-degenerate block sizes for prime T.
+* The backward pass is two more Pallas kernels (the standard flash-
+  attention recomputation form): ``dq`` accumulates over K blocks with
+  the forward's saved logsumexp; ``dk/dv`` swaps the loop nest and
+  accumulates over Q blocks. ``delta = rowsum(dO * O)`` is precomputed in
+  XLA. The scan implementation (ops/attention.py) remains the CPU
+  fallback and the parity oracle.
+
+Use :func:`flash_attention`; it dispatches pallas-on-TPU / scan-elsewhere
+and is differentiable either way.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from sparkucx_tpu.ops.attention import NEG_INF, blockwise_attention
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _iota2(n, m, axis):
+    return jax.lax.broadcasted_iota(jnp.int32, (n, m), axis)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, mrun, lrun, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                nk: int, t_real: int):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    bq, d = q_ref.shape[1], q_ref.shape[2]
+    bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        mrun[...] = jnp.full_like(mrun, NEG_INF)
+        lrun[...] = jnp.zeros_like(lrun)
+
+    row = i * block_q + _iota2(bq, bk, 0)          # absolute q positions
+    col = j * block_k + _iota2(bq, bk, 1)          # absolute k positions
+
+    # causal: skip K blocks strictly above the diagonal for this Q tile
+    live = (j * block_k <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        mask = col < t_real                         # tail padding
+        if causal:
+            mask &= col <= row
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = mrun[:, 0]
+        l_prev = lrun[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        dead = m_new <= NEG_INF / 2                 # fully-masked row
+        m_safe = jnp.where(dead, 0.0, m_new)
+        alpha = jnp.where(dead, 1.0, jnp.exp(m_prev - m_safe))
+        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.where(dead[:, None], 0.0, p)
+        lrun[:, 0] = l_prev * alpha + jnp.sum(p, axis=-1)
+        mrun[:, 0] = m_new
+        acc[...] = acc[...] * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = lrun[:, 0]
+        denom = jnp.where(l <= 0.0, 1.0, l)
+        o_ref[0] = (acc[...] / denom[:, None]).astype(o_ref.dtype)
+        # logsumexp row for the backward recomputation; 0 for dead rows
+        lse = jnp.where(l <= 0.0, 0.0, mrun[:, 0] + jnp.log(denom))
+        lse_ref[0, 0] = lse
+
+
+def _fwd_pallas(q, k, v, bq, bk, causal, scale, interpret, t_real):
+    BH, T, D = q.shape
+    nq, nk = T // bq, T // bk
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        nk=nk, t_real=t_real)
+    return pl.pallas_call(
+        kernel,
+        # lse rides as [BH, 1, T]: a 2-D [BH, T] output would need block
+        # (1, bq), whose sublane dim (1) violates Mosaic's (8, 128) tiling
+        # rule; with the unit middle axis the block's last two dims are
+        # (1, bq) where 1 == the array dim — the allowed "equal" escape
+        out_shape=(jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+                   jax.ShapeDtypeStruct((BH, 1, T), jnp.float32)),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=(pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i))),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32),
+                        pltpu.VMEM((bq, 1), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref, dq_ref, dqa, *,
+               scale: float, causal: bool, block_q: int, block_k: int,
+               nk: int, t_real: int):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    bq = q_ref.shape[1]
+    bk = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dqa[...] = jnp.zeros_like(dqa)
+
+    row = i * block_q + _iota2(bq, bk, 0)
+    col = j * block_k + _iota2(bq, bk, 1)
+    live = (j * block_k <= (i + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        mask = col < t_real
+        if causal:
+            mask &= col <= row
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt_ref[0, 0][:, None]) * scale
+        dqa[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0] = dqa[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, dlt_ref,
+                dk_ref, dv_ref, dka, dva, *,
+                scale: float, causal: bool, block_q: int, block_k: int,
+                nq: int, t_real: int):
+    i = pl.program_id(1)                            # k-block index
+    j = pl.program_id(2)                            # q-block index
+    bk = k_ref.shape[1]
+    bq = q_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dka[...] = jnp.zeros_like(dka)
+        dva[...] = jnp.zeros_like(dva)
+
+    row = j * block_q + _iota2(bq, bk, 0)
+    col = i * block_k + _iota2(bq, bk, 1)
+    # causal: this K block only sees Q rows at or below its diagonal
+    live = ((j + 1) * block_q - 1 >= i * block_k) if causal else True
+
+    @pl.when(live)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        g = g_ref[0].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)     # [bq, bk]
+        mask = col < t_real
+        if causal:
+            mask &= col <= row
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)
+        dva[...] += jax.lax.dot_general(            # p^T @ g
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt_ref[0, 0][:, None]) * scale
+        dka[...] += jax.lax.dot_general(            # ds^T @ q
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nq - 1)
+    def _finalize():
+        dk_ref[0] = dka[...].astype(dk_ref.dtype)
+        dv_ref[0] = dva[...].astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, g, lse, delta, bq, bk, causal, scale, interpret,
+                t_real):
+    BH, T, D = q.shape
+    nq, nk = T // bq, T // bk
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nk=nk, t_real=t_real),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, nq=nq, t_real=t_real),
+        out_shape=(jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, T, D), v.dtype)),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, j)),
+        ],
+        out_specs=(pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, bk, D), lambda b, i, j: (b, i, 0))),
+        scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                        pltpu.VMEM((bk, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# padding wrapper + custom VJP
+# ---------------------------------------------------------------------------
+
+def _pad_t(x, tp):
+    T = x.shape[1]
+    if T == tp:
+        return x
+    return jnp.pad(x, ((0, 0), (0, tp - T), (0, 0)))
+
+
+def _pow2_floor(x: int) -> int:
+    return 1 << (max(x, 1).bit_length() - 1)
+
+
+def _flash_call(q, k, v, block_q, block_k, causal, scale, interpret):
+    """Flatten [B, H, T, D] -> [BH, Tp, D], run the padded kernel, return
+    (out [B,H,T,D], residuals for the backward).
+
+    Blocks snap DOWN to powers of two (<= T), so the smaller always
+    divides the larger and the pad is < max(bq, bk) rows — never the
+    lcm blowup a free-form pair would give (e.g. blocks 256/264 -> lcm
+    8448 would pad T=260 by 32x)."""
+    B, H, T, D = q.shape
+    bq = max(8, _pow2_floor(min(block_q, T)))
+    bk = max(8, _pow2_floor(min(block_k, T)))
+    tp = _round_up(T, max(bq, bk))
+    # Mosaic lane rule: the lse block's last dim (bq) must be divisible by
+    # 128 or equal the (padded) array dim. Small sequences collapse to one
+    # block; mid sizes clamp the q block up to 128.
+    if tp <= 128:
+        bq = bk = tp = _round_up(T, 8)
+    elif bq < 128:
+        bq = 128
+        tp = _round_up(T, max(bq, bk))
+    qf = _pad_t(q.reshape(B * H, T, D), tp)
+    kf = _pad_t(k.reshape(B * H, T, D), tp)
+    vf = _pad_t(v.reshape(B * H, T, D), tp)
+    out, lse = _fwd_pallas(qf, kf, vf, bq, bk, causal, scale, interpret, T)
+    return out, lse, (qf, kf, vf, bq, bk, tp)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, block_q, block_k, causal, scale, interpret):
+    B, H, T, D = q.shape
+    out, _, _ = _flash_call(q, k, v, block_q, block_k, causal, scale,
+                            interpret)
+    return out[:, :T].reshape(B, H, T, D)
+
+
+def _flash_fwd(q, k, v, block_q, block_k, causal, scale, interpret):
+    B, H, T, D = q.shape
+    out, lse, (qf, kf, vf, bq, bk, tp) = _flash_call(
+        q, k, v, block_q, block_k, causal, scale, interpret)
+    res = (qf, kf, vf, out, lse, (B, H, T, D, bq, bk, tp))
+    return out[:, :T].reshape(B, H, T, D), res
+
+
+def _flash_bwd(block_q, block_k, causal, scale, interpret, res, g):
+    qf, kf, vf, out, lse, (B, H, T, D, bq, bk, tp) = res
+    gf = _pad_t(g.reshape(B * H, T, D).astype(jnp.float32), tp)
+    # delta = rowsum(dO * O): cheap elementwise+reduce, stays in XLA.
+    # [BH, 1, Tp] to match the kernels' 3-D lse/delta block layout.
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)[:, None, :]
+    dq, dk, dv = _bwd_pallas(qf, kf, vf, gf.astype(qf.dtype), lse, delta,
+                             bq, bk, causal, scale, interpret, T)
+    trim = lambda x: x[:, :T].reshape(B, H, T, D)
+    return trim(dq), trim(dk), trim(dv)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    block_q: int = 256, block_k: int = 256,
+                    causal: bool = False, scale: Optional[float] = None,
+                    impl: str = "auto") -> jax.Array:
+    """[B, H, T, D] attention; pallas kernels on TPU, scan fallback on CPU.
+
+    ``impl``: 'auto' | 'pallas' | 'interpret' (pallas interpreter — CPU
+    debugging) | 'scan'. Differentiable under every impl; 'pallas' /
+    'interpret' use the flash backward kernels.
+    """
+    scale_ = q.shape[-1] ** -0.5 if scale is None else scale
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "scan"
+    if impl == "scan":
+        return blockwise_attention(q, k, v, block_k=block_k, causal=causal,
+                                   scale=scale_)
+    if impl not in ("pallas", "interpret"):
+        raise ValueError(f"unknown flash_attention impl {impl!r}")
+    return _flash(q, k, v, block_q, block_k, causal, scale_,
+                  impl == "interpret")
+
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,258 @@
+"""First-party ragged all-to-all — Pallas remote-DMA transport (experimental).
+
+This is the framework's own collective: per-peer one-sided DMA writes over
+ICI, the direct TPU analog of the reference's UCX data plane (one-sided
+``ucp_get``/``ucp_put`` into registered remote memory,
+ref: reducer/compat/spark_3_0/UcxShuffleClient.java:95-127,
+CommonUcxShuffleBlockResolver.scala:91-98) — built with
+``pltpu.make_async_remote_copy`` instead of XLA's ``ragged_all_to_all``
+op. It exists as the measured alternative for the collective's cost
+structure (round-2: the stock op spends ~23 ms on an 80 MB single-device
+exchange — bookkeeping, not wire) and as the natural home for DMA-level
+optimizations XLA cannot express (chunk pipelining, priority hints).
+
+Layout contract — CHUNK-ALIGNED segments. Mosaic DMA slices must be
+128-lane aligned, so the kernel moves whole chunks of
+``chunk_rows = 128 // gcd(width, 128)`` rows (`chunk_rows * width` int32
+words ≡ 0 mod 128) and requires both the send buffer and the receive
+buffer to place every per-peer segment at a chunk-aligned row offset,
+padded up to a chunk multiple. :func:`aligned_plan` computes those
+offsets from a size row; senders and receivers derive identical plans
+from the all-gathered size matrix (the same derive-don't-ship trick the
+reference plays with index-file offsets,
+ref: OnOffsetsFetchCallback.java:44-52). Pad rows travel with their
+segment; consumers mask them with the per-segment valid sizes the plan
+carries. A dense-packed result (the stock op's contract) costs one
+receive-side compaction gather — by design left to the caller, because
+the partition-major reader can consume the aligned layout directly with
+prefix-sum arithmetic.
+
+Validation without hardware: the unit tests run the kernel in Pallas TPU
+INTERPRET mode (cross-device DMA simulation with race detection) on the
+CPU mesh against a numpy oracle, and AOT-compile it against an unattached
+v5e topology (shuffle/aot.py pattern) to prove the Mosaic lowering.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128  # int32 lane tiling of HBM DMA slices
+
+
+def chunk_rows_for(width: int) -> int:
+    """Smallest row chunk whose flat int32 word count is 128-aligned."""
+    if width <= 0:
+        raise ValueError("width must be positive")
+    return LANES // math.gcd(width, LANES)
+
+
+def align_rows(n, chunk: int):
+    """Round a row count up to a chunk multiple (jnp or python int)."""
+    return ((n + chunk - 1) // chunk) * chunk
+
+
+def aligned_plan(sizes: jnp.ndarray, axis_name: str, width: int
+                 ) -> Tuple[jnp.ndarray, ...]:
+    """Chunk-aligned exchange plan from my [P] size row (rows units).
+
+    Returns (in_off, in_sz, out_off, recv_sz, recv_off, total_aligned,
+    real_recv, max_recv_total):
+      in_off[j]   — aligned row offset of my j-segment in MY send buffer
+      in_sz[j]    — aligned row count of that segment (>= sizes[j])
+      out_off[j]  — aligned row offset where MY segment lands on peer j
+      recv_sz[j]  — aligned row count I receive from peer j
+      recv_off[j] — aligned row offset of peer j's segment in MY output
+      total_aligned — valid aligned prefix of my output
+      real_recv[j]  — UNALIGNED rows I receive from peer j
+      max_recv_total — max aligned receive total over ALL devices (the
+                       capacity-overflow predicate; identical everywhere)
+    One all_gather of the raw size matrix; everything else is local
+    arithmetic, identical on every device."""
+    chunk = chunk_rows_for(width)
+    all_raw = lax.all_gather(sizes.astype(jnp.int32), axis_name)  # [P, P]
+    all_sz = align_rows(all_raw, chunk)                           # [P, P]
+    me = lax.axis_index(axis_name)
+    a_sizes = all_sz[me]                                          # [P]
+    in_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(a_sizes)[:-1]]
+    ).astype(jnp.int32)
+    # out_off[j]: where my aligned segment starts on receiver j =
+    # sum of aligned sizes of senders i < me toward j
+    col_cum = jnp.cumsum(all_sz, axis=0)                          # [P, P]
+    excl = col_cum - all_sz
+    out_off = excl[me].astype(jnp.int32)                          # [P]
+    recv_sz = all_sz[:, me].astype(jnp.int32)                     # [P]
+    recv_off = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(recv_sz)[:-1]]
+    ).astype(jnp.int32)
+    total_aligned = recv_sz.sum().astype(jnp.int32)
+    real_recv = all_raw[:, me].astype(jnp.int32)                  # [P]
+    max_recv_total = all_sz.sum(axis=0).max().astype(jnp.int32)
+    max_send_total = all_sz.sum(axis=1).max().astype(jnp.int32)
+    return (in_off, a_sizes, out_off, recv_sz, recv_off, total_aligned,
+            real_recv, max_recv_total, max_send_total)
+
+
+def _kernel(in_off, in_sz, out_off, recv_sz, x_ref, o_ref,
+            send_sem, recv_sem, *, num_devices: int):
+    """One-shot all-to-all: P one-sided DMA writes + byte-counted waits.
+
+    Offsets/sizes arrive PRE-CONVERTED to flat [M, 128]-row units via
+    scalar prefetch ([1, P] SMEM refs); the data refs are the flat
+    views."""
+    # Entry barrier: a one-sided write must not land before its target
+    # device has entered the kernel and owns its output buffer (the
+    # rendezvous role of the reference's preconnect + blocking put wait,
+    # ref: CommonUcxShuffleBlockResolver.scala:100-103).
+    bar = pltpu.get_barrier_semaphore()
+    for j in range(num_devices):
+        pltpu.semaphore_signal(bar, 1, device_id=(j,),
+                               device_id_type=pltpu.DeviceIdType.MESH)
+    pltpu.semaphore_wait(bar, num_devices)
+
+    def send_desc(j):
+        return pltpu.make_async_remote_copy(
+            x_ref.at[pl.ds(in_off[0, j], in_sz[0, j])],
+            o_ref.at[pl.ds(out_off[0, j], in_sz[0, j])],
+            send_sem, recv_sem, device_id=jnp.int32(j),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    # Issue all sends up front (static peer loop, dynamic aligned sizes);
+    # the DMA engine pipelines them. ZERO-size segments issue no DMA at
+    # all — a zero-length descriptor never signals its semaphores and
+    # wedges both the interpreter and the wait protocol.
+    for j in range(num_devices):
+        @pl.when(in_sz[0, j] > 0)
+        def _(j=j):
+            send_desc(j).start()
+    for j in range(num_devices):
+        @pl.when(in_sz[0, j] > 0)
+        def _(j=j):
+            # reconstructed descriptor: wait_send only consumes the
+            # byte count, which matches the started copy exactly
+            send_desc(j).wait_send()
+    # Arrival: DMA semaphores count BYTES and are only waitable through a
+    # descriptor, so wait one reconstructed descriptor per sender sized
+    # by the aligned amount that sender ships me.
+    roff = jnp.int32(0)
+    for i in range(num_devices):
+        @pl.when(recv_sz[0, i] > 0)
+        def _(i=i, roff=roff):
+            rc = pltpu.make_async_remote_copy(
+                x_ref.at[pl.ds(0, recv_sz[0, i])],
+                o_ref.at[pl.ds(roff, recv_sz[0, i])],
+                send_sem, recv_sem, device_id=jnp.int32(i),
+                device_id_type=pltpu.DeviceIdType.LOGICAL)
+            rc.wait_recv()
+        roff = roff + recv_sz[0, i]
+
+
+def pallas_ragged_all_to_all(
+    data: jnp.ndarray,
+    sizes: jnp.ndarray,
+    axis_name: str,
+    *,
+    out_capacity: int,
+    num_devices: int,
+    interpret: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Exchange CHUNK-ALIGNED segments over the mesh axis. Call inside
+    ``shard_map``.
+
+    data         — [cap_in, width] int32; my segment for peer j occupies
+                   rows [aligned_off(j), +sizes[j]) where aligned_off is
+                   :func:`aligned_plan`'s in_off (segments start at chunk
+                   multiples; rows between sizes[j] and the aligned end
+                   are pad and travel as-is).
+    sizes        — [P] REAL (unaligned) rows destined to each peer.
+    out_capacity — static output rows; must be a chunk multiple and hold
+                   the aligned total (caller provisions via
+                   ``align_rows(cap, chunk) + P * chunk`` headroom).
+
+    Returns (out, recv_sizes, recv_off, total_aligned): ``out`` holds one
+    aligned segment per sender at ``recv_off[i]`` with ``recv_sizes[i]``
+    REAL rows (pad after); rows outside every segment are unspecified.
+    Capacity overflow on ANY device skips the whole exchange mesh-wide
+    (zero recv_sizes, total_aligned == -1) — never a one-sided write past
+    a receiver's buffer; the caller retries with more capacity.
+    """
+    cap_in, width = data.shape
+    chunk = chunk_rows_for(width)
+    if out_capacity % chunk:
+        raise ValueError(
+            f"out_capacity {out_capacity} must be a multiple of the "
+            f"chunk ({chunk} rows for width {width})")
+    if cap_in % chunk:
+        raise ValueError(
+            f"cap_in {cap_in} must be a multiple of the chunk ({chunk})")
+    # flat [M, 128] views — the shape Mosaic DMA slicing accepts
+    m_in = cap_in * width // LANES
+    m_out = out_capacity * width // LANES
+
+    (in_off, in_sz, out_off, recv_sz_al, recv_off, total_al,
+     real_recv, max_recv_total, max_send_total) = aligned_plan(
+        sizes, axis_name, width)
+    # Capacity guard, BOTH sides: a one-sided write past a receiver's out
+    # buffer is silent remote HBM corruption, and a send whose aligned
+    # segments overrun cap_in would DMA garbage from past the send buffer
+    # into peers' valid segments. On ANY device overflowing, every device
+    # zeroes its plan (no DMAs, no waits — the predicate derives from the
+    # shared size matrix, so the skip is consistent mesh-wide) and the
+    # caller retries bigger, exactly the native path's overflow contract
+    # (shuffle/alltoall._a2a_native).
+    overflow = (max_recv_total > out_capacity) | (max_send_total > cap_in)
+    z = jnp.where(overflow, 0, 1).astype(jnp.int32)
+    in_sz = in_sz * z
+    recv_sz_al = recv_sz_al * z
+    real_recv = real_recv * z
+
+    def to_flat(rows):
+        # chunk-aligned row units -> flat [M, 128]-row units (exact:
+        # chunk * width % 128 == 0)
+        return (rows * width) // LANES
+
+    x_flat = data.reshape(m_in, LANES)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        scratch_shapes=(pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+    )
+    out_flat = pl.pallas_call(
+        functools.partial(_kernel, num_devices=num_devices),
+        out_shape=jax.ShapeDtypeStruct((m_out, LANES), jnp.int32),
+        compiler_params=pltpu.CompilerParams(collective_id=0),
+        grid_spec=grid_spec,
+        interpret=pltpu.InterpretParams(detect_races=True)
+        if interpret else False,
+    )(to_flat(in_off).reshape(1, -1), to_flat(in_sz).reshape(1, -1),
+      to_flat(out_off).reshape(1, -1), to_flat(recv_sz_al).reshape(1, -1),
+      x_flat)
+    out = out_flat.reshape(out_capacity, width)
+    return out, real_recv, recv_off, \
+        jnp.where(overflow, -1, total_al).reshape(1)
+
+
+def build_aligned_send_np(segments, width: int, cap_in: int) -> np.ndarray:
+    """Test/oracle helper: place per-peer row blocks at chunk-aligned
+    offsets in a [cap_in, width] int32 buffer (numpy, host-side)."""
+    chunk = chunk_rows_for(width)
+    out = np.zeros((cap_in, width), np.int32)
+    off = 0
+    for seg in segments:
+        n = seg.shape[0]
+        out[off:off + n] = seg
+        off += ((n + chunk - 1) // chunk) * chunk
+    if off > cap_in:
+        raise ValueError(f"aligned segments ({off}) exceed cap_in {cap_in}")
+    return out

@@ -1,0 +1,75 @@
+"""Ulysses-style context parallelism — attention via head<->sequence
+all-to-all resharding.
+
+The second long-context strategy (complementing ring attention): instead of
+streaming KV around the ring, reshard with two all-to-alls. Inbound, each
+device trades its sequence shard of *all* heads for the full sequence of
+*its* heads; attention then runs locally and exactly (no online-softmax
+recurrence); outbound, the inverse all-to-all restores sequence sharding.
+This is mechanically the same primitive as the shuffle data plane — an
+all-to-all repartition where "partition" = head instead of reduce-key
+(SURVEY.md §2.6: the shuffle IS the SP/EP dispatch kernel; cf.
+reducer/compat/spark_3_0/UcxShuffleClient.java:95-127 for the reference's
+N×M fetch storm that the single collective replaces).
+
+Trade-offs vs ring: one big collective (better for ICI all-to-all
+bandwidth, no P-step latency chain) but requires ``num_heads % P == 0``
+and holds the full sequence of T/H-shard heads per device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from sparkucx_tpu.ops.pallas.flash_attention import flash_attention
+
+
+def _ulysses_sharded(q, k, v, axis: str, causal: bool,
+                     scale: Optional[float], block_q: int, block_k: int,
+                     impl: str):
+    """Per-device body. q/k/v local: [B, H, t, D] (seq-sharded)."""
+    # seq-sharded [B, H, t, D] -> head-sharded [B, H/P, T, D]:
+    # split axis 1 (heads) across peers, concat axis 2 (seq) from peers
+    def to_heads(x):
+        return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def to_seq(x):
+        return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    oh = flash_attention(qh, kh, vh, block_q=block_q, block_k=block_k,
+                         causal=causal, scale=scale, impl=impl)
+    return to_seq(oh)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                      axis: str = "sp", causal: bool = False,
+                      scale: Optional[float] = None, block_q: int = 256,
+                      block_k: int = 512, impl: str = "auto") -> jax.Array:
+    """Global-view Ulysses attention.
+
+    ``q``/``k``/``v``: [B, H, T, D]; both H and T must divide by the
+    ``axis`` size. Returns [B, H, T, D] sequence-sharded like the inputs.
+    """
+    p = mesh.shape[axis]
+    if q.shape[1] % p != 0:
+        raise ValueError(
+            f"num_heads {q.shape[1]} not divisible by axis {axis}={p}; "
+            f"use ring_attention for head counts below the mesh size")
+    pspec = P(None, None, axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_sharded, axis=axis, causal=causal,
+                          scale=scale, block_q=block_q, block_k=block_k,
+                          impl=impl),
+        mesh=mesh, in_specs=(pspec, pspec, pspec),
+        out_specs=pspec, check_vma=False)
+    return fn(q, k, v)
+
+
+__all__ = ["ulysses_attention"]

@@ -1,0 +1,190 @@
+"""Config-keyed plugin facade — the ``spark.shuffle.manager`` seam.
+
+The reference is adopted by a host engine through two config keys and zero
+code changes: Spark instantiates the manager named by
+``spark.shuffle.manager`` and the IO plugin named by
+``spark.shuffle.sort.io.plugin.class`` (ref: README.md:44-48,
+compat/spark_3_0/UcxLocalDiskShuffleDataIO.scala:15-20,
+UcxShuffleManager.scala:63-72). This module is that selection surface for
+the TPU framework: :func:`connect` builds the whole stack — node, manager,
+Arrow ingress/egress — purely from a flat conf mapping, so an external
+engine drives shuffles without touching any internal constructor.
+
+Conf keys consumed here (beyond the ``spark.shuffle.tpu.*`` surface the
+stack itself reads):
+
+    spark.shuffle.tpu.io.format      arrow | raw   (ingress/egress codec)
+    spark.shuffle.tpu.io.keyColumn   Arrow key column name (default "key")
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.runtime.node import TpuNode
+from sparkucx_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("service")
+
+IO_FORMATS = ("arrow", "raw")
+
+
+class ShuffleService:
+    """The assembled stack behind one :func:`connect` call.
+
+    Mirrors the Spark SPI verbs end to end (register / write / read /
+    unregister / stop) but in the conf-selected IO format, so the host
+    engine never handles numpy row tuples unless it asked for ``raw``."""
+
+    def __init__(self, conf: TpuShuffleConf, distributed: bool = False,
+                 process_id: int = 0, metrics_reporter=None):
+        self.conf = conf
+        self.io_format = conf.get(
+            "spark.shuffle.tpu.io.format", "arrow").strip().lower()
+        if self.io_format not in IO_FORMATS:
+            raise ValueError(
+                f"unknown io.format {self.io_format!r}; want {IO_FORMATS}")
+        self.key_column = conf.get("spark.shuffle.tpu.io.keyColumn", "key")
+        # declared per-record ceiling for string/binary Arrow columns
+        # (varlen transport pad width — io/varlen.py); part of the shuffle
+        # schema, so it is a conf key, not a per-call argument
+        self.string_max_bytes = int(conf.get(
+            "spark.shuffle.tpu.io.stringMaxBytes", "64"))
+        self.node = TpuNode.start(conf, distributed=distributed,
+                                  process_id=process_id)
+        self.manager = TpuShuffleManager(self.node, conf)
+        # Host-engine metrics seam: fn(name, value) observes every
+        # counter increment live — shuffle.read.ms (fetch wait),
+        # shuffle.rows, shuffle.bytes, shuffle.retries — the role of
+        # Spark's ShuffleReadMetricsReporter
+        # (ref: compat/spark_3_0/UcxShuffleReader.scala:111-116).
+        self._metrics_reporter = metrics_reporter
+        if metrics_reporter is not None:
+            self.node.metrics.add_reporter(metrics_reporter)
+        log.info("ShuffleService up: io=%s, %d devices",
+                 self.io_format, self.node.num_devices)
+
+    # -- lifecycle (registerShuffle / unregisterShuffle / stop) -----------
+    def register_shuffle(self, shuffle_id: int, num_maps: int,
+                         num_partitions: int,
+                         partitioner: str = "hash",
+                         bounds=None) -> ShuffleHandle:
+        return self.manager.register_shuffle(
+            shuffle_id, num_maps, num_partitions, partitioner,
+            bounds=bounds)
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        self.manager.unregister_shuffle(shuffle_id)
+
+    def stop(self) -> None:
+        if self._metrics_reporter is not None:
+            self.node.metrics.remove_reporter(self._metrics_reporter)
+            self._metrics_reporter = None
+        self.manager.stop()
+        self.node.close()
+
+    # the name users reach for first; stop() is the Spark-SPI name
+    close = stop
+
+    def __enter__(self) -> "ShuffleService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- map side (getWriter) ---------------------------------------------
+    def write(self, handle: ShuffleHandle, map_id: int, data,
+              values: Optional[np.ndarray] = None) -> None:
+        """Stage + commit one map task's output.
+
+        arrow: ``data`` is a RecordBatch or a sequence of them; the
+        conf-named key column routes, remaining numeric columns ride.
+        raw:   ``data`` is a [N] int64 key array (+ optional values).
+        """
+        if self.io_format == "arrow":
+            from sparkucx_tpu.io.arrow import write_batches
+            batches = data if isinstance(data, (list, tuple)) else [data]
+            write_batches(self.manager, handle, map_id, batches,
+                          self.key_column,
+                          string_max_bytes=self.string_max_bytes)
+            return
+        w = self.manager.get_writer(handle, map_id)
+        w.write(np.asarray(data), values)
+        w.commit(handle.num_partitions)
+
+    def writer(self, handle: ShuffleHandle, map_id: int):
+        """Raw incremental writer for multi-batch map tasks (both formats;
+        arrow callers convert with io.arrow.batch_to_kv)."""
+        return self.manager.get_writer(handle, map_id)
+
+    def warmup(self, handle: ShuffleHandle, **kw):
+        """Pre-compile the exchange for a handle's expected shape while
+        map tasks run — the preconnect analog (manager.warmup docstring;
+        ref: UcxWorkerWrapper.scala:125-127)."""
+        return self.manager.warmup(handle, **kw)
+
+    # -- reduce side (getReader) ------------------------------------------
+    def read(self, handle: ShuffleHandle,
+             timeout: Optional[float] = None,
+             combine: Optional[str] = None,
+             ordered: bool = False,
+             combine_sum_words: int = 0):
+        """Full exchange. arrow: list of per-partition RecordBatches;
+        raw: the ShuffleReaderResult partition view. ``combine="sum"``
+        runs device combine-by-key; ``ordered=True`` returns key-sorted
+        partitions; ``combine_sum_words`` > 0 sums only that many leading
+        value words and carries the rest per key — REQUIRED when the
+        value row holds a varlen payload next to the summed lane
+        (io/varlen.py pack_counted_varbytes), or the combiner would sum
+        the payload bytes (manager.read docstring)."""
+        if self.io_format == "arrow":
+            from sparkucx_tpu.io.arrow import read_batches
+            return read_batches(self.manager, handle,
+                                key_column=self.key_column, timeout=timeout,
+                                ordered=ordered, combine=combine,
+                                combine_sum_words=combine_sum_words)
+        return self.manager.read(handle, timeout=timeout, combine=combine,
+                                 ordered=ordered,
+                                 combine_sum_words=combine_sum_words)
+
+    def submit(self, handle: ShuffleHandle,
+               timeout: Optional[float] = None,
+               combine: Optional[str] = None,
+               ordered: bool = False,
+               combine_sum_words: int = 0):
+        """Asynchronous raw read (shuffle/reader.py PendingShuffle)."""
+        return self.manager.submit(handle, timeout=timeout,
+                                   combine=combine, ordered=ordered,
+                                   combine_sum_words=combine_sum_words)
+
+
+def connect(conf: Optional[Mapping[str, str]] = None, *,
+            distributed: bool = False,
+            process_id: int = 0,
+            use_env: bool = True,
+            metrics_reporter=None) -> ShuffleService:
+    """Build the framework purely from configuration — the zero-code
+    adoption path (ref: README.md:44-48: the reference is enabled by
+    setting ``spark.shuffle.manager`` and the IO plugin class key, nothing
+    else).
+
+    ``conf`` is any flat string mapping (a SparkConf dump, CLI overrides);
+    ``SPARKUCX_TPU_*`` environment variables overlay unless
+    ``use_env=False``. ``distributed=True`` additionally runs the
+    jax.distributed bootstrap using the conf's coordinator address —
+    matching the reference's driver-rendezvous flow
+    (ref: UcxNode.java:111-145).
+
+    ``metrics_reporter`` — optional ``fn(name, value)`` observing every
+    shuffle metric increment (read wait ms, rows, bytes, retry counts) —
+    the embedding engine's ShuffleReadMetricsReporter seam
+    (ref: UcxShuffleReader.scala:111-116)."""
+    tconf = conf if isinstance(conf, TpuShuffleConf) \
+        else TpuShuffleConf(conf, use_env=use_env)
+    return ShuffleService(tconf, distributed=distributed,
+                          process_id=process_id,
+                          metrics_reporter=metrics_reporter)

@@ -1,0 +1,318 @@
+"""Multi-process (multi-host) read path — the DCN-scale deployment shape.
+
+The reference runs one ``UcxNode`` per Spark executor process and scales to
+many hosts through the driver's full-mesh introduction RPC
+(ref: UcxNode.java:111-145, rpc/RpcConnectionCallback.java:70-84). The TPU
+analog is JAX multi-controller: every process calls
+``jax.distributed.initialize`` (the rendezvous), ``jax.devices()`` spans
+the cluster, and ONE SPMD program executes the exchange — the same
+compiled step as single-process, just over a bigger mesh.
+
+What is genuinely different from the single-process path:
+
+- **Map outputs are process-local.** A mapper's staged rows live in its
+  process's host arena and can only be device_put onto that process's
+  devices — exactly Spark's "map outputs stay on the executor's local
+  disk". So map outputs round-robin over the *local* shards, and the
+  global send buffer is assembled with
+  ``jax.make_array_from_process_local_data``.
+- **The metadata plane needs a real wire.** Size rows / schema / presence
+  are per-process facts; they cross processes with
+  ``multihost_utils.process_allgather`` (the driver-table fetch analog,
+  ref: UcxWorkerWrapper.scala:176-196, as a collective instead of a
+  one-sided read of a driver buffer).
+- **Results are partial views.** Each process owns the reduce partitions
+  that land on its shards (Spark reducers read only their partition);
+  ``partition(r)`` raises for non-local partitions instead of silently
+  returning wrong data.
+
+Every process MUST call :func:`read_shuffle_distributed` (it is a
+collective); mismatched call counts deadlock, like any SPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sparkucx_tpu.shuffle.plan import ShufflePlan
+from sparkucx_tpu.shuffle.reader import (
+    PendingExchangeBase, ShuffleReaderResult, _blocked_map, _build_step,
+    max_recv_rows)
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("shuffle.distributed")
+
+
+def local_shard_ids(mesh: Mesh) -> list:
+    """Global flat shard indices owned by this process, in mesh order."""
+    me = jax.process_index()
+    return [i for i, d in enumerate(mesh.devices.reshape(-1))
+            if d.process_index == me]
+
+
+def allgather_sizes(local_vals: np.ndarray, shard_ids: Sequence[int],
+                    num_shards: int) -> np.ndarray:
+    """Scatter this process's per-shard values into a [num_shards] row and
+    sum-allgather so every process holds the full size row — the
+    driver-table fetch (ref: UcxWorkerWrapper.scala:176-196) as a
+    collective."""
+    from jax.experimental import multihost_utils
+    row = np.zeros(num_shards, dtype=np.int64)
+    row[list(shard_ids)] = np.asarray(local_vals, dtype=np.int64)
+    gathered = multihost_utils.process_allgather(row)   # [nproc, num_shards]
+    return gathered.sum(axis=0)
+
+
+def allgather_blob(blob: np.ndarray) -> np.ndarray:
+    """[nproc, ...] stack of one small host array per process (schema
+    agreement checks)."""
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(blob))
+
+
+class DistributedReaderResult(ShuffleReaderResult):
+    """Partial, process-local view: only partitions on local shards are
+    readable (the Spark-reducer contract). Layout is partition-major
+    (reader.py ``_RunIndex``): ``seg_counts`` is [NS, R] shared (flat
+    exchange) or [L, NS, R] with this process's shards only
+    (hierarchical)."""
+
+    def __init__(self, num_partitions: int, part_to_shard: np.ndarray,
+                 shard_ids: Sequence[int], local_rows: np.ndarray,
+                 seg_counts: np.ndarray, val_shape, val_dtype,
+                 align_chunk: int = 0):
+        super().__init__(num_partitions, part_to_shard, local_rows,
+                         seg_counts, val_shape, val_dtype,
+                         align_chunk=align_chunk)
+        self._shard_ord = {int(s): i for i, s in enumerate(shard_ids)}
+
+    def is_local(self, r: int) -> bool:
+        return int(self._part_to_shard[r]) in self._shard_ord
+
+    def _ordinal(self, shard: int) -> int:
+        if shard not in self._shard_ord:
+            raise KeyError(
+                f"shard {shard} is not on this process (local shards: "
+                f"{sorted(self._shard_ord)})")
+        return self._shard_ord[shard]
+
+    def _seg_matrix(self, shard: int) -> np.ndarray:
+        return self._seg if self._seg.ndim == 2 \
+            else self._seg[self._ordinal(shard)]
+
+    def _shard_rows(self, shard: int) -> np.ndarray:
+        return self._rows[self._ordinal(shard)]
+
+    def partition(self, r: int):
+        if not self.is_local(r):
+            raise KeyError(
+                f"partition {r} lives on shard "
+                f"{int(self._part_to_shard[r])}, not on this process "
+                f"(local shards: {sorted(self._shard_ord)})")
+        return super().partition(r)
+
+    def partitions(self):
+        for r in range(self.num_partitions):
+            if self.is_local(r):
+                yield r, self.partition(r)
+
+
+def _local_shards_of(arr: jax.Array, shard_ids: Sequence[int],
+                     rows_per_shard: int) -> np.ndarray:
+    """Collect this process's shards of a P(axis)-sharded global array
+    into [L, rows_per_shard, ...] in shard_ids order."""
+    by_start = {}
+    for s in arr.addressable_shards:
+        start = s.index[0].start or 0
+        by_start[start // rows_per_shard] = np.asarray(s.data)
+    return np.stack([by_start[int(i)] for i in shard_ids])
+
+
+def read_shuffle_distributed(
+    mesh: Mesh,
+    axis: str,
+    plan: ShufflePlan,
+    local_rows: np.ndarray,
+    local_nvalid: np.ndarray,
+    shard_ids: Sequence[int],
+    val_shape: Optional[Tuple[int, ...]],
+    val_dtype,
+    hier_mesh: Optional[Mesh] = None,
+    dcn_axis: Optional[str] = None,
+) -> DistributedReaderResult:
+    """Run the exchange across all processes; COLLECTIVE — every process
+    must call with the same plan/width.
+
+    local_rows   — [L, cap_in, width] fused rows for this process's shards
+    local_nvalid — [L] valid counts
+    shard_ids    — global shard indices of this process (mesh order;
+                   identical for the flat and 2-D mesh because the
+                   hierarchical flattening is row-major over (dcn, ici))
+    hier_mesh    — when set (with ``dcn_axis``), run the two-stage
+                   ICI-then-DCN exchange over this 2-D mesh instead of the
+                   flat single collective, so each row crosses the slow
+                   DCN links exactly once (shuffle/hierarchical.py)
+    """
+    return submit_shuffle_distributed(
+        mesh, axis, plan, local_rows, local_nvalid, shard_ids,
+        val_shape, val_dtype, hier_mesh=hier_mesh,
+        dcn_axis=dcn_axis).result()
+
+
+class PendingDistributedShuffle(PendingExchangeBase):
+    """Future-like handle for an in-flight MULTI-PROCESS exchange.
+
+    Collective contract: every process must call submit (which dispatches
+    the SPMD step) and, later, ``result()`` — in the same order relative
+    to other collectives. Between the two calls each process is free to
+    pack the next shuffle or run any host work: XLA dispatch is already
+    asynchronous, so the collective rides the wire meanwhile (the
+    per-executor fetch/compute overlap of the reference's non-blocking
+    ``ucp_get`` storm, ref: UcxShuffleClient.java (3.0):95-127).
+
+    ``done()`` is a LOCAL, non-collective poll (this process's outputs
+    computed); the overflow verdict and any retry live in ``result()``,
+    because they require the cross-process allgather. Lifecycle
+    (exactly-once on_done, abandonment release, result caching) comes
+    from :class:`sparkucx_tpu.shuffle.reader.PendingExchangeBase`."""
+
+    def __init__(self, mesh, axis, plan, local_rows, local_nvalid,
+                 shard_ids, val_shape, val_dtype, hier_mesh, dcn_axis,
+                 on_done=None, admit=None):
+        self._mesh, self._axis = mesh, axis
+        self._plan = plan
+        self._local_rows, self._local_nvalid = local_rows, local_nvalid
+        self._shard_ids = list(shard_ids)
+        self._val_shape, self._val_dtype = val_shape, val_dtype
+        self._hier_mesh, self._dcn_axis = hier_mesh, dcn_axis
+        L, cap_in, width = local_rows.shape
+        self._L, self._cap_in, self._width = L, cap_in, width
+        if hier_mesh is not None:
+            self._sharding = NamedSharding(hier_mesh, P((dcn_axis, axis)))
+        else:
+            self._sharding = NamedSharding(mesh, P(axis))
+        self._result = None
+        self._attempt = 0
+        self._on_done = None
+        # the defer decision is deterministic across processes (same plan,
+        # same footprint arithmetic, same submit/result order), so queued
+        # dispatches stay in SPMD lockstep
+        self._initial_dispatch(admit)
+        self._on_done = on_done
+
+    def _dispatch(self):
+        cur = self._plan
+        if self._hier_mesh is not None:
+            from sparkucx_tpu.shuffle.hierarchical import _build_hier_step
+            step = _build_hier_step(self._hier_mesh, self._dcn_axis,
+                                    self._axis, cur, self._width)
+        else:
+            step = _build_step(self._mesh, self._axis, cur, self._width)
+        payload = jax.make_array_from_process_local_data(
+            self._sharding,
+            self._local_rows.reshape(self._L * self._cap_in, self._width))
+        nvalid = jax.make_array_from_process_local_data(
+            self._sharding,
+            self._local_nvalid.astype(np.int32).reshape(self._L))
+        self._out = step(payload, nvalid)
+
+    def _result_inner(self):
+        # COLLECTIVE: every process must reach result() — it allgathers
+        # the overflow verdict and retries in lockstep.
+        R = self._plan.num_partitions
+        Pn = self._plan.num_shards
+        part_to_shard = np.asarray(_blocked_map(R, Pn))
+        while True:
+            cur = self._plan
+            rows_out, seg, total, ovf = self._out
+            # The retry decision must be identical on every process or
+            # the SPMD group diverges. The flat exchange's flag is a
+            # mesh-wide psum, but the hierarchical flag (r1|r2) is only
+            # uniform within a slice — so allgather the local verdicts
+            # and OR them globally.
+            mine = any(bool(np.asarray(s.data).any())
+                       for s in ovf.addressable_shards)
+            ovf_global = bool(allgather_blob(
+                np.array([1 if mine else 0], dtype=np.int64)).any())
+            if not ovf_global:
+                if cur.combine or cur.ordered or self._hier_mesh is not None:
+                    # SHARDED seg output — collect this process's rows:
+                    # [1, R] own counts under combine/ordered, else
+                    # [S, R] relay counts (hierarchical)
+                    ns = 1 if (cur.combine or cur.ordered) \
+                        else self._hier_mesh.devices.shape[0]
+                    seg_host = _local_shards_of(seg, self._shard_ids, ns)
+                else:
+                    # flat uncombined: replicated [P, R] — any addressable
+                    # copy is the whole matrix (np.asarray rejects
+                    # multi-process arrays)
+                    seg_host = np.asarray(seg.addressable_shards[0].data)
+                # per-shard capacity from the OUTPUT, not the plan: the
+                # pallas transport's buffers are chunk-inflated
+                # (cap_eff = align(cap_out) + P*chunk), so slicing by
+                # cur.cap_out would misattribute shards (reader.py's
+                # single-process _result_inner derives it the same way)
+                cap_shard = rows_out.shape[0] // Pn
+                align_chunk = 0
+                if cur.impl == "pallas" and not (cur.combine
+                                                 or cur.ordered):
+                    from sparkucx_tpu.ops.pallas.ragged_a2a import \
+                        chunk_rows_for
+                    align_chunk = chunk_rows_for(self._width)
+                elif cur.strips_active():
+                    # degenerate 1-shard cluster: step_body takes the
+                    # strip fast path (see reader.py resolve)
+                    align_chunk = cur.strip_rows()
+                res = DistributedReaderResult(
+                    R, part_to_shard, self._shard_ids,
+                    _local_shards_of(rows_out, self._shard_ids,
+                                     cap_shard),
+                    seg_host, self._val_shape, self._val_dtype,
+                    align_chunk=align_chunk)
+                res.cap_out_used = cur.cap_out
+                if not (cur.combine or cur.ordered
+                        or self._hier_mesh is not None):
+                    # flat plain: the replicated [P, R] seg carries true
+                    # delivered counts, identical on every process — the
+                    # manager's hint decay stays in SPMD lockstep
+                    res.recv_rows_needed = max_recv_rows(
+                        seg_host, part_to_shard, Pn)
+                return res
+            if self._attempt >= self._plan.max_retries:
+                raise RuntimeError(
+                    f"shuffle still overflowing after "
+                    f"{self._plan.max_retries} retries "
+                    f"(cap_out={cur.cap_out}); extreme skew — repartition "
+                    f"the data")
+            log.info("distributed shuffle overflow at cap_out=%d "
+                     "(attempt %d)", cur.cap_out, self._attempt)
+            self._plan = cur.grown()
+            self._attempt += 1
+            self._dispatch()
+
+
+def submit_shuffle_distributed(
+    mesh: Mesh,
+    axis: str,
+    plan: ShufflePlan,
+    local_rows: np.ndarray,
+    local_nvalid: np.ndarray,
+    shard_ids: Sequence[int],
+    val_shape: Optional[Tuple[int, ...]],
+    val_dtype,
+    hier_mesh: Optional[Mesh] = None,
+    dcn_axis: Optional[str] = None,
+    on_done=None,
+    admit=None,
+) -> PendingDistributedShuffle:
+    """Dispatch the multi-process exchange without blocking (collective:
+    see :class:`PendingDistributedShuffle`)."""
+    return PendingDistributedShuffle(
+        mesh, axis, plan, local_rows, local_nvalid, shard_ids,
+        val_shape, val_dtype, hier_mesh, dcn_axis, on_done=on_done,
+        admit=admit)

@@ -1,0 +1,182 @@
+"""Shuffle plans — static-shape capacity policy.
+
+XLA compiles one program per shape, so the ragged reality of a shuffle
+(skewed partition sizes, ref hard-part (a) in SURVEY.md §7) is absorbed
+host-side into a small set of padded capacities. This module decides them:
+
+* ``cap_in``  — per-shard send-buffer rows (max staged rows, padded up)
+* ``cap_out`` — per-shard receive rows = balanced share x capacityFactor
+* retry policy — overflow is detected mesh-wide by the data plane; the
+  caller doubles ``cap_out`` and re-runs (geometric, bounded), the moral
+  equivalent of the reference's inflight-bytes throttling loop in Spark's
+  ShuffleBlockFetcherIterator (ref: UcxShuffleReader.scala:56-70) — except
+  here the budget is HBM instead of network credits.
+
+Capacities are rounded to multiples of 8 rows to keep TPU-friendly tiling.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import numpy as np
+
+from sparkucx_tpu.config import TpuShuffleConf
+
+
+def _round_up(x: int, mult: int = 8) -> int:
+    return max(mult, ((int(x) + mult - 1) // mult) * mult)
+
+
+@dataclass(frozen=True)
+class ShufflePlan:
+    """Static shapes for one exchange step. Hashable: the jit-cache key."""
+
+    num_shards: int
+    num_partitions: int
+    cap_in: int
+    cap_out: int
+    impl: str
+    partitioner: str = "hash"  # hash | direct (keys ARE partition ids)
+    max_retries: int = 4
+    sort_impl: str = "auto"    # ops/partition.py destination_sort method
+    # single-shard plain exchanges only: destination-sort in this many
+    # independent strips (ops/partition.destination_sort_strips — one
+    # batched sort network of depth ~log^2(cap_in/strips) instead of
+    # ~log^2(cap_in)), served back as `strips` virtual senders by the
+    # reader's run index. 1 = one flat sort. Ignored off the single-shard
+    # plain path (combine/ordered have their own sort semantics; the
+    # multi-shard collective needs device-contiguous send segments).
+    sort_strips: int = 1
+    # device combine-by-key (ops/aggregate.py): None, or a COMBINERS entry
+    # ("sum"). Applied map-side (before the wire) AND reduce-side (before
+    # D2H); needs a numeric value schema, carried here so the jit cache
+    # keys on it.
+    combine: Optional[str] = None
+    combine_words: int = 0     # value width in int32 words (combine only)
+    combine_dtype: str = ""    # np.dtype.str of the value (combine only)
+    # transport words the combiner SUMS; the rest of the value row is
+    # CARRIED per key (per-key-constant payload, e.g. varlen record
+    # bytes — io/varlen.py). 0 = sum the whole value row.
+    combine_sum_words: int = 0
+    # combine_rows end-row compaction formulation (stable | unstable) —
+    # bit-identical output, different TPU sort cost; conf-selectable for
+    # the on-chip A/B (a2a.combineCompaction).
+    combine_compaction: str = "stable"
+    # device key sort: partitions come back key-sorted (signed int64
+    # order) — the "sort" half of the reference reduce pipeline's stock
+    # aggregate+sort, without aggregation (TeraSort's shape). Implied by
+    # combine (combined output is already key-sorted).
+    ordered: bool = False
+    # sorted int64 split points for partitioner="range" (the Spark
+    # RangePartitioner analog, device-evaluated): static, so they are
+    # part of the compiled program and the jit-cache key.
+    bounds: Optional[Tuple[int, ...]] = None
+    # impl='pallas' only: None resolves interpret mode from the default
+    # backend AT TRACE TIME (CPU tests interpret, TPU compiles); pin it
+    # explicitly when tracing for a backend other than the host's — the
+    # same backend-keyed-trace hazard aot.py pins sort_impl against (an
+    # AOT compile from a CPU host would otherwise bake the interpreter
+    # into the TPU program).
+    pallas_interpret: Optional[bool] = None
+
+    def grown(self) -> "ShufflePlan":
+        """Next plan after an overflow: double the receive capacity."""
+        import dataclasses
+        return dataclasses.replace(self, cap_out=self.cap_out * 2)
+
+    def strips_active(self) -> bool:
+        """True when the single-shard strip-sorted plain path runs —
+        THE activation predicate, shared by the step that writes the
+        layout (reader.step_body) and the resolves that index it
+        (reader/distributed align_chunk): one source, no desync."""
+        return (self.num_shards == 1 and self.sort_strips > 1
+                and not (self.combine or self.ordered)
+                and self.impl != "pallas")
+
+    def strip_rows(self) -> int:
+        """Rows per strip region in the strip-sorted layout (the
+        ``align_chunk`` of the result's run index) — the sorted buffer is
+        ``sort_strips * strip_rows()`` rows. Meaningful only when
+        :meth:`strips_active`. The step statically checks its payload cap
+        equals ``cap_in``, so this host-side derivation and the sort's
+        runtime one provably agree."""
+        s = max(1, min(int(self.sort_strips), self.cap_in))
+        return -(-self.cap_in // s)
+
+
+# Measured-best strip counts for the single-shard plain path, by backend
+# (ops/partition.destination_sort_strips; see bench_runs/NOTES_r4.md for
+# the on-chip sweep). Empty entry / unknown backend = 1 (flat sort).
+# Kept as data so a new measurement is a one-line change with a citation.
+_MEASURED_STRIPS: dict = {}
+
+# Valid a2a.sortStrips bounds — ONE constant shared by conf validation
+# and bench's parse-time check so the two cannot drift.
+STRIPS_RANGE = (1, 4096)
+
+
+def default_sort_strips(backend: str, num_shards: int) -> int:
+    """Resolve ``a2a.sortStrips=auto``: the measured-best strip count for
+    this backend on a single-shard axis, else 1 (the lever only exists on
+    the 1-shard plain path — ShufflePlan.strips_active)."""
+    if num_shards != 1:
+        return 1
+    return int(_MEASURED_STRIPS.get(backend, 1))
+
+
+def resolve_sort_strips(conf_val, num_shards: int) -> int:
+    """'auto' -> backend-measured default; anything else is already an
+    int (conf validation). jax imported lazily: plan.py stays importable
+    without touching a backend. Public: bench.py resolves its
+    --sort-strips flag through this same path so the bench measures
+    exactly what production make_plan would run."""
+    if conf_val != "auto":
+        return int(conf_val)
+    import jax
+    return default_sort_strips(jax.default_backend(), num_shards)
+
+
+def make_plan(
+    shard_rows: np.ndarray,
+    num_shards: int,
+    num_partitions: int,
+    conf: Optional[TpuShuffleConf] = None,
+    partitioner: str = "hash",
+    bounds=None,
+) -> ShufflePlan:
+    """Derive capacities from per-shard staged row counts.
+
+    ``shard_rows`` — [P] rows staged on each shard. cap_out starts at the
+    perfectly-balanced share times ``capacityFactor``; skew beyond that is
+    handled by the overflow-retry loop, trading one recompile for not
+    provisioning worst-case HBM everywhere."""
+    conf = conf or TpuShuffleConf()
+    total = int(np.sum(shard_rows))
+    cap_in = _round_up(int(np.max(shard_rows, initial=0)))
+    balanced = total / max(num_shards, 1)
+    cap_out = _round_up(int(np.ceil(balanced * conf.capacity_factor)))
+    if partitioner not in ("hash", "direct", "range"):
+        raise ValueError(f"unknown partitioner {partitioner!r}")
+    if (partitioner == "range") != (bounds is not None):
+        raise ValueError("partitioner='range' needs bounds (and only it)")
+    if bounds is not None:
+        b = np.asarray(bounds, dtype=np.int64)
+        if b.shape != (num_partitions - 1,) or (np.diff(b) < 0).any():
+            raise ValueError(
+                f"range bounds must be {num_partitions - 1} sorted int64 "
+                f"split points, got shape {b.shape}")
+        bounds = tuple(int(x) for x in b)
+    return ShufflePlan(
+        num_shards=num_shards,
+        num_partitions=num_partitions,
+        cap_in=cap_in,
+        cap_out=cap_out,
+        impl=conf.a2a_impl,
+        partitioner=partitioner,
+        sort_impl=conf.sort_impl,
+        sort_strips=resolve_sort_strips(conf.sort_strips, num_shards),
+        combine_compaction=conf.combine_compaction,
+        bounds=bounds,
+    )

@@ -1,0 +1,4 @@
+from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.metrics import Metrics, Timer
+
+__all__ = ["get_logger", "Metrics", "Timer"]

@@ -1,0 +1,33 @@
+"""Structured logging, the slf4j-logger analog used throughout the reference
+(ref: UcxNode.java:35, MemoryPool.java:28)."""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def _configure() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    level = os.environ.get("SPARKUCX_TPU_LOG", "WARNING").upper()
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+    )
+    root = logging.getLogger("sparkucx_tpu")
+    root.addHandler(handler)
+    root.setLevel(getattr(logging, level, logging.WARNING))
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure()
+    if not name.startswith("sparkucx_tpu"):
+        name = f"sparkucx_tpu.{name}"
+    return logging.getLogger(name)

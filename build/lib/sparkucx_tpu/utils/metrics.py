@@ -1,0 +1,97 @@
+"""Lightweight metrics + timers.
+
+The reference has targeted latency logging rather than a tracer: map-publish
+overhead per mapId (ref: CommonUcxShuffleBlockResolver.scala:105-106),
+per-request completion ms (ref: UcxWorkerWrapper.scala:101-103), per-endpoint
+fetch bytes+ms (ref: OnBlocksFetchCallback.java:55-56), and fetch-wait time
+fed into Spark's ShuffleReadMetricsReporter
+(ref: compat/spark_3_0/UcxShuffleReader.scala:84-87). This module provides
+the same spirit as in-process counters/timers that the manager/reader report
+into, plus a context-manager timer."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+from typing import Dict
+
+
+class Timer:
+    """Context-manager wall timer; `.ms` after exit."""
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.perf_counter()
+        self.ms = 0.0
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.ms = (time.perf_counter() - self._t0) * 1e3
+
+
+class Metrics:
+    """Thread-safe counter/gauge registry.
+
+    Role of Spark's ShuffleReadMetricsReporter integration
+    (ref: UcxShuffleReader.scala:111-116): incFetchWaitTime, incRecordsRead
+    become plain named counters here.
+
+    Reporters: a host engine embedding the framework can observe every
+    increment live — ``add_reporter(fn)`` with ``fn(name, value)`` — the
+    push-style seam Spark's reporter object provides. Reporter failures
+    are swallowed (logged once per reporter): observability must never
+    fail a shuffle."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._reporters = []
+        self._broken = set()
+
+    def add_reporter(self, fn) -> None:
+        """Attach fn(name: str, value: float), called on every inc()."""
+        with self._lock:
+            self._reporters.append(fn)
+
+    def remove_reporter(self, fn) -> None:
+        with self._lock:
+            try:
+                self._reporters.remove(fn)
+            except ValueError:
+                pass
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+            reporters = list(self._reporters)
+        for fn in reporters:
+            try:
+                fn(name, value)
+            except Exception:
+                if id(fn) not in self._broken:
+                    self._broken.add(id(fn))
+                    from sparkucx_tpu.utils.logging import get_logger
+                    get_logger("metrics").exception(
+                        "metrics reporter %r raised; further failures "
+                        "from it are silenced", fn)
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
+
+    @contextlib.contextmanager
+    def timeit(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.inc(name + ".ms", (time.perf_counter() - t0) * 1e3)
+            self.inc(name + ".count", 1)
+
+
+GLOBAL_METRICS = Metrics()

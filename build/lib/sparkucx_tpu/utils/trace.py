@@ -1,0 +1,261 @@
+"""Structured tracing — spans, Chrome-trace export, device profiler hooks.
+
+The reference has no tracer; its observability is targeted latency logging
+(map-publish overhead per mapId, ref: CommonUcxShuffleBlockResolver.scala:105-106;
+per-request completion ms, ref: UcxWorkerWrapper.scala:101-103; per-endpoint
+fetch bytes+ms, ref: OnBlocksFetchCallback.java:55-56). SURVEY.md §5 calls for
+"the same spirit via structured timers + jax.profiler traces" — this module is
+that: nested wall-clock spans on the host side, optional XLA device traces via
+``jax.profiler``, and a Chrome ``chrome://tracing`` / Perfetto export so a
+shuffle's publish → plan → exchange → group timeline is inspectable.
+
+Design constraints:
+
+* **Near-zero cost when disabled.** ``span()`` on a disabled tracer returns a
+  shared no-op context manager — no allocation, no clock read. Enable with
+  conf key ``spark.shuffle.tpu.trace.enabled`` (env
+  ``SPARKUCX_TPU_TRACE_ENABLED=1``) or ``Tracer(enabled=True)``.
+* **Thread-safe, nesting-aware.** Spans nest per-thread (a reduce task's
+  ``exchange`` span sits under its ``read`` span); cross-thread events land
+  on their own track, like the reference's per-task-thread workers
+  (ref: UcxNode.java:85-95).
+* **Bounded memory.** A ring buffer of ``capacity`` finished spans; drops are
+  counted, never silent (the same no-silent-truncation policy as the data
+  plane's overflow flag).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+import time
+from collections import defaultdict, deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("trace")
+
+
+@dataclass
+class Span:
+    """One finished span (Chrome trace 'X' event)."""
+
+    name: str
+    start_us: float
+    dur_us: float
+    tid: int
+    depth: int
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def dur_ms(self) -> float:
+        return self.dur_us / 1e3
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):  # parity with _LiveSpan
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _LiveSpan:
+    __slots__ = ("_tracer", "name", "attrs", "_t0", "_annot")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Dict[str, Any]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self._annot = None
+
+    def set(self, **attrs) -> "_LiveSpan":
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "_LiveSpan":
+        tls = self._tracer._tls
+        tls.depth = getattr(tls, "depth", 0) + 1
+        if self._tracer.annotate_device:
+            try:
+                import jax.profiler
+                self._annot = jax.profiler.TraceAnnotation(self.name)
+                self._annot.__enter__()
+            except Exception:  # profiler backend absent; host spans still work
+                self._annot = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        t1 = time.perf_counter()
+        if self._annot is not None:
+            self._annot.__exit__(*exc)
+        tls = self._tracer._tls
+        depth = getattr(tls, "depth", 1)
+        tls.depth = depth - 1
+        self._tracer._record(Span(
+            name=self.name,
+            start_us=(self._t0 - self._tracer._epoch) * 1e6,
+            dur_us=(t1 - self._t0) * 1e6,
+            tid=threading.get_ident(),
+            depth=depth - 1,
+            attrs=self.attrs,
+        ))
+        return False
+
+
+class Tracer:
+    """Span collector with Chrome-trace export.
+
+    ``annotate_device=True`` additionally wraps every span in a
+    ``jax.profiler.TraceAnnotation`` so host spans line up with XLA device
+    ops inside a ``device_trace()`` capture."""
+
+    def __init__(self, enabled: bool = False, capacity: int = 65536,
+                 annotate_device: bool = False):
+        self.enabled = enabled
+        self.annotate_device = annotate_device
+        self._spans: deque = deque(maxlen=capacity)
+        self._dropped = 0
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._epoch = time.perf_counter()
+
+    # -- recording --------------------------------------------------------
+    def span(self, name: str, **attrs):
+        """Context manager timing one region. No-op when disabled."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _LiveSpan(self, name, attrs)
+
+    def instant(self, name: str, **attrs) -> None:
+        """Zero-duration marker event."""
+        if not self.enabled:
+            return
+        self._record(Span(name, (time.perf_counter() - self._epoch) * 1e6,
+                          0.0, threading.get_ident(), 0, attrs))
+
+    def _record(self, s: Span) -> None:
+        with self._lock:
+            if len(self._spans) == self._capacity:
+                self._dropped += 1
+            self._spans.append(s)
+
+    # -- inspection -------------------------------------------------------
+    def spans(self, name: Optional[str] = None) -> List[Span]:
+        with self._lock:
+            out = list(self._spans)
+        return [s for s in out if s.name == name] if name else out
+
+    @property
+    def dropped(self) -> int:
+        return self._dropped
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-name {count, total_ms, mean_ms, p50_ms, p99_ms, max_ms}
+        aggregate — the MemoryPool-stats-at-close analog
+        (ref: MemoryPool.java:30-39). p50/p99 mirror the reference's
+        per-fetch latency log (ref: OnBlocksFetchCallback.java:55-56),
+        which BASELINE.md adopts as half its metric."""
+        groups: Dict[str, List[float]] = defaultdict(list)
+        for s in self.spans():
+            groups[s.name].append(s.dur_ms)
+        out = {}
+        for name, ds in groups.items():
+            ds.sort()
+            out[name] = {
+                "count": float(len(ds)),
+                "total_ms": sum(ds),
+                "mean_ms": sum(ds) / len(ds),
+                "p50_ms": ds[len(ds) // 2],
+                "p99_ms": ds[min(len(ds) - 1, (len(ds) * 99) // 100)],
+                "max_ms": ds[-1],
+            }
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+
+    # -- export -----------------------------------------------------------
+    def export_chrome_trace(self, path: str) -> int:
+        """Write the span buffer as a Chrome trace-event JSON file, loadable
+        in Perfetto / chrome://tracing. Returns the number of events."""
+        events = []
+        for s in self.spans():
+            events.append({
+                "name": s.name, "ph": "X", "ts": s.start_us, "dur": s.dur_us,
+                "pid": 0, "tid": s.tid,
+                "args": {k: _jsonable(v) for k, v in s.attrs.items()},
+            })
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        if self._dropped:
+            log.warning("trace export dropped %d spans (capacity %d)",
+                        self._dropped, self._capacity)
+        return len(events)
+
+    # -- device (XLA) traces ----------------------------------------------
+    @contextlib.contextmanager
+    def device_trace(self, logdir: str):
+        """Capture an XLA profiler trace (TensorBoard format) around a
+        region. Host spans recorded inside also appear as annotations when
+        ``annotate_device`` is set. Degrades to host-only tracing when the
+        profiler backend is unavailable (e.g. some CPU builds)."""
+        started = False
+        try:
+            import jax.profiler
+            jax.profiler.start_trace(logdir)
+            started = True
+        except Exception as e:
+            log.warning("device trace unavailable (%s); host spans only", e)
+        try:
+            yield self
+        finally:
+            if started:
+                import jax.profiler
+                jax.profiler.stop_trace()
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except TypeError:
+        return repr(v)
+
+
+GLOBAL_TRACER = Tracer(enabled=False)
+
+
+def configure_from_conf(conf) -> Tracer:
+    """Wire the global tracer from conf keys:
+
+    ``spark.shuffle.tpu.trace.enabled``   master switch (default off)
+    ``spark.shuffle.tpu.trace.capacity``  span ring size (default 65536)
+    ``spark.shuffle.tpu.trace.device``    wrap spans in TraceAnnotations
+    """
+    GLOBAL_TRACER.enabled = conf.get_bool("trace.enabled", False)
+    GLOBAL_TRACER.annotate_device = conf.get_bool("trace.device", False)
+    cap = conf.get_int("trace.capacity", 65536)
+    if cap != GLOBAL_TRACER._capacity:
+        with GLOBAL_TRACER._lock:
+            GLOBAL_TRACER._capacity = cap
+            GLOBAL_TRACER._spans = deque(GLOBAL_TRACER._spans, maxlen=cap)
+    return GLOBAL_TRACER

@@ -1,0 +1,88 @@
+"""TeraSort workload — the BASELINE.md headline benchmark shape.
+
+HiBench Terasort = range-partition by key, shuffle, sort each partition
+locally; concatenating partitions in order yields the globally sorted
+dataset.
+
+Two formulations:
+
+``mode="range"`` (default) — the fully device-side pipeline: keys route
+through the DEVICE range partitioner (``partitioner="range"``, the Spark
+RangePartitioner analog evaluated inside the compiled step) and
+``ordered=True`` returns every partition key-sorted by the DEVICE — the
+host never sorts anything, it only verifies.
+
+``mode="direct"`` — the round-1 formulation kept for the Partitioner-SPI
+coverage: routing ids are precomputed host-side (``partitioner="direct"``,
+true keys ride in the value payload) and each partition is sorted on the
+host after the exchange.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from sparkucx_tpu.ops.partition import range_partition, sample_bounds
+from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+
+def run_terasort(manager: TpuShuffleManager, *, num_mappers: int = 8,
+                 rows_per_mapper: int = 2000, num_partitions: int = 32,
+                 shuffle_id: int = 9002, seed: int = 0,
+                 mode: str = "range") -> Dict[str, int]:
+    """Distributed sort of random uint keys; verifies global order."""
+    rng = np.random.default_rng(seed)
+    shards = [rng.integers(0, 1 << 40, size=rows_per_mapper).astype(np.int64)
+              for _ in range(num_mappers)]
+    # sampled split points (the RangePartitioner reservoir-sampling role)
+    sample = np.concatenate([s[:: max(1, len(s) // 64)] for s in shards])
+    bounds = sample_bounds(sample, num_partitions)
+
+    if mode == "range":
+        h = manager.register_shuffle(shuffle_id, num_mappers,
+                                     num_partitions, partitioner="range",
+                                     bounds=bounds)
+    else:
+        h = manager.register_shuffle(shuffle_id, num_mappers,
+                                     num_partitions, partitioner="direct")
+    try:
+        for m, keys in enumerate(shards):
+            w = manager.get_writer(h, m)
+            if mode == "range":
+                w.write(keys)                      # the key IS the payload
+            else:
+                part = np.asarray(range_partition(keys, bounds),
+                                  dtype=np.int64)
+                w.write(part, keys.reshape(-1, 1))
+            w.commit(num_partitions)
+        res = manager.read(h, ordered=(mode == "range"))
+
+        out = []
+        rows = 0
+        for r in range(num_partitions):
+            if mode == "range":
+                local, _ = res.partition(r)
+                if (np.diff(local) < 0).any():
+                    raise AssertionError(
+                        f"device-sorted partition {r} is out of order")
+            else:
+                pid, v = res.partition(r)
+                assert (pid == r).all(), "direct routing misplaced rows"
+                local = np.sort(v[:, 0])
+            # range invariant: partition r's keys fall inside its bounds
+            if local.size:
+                if r > 0:
+                    assert local[0] >= bounds[r - 1]
+                if r < num_partitions - 1:
+                    assert local[-1] <= bounds[r]
+            out.append(local)
+            rows += local.size
+        merged = np.concatenate(out)
+        want = np.sort(np.concatenate(shards))
+        if not np.array_equal(merged, want):
+            raise AssertionError("terasort output is not globally sorted")
+        return {"rows": rows, "partitions": num_partitions}
+    finally:
+        manager.unregister_shuffle(shuffle_id)
