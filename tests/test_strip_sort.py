@@ -350,3 +350,30 @@ def test_random_strips_roundtrip(manager_factory, seed):
         assert sorted(got[ki], key=repr) == sorted(kv[ki], key=repr), \
             f"multiset mismatch for key {ki}"
     m.unregister_shuffle(20_000 + seed)
+
+
+def test_warmup_precompiles_strip_step(manager_factory, rng):
+    """warmup on a 1-device mesh with sortStrips set must compile the
+    STRIP step (plan.sort_strips threaded through), so the first read
+    is a jit-cache hit on the same executable."""
+    import jax as _jax
+
+    from sparkucx_tpu.shuffle import reader as reader_mod
+
+    m = manager_factory({"spark.shuffle.tpu.a2a.sortStrips": "8"})
+    m.node.remesh(devices=list(_jax.devices())[:1], reason="strip warm")
+    h = m.register_shuffle(973, num_maps=2, num_partitions=8)
+    plan = m.warmup(h, rows_per_map=100)
+    assert plan.sort_strips == 8 and plan.strips_active()
+    step = reader_mod._build_step(m.exchange_mesh, m.axis, plan, 2)
+    assert step._cache_size() == 1
+    for mid in range(2):
+        w = m.get_writer(h, mid)
+        w.write(rng.integers(0, 1 << 40, size=100).astype(np.int64))
+        w.commit(8)
+    res = m.read(h)
+    assert sum(res.partition(r)[0].shape[0] for r in range(8)) == 200
+    step_after = reader_mod._build_step(m.exchange_mesh, m.axis, plan, 2)
+    assert step_after is step and step._cache_size() == 1, \
+        "first strip read after warmup must not compile a second program"
+    m.unregister_shuffle(973)
