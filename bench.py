@@ -873,7 +873,7 @@ def main() -> None:
     ap.add_argument("--init-retry-s", type=int, default=None,
                     help="total window for TPU bring-up probes with "
                          "backoff (default env SPARKUCX_BENCH_INIT_RETRY_S "
-                         "or 2700); the tunnel often recovers in-round")
+                         "or 1200); the tunnel often recovers in-round")
     args = ap.parse_args()
 
     if args.platform == "cpu":
