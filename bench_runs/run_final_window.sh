@@ -1,0 +1,94 @@
+#!/bin/bash
+# Round-4 final-window runner: wait for the strips A/B queue, then probe
+# until healthy and run the remaining capture in PRIORITY order, never
+# starting a stage after the deadline (the driver's own capture follows;
+# stop_runners_for_driver.sh SIGTERMs this shell at 13:50Z regardless).
+# Replaces run_micro_retry.sh (killed in its wait loop) so the strips
+# sweep + default bench outrank the micro-ladder tail if the tunnel
+# heals late. No external kill-timeouts around TPU work (NOTES_r2).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+TS=$(date +%H%M%S)
+DEADLINE=$(date -u -d "13:40" +%s)
+
+left() { echo $(( DEADLINE - $(date +%s) )); }
+
+echo "== wait for the strips A/B queue to drain =="
+while pgrep -f "run_strips_ab[.]sh" > /dev/null; do sleep 60; done
+
+echo "== probe until healthy or deadline =="
+healthy=0
+while [ "$(left)" -gt 600 ]; do
+    if python - <<'PYEOF'
+from bench import _tpu_probe_once
+import sys
+rec = _tpu_probe_once(240)
+print(rec, flush=True)
+sys.exit(0 if rec.get("rc") == 0 and rec.get("backend") == "tpu" else 3)
+PYEOF
+    then healthy=1; break; fi
+    echo "# unhealthy; $(left)s to deadline; sleeping 300s"
+    sleep 300
+done
+if [ "$healthy" != 1 ]; then
+    echo "== never healed before deadline; giving up =="
+    exit 3
+fi
+
+run_bench() {  # label, extra args...
+    local label=$1; shift
+    local out="bench_runs/r4_tpu_${TS}_${label}.json"
+    if python bench.py --no-fallback --init-retry-s 60 "$@" \
+            | tail -1 | tee "$out"; then
+        echo "saved $out"
+    else
+        mv "$out" "$out.FAILED" 2>/dev/null
+        echo "bench ($label) FAILED — artifact renamed"
+    fi
+}
+
+# priority 1: the strip-sort sweep (the round's open perf question)
+if [ "$(left)" -gt 900 ]; then
+    echo "== strip-sort micro sweep =="
+    python bench_runs/micro_r4b.py --watchdog 1500 \
+        | tee "bench_runs/r4_strips_${TS}.jsonl"
+    BEST_S=$(python - "bench_runs/r4_strips_${TS}.jsonl" <<'PYEOF'
+import json, sys
+best, best_ms = 1, None
+for line in open(sys.argv[1]):
+    try:
+        d = json.loads(line)
+    except ValueError:
+        continue
+    if d.get("exp") == "strip_sort" and d.get("key") == "i32" \
+            and not d.get("degenerate") and "ms" in d:
+        if best_ms is None or d["ms"] < best_ms:
+            best, best_ms = d["S"], d["ms"]
+print(best)
+PYEOF
+    )
+    echo "== best strip count (i32): ${BEST_S} =="
+    # priority 2: official A/B at the winning strip count
+    if [ "${BEST_S}" != 1 ] && [ "$(left)" -gt 1500 ]; then
+        run_bench "strips${BEST_S}" --sort-strips "${BEST_S}"
+    fi
+fi
+
+# priority 3: official default (validates the widened windows on-chip)
+if [ "$(left)" -gt 1500 ]; then
+    run_bench default
+fi
+
+# priority 4: the micro ladder tail the wedge cost (suspect dead last)
+if [ "$(left)" -gt 2000 ]; then
+    echo "== micro ladder r4 retry =="
+    python bench_runs/micro_r4.py --watchdog 1800 \
+        | tee "bench_runs/r4_micro_retry_${TS}.jsonl"
+fi
+
+# priority 5: pallas transport A/B
+if [ "$(left)" -gt 1500 ]; then
+    run_bench pallas --a2a-impl pallas
+fi
+
+echo "== final-window runner done =="

@@ -13,7 +13,7 @@ if [ "$wait_s" -gt 0 ]; then
     echo "draining runners in ${wait_s}s ($(date -u -d @${STOP_AT_EPOCH} 2>/dev/null || true))"
     sleep "$wait_s"
 fi
-for script in run_strips_ab.sh run_micro_retry.sh run_when_healthy_r4.sh; do
+for script in run_strips_ab.sh run_micro_retry.sh run_when_healthy_r4.sh run_final_window.sh; do
     pids=$(pgrep -f "bash .*${script}" || true)
     if [ -n "$pids" ]; then
         echo "terminating $script shell(s): $pids (children drain on own watchdogs)"
