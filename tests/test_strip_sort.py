@@ -377,3 +377,22 @@ def test_warmup_precompiles_strip_step(manager_factory, rng):
     assert step_after is step and step._cache_size() == 1, \
         "first strip read after warmup must not compile a second program"
     m.unregister_shuffle(973)
+
+
+def test_strip_step_static_cap_guard():
+    """The strip branch's trace-time guard: a payload whose cap differs
+    from plan.cap_in must raise at trace (the resolve derives
+    align_chunk from cap_in — a silent mismatch would misindex)."""
+    plan = ShufflePlan(num_shards=1, num_partitions=8, cap_in=256,
+                       cap_out=256, impl="dense", partitioner="direct",
+                       sort_strips=4)
+    step = step_body(plan, "shuffle")
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("shuffle",))
+    sm = jax.shard_map(step, mesh=mesh1,
+                       in_specs=(P("shuffle"), P("shuffle")),
+                       out_specs=(P("shuffle"), P(), P("shuffle"),
+                                  P("shuffle")), check_vma=False)
+    with pytest.raises(ValueError, match="cap_in"):
+        jax.eval_shape(sm,
+                       jax.ShapeDtypeStruct((128, 4), jnp.int32),
+                       jax.ShapeDtypeStruct((1,), jnp.int32))
