@@ -1,0 +1,92 @@
+"""Summarize every bench artifact under bench_runs/ as one table —
+the audit view over the round's measurement record (official bench
+JSONs, micro-ladder JSONLs, AOT proofs).
+
+Usage: python bench_runs/summarize.py [--all]   (--all includes CPU runs)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def fmt(v):
+    return "-" if v is None else (f"{v:.2f}" if isinstance(v, float) else v)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--all", action="store_true",
+                    help="include CPU-backend artifacts")
+    args = ap.parse_args()
+
+    rows = []
+    for name in sorted(os.listdir(HERE)):
+        path = os.path.join(HERE, name)
+        if name.endswith(".json"):
+            try:
+                rec = json.load(open(path))
+            except Exception:
+                continue
+            if "exp" in rec or "detail" not in rec:
+                # AOT proofs and misc dicts get their own section below
+                continue
+            stages = rec["detail"].get("stages", {})
+            backend = stages.get("init", {}).get("backend")
+            if backend != "tpu" and not args.all:
+                continue
+            r = {"artifact": name, "backend": backend,
+                 "value": rec.get("value"),
+                 "vs_baseline": rec.get("vs_baseline")}
+            for st in ("exchange_small", "exchange_full",
+                       "exchange_combine", "exchange_ordered"):
+                s = stages.get(st, {})
+                g = s.get("GBps_per_chip")
+                if g is None and s.get("step_ms") and s.get("rows_per_chip"):
+                    g = (s["rows_per_chip"] * s["row_bytes"]
+                         / (s["step_ms"] * 1e6))
+                tag = "" if not s.get("degenerate_timing") else "~"
+                r[st] = f"{g:.2f}{tag}" if g else \
+                    (s.get("status", "-") if s else "-")
+            if "fetch_p50_ms" in rec.get("detail", {}):
+                r["p50/p99 ms"] = (f"{rec['detail']['fetch_p50_ms']}/"
+                                   f"{rec['detail'].get('fetch_p99_ms')}")
+            rows.append(r)
+
+    cols = ["artifact", "backend", "value", "vs_baseline",
+            "exchange_small", "exchange_full", "exchange_combine",
+            "exchange_ordered", "p50/p99 ms"]
+    widths = {c: max(len(c), *(len(str(fmt(r.get(c)))) for r in rows))
+              for c in cols} if rows else {}
+    if rows:
+        print("  ".join(c.ljust(widths[c]) for c in cols))
+        for r in rows:
+            print("  ".join(str(fmt(r.get(c))).ljust(widths[c])
+                            for c in cols))
+        print("(~ = degenerate differencing window: conservative rate)")
+    else:
+        print("no official bench artifacts matched")
+
+    print("\nAOT lowering proofs:")
+    for name in sorted(os.listdir(HERE)):
+        if not (name.startswith("r") and "aot" in name
+                and name.endswith(".json")):
+            continue
+        try:
+            rec = json.load(open(os.path.join(HERE, name)))
+        except Exception:
+            continue
+        keys = {k: rec[k] for k in ("ok", "topology", "devices", "slices",
+                                    "strips", "group_sizes",
+                                    "replica_groups_n") if k in rec}
+        print(f"  {name}: {keys}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
