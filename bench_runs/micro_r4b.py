@@ -41,6 +41,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--watchdog", type=int, default=1800)
     ap.add_argument("--rows-log2", type=int, default=21)
+    ap.add_argument("--no-i8", action="store_true",
+                    help="skip the int8-key sweeps entirely (they are the "
+                         "r4 wedge suspects; an early-window run must not "
+                         "risk wedging the tunnel before the official "
+                         "capture lands)")
     args = ap.parse_args()
     threading.Timer(args.watchdog, lambda: os._exit(3)).start()
 
@@ -107,7 +112,8 @@ def main():
     # wedge suspects are int8 sort operands (ms8 stage; combine unstable
     # compaction), so the suspects must not cost the i32 sweep its window
     sweeps = [(S, jnp.int32, "i32") for S in (1, 8, 16, 32, 64, 128, 256)]
-    sweeps += [(S, jnp.int8, "i8") for S in (1, 64)]
+    if not args.no_i8:
+        sweeps += [(S, jnp.int8, "i8") for S in (1, 64)]
     for S, key_dtype, label in sweeps:
         M = rows // S
         r3 = jax.device_put(jnp.asarray(payload_np.reshape(S, M, W)))

@@ -1,0 +1,101 @@
+#!/bin/bash
+# Round-5 measurement runner — the window round 4 was denied.
+# Priority order is VERDICT r4 "Next round" items 1/2/4/6:
+#   1. strip-sort i32 sweep (micro_r4b --no-i8; the i8 wedge suspects
+#      NEVER run here — they cost two rounds their windows)
+#   2. official bench at the winning strip count (the A/B)
+#   3. official default bench (fresh non-tpu_failed BENCH_r05 evidence)
+#   4. pallas transport full-shape (promote/demote decision input)
+#   5. at-scale spill-backed run (bench_runs/scale_r5.py, if present)
+# NOTHING wraps TPU work in an external kill-timeout (NOTES_r2: that
+# wedges the tunnel); every python child self-watchdogs.
+# stop_r5_for_driver.sh SIGTERMs this SHELL before the driver's capture.
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+TS=$(date +%H%M%S)
+# no NEW stage after this epoch (driver's capture needs a drained chip)
+DEADLINE=${R5_DEADLINE_EPOCH:?set R5_DEADLINE_EPOCH}
+
+left() { echo $(( DEADLINE - $(date +%s) )); }
+
+log() { echo "[$(date -u +%H:%M:%S)] $*"; }
+
+log "== probe until healthy or deadline (left=$(left)s) =="
+healthy=0
+while [ "$(left)" -gt 900 ]; do
+    if python - <<'PYEOF'
+from bench import _tpu_probe_once
+import sys
+rec = _tpu_probe_once(240)
+print(rec, flush=True)
+sys.exit(0 if rec.get("rc") == 0 and rec.get("backend") == "tpu" else 3)
+PYEOF
+    then healthy=1; break; fi
+    log "# unhealthy; $(left)s to deadline; sleeping 300s"
+    sleep 300
+done
+if [ "$healthy" != 1 ]; then
+    log "== never healed before deadline; giving up =="
+    exit 3
+fi
+log "== HEALTHY — window open =="
+
+run_bench() {  # label, extra args...
+    local label=$1; shift
+    local out="bench_runs/r5_tpu_${TS}_${label}.json"
+    if python bench.py --no-fallback --init-retry-s 60 "$@" \
+            | tail -1 | tee "$out"; then
+        log "saved $out"
+    else
+        mv "$out" "$out.FAILED" 2>/dev/null
+        log "bench ($label) FAILED — artifact renamed"
+    fi
+}
+
+# priority 1: strip-sort i32 sweep (~10 min; i8 suspects excluded)
+BEST_S=1
+if [ "$(left)" -gt 1200 ]; then
+    log "== strip-sort i32 sweep =="
+    python bench_runs/micro_r4b.py --watchdog 1200 --no-i8 \
+        | tee "bench_runs/r5_strips_${TS}.jsonl"
+    BEST_S=$(python - "bench_runs/r5_strips_${TS}.jsonl" <<'PYEOF'
+import json, sys
+best, best_ms = 1, None
+for line in open(sys.argv[1]):
+    try:
+        d = json.loads(line)
+    except ValueError:
+        continue
+    if d.get("exp") == "strip_sort" and d.get("key") == "i32" \
+            and not d.get("degenerate") and "ms" in d:
+        if best_ms is None or d["ms"] < best_ms:
+            best, best_ms = d["S"], d["ms"]
+print(best)
+PYEOF
+    )
+    log "== best strip count (i32): ${BEST_S} =="
+fi
+
+# priority 2: official A/B at the winning strip count
+if [ "${BEST_S}" != 1 ] && [ "$(left)" -gt 1800 ]; then
+    run_bench "strips${BEST_S}" --sort-strips "${BEST_S}"
+fi
+
+# priority 3: official default (the fresh headline capture)
+if [ "$(left)" -gt 1800 ]; then
+    run_bench default
+fi
+
+# priority 4: pallas transport full-shape (VERDICT item 4)
+if [ "$(left)" -gt 1800 ]; then
+    run_bench pallas --a2a-impl pallas
+fi
+
+# priority 5: at-scale spill-backed run (VERDICT item 6), if shipped
+if [ -f bench_runs/scale_r5.py ] && [ "$(left)" -gt 2400 ]; then
+    log "== at-scale run =="
+    python bench_runs/scale_r5.py --watchdog 2100 \
+        | tee "bench_runs/r5_scale_${TS}.jsonl"
+fi
+
+log "== r5 runner done; artifacts under bench_runs/r5_* =="

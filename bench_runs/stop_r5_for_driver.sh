@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-5 drain guard: at the given UTC epoch, SIGTERM the runner SHELL
+# (run_r5_window.sh) so no NEW TPU stage launches — never its in-flight
+# python children (killing a client mid-compile wedges the tunnel,
+# NOTES_r2; children self-watchdog <=35 min, so the chip drains on its
+# own well before the driver runs bench.py).
+set -u
+STOP_AT_EPOCH=${1:?usage: stop_r5_for_driver.sh <epoch-seconds>}
+now=$(date +%s)
+wait_s=$((STOP_AT_EPOCH - now))
+if [ "$wait_s" -gt 0 ]; then
+    echo "draining r5 runner in ${wait_s}s"
+    sleep "$wait_s"
+fi
+pids=$(pgrep -f "bash .*run_r5_window[.]sh" || true)
+if [ -n "$pids" ]; then
+    echo "terminating run_r5_window.sh shell(s): $pids"
+    kill $pids 2>/dev/null || true
+fi
+echo "r5 drain guard done at $(date -u)"

@@ -199,11 +199,13 @@ def aot_compile_pallas_step(
     return report
 
 
-def _ragged_group_sizes(txt: str):
-    """Distinct replica-group sizes attached to ragged-all-to-all lines
-    in post-opt HLO, both textual forms ('{{0,1,..}}' braces and iota-v2
-    '[G,K]<=[N]')."""
-    sizes = set()
+def _ragged_group_size_counts(txt: str) -> dict:
+    """replica-group size -> number of ragged-all-to-all HLO lines
+    carrying it (post-opt), both textual forms ('{{0,1,..}}' braces and
+    iota-v2 '[G,K]<=[N]'). The COUNT matters: a two-stage proof must see
+    two distinct collective occurrences, not one line satisfying two
+    membership checks (ADVICE r4)."""
+    counts: dict = {}
     for line in txt.splitlines():
         if "ragged-all-to-all" not in line or "replica_groups" not in line:
             continue
@@ -211,11 +213,19 @@ def _ragged_group_sizes(txt: str):
         if inner.startswith("["):
             dims = inner[1:].split("]")[0].split(",")
             if "<=" in inner.split("]")[1][:3] and len(dims) == 2:
-                sizes.add(int(dims[1].strip()))
+                size = int(dims[1].strip())
+                counts[size] = counts.get(size, 0) + 1
             continue
         ids = inner.split("}")[0].strip("{").replace("{", "")
-        sizes.add(len([x for x in ids.split(",") if x.strip()]))
-    return sizes
+        size = len([x for x in ids.split(",") if x.strip()])
+        counts[size] = counts.get(size, 0) + 1
+    return counts
+
+
+def _ragged_group_sizes(txt: str):
+    """Distinct replica-group sizes attached to ragged-all-to-all lines
+    in post-opt HLO (set view of _ragged_group_size_counts)."""
+    return set(_ragged_group_size_counts(txt))
 
 
 def aot_compile_hier_step(
@@ -274,10 +284,16 @@ def aot_compile_hier_step(
     except Exception as e:
         report.update(ok=False, error=f"compile: {str(e)[:300]}")
         return report
-    sizes = _ragged_group_sizes(txt)
-    report["group_sizes"] = sorted(sizes)
-    # both stages present: ICI groups of per_slice, DCN groups of slices
-    report["ok"] = per_slice in sizes and slices in sizes
+    counts = _ragged_group_size_counts(txt)
+    report["group_sizes"] = sorted(counts)
+    report["group_size_counts"] = {str(k): v for k, v in
+                                   sorted(counts.items())}
+    # both stages present: ICI groups of per_slice AND DCN groups of
+    # slices — as TWO collective occurrences. When slices == per_slice a
+    # single one-stage lowering would satisfy both membership checks
+    # vacuously (ADVICE r4), so the line count must be >= 2.
+    report["ok"] = (per_slice in counts and slices in counts
+                    and sum(counts.values()) >= 2)
     return report
 
 

@@ -687,8 +687,17 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
             return
         pending = {}
         for s in range(self._num_shards):
-            # already-host shards are trivially ready (dev=None marker)
-            pending[s] = None if s in self._shards else self._shard_dev(s)
+            # already-host shards are trivially ready (dev=None marker);
+            # a shard that is NEITHER host-cached nor device-addressable
+            # must fail up front with the descriptive error, not surface
+            # as a KeyError from _shard_rows mid-iteration (ADVICE r4)
+            if s in self._shards:
+                pending[s] = None
+            else:
+                dev = self._shard_dev(s)
+                if dev is None:
+                    raise KeyError(f"shard {s} not addressable here")
+                pending[s] = dev
         while pending:
             progressed = False
             for s, dev in list(pending.items()):
