@@ -1,0 +1,224 @@
+"""Round-5 wedge root-cause ladder (VERDICT r4 next-round item 3).
+
+Two consecutive rounds lost their measurement windows to the same hang
+class: an on-chip sort with a NARROW key operand — r3's ms8 full-shape
+(``multisort8``: int8 destination key) and r4's combine-``unstable``
+compaction (4-key unstable sort whose first key is an {0,1} int32 flag)
+each ran >25 min before the watchdog fired, and the kill left the
+tunnel wedged for ~10 h (bench_runs/NOTES_r4.md window-3 timeline).
+
+This ladder answers the one question that can be answered WITHOUT
+renting the suspect another window: is the hang in XLA:TPU COMPILATION
+(reproducible offline through the local libtpu's AOT path — the same
+compiler the chip run invokes first) or in execution/tunnel
+interaction? Every case AOT-compiles one suspect formulation against a
+single-chip v5e topology in a KILLABLE subprocess (safe here: the local
+AOT path opens no tunnel connection — killing it cannot wedge anything,
+unlike on-chip clients, NOTES_r2).
+
+Bisection axes: is_stable x key dtype (i8 / i32 / {0,1}-flag) x
+num_keys x rows. Emits one JSONL line per case with compile seconds or
+TIMEOUT; the last line summarizes. Artifact: r5_wedge_aot.jsonl.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CASE_SRC = r"""
+import os, sys, json
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("ALLOW_MULTIPLE_LIBTPU_LOAD", "true")
+sys.path.insert(0, {repo!r})
+import jax, jax.numpy as jnp
+import numpy as np
+from jax.experimental import topologies
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from sparkucx_tpu.shuffle.aot import _resolve_topology
+
+case = json.loads(sys.argv[1])
+rep = {{}}
+topo = _resolve_topology(rep, None)
+assert topo is not None, rep
+# one topology chip + replicated shardings on it: the lowering targets
+# XLA:TPU (the compiler the on-chip run invokes), not the CPU backend
+mesh = Mesh(np.array(list(topo.devices))[:1], ("d",))
+shard1 = NamedSharding(mesh, P())
+
+rows = case["rows"]
+W = case.get("payload_words", 10)
+
+def build(case):
+    kind = case["kind"]
+    if kind == "sort":
+        kdt = dict(i8=jnp.int8, i32=jnp.int32)[case["key_dtype"]]
+        nk = case.get("num_keys", 1)
+        def fn(key, payload):
+            if case.get("flag_first"):
+                # the combine-unstable shape: {{0,1}} flag key leads
+                flag = (key & 1).astype(jnp.int32)
+                ops = (flag, key.astype(kdt)) + tuple(
+                    payload[:, j] for j in range(W))
+                return jax.lax.sort(ops, num_keys=nk,
+                                    is_stable=case["stable"])[2]
+            ops = (key.astype(kdt),) + tuple(
+                payload[:, j] for j in range(W))
+            return jax.lax.sort(ops, num_keys=nk,
+                                is_stable=case["stable"])[1]
+        args = (jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=shard1),
+                jax.ShapeDtypeStruct((rows, W), jnp.int32, sharding=shard1))
+        return fn, args
+    if kind == "combine":
+        from sparkucx_tpu.ops.aggregate import combine_rows
+        def fn(payload, part):
+            out, counts, _ = combine_rows(
+                payload, part, jnp.int32(rows), 64, 1,
+                np.dtype(np.int32), "sum",
+                compaction=case["compaction"])
+            return out[0]
+        args = (jax.ShapeDtypeStruct((rows, W), jnp.int32, sharding=shard1),
+                jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=shard1))
+        return fn, args
+    if kind == "multisort8":
+        from sparkucx_tpu.ops.partition import destination_sort
+        def fn(payload, part):
+            srt, seg = destination_sort(payload, part, jnp.int32(rows),
+                                        64, method=case["method"])
+            return srt[0]
+        args = (jax.ShapeDtypeStruct((rows, W), jnp.int32, sharding=shard1),
+                jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=shard1))
+        return fn, args
+    if kind == "scan_combine":
+        # the bench's ACTUAL program shape: the combine inside a
+        # k-length scan (diff_time wraps every measured step this way).
+        # If compile cost explodes superlinearly in k, the on-chip
+        # "hang" was a pathological compile - killed mid-way, which is
+        # precisely what wedges the tunnel.
+        from sparkucx_tpu.ops.aggregate import combine_rows
+        k = case["scan_len"]
+        def fn(payload, part):
+            def body(c, _):
+                pl, pt = c
+                pl = jax.lax.optimization_barrier(pl)
+                out, counts, _ = combine_rows(
+                    pl, pt, jnp.int32(rows), 64, 1,
+                    np.dtype(np.int32), "sum",
+                    compaction=case["compaction"])
+                return (pl ^ out[0:1, :], pt), ()
+            (pl, _), _ = jax.lax.scan(body, (payload, part), None,
+                                      length=k)
+            return pl.reshape(-1)[0:1]
+        args = (jax.ShapeDtypeStruct((rows, W), jnp.int32, sharding=shard1),
+                jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=shard1))
+        return fn, args
+    raise ValueError(kind)
+
+fn, args = build(case)
+import time as _t
+t0 = _t.perf_counter()
+lowered = jax.jit(fn).lower(*args)
+t_lower = _t.perf_counter() - t0
+t0 = _t.perf_counter()
+compiled = lowered.compile()
+t_compile = _t.perf_counter() - t0
+txt = compiled.as_text()
+print(json.dumps({{"ok": True, "lower_s": round(t_lower, 2),
+                  "compile_s": round(t_compile, 2),
+                  "hlo_lines": len(txt.splitlines()),
+                  "topology": rep.get("topology")}}), flush=True)
+"""
+
+
+def run_case(case: dict, timeout_s: int) -> dict:
+    code = CASE_SRC.format(repo=REPO)
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code, json.dumps(case)],
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"status": "TIMEOUT", "timeout_s": timeout_s,
+                "wall_s": round(time.perf_counter() - t0, 1)}
+    if proc.returncode != 0:
+        return {"status": "error",
+                "error": (proc.stderr or proc.stdout)[-300:]}
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            rep = json.loads(line)
+            rep["status"] = "ok"
+            return rep
+        except json.JSONDecodeError:
+            continue
+    return {"status": "error", "error": "no JSON line"}
+
+
+def main() -> None:
+    full = 1 << 21
+    small = 1 << 16
+    if "--scan" in sys.argv:
+        # phase 2: does the bench's scan harness multiply compile cost?
+        # (XLA:TPU may unroll constant-trip-count while loops; a 378 s
+        # body x12 unrolled would look exactly like the 25-min on-chip
+        # hang.) k=2 vs k=12 separates while-loop from unroll behavior.
+        cases = [
+            dict(name="scan2_combine_unstable", kind="scan_combine",
+                 compaction="unstable", scan_len=2, rows=full),
+            dict(name="scan12_combine_unstable", kind="scan_combine",
+                 compaction="unstable", scan_len=12, rows=full),
+            dict(name="scan12_combine_stable", kind="scan_combine",
+                 compaction="stable", scan_len=12, rows=full),
+        ]
+        results = {}
+        for case in cases:
+            rec = run_case(case, timeout_s=2400)
+            rec["case"] = case["name"]
+            results[case["name"]] = rec.get("status"), \
+                rec.get("compile_s", rec.get("timeout_s"))
+            print(json.dumps(rec), flush=True)
+        print(json.dumps({"summary": results}), flush=True)
+        return
+    cases = [
+        # controls first: known-good on-chip formulations
+        dict(name="i32_unstable_full", kind="sort", key_dtype="i32",
+             stable=False, rows=full),
+        dict(name="combine_stable_full", kind="combine",
+             compaction="stable", rows=full),
+        # the two wedge suspects, exact formulation, full shape
+        dict(name="combine_unstable_full", kind="combine",
+             compaction="unstable", rows=full),
+        dict(name="multisort8_full", kind="multisort8",
+             method="multisort8", rows=full),
+        # minimal bisections
+        dict(name="i8_unstable_full", kind="sort", key_dtype="i8",
+             stable=False, rows=full),
+        dict(name="i8_stable_full", kind="sort", key_dtype="i8",
+             stable=True, rows=full),
+        dict(name="i8_unstable_small", kind="sort", key_dtype="i8",
+             stable=False, rows=small),
+        dict(name="flag2key_unstable_full", kind="sort", key_dtype="i32",
+             stable=False, rows=full, num_keys=2, flag_first=True),
+        dict(name="multisort8_small", kind="multisort8",
+             method="multisort8", rows=small),
+        dict(name="combine_unstable_small", kind="combine",
+             compaction="unstable", rows=small),
+    ]
+    results = {}
+    for case in cases:
+        rec = run_case(case, timeout_s=420)
+        rec["case"] = case["name"]
+        results[case["name"]] = rec.get("status"), \
+            rec.get("compile_s", rec.get("timeout_s"))
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({"summary": {k: v for k, v in results.items()}}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,44 @@
+"""Versioned host-engine adapters — the compat seam.
+
+The reference proves its integration layer survives host-API drift by
+shipping the SAME data plane behind two differently-shaped SPI facades
+(ref: compat/spark_2_4/ vs compat/spark_3_0/ — e.g. the two
+``registerShuffle`` signatures at spark_3_0/UcxShuffleManager.scala:25-30
+and the per-block vs batch fetch contracts of the two UcxShuffleClient
+generations). This package is that capability here:
+
+- :mod:`v1` — the original facade contract (``service.ShuffleService``):
+  positional ``register_shuffle(id, num_maps, num_partitions, ...)``,
+  whole-result ``read()``.
+- :mod:`v2` — a drifted contract of the kind a newer host engine ships:
+  registration takes a :class:`~sparkucx_tpu.compat.v2.ShuffleDependency`
+  descriptor object, writers carry a (map_id, attempt_id) pair with
+  first-commit-wins on attempts, and reads go through a reader OBJECT
+  scoped to a partition range (the 3.0 ``startPartition/endPartition``
+  seam).
+
+Selection is purely by conf key — ``spark.shuffle.tpu.compat.version``
+(default ``v1``) — through :func:`sparkucx_tpu.connect`, exactly as the
+reference selects its compat flavor by what class name the host's conf
+carries (ref: README.md:44-48). Both adapters drive the one production
+manager; neither reimplements any data-plane behavior.
+"""
+
+from __future__ import annotations
+
+ADAPTER_VERSIONS = ("v1", "v2")
+
+
+def resolve_adapter(version: str):
+    """Adapter class for a ``compat.version`` conf value (ValueError on
+    an unknown version — at connect() time, not first use)."""
+    v = version.strip().lower()
+    if v == "v1":
+        from sparkucx_tpu.service import ShuffleService
+        return ShuffleService
+    if v == "v2":
+        from sparkucx_tpu.compat.v2 import ShuffleServiceV2
+        return ShuffleServiceV2
+    raise ValueError(
+        f"unknown spark.shuffle.tpu.compat.version {version!r}; "
+        f"want one of {ADAPTER_VERSIONS}")

@@ -1,0 +1,205 @@
+"""The v2 host-engine adapter — a DRIFTED facade contract over the same
+production stack (see compat/__init__ for why this exists; the reference
+analog is the spark_3_0 generation of its SPI: dependency-object
+registration at compat/spark_3_0/UcxShuffleManager.scala:25-30, map
+ATTEMPTS with first-commit-wins, and partition-range readers at
+UcxShuffleManager.scala:53-60).
+
+Contract differences vs v1 (``service.ShuffleService``), mirroring the
+kind of drift a major host-engine release ships:
+
+- ``register(dep)``: one :class:`ShuffleDependency` descriptor instead of
+  positional arguments; the shuffle id lives IN the descriptor.
+- ``writer(handle, map_id, attempt_id)``: attempts are explicit. A retry
+  attempt for a committed map output raises (first-commit-wins, the same
+  manager rule v1 hits implicitly); a retry of an UNcommitted attempt
+  supersedes it.
+- ``reader(handle, start, end)``: reads return a :class:`PartitionReader`
+  scoped to [start, end) — iteration, not a whole-result object; the
+  exchange is still the manager's one collective.
+
+No data-plane logic here: everything delegates to TpuShuffleManager.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, Mapping, Optional, Tuple
+
+import numpy as np
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.runtime.node import TpuNode
+from sparkucx_tpu.shuffle.manager import ShuffleHandle, TpuShuffleManager
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("compat.v2")
+
+
+@dataclass(frozen=True)
+class ShuffleDependency:
+    """Registration descriptor — the v2 contract's analog of Spark's
+    ShuffleDependency argument (ref: compat/spark_3_0/
+    UcxShuffleManager.scala:25-30 registers from a dependency object,
+    where the 2.4 signature took discrete numMaps arguments)."""
+    shuffle_id: int
+    num_maps: int
+    num_partitions: int
+    partitioner: str = "hash"
+    bounds: Optional[Tuple[int, ...]] = None
+    # read-side defaults carried WITH the shuffle (a v2-only drift:
+    # the dependency declares its aggregator, reads just execute it)
+    combine: Optional[str] = None
+    combine_sum_words: int = 0
+    ordered: bool = False
+
+
+class MapWriterV2:
+    """One (map_id, attempt_id) writer lease. ``write`` stages batches,
+    ``commit`` publishes — identical data plane, drifted surface."""
+
+    def __init__(self, mgr: TpuShuffleManager, handle: ShuffleHandle,
+                 map_id: int, attempt_id: int):
+        self._mgr = mgr
+        self._handle = handle
+        self.map_id = map_id
+        self.attempt_id = attempt_id
+        self._w = mgr.get_writer(handle, map_id)
+
+    def write(self, keys, values: Optional[np.ndarray] = None) -> None:
+        self._w.write(np.asarray(keys), values)
+
+    def commit(self) -> None:
+        self._w.commit(self._handle.num_partitions)
+
+    @property
+    def committed(self) -> bool:
+        return self._w.committed
+
+
+class PartitionReader:
+    """Reader scoped to partitions [start, end) of one shuffle — the
+    v2 read contract (ref: compat/spark_3_0/UcxShuffleManager.scala:53-60
+    passes startPartition/endPartition into the reader; the whole reduce
+    side is still ONE exchange underneath, manager.read_partitions)."""
+
+    def __init__(self, mgr: TpuShuffleManager, handle: ShuffleHandle,
+                 start: int, end: int, dep: ShuffleDependency,
+                 timeout: Optional[float]):
+        self._mgr = mgr
+        self._handle = handle
+        self.start, self.end = start, end
+        self._dep = dep
+        self._timeout = timeout
+        self._res = None
+
+    def _result(self):
+        if self._res is None:
+            self._res = self._mgr.read(
+                self._handle, timeout=self._timeout,
+                combine=self._dep.combine, ordered=self._dep.ordered,
+                combine_sum_words=self._dep.combine_sum_words)
+        return self._res
+
+    def __iter__(self) -> Iterator[Tuple[int, tuple]]:
+        res = self._result()
+        for r in range(self.start, self.end):
+            if res.is_local(r):
+                yield r, res.partition(r)
+
+    def batch(self) -> dict:
+        """All partitions in range as {r: (keys, values)} — the v2
+        batch-fetch verb (the reference's 3.0 client fetches blocks in
+        one batched request, reducer/compat/spark_3_0/
+        UcxShuffleClient.java:95-127)."""
+        return dict(iter(self))
+
+
+class ShuffleServiceV2:
+    """The v2 facade. Same constructor seam as v1 so ``connect()`` can
+    select either class purely from conf (compat/__init__)."""
+
+    def __init__(self, conf: TpuShuffleConf, distributed: bool = False,
+                 process_id: int = 0, metrics_reporter=None):
+        self.conf = conf
+        # the v2 contract carries raw int rows; a configured codec the
+        # adapter would silently drop must be REJECTED at connect time
+        # (v1 validates the same key — switching compat.version must not
+        # switch off conf validation)
+        self.io_format = conf.get(
+            "spark.shuffle.tpu.io.format", "raw").strip().lower()
+        if self.io_format != "raw":
+            raise ValueError(
+                f"compat v2 adapter supports io.format=raw only, got "
+                f"{self.io_format!r}; use compat.version=v1 for arrow")
+        self.node = TpuNode.start(conf, distributed=distributed,
+                                  process_id=process_id)
+        self.manager = TpuShuffleManager(self.node, conf)
+        self._deps: dict = {}
+        self._attempts: dict = {}      # (sid, map_id) -> attempt_id
+        self._metrics_reporter = metrics_reporter
+        if metrics_reporter is not None:
+            self.node.metrics.add_reporter(metrics_reporter)
+        log.info("ShuffleServiceV2 up: %d devices", self.node.num_devices)
+
+    # -- lifecycle ---------------------------------------------------------
+    def register(self, dep: ShuffleDependency) -> ShuffleHandle:
+        h = self.manager.register_shuffle(
+            dep.shuffle_id, dep.num_maps, dep.num_partitions,
+            dep.partitioner, bounds=dep.bounds)
+        self._deps[dep.shuffle_id] = dep
+        return h
+
+    def unregister(self, shuffle_id: int) -> None:
+        self.manager.unregister_shuffle(shuffle_id)
+        self._deps.pop(shuffle_id, None)
+        self._attempts = {k: v for k, v in self._attempts.items()
+                          if k[0] != shuffle_id}
+
+    def stop(self) -> None:
+        if self._metrics_reporter is not None:
+            self.node.metrics.remove_reporter(self._metrics_reporter)
+            self._metrics_reporter = None
+        self.manager.stop()
+        self.node.close()
+
+    close = stop
+
+    def __enter__(self) -> "ShuffleServiceV2":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- map side ----------------------------------------------------------
+    def writer(self, handle: ShuffleHandle, map_id: int,
+               attempt_id: int = 0) -> MapWriterV2:
+        """Writer lease for one map ATTEMPT. First-commit-wins across
+        attempts (the manager enforces it); a stale attempt id (lower
+        than one already seen) is rejected up front — the speculative-
+        task discipline the reference gets from Spark's scheduler."""
+        key = (handle.shuffle_id, map_id)
+        seen = self._attempts.get(key)
+        if seen is not None and attempt_id < seen:
+            raise RuntimeError(
+                f"stale attempt {attempt_id} for shuffle "
+                f"{handle.shuffle_id} map {map_id}: attempt {seen} "
+                f"already ran")
+        self._attempts[key] = attempt_id
+        return MapWriterV2(self.manager, handle, map_id, attempt_id)
+
+    # -- reduce side -------------------------------------------------------
+    def reader(self, handle: ShuffleHandle, start: int = 0,
+               end: Optional[int] = None,
+               timeout: Optional[float] = None) -> PartitionReader:
+        end = handle.num_partitions if end is None else end
+        if not (0 <= start <= end <= handle.num_partitions):
+            raise IndexError(
+                f"partition range [{start}, {end}) out of "
+                f"[0, {handle.num_partitions}]")
+        dep = self._deps.get(handle.shuffle_id)
+        if dep is None:
+            raise KeyError(f"shuffle {handle.shuffle_id} not registered "
+                           f"through this adapter")
+        return PartitionReader(self.manager, handle, start, end, dep,
+                               timeout)

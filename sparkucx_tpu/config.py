@@ -110,6 +110,8 @@ class TpuShuffleConf:
                         "(io/arrow.py)",
         "io.stringMaxBytes": "varlen format: per-string byte cap "
                              "(io/varlen.py)",
+        "compat.version": "host-adapter contract: v1 | v2 "
+                          "(compat/__init__.resolve_adapter)",
         "trace.enabled": "turn on the span tracer (utils/trace.py)",
         "trace.device": "also record device-time spans",
         "trace.capacity": "tracer ring-buffer size",
@@ -192,7 +194,17 @@ class TpuShuffleConf:
 
     # -- raw access -------------------------------------------------------
     def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
-        return self._conf.get(key, default)
+        # exact spelling first; else the case/punctuation-insensitive
+        # index — so a conf written under an alternate spelling is still
+        # FOUND by canonical-key readers (set() already writes through
+        # the index; reading must honor the same equivalence, or e.g.
+        # 'compat.Version: v2' would silently select the default adapter)
+        if key in self._conf:
+            return self._conf[key]
+        canonical = self._index.get(_norm(key))
+        if canonical is not None and canonical in self._conf:
+            return self._conf[canonical]
+        return default
 
     def set(self, key: str, value) -> "TpuShuffleConf":
         # Case/punctuation-insensitive: writing through any spelling updates

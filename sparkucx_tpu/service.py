@@ -182,9 +182,19 @@ def connect(conf: Optional[Mapping[str, str]] = None, *,
     ``metrics_reporter`` — optional ``fn(name, value)`` observing every
     shuffle metric increment (read wait ms, rows, bytes, retry counts) —
     the embedding engine's ShuffleReadMetricsReporter seam
-    (ref: UcxShuffleReader.scala:111-116)."""
+    (ref: UcxShuffleReader.scala:111-116).
+
+    ``spark.shuffle.tpu.compat.version`` selects WHICH facade contract
+    wraps the stack — ``v1`` (this module's ShuffleService, default) or
+    ``v2`` (compat/v2.py: dependency-object registration, attempt-id
+    writers, partition-range readers) — the versioned-adapter seam the
+    reference demonstrates with its two compat generations
+    (ref: compat/spark_2_4/ vs compat/spark_3_0/)."""
     tconf = conf if isinstance(conf, TpuShuffleConf) \
         else TpuShuffleConf(conf, use_env=use_env)
-    return ShuffleService(tconf, distributed=distributed,
-                          process_id=process_id,
-                          metrics_reporter=metrics_reporter)
+    from sparkucx_tpu.compat import resolve_adapter
+    cls = resolve_adapter(
+        tconf.get("spark.shuffle.tpu.compat.version", "v1"))
+    return cls(tconf, distributed=distributed,
+               process_id=process_id,
+               metrics_reporter=metrics_reporter)

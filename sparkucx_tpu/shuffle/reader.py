@@ -32,7 +32,7 @@ from __future__ import annotations
 
 import functools
 import os
-import time
+import threading
 from typing import Optional, Tuple
 
 import jax
@@ -675,48 +675,66 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
 
     def partitions_ready(self, poll_s: float = 0.002):
         """Arrival-order iteration: shards whose transfer already
-        completed yield their partitions first (polled via the array's
-        non-blocking ``is_ready``), so a slow shard never head-of-line
-        blocks the consumer — the reference's reducers likewise consume
-        whichever remote's blocks complete first
-        (ref: reducer/OnBlocksFetchCallback.java:45-53). Partition
-        granularity transfers on demand (arrival order has no meaning
-        there): index order."""
+        completed yield their partitions first, so a slow shard never
+        head-of-line blocks the consumer — the reference's reducers
+        likewise consume whichever remote's blocks complete first
+        (ref: reducer/OnBlocksFetchCallback.java:45-53).
+
+        EVENT-driven, not a spin: each still-pending shard gets a waiter
+        thread parked in the runtime's own completion wait
+        (``block_until_ready`` — the WAKEUP-event discipline of the
+        reference's progress loop, ref: UcxNode.java:63-66,
+        UcxListenerThread.java:44-52), posting to a queue the consumer
+        blocks on. ``poll_s`` is kept for API compatibility; nothing
+        sleeps on it anymore. Partition granularity transfers on demand
+        (arrival order has no meaning there): index order."""
         if self._rows_dev is None or self.fetch_granularity == "partition":
             yield from self.partitions()
             return
-        pending = {}
+        import queue as _queue
+        ready_q: "_queue.Queue" = _queue.Queue()
+        n_pending = 0
         for s in range(self._num_shards):
-            # already-host shards are trivially ready (dev=None marker);
-            # a shard that is NEITHER host-cached nor device-addressable
-            # must fail up front with the descriptive error, not surface
-            # as a KeyError from _shard_rows mid-iteration (ADVICE r4)
+            # already-host shards are trivially ready (yield first, in
+            # index order); a shard NEITHER host-cached nor
+            # device-addressable must fail up front with the descriptive
+            # error, not a KeyError mid-iteration (ADVICE r4)
             if s in self._shards:
-                pending[s] = None
-            else:
-                dev = self._shard_dev(s)
-                if dev is None:
-                    raise KeyError(f"shard {s} not addressable here")
-                pending[s] = dev
-        while pending:
-            progressed = False
-            for s, dev in list(pending.items()):
+                ready_q.put(s)
+                n_pending += 1
+                continue
+            dev = self._shard_dev(s)
+            if dev is None:
+                raise KeyError(f"shard {s} not addressable here")
+            # non-blocking pre-pass: a transfer that already completed
+            # (the common case once the exchange quiesced) costs no
+            # thread — only genuinely in-flight shards get a waiter
+            try:
+                already = bool(dev.is_ready())
+            except AttributeError:
+                already = True       # no readiness API: don't stall
+            if already:
+                ready_q.put(s)
+                n_pending += 1
+                continue
+
+            def wait(shard=s, d=dev):
                 try:
-                    ready = dev is None or bool(dev.is_ready())
-                except AttributeError:   # no readiness API: don't stall
-                    ready = True
-                if ready:
-                    del pending[s]
-                    progressed = True
-                    # blocked map is sorted (same invariant _runs uses)
-                    r_lo = int(np.searchsorted(self._part_to_shard, s,
-                                               "left"))
-                    r_hi = int(np.searchsorted(self._part_to_shard, s,
-                                               "right"))
-                    for r in range(r_lo, r_hi):
-                        yield r, self.partition(r)
-            if pending and not progressed:
-                time.sleep(poll_s)
+                    d.block_until_ready()
+                except Exception:
+                    pass        # surface errors on the fetch itself
+                ready_q.put(shard)
+            t = threading.Thread(target=wait, daemon=True,
+                                 name=f"sxt-shard-wait-{s}")
+            t.start()
+            n_pending += 1
+        for _ in range(n_pending):
+            s = ready_q.get()       # true event wait, no spin
+            # blocked map is sorted (same invariant _runs uses)
+            r_lo = int(np.searchsorted(self._part_to_shard, s, "left"))
+            r_hi = int(np.searchsorted(self._part_to_shard, s, "right"))
+            for r in range(r_lo, r_hi):
+                yield r, self.partition(r)
 
     def _partition_block(self, r: int, shard: int) -> np.ndarray:
         if self.fetch_granularity != "partition" \

@@ -686,9 +686,13 @@ def test_partitions_ready_arrival_order(manager_factory, rng):
         allk.append(k)
     res = m.read(h)
 
-    # wrap shard 0's device array: is_ready() stays False until shard
-    # 1's rows were consumed, proving the iterator reorders around it
+    # wrap shard 0's device array: its completion wait (the iterator's
+    # per-shard block_until_ready event) does not fire until shard 1's
+    # rows were consumed, proving the iterator reorders around it
+    import threading
     consumed = []
+    shard1_consumed = threading.Event()
+    wait_timed_out = []
 
     class _SlowDev:
         def __init__(self, real):
@@ -696,7 +700,15 @@ def test_partitions_ready_arrival_order(manager_factory, rng):
             self.shape = real.shape
 
         def is_ready(self):
-            return 1 in consumed
+            return False            # force the event-driven waiter path
+
+        def block_until_ready(self):
+            # NOTE: runs inside the reader's waiter thread where raised
+            # exceptions are swallowed — record the failure for the main
+            # thread instead of asserting here
+            if not shard1_consumed.wait(timeout=30):
+                wait_timed_out.append(True)
+            return self
 
         def __array__(self, dtype=None, copy=None):
             return np.asarray(self._real)
@@ -716,8 +728,11 @@ def test_partitions_ready_arrival_order(manager_factory, rng):
         shard = int(res._part_to_shard[r])
         if shard not in consumed:
             consumed.append(shard)
+        if shard == 1:
+            shard1_consumed.set()
         order.append(r)
         got[r] = k
+    assert not wait_timed_out, "consumer never reached shard 1"
     assert sorted(order) == list(range(R)), "every partition exactly once"
     slow_rs = np.nonzero(np.asarray(res._part_to_shard) == 0)[0].tolist()
     assert order[-len(slow_rs):] == slow_rs, \
