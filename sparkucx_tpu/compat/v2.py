@@ -185,8 +185,12 @@ class ShuffleServiceV2:
                 f"stale attempt {attempt_id} for shuffle "
                 f"{handle.shuffle_id} map {map_id}: attempt {seen} "
                 f"already ran")
+        # lease FIRST: a rejected lease (committed map, bad map_id) must
+        # not advance the watermark, or later errors would name an
+        # attempt that never obtained a writer
+        w = MapWriterV2(self.manager, handle, map_id, attempt_id)
         self._attempts[key] = attempt_id
-        return MapWriterV2(self.manager, handle, map_id, attempt_id)
+        return w
 
     # -- reduce side -------------------------------------------------------
     def reader(self, handle: ShuffleHandle, start: int = 0,

@@ -193,18 +193,26 @@ class TpuShuffleConf:
         return rows
 
     # -- raw access -------------------------------------------------------
-    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
-        # exact spelling first; else the case/punctuation-insensitive
-        # index — so a conf written under an alternate spelling is still
-        # FOUND by canonical-key readers (set() already writes through
-        # the index; reading must honor the same equivalence, or e.g.
-        # 'compat.Version: v2' would silently select the default adapter)
+    def _lookup(self, key: str):
+        """Exact spelling first; else the case/punctuation-insensitive
+        index — ONE equivalence rule shared by get(), __contains__ and
+        the typed _get(), so full-key and short-key reads cannot
+        disagree on what counts as the same key. Returns the value or
+        None."""
         if key in self._conf:
             return self._conf[key]
-        canonical = self._index.get(_norm(key))
-        if canonical is not None and canonical in self._conf:
-            return self._conf[canonical]
-        return default
+        hit = self._index.get(_norm(key))
+        if hit is not None:
+            return self._conf[hit]
+        return None
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        # spelling-insensitive: a conf written under an alternate
+        # spelling must still be FOUND by canonical-key readers (set()
+        # already writes through the index; e.g. 'compat.Version: v2'
+        # must not silently select the default adapter)
+        got = self._lookup(key)
+        return default if got is None else got
 
     def set(self, key: str, value) -> "TpuShuffleConf":
         # Case/punctuation-insensitive: writing through any spelling updates
@@ -215,7 +223,7 @@ class TpuShuffleConf:
         return self
 
     def __contains__(self, key: str) -> bool:
-        return key in self._conf
+        return self._lookup(key) is not None
 
     def items(self) -> Iterator[Tuple[str, str]]:
         return iter(sorted(self._conf.items()))
@@ -224,13 +232,8 @@ class TpuShuffleConf:
     def _get(self, short: str, default) -> str:
         if getattr(self, "_seen_shorts", None) is not None:
             self._seen_shorts.add(short)   # validate() key-surface census
-        full = PREFIX + short
-        if full in self._conf:
-            return self._conf[full]
-        hit = self._index.get(_norm(full))
-        if hit is not None:
-            return self._conf[hit]
-        return str(default)
+        got = self._lookup(PREFIX + short)
+        return str(default) if got is None else got
 
     def get_int(self, short: str, default: int) -> int:
         return int(self._get(short, default))

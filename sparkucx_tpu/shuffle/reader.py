@@ -721,6 +721,17 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
             def wait(shard=s, d=dev):
                 try:
                     d.block_until_ready()
+                except AttributeError:
+                    # readiness API without a blocking wait (the pre-pass
+                    # just saw is_ready() False): poll INSIDE the waiter —
+                    # posting immediately would hand the consumer a
+                    # knowingly in-flight transfer
+                    pause = threading.Event()
+                    try:
+                        while not d.is_ready():
+                            pause.wait(0.002)
+                    except Exception:
+                        pass    # surface errors on the fetch itself
                 except Exception:
                     pass        # surface errors on the fetch itself
                 ready_q.put(shard)

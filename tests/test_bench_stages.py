@@ -48,8 +48,7 @@ def test_fetch_device_stage_skips_tiny_shapes(mesh8):
     assert mon.ended["fetch_device"]["status"] == "skipped"
 
 
-def test_best_recorded_run_ranks_full_stage_with_zero_value(tmp_path,
-                                                            monkeypatch):
+def test_best_recorded_run_ranks_full_stage_with_zero_value(tmp_path):
     """An artifact whose top-level value is 0 but whose exchange_full
     stage is valid must still rank for the headline (ADVICE r4)."""
     rundir = tmp_path / "bench_runs"
@@ -68,9 +67,7 @@ def test_best_recorded_run_ranks_full_stage_with_zero_value(tmp_path,
             "exchange_full": {"status": "ok", "rows_per_chip": 1 << 12,
                               "row_bytes": 40, "GBps_per_chip": 14.8,
                               "degenerate_timing": False}}}}))
-    monkeypatch.setattr(bench.os.path, "dirname",
-                        lambda p: str(tmp_path))
-    best = bench._best_recorded_tpu_run()
+    best = bench._best_recorded_tpu_run(rundir=str(rundir))
     # full-shape headline comes from a.json despite value=0; the higher
     # small-shape value rides along as context, never displaces it
     assert best["value"] == 7.5
