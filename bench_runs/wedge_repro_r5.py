@@ -95,6 +95,61 @@ def build(case):
         args = (jax.ShapeDtypeStruct((rows, W), jnp.int32, sharding=shard1),
                 jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=shard1))
         return fn, args
+    if kind == "sort_ops":
+        # decomposition probe: is compile cost driven by the sort's
+        # OPERAND COUNT, the key count, or the surrounding machinery?
+        nk = case.get("num_keys", 1)
+        nops = case["num_operands"]
+        with_cumsum = case.get("with_cumsum", False)
+        def fn(key, payload):
+            ops = tuple((key + j) if j < nk else payload[:, j % W]
+                        for j in range(nk)) + tuple(
+                payload[:, j % W] + j for j in range(nops - nk))
+            out = jax.lax.sort(ops, num_keys=nk,
+                               is_stable=case.get("stable", False))
+            r = out[nk]
+            if with_cumsum:
+                inc = jnp.cumsum(jnp.stack(out[nk:nk + 4], axis=1),
+                                 axis=0)
+                r = r + inc[:, 0]
+            return r
+        args = (jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=shard1),
+                jax.ShapeDtypeStruct((rows, W), jnp.int32, sharding=shard1))
+        return fn, args
+    if kind == "pieces":
+        # bisect destination_sort's machinery: sentinel key, the sort
+        # itself (i8/i32), counts_from_sorted (searchsorted diffs)
+        from sparkucx_tpu.ops.partition import (_sentinel_key,
+                                                counts_from_sorted)
+        which = case["which"]
+        def fn(payload, part):
+            key = _sentinel_key(part, jnp.int32(rows), 64, rows)
+            if case.get("i8"):
+                key = key.astype(jnp.int8)
+            if which == "counts_only":
+                c = counts_from_sorted(key, 64)
+                return c
+            ops = (key,) + tuple(payload[:, j] for j in range(W))
+            out = jax.lax.sort(ops, num_keys=1, is_stable=False)
+            if which == "sort_only":
+                return out[1]
+            if which == "sort_stack":
+                # the full row reconstruction destination_sort ships:
+                # does the [2M, 10] stack of sorted columns explode
+                # compile where the sort itself does not?
+                return jnp.stack(out[1:], axis=1)
+            if which == "sort_stack0T":
+                # candidate cheap reconstruction: one [W, cap] stack +
+                # one transpose instead of W slice-inserts along axis 1
+                return jnp.stack(out[1:], axis=0).T
+            if which == "sort_concat":
+                return jnp.concatenate([o[:, None] for o in out[1:]],
+                                       axis=1)
+            c = counts_from_sorted(out[0], 64)       # sort_plus_counts
+            return out[1][:64] + c
+        args = (jax.ShapeDtypeStruct((rows, W), jnp.int32, sharding=shard1),
+                jax.ShapeDtypeStruct((rows,), jnp.int32, sharding=shard1))
+        return fn, args
     if kind == "scan_combine":
         # the bench's ACTUAL program shape: the combine inside a
         # k-length scan (diff_time wraps every measured step this way).
@@ -159,6 +214,19 @@ def run_case(case: dict, timeout_s: int) -> dict:
     return {"status": "error", "error": "no JSON line"}
 
 
+def run_ladder(cases, timeout_s):
+    """Run cases sequentially, one JSONL line each + a summary line."""
+    results = {}
+    for case in cases:
+        rec = run_case(case, timeout_s=timeout_s)
+        rec["case"] = case["name"]
+        results[case["name"]] = (rec.get("status"),
+                                 rec.get("compile_s",
+                                         rec.get("timeout_s")))
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({"summary": results}), flush=True)
+
+
 def main() -> None:
     full = 1 << 21
     small = 1 << 16
@@ -175,14 +243,63 @@ def main() -> None:
             dict(name="scan12_combine_stable", kind="scan_combine",
                  compaction="stable", scan_len=12, rows=full),
         ]
-        results = {}
-        for case in cases:
-            rec = run_case(case, timeout_s=2400)
-            rec["case"] = case["name"]
-            results[case["name"]] = rec.get("status"), \
-                rec.get("compile_s", rec.get("timeout_s"))
-            print(json.dumps(rec), flush=True)
-        print(json.dumps({"summary": results}), flush=True)
+        run_ladder(cases, 2400)
+        return
+    if "--ops" in sys.argv:
+        # phase 3: decompose the combine/multisort8 compile blowup.
+        # combine's sort: 4 keys + ~15 operands; plain fast sorts: 1 key
+        # + 11 operands. Sweep the axes separately.
+        cases = [
+            dict(name="ops11_k1", kind="sort_ops", num_operands=11,
+                 num_keys=1, rows=full),
+            dict(name="ops16_k1", kind="sort_ops", num_operands=16,
+                 num_keys=1, rows=full),
+            dict(name="ops16_k4", kind="sort_ops", num_operands=16,
+                 num_keys=4, rows=full),
+            dict(name="ops11_k4", kind="sort_ops", num_operands=11,
+                 num_keys=4, rows=full),
+            dict(name="ops16_k4_cumsum", kind="sort_ops",
+                 num_operands=16, num_keys=4, with_cumsum=True,
+                 rows=full),
+            dict(name="ops24_k1", kind="sort_ops", num_operands=24,
+                 num_keys=1, rows=full),
+        ]
+        run_ladder(cases, 900)
+        return
+    if "--pieces3" in sys.argv:
+        cases = [
+            dict(name="sent_i32_sort_stack0T", kind="pieces",
+                 which="sort_stack0T", rows=full),
+            dict(name="sent_i32_sort_concat", kind="pieces",
+                 which="sort_concat", rows=full),
+        ]
+        run_ladder(cases, 900)
+        return
+    if "--pieces2" in sys.argv:
+        cases = [
+            dict(name="sent_i8_sort_stack", kind="pieces",
+                 which="sort_stack", i8=True, rows=full),
+            dict(name="sent_i32_sort_stack", kind="pieces",
+                 which="sort_stack", rows=full),
+            dict(name="multisort8_again", kind="multisort8",
+                 method="multisort8", rows=full),
+        ]
+        run_ladder(cases, 900)
+        return
+    if "--pieces" in sys.argv:
+        cases = [
+            dict(name="sent_i8_sort_only", kind="pieces",
+                 which="sort_only", i8=True, rows=full),
+            dict(name="sent_i32_sort_only", kind="pieces",
+                 which="sort_only", rows=full),
+            dict(name="counts_only_i32", kind="pieces",
+                 which="counts_only", rows=full),
+            dict(name="sent_i8_sort_counts", kind="pieces",
+                 which="sort_plus_counts", i8=True, rows=full),
+            dict(name="sent_i32_sort_counts", kind="pieces",
+                 which="sort_plus_counts", rows=full),
+        ]
+        run_ladder(cases, 900)
         return
     cases = [
         # controls first: known-good on-chip formulations
@@ -209,15 +326,7 @@ def main() -> None:
         dict(name="combine_unstable_small", kind="combine",
              compaction="unstable", rows=small),
     ]
-    results = {}
-    for case in cases:
-        rec = run_case(case, timeout_s=420)
-        rec["case"] = case["name"]
-        results[case["name"]] = rec.get("status"), \
-            rec.get("compile_s", rec.get("timeout_s"))
-        print(json.dumps(rec), flush=True)
-    print(json.dumps({"summary": {k: v for k, v in results.items()}}),
-          flush=True)
+    run_ladder(cases, 420)
 
 
 if __name__ == "__main__":

@@ -155,13 +155,17 @@ def combine_rows(
                  ``stable``   — 1-key (flag) stable sort; relies on
                                 stability to keep the (part, key) order
                                 from the grouping sort.
-                 ``unstable`` — 4-key (flag, part, key_hi, key_lo)
-                                unstable sort; end rows are unique per
-                                (part, key), so explicit keys restore the
-                                exact same order without paying the
-                                stability machinery (~40% of TPU sort
-                                cost per the round-2 A/B — the candidate
-                                for the 101 ms combine laggard).
+                 ``unstable`` — explicit-key unstable sort: 3 keys
+                                (flag|part fused, key_hi, key_lo) when
+                                num_parts < 2^30 (the common case; key
+                                count drives XLA:TPU sort compile cost,
+                                r5_wedge_aot.jsonl), else the 4-key
+                                (flag, part, key_hi, key_lo) form. End
+                                rows are unique per (part, key), so
+                                explicit keys restore the exact same
+                                order without paying the stability
+                                machinery (~40% of TPU sort cost per the
+                                round-2 A/B).
 
     Returns (rows_out [cap, W], pcounts [num_parts], n_out [1]):
     rows_out's first n_out rows are one row per distinct (partition, key),
@@ -213,12 +217,32 @@ def combine_rows(
     # representative, no differencing.
     flag = jnp.where(is_end, 0, 1).astype(jnp.int32)
     m = incl.shape[1]
-    if compaction == "unstable":
-        # explicit (flag, part, key) keys — end rows are unique per
+    if compaction == "unstable" and num_parts < (1 << 30):
+        # explicit (flag|part, key) keys — end rows are unique per
         # (part, key), so the unstable order equals the stable one; the
         # lo word is flipped for unsigned compare (module docstring).
-        # Dead (flag=1) rows land in arbitrary order past n_out, where
-        # every lane is masked to zero below.
+        # flag ({0,1}) packs into bit 30 above part (< 2^30): one fused
+        # key orders identically to the (flag, part) pair and drops a
+        # whole key operand — the r5 AOT bisection measured XLA:TPU sort
+        # compile cost scaling with KEY COUNT (4 keys 75 s vs 1 key 9 s
+        # at identical operand counts, bench_runs/r5_wedge_aot.jsonl),
+        # and every comparator stage at runtime evaluates one less
+        # column. Dead (flag=1) rows land past n_out, where every lane
+        # is masked to zero below.
+        flagpart = (flag << jnp.int32(30)) | spart
+        sort_ops = (flagpart, srows[:, 1],
+                    srows[:, 0] ^ jnp.int32(_FLIP)) \
+            + (srows[:, 0],) \
+            + tuple(incl[:, t] for t in range(m)) \
+            + tuple(srows[:, 2 + sum_words + t] for t in range(carry_n))
+        out = jax.lax.sort(sort_ops, num_keys=3, is_stable=False)
+        epart = out[0] & jnp.int32((1 << 30) - 1)
+        khi, klo = out[1], out[3]
+        ends_incl = jnp.stack(out[4:4 + m], axis=1)       # [cap, m]
+        carry_start = 4 + m
+    elif compaction == "unstable":
+        # partition counts >= 2^30 cannot pack next to the flag bit in
+        # int32: keep the explicit 4-key form
         sort_ops = (flag, spart, srows[:, 1],
                     srows[:, 0] ^ jnp.int32(_FLIP)) \
             + (srows[:, 0],) \

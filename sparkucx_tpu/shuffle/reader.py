@@ -685,9 +685,10 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
         (``block_until_ready`` — the WAKEUP-event discipline of the
         reference's progress loop, ref: UcxNode.java:63-66,
         UcxListenerThread.java:44-52), posting to a queue the consumer
-        blocks on. ``poll_s`` is kept for API compatibility; nothing
-        sleeps on it anymore. Partition granularity transfers on demand
-        (arrival order has no meaning there): index order."""
+        blocks on. ``poll_s`` only matters on the degenerate backend
+        shape that exposes ``is_ready`` but no blocking wait — there the
+        waiter polls at this interval. Partition granularity transfers
+        on demand (arrival order has no meaning there): index order."""
         if self._rows_dev is None or self.fetch_granularity == "partition":
             yield from self.partitions()
             return
@@ -729,7 +730,7 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
                     pause = threading.Event()
                     try:
                         while not d.is_ready():
-                            pause.wait(0.002)
+                            pause.wait(poll_s)
                     except Exception:
                         pass    # surface errors on the fetch itself
                 except Exception:
