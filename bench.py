@@ -1074,14 +1074,22 @@ def main() -> None:
     common = dict(val_words=args.val_words, sort_impl=args.sort_impl,
                   partitions_per_dev=8, read_mode=args.read_mode,
                   force_impl=args.a2a_impl, sort_strips=strips)
+    # The pallas step costs ~427 s of XLA:TPU compile at the n=1 full
+    # shape LOCALLY (r5 probe; more over the tunnel), and each read mode
+    # is its own program — budgets must cover a first, uncached compile
+    # or the monitor's os._exit lands mid-compile (the tunnel-wedging
+    # kill, NOTES_r5.md).
+    pallas_sel = args.a2a_impl == "pallas"
+    b_small, b_full, b_ord = (900, 2000, 1600) if pallas_sel \
+        else (600, 1200, 900)
     # k1=64/k2=1024: the r4 auto capture went degenerate at 32/288 —
     # with the landed sort levers the small-shape step is ~0.01-0.26 ms,
     # so the window must be ~1000 steps to clear tunneled-dispatch
     # jitter (~5 ms) at the fast end while staying <0.5 s per call
-    stage_exchange(mon, jax, "exchange_small", 600, native_ok,
+    stage_exchange(mon, jax, "exchange_small", b_small, native_ok,
                    rows_log2=12, k1=64, k2=1024, reps=2, **common)
     if not args.smoke:
-        stage_exchange(mon, jax, "exchange_full", 1200, native_ok,
+        stage_exchange(mon, jax, "exchange_full", b_full, native_ok,
                        rows_log2=args.rows_log2 or 21, k1=2, k2=12,
                        reps=args.reps, **common)
         if args.read_mode != "combine":
@@ -1108,7 +1116,7 @@ def main() -> None:
             # secondary metric (detail only): ordered (key-sorted
             # partitions) rate — the TeraSort mode the BASELINE.md
             # methodology is named after
-            stage_exchange(mon, jax, "exchange_ordered", 900, native_ok,
+            stage_exchange(mon, jax, "exchange_ordered", b_ord, native_ok,
                            rows_log2=args.rows_log2 or 21, k1=2, k2=10,
                            reps=2, record=False,
                            **{**common, "read_mode": "ordered"})
