@@ -1080,8 +1080,12 @@ def main() -> None:
     # or the monitor's os._exit lands mid-compile (the tunnel-wedging
     # kill, NOTES_r5.md).
     pallas_sel = args.a2a_impl == "pallas"
+    # default ordered budget 1200 (was 900): its multisort program costs
+    # ~150-320 s of compile locally, ~3x over the tunnel on a cold cache
+    # — the driver's end-of-round run must never fire the monitor
+    # mid-compile (NOTES_r5.md)
     b_small, b_full, b_ord = (900, 2000, 1600) if pallas_sel \
-        else (600, 1200, 900)
+        else (600, 1200, 1200)
     # k1=64/k2=1024: the r4 auto capture went degenerate at 32/288 —
     # with the landed sort levers the small-shape step is ~0.01-0.26 ms,
     # so the window must be ~1000 steps to clear tunneled-dispatch
