@@ -217,6 +217,7 @@ def _best_recorded_tpu_run(rundir=None):
     ``rundir`` is injectable for tests."""
     best_full = None    # headline: exchange_full ok at >=2M rows (1<<21)
     best_any = None     # any recorded on-chip value (small shapes too)
+    best_fetch = None   # fetch-latency record (device-tier preferred)
     if rundir is None:
         rundir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                               "bench_runs")
@@ -269,6 +270,32 @@ def _best_recorded_tpu_run(rundir=None):
                          "unit": rec.get("unit", "GB/s"),
                          "vs_baseline": round(full_val / BASELINE_GBPS, 3),
                          "artifact": f"bench_runs/{name}"}
+        # second BASELINE metric (fetch p50/p99), tracked INDEPENDENTLY
+        # of the bandwidth winner so a faster exchange-only artifact
+        # cannot drop it, and carrying its own artifact + shape
+        # qualifier so a smaller-shape e2e latency never masquerades as
+        # the contract-shape number (VERDICT item 5). The tunnel-proof
+        # device-side stage is preferred over wall-clock e2e spans.
+        for stage, keys in (("fetch_device", ("fetch_p50_device_ms",
+                                              "fetch_p99_device_ms",
+                                              "d2h_link_GBps")),
+                            ("e2e", ("fetch_p50_ms", "fetch_p99_ms"))):
+            srec = stages.get(stage, {})
+            got = {k: srec[k] for k in keys
+                   if isinstance(srec.get(k), (int, float))}
+            if not got:
+                continue
+            got["artifact"] = f"bench_runs/{name}"
+            got["stage"] = stage
+            if isinstance(srec.get("rows_per_chip"), int):
+                got["rows_per_chip"] = srec["rows_per_chip"]
+            # device-tier beats e2e-tier; within a tier the NEWEST
+            # artifact wins (names sort chronologically by round)
+            is_dev = stage == "fetch_device"
+            was_dev = (best_fetch or {}).get("stage") == "fetch_device"
+            if best_fetch is None or is_dev or not was_dev:
+                best_fetch = got
+            break
         if val <= 0:
             continue
         entry = {"value": val, "unit": rec.get("unit", "GB/s"),
@@ -282,9 +309,13 @@ def _best_recorded_tpu_run(rundir=None):
     # (it may be a small-shape rate OR a disqualified full-shape one —
     # the artifact it names carries the specifics)
     if best_full is None:
+        if best_any and best_fetch:
+            best_any = dict(best_any, fetch_latency=best_fetch)
         return best_any
     if best_any and best_any["value"] > best_full["value"]:
         best_full = dict(best_full, best_any_shape=best_any)
+    if best_fetch:
+        best_full = dict(best_full, fetch_latency=best_fetch)
     return best_full
 
 
