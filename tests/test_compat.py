@@ -150,3 +150,27 @@ def test_v2_attempts_first_commit_wins(base_conf):
             seen, np.sort(np.concatenate(
                 [np.arange(10, 20), np.arange(5)])))
         svc.unregister(14)
+
+
+def test_v2_failed_lease_does_not_advance_watermark(base_conf):
+    """A rejected writer lease (committed map / bad map id) must not
+    advance the attempt watermark — later errors would otherwise name an
+    attempt that never obtained a writer (r5 review finding)."""
+    conf = dict(base_conf, **{"spark.shuffle.tpu.compat.version": "v2"})
+    with sparkucx_tpu.connect(conf, use_env=False) as svc:
+        h = svc.register(ShuffleDependency(15, 1, 4))
+        w = svc.writer(h, 0, attempt_id=1)
+        w.write(np.arange(8, dtype=np.int64))
+        w.commit()
+        # attempt 7's lease is REJECTED (first-commit-wins)...
+        with pytest.raises(RuntimeError, match="first commit"):
+            svc.writer(h, 0, attempt_id=7)
+        # ...so attempt 2 must still fail on the COMMIT rule, not be
+        # called stale against the never-leased attempt 7
+        with pytest.raises(RuntimeError, match="first commit"):
+            svc.writer(h, 0, attempt_id=2)
+        # a genuinely stale attempt still reports against the real
+        # watermark (1), proving it was not polluted
+        with pytest.raises(RuntimeError, match="attempt 1 already ran"):
+            svc.writer(h, 0, attempt_id=0)
+        svc.unregister(15)
