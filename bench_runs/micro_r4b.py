@@ -47,7 +47,22 @@ def main():
                          "risk wedging the tunnel before the official "
                          "capture lands)")
     args = ap.parse_args()
-    threading.Timer(args.watchdog, lambda: os._exit(3)).start()
+    # COOPERATIVE deadline, hard kill as a bounded backstop: each
+    # full-shape config costs ~110 s of XLA:TPU compile per scan length
+    # (r5 probe — scan-wrapped sorts, stack or no stack), so a hard
+    # os._exit exactly at --watchdog could land MID-COMPILE of the last
+    # config and wedge the tunnel (NOTES_r5). The sweep stops STARTING
+    # configs at 60% of the budget (clean exit with partial results,
+    # most-informative-first); the hard kill fires at --watchdog + 600 s
+    # — enough for the last config's tunneled compile to drain, while
+    # keeping worst-case chip occupancy bounded for the runner's
+    # deadline gates (a hang past that means the tunnel is already
+    # gone, and the exit cannot make it worse).
+    t_start = time.time()
+    soft_deadline = t_start + args.watchdog * 0.6
+    wd = threading.Timer(args.watchdog + 600, lambda: os._exit(3))
+    wd.daemon = True
+    wd.start()
 
     import jax
     import jax.numpy as jnp
@@ -72,7 +87,7 @@ def main():
                     c = lax.optimization_barrier(c)
                     return step(*c), ()
                 c, _ = lax.scan(body, arrs, None, length=k)
-                return c[0].reshape(-1)[0:1]
+                return jax.tree_util.tree_leaves(c)[0].reshape(-1)[0:1]
             return jax.jit(many)
 
         def timed(k):
@@ -95,31 +110,48 @@ def main():
         emit(name, ms=round(ms, 3), GBps=round(nbytes / ms / 1e6, 2),
              degenerate=degenerate, **kw)
 
-    # step(rows [S, M, W], key [S, M]) -> (rows', key'): batched
+    # step(cols = W x [S, M], key [S, M]) -> (cols', key'): batched
     # multisort carrying all W columns, key re-scrambled afterwards so
     # scan iterations can't collapse.  S=1 is the flat baseline.
+    #
+    # Columns stay a TUPLE through the scan — no jnp.stack row
+    # reconstruction: the r5 AOT bisection measured the stack epilogue
+    # at ~100-150 s of XLA:TPU compile PER PROGRAM (r5_wedge_aot.jsonl;
+    # this ladder's original stacked step probed at 84-113 s/config),
+    # and 7 configs x 2 scan lengths of that against the runner's
+    # 1200 s watchdog is a guaranteed mid-compile kill — the exact
+    # tunnel-wedging failure NOTES_r5 root-causes. The sort itself
+    # (what this ladder measures: depth vs strip count) carries the
+    # same 11 operands either way; the production A/B (priority 2)
+    # measures the full step WITH its reconstruction.
     def make_step(S, key_dtype):
-        def step(r3, k2d):
-            ops = (k2d.astype(key_dtype),) + tuple(
-                r3[..., j] for j in range(W))
+        def step(cols, k2d):
+            ops = (k2d.astype(key_dtype),) + cols
             srt = lax.sort(ops, dimension=-1, num_keys=1, is_stable=False)
-            r_out = jnp.stack(srt[1:], axis=-1)
             k_out = (k2d ^ srt[1][:, ::-1].astype(jnp.int32)) % D
-            return r_out, k_out
+            return tuple(srt[1:]), k_out
         return step
 
-    # ALL int32 sweeps first; int8 keys LAST — the r4 official run's
-    # wedge suspects are int8 sort operands (ms8 stage; combine unstable
-    # compaction), so the suspects must not cost the i32 sweep its window
-    sweeps = [(S, jnp.int32, "i32") for S in (1, 8, 16, 32, 64, 128, 256)]
+    # Most-informative configs FIRST so a cooperative-deadline exit
+    # still answers the depth question: flat baseline, then the
+    # log2-spread (64, 256, 16), then the fill-in points. int8 keys
+    # LAST (r4's quarantine — exonerated by the r5 bisection, kept last
+    # out of caution).
+    sweeps = [(S, jnp.int32, "i32") for S in (1, 64, 256, 16, 32, 128, 8)]
     if not args.no_i8:
         sweeps += [(S, jnp.int8, "i8") for S in (1, 64)]
     for S, key_dtype, label in sweeps:
+        if time.time() > soft_deadline:
+            emit("deadline", skipped_from=f"S={S}/{label}",
+                 elapsed_s=round(time.time() - t_start, 1))
+            break
         M = rows // S
-        r3 = jax.device_put(jnp.asarray(payload_np.reshape(S, M, W)))
+        r3 = payload_np.reshape(S, M, W)
+        cols = tuple(jax.device_put(jnp.asarray(r3[..., j]))
+                     for j in range(W))
         k2d = jax.device_put(jnp.asarray(key_np.reshape(S, M)))
         try:
-            ms, deg = diff_time(make_step(S, key_dtype), r3, k2d)
+            ms, deg = diff_time(make_step(S, key_dtype), cols, k2d)
             report("strip_sort", ms, deg, S=S, key=label)
         except Exception as e:
             emit("strip_sort", S=S, key=label, error=str(e)[:200])
