@@ -699,7 +699,8 @@ def stage_fetch_device(mon, jax, rows_log2, val_words):
 
 def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
                  partitions_per_dev, sort_impl, impl, read_mode="plain",
-                 key_space=None, sort_strips=1):
+                 key_space=None, sort_strips=1,
+                 combine_compaction="stable"):
     import dataclasses
 
     import jax.numpy as jnp
@@ -728,7 +729,8 @@ def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
     elif read_mode == "combine":
         plan = dataclasses.replace(plan, combine="sum",
                                    combine_words=val_words,
-                                   combine_dtype="<i4")
+                                   combine_dtype="<i4",
+                                   combine_compaction=combine_compaction)
     step = step_body(plan, "shuffle")
 
     def make(k):
@@ -801,6 +803,8 @@ def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
         "impl": impl,
         "read_mode": read_mode,
         "sort_strips": sort_strips,
+        **({"combine_compaction": combine_compaction}
+           if read_mode == "combine" else {}),
         "step_ms": round(per_step * 1e3, 3),
         "t_small_ms": round(t_small * 1e3, 3),
         "t_large_ms": round(t_large * 1e3, 3),
@@ -1023,6 +1027,12 @@ def main() -> None:
                     help="exchange flavor for the main stages (combine = "
                          "device combine-by-key, ordered = key-sorted "
                          "partitions)")
+    ap.add_argument("--combine-compaction", default="stable",
+                    choices=("stable", "unstable"),
+                    help="combine end-row compaction formulation to A/B "
+                         "(unstable = explicit-key sort, 3-key fused "
+                         "form since r5; stable = 1-key stable sort — "
+                         "the conf default)")
     ap.add_argument("--platform", default="auto",
                     choices=("auto", "tpu", "cpu"),
                     help="cpu forces the CPU backend via jax.config before "
@@ -1104,7 +1114,8 @@ def main() -> None:
     strips = resolve_sort_strips(args.sort_strips, len(devs))
     common = dict(val_words=args.val_words, sort_impl=args.sort_impl,
                   partitions_per_dev=8, read_mode=args.read_mode,
-                  force_impl=args.a2a_impl, sort_strips=strips)
+                  force_impl=args.a2a_impl, sort_strips=strips,
+                  combine_compaction=args.combine_compaction)
     # The pallas step costs ~427 s of XLA:TPU compile at the n=1 full
     # shape LOCALLY (r5 probe; more over the tunnel), and each read mode
     # is its own program — budgets must cover a first, uncached compile

@@ -1,0 +1,61 @@
+#!/bin/bash
+# Round-5 TAIL runner — the weak-#6 datapoints (VERDICT r4): after the
+# main window queue (run_r5_window.sh) drains, if the tunnel is healthy
+# and there is still comfortable room before the drain guard, capture:
+#   1. combine-UNSTABLE compaction A/B (the r4 wedge suspect, exonerated
+#      offline by the r5 compile bisection — 3-key fused form)
+#   2. full-shape multisort8 (the r3 small-shape 14.8 GB/s lever, never
+#      measured at the contract shape)
+# Compiles for these are ~150-380 s/program locally; budgets in bench.py
+# already cover them and the persistent cache is warm after the main
+# queue. No external kill-timeouts around TPU work (NOTES_r2).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+TS=$(date +%H%M%S)
+DEADLINE=${R5_DEADLINE_EPOCH:?set R5_DEADLINE_EPOCH}
+
+left() { echo $(( DEADLINE - $(date +%s) )); }
+log() { echo "[$(date -u +%H:%M:%S)] $*"; }
+
+log "== wait for the main window queue to drain =="
+while pgrep -f "run_r5_window[.]sh" > /dev/null; do sleep 120; done
+
+# only run if the MAIN queue actually produced an official artifact —
+# these are secondary datapoints and must never displace the headline
+ls bench_runs/r5_tpu_*_default.json bench_runs/r5_tpu_*_strips*.json \
+    > /dev/null 2>&1 || { log "no official artifact; tail stands down"; exit 0; }
+
+if [ "$(left)" -lt 2400 ]; then
+    log "too close to drain ($(left)s); standing down"; exit 0
+fi
+
+if ! python - <<'PYEOF'
+from bench import _tpu_probe_once
+import sys
+rec = _tpu_probe_once(240)
+print(rec, flush=True)
+sys.exit(0 if rec.get("rc") == 0 and rec.get("backend") == "tpu" else 3)
+PYEOF
+then log "unhealthy; tail stands down"; exit 3; fi
+
+run_bench() {  # label, extra args...
+    local label=$1; shift
+    local out="bench_runs/r5_tpu_${TS}_${label}.json"
+    if python bench.py --no-fallback --init-retry-s 60 "$@" \
+            | tail -1 | tee "$out"; then
+        log "saved $out"
+    else
+        mv "$out" "$out.FAILED" 2>/dev/null
+        log "bench ($label) FAILED — artifact renamed"
+    fi
+}
+
+log "== combine-unstable A/B (smoke-scoped: combine stage only) =="
+run_bench combine_unstable --read-mode combine --combine-compaction unstable
+
+if [ "$(left)" -gt 2400 ]; then
+    log "== full-shape multisort8 =="
+    run_bench ms8full --sort-impl multisort8
+fi
+
+log "== tail runner done =="

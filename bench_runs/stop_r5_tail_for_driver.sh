@@ -1,0 +1,16 @@
+#!/bin/bash
+# Second drain guard (the first, stop_r5_for_driver.sh, was already
+# running when run_r5_tail.sh was added — a running bash script must
+# never be edited, NOTES memory): SIGTERM the TAIL runner shell at the
+# given epoch; never its in-flight python children (they self-watchdog).
+set -u
+STOP_AT_EPOCH=${1:?usage: stop_r5_tail_for_driver.sh <epoch-seconds>}
+now=$(date +%s)
+wait_s=$((STOP_AT_EPOCH - now))
+[ "$wait_s" -gt 0 ] && sleep "$wait_s"
+pids=$(pgrep -f "bash .*run_r5_tail[.]sh" || true)
+if [ -n "$pids" ]; then
+    echo "terminating run_r5_tail.sh shell(s): $pids"
+    kill $pids 2>/dev/null || true
+fi
+echo "tail drain guard done at $(date -u)"
