@@ -23,7 +23,20 @@ pointing into /root/reference.
 
 __version__ = "0.2.0"
 
-from sparkucx_tpu.config import TpuShuffleConf
+
+import sys as _sys
+
+if "jax" in _sys.modules:
+    # jax is already loaded (tests, bench, any device-plane caller):
+    # install the cross-generation shim now so `jax.shard_map` works
+    # even for code that calls it directly after importing this package.
+    # When jax is NOT loaded yet, importing it here would violate the
+    # lazy-import contract below (config-only tooling must not pay
+    # backend init) — the device-plane modules import
+    # utils/jaxcompat themselves before first use instead.
+    from sparkucx_tpu.utils import jaxcompat as _jaxcompat  # noqa: F401
+
+from sparkucx_tpu.config import TpuShuffleConf  # noqa: E402
 
 
 def connect(conf=None, **kw):

@@ -88,8 +88,10 @@ class TpuShuffleConf:
         "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
         "spill_threshold", "spill_dir", "a2a_impl", "sort_impl",
         "sort_strips", "combine_compaction", "fetch_granularity",
-        "capacity_factor", "max_bytes_in_flight", "mesh_ici_axis",
-        "mesh_dcn_axis", "num_slices", "num_processes",
+        "capacity_factor", "cap_buckets", "cap_bucket_growth",
+        "max_bytes_in_flight", "compile_cache_enabled",
+        "compile_cache_dir", "compile_min_compile_time_secs",
+        "mesh_ici_axis", "mesh_dcn_axis", "num_slices", "num_processes",
         "cores_per_process", "connection_timeout_ms")
     # Namespace keys consumed OUTSIDE config.py (grep-verified), plus the
     # prefix families. A spark.shuffle.tpu.* key matching none of these is
@@ -418,6 +420,72 @@ class TpuShuffleConf:
 
         The static-shape answer to ragged skew (SURVEY.md §7 hard part (a))."""
         return float(self._get("a2a.capacityFactor", 2.0))
+
+    @property
+    def cap_buckets(self) -> bool:
+        """Plan-shape bucketing: quantize plan capacities UP onto a
+        geometric ladder (shuffle/plan.bucket_cap) so drifting row counts
+        across epochs land on a handful of compiled exchange programs
+        instead of one per exact shape. Rounding is up-only — overflow
+        semantics and results are unchanged (modulo trailing padding)."""
+        return self.get_bool("a2a.capBuckets", True)
+
+    @property
+    def cap_bucket_growth(self) -> float:
+        """Geometric growth factor of the capacity-bucket ladder
+        (``a2a.capBuckets``): consecutive rungs differ by ~this ratio, so
+        worst-case over-provisioning per buffer is bounded by it.
+        Validated at construction like every typed key — a malformed
+        value fails fast even while bucketing is off."""
+        raw = float(self._get("a2a.capBucketGrowth", 1.25))
+        from sparkucx_tpu.shuffle.plan import CAP_BUCKET_GROWTH_RANGE
+        if not CAP_BUCKET_GROWTH_RANGE[0] <= raw \
+                <= CAP_BUCKET_GROWTH_RANGE[1]:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.capBucketGrowth={raw}: want "
+                f"{CAP_BUCKET_GROWTH_RANGE[0]}..{CAP_BUCKET_GROWTH_RANGE[1]}")
+        return raw
+
+    @property
+    def compile_cache_enabled(self) -> bool:
+        """Persistent XLA compile cache (jax_compilation_cache_dir): on
+        by default so a fresh process's first exchange reuses programs
+        compiled by ANY earlier process instead of re-paying minutes of
+        XLA compile (runtime/compile_cache.py, wired in TpuNode init /
+        service.connect)."""
+        return self.get_bool("compile.cacheEnabled", True)
+
+    @property
+    def compile_cache_dir(self) -> str:
+        """Directory of the persistent compile cache. The default is a
+        PER-USER path with no pid component — cross-process reuse is the
+        point, but a fixed world-shared /tmp path would let one local
+        user feed serialized executables to another (and breaks for the
+        second user anyway: the dir belongs to the first). Point it at
+        durable storage for cross-reboot reuse, or a shared mount to
+        share across hosts you trust."""
+        home = os.path.expanduser("~")
+        if home and home != "/" and os.path.isdir(home):
+            default = os.path.join(home, ".cache", "sparkucx_tpu", "xla")
+        else:
+            import tempfile
+            uid = getattr(os, "getuid", lambda: "u")()
+            default = os.path.join(
+                tempfile.gettempdir(), f"sparkucx_tpu_compile_cache_{uid}")
+        return self._get("compile.cacheDir", default)
+
+    @property
+    def compile_min_compile_time_secs(self) -> float:
+        """Only compiles at least this long are persisted
+        (jax_persistent_cache_min_compile_time_secs): keeps trivial
+        programs from churning the cache dir while the multi-minute
+        exchange steps always qualify."""
+        v = float(self._get("compile.minCompileTimeSecs", 1.0))
+        if v < 0:
+            raise ValueError(
+                f"spark.shuffle.tpu.compile.minCompileTimeSecs={v}: "
+                f"want >= 0")
+        return v
 
     @property
     def max_bytes_in_flight(self) -> int:

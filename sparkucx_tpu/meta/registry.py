@@ -60,6 +60,18 @@ class ShuffleEntry:
                 f"{self.num_partitions}")
         rec = pack_record(map_id, np.asarray(sizes, dtype=np.uint64))
         with self._cv:
+            if self._present[map_id]:
+                # First-commit-wins at the metadata plane too: a second
+                # publish (late speculative attempt, double commit) would
+                # overwrite the size row readers already trust — reads
+                # between the two publishes would disagree with reads
+                # after. The manager's committed-writer rule makes this
+                # unreachable through the normal path; this guard covers
+                # direct registry users and future facades.
+                raise RuntimeError(
+                    f"shuffle {self.shuffle_id}: map {map_id} already "
+                    f"published; its size row is immutable (first commit "
+                    f"wins)")
             self.table[map_id * self.slot:(map_id + 1) * self.slot] = rec
             self._present[map_id] = True
             self._cv.notify_all()

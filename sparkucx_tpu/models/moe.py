@@ -28,6 +28,8 @@ from dataclasses import dataclass
 from typing import Dict, Tuple
 
 import jax
+
+from sparkucx_tpu.utils import jaxcompat as _jaxcompat  # noqa: F401  (jax.shard_map shim)
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P

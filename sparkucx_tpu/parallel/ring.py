@@ -24,6 +24,8 @@ import functools
 from typing import Optional
 
 import jax
+
+from sparkucx_tpu.utils import jaxcompat as _jaxcompat  # noqa: F401  (jax.shard_map shim)
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

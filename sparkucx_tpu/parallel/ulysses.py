@@ -23,6 +23,8 @@ import functools
 from typing import Optional
 
 import jax
+
+from sparkucx_tpu.utils import jaxcompat as _jaxcompat  # noqa: F401  (jax.shard_map shim)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from sparkucx_tpu.ops.pallas.flash_attention import flash_attention

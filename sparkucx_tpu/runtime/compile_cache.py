@@ -1,0 +1,89 @@
+"""Persistent XLA compile cache — the production conf seam.
+
+The round-5 verdict measured the production exchange step at minutes of
+XLA compile per fresh process (combine ~370 s, pallas ~427 s on TPU);
+until this module, the persistent compilation cache existed only as a
+private block inside bench.py, so ``service.connect()`` + ``warmup()``
+re-paid that cost on every deployment restart. Here it is a conf-keyed
+subsystem wired into :class:`~sparkucx_tpu.runtime.node.TpuNode` init
+(and therefore every ``connect()``), with bench.py delegating to the
+SAME path:
+
+    spark.shuffle.tpu.compile.cacheEnabled        master switch (default on)
+    spark.shuffle.tpu.compile.cacheDir            shared per-host dir
+    spark.shuffle.tpu.compile.minCompileTimeSecs  persistence threshold
+
+The cache is cross-process by construction (jax keys entries by program
+fingerprint; the dir default carries no pid), so the second process's
+first exchange deserializes the first process's programs instead of
+recompiling — the "kill the cold start" half that survives process
+death. The in-process half (shuffle/stepcache.py) sits above it: a step
+signature that misses there still hits here if ANY process compiled it.
+
+Best-effort throughout: a backend that cannot serialize programs, an
+unwritable dir, or an older jax just logs and runs uncached — cache
+plumbing must never fail a shuffle.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("runtime.compile_cache")
+
+_lock = threading.Lock()
+_configured_dir: Optional[str] = None
+
+
+def configure_compile_cache(conf) -> Optional[str]:
+    """Apply the conf's persistent-compile-cache keys to this process's
+    jax config. Returns the active cache dir, or None when disabled or
+    unavailable. Idempotent; a later call with a DIFFERENT dir rebinds
+    (and logs) — the last writer wins, matching jax.config semantics.
+
+    Precedence: an explicit ``compile.cacheDir`` conf entry, then the
+    standard ``JAX_COMPILATION_CACHE_DIR`` env var, then the per-user
+    default. The env var is resolved HERE (not only at one entry point)
+    so a later TpuNode.start with a default conf cannot silently rebind
+    the cache away from the directory the operator exported."""
+    global _configured_dir
+    if not conf.compile_cache_enabled:
+        log.debug("persistent compile cache disabled by conf")
+        return None
+    explicit = conf.get("spark.shuffle.tpu.compile.cacheDir")
+    cache_dir = explicit \
+        or os.environ.get("JAX_COMPILATION_CACHE_DIR") \
+        or conf.compile_cache_dir
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+        with _lock:
+            if _configured_dir is not None and _configured_dir != cache_dir:
+                log.warning("rebinding compile cache dir %s -> %s",
+                            _configured_dir, cache_dir)
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              conf.compile_min_compile_time_secs)
+            _configured_dir = cache_dir
+        log.info("persistent compile cache at %s (minCompileTimeSecs=%s)",
+                 cache_dir, conf.compile_min_compile_time_secs)
+        return cache_dir
+    except Exception as e:
+        # never let cache plumbing cost a shuffle (or a bench window)
+        log.warning("persistent compile cache unavailable (%s); "
+                    "compiles will not persist", e)
+        return None
+
+
+def cache_entry_count(cache_dir: str) -> int:
+    """Number of persisted program entries in ``cache_dir`` (jax writes
+    one ``*-cache`` file per program). 0 for a missing dir — the
+    cold-start probe's before/after evidence."""
+    try:
+        return sum(1 for n in os.listdir(cache_dir) if n.endswith("-cache"))
+    except OSError:
+        return 0

@@ -60,6 +60,12 @@ class TpuNode:
         self.process_id = process_id
         self._distributed = distributed
         self.is_distributed = distributed and conf.num_processes > 1
+        # Persistent compile cache FIRST — before any code path can
+        # trigger a compile — so service.connect()/warmup() amortize XLA
+        # compile across processes instead of re-paying minutes per
+        # restart (runtime/compile_cache.py; conf compile.*).
+        from sparkucx_tpu.runtime.compile_cache import configure_compile_cache
+        self.compile_cache_dir = configure_compile_cache(conf)
         if self.is_distributed:
             # Multi-host: rendezvous at the coordinator like executors
             # dialing the driver sockaddr (UcxNode.java:130-134).

@@ -29,9 +29,9 @@ instead of shipping a size manifest (ref: OnOffsetsFetchCallback.java:44-52).
 
 from __future__ import annotations
 
-import functools
-
 import jax
+
+from sparkucx_tpu.utils import jaxcompat as _jaxcompat  # noqa: F401  (jax.shard_map shim)
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
@@ -46,12 +46,24 @@ from sparkucx_tpu.utils.logging import get_logger
 log = get_logger("shuffle.hierarchical")
 
 
-@functools.lru_cache(maxsize=64)
 def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
                      plan: ShufflePlan, width: int):
-    """Compile the two-stage exchange for one (mesh, plan, width).
+    """The two-stage exchange for one (mesh, plan, width), served from
+    the shared keyed step cache (shuffle/stepcache.py — one compiled
+    program per plan signature, observable, shared with the flat builder
+    and manager.warmup)."""
+    from sparkucx_tpu.shuffle.stepcache import GLOBAL_STEP_CACHE
+    return GLOBAL_STEP_CACHE.get(
+        ("hier", mesh, dcn_axis, ici_axis, plan, width),
+        lambda: _build_hier_step_uncached(mesh, dcn_axis, ici_axis, plan,
+                                          width),
+        {"kind": "hier", "cap_in": plan.cap_in, "cap_out": plan.cap_out,
+         "width": width, "impl": plan.impl})
 
-    Mesh must be 2-D ``(dcn=S, ici=D)``; global shard id g = s*D + d
+
+def _build_hier_step_uncached(mesh: Mesh, dcn_axis: str, ici_axis: str,
+                              plan: ShufflePlan, width: int):
+    """Mesh must be 2-D ``(dcn=S, ici=D)``; global shard id g = s*D + d
     matches ``mesh.devices.reshape(-1)`` order, so the flat
     ``blocked_partition_map`` routing is identical to the flat reader's."""
     if mesh.axis_names != (dcn_axis, ici_axis):

@@ -822,12 +822,19 @@ class TpuShuffleManager:
         cap over the balanced share — so one huge skewed shuffle doesn't
         permanently inflate every later small shuffle of the same shape."""
         import dataclasses
+
+        from sparkucx_tpu.shuffle.plan import bucket_cap_conf
         with self._lock:
             factor = self._cap_hints.get(self._cap_key(handle))
         if not factor:
             return plan
         balanced = max(1.0, total_rows / max(plan.num_shards, 1))
-        hint = int(np.ceil(balanced * factor / 8.0)) * 8
+        # the hint-derived capacity is quantized by the SAME bucket
+        # ladder as make_plan's, or learned hints would mint one fresh
+        # compiled-step signature per observed skew factor — exactly the
+        # shape churn a2a.capBuckets exists to collapse
+        hint = bucket_cap_conf(
+            int(np.ceil(balanced * factor / 8.0)) * 8, self.conf)
         if hint > plan.cap_out:
             log.debug("seeding cap_out=%d from learned skew factor %.2f "
                       "(plan computed %d)", hint, factor, plan.cap_out)
@@ -1184,6 +1191,15 @@ class TpuShuffleManager:
                 self.node.pool.put(stage_buf)
                 release_admitted()
             raise
+
+    def has_live_writer(self, shuffle_id: int, map_id: int) -> bool:
+        """True when (shuffle_id, map_id) currently holds an UNCOMMITTED
+        writer — the live-lease query facades use to reject an equal-id
+        re-lease (compat/v2.writer) without reaching into this manager's
+        writer table themselves."""
+        with self._lock:
+            w = self._writers.get(shuffle_id, {}).get(map_id)
+        return w is not None and not w.committed
 
     # -- checkpoint support ----------------------------------------------
     def live_shuffles(self):

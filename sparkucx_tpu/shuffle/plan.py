@@ -116,6 +116,69 @@ _MEASURED_STRIPS: dict = {}
 # and bench's parse-time check so the two cannot drift.
 STRIPS_RANGE = (1, 4096)
 
+# Valid a2a.capBucketGrowth bounds (the STRIPS_RANGE discipline: one
+# constant shared by conf validation and the quantizer). Growth close to
+# 1.0 degenerates into one bucket per shape (no amortization); growth
+# past 4x over-provisions HBM beyond what any skew hint would.
+CAP_BUCKET_GROWTH_RANGE = (1.05, 4.0)
+
+# Hard ceiling on any bucketed capacity: row counts must stay addressable
+# by the int32 arithmetic the compiled step runs (the same bound
+# meta/segments.validate_row_sizes enforces on staged totals).
+CAP_BUCKET_CEILING = (1 << 31) - 8
+
+
+def bucket_cap(cap: int, growth: float) -> int:
+    """Round ``cap`` UP to the next rung of the geometric capacity ladder
+    ``rung(k) = round_up8(8 * growth**k)`` — the plan-shape quantizer
+    behind ``a2a.capBuckets``.
+
+    XLA compiles one program per (cap_in, cap_out, width) shape, so
+    row-count drift across epochs otherwise compiles a fresh program per
+    exact shape; quantizing capacities onto a small geometric ladder
+    lands drifting shapes on a handful of compiled programs. Rounding is
+    UP only (never down), so overflow semantics are unchanged — a
+    bucketed plan can only overflow less than the exact one. Rungs stay
+    multiples of 8 (the TPU tiling rule _round_up keeps), floored at 8
+    and clamped to CAP_BUCKET_CEILING."""
+    import math
+    if not CAP_BUCKET_GROWTH_RANGE[0] <= growth <= CAP_BUCKET_GROWTH_RANGE[1]:
+        raise ValueError(
+            f"cap bucket growth {growth} out of "
+            f"{CAP_BUCKET_GROWTH_RANGE[0]}..{CAP_BUCKET_GROWTH_RANGE[1]}")
+    cap = _round_up(int(cap))
+    if cap <= 8:
+        return 8
+    if cap >= CAP_BUCKET_CEILING:
+        return CAP_BUCKET_CEILING
+    # smallest ladder rung >= cap. The float log only seeds the search;
+    # the loops below settle it exactly — round-to-8 can make SEVERAL
+    # consecutive k collapse onto one rung (a lower k may already cover
+    # cap), and the smallest-rung answer is what makes the quantizer
+    # idempotent (a rung maps to itself, so re-quantizing on the
+    # cap-hint path is stable).
+    def rung(k: int) -> int:
+        return _round_up(int(math.ceil(8.0 * growth ** k)))
+
+    k = max(0, math.ceil(math.log(cap / 8.0) / math.log(growth) - 1e-9))
+    while k > 0 and rung(k - 1) >= cap:
+        k -= 1
+    r = rung(k)
+    while r < cap:
+        k += 1
+        r = rung(k)
+    return min(r, CAP_BUCKET_CEILING)
+
+
+def bucket_cap_conf(cap: int, conf: "TpuShuffleConf") -> int:
+    """Conf-gated quantizer: ``a2a.capBuckets`` off returns ``cap``
+    unchanged. ONE seam shared by make_plan and the manager's cap-hint
+    path so every capacity that reaches a compiled-step signature is
+    quantized by the same rule."""
+    if not conf.cap_buckets:
+        return int(cap)
+    return bucket_cap(cap, conf.cap_bucket_growth)
+
 
 def default_sort_strips(backend: str, num_shards: int) -> int:
     """Resolve ``a2a.sortStrips=auto``: the measured-best strip count for
@@ -154,9 +217,11 @@ def make_plan(
     provisioning worst-case HBM everywhere."""
     conf = conf or TpuShuffleConf()
     total = int(np.sum(shard_rows))
-    cap_in = _round_up(int(np.max(shard_rows, initial=0)))
+    cap_in = bucket_cap_conf(
+        _round_up(int(np.max(shard_rows, initial=0))), conf)
     balanced = total / max(num_shards, 1)
-    cap_out = _round_up(int(np.ceil(balanced * conf.capacity_factor)))
+    cap_out = bucket_cap_conf(
+        _round_up(int(np.ceil(balanced * conf.capacity_factor))), conf)
     if partitioner not in ("hash", "direct", "range"):
         raise ValueError(f"unknown partitioner {partitioner!r}")
     if (partitioner == "range") != (bounds is not None):

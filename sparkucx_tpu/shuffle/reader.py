@@ -36,6 +36,8 @@ import threading
 from typing import Optional, Tuple
 
 import jax
+
+from sparkucx_tpu.utils import jaxcompat as _jaxcompat  # noqa: F401  (jax.shard_map shim)
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
@@ -315,13 +317,23 @@ def _pallas_step_body(plan: ShufflePlan, axis: str):
     return step
 
 
-@functools.lru_cache(maxsize=64)
 def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
-    """Compile the exchange step for one (mesh, plan, row width).
-
-    lru_cache keys on the hashable plan — the jit-cache discipline that
-    keeps one compiled program per shape family. The pipeline itself is
+    """The exchange step for one (mesh, plan, row width), served from the
+    shared keyed step cache (shuffle/stepcache.py) — the jit-cache
+    discipline that keeps one compiled program per shape family, now
+    observable (compile.step.* counters) and shared with the hierarchical
+    builder and manager.warmup. The pipeline itself is
     :func:`step_body`."""
+    from sparkucx_tpu.shuffle.stepcache import GLOBAL_STEP_CACHE
+    return GLOBAL_STEP_CACHE.get(
+        ("flat", mesh, axis, plan, width),
+        lambda: _build_step_uncached(mesh, axis, plan, width),
+        {"kind": "flat", "cap_in": plan.cap_in, "cap_out": plan.cap_out,
+         "width": width, "impl": plan.impl})
+
+
+def _build_step_uncached(mesh: Mesh, axis: str, plan: ShufflePlan,
+                         width: int):
     step = step_body(plan, axis)
     seg_spec = P(axis) if (plan.combine or plan.ordered) else P()
 
@@ -634,18 +646,26 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
         self.recv_rows_needed: Optional[int] = None
         self.fetch_granularity: str = "shard"
         self._part_cache: dict = {}        # r -> np [n, width] block
+        # ONE result may be shared by concurrent readers (compat/v2
+        # caches it per shuffle): the lazy fetch paths flip _seg_dev /
+        # _rows_dev to None after materializing, and an unsynchronized
+        # second thread between the None-check and the dereference would
+        # crash. RLock: _partition_block -> _shard_rows nests.
+        self._fetch_lock = threading.RLock()
 
     def _seg_matrix(self, shard: int) -> np.ndarray:
-        if self._seg is None:
-            if self._per_shard_segs:
-                self._seg = np.asarray(self._seg_dev).reshape(
-                    self._num_shards, -1, self.num_partitions)
-            else:
-                # replicated output: any addressable copy is the whole
-                # matrix (np.asarray would reject a multi-process array)
-                self._seg = np.asarray(
-                    self._seg_dev.addressable_shards[0].data)
-            self._seg_dev = None
+        with self._fetch_lock:
+            if self._seg is None:
+                if self._per_shard_segs:
+                    self._seg = np.asarray(self._seg_dev).reshape(
+                        self._num_shards, -1, self.num_partitions)
+                else:
+                    # replicated output: any addressable copy is the
+                    # whole matrix (np.asarray would reject a
+                    # multi-process array)
+                    self._seg = np.asarray(
+                        self._seg_dev.addressable_shards[0].data)
+                self._seg_dev = None
         return super()._seg_matrix(shard)
 
     def _shard_dev(self, shard: int):
@@ -660,18 +680,19 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
         return None
 
     def _shard_rows(self, shard: int) -> np.ndarray:
-        got = self._shards.get(shard)
-        if got is None:
-            dev = self._shard_dev(shard)
-            if dev is None:
-                raise KeyError(f"shard {shard} not addressable here")
-            got = np.asarray(dev)
-            self._shards[shard] = got
-            if len(self._shards) == self._num_shards:
-                # every shard is host-side; drop the device buffers so
-                # the HBM is free for the next shuffle's exchange
-                self._rows_dev = None
-        return got
+        with self._fetch_lock:
+            got = self._shards.get(shard)
+            if got is None:
+                dev = self._shard_dev(shard)
+                if dev is None:
+                    raise KeyError(f"shard {shard} not addressable here")
+                got = np.asarray(dev)
+                self._shards[shard] = got
+                if len(self._shards) == self._num_shards:
+                    # every shard is host-side; drop the device buffers
+                    # so the HBM is free for the next shuffle's exchange
+                    self._rows_dev = None
+            return got
 
     def partitions_ready(self, poll_s: float = 0.002):
         """Arrival-order iteration: shards whose transfer already
@@ -699,12 +720,18 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
             # already-host shards are trivially ready (yield first, in
             # index order); a shard NEITHER host-cached nor
             # device-addressable must fail up front with the descriptive
-            # error, not a KeyError mid-iteration (ADVICE r4)
-            if s in self._shards:
+            # error, not a KeyError mid-iteration (ADVICE r4). The
+            # cached/device snapshot rides _fetch_lock: a concurrent
+            # reader of the SHARED result (compat/v2) may materialize
+            # the final shard — flipping _rows_dev to None — between an
+            # unlocked membership check and _shard_dev's dereference.
+            with self._fetch_lock:
+                cached = s in self._shards
+                dev = None if cached else self._shard_dev(s)
+            if cached:
                 ready_q.put(s)
                 n_pending += 1
                 continue
-            dev = self._shard_dev(s)
             if dev is None:
                 raise KeyError(f"shard {s} not addressable here")
             # non-blocking pre-pass: a transfer that already completed
@@ -749,6 +776,10 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
                 yield r, self.partition(r)
 
     def _partition_block(self, r: int, shard: int) -> np.ndarray:
+        with self._fetch_lock:
+            return self._partition_block_locked(r, shard)
+
+    def _partition_block_locked(self, r: int, shard: int) -> np.ndarray:
         if self.fetch_granularity != "partition" \
                 or shard in self._shards:
             return super()._partition_block(r, shard)

@@ -1,0 +1,135 @@
+"""Keyed compiled-step cache — ONE in-process home for exchange programs.
+
+The exchange step is compiled once per plan signature ``(mesh, axes,
+cap_in, cap_out, width, impl, combine, ordered, strips, ...)`` — the
+hashable :class:`~sparkucx_tpu.shuffle.plan.ShufflePlan` plus the mesh
+and row width. Before this module, the flat and hierarchical builders
+each kept a private ``functools.lru_cache`` with no observability: a
+warmup that missed, or a row-count drift that compiled 20 programs for
+one logical shuffle, was invisible until someone timed a read.
+
+This cache is shared by ``reader._build_step``,
+``hierarchical._build_hier_step`` and (through them)
+``manager._warm_step``, and instruments every lookup:
+
+* ``compile.step.programs``   — distinct step programs built (cache misses)
+* ``compile.step.hits``       — lookups served by an already-built program
+* ``compile.step.seconds``    — wall seconds of first invocations (XLA
+  compile + first execute; later calls are untimed passthrough)
+
+(counter names: :mod:`sparkucx_tpu.utils.metrics`), plus a
+``compile.step`` tracer span around each first invocation so compile
+cost shows up on the shuffle timeline next to plan/pack/dispatch.
+
+Cache hits return the IDENTICAL callable (tests pin this: a warmed step
+and the read that follows must share one jit call cache). Eviction is
+LRU with a bounded capacity, matching the old per-builder
+``lru_cache(maxsize=64)`` discipline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Callable
+
+from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
+                                        COMPILE_SECONDS, GLOBAL_METRICS)
+from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+
+log = get_logger("shuffle.stepcache")
+
+
+class _TimedStep:
+    """Callable proxy over a jitted step: the FIRST invocation — where
+    XLA actually compiles — is timed into ``compile.step.seconds`` and
+    wrapped in a ``compile.step`` tracer span; every later call is plain
+    passthrough. Attribute access (``_cache_size``, ``lower``, ...)
+    delegates to the underlying jit function, so callers that inspect
+    the step see the real thing."""
+
+    __slots__ = ("_fn", "_attrs", "_first", "_lock")
+
+    def __init__(self, fn: Callable, attrs: dict):
+        self._fn = fn
+        self._attrs = attrs
+        self._first = True
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        if self._first:
+            # serialize concurrent first calls: both would compile the
+            # same program anyway, and blocking the second is cheaper
+            with self._lock:
+                if self._first:
+                    t0 = time.perf_counter()
+                    with GLOBAL_TRACER.span("compile.step", **self._attrs):
+                        out = self._fn(*args, **kwargs)
+                    secs = time.perf_counter() - t0
+                    GLOBAL_METRICS.inc(COMPILE_SECONDS, secs)
+                    log.debug("step first-call (compile+run) %.2fs: %s",
+                              secs, self._attrs)
+                    self._first = False
+                    return out
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+class CompiledStepCache:
+    """LRU map ``(kind, mesh, axes..., plan, width) -> compiled step``.
+
+    ``kind`` namespaces the builder ("flat" | "hier") so the two step
+    families can never collide on a shared plan. Thread-safe; a miss
+    builds OUTSIDE the lock (tracing can be slow) and the first stored
+    entry wins, so two racing builders converge on one program."""
+
+    def __init__(self, capacity: int = 128):
+        self._capacity = capacity
+        self._entries: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key: tuple, builder: Callable[[], Callable],
+            attrs: dict) -> Callable:
+        with self._lock:
+            hit = self._entries.get(key)
+            if hit is not None:
+                self._entries.move_to_end(key)
+                GLOBAL_METRICS.inc(COMPILE_HITS)
+                return hit
+        step = _TimedStep(builder(), attrs)
+        with self._lock:
+            # first stored wins: a racing builder's duplicate is dropped
+            # so every caller shares ONE jit call cache per signature
+            won = self._entries.setdefault(key, step)
+            if won is step:
+                GLOBAL_METRICS.inc(COMPILE_PROGRAMS)
+                while len(self._entries) > self._capacity:
+                    self._entries.popitem(last=False)
+            else:
+                GLOBAL_METRICS.inc(COMPILE_HITS)
+        return won
+
+    def stats(self) -> dict:
+        """{entries, capacity, programs, hits, compile_seconds} — entries
+        is this cache's live size; the counters are process-global
+        (GLOBAL_METRICS), matching how the cache itself is shared."""
+        with self._lock:
+            entries = len(self._entries)
+        return {
+            "entries": entries,
+            "capacity": self._capacity,
+            "programs": GLOBAL_METRICS.get(COMPILE_PROGRAMS),
+            "hits": GLOBAL_METRICS.get(COMPILE_HITS),
+            "compile_seconds": GLOBAL_METRICS.get(COMPILE_SECONDS),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+GLOBAL_STEP_CACHE = CompiledStepCache()

@@ -155,6 +155,7 @@ class MapOutputWriter:
         self._values: List[np.ndarray] = []
         self._staged: List[ArenaBuffer] = []
         self._committed = False
+        self._released = False
         # spill plumbing (threshold 0 = arena-only staging)
         self._spill_dir = spill_dir
         self._spill_threshold = spill_threshold if spill_dir else 0
@@ -168,8 +169,16 @@ class MapOutputWriter:
               values: Optional[np.ndarray] = None) -> None:
         """Append a batch of records. ``keys`` [N] integer; ``values``
         [N, ...] optional payload rows."""
+        # committed FIRST: a committed writer released by normal
+        # teardown must keep reporting the accurate immutability error,
+        # not claim a speculative supersede discarded its rows
         if self._committed:
             raise RuntimeError("writer already committed")
+        if self._released:
+            raise RuntimeError(
+                f"map {self.map_id}: writer was released (superseded "
+                f"attempt, failed-task retry, or shuffle teardown); its "
+                f"staged rows are gone — obtain a fresh writer")
         keys = np.ascontiguousarray(keys)
         if keys.ndim != 1:
             raise ValueError("keys must be 1-D")
@@ -259,8 +268,21 @@ class MapOutputWriter:
         The writeIndexFileAndCommit hook: stock commit is our staging,
         the publish is the put to the driver table
         (ref: CommonUcxShuffleBlockResolver.scala:78-103)."""
+        # committed before released: a committed-then-released writer
+        # (normal unregister/remesh teardown) reports immutability, the
+        # accurate diagnosis
         if self._committed:
             raise RuntimeError("writer already committed")
+        if self._released:
+            # A superseded speculative attempt committing late must fail
+            # HERE, not publish: release() cleared its staged rows, so a
+            # publish would mark the map complete with a zero size row —
+            # the reader would silently lose that map's data (ADVICE r5
+            # high: the late-committing-attempt hole in first-commit-wins)
+            raise RuntimeError(
+                f"map {self.map_id}: writer was released (superseded "
+                f"attempt?) — its staged rows are gone and it may not "
+                f"publish; first commit wins")
         if self.faults is not None:
             self.faults.check("publish")
         with Timer() as t, GLOBAL_TRACER.span(
@@ -318,7 +340,11 @@ class MapOutputWriter:
     def release(self) -> None:
         """Return staging buffers to the pool and delete spill files
         (removeShuffle's parallel deregister+munmap,
-        ref: CommonUcxShuffleBlockResolver.scala:109-121)."""
+        ref: CommonUcxShuffleBlockResolver.scala:109-121).
+
+        The writer is DEAD afterwards: write()/commit() raise. Idempotent
+        (the graveyard/stop paths may release a batch more than once)."""
+        self._released = True
         for b in self._staged:
             self.pool.put(b)
         self._staged.clear()

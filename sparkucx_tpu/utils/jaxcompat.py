@@ -1,0 +1,36 @@
+"""jax generation shim — importing this module installs it.
+
+``jax.shard_map`` (with its ``check_vma`` kwarg) graduated out of
+``jax.experimental.shard_map`` (where the kwarg is ``check_rep``) after
+the 0.4.x line; this image bakes a 0.4.x jax. Aliasing it keeps the
+device plane source written against the current API working on both
+generations; no-op on newer jax.
+
+Imported by every module that calls ``jax.shard_map`` (reader,
+hierarchical, aot, models, parallel) rather than unconditionally by the
+package ``__init__``: config-only tooling must not pay the jax import
+(the lazy-import contract ``sparkucx_tpu.connect`` documents). The
+package init still installs it WHEN jax is already imported, which
+covers callers (tests, bench harnesses) that use ``jax.shard_map``
+directly after importing the package.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install() -> None:
+    """Idempotent; safe on any jax generation."""
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma, **kw)
+
+    jax.shard_map = shard_map
+
+
+install()

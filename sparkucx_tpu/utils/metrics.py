@@ -18,6 +18,14 @@ from collections import defaultdict
 from typing import Dict
 
 
+# Compile-cost observability (shuffle/stepcache.py, bench --stage
+# coldstart): ONE place for the counter names so the cache, the bench and
+# the tests cannot drift on spelling.
+COMPILE_PROGRAMS = "compile.step.programs"   # distinct step programs built
+COMPILE_HITS = "compile.step.hits"           # step-cache lookups served
+COMPILE_SECONDS = "compile.step.seconds"     # first-invocation wall secs
+
+
 class Timer:
     """Context-manager wall timer; `.ms` after exit."""
 

@@ -42,6 +42,10 @@ import pytest  # noqa: E402
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "tpu: needs a real TPU backend (SPARKUCX_TPU_TEST_TPU=1)")
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 budget (-m 'not slow')"
+        " — multi-minute AOT topology compiles; CI's full run and the"
+        " bench's stage_native_aot still execute them")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -210,6 +210,7 @@ def test_local_fastpath_single_shard(rng):
     assert bool(np.asarray(ovf)[0])
 
 
+@pytest.mark.slow
 def test_native_multipeer_aot_proof_v5e16(mesh8):
     """Same proof at the BASELINE north-star topology itself (v5e-16):
     the production step lowers at n=16 with all 16 replicas."""
@@ -223,6 +224,7 @@ def test_native_multipeer_aot_proof_v5e16(mesh8):
     assert rep["replica_groups_n"] == 16
 
 
+@pytest.mark.slow
 def test_native_multipeer_aot_proof(mesh8):
     """Multi-peer lowering proof without hardware: AOT-compile the n=8
     native exchange step against an unattached v5e topology via the

@@ -73,3 +73,20 @@ def test_best_recorded_run_ranks_full_stage_with_zero_value(tmp_path):
     assert best["value"] == 7.5
     assert "a.json" in best["artifact"]
     assert best["best_any_shape"]["value"] == 14.8
+
+
+def test_coldstart_bucket_sweep_small():
+    """The --stage coldstart sweep machinery at a CI-sized shape:
+    bucketing must cut distinct step compiles under row-count drift and
+    leave every partition bit-identical (bucketing only pads capacities
+    up — trailing padding never reaches a partition view)."""
+    rec = bench.coldstart_bucket_sweep(exchanges=6, jitter=0.2,
+                                       rows_per_map=512, maps=8,
+                                       partitions=16, seed=3)
+    assert rec["bit_identical"], rec
+    assert rec["compiles_bucketing_off"] >= 4, rec
+    # the full >=5x criterion belongs to the 20-exchange artifact; at 6
+    # exchanges the off-count has not spread yet, so the smoke bar is
+    # strictly-fewer
+    assert rec["compiles_bucketing_on"] < \
+        rec["compiles_bucketing_off"], rec

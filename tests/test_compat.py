@@ -174,3 +174,87 @@ def test_v2_failed_lease_does_not_advance_watermark(base_conf):
         with pytest.raises(RuntimeError, match="attempt 1 already ran"):
             svc.writer(h, 0, attempt_id=0)
         svc.unregister(15)
+
+
+def test_v2_superseded_attempt_cannot_publish(base_conf):
+    """ADVICE r5 high: a superseded speculative attempt committing LATE
+    must raise, not publish a zero size row that silently loses the real
+    attempt's data. release() marks the writer dead; commit()/write() on
+    the stale handle fail loudly."""
+    conf = dict(base_conf, **{"spark.shuffle.tpu.compat.version": "v2"})
+    with sparkucx_tpu.connect(conf, use_env=False) as svc:
+        h = svc.register(ShuffleDependency(16, 1, 4))
+        w0 = svc.writer(h, 0, attempt_id=0)
+        w0.write(np.arange(10, dtype=np.int64))
+        # attempt 1 supersedes the uncommitted attempt 0 ...
+        w1 = svc.writer(h, 0, attempt_id=1)
+        w1.write(np.arange(10, 20, dtype=np.int64))
+        w1.commit()
+        # ... so the stale handle is DEAD: neither publish nor stage
+        with pytest.raises(RuntimeError, match="released"):
+            w0.commit()
+        with pytest.raises(RuntimeError, match="released"):
+            w0.write(np.arange(3, dtype=np.int64))
+        # the real attempt's rows are what readers see
+        keys = np.sort(np.concatenate(
+            [k for _, (k, _) in svc.reader(h)]))
+        np.testing.assert_array_equal(keys, np.arange(10, 20))
+        svc.unregister(16)
+
+
+def test_v2_equal_attempt_rellease_rejected(base_conf):
+    """ADVICE r5 low, pinned: re-leasing the SAME live attempt id is
+    rejected (it would silently discard that attempt's staged rows); a
+    HIGHER id still supersedes, and a committed equal attempt reports
+    first-commit-wins."""
+    conf = dict(base_conf, **{"spark.shuffle.tpu.compat.version": "v2"})
+    with sparkucx_tpu.connect(conf, use_env=False) as svc:
+        h = svc.register(ShuffleDependency(17, 2, 4))
+        w = svc.writer(h, 0, attempt_id=3)
+        w.write(np.arange(5, dtype=np.int64))
+        with pytest.raises(RuntimeError, match="live writer lease"):
+            svc.writer(h, 0, attempt_id=3)
+        # the rejected re-lease must not have touched the live writer
+        w.commit()
+        assert w.committed
+        # equal id AFTER commit: the first-commit-wins rule, by name
+        with pytest.raises(RuntimeError, match="already committed"):
+            svc.writer(h, 0, attempt_id=3)
+        # higher id on another map still works
+        w2 = svc.writer(h, 1, attempt_id=0)
+        w2.write(np.arange(2, dtype=np.int64))
+        w2.commit()
+        svc.unregister(17)
+
+
+def test_v2_partition_readers_share_one_exchange(base_conf):
+    """ADVICE r5 medium: N PartitionReaders of one shuffle must trigger
+    ONE collective (counted via shuffle.read.count), invalidated by
+    unregister — the natural one-reader-per-reduce-task pattern must not
+    multiply the exchange cost (or deadlock distributed mode)."""
+    conf = dict(base_conf, **{"spark.shuffle.tpu.compat.version": "v2"})
+    with sparkucx_tpu.connect(conf, use_env=False) as svc:
+        R, M = 8, 4
+        h = svc.register(ShuffleDependency(18, M, R))
+        rng = np.random.default_rng(5)
+        staged = []
+        for m in range(M):
+            w = svc.writer(h, m)
+            keys = rng.integers(0, 1 << 31, size=200).astype(np.int64)
+            staged.append(keys)
+            w.write(keys)
+            w.commit()
+        reads0 = svc.node.metrics.get("shuffle.read.count")
+        parts = {}
+        for r in range(R):          # one range reader per reduce task
+            for rr, (k, _) in svc.reader(h, r, r + 1):
+                parts[rr] = k
+        assert sorted(parts) == list(range(R))
+        np.testing.assert_array_equal(
+            np.sort(np.concatenate(list(parts.values()))),
+            np.sort(np.concatenate(staged)))
+        assert svc.node.metrics.get("shuffle.read.count") - reads0 == 1, \
+            "N range readers must share one exchange"
+        svc.unregister(18)
+        # unregister invalidated the cached result
+        assert 18 not in svc._results

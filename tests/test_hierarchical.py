@@ -190,6 +190,7 @@ def test_manager_hierarchical_optout(rng):
         node.close()
 
 
+@pytest.mark.slow
 def test_hier_step_aot_proof():
     """The two-stage (ICI, DCN) exchange lowers for TPU at a 2x4
     topology via the local libtpu: BOTH collectives survive post-opt
@@ -205,3 +206,20 @@ def test_hier_step_aot_proof():
         _pytest.skip(f"no TPU topology support here: {rep.get('error')}")
     assert rep["ok"], rep
     assert set(rep["group_sizes"]) >= {2, 4}
+
+
+def test_two_stage_proof_decision_closes_equal_size_hole():
+    """ADVICE r5 low: the slices == per_slice case must demand TWO
+    collectives OF THAT SIZE — one required-size line plus one of an
+    unrelated size used to pass vacuously through the summed count."""
+    from sparkucx_tpu.shuffle.aot import _two_stage_ok
+
+    # general case: both sizes present, regardless of extras
+    assert _two_stage_ok({2: 1, 4: 1}, slices=2, per_slice=4)
+    assert not _two_stage_ok({4: 2}, slices=2, per_slice=4)
+    assert not _two_stage_ok({2: 2}, slices=2, per_slice=4)
+    # degenerate slices == per_slice: the size must occur twice
+    assert _two_stage_ok({4: 2}, slices=4, per_slice=4)
+    assert not _two_stage_ok({4: 1}, slices=4, per_slice=4)
+    # THE hole: one required-size collective + one unrelated size
+    assert not _two_stage_ok({4: 1, 8: 1}, slices=4, per_slice=4)

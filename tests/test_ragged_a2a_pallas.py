@@ -104,6 +104,7 @@ def test_interpret_oracle_eight_devices(mesh8):
     _run_interpret(8, 10, sizes, seed=8)
 
 
+@pytest.mark.slow
 def test_mosaic_aot_lowering_v5e(mesh8):
     """The Mosaic lowering proof: compile the kernel at n=8 against an
     unattached v5e topology (no devices needed). Skips where libtpu /
@@ -465,6 +466,7 @@ def test_pallas_combine_ordered_fuzz(pallas_manager, seed):
     m.unregister_shuffle(sid)
 
 
+@pytest.mark.slow
 def test_pallas_step_aot_lowering_v5e(mesh8):
     """The FULL pallas step (aligned sort + kernel + seg all_gather)
     AOT-compiles at n=8 against an unattached v5e topology with

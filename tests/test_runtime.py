@@ -144,3 +144,18 @@ def test_mesh_and_node():
     node.close()
     with pytest.raises(RuntimeError):
         TpuNode.get()
+
+
+def test_registry_rejects_double_publish(rng):
+    """First-commit-wins at the metadata plane: a second publish for the
+    same map (late speculative attempt, double commit) must raise, never
+    overwrite the size row readers already trust."""
+    reg = ShuffleRegistry()
+    e = reg.register(3, 2, 4)
+    e.publish(0, rng.integers(0, 10, size=4))
+    with pytest.raises(RuntimeError, match="already published"):
+        e.publish(0, np.zeros(4))
+    # the other slot is unaffected
+    e.publish(1, rng.integers(0, 10, size=4))
+    assert e.num_present == 2
+    reg.unregister(3)

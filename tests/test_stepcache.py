@@ -1,0 +1,169 @@
+"""The keyed compiled-step cache + persistent compile-cache conf seam.
+
+The cold-start subsystem's in-process half: one compiled program per plan
+signature, SHARED across managers (and warmup), with observable
+compile-count / cache-hit / compile-seconds counters."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.shuffle.stepcache import GLOBAL_STEP_CACHE
+from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
+                                        COMPILE_SECONDS, GLOBAL_METRICS)
+
+
+def _run_shuffle(mgr, sid, rows=500, maps=4, R=8, seed=0):
+    rng = np.random.default_rng(seed)
+    h = mgr.register_shuffle(sid, maps, R)
+    for m in range(maps):
+        w = mgr.get_writer(h, m)
+        w.write(rng.integers(0, 1 << 40, size=rows, dtype=np.int64))
+        w.commit(R)
+    res = mgr.read(h)
+    total = sum(res.partition(r)[0].shape[0] for r in range(R))
+    assert total == maps * rows
+    mgr.unregister_shuffle(sid)
+
+
+def test_step_cache_shared_across_managers(mesh8):
+    """Two managers in ONE process: the second manager's same-shape read
+    must HIT the program the first compiled — the counters are the
+    evidence (compile.step.programs unchanged, compile.step.hits up)."""
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    m1 = TpuShuffleManager(node, conf)
+    m2 = TpuShuffleManager(node, conf)
+    try:
+        GLOBAL_STEP_CACHE.clear()
+        p0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+        h0 = GLOBAL_METRICS.get(COMPILE_HITS)
+        s0 = GLOBAL_METRICS.get(COMPILE_SECONDS)
+
+        _run_shuffle(m1, 701)
+        p1 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+        assert p1 - p0 == 1, "first read compiles exactly one program"
+        assert GLOBAL_METRICS.get(COMPILE_SECONDS) > s0, \
+            "the first invocation must record compile seconds"
+
+        _run_shuffle(m2, 702)          # same shape, OTHER manager
+        assert GLOBAL_METRICS.get(COMPILE_PROGRAMS) == p1, \
+            "same-shape read on a second manager must not recompile"
+        assert GLOBAL_METRICS.get(COMPILE_HITS) > h0
+
+        stats = GLOBAL_STEP_CACHE.stats()
+        assert stats["entries"] >= 1
+        assert stats["programs"] >= 1
+    finally:
+        m1.stop()
+        m2.stop()
+        node.close()
+
+
+def test_warmup_seeds_cache_for_bucketed_drift(mesh8):
+    """With a2a.capBuckets on, a warmup at the EXPECTED shape covers
+    reads whose row counts drifted within the bucket: the read's plan
+    quantizes to the warmed signature, so no second program compiles —
+    the cross-shape amortization the old exact-match warmup could not
+    give."""
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense",
+                           "spark.shuffle.tpu.a2a.capBuckets": "true"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    mgr = TpuShuffleManager(node, conf)
+    try:
+        GLOBAL_STEP_CACHE.clear()
+        rng = np.random.default_rng(3)
+        maps, R, rows = 8, 16, 1000
+        h = mgr.register_shuffle(711, maps, R)
+        mgr.warmup(h, rows_per_map=rows)
+        p0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+        # drift: 3% fewer rows per map — a different exact shape, the
+        # same bucket rung
+        for m in range(maps):
+            w = mgr.get_writer(h, m)
+            w.write(rng.integers(0, 1 << 40, size=rows - 32,
+                                 dtype=np.int64))
+            w.commit(R)
+        res = mgr.read(h)
+        assert sum(res.partition(r)[0].shape[0]
+                   for r in range(R)) == maps * (rows - 32)
+        assert GLOBAL_METRICS.get(COMPILE_PROGRAMS) == p0, \
+            "drifted-row read must land on the warmed bucket's program"
+        mgr.unregister_shuffle(711)
+    finally:
+        mgr.stop()
+        node.close()
+
+
+def test_step_cache_eviction_bounded():
+    cache = type(GLOBAL_STEP_CACHE)(capacity=2)
+    built = []
+    for i in range(4):
+        cache.get(("k", i), lambda i=i: built.append(i) or (lambda: i),
+                  {"i": i})
+    assert built == [0, 1, 2, 3]
+    assert cache.stats()["entries"] == 2
+    # an evicted key rebuilds; a live key does not
+    cache.get(("k", 3), lambda: built.append(9) or (lambda: 9), {})
+    assert built == [0, 1, 2, 3]
+    cache.get(("k", 0), lambda: built.append(0) or (lambda: 0), {})
+    assert built == [0, 1, 2, 3, 0]
+
+
+def test_configure_compile_cache(tmp_path):
+    """The conf-keyed persistent-cache seam: enabled -> dir created and
+    returned; disabled -> None and no dir side effects."""
+    import jax
+
+    from sparkucx_tpu.runtime.compile_cache import (cache_entry_count,
+                                                    configure_compile_cache)
+
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        d = str(tmp_path / "xla_cache")
+        on = TpuShuffleConf({
+            "spark.shuffle.tpu.compile.cacheDir": d,
+            "spark.shuffle.tpu.compile.minCompileTimeSecs": "0.5",
+        }, use_env=False)
+        got = configure_compile_cache(on)
+        assert got == d and os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.5
+        assert cache_entry_count(d) == 0
+        assert cache_entry_count(str(tmp_path / "missing")) == 0
+
+        off = TpuShuffleConf({
+            "spark.shuffle.tpu.compile.cacheEnabled": "false",
+            "spark.shuffle.tpu.compile.cacheDir": str(tmp_path / "never"),
+        }, use_env=False)
+        assert configure_compile_cache(off) is None
+        assert not (tmp_path / "never").exists()
+
+        # JAX_COMPILATION_CACHE_DIR beats the default but not an
+        # explicit conf entry — and survives a later default-conf call
+        # (the TpuNode.start-clobbers-the-operator's-dir regression)
+        env_d = str(tmp_path / "env_cache")
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = env_d
+        try:
+            assert configure_compile_cache(
+                TpuShuffleConf(use_env=False)) == env_d
+            assert configure_compile_cache(on) == d   # explicit wins
+        finally:
+            del os.environ["JAX_COMPILATION_CACHE_DIR"]
+    finally:
+        # the jax cache config is process-global: the tmp dir dies with
+        # this test, so later compiles must not try to persist into it
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          prev_min)

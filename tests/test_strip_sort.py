@@ -197,6 +197,7 @@ def test_manager_e2e_strips(rng):
         node.close()
 
 
+@pytest.mark.slow
 def test_strip_step_aot_proof():
     """The strip-sorted step lowers for the v5e chip via the local
     libtpu (no tunnel needed): pure sort — no collective, no scatter
