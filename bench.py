@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import signal
 import subprocess
@@ -164,6 +165,13 @@ class StageMonitor:
         try:
             detail = {"stages": dict(self.stages)}
             detail.update(self.extra)
+            # every BENCH artifact carries its compile/retry/skew context
+            # (counters + histogram percentiles + span summary) — a
+            # number without its telemetry is unexplainable after the
+            # fact, which is how three rounds of outages were lost
+            tel = _telemetry_blob()
+            if tel:
+                detail["telemetry"] = tel
             out = {
                 "metric": METRIC,
                 "value": round(self.best_value, 3),
@@ -208,6 +216,36 @@ class StageMonitor:
                 signal.signal(sig, _on_kill)
             except (ValueError, OSError):
                 pass   # non-main thread / unsupported platform
+
+
+def _telemetry_blob():
+    """Metrics snapshot + histogram percentiles + span summary for
+    embedding in bench artifacts. Best-effort and stdlib-import-only on
+    the failure path: emit() also runs from the kill handler, where a
+    telemetry failure must never cost the one JSON line."""
+    try:
+        from sparkucx_tpu.utils.metrics import GLOBAL_METRICS
+        from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+        counters = GLOBAL_METRICS.snapshot()
+        hists = {name: {f: v for f, v in snap.items() if f != "buckets"}
+                 for name, snap in GLOBAL_METRICS.histograms().items()
+                 if snap["count"]}
+        blob = {}
+        if counters:
+            blob["counters"] = {k: round(v, 4)
+                                for k, v in sorted(counters.items())}
+        if hists:
+            blob["histograms"] = {
+                k: {f: round(v, 4) for f, v in p.items()}
+                for k, p in sorted(hists.items())}
+        spans = GLOBAL_TRACER.summary()
+        if spans:
+            blob["spans"] = {
+                k: {f: round(v, 4) for f, v in agg.items()}
+                for k, agg in sorted(spans.items())}
+        return blob
+    except Exception:
+        return None
 
 
 def _best_recorded_tpu_run(rundir=None):
@@ -1143,8 +1181,239 @@ def stage_coldstart(args) -> int:
         and sweep["compile_ratio"] >= 5.0
         and pc.get("cache_engaged", False)
         and not pc.get("recompiled_on_warm", True))
+    out["telemetry"] = _telemetry_blob()
     artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "bench_runs", "coldstart.json")
+    try:
+        os.makedirs(os.path.dirname(artifact), exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(out, f, indent=1)
+        out["artifact"] = os.path.relpath(
+            artifact, os.path.dirname(os.path.abspath(__file__)))
+    except OSError as e:
+        out["artifact_error"] = str(e)[:200]
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 2
+
+
+def obs_overhead_measure(exchanges=30, rows_per_map=2048, maps=4,
+                         partitions=8, reps=3, seed=0):
+    """Measure the telemetry plane's cost on the CPU exchange loop.
+
+    The GATING number (``overhead_disabled_pct``) is deterministic
+    accounting, not an A/B: count every telemetry hook one exchange
+    actually executes with the plane disabled (Metrics.inc / .observe,
+    disabled-tracer span() calls, ExchangeReport accumulation),
+    microbenchmark each primitive's disabled-path cost in a tight loop,
+    and divide the product by the measured median exchange wall time.
+    A direct A/B of a sub-1% effect on a ~10 ms loop is unresolvable
+    under shared-CPU load drift (the first cut of this stage measured
+    telemetry-ENABLED faster than disabled); the per-primitive costs
+    are sub-µs and measure cleanly.
+
+    A/B medians (``median_exchange_ms``: hooks monkeypatched out vs
+    shipping defaults vs tracer+recorder on, interleaved rounds, min
+    over ``reps``) ride along as context. In-process and CPU-safe, so
+    tests run it at tiny shapes. Returns the result dict."""
+    import contextlib
+    import time as _time
+
+    import numpy as np
+
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.failures import FlightRecorder
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import (ExchangeReport,
+                                              TpuShuffleManager)
+    from sparkucx_tpu.utils import metrics as _metrics_mod
+    from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+
+    rng = np.random.default_rng(seed)
+    data = [rng.integers(0, 1 << 40, size=rows_per_map, dtype=np.int64)
+            for _ in range(maps)]
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    mgr = TpuShuffleManager(node, conf)
+
+    sid_box = [50000]
+
+    def loop_median_ms():
+        times = []
+        for _ in range(exchanges):
+            sid = sid_box[0]
+            sid_box[0] += 1
+            t0 = _time.perf_counter()
+            h = mgr.register_shuffle(sid, maps, partitions)
+            for m in range(maps):
+                w = mgr.get_writer(h, m)
+                w.write(data[m])
+                w.commit(partitions)
+            res = mgr.read(h)
+            res.partition(0)
+            times.append(_time.perf_counter() - t0)
+            mgr.unregister_shuffle(sid)
+        times.sort()
+        return times[len(times) // 2] * 1e3
+
+    @contextlib.contextmanager
+    def noop_telemetry():
+        saved = (_metrics_mod.Metrics.inc, _metrics_mod.Metrics.observe,
+                 TpuShuffleManager._new_report,
+                 TpuShuffleManager._report_volume)
+        _metrics_mod.Metrics.inc = lambda self, name, value=1.0: None
+        _metrics_mod.Metrics.observe = lambda self, name, value: None
+        TpuShuffleManager._new_report = \
+            lambda self, h, distributed: ExchangeReport(
+                shuffle_id=h.shuffle_id, num_maps=h.num_maps,
+                num_partitions=h.num_partitions,
+                partitioner=h.partitioner)
+        TpuShuffleManager._report_volume = lambda self, *a, **k: None
+        try:
+            yield
+        finally:
+            (_metrics_mod.Metrics.inc, _metrics_mod.Metrics.observe,
+             TpuShuffleManager._new_report,
+             TpuShuffleManager._report_volume) = saved
+
+    @contextlib.contextmanager
+    def enabled_telemetry():
+        recorder = FlightRecorder(capacity=512)
+        was = GLOBAL_TRACER.enabled
+        GLOBAL_TRACER.enabled = True
+        node.metrics.add_reporter(recorder.metrics_reporter)
+        try:
+            yield
+        finally:
+            GLOBAL_TRACER.enabled = was
+            node.metrics.remove_reporter(recorder.metrics_reporter)
+
+    out = {"exchanges": exchanges, "rows_per_map": rows_per_map,
+           "maps": maps, "partitions": partitions, "reps": reps}
+    def count_hooks():
+        """Hook invocations ONE disabled-telemetry exchange executes."""
+        counts = {"inc": 0, "observe": 0, "span": 0}
+        saved = (_metrics_mod.Metrics.inc, _metrics_mod.Metrics.observe,
+                 type(GLOBAL_TRACER).span)
+
+        def _inc(self, name, value=1.0):
+            counts["inc"] += 1
+            return saved[0](self, name, value)
+
+        def _observe(self, name, value):
+            counts["observe"] += 1
+            return saved[1](self, name, value)
+
+        def _span(self, name, **attrs):
+            counts["span"] += 1
+            return saved[2](self, name, **attrs)
+
+        _metrics_mod.Metrics.inc = _inc
+        _metrics_mod.Metrics.observe = _observe
+        type(GLOBAL_TRACER).span = _span
+        try:
+            sid = sid_box[0]
+            sid_box[0] += 1
+            h = mgr.register_shuffle(sid, maps, partitions)
+            for m in range(maps):
+                w = mgr.get_writer(h, m)
+                w.write(data[m])
+                w.commit(partitions)
+            mgr.read(h).partition(0)
+            mgr.unregister_shuffle(sid)
+        finally:
+            (_metrics_mod.Metrics.inc, _metrics_mod.Metrics.observe,
+             type(GLOBAL_TRACER).span) = saved
+        return counts
+
+    def microbench(fn, n=20000):
+        """Per-call microseconds of one disabled-path primitive."""
+        fn()   # warm any first-call allocation
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (_time.perf_counter() - t0) / n * 1e6
+
+    modes = (("noop", noop_telemetry),
+             ("disabled", contextlib.nullcontext),
+             ("enabled", enabled_telemetry))
+    try:
+        loop_median_ms()   # warmup: compile + caches, outside the clock
+        hook_counts = count_hooks()
+        bench_metrics = _metrics_mod.Metrics()
+
+        def _one_span():
+            with GLOBAL_TRACER.span("bench.noop"):
+                pass
+
+        assert not GLOBAL_TRACER.enabled
+        hook_us = {
+            "inc": microbench(lambda: bench_metrics.inc("bench.x", 1.0)),
+            "observe": microbench(
+                lambda: bench_metrics.observe("bench.h", 1.0)),
+            "span": microbench(_one_span),
+        }
+        # report accumulation cost: dataclass + ring insert + volume
+        # fields, timed through the real manager methods
+        rep_handle = mgr.register_shuffle(sid_box[0], maps, partitions)
+        sid_box[0] += 1
+        import numpy as _np
+        nv = _np.full(node.num_devices, rows_per_map, dtype=_np.int64)
+        from sparkucx_tpu.shuffle.plan import make_plan as _mk
+        plan = _mk(nv, node.num_devices, partitions, conf)
+
+        def _one_report():
+            r = mgr._new_report(rep_handle, False)
+            mgr._report_volume(r, plan, nv, 2)
+
+        report_us = microbench(_one_report, n=2000)
+        mgr.unregister_shuffle(rep_handle.shuffle_id)
+        # the _report_volume above observes 2 histograms per peer — those
+        # observes are part of the report cost, remove the double count
+        est_us = (hook_counts["inc"] * hook_us["inc"]
+                  + hook_counts["observe"] * hook_us["observe"]
+                  + hook_counts["span"] * hook_us["span"]
+                  + report_us
+                  - 2 * node.num_devices * hook_us["observe"])
+        # INTERLEAVED A/B rounds (noop/disabled/enabled per rep, min
+        # over reps) — context only; sequential blocks bias whichever
+        # mode runs while the machine is warmest
+        medians = {name: math.inf for name, _ in modes}
+        for _ in range(reps):
+            for name, ctx in modes:
+                with ctx():
+                    medians[name] = min(medians[name], loop_median_ms())
+    finally:
+        mgr.stop()
+        node.close()
+    out["hook_counts_per_exchange"] = hook_counts
+    out["hook_cost_us"] = {k: round(v, 4) for k, v in hook_us.items()}
+    out["report_cost_us"] = round(report_us, 4)
+    out["telemetry_us_per_exchange"] = round(est_us, 3)
+    out["median_exchange_ms"] = {k: round(v, 4)
+                                 for k, v in medians.items()}
+    out["overhead_disabled_pct"] = round(
+        est_us / 1e3 / medians["disabled"] * 100.0, 4)
+    out["overhead_enabled_ab_pct"] = round(max(
+        0.0, (medians["enabled"] - medians["noop"])
+        / medians["noop"] * 100.0), 3)
+    return out
+
+
+def stage_obs_overhead(args) -> int:
+    """``--stage obs-overhead``: prove the telemetry plane costs <1% of
+    the CPU exchange loop when disabled (the near-zero-when-off
+    contract), with the enabled cost alongside for context. Prints ONE
+    JSON line and writes bench_runs/obs_overhead.json."""
+    out = {"metric": "obs_overhead",
+           "detail": obs_overhead_measure(
+               exchanges=30, rows_per_map=1 << (args.rows_log2 or 11),
+               reps=args.reps)}
+    out["ok"] = out["detail"]["overhead_disabled_pct"] < 1.0
+    out["telemetry"] = _telemetry_blob()
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_runs", "obs_overhead.json")
     try:
         os.makedirs(os.path.dirname(artifact), exist_ok=True)
         with open(artifact, "w") as f:
@@ -1232,11 +1501,14 @@ def main() -> None:
                          "(unstable = explicit-key sort, 3-key fused "
                          "form since r5; stable = 1-key stable sort — "
                          "the conf default)")
-    ap.add_argument("--stage", default=None, choices=("coldstart",),
+    ap.add_argument("--stage", default=None,
+                    choices=("coldstart", "obs-overhead"),
                     help="run ONE dedicated stage instead of the ladder: "
                          "coldstart = compile-cost artifact (persistent "
                          "cache cold-vs-warm across processes + "
-                         "capBuckets drifting-shape compile sweep), "
+                         "capBuckets drifting-shape compile sweep); "
+                         "obs-overhead = telemetry-plane cost on the "
+                         "exchange loop (disabled must be <1%). Both "
                          "CPU-measurable")
     ap.add_argument("--platform", default="auto",
                     choices=("auto", "tpu", "cpu"),
@@ -1251,19 +1523,21 @@ def main() -> None:
                          "or 1200); the tunnel often recovers in-round")
     args = ap.parse_args()
 
-    if args.platform == "cpu" or args.stage == "coldstart":
+    if args.platform == "cpu" or args.stage is not None:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8").strip()
 
-    if args.stage == "coldstart":
-        # a compile-COST artifact, deliberately CPU: the measurement is
-        # recompiles avoided, not bandwidth, so it lands even when the
-        # TPU window is dark (VERDICT chip-outage plan B)
+    if args.stage is not None:
+        # dedicated stages are compile-cost / overhead artifacts,
+        # deliberately CPU: the measurement is recompiles avoided or
+        # telemetry microseconds, not bandwidth, so it lands even when
+        # the TPU window is dark (VERDICT chip-outage plan B)
         import jax
         jax.config.update("jax_platforms", "cpu")
-        sys.exit(stage_coldstart(args))
+        sys.exit(stage_coldstart(args) if args.stage == "coldstart"
+                 else stage_obs_overhead(args))
 
     fallback = None
     if args.platform == "auto" and not args.no_fallback:

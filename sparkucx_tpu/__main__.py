@@ -1,9 +1,106 @@
-"""``python -m sparkucx_tpu`` — print the self-describing conf-key
-table (the reference's UcxShuffleConf documents its key surface the
-same way, through ConfigBuilder doc strings,
-ref: UcxShuffleConf.scala:25-89)."""
+"""``python -m sparkucx_tpu`` — operator CLI.
 
-from sparkucx_tpu.config import _print_key_table
+Subcommands:
+
+``keys`` (default)
+    Print the self-describing conf-key table (the reference's
+    UcxShuffleConf documents its key surface the same way, through
+    ConfigBuilder doc strings, ref: UcxShuffleConf.scala:25-89).
+
+``stats [--input DUMP.json] [--format prometheus|json]``
+    Render a telemetry snapshot. With ``--input``, re-render a dump
+    written by the periodic dumper (``spark.shuffle.tpu.metrics.dumpDir``)
+    or a flight-recorder postmortem — same renderer, so a dead process's
+    dump reads exactly like a live scrape. Without ``--input``, snapshot
+    THIS process's registries (the declared histograms export with zero
+    counts, so the scrape surface is complete from process start).
+
+``trace [--input DUMP.json] [--out TRACE.json]``
+    Print the span summary table (count / mean / p50 / p99 / max ms per
+    span name) from a dump, and optionally extract its Chrome trace
+    events to a file loadable in Perfetto / chrome://tracing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _live_snapshot() -> dict:
+    from sparkucx_tpu.utils.export import collect_snapshot
+    from sparkucx_tpu.utils.metrics import GLOBAL_METRICS
+    from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+    return collect_snapshot(GLOBAL_METRICS, tracer=GLOBAL_TRACER)
+
+
+def _cmd_stats(args) -> int:
+    from sparkucx_tpu.utils.export import render_json, render_prometheus
+    doc = _load(args.input) if args.input else _live_snapshot()
+    if args.format == "prometheus":
+        sys.stdout.write(render_prometheus(doc))
+    else:
+        sys.stdout.write(render_json(doc) + "\n")
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    doc = _load(args.input) if args.input else None
+    if doc is not None:
+        spans = doc.get("spans", {})
+        events = doc.get("trace_events", doc.get("traceEvents", []))
+    else:
+        from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+        spans = GLOBAL_TRACER.summary()
+        events = GLOBAL_TRACER.chrome_events()
+    cols = ("count", "mean_ms", "p50_ms", "p99_ms", "max_ms")
+    w = max([len(n) for n in spans] + [4])
+    print(f"{'span':<{w}}  " + "  ".join(f"{c:>9}" for c in cols))
+    for name in sorted(spans):
+        agg = spans[name]
+        print(f"{name:<{w}}  "
+              + "  ".join(f"{agg.get(c, 0.0):>9.2f}" for c in cols))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        print(f"wrote {len(events)} chrome trace events -> {args.out}")
+    return 0
+
+
+def _cmd_keys(args) -> int:
+    from sparkucx_tpu.config import _print_key_table
+    _print_key_table()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m sparkucx_tpu")
+    sub = ap.add_subparsers(dest="cmd")
+    sub.add_parser("keys", help="print the conf-key table (default)")
+    p_stats = sub.add_parser("stats", help="render a telemetry snapshot")
+    p_stats.add_argument("--input", default=None,
+                         help="metrics dump / flight-recorder JSON "
+                              "(default: this process, live)")
+    p_stats.add_argument("--format", default="prometheus",
+                         choices=("prometheus", "json"))
+    p_trace = sub.add_parser("trace", help="span summary + chrome export")
+    p_trace.add_argument("--input", default=None,
+                         help="flight-recorder / snapshot JSON")
+    p_trace.add_argument("--out", default=None,
+                         help="write chrome traceEvents JSON here")
+    args = ap.parse_args(argv)
+    if args.cmd == "stats":
+        return _cmd_stats(args)
+    if args.cmd == "trace":
+        return _cmd_trace(args)
+    return _cmd_keys(args)
+
 
 if __name__ == "__main__":
-    _print_key_table()
+    sys.exit(main())

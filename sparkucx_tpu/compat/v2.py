@@ -24,6 +24,7 @@ No data-plane logic here: everything delegates to TpuShuffleManager.
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Iterator, Mapping, Optional, Tuple
 
@@ -151,6 +152,8 @@ class ShuffleServiceV2:
         self._metrics_reporter = metrics_reporter
         if metrics_reporter is not None:
             self.node.metrics.add_reporter(metrics_reporter)
+        from sparkucx_tpu.service import _start_dumper
+        self._dumper = _start_dumper(conf, self.stats)
         log.info("ShuffleServiceV2 up: %d devices", self.node.num_devices)
 
     # -- lifecycle ---------------------------------------------------------
@@ -197,6 +200,7 @@ class ShuffleServiceV2:
         per-shuffle lock and inherit that outcome (their own timeout is
         not re-applied — the exchange is one shared event, not N)."""
         sid = handle.shuffle_id
+        t0 = time.perf_counter()
         with self._results_guard:
             if sid not in self._deps:
                 # a stale reader of an unregistered shuffle must fail
@@ -223,9 +227,23 @@ class ShuffleServiceV2:
                     # next shuffle's readers with stale partitions
                     if self._read_locks.get(sid) is lock:
                         self._results[sid] = res
+            else:
+                # CACHED-read fetch wait: every PartitionReader records
+                # its OWN wait (here: the per-shuffle lock wait while the
+                # dispatching reader runs the collective, plus the cache
+                # lookup), not just the first collective — the manager's
+                # read() already observes the dispatcher's. Spark charges
+                # each reduce task's reporter the same way.
+                from sparkucx_tpu.utils.metrics import H_FETCH_WAIT
+                self.node.metrics.observe(
+                    H_FETCH_WAIT, (time.perf_counter() - t0) * 1e3)
+                self.node.metrics.inc("shuffle.read.cached.count", 1)
             return res
 
     def stop(self) -> None:
+        if self._dumper is not None:
+            self._dumper.stop()
+            self._dumper = None
         if self._metrics_reporter is not None:
             self.node.metrics.remove_reporter(self._metrics_reporter)
             self._metrics_reporter = None
@@ -233,6 +251,13 @@ class ShuffleServiceV2:
         self.node.close()
 
     close = stop
+
+    def stats(self, format: str = "json"):
+        """Same telemetry snapshot surface as the v1 facade
+        (service._collect_stats) — the scrape seam does not drift with
+        the host-adapter contract."""
+        from sparkucx_tpu.service import _collect_stats
+        return _collect_stats(self.node, self.manager, format)
 
     def __enter__(self) -> "ShuffleServiceV2":
         return self

@@ -117,6 +117,19 @@ class TpuShuffleConf:
         "trace.enabled": "turn on the span tracer (utils/trace.py)",
         "trace.device": "also record device-time spans",
         "trace.capacity": "tracer ring-buffer size",
+        "metrics.dumpDir": "periodic JSON metrics-snapshot dumps land "
+                           "here (off when unset; utils/export.py)",
+        "metrics.dumpIntervalSecs": "seconds between periodic metrics "
+                                    "dumps (default 60)",
+        "flightRecorder.enabled": "crash flight recorder: ring of recent "
+                                  "telemetry events + postmortem JSON on "
+                                  "retry exhaustion / DeviceUnhealthy / "
+                                  "abort (runtime/failures.py; implies "
+                                  "trace.enabled)",
+        "flightRecorder.dir": "where flight-recorder postmortems are "
+                              "written (default: per-pid temp dir)",
+        "flightRecorder.capacity": "flight-recorder event-ring size "
+                                   "(default 512)",
         "failure.maxAttempts": "read-retry budget after device loss "
                                "(runtime/failures.py)",
         "failure.backoffMs": "backoff between failure-recovery attempts",

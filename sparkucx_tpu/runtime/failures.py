@@ -32,14 +32,18 @@ than the reference:
 
 from __future__ import annotations
 
+import os
+import sys
 import threading
 import time
-from dataclasses import dataclass
+from collections import deque
+from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional, Tuple
 
 import numpy as np
 
 from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.metrics import GLOBAL_METRICS, H_RETRY_MS
 
 log = get_logger("runtime.failures")
 
@@ -65,6 +69,201 @@ class NumericFailure(RuntimeError):
     """A monitored value went non-finite (NaN/Inf poison surfaced)."""
 
 
+# -- flight recorder ------------------------------------------------------
+class _NullFlightRecorder:
+    """No-op stand-in when ``spark.shuffle.tpu.flightRecorder.enabled``
+    is off — the tracer's null-object pattern: call sites stay
+    unconditional and cost one attribute lookup + a pass-through call."""
+
+    __slots__ = ()
+    enabled = False
+
+    def record(self, kind: str, **data) -> None:
+        pass
+
+    def metrics_reporter(self, name: str, value: float) -> None:
+        pass
+
+    def on_epoch_bump(self, epoch: int) -> None:
+        pass
+
+    def dump(self, reason: str, extra: Optional[Dict] = None):
+        return None
+
+    def add_context_provider(self, fn) -> None:
+        pass
+
+    def remove_context_provider(self, fn) -> None:
+        pass
+
+    def install_abort_hook(self) -> None:
+        pass
+
+    def uninstall_abort_hook(self) -> None:
+        pass
+
+
+NULL_FLIGHT_RECORDER = _NullFlightRecorder()
+
+
+class FlightRecorder:
+    """Bounded ring of recent telemetry events + one-shot postmortem dump.
+
+    The black box the round-5 outages were diagnosed WITHOUT: a ring of
+    recent metric deltas, epoch bumps, fault-injector firings and retry
+    events, plus context providers (the manager contributes its exchange
+    reports), flushed to a single JSON file — metrics snapshot, chrome
+    trace spans, last reports, the event ring — when a retry budget
+    exhausts, :class:`DeviceUnhealthy` fires, or an unhandled exception
+    aborts the process (``install_abort_hook``). Gated by
+    ``spark.shuffle.tpu.flightRecorder.enabled``; recording never raises
+    into a shuffle (swallow-and-log-once, the metric-reporter policy)."""
+
+    enabled = True
+
+    def __init__(self, conf=None, capacity: int = 512,
+                 out_dir: Optional[str] = None):
+        if conf is not None:
+            capacity = conf.get_int("flightRecorder.capacity", capacity)
+            out_dir = out_dir or conf.get(
+                "spark.shuffle.tpu.flightRecorder.dir")
+        if not out_dir:
+            import tempfile
+            out_dir = os.path.join(tempfile.gettempdir(),
+                                   f"sparkucx_tpu_flight_{os.getpid()}")
+        self.out_dir = out_dir
+        self._events: deque = deque(maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self._providers: list = []
+        self._warned = False
+        self._prev_hook = None
+        self.dumps: list = []          # paths written (tests/CI read this)
+        # Metrics registries snapshotted into every dump, beyond the
+        # process-global one (the node appends its per-node registry)
+        self.metrics_sources: list = []
+        self._epoch = time.time()
+
+    # -- recording --------------------------------------------------------
+    def record(self, kind: str, **data) -> None:
+        try:
+            with self._lock:
+                self._events.append(
+                    {"t": round(time.time() - self._epoch, 6),
+                     "kind": kind, **data})
+        except Exception:
+            self._warn_once("flight recorder record failed")
+
+    def metrics_reporter(self, name: str, value: float) -> None:
+        """fn(name, value) — attach via Metrics.add_reporter so every
+        counter increment / histogram observation lands in the ring."""
+        self.record("metric", name=name, value=value)
+
+    def on_epoch_bump(self, epoch: int) -> None:
+        self.record("epoch", epoch=epoch)
+
+    def add_context_provider(self, fn) -> None:
+        """``fn() -> JSON-able`` called at dump time; keyed by fn name."""
+        with self._lock:
+            self._providers.append(fn)
+
+    def remove_context_provider(self, fn) -> None:
+        with self._lock:
+            try:
+                self._providers.remove(fn)
+            except ValueError:
+                pass
+
+    # -- the postmortem ---------------------------------------------------
+    def dump(self, reason: str, extra: Optional[Dict] = None
+             ) -> Optional[str]:
+        """Write the postmortem JSON; returns the path (None on failure —
+        a dying process must not die harder because its black box did)."""
+        try:
+            from sparkucx_tpu.utils.export import write_snapshot
+            from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+            with self._lock:
+                events = list(self._events)
+                providers = list(self._providers)
+            contexts: Dict = {}
+            for fn in providers:
+                try:
+                    contexts[getattr(fn, "__name__", repr(fn))] = fn()
+                except Exception as e:
+                    contexts[getattr(fn, "__name__", repr(fn))] = \
+                        f"<provider failed: {e!r}>"
+            doc = {
+                "reason": reason,
+                "ts": time.time(),
+                "pid": os.getpid(),
+                "events": events,
+                "counters": {},
+                "histograms": {},
+                "spans": GLOBAL_TRACER.summary(),
+                "trace_events": GLOBAL_TRACER.chrome_events(),
+                "dropped_spans": GLOBAL_TRACER.dropped,
+                "contexts": contexts,
+            }
+            for m in [GLOBAL_METRICS] + list(self.metrics_sources):
+                doc["counters"].update(m.snapshot())
+                doc["histograms"].update(m.histograms())
+            if extra:
+                doc.update(extra)
+            os.makedirs(self.out_dir, exist_ok=True)
+            slug = "".join(c if c.isalnum() else "-"
+                           for c in reason.lower())[:40].strip("-")
+            path = os.path.join(
+                self.out_dir,
+                f"flight_{int(time.time() * 1e3)}_{slug or 'dump'}.json")
+            write_snapshot(doc, path)
+            self.dumps.append(path)
+            log.error("flight recorder dumped postmortem (%s): %s",
+                      reason, path)
+            return path
+        except Exception:
+            self._warn_once("flight recorder dump failed")
+            return None
+
+    def _warn_once(self, msg: str) -> None:
+        if not self._warned:
+            self._warned = True
+            log.exception("%s; further failures are silenced", msg)
+
+    # -- abort hook -------------------------------------------------------
+    def install_abort_hook(self) -> None:
+        """Dump on unhandled exceptions (the process-abort trigger); the
+        previous hooks still run — this is a tap, not a handler. BOTH
+        sys.excepthook and threading.excepthook are tapped: an exception
+        escaping a worker thread (dispatch callbacks, dump threads)
+        routes to the latter and would otherwise die undumped."""
+        if self._prev_hook is not None:
+            return
+        prev = sys.excepthook
+        prev_thread = threading.excepthook
+
+        def hook(exc_type, exc, tb):
+            try:
+                self.dump(f"unhandled {exc_type.__name__}: {exc}")
+            finally:
+                prev(exc_type, exc, tb)
+
+        def thread_hook(args):
+            try:
+                self.dump(f"unhandled {args.exc_type.__name__} in thread "
+                          f"{getattr(args.thread, 'name', '?')}: "
+                          f"{args.exc_value}")
+            finally:
+                prev_thread(args)
+
+        self._prev_hook = (prev, prev_thread)
+        sys.excepthook = hook
+        threading.excepthook = thread_hook
+
+    def uninstall_abort_hook(self) -> None:
+        if self._prev_hook is not None:
+            sys.excepthook, threading.excepthook = self._prev_hook
+            self._prev_hook = None
+
+
 # -- fault injection ------------------------------------------------------
 class FaultInjector:
     """Deterministic fault injection at named sites.
@@ -80,7 +279,9 @@ class FaultInjector:
     (metadata table fetch), ``exchange`` (the collective step). Tests may
     invent their own sites freely."""
 
-    def __init__(self, conf=None, seed: Optional[int] = None):
+    def __init__(self, conf=None, seed: Optional[int] = None,
+                 flight=NULL_FLIGHT_RECORDER):
+        self.flight = flight
         self._lock = threading.Lock()
         self._fail_count: Dict[str, int] = {}
         self._fail_rate: Dict[str, float] = {}
@@ -148,6 +349,7 @@ class FaultInjector:
         if delay:
             time.sleep(delay / 1e3)
         if fire:
+            self.flight.record("fault", site=site)
             raise InjectedFault(f"injected fault at site {site!r}")
 
     def stats(self) -> Dict[str, Tuple[int, int]]:
@@ -171,6 +373,14 @@ class RetryPolicy:
     backoff_ms: float = 10.0
     backoff_factor: float = 2.0
     retryable: Tuple[type, ...] = (TransientError,)
+    # telemetry seams: failed-attempt latencies observe into ``metrics``
+    # (H_RETRY_MS histogram; default the process-global registry), and an
+    # exhausted budget flushes the flight recorder's postmortem —
+    # compare=False keeps the policy's value semantics unchanged
+    metrics: Optional[object] = field(default=None, compare=False,
+                                      repr=False)
+    flight: object = field(default=NULL_FLIGHT_RECORDER, compare=False,
+                           repr=False)
 
     def __post_init__(self):
         if self.max_attempts < 1:
@@ -180,12 +390,31 @@ class RetryPolicy:
 
     def run(self, fn: Callable, *args, on_retry: Optional[Callable] = None,
             **kwargs):
+        metrics = self.metrics if self.metrics is not None \
+            else GLOBAL_METRICS
         delay = self.backoff_ms / 1e3
         for attempt in range(1, self.max_attempts + 1):
+            t0 = time.perf_counter()
             try:
                 return fn(*args, **kwargs)
             except self.retryable as e:
+                # the latency a retry COSTS — failed-attempt wall time —
+                # as a distribution, not a flat sum (telemetry must
+                # never raise into the retried operation)
+                try:
+                    ms = (time.perf_counter() - t0) * 1e3
+                    metrics.observe(H_RETRY_MS, ms)
+                    self.flight.record("retry", attempt=attempt,
+                                       error=repr(e)[:200], ms=round(ms, 3))
+                    from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+                    GLOBAL_TRACER.instant("retry", attempt=attempt,
+                                          error=repr(e)[:200])
+                except Exception:
+                    log.debug("retry telemetry failed", exc_info=True)
                 if attempt == self.max_attempts:
+                    self.flight.dump(
+                        f"retry budget exhausted after {attempt} "
+                        f"attempts: {e!r}")
                     raise
                 log.info("attempt %d/%d failed (%s); retrying in %.0f ms",
                          attempt, self.max_attempts, e, delay * 1e3)
@@ -195,10 +424,12 @@ class RetryPolicy:
                 delay *= self.backoff_factor
 
     @classmethod
-    def from_conf(cls, conf) -> "RetryPolicy":
+    def from_conf(cls, conf, metrics=None,
+                  flight=NULL_FLIGHT_RECORDER) -> "RetryPolicy":
         return cls(
             max_attempts=conf.get_int("failure.maxAttempts", 3),
             backoff_ms=conf.get_float("failure.backoffMs", 10.0),
+            metrics=metrics, flight=flight,
         )
 
 
@@ -212,9 +443,11 @@ class HealthMonitor:
     SPMD collectives hang (not error) on peer loss, so the probe runs a
     *per-device* op that cannot deadlock."""
 
-    def __init__(self, mesh, timeout_ms: float = 30_000.0):
+    def __init__(self, mesh, timeout_ms: float = 30_000.0,
+                 flight=NULL_FLIGHT_RECORDER):
         self.mesh = mesh
         self.timeout_ms = timeout_ms
+        self.flight = flight
 
     def probe(self) -> Dict[str, bool]:
         """{device_str: alive} via an independent tiny op per device."""
@@ -248,6 +481,8 @@ class HealthMonitor:
     def assert_healthy(self) -> None:
         bad = [d for d, ok in self.probe().items() if not ok]
         if bad:
+            self.flight.record("device_unhealthy", devices=bad)
+            self.flight.dump(f"DeviceUnhealthy: {bad}")
             raise DeviceUnhealthy(f"devices failed liveness probe: {bad}")
 
     @staticmethod

@@ -36,8 +36,10 @@ import jax
 from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.meta.registry import ShuffleRegistry
 from sparkucx_tpu.parallel.mesh import make_shuffle_mesh
-from sparkucx_tpu.runtime.failures import (EpochManager, FaultInjector,
-                                           HealthMonitor, RetryPolicy)
+from sparkucx_tpu.runtime.failures import (NULL_FLIGHT_RECORDER,
+                                           EpochManager, FaultInjector,
+                                           FlightRecorder, HealthMonitor,
+                                           RetryPolicy)
 from sparkucx_tpu.runtime.memory import HostMemoryPool
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.metrics import Metrics
@@ -99,13 +101,28 @@ class TpuNode:
         self.registry = ShuffleRegistry()
         self.metrics = Metrics()
         self.tracer = configure_from_conf(conf)
+        # Flight recorder (spark.shuffle.tpu.flightRecorder.enabled):
+        # created BEFORE the failure plane so the injector/retry/health
+        # pieces record into it. Enabling it implies span recording —
+        # a postmortem without a timeline answers nothing.
+        if conf.get_bool("flightRecorder.enabled", False):
+            self.flight = FlightRecorder(conf)
+            self.flight.metrics_sources.append(self.metrics)
+            self.metrics.add_reporter(self.flight.metrics_reporter)
+            self.tracer.enabled = True
+            self.flight.install_abort_hook()
+        else:
+            self.flight = NULL_FLIGHT_RECORDER
         # Failure plane (SURVEY.md §5 do-better): injection sites, bounded
         # retries, active liveness probing, epoch fencing for remesh.
-        self.faults = FaultInjector(conf)
-        self.retry_policy = RetryPolicy.from_conf(conf)
+        self.faults = FaultInjector(conf, flight=self.flight)
+        self.retry_policy = RetryPolicy.from_conf(
+            conf, metrics=self.metrics, flight=self.flight)
         self.health = HealthMonitor(
-            self.mesh, timeout_ms=conf.connection_timeout_ms)
+            self.mesh, timeout_ms=conf.connection_timeout_ms,
+            flight=self.flight)
         self.epochs = EpochManager()
+        self.epochs.on_bump(self.flight.on_epoch_bump)
         self._closed = False
         log.info("TpuNode up: %d devices, mesh axes %s",
                  len(jax.devices()), self.mesh.axis_names)
@@ -186,7 +203,8 @@ class TpuNode:
             raise RuntimeError("remesh with zero surviving devices")
         self.mesh = make_shuffle_mesh(devices, self.conf)
         self.health = HealthMonitor(
-            self.mesh, timeout_ms=self.conf.connection_timeout_ms)
+            self.mesh, timeout_ms=self.conf.connection_timeout_ms,
+            flight=self.flight)
         self.registry.clear()
         epoch = self.epochs.bump(reason or "remesh")
         log.warning("remesh: %d devices, epoch %d (%s)",
@@ -201,6 +219,9 @@ class TpuNode:
         if self._closed:
             return
         self._closed = True
+        self.flight.uninstall_abort_hook()
+        self.metrics.remove_reporter(self.flight.metrics_reporter)
+        self.epochs.remove_listener(self.flight.on_epoch_bump)
         self.registry.clear()
         self.pool.close()
         if self._distributed and self.conf.num_processes > 1:

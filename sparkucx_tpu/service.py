@@ -33,6 +33,41 @@ log = get_logger("service")
 IO_FORMATS = ("arrow", "raw")
 
 
+def _collect_stats(node: TpuNode, manager: TpuShuffleManager,
+                   format: str):
+    """One telemetry snapshot for a (node, manager) pair — counters,
+    histograms (live p50/p99), span summary, exchange reports — shared
+    by both facade generations so the scrape seam cannot drift with the
+    host-adapter contract. ``json`` returns the snapshot dict;
+    ``prometheus`` text exposition."""
+    from sparkucx_tpu.utils.export import (collect_snapshot,
+                                           render_prometheus)
+    from sparkucx_tpu.utils.metrics import GLOBAL_METRICS
+    doc = collect_snapshot(
+        [GLOBAL_METRICS, node.metrics], tracer=node.tracer,
+        reports=manager.exchange_reports())
+    if format == "json":
+        return doc
+    if format == "prometheus":
+        return render_prometheus(doc)
+    raise ValueError(f"unknown stats format {format!r}; "
+                     f"want json|prometheus")
+
+
+def _start_dumper(conf: TpuShuffleConf, stats_fn):
+    """Periodic metrics-snapshot dump thread, keyed by
+    ``spark.shuffle.tpu.metrics.dumpDir`` (off when unset) and
+    ``metrics.dumpIntervalSecs`` (default 60). Shared by both facade
+    generations — the dumper only needs a stats() callable."""
+    dump_dir = conf.get("spark.shuffle.tpu.metrics.dumpDir")
+    if not dump_dir:
+        return None
+    from sparkucx_tpu.utils.export import PeriodicDumper
+    interval = conf.get_float("metrics.dumpIntervalSecs", 60.0)
+    return PeriodicDumper(lambda: stats_fn("json"), dump_dir,
+                          interval).start()
+
+
 class ShuffleService:
     """The assembled stack behind one :func:`connect` call.
 
@@ -65,6 +100,7 @@ class ShuffleService:
         self._metrics_reporter = metrics_reporter
         if metrics_reporter is not None:
             self.node.metrics.add_reporter(metrics_reporter)
+        self._dumper = _start_dumper(conf, self.stats)
         log.info("ShuffleService up: io=%s, %d devices",
                  self.io_format, self.node.num_devices)
 
@@ -81,6 +117,9 @@ class ShuffleService:
         self.manager.unregister_shuffle(shuffle_id)
 
     def stop(self) -> None:
+        if self._dumper is not None:
+            self._dumper.stop()
+            self._dumper = None
         if self._metrics_reporter is not None:
             self.node.metrics.remove_reporter(self._metrics_reporter)
             self._metrics_reporter = None
@@ -89,6 +128,16 @@ class ShuffleService:
 
     # the name users reach for first; stop() is the Spark-SPI name
     close = stop
+
+    # -- telemetry (the scrape endpoint's data source) ---------------------
+    def stats(self, format: str = "json"):
+        """One snapshot of the whole telemetry plane (see
+        :func:`_collect_stats`). ``format="json"`` returns the snapshot
+        dict (what the periodic dumper writes and ``python -m
+        sparkucx_tpu stats`` re-renders); ``format="prometheus"`` text
+        exposition ready to serve from a /metrics endpoint or drop in a
+        textfile-collector dir."""
+        return _collect_stats(self.node, self.manager, format)
 
     def __enter__(self) -> "ShuffleService":
         return self

@@ -19,10 +19,14 @@ embeds the driver table's {address, rkey}
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import threading
+import time
 import weakref
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -40,8 +44,64 @@ from sparkucx_tpu.shuffle.reader import (
 )
 from sparkucx_tpu.shuffle.writer import MapOutputWriter
 from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
+                                        GLOBAL_METRICS, H_FETCH_WAIT,
+                                        H_PEER_BYTES, H_PEER_ROWS)
 
 log = get_logger("shuffle.manager")
+
+# Most-recent ExchangeReports the manager retains (keyed by shuffle id,
+# LRU-evicted) — bounded like every other telemetry ring.
+REPORT_CAPACITY = 64
+
+
+@dataclass
+class ExchangeReport:
+    """Structured postmortem of one shuffle read — the per-exchange unit
+    of the telemetry plane. Accumulated by the manager during
+    ``_submit_local`` / ``_submit_distributed`` (phases timed directly —
+    a report must exist even when the tracer is off), completed by the
+    read's exactly-once ``on_done``, and retrievable after the fact via
+    ``manager.report(shuffle_id)`` — the "explain this exchange without
+    a rerun" answer the reference's four log lines approximate.
+
+    ``group_ms`` spans dispatch-start to completion (the collective +
+    receive-side grouping); ``skew_ratio`` is max/mean partition rows
+    from the metadata table (per-peer rows in distributed mode, where no
+    single process holds the [M, R] table)."""
+
+    shuffle_id: int
+    num_maps: int
+    num_partitions: int
+    partitioner: str
+    process_id: int = 0
+    distributed: bool = False
+    hierarchical: bool = False
+    impl: str = ""
+    plan_ms: float = 0.0
+    pack_ms: float = 0.0
+    dispatch_ms: float = 0.0
+    group_ms: float = 0.0
+    rows_global: int = 0
+    rows_local: int = 0
+    bytes_local: int = 0
+    peer_rows: List[int] = field(default_factory=list)
+    peer_bytes: List[int] = field(default_factory=list)
+    skew_ratio: float = 0.0
+    retries: int = 0
+    stepcache_hits: int = 0
+    stepcache_programs: int = 0
+    plan_bucket: List[int] = field(default_factory=list)
+    completed: bool = False
+    error: Optional[str] = None
+    # bookkeeping, excluded from to_dict()
+    _t_dispatched: float = field(default=0.0, repr=False)
+    _hits0: float = field(default=0.0, repr=False)
+    _prog0: float = field(default=0.0, repr=False)
+
+    def to_dict(self) -> Dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if not k.startswith("_")}
 
 
 @dataclass
@@ -105,6 +165,12 @@ class TpuShuffleManager:
         self._inflight_cv = threading.Condition(self._lock)
         self._admit_queue: list = []   # FIFO tickets of deferred exchanges
         self._admit_ticket = 0
+        # Telemetry plane: latest ExchangeReport per shuffle id (LRU ring,
+        # survives unregister so a postmortem can still explain a shuffle
+        # that was torn down). The flight recorder pulls them at dump
+        # time through the exchange_reports context provider.
+        self._reports: "OrderedDict[int, ExchangeReport]" = OrderedDict()
+        self.node.flight.add_context_provider(self.exchange_reports)
         self._bind_mesh()
         # Elastic membership: a remesh (node.remesh) bumps the epoch; this
         # manager rebinds to the new mesh and drops writer state for the
@@ -202,6 +268,73 @@ class TpuShuffleManager:
             # read-drain wait too
             self._inflight_cv.notify_all()
         self._release_writer_batches(to_free)
+
+    # -- exchange reports (telemetry plane) --------------------------------
+    def _new_report(self, handle: ShuffleHandle,
+                    distributed: bool) -> ExchangeReport:
+        rep = ExchangeReport(
+            shuffle_id=handle.shuffle_id, num_maps=handle.num_maps,
+            num_partitions=handle.num_partitions,
+            partitioner=handle.partitioner,
+            process_id=self.node.process_id, distributed=distributed,
+            hierarchical=self.hierarchical)
+        # step-cache counters are process-global; the delta between read
+        # start and completion attributes compiles to this exchange
+        # (approximate under concurrent reads, exact in the common case)
+        rep._hits0 = GLOBAL_METRICS.get(COMPILE_HITS)
+        rep._prog0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+        with self._lock:
+            self._reports[handle.shuffle_id] = rep
+            self._reports.move_to_end(handle.shuffle_id)
+            while len(self._reports) > REPORT_CAPACITY:
+                self._reports.popitem(last=False)
+        return rep
+
+    def report(self, shuffle_id: int) -> Optional[ExchangeReport]:
+        """Latest ExchangeReport for a shuffle (None if never read or
+        evicted from the ring)."""
+        with self._lock:
+            return self._reports.get(shuffle_id)
+
+    def reports(self) -> List[ExchangeReport]:
+        """All retained reports, oldest first."""
+        with self._lock:
+            return list(self._reports.values())
+
+    def exchange_reports(self) -> List[Dict]:
+        """JSON-able view of the retained reports — the flight-recorder
+        context provider (its dump key is this method's name)."""
+        return [r.to_dict() for r in self.reports()]
+
+    def gather_reports(self, shuffle_id: int) -> List[Dict]:
+        """COLLECTIVE (distributed mode): allgather every process's
+        report for a shuffle so any process — process 0 for the operator
+        — holds the cluster-wide picture. Two allgather rounds (length,
+        then max-padded payload) over ``shuffle/distributed
+        .allgather_blob``, the same metadata-plane channel the schema
+        agreement rides. Single-process: the local report alone.
+
+        Every process must call it (the usual SPMD discipline); entries
+        are per-process dicts, empty for a process that never read the
+        shuffle."""
+        rep = self.report(shuffle_id)
+        local = rep.to_dict() if rep is not None else {}
+        if not self.node.is_distributed:
+            return [local] if local else []
+        from sparkucx_tpu.shuffle.distributed import allgather_blob
+        raw = np.frombuffer(json.dumps(local).encode(), dtype=np.uint8)
+        lens = allgather_blob(np.array([raw.size], dtype=np.int64))[:, 0]
+        cap = int(lens.max())
+        buf = np.zeros(cap, dtype=np.uint8)
+        buf[:raw.size] = raw
+        gathered = allgather_blob(buf)                  # [nproc, cap]
+        out = []
+        for row, n in zip(gathered, lens):
+            try:
+                out.append(json.loads(bytes(row[:int(n)]).decode()))
+            except ValueError:
+                out.append({})
+        return out
 
     # -- lifecycle --------------------------------------------------------
     def register_shuffle(self, shuffle_id: int, num_maps: int,
@@ -537,11 +670,14 @@ class TpuShuffleManager:
         if self.node.is_distributed:
             # collective: every process must pass the same combine/ordered
             # values (same SPMD discipline as calling read() at all)
-            with self.node.metrics.timeit("shuffle.read"):
+            # hist=H_FETCH_WAIT: the fetch-wait DISTRIBUTION per read —
+            # what Spark's incFetchWaitTime flattens into a sum
+            with self.node.metrics.timeit("shuffle.read",
+                                          hist=H_FETCH_WAIT):
                 return self._submit_distributed(
                     handle, timeout, combine=combine, ordered=ordered,
                     combine_sum_words=combine_sum_words).result()
-        with self.node.metrics.timeit("shuffle.read"):
+        with self.node.metrics.timeit("shuffle.read", hist=H_FETCH_WAIT):
             return self._submit_local(
                 handle, timeout, combine=combine, ordered=ordered,
                 combine_sum_words=combine_sum_words).result()
@@ -604,6 +740,19 @@ class TpuShuffleManager:
                       combine: Optional[str] = None,
                       ordered: bool = False,
                       combine_sum_words: int = 0):
+        # the report exists from read START: a read that dies in the
+        # metadata fetch must still be explainable from the postmortem
+        rep = self._new_report(handle, distributed=False)
+        try:
+            return self._submit_local_staged(
+                handle, timeout, combine, ordered, combine_sum_words, rep)
+        except BaseException as e:
+            rep.error = rep.error or repr(e)[:300]
+            raise
+
+    def _submit_local_staged(self, handle: ShuffleHandle, timeout: float,
+                             combine: Optional[str], ordered: bool,
+                             combine_sum_words: int, rep: ExchangeReport):
         tracer = self.node.tracer
         if not handle.entry.wait_complete(timeout):
             raise TimeoutError(
@@ -666,11 +815,13 @@ class TpuShuffleManager:
             nvalid = np.array(
                 [sum(k.shape[0] for k, _ in outs) for outs in shard_outputs],
                 dtype=np.int64)
+            t_plan = time.perf_counter()
             with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
                 plan = make_plan(nvalid, Pn, handle.num_partitions,
                                  self.conf, partitioner=handle.partitioner,
                                  bounds=handle.bounds)
                 plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
+            rep.plan_ms = (time.perf_counter() - t_plan) * 1e3
             plan = self._decorated_plan(plan, combine, ordered, has_vals,
                                         val_tail, val_dtype,
                                         combine_sum_words)
@@ -679,9 +830,13 @@ class TpuShuffleManager:
             # value casts — jnp would silently truncate int64 with x64 off)
             width = KEY_WORDS + (value_words(val_tail, val_dtype)
                                  if has_vals else 0)
+            self._report_volume(rep, plan, nvalid, width,
+                                part_rows=table.sizes.sum(axis=0))
+            t_pack = time.perf_counter()
             with tracer.span("shuffle.pack", rows=int(nvalid.sum())):
                 shard_rows, stage_buf = self._pack_shards(
                     shard_outputs, plan.cap_in, width, has_vals)
+            rep.pack_ms = (time.perf_counter() - t_pack) * 1e3
         finally:
             self._read_finished(read_gen)
 
@@ -693,7 +848,7 @@ class TpuShuffleManager:
 
         on_done, arm = self._arm_read_callbacks(
             stage_buf, release_admitted, handle,
-            int(nvalid.sum()), int(nvalid.sum()), width)
+            int(nvalid.sum()), int(nvalid.sum()), width, report=rep)
 
         # Buffer ownership: until a pending handle exists, failures here
         # (the fault site, compile errors inside the first dispatch) must
@@ -707,6 +862,7 @@ class TpuShuffleManager:
             # span covers DISPATCH only — the exchange itself completes
             # asynchronously inside result() (read() wraps that wait in
             # metrics "shuffle.read")
+            rep._t_dispatched = time.perf_counter()
             with tracer.span("shuffle.dispatch",
                              shuffle_id=handle.shuffle_id,
                              rows=int(nvalid.sum()), width=width,
@@ -736,6 +892,8 @@ class TpuShuffleManager:
                         self.exchange_mesh, self.axis, plan,
                         shard_rows, nvalid, vt, val_dtype,
                         on_done=on_done, admit=admit)
+            rep.dispatch_ms = (time.perf_counter()
+                               - rep._t_dispatched) * 1e3
             arm(pending)
             return pending
         except BaseException:
@@ -744,12 +902,44 @@ class TpuShuffleManager:
                 release_admitted()
             raise
 
+    def _report_volume(self, rep: ExchangeReport, plan: ShufflePlan,
+                       nvalid: np.ndarray, width: int,
+                       part_rows: Optional[np.ndarray] = None,
+                       local_rows: Optional[int] = None) -> None:
+        """Fill the report's volume/skew/plan fields and feed the
+        per-peer distribution histograms — one observation per peer per
+        exchange, the per-endpoint bytes log of the reference
+        (OnBlocksFetchCallback.java:55-56) as a live distribution."""
+        rep.impl = plan.impl
+        rep.plan_bucket = [int(plan.cap_in), int(plan.cap_out)]
+        # plain-python arithmetic over the (tiny, per-peer) lists: numpy
+        # reductions on 8-element arrays cost more in dispatch than the
+        # math, and this runs on every read (bench --stage obs-overhead)
+        rep.peer_rows = [int(x) for x in nvalid]
+        rep.peer_bytes = [r * width * 4 for r in rep.peer_rows]
+        rep.rows_global = sum(rep.peer_rows)
+        rep.rows_local = rep.rows_global if local_rows is None \
+            else int(local_rows)
+        rep.bytes_local = rep.rows_local * width * 4
+        if part_rows is not None:
+            skew_src = [int(x) for x in part_rows]
+        else:
+            skew_src = rep.peer_rows
+        mean = sum(skew_src) / len(skew_src) if skew_src else 0.0
+        rep.skew_ratio = max(skew_src) / mean if mean > 0 else 0.0
+        metrics = self.node.metrics
+        for r, b in zip(rep.peer_rows, rep.peer_bytes):
+            metrics.observe(H_PEER_ROWS, float(r))
+            metrics.observe(H_PEER_BYTES, float(b))
+
     def _arm_read_callbacks(self, stage_buf, release_admitted, handle,
-                            global_rows: int, local_rows: int, width: int):
+                            global_rows: int, local_rows: int, width: int,
+                            report: Optional[ExchangeReport] = None):
         """(on_done, arm) pair shared by the local and distributed submit
         paths: exactly-once pinned-buffer + admission release, capacity
-        learning, and the reporter counters (rows/bytes local to this
-        process; retries read from the pending handle). ``arm(pending)``
+        learning, the reporter counters (rows/bytes local to this
+        process; retries read from the pending handle), and
+        ExchangeReport completion. ``arm(pending)``
         records a WEAK reference — a strong one would cycle through
         on_done back to the pending and defer the __del__-based
         abandoned-handle release from refcounting to cyclic GC."""
@@ -769,11 +959,25 @@ class TpuShuffleManager:
                                       float(local_rows) * width * 4)
             ref = handle_box.get("pending")
             pend = ref() if ref is not None else None
-            if pend is not None and getattr(pend, "_attempt", 0):
+            retries = getattr(pend, "_attempt", 0) if pend is not None \
+                else 0
+            if retries:
                 # overflow retries this read paid (capacity growth) — the
                 # reporter-visible retry counter
-                self.node.metrics.inc("shuffle.retries",
-                                      float(pend._attempt))
+                self.node.metrics.inc("shuffle.retries", float(retries))
+            if report is not None:
+                if report._t_dispatched:
+                    report.group_ms = (time.perf_counter()
+                                       - report._t_dispatched) * 1e3
+                report.retries = int(retries)
+                report.stepcache_hits = int(
+                    GLOBAL_METRICS.get(COMPILE_HITS) - report._hits0)
+                report.stepcache_programs = int(
+                    GLOBAL_METRICS.get(COMPILE_PROGRAMS) - report._prog0)
+                if result is not None:
+                    report.completed = True
+                else:
+                    report.error = report.error or "exchange failed"
 
         def arm(pending):
             handle_box["pending"] = weakref.ref(pending)
@@ -967,6 +1171,18 @@ class TpuShuffleManager:
                             combine: Optional[str] = None,
                             ordered: bool = False,
                             combine_sum_words: int = 0):
+        rep = self._new_report(handle, distributed=True)
+        try:
+            return self._submit_distributed_impl(
+                handle, timeout, combine, ordered, combine_sum_words, rep)
+        except BaseException as e:
+            rep.error = rep.error or repr(e)[:300]
+            raise
+
+    def _submit_distributed_impl(self, handle: ShuffleHandle,
+                                 timeout: float, combine: Optional[str],
+                                 ordered: bool, combine_sum_words: int,
+                                 rep: ExchangeReport):
         """COLLECTIVE multi-process submit (shuffle/distributed.py);
         returns a PendingDistributedShuffle — result() is the other half
         of the collective. Map
@@ -1073,13 +1289,14 @@ class TpuShuffleManager:
                     f"unregister raced this read)")
             return self._submit_distributed_staged(
                 handle, writers, L, Pn, shard_ids, combine, ordered,
-                tracer, combine_sum_words)
+                tracer, combine_sum_words, rep)
         finally:
             self._read_finished(read_gen)
 
     def _submit_distributed_staged(self, handle, writers, L, Pn, shard_ids,
                                    combine, ordered, tracer,
-                                   combine_sum_words: int = 0):
+                                   combine_sum_words: int = 0,
+                                   rep: Optional[ExchangeReport] = None):
         from sparkucx_tpu.shuffle.distributed import (
             allgather_blob, allgather_sizes, submit_shuffle_distributed)
 
@@ -1122,6 +1339,7 @@ class TpuShuffleManager:
             dtype=np.int64)
         nvalid = allgather_sizes(nvalid_local, shard_ids, Pn)
         validate_row_sizes(nvalid.reshape(1, -1))
+        t_plan = time.perf_counter()
         with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
             plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
                              partitioner=handle.partitioner,
@@ -1129,14 +1347,25 @@ class TpuShuffleManager:
             # safe cross-process: every process runs the same collective
             # read sequence, so learned hints advance in lockstep
             plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
+        if rep is not None:
+            rep.plan_ms = (time.perf_counter() - t_plan) * 1e3
         plan = self._decorated_plan(plan, combine, ordered, has_vals,
                                     val_tail, val_dtype, combine_sum_words)
 
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)
+        if rep is not None:
+            # no process holds the [M, R] table here: skew comes from the
+            # allgathered per-peer rows (the cluster-wide view every
+            # process shares by construction)
+            self._report_volume(rep, plan, nvalid, width,
+                                local_rows=int(nvalid_local.sum()))
+        t_pack = time.perf_counter()
         with tracer.span("shuffle.pack", rows=int(nvalid_local.sum())):
             local_rows, stage_buf = self._pack_shards(
                 shard_outputs, plan.cap_in, width, has_vals)
+        if rep is not None:
+            rep.pack_ms = (time.perf_counter() - t_pack) * 1e3
 
         # Admission control — the footprint must be identical on every
         # process or defer decisions diverge and (timeout=None) the group
@@ -1155,13 +1384,15 @@ class TpuShuffleManager:
 
         on_done, arm = self._arm_read_callbacks(
             stage_buf, release_admitted, handle,
-            int(nvalid.sum()), int(nvalid_local.sum()), width)
+            int(nvalid.sum()), int(nvalid_local.sum()), width, report=rep)
 
         # same ownership rule as the local path: the armed handle is the
         # sole releaser of the pack buffer
         pending = None
         try:
             self.node.faults.check("exchange")
+            if rep is not None:
+                rep._t_dispatched = time.perf_counter()
             with tracer.span("shuffle.dispatch",
                              shuffle_id=handle.shuffle_id,
                              rows=int(nvalid.sum()), width=width,
@@ -1184,6 +1415,9 @@ class TpuShuffleManager:
                     hier_mesh=self.node.mesh if hier else None,
                     dcn_axis=self.conf.mesh_dcn_axis if hier else None,
                     on_done=on_done, admit=admit)
+            if rep is not None:
+                rep.dispatch_ms = (time.perf_counter()
+                                   - rep._t_dispatched) * 1e3
             arm(pending)
             return pending
         except BaseException:
@@ -1262,6 +1496,7 @@ class TpuShuffleManager:
         and its buffers are released anyway (shutdown must terminate)."""
         import time as _time
         self.node.epochs.remove_listener(self._on_epoch_bump)
+        self.node.flight.remove_context_provider(self.exchange_reports)
         deadline = _time.monotonic() + drain_timeout
         with self._inflight_cv:
             while self._active_reads:

@@ -36,7 +36,8 @@ from typing import Callable
 
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
-                                        COMPILE_SECONDS, GLOBAL_METRICS)
+                                        COMPILE_SECONDS, GLOBAL_METRICS,
+                                        H_COMPILE_SECS)
 from sparkucx_tpu.utils.trace import GLOBAL_TRACER
 
 log = get_logger("shuffle.stepcache")
@@ -69,6 +70,9 @@ class _TimedStep:
                         out = self._fn(*args, **kwargs)
                     secs = time.perf_counter() - t0
                     GLOBAL_METRICS.inc(COMPILE_SECONDS, secs)
+                    # the flat sum hides one 400 s program among twenty
+                    # 2 s ones; the distribution doesn't
+                    GLOBAL_METRICS.observe(H_COMPILE_SECS, secs)
                     log.debug("step first-call (compile+run) %.2fs: %s",
                               secs, self._attrs)
                     self._first = False

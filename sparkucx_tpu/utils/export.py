@@ -1,0 +1,186 @@
+"""Metric exporters — Prometheus text exposition + JSON snapshots.
+
+The reference's observability terminates in slf4j log lines; a production
+deployment of THIS stack is scraped, not grepped. This module renders one
+canonical snapshot document (counters, histograms, span summary, exchange
+reports) into:
+
+* Prometheus text exposition (``render_prometheus``) — counters, full
+  ``_bucket{le=...}`` histogram series, and companion ``_p50``/``_p99``/
+  ``_max`` gauges, ready for a scrape endpoint or textfile collector;
+* a JSON snapshot (``render_json``) — what the periodic dumper writes and
+  the ``python -m sparkucx_tpu stats`` CLI re-renders offline.
+
+Everything renders FROM the snapshot dict (not live objects), so a dump
+written by a dead process renders identically to a live scrape — the
+flight recorder (runtime/failures.py) leans on that for postmortems.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Union
+
+from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.metrics import Metrics
+from sparkucx_tpu.utils.trace import Tracer
+
+log = get_logger("export")
+
+PROM_PREFIX = "sparkucx_tpu_"
+_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def prom_name(name: str) -> str:
+    """Metric name -> Prometheus-legal series name (dots/dashes become
+    underscores, namespaced under ``sparkucx_tpu_``)."""
+    return PROM_PREFIX + _BAD_CHARS.sub("_", name)
+
+
+def _fmt(v: float) -> str:
+    """Float -> exposition literal. Prometheus accepts 'Inf'/'+Inf';
+    integral values render without a trailing .0 for stable goldens."""
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    if float(v) == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def collect_snapshot(metrics: Union[Metrics, Iterable[Metrics]],
+                     tracer: Optional[Tracer] = None,
+                     reports: Optional[List[Dict]] = None,
+                     extra: Optional[Dict] = None) -> Dict:
+    """Build the canonical snapshot document.
+
+    ``metrics`` may be one registry or several (the node's registry plus
+    the process-global one the step cache reports into) — counters and
+    histograms merge, later registries winning name collisions."""
+    if isinstance(metrics, Metrics):
+        metrics = [metrics]
+    counters: Dict[str, float] = {}
+    histograms: Dict[str, Dict] = {}
+    for m in metrics:
+        counters.update(m.snapshot())
+        histograms.update(m.histograms())
+    doc = {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "counters": counters,
+        "histograms": histograms,
+    }
+    if tracer is not None:
+        doc["spans"] = tracer.summary()
+        doc["dropped_spans"] = tracer.dropped
+    if reports is not None:
+        doc["exchange_reports"] = reports
+    if extra:
+        doc.update(extra)
+    return doc
+
+
+def render_json(doc: Dict, indent: int = 1) -> str:
+    return json.dumps(doc, indent=indent, sort_keys=True, default=repr)
+
+
+def render_prometheus(doc: Dict) -> str:
+    """Snapshot document -> Prometheus text exposition (format 0.0.4).
+
+    Counters export as ``counter``; histograms as a full cumulative
+    ``_bucket`` series + ``_sum``/``_count`` plus ``_p50``/``_p99``/
+    ``_max`` companion gauges (quantiles are not part of the histogram
+    exposition type, and forcing a dashboard to compute
+    histogram_quantile() before a human can read p99 defeats the
+    point of carrying it live)."""
+    lines: List[str] = []
+    for name in sorted(doc.get("counters", {})):
+        n = prom_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_fmt(doc['counters'][name])}")
+    for name in sorted(doc.get("histograms", {})):
+        h = doc["histograms"][name]
+        n = prom_name(name)
+        lines.append(f"# TYPE {n} histogram")
+        for le, cum in h.get("buckets", []):
+            lines.append(f'{n}_bucket{{le="{_fmt(float(le))}"}} {int(cum)}')
+        lines.append(f"{n}_sum {_fmt(h.get('sum', 0.0))}")
+        lines.append(f"{n}_count {int(h.get('count', 0))}")
+        for q in ("p50", "p99", "max"):
+            lines.append(f"# TYPE {n}_{q} gauge")
+            lines.append(f"{n}_{q} {_fmt(h.get(q, 0.0))}")
+    # span summary rides as gauges so a scrape sees phase timings without
+    # needing the chrome trace (one family per aggregate field)
+    for name in sorted(doc.get("spans", {})):
+        agg = doc["spans"][name]
+        n = prom_name("span." + name)
+        for field in ("count", "mean_ms", "p50_ms", "p99_ms", "max_ms"):
+            if field in agg:
+                lines.append(f"# TYPE {n}_{field} gauge")
+                lines.append(f"{n}_{field} {_fmt(agg[field])}")
+    return "\n".join(lines) + "\n"
+
+
+def write_snapshot(doc: Dict, path: str) -> str:
+    """Atomic JSON snapshot write (tmp + rename): a scraper of the dump
+    directory must never read a torn file. The tmp name carries the
+    thread id too — PeriodicDumper.stop()'s final dump can overlap a
+    still-running background dump of the same path."""
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+    with open(tmp, "w") as f:
+        f.write(render_json(doc))
+    os.replace(tmp, path)
+    return path
+
+
+class PeriodicDumper:
+    """Background metrics-snapshot writer, keyed by the conf pair
+    ``spark.shuffle.tpu.metrics.dumpDir`` / ``metrics.dumpIntervalSecs``
+    (service.py wires it). One rolling file per process
+    (``metrics_<pid>.json``, atomic replace) — the textfile-collector /
+    sidecar-scrape integration for engines that cannot host an HTTP
+    endpoint. Failures are swallowed and logged once: observability must
+    never fail a shuffle."""
+
+    def __init__(self, collect, out_dir: str, interval_s: float):
+        self._collect = collect
+        self._dir = out_dir
+        self._interval = max(0.1, float(interval_s))
+        self._stop = threading.Event()
+        self._warned = False
+        self._thread = threading.Thread(
+            target=self._run, name="sparkucx-metrics-dump", daemon=True)
+
+    @property
+    def path(self) -> str:
+        return os.path.join(self._dir, f"metrics_{os.getpid()}.json")
+
+    def start(self) -> "PeriodicDumper":
+        self._thread.start()
+        return self
+
+    def dump_once(self) -> Optional[str]:
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            return write_snapshot(self._collect(), self.path)
+        except Exception:
+            if not self._warned:
+                self._warned = True
+                log.exception("metrics dump to %s failed; further "
+                              "failures are silenced", self._dir)
+            return None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self.dump_once()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+        self.dump_once()   # final snapshot so a clean stop leaves state

@@ -1,4 +1,4 @@
-"""Lightweight metrics + timers.
+"""Lightweight metrics: counters, histograms, timers.
 
 The reference has targeted latency logging rather than a tracer: map-publish
 overhead per mapId (ref: CommonUcxShuffleBlockResolver.scala:105-106),
@@ -7,15 +7,20 @@ fetch bytes+ms (ref: OnBlocksFetchCallback.java:55-56), and fetch-wait time
 fed into Spark's ShuffleReadMetricsReporter
 (ref: compat/spark_3_0/UcxShuffleReader.scala:84-87). This module provides
 the same spirit as in-process counters/timers that the manager/reader report
-into, plus a context-manager timer."""
+into, plus fixed log-bucket :class:`Histogram` metrics for the quantities
+where a flat counter is lossy (fetch-wait per read, per-peer bytes, retry
+latencies, compile seconds) — the p50/p99 half of the reference's per-fetch
+latency log becomes a live queryable distribution instead of grep fodder.
+"""
 
 from __future__ import annotations
 
 import contextlib
+import math
 import threading
 import time
 from collections import defaultdict
-from typing import Dict
+from typing import Dict, List, Optional, Tuple
 
 
 # Compile-cost observability (shuffle/stepcache.py, bench --stage
@@ -24,6 +29,125 @@ from typing import Dict
 COMPILE_PROGRAMS = "compile.step.programs"   # distinct step programs built
 COMPILE_HITS = "compile.step.hits"           # step-cache lookups served
 COMPILE_SECONDS = "compile.step.seconds"     # first-invocation wall secs
+
+# Histogram names — the telemetry plane's distribution metrics. Declared
+# here (not at the observation sites) for the same no-spelling-drift
+# reason as the compile counters; every registry pre-creates them so an
+# exporter always has the full surface even before the first shuffle.
+H_FETCH_WAIT = "shuffle.read.wait_ms"        # per-read fetch-wait (ms)
+H_PEER_ROWS = "shuffle.peer.rows"            # rows per peer per exchange
+H_PEER_BYTES = "shuffle.peer.bytes"          # bytes per peer per exchange
+H_RETRY_MS = "failure.retry.ms"              # failed-attempt latency (ms)
+H_COMPILE_SECS = "compile.step.duration_s"   # per-program compile seconds
+
+WELL_KNOWN_HISTOGRAMS = (H_FETCH_WAIT, H_PEER_ROWS, H_PEER_BYTES,
+                         H_RETRY_MS, H_COMPILE_SECS)
+
+
+class Histogram:
+    """Thread-safe fixed log-bucket histogram with live p50/p99/max.
+
+    Buckets are a fixed geometric ladder ``GROWTH**k`` (8 per octave, so
+    consecutive bucket bounds differ by ~9%); an observation lands in the
+    smallest bucket whose upper bound covers it. Quantiles interpolate at
+    the geometric midpoint of the hit bucket clipped to the observed
+    [min, max], bounding relative quantile error by half a bucket (~4.5%)
+    — the trade the reference's per-fetch log line can't make (exact
+    values, but only in a log file). Memory is O(occupied buckets): a
+    sparse dict, ~no cost until observed."""
+
+    GROWTH = 2.0 ** 0.125
+    _LOG_G = math.log(GROWTH)
+
+    __slots__ = ("name", "_lock", "_counts", "_nonpos", "count", "sum",
+                 "min", "max")
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counts: Dict[int, int] = {}
+        self._nonpos = 0          # observations <= 0 (their own bucket)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+
+    def _index(self, value: float) -> int:
+        # smallest k with GROWTH**k >= value
+        return int(math.ceil(math.log(value) / self._LOG_G - 1e-9))
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            if value < self.min:
+                self.min = value
+            if value > self.max:
+                self.max = value
+            if value <= 0.0:
+                self._nonpos += 1
+            else:
+                idx = self._index(value)
+                self._counts[idx] = self._counts.get(idx, 0) + 1
+
+    def quantile(self, q: float) -> float:
+        """Estimated q-quantile (0 <= q <= 1) of everything observed."""
+        with self._lock:
+            return self._quantile_locked(q)
+
+    def _quantile_locked(self, q: float) -> float:
+        if self.count == 0:
+            return 0.0
+        target = q * self.count
+        cum = self._nonpos
+        if cum >= target and self._nonpos:
+            return min(self.min, 0.0)
+        for idx in sorted(self._counts):
+            cum += self._counts[idx]
+            if cum >= target:
+                lo = self.GROWTH ** (idx - 1)
+                hi = self.GROWTH ** idx
+                est = math.sqrt(lo * hi)    # geometric midpoint
+                return min(max(est, self.min), self.max)
+        return self.max
+
+    def percentiles(self) -> Dict[str, float]:
+        with self._lock:
+            if self.count == 0:
+                return {"count": 0.0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                        "mean": 0.0, "p50": 0.0, "p99": 0.0}
+            return {
+                "count": float(self.count),
+                "sum": self.sum,
+                "min": self.min,
+                "max": self.max,
+                "mean": self.sum / self.count,
+                "p50": self._quantile_locked(0.50),
+                "p99": self._quantile_locked(0.99),
+            }
+
+    def buckets(self) -> List[Tuple[float, int]]:
+        """Cumulative ``(upper_bound, count_leq)`` pairs over occupied
+        buckets plus the +Inf terminal — the Prometheus histogram series
+        shape (utils/export.py renders these as ``_bucket{le=...}``)."""
+        with self._lock:
+            out: List[Tuple[float, int]] = []
+            cum = self._nonpos
+            if self._nonpos:
+                out.append((0.0, cum))
+            for idx in sorted(self._counts):
+                cum += self._counts[idx]
+                out.append((self.GROWTH ** idx, cum))
+            out.append((math.inf, self.count))
+            return out
+
+    def snapshot(self) -> Dict:
+        """percentiles() plus the bucket series — the JSON-able full
+        state an exporter or flight-recorder dump embeds."""
+        snap = self.percentiles()
+        snap["buckets"] = [[le, c] for le, c in self.buckets()]
+        return snap
 
 
 class Timer:
@@ -56,6 +180,11 @@ class Metrics:
         self._counters: Dict[str, float] = defaultdict(float)
         self._reporters = []
         self._broken = set()
+        # pre-create the declared distribution metrics so exporters and
+        # scrapes see the full surface (with zero counts) from process
+        # start — a dashboard query must not 404 until the first shuffle
+        self._histograms: Dict[str, Histogram] = {
+            name: Histogram(name) for name in WELL_KNOWN_HISTOGRAMS}
 
     def add_reporter(self, fn) -> None:
         """Attach fn(name: str, value: float), called on every inc()."""
@@ -69,10 +198,7 @@ class Metrics:
             except ValueError:
                 pass
 
-    def inc(self, name: str, value: float = 1.0) -> None:
-        with self._lock:
-            self._counters[name] += value
-            reporters = list(self._reporters)
+    def _report(self, name: str, value: float, reporters) -> None:
         for fn in reporters:
             try:
                 fn(name, value)
@@ -84,22 +210,67 @@ class Metrics:
                         "metrics reporter %r raised; further failures "
                         "from it are silenced", fn)
 
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+            reporters = list(self._reporters)
+        self._report(name, value, reporters)
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one observation into the named histogram (created on
+        first use). Reporters see it through the same fn(name, value)
+        seam as counters — the push-style integration is one channel.
+
+        Fast path: histogram exists and no reporters attached — both
+        reads are GIL-atomic (histogram entries are never deleted, only
+        added under the lock), so the registry lock is skipped and the
+        cost is one dict lookup + the histogram's own update. This is
+        the common case on the read hot path and the reason the
+        disabled-telemetry overhead stays <1% (bench --stage
+        obs-overhead)."""
+        h = self._histograms.get(name)
+        if h is not None and not self._reporters:
+            h.observe(value)
+            return
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name)
+            reporters = list(self._reporters)
+        h.observe(value)
+        self._report(name, value, reporters)
+
     def get(self, name: str) -> float:
         with self._lock:
             return self._counters.get(name, 0.0)
+
+    def histogram(self, name: str) -> Optional[Histogram]:
+        with self._lock:
+            return self._histograms.get(name)
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             return dict(self._counters)
 
+    def histograms(self) -> Dict[str, Dict]:
+        """{name: Histogram.snapshot()} — the exporter-facing view."""
+        with self._lock:
+            hists = list(self._histograms.items())
+        return {name: h.snapshot() for name, h in hists}
+
     @contextlib.contextmanager
-    def timeit(self, name: str):
+    def timeit(self, name: str, hist: Optional[str] = None):
+        """Counter timer; ``hist=<histogram name>`` additionally observes
+        the wall ms into that distribution (fetch-wait and friends)."""
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.inc(name + ".ms", (time.perf_counter() - t0) * 1e3)
+            ms = (time.perf_counter() - t0) * 1e3
+            self.inc(name + ".ms", ms)
             self.inc(name + ".count", 1)
+            if hist is not None:
+                self.observe(hist, ms)
 
 
 GLOBAL_METRICS = Metrics()

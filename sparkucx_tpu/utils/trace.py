@@ -163,7 +163,26 @@ class Tracer:
 
     @property
     def dropped(self) -> int:
-        return self._dropped
+        # under the lock: an unsynchronized read can observe a torn
+        # update relative to the span append it pairs with (_record holds
+        # the lock for both), so exporters could report a drop count that
+        # disagrees with the buffer they just copied
+        with self._lock:
+            return self._dropped
+
+    def resize(self, capacity: int) -> None:
+        """Resize the span ring, preserving buffered spans (newest-first
+        within the new capacity) and the drop count — spans discarded by
+        a shrink are counted as dropped, same no-silent-truncation policy
+        as the ring itself. One atomic mutation under the lock: a
+        concurrent _record must never see capacity and deque disagree."""
+        with self._lock:
+            if capacity == self._capacity:
+                return
+            discarded = max(0, len(self._spans) - capacity)
+            self._dropped += discarded
+            self._capacity = capacity
+            self._spans = deque(self._spans, maxlen=capacity)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
         """Per-name {count, total_ms, mean_ms, p50_ms, p99_ms, max_ms}
@@ -193,22 +212,27 @@ class Tracer:
             self._dropped = 0
 
     # -- export -----------------------------------------------------------
+    def chrome_events(self) -> List[Dict[str, Any]]:
+        """The span buffer as Chrome trace-event dicts (the 'X' events of
+        a ``traceEvents`` list) — shared by the file export and the
+        flight recorder's postmortem embed."""
+        return [{
+            "name": s.name, "ph": "X", "ts": s.start_us, "dur": s.dur_us,
+            "pid": 0, "tid": s.tid,
+            "args": {k: _jsonable(v) for k, v in s.attrs.items()},
+        } for s in self.spans()]
+
     def export_chrome_trace(self, path: str) -> int:
         """Write the span buffer as a Chrome trace-event JSON file, loadable
         in Perfetto / chrome://tracing. Returns the number of events."""
-        events = []
-        for s in self.spans():
-            events.append({
-                "name": s.name, "ph": "X", "ts": s.start_us, "dur": s.dur_us,
-                "pid": 0, "tid": s.tid,
-                "args": {k: _jsonable(v) for k, v in s.attrs.items()},
-            })
+        events = self.chrome_events()
         doc = {"traceEvents": events, "displayTimeUnit": "ms"}
         with open(path, "w") as f:
             json.dump(doc, f)
-        if self._dropped:
+        dropped = self.dropped
+        if dropped:
             log.warning("trace export dropped %d spans (capacity %d)",
-                        self._dropped, self._capacity)
+                        dropped, self._capacity)
         return len(events)
 
     # -- device (XLA) traces ----------------------------------------------
@@ -253,9 +277,5 @@ def configure_from_conf(conf) -> Tracer:
     """
     GLOBAL_TRACER.enabled = conf.get_bool("trace.enabled", False)
     GLOBAL_TRACER.annotate_device = conf.get_bool("trace.device", False)
-    cap = conf.get_int("trace.capacity", 65536)
-    if cap != GLOBAL_TRACER._capacity:
-        with GLOBAL_TRACER._lock:
-            GLOBAL_TRACER._capacity = cap
-            GLOBAL_TRACER._spans = deque(GLOBAL_TRACER._spans, maxlen=cap)
+    GLOBAL_TRACER.resize(conf.get_int("trace.capacity", 65536))
     return GLOBAL_TRACER

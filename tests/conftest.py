@@ -48,6 +48,42 @@ def pytest_configure(config):
         " bench's stage_native_aot still execute them")
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """CI telemetry artifact: when the suite FAILS and
+    SPARKUCX_TPU_CI_TELEMETRY_DIR is set (.github/workflows/ci.yml), write
+    a metrics snapshot and a flight-recorder postmortem there so the
+    workflow can upload them — the round-5 outages were diagnosed from
+    ad-hoc logs precisely because nothing did this."""
+    out = os.environ.get("SPARKUCX_TPU_CI_TELEMETRY_DIR")
+    if not out or exitstatus == 0:
+        return
+    try:
+        os.makedirs(out, exist_ok=True)
+        from sparkucx_tpu.runtime.failures import FlightRecorder
+        from sparkucx_tpu.runtime.node import TpuNode
+        from sparkucx_tpu.utils.export import (collect_snapshot,
+                                               write_snapshot)
+        from sparkucx_tpu.utils.metrics import GLOBAL_METRICS
+        from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+        rec = FlightRecorder(out_dir=out)
+        metrics = [GLOBAL_METRICS]
+        node = TpuNode._instance
+        if node is not None and not node._closed:
+            rec.metrics_sources.append(node.metrics)
+            metrics.append(node.metrics)
+            # a live enabled recorder has the richer event ring — flush
+            # it INTO the upload dir (its own out_dir is a temp path the
+            # workflow never uploads)
+            node.flight.out_dir = out
+            node.flight.dump(f"tier-1 failure (exit {exitstatus})")
+        rec.dump(f"tier-1 failure (exit {exitstatus})")
+        doc = collect_snapshot(metrics, tracer=GLOBAL_TRACER)
+        doc["pytest_exitstatus"] = int(exitstatus)
+        write_snapshot(doc, os.path.join(out, "metrics_snapshot.json"))
+    except Exception as e:  # artifact collection must never mask the run
+        print(f"[conftest] telemetry artifact collection failed: {e!r}")
+
+
 def pytest_collection_modifyitems(config, items):
     if TPU_MODE:
         skip = pytest.mark.skip(

@@ -90,3 +90,23 @@ def test_coldstart_bucket_sweep_small():
     # strictly-fewer
     assert rec["compiles_bucketing_on"] < \
         rec["compiles_bucketing_off"], rec
+
+
+def test_obs_overhead_measure_small(mesh8):
+    """The obs-overhead stage's measurement core at a tiny shape: hook
+    accounting present, estimate positive, and the A/B medians sane.
+    The <1% gate itself is the bench stage's contract (run at the full
+    shape with interleaved reps); asserting it here would couple the
+    suite to shared-CI load noise."""
+    rec = bench.obs_overhead_measure(exchanges=6, rows_per_map=256,
+                                     maps=2, partitions=4, reps=1)
+    counts = rec["hook_counts_per_exchange"]
+    assert counts["inc"] > 0 and counts["observe"] > 0 \
+        and counts["span"] > 0
+    assert rec["telemetry_us_per_exchange"] > 0
+    assert set(rec["median_exchange_ms"]) == {"noop", "disabled",
+                                              "enabled"}
+    assert all(v > 0 for v in rec["median_exchange_ms"].values())
+    assert rec["overhead_disabled_pct"] >= 0
+    # the disabled-path estimate must be microseconds, not milliseconds
+    assert rec["telemetry_us_per_exchange"] < 1000

@@ -258,3 +258,32 @@ def test_v2_partition_readers_share_one_exchange(base_conf):
         svc.unregister(18)
         # unregister invalidated the cached result
         assert 18 not in svc._results
+
+
+def test_v2_cached_readers_record_their_own_fetch_wait(base_conf):
+    """Each PartitionReader records its OWN fetch wait: the dispatcher
+    through the manager's read histogram, every cached reader through
+    the facade's cached path — N readers produce N observations, the
+    per-reduce-task accounting Spark's reporter contract implies."""
+    from sparkucx_tpu.utils.metrics import H_FETCH_WAIT
+    conf = dict(base_conf, **{"spark.shuffle.tpu.compat.version": "v2"})
+    with sparkucx_tpu.connect(conf, use_env=False) as svc:
+        R, M = 8, 2
+        h = svc.register(ShuffleDependency(19, M, R))
+        rng = np.random.default_rng(7)
+        for m in range(M):
+            w = svc.writer(h, m)
+            w.write(rng.integers(0, 1 << 31, size=100).astype(np.int64))
+            w.commit()
+        hist = svc.node.metrics.histogram(H_FETCH_WAIT)
+        assert hist.count == 0
+        readers = R
+        for r in range(readers):
+            list(svc.reader(h, r, r + 1))
+        # 1 dispatching reader (manager.read) + (R-1) cached readers
+        assert hist.count == readers
+        assert svc.node.metrics.get("shuffle.read.cached.count") == \
+            readers - 1
+        # still ONE collective underneath
+        assert svc.node.metrics.get("shuffle.read.count") == 1
+        svc.unregister(19)

@@ -1,0 +1,196 @@
+"""Crash flight recorder (runtime/failures.FlightRecorder): event ring,
+postmortem triggers (retry-budget exhaustion via FaultInjector,
+DeviceUnhealthy), dump contents (ExchangeReport + chrome-trace spans +
+metrics), null-object cost when disabled, and the retry-latency
+histogram the policy feeds."""
+
+import json
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.runtime.failures import (NULL_FLIGHT_RECORDER,
+                                           DeviceUnhealthy, FaultInjector,
+                                           FlightRecorder, InjectedFault,
+                                           RetryPolicy, TransientError)
+from sparkucx_tpu.utils.metrics import H_RETRY_MS, Metrics
+
+
+def _flight_conf(tmp_path, extra=None):
+    conf_map = {
+        "spark.shuffle.tpu.a2a.impl": "dense",
+        "spark.shuffle.tpu.flightRecorder.enabled": "true",
+        "spark.shuffle.tpu.flightRecorder.dir": str(tmp_path / "flight"),
+    }
+    conf_map.update(extra or {})
+    return conf_map
+
+
+def test_ring_is_bounded_and_records_kinds(tmp_path):
+    rec = FlightRecorder(capacity=4, out_dir=str(tmp_path))
+    for i in range(10):
+        rec.record("metric", name="x", value=float(i))
+    rec.on_epoch_bump(3)
+    path = rec.dump("test")
+    doc = json.loads(open(path).read())
+    assert len(doc["events"]) == 4               # ring bound
+    assert doc["events"][-1]["kind"] == "epoch"
+    assert doc["reason"] == "test"
+
+
+def test_null_recorder_is_noop(tmp_path):
+    n = NULL_FLIGHT_RECORDER
+    n.record("x")
+    n.metrics_reporter("a", 1.0)
+    n.on_epoch_bump(1)
+    assert n.dump("whatever") is None
+    assert not list(tmp_path.iterdir())
+
+
+def test_retry_budget_exhaustion_dumps_and_observes_latency(tmp_path):
+    metrics = Metrics()
+    rec = FlightRecorder(out_dir=str(tmp_path))
+    policy = RetryPolicy(max_attempts=3, backoff_ms=1.0,
+                         metrics=metrics, flight=rec)
+
+    def always_fails():
+        raise TransientError("nope")
+
+    with pytest.raises(TransientError):
+        policy.run(always_fails)
+    assert len(rec.dumps) == 1
+    doc = json.loads(open(rec.dumps[0]).read())
+    assert "retry budget exhausted" in doc["reason"]
+    retries = [e for e in doc["events"] if e["kind"] == "retry"]
+    assert len(retries) == 3                     # every failed attempt
+    assert metrics.histogram(H_RETRY_MS).count == 3
+
+
+def test_retry_success_does_not_dump(tmp_path):
+    rec = FlightRecorder(out_dir=str(tmp_path))
+    policy = RetryPolicy(max_attempts=3, backoff_ms=1.0, flight=rec)
+    calls = []
+
+    def fails_once():
+        calls.append(1)
+        if len(calls) == 1:
+            raise TransientError("transient")
+        return "ok"
+
+    assert policy.run(fails_once) == "ok"
+    assert rec.dumps == []
+
+
+def test_injected_fault_postmortem_contains_report_and_spans(
+        manager_factory, rng, tmp_path):
+    """The acceptance scenario: a FaultInjector-injected fault exhausts
+    the retry budget; the dump contains the failing shuffle's
+    ExchangeReport and chrome-trace spans."""
+    mgr = manager_factory(_flight_conf(tmp_path, {
+        "spark.shuffle.tpu.failure.maxAttempts": "2",
+        "spark.shuffle.tpu.failure.backoffMs": "1",
+    }))
+    node = mgr.node
+    assert node.flight is not NULL_FLIGHT_RECORDER
+    assert node.tracer.enabled          # recorder implies span recording
+
+    # a healthy read first, so spans + a completed report exist
+    h = mgr.register_shuffle(31, 2, 4)
+    for m in range(2):
+        w = mgr.get_writer(h, m)
+        w.write(rng.integers(0, 1 << 30, size=64, dtype=np.int64))
+        w.commit(4)
+    mgr.read(h)
+
+    node.faults.arm("fetch", fail_count=10)
+    h2 = mgr.register_shuffle(32, 2, 4)
+    for m in range(2):
+        w = mgr.get_writer(h2, m)
+        w.write(rng.integers(0, 1 << 30, size=16, dtype=np.int64))
+        w.commit(4)
+    with pytest.raises(InjectedFault):
+        mgr.read(h2)
+    node.faults.disarm("fetch")
+
+    assert len(node.flight.dumps) == 1
+    doc = json.loads(open(node.flight.dumps[0]).read())
+    reports = doc["contexts"]["exchange_reports"]
+    assert any(r["shuffle_id"] == 32 for r in reports)   # the failing one
+    assert any(r["shuffle_id"] == 31 and r["completed"]
+               for r in reports)
+    assert doc["trace_events"], "postmortem must carry chrome spans"
+    names = {e["name"] for e in doc["trace_events"]}
+    assert "shuffle.dispatch" in names          # the healthy read's spans
+    assert "retry" in names                     # the failing read's marks
+    assert [e for e in doc["events"] if e["kind"] == "fault"]
+    assert doc["counters"]["shuffle.read.count"] >= 1
+    assert "shuffle.read.wait_ms" in doc["histograms"]
+
+
+def test_device_unhealthy_dumps(tmp_path, mesh8, monkeypatch):
+    from sparkucx_tpu.runtime.failures import HealthMonitor
+    rec = FlightRecorder(out_dir=str(tmp_path))
+    mon = HealthMonitor(mesh8, flight=rec)
+    monkeypatch.setattr(mon, "probe", lambda: {"TPU_0": False})
+    with pytest.raises(DeviceUnhealthy):
+        mon.assert_healthy()
+    assert len(rec.dumps) == 1
+    doc = json.loads(open(rec.dumps[0]).read())
+    assert "DeviceUnhealthy" in doc["reason"]
+    assert any(e["kind"] == "device_unhealthy" for e in doc["events"])
+
+
+def test_fault_injector_records_into_recorder(tmp_path):
+    rec = FlightRecorder(out_dir=str(tmp_path))
+    inj = FaultInjector(flight=rec)
+    inj.arm("site1", fail_count=1)
+    with pytest.raises(InjectedFault):
+        inj.check("site1")
+    path = rec.dump("after")
+    doc = json.loads(open(path).read())
+    assert [e for e in doc["events"]
+            if e["kind"] == "fault" and e["site"] == "site1"]
+
+
+def test_epoch_bump_and_metric_deltas_in_ring(manager_factory, rng,
+                                              tmp_path):
+    mgr = manager_factory(_flight_conf(tmp_path))
+    node = mgr.node
+    h = mgr.register_shuffle(41, 2, 4)
+    for m in range(2):
+        w = mgr.get_writer(h, m)
+        w.write(rng.integers(0, 1 << 30, size=32, dtype=np.int64))
+        w.commit(4)
+    mgr.read(h)
+    node.remesh(devices=list(node.mesh.devices.reshape(-1)),
+                reason="test bump")
+    path = node.flight.dump("inspect")
+    doc = json.loads(open(path).read())
+    kinds = {e["kind"] for e in doc["events"]}
+    assert "metric" in kinds and "epoch" in kinds
+    metric_names = {e["name"] for e in doc["events"]
+                    if e["kind"] == "metric"}
+    assert "shuffle.rows" in metric_names
+
+
+def test_abort_hook_installs_and_uninstalls(tmp_path):
+    import sys
+    prev = sys.excepthook
+    rec = FlightRecorder(out_dir=str(tmp_path))
+    rec.install_abort_hook()
+    assert sys.excepthook is not prev
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        sys.excepthook(*sys.exc_info())          # simulate the abort path
+    assert len(rec.dumps) == 1
+    assert "unhandled ValueError" in json.loads(
+        open(rec.dumps[0]).read())["reason"]
+    rec.uninstall_abort_hook()
+    assert sys.excepthook is prev
+
+
+def test_dump_never_raises(tmp_path, monkeypatch):
+    rec = FlightRecorder(out_dir="/proc/definitely/not/writable")
+    assert rec.dump("x") is None                 # swallowed, logged once

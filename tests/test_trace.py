@@ -125,6 +125,63 @@ def test_configure_from_conf():
         tr.clear()
 
 
+def test_resize_shrink_counts_discards_as_dropped():
+    """A capacity shrink discards the oldest buffered spans — those must
+    land in the drop count (no silent truncation), and the count must
+    survive the resize."""
+    t = Tracer(enabled=True, capacity=8)
+    for i in range(10):
+        t.instant(f"e{i}")
+    assert t.dropped == 2
+    t.resize(4)
+    assert len(t.spans()) == 4
+    assert t.dropped == 2 + 4          # prior drops + shrink discards
+    assert [s.name for s in t.spans()] == ["e6", "e7", "e8", "e9"]
+    t.resize(4)                        # no-op resize changes nothing
+    assert t.dropped == 6
+
+
+def test_configure_from_conf_resize_preserves_drop_count():
+    conf = TpuShuffleConf({"spark.shuffle.tpu.trace.enabled": "true",
+                           "spark.shuffle.tpu.trace.capacity": "8"},
+                          use_env=False)
+    tr = configure_from_conf(conf)
+    try:
+        tr.clear()
+        for i in range(12):
+            tr.instant(f"e{i}")
+        assert tr.dropped == 4
+        conf.set("spark.shuffle.tpu.trace.capacity", "4")
+        tr2 = configure_from_conf(conf)
+        assert tr2 is tr
+        assert tr.dropped == 4 + 4
+        assert len(tr.spans()) == 4
+    finally:
+        tr.enabled = False
+        tr.clear()
+        tr.resize(65536)
+
+
+def test_dropped_read_is_locked_during_concurrent_records():
+    """Reading .dropped while writers hammer the ring must never tear or
+    race; final count is exact."""
+    t = Tracer(enabled=True, capacity=16)
+    N, THREADS = 400, 4
+
+    def work():
+        for i in range(N):
+            t.instant("x")
+
+    threads = [threading.Thread(target=work) for _ in range(THREADS)]
+    for th in threads:
+        th.start()
+    reads = [t.dropped for _ in range(100)]   # concurrent locked reads
+    for th in threads:
+        th.join()
+    assert reads == sorted(reads)             # monotone, never torn
+    assert t.dropped == N * THREADS - 16
+
+
 def test_clear_resets():
     t = Tracer(enabled=True, capacity=2)
     for i in range(5):
