@@ -55,6 +55,7 @@ Prints ONE JSON line:
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import math
 import os
@@ -1384,6 +1385,39 @@ def obs_overhead_measure(exchanges=30, rows_per_map=2048, maps=4,
             for name, ctx in modes:
                 with ctx():
                     medians[name] = min(medians[name], loop_median_ms())
+        # Doctor-pass cost (the <1% acceptance gate extension): one full
+        # snapshot + diagnose over the telemetry this loop just
+        # generated. The doctor's input is the exchange-report ring plus
+        # cumulative histograms — running it more often than once per
+        # ring-fill re-reads the same data, so its natural maximum
+        # cadence is one pass per ring-fill and the per-exchange
+        # overhead is the pass cost amortized over the OCCUPANCY the
+        # timed pass actually scanned (pass cost scales with occupancy,
+        # so amortizing a half-full-ring pass over the full
+        # REPORT_CAPACITY would understate it; a periodic-dump
+        # deployment at the default 60 s interval sits far below this
+        # bound either way). The tracer ring is cleared first: the gate
+        # covers the DISABLED-telemetry default, where no spans exist —
+        # the A/B rounds' span debris belongs to the enabled
+        # configuration (its cost rides in median_exchange_ms.enabled).
+        # Warm once (module import + first-call allocation are process
+        # costs, not per-pass), then min over several passes — the same
+        # anti-drift discipline as the hook microbenches.
+        from sparkucx_tpu.utils.doctor import diagnose
+
+        def doctor_pass():
+            return diagnose(node.telemetry_snapshot(
+                reports=mgr.exchange_reports()))
+
+        doctor_findings = doctor_pass()    # warm + keep the findings
+        GLOBAL_TRACER.clear()
+        doctor_window = max(1, len(mgr.reports()))
+        doctor_ms = math.inf
+        for _ in range(5):
+            t_doc = _time.perf_counter()
+            doctor_pass()
+            doctor_ms = min(doctor_ms,
+                            (_time.perf_counter() - t_doc) * 1e3)
     finally:
         mgr.stop()
         node.close()
@@ -1398,6 +1432,11 @@ def obs_overhead_measure(exchanges=30, rows_per_map=2048, maps=4,
     out["overhead_enabled_ab_pct"] = round(max(
         0.0, (medians["enabled"] - medians["noop"])
         / medians["noop"] * 100.0), 3)
+    out["doctor_pass_ms"] = round(doctor_ms, 3)
+    out["doctor_findings"] = len(doctor_findings)
+    out["doctor_window_exchanges"] = doctor_window
+    out["doctor_overhead_pct"] = round(
+        doctor_ms / (medians["disabled"] * doctor_window) * 100.0, 4)
     return out
 
 
@@ -1410,7 +1449,8 @@ def stage_obs_overhead(args) -> int:
            "detail": obs_overhead_measure(
                exchanges=30, rows_per_map=1 << (args.rows_log2 or 11),
                reps=args.reps)}
-    out["ok"] = out["detail"]["overhead_disabled_pct"] < 1.0
+    out["ok"] = (out["detail"]["overhead_disabled_pct"] < 1.0
+                 and out["detail"]["doctor_overhead_pct"] < 1.0)
     out["telemetry"] = _telemetry_blob()
     artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "bench_runs", "obs_overhead.json")
@@ -1424,6 +1464,211 @@ def stage_obs_overhead(args) -> int:
         out["artifact_error"] = str(e)[:200]
     print(json.dumps(out), flush=True)
     return 0 if out["ok"] else 2
+
+
+# -- regression gating (--stage regress) ------------------------------------
+# Suffix → direction heuristics over dotted metric paths. -1 = lower is
+# better (an increase is a regression), +1 = higher is better. Unknown
+# directions are SKIPPED, not guessed: a wrong-signed "regression" is
+# worse than no finding.
+_LOWER_BETTER = ("_ms", "_us", "_s", "_secs", "_seconds", "_pct",
+                 "compiles", "dropped", "retries", "misses")
+_HIGHER_BETTER = ("gbps", "gbps_per_chip", "value", "hits", "rate",
+                  "speedup", "bandwidth", "x_faster", "vs_baseline",
+                  "rows_per_s", "programs_saved")
+# Metrics their OWN stage documents as context-only / unresolvable under
+# shared-CPU drift — diffing them produces alarms about the machine, not
+# the code: the A/B medians and every derived percentage/microbench that
+# divides by them (obs-overhead's gate enforces the <1% contract itself;
+# regress must not re-litigate it from two noisy samples). What remains
+# comparable in that artifact is the deterministic accounting
+# (hook_counts_per_exchange) and shape constants; count-based artifacts
+# like coldstart's compile tallies diff meaningfully.
+_CONTEXT_ONLY = ("overhead_enabled_ab_pct", "median_exchange_ms",
+                 "doctor_pass_ms", "doctor_findings",
+                 "overhead_disabled_pct", "doctor_overhead_pct",
+                 "telemetry_us_per_exchange", "report_cost_us",
+                 "hook_cost_us")
+
+
+# Path segments whose whole subtree is lower-better regardless of leaf
+# name: deterministic accounting (hook invocations per exchange) — the
+# noise-free comparison the obs-overhead artifact supports
+_SUBTREE_LOWER_BETTER = ("hook_counts_per_exchange",)
+
+
+def _metric_direction(path: str) -> int:
+    segs = path.lower().split(".")
+    if any(s in _SUBTREE_LOWER_BETTER for s in segs):
+        return -1
+    leaf = segs[-1]
+    for s in _HIGHER_BETTER:
+        if leaf == s or leaf.endswith(s):
+            return 1
+    for s in _LOWER_BETTER:
+        if leaf.endswith(s):
+            return -1
+    return 0
+
+
+def _numeric_leaves(doc, prefix="") -> dict:
+    """Flatten nested dicts to {dotted.path: float}. Lists and the
+    embedded telemetry blob are skipped — the comparison surface is the
+    artifact's scalar measurements, not its raw series."""
+    out = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            if k in ("telemetry", "buckets", "artifact"):
+                continue
+            out.update(_numeric_leaves(v, f"{prefix}{k}."))
+    elif isinstance(doc, bool):
+        pass
+    elif isinstance(doc, (int, float)):
+        out[prefix[:-1]] = float(doc)
+    return out
+
+
+def regress_compare(baseline_doc, candidate_doc, warn_pct=50.0,
+                    critical_pct=150.0, abs_floor_ms=0.05):
+    """Diff two bench artifacts into doctor-schema findings.
+
+    Noise-aware: a metric only fires when BOTH the relative move exceeds
+    the threshold AND (for time-like metrics) the absolute move clears
+    ``abs_floor_ms`` — sub-0.05 ms jitter on a microbenched primitive is
+    scheduler noise, not a regression, no matter its percentage.
+    Improvements surface as info findings so a gate run reads the whole
+    story, and perf regressions grade exactly like runtime anomalies
+    (same Finding schema as `python -m sparkucx_tpu doctor`)."""
+    from sparkucx_tpu.utils.doctor import Finding
+    b = _numeric_leaves(baseline_doc)
+    c = _numeric_leaves(candidate_doc)
+    findings, compared, skipped = [], 0, 0
+    for path in sorted(set(b) & set(c)):
+        if any(seg in _CONTEXT_ONLY for seg in path.split(".")):
+            skipped += 1
+            continue
+        direction = _metric_direction(path)
+        if direction == 0:
+            skipped += 1
+            continue
+        bv, cv = b[path], c[path]
+        if bv <= 0.0:
+            skipped += 1
+            continue
+        compared += 1
+        rel = (cv - bv) / bv * 100.0
+        badness = rel * -direction       # positive = got worse
+        leaf = path.rsplit(".", 1)[-1].lower()
+        timelike = leaf.endswith(("_ms", "_us", "_s", "_secs",
+                                  "_seconds"))
+        if timelike:
+            scale = {"_us": 1e-3, "_s": 1e3, "_secs": 1e3,
+                     "_seconds": 1e3}
+            mult = next((m for suf, m in scale.items()
+                         if leaf.endswith(suf)), 1.0)
+            if abs(cv - bv) * mult < abs_floor_ms:
+                continue
+        if badness >= warn_pct:
+            findings.append(Finding(
+                rule="perf_regression",
+                grade="critical" if badness >= critical_pct else "warn",
+                summary=(f"{path}: {bv:g} -> {cv:g} "
+                         f"({rel:+.1f}%, "
+                         f"{'lower' if direction < 0 else 'higher'}-is-"
+                         f"better) — regressed past the "
+                         f"{warn_pct:.0f}% noise threshold"),
+                evidence={"metric": path, "baseline": bv,
+                          "candidate": cv, "delta_pct": round(rel, 2)},
+                conf_key=None,
+                remediation=("bisect the commits between the two "
+                             "artifacts; re-run the stage to rule out "
+                             "machine noise before reverting")))
+        elif badness <= -warn_pct:
+            findings.append(Finding(
+                rule="perf_improvement", grade="info",
+                summary=f"{path}: {bv:g} -> {cv:g} ({rel:+.1f}%)",
+                evidence={"metric": path, "baseline": bv,
+                          "candidate": cv, "delta_pct": round(rel, 2)}))
+    findings.sort(key=lambda f: ({"critical": 0, "warn": 1,
+                                  "info": 2}[f.grade], f.rule))
+    return findings, compared, skipped
+
+
+def stage_regress(args) -> int:
+    """``--stage regress``: diff a fresh (or ``--candidate``) bench
+    artifact against a prior one (``--baseline``; default: the committed
+    ``bench_runs/obs_overhead.json``, falling back to any
+    ``bench_runs/*.json`` with the same ``metric``) and emit a findings
+    doc in the doctor schema — perf regressions and runtime anomalies
+    read identically. Prints ONE JSON line and writes
+    ``bench_runs/regress.json``. Exit 0 unless ``--gate-regress`` is set
+    and a critical regression fired (the non-blocking CI smoke uses the
+    default)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    rundir = os.path.join(here, "bench_runs")
+
+    if args.candidate:
+        with open(args.candidate) as f:
+            candidate = json.load(f)
+        candidate_src = args.candidate
+    else:
+        # fresh quick measurement in the obs-overhead artifact schema —
+        # CPU-safe, minutes not hours, and every committed repo already
+        # carries the matching baseline artifact
+        candidate = {"metric": "obs_overhead",
+                     "detail": obs_overhead_measure(
+                         exchanges=10, rows_per_map=1 << 11, reps=1)}
+        candidate_src = "<fresh obs-overhead run>"
+
+    if args.baseline:
+        baseline_path = args.baseline
+    else:
+        default = os.path.join(rundir, "obs_overhead.json")
+        baseline_path = default if os.path.exists(default) else None
+        if baseline_path is None:
+            # any prior artifact with a matching metric field
+            for p in sorted(glob.glob(os.path.join(rundir, "*.json"))):
+                try:
+                    with open(p) as f:
+                        if json.load(f).get("metric") == \
+                                candidate.get("metric"):
+                            baseline_path = p
+                            break
+                except (OSError, ValueError):
+                    continue
+    out = {"metric": "bench_regress", "candidate": candidate_src,
+           "baseline": baseline_path}
+    if baseline_path is None:
+        out.update(ok=True, findings=[], compared=0,
+                   note="no baseline artifact found; nothing to gate")
+        print(json.dumps(out), flush=True)
+        return 0
+    with open(baseline_path) as f:
+        baseline = json.load(f)
+    findings, compared, skipped = regress_compare(
+        baseline, candidate, warn_pct=args.regress_warn_pct,
+        critical_pct=args.regress_critical_pct)
+    regressions = [f for f in findings if f.rule == "perf_regression"]
+    out.update(
+        compared=compared, skipped_unknown_direction=skipped,
+        thresholds={"warn_pct": args.regress_warn_pct,
+                    "critical_pct": args.regress_critical_pct},
+        findings=[f.to_dict() for f in findings],
+        regressions=len(regressions),
+        ok=not any(f.grade == "critical" for f in regressions))
+    artifact = getattr(args, "regress_out", None) \
+        or os.path.join(rundir, "regress.json")
+    try:
+        os.makedirs(os.path.dirname(artifact) or ".", exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(out, f, indent=1)
+        out["artifact"] = os.path.relpath(artifact, here)
+    except OSError as e:
+        out["artifact_error"] = str(e)[:200]
+    print(json.dumps(out), flush=True)
+    if args.gate_regress and not out["ok"]:
+        return 2
+    return 0
 
 
 def stage_exchange(mon, jax, name, seconds, native_ok, record=True,
@@ -1502,14 +1747,35 @@ def main() -> None:
                          "form since r5; stable = 1-key stable sort — "
                          "the conf default)")
     ap.add_argument("--stage", default=None,
-                    choices=("coldstart", "obs-overhead"),
+                    choices=("coldstart", "obs-overhead", "regress"),
                     help="run ONE dedicated stage instead of the ladder: "
                          "coldstart = compile-cost artifact (persistent "
                          "cache cold-vs-warm across processes + "
                          "capBuckets drifting-shape compile sweep); "
                          "obs-overhead = telemetry-plane cost on the "
-                         "exchange loop (disabled must be <1%). Both "
-                         "CPU-measurable")
+                         "exchange loop (disabled + doctor pass must "
+                         "each be <1%); regress = diff a bench artifact "
+                         "against a prior one into doctor-schema "
+                         "findings. All CPU-measurable")
+    ap.add_argument("--baseline", default=None,
+                    help="regress stage: prior artifact to diff against "
+                         "(default bench_runs/obs_overhead.json)")
+    ap.add_argument("--candidate", default=None,
+                    help="regress stage: candidate artifact (default: "
+                         "run a fresh quick obs-overhead measurement)")
+    ap.add_argument("--regress-warn-pct", type=float, default=50.0,
+                    help="regress: relative move that grades warn "
+                         "(generous by default: shared-CPU bench wall "
+                         "times drift tens of percent run to run)")
+    ap.add_argument("--regress-critical-pct", type=float, default=150.0,
+                    help="regress: relative move that grades critical")
+    ap.add_argument("--gate-regress", action="store_true",
+                    help="regress: exit 2 on a critical regression "
+                         "(default: report-only, the non-blocking CI "
+                         "smoke shape)")
+    ap.add_argument("--regress-out", default=None,
+                    help="regress: findings-doc path (default "
+                         "bench_runs/regress.json)")
     ap.add_argument("--platform", default="auto",
                     choices=("auto", "tpu", "cpu"),
                     help="cpu forces the CPU backend via jax.config before "
@@ -1536,8 +1802,9 @@ def main() -> None:
         # the TPU window is dark (VERDICT chip-outage plan B)
         import jax
         jax.config.update("jax_platforms", "cpu")
-        sys.exit(stage_coldstart(args) if args.stage == "coldstart"
-                 else stage_obs_overhead(args))
+        sys.exit({"coldstart": stage_coldstart,
+                  "obs-overhead": stage_obs_overhead,
+                  "regress": stage_regress}[args.stage](args))
 
     fallback = None
     if args.platform == "auto" and not args.no_fallback:

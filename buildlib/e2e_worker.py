@@ -64,6 +64,9 @@ def main() -> int:
         # >1 slices: 2-D (dcn, ici) mesh -> the two-stage hierarchical
         # exchange runs across processes (shuffle/hierarchical.py)
         "spark.shuffle.tpu.mesh.numSlices": str(num_slices),
+        # span recording on: the telemetry job below gathers every
+        # process's spans and proves the merged timeline clock-aligns
+        "spark.shuffle.tpu.trace.enabled": "true",
     }, use_env=False)
     try:
         node = TpuNode.start(conf, distributed=True, process_id=proc_id)
@@ -335,6 +338,60 @@ def main() -> int:
                         dtype=np.int64)
     assert merged.tolist() == want_vec.tolist(), \
         "distributed text wordcount mismatch"
+
+    # sixth job: the telemetry plane's CLUSTER story. (a) gathered
+    # reports for the first shuffle carry the SAME trace id on every
+    # process (reads are collective, so the exchange seq agrees); (b)
+    # gathered spans merge into one clock-aligned timeline — every
+    # process's dispatch span for that exchange must overlap in merged
+    # wall time, since the collective cannot complete until all entered;
+    # (c) the doctor diagnoses the allgathered per-process snapshots.
+    reps = mgr.gather_reports(7)
+    assert len(reps) == nprocs, f"gather_reports: {len(reps)}"
+    tids = {r.get("trace_id") for r in reps if r}
+    assert len(tids) == 1 and "" not in tids, \
+        f"trace ids disagree across processes: {tids}"
+    tid = next(iter(tids))
+
+    from sparkucx_tpu.utils.export import merge_timeline
+    blobs = mgr.gather_spans()
+    assert len(blobs) == nprocs, f"gather_spans: {len(blobs)}"
+    tl = merge_timeline(blobs)
+    tracks = {ev["pid"] for ev in tl["traceEvents"] if ev.get("ph") == "X"}
+    assert len(tracks) == nprocs, f"timeline tracks: {tracks}"
+    windows = {}
+    for ev in tl["traceEvents"]:
+        if ev.get("ph") == "X" and ev.get("name") == "shuffle.dispatch" \
+                and (ev.get("args") or {}).get("trace") == tid:
+            lo, hi = windows.get(ev["pid"], (float("inf"), 0.0))
+            windows[ev["pid"]] = (min(lo, ev["ts"]),
+                                  max(hi, ev["ts"] + ev["dur"]))
+    assert len(windows) == nprocs, \
+        f"dispatch spans for {tid} missing tracks: {sorted(windows)}"
+    # anchor tolerance: same host, shared clocks — 2 s covers scheduling
+    # slop between a process's dispatch and its slowest peer's, while
+    # catching a mis-anchored track (whose offset would be the process
+    # lifetime, minutes)
+    TOL_US = 2e6
+    starts = [w[0] for w in windows.values()]
+    ends = [w[1] for w in windows.values()]
+    assert max(starts) <= min(ends) + TOL_US, \
+        f"dispatch spans misaligned: starts={starts} ends={ends}"
+    print(f"worker {proc_id}: TIMELINE ALIGNED OK "
+          f"({nprocs} tracks, trace {tid})", flush=True)
+
+    from sparkucx_tpu.shuffle.distributed import allgather_json
+    from sparkucx_tpu.utils.doctor import diagnose
+    snap = node.telemetry_snapshot(reports=mgr.exchange_reports())
+    # connect-time anchor table: every member holds every peer's
+    # wall↔perf pair (gathered at bootstrap), embedded in its snapshot
+    assert len(snap["cluster_anchors"]) == nprocs, snap["cluster_anchors"]
+    assert {int(a["process_id"]) for a in snap["cluster_anchors"]} == \
+        set(range(nprocs))
+    findings = diagnose(allgather_json(snap))
+    print(f"worker {proc_id}: CLUSTER DOCTOR OK "
+          f"({len(findings)} finding(s): "
+          f"{sorted({f.rule for f in findings})})", flush=True)
 
     mgr.stop()
     node.close()

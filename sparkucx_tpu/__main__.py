@@ -19,18 +19,75 @@ Subcommands:
     Print the span summary table (count / mean / p50 / p99 / max ms per
     span name) from a dump, and optionally extract its Chrome trace
     events to a file loadable in Perfetto / chrome://tracing.
+
+``timeline [--input DUMP_OR_DIR ...] [--out TIMELINE.json]``
+    Merge per-process span captures into ONE clock-aligned Chrome/
+    Perfetto timeline with a track per process. Inputs are snapshot/
+    flight dumps (files, or directories of ``metrics_*.json`` +
+    ``flight_*.json``); without ``--input``, this process's live
+    capture. Every input must carry a clock anchor (the wall↔perf pair
+    ``export.collect_snapshot`` embeds) — anchor-less dumps are
+    REJECTED rather than silently misaligned, and so are ``stats``/
+    ``trace`` inputs.
+
+``doctor [--input DUMP_OR_DIR ...] [--format text|json] [--fail-on G]``
+    Automated diagnosis: run the rule engine (utils/doctor.py) over one
+    or many telemetry dumps — or this live process — and print graded
+    findings with evidence and the conf key to turn. Multiple inputs
+    aggregate cluster-wide (histograms merge exactly, reports
+    concatenate). ``--fail-on warn|critical`` exits non-zero when a
+    finding of that grade (or worse) fired — the CI gate shape.
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 import sys
 
 
 def _load(path: str) -> dict:
     with open(path) as f:
         return json.load(f)
+
+
+def _expand_inputs(paths) -> list:
+    """Files stay files; a directory expands to the telemetry dumps the
+    periodic dumper and flight recorder write into it. An explicitly
+    passed but EMPTY --input (a shell glob that matched nothing) is an
+    error — falling back to diagnosing this fresh CLI process would
+    print 'healthy' and mask the missing dumps, the worst failure mode
+    for a gate."""
+    if not paths:
+        raise FileNotFoundError(
+            "--input was given but resolved to no paths (empty shell "
+            "glob?); pass dump files/directories or drop --input for "
+            "live mode")
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            hits = sorted(glob.glob(os.path.join(p, "metrics_*.json"))
+                          + glob.glob(os.path.join(p, "flight_*.json")))
+            if not hits:
+                raise FileNotFoundError(
+                    f"{p}: no metrics_*.json / flight_*.json dumps")
+            out.extend(hits)
+        else:
+            out.append(p)
+    return out
+
+
+def _load_anchored(path: str) -> dict:
+    """Load a dump and insist on its clock anchor: span epochs are
+    per-process monotonic offsets, so an anchor-less dump can only be
+    misaligned — fail loudly instead (satellite: snapshot clock
+    anchor)."""
+    from sparkucx_tpu.utils.export import require_anchor
+    doc = _load(path)
+    require_anchor(doc, path)
+    return doc
 
 
 def _live_snapshot() -> dict:
@@ -42,7 +99,7 @@ def _live_snapshot() -> dict:
 
 def _cmd_stats(args) -> int:
     from sparkucx_tpu.utils.export import render_json, render_prometheus
-    doc = _load(args.input) if args.input else _live_snapshot()
+    doc = _load_anchored(args.input) if args.input else _live_snapshot()
     if args.format == "prometheus":
         sys.stdout.write(render_prometheus(doc))
     else:
@@ -51,7 +108,7 @@ def _cmd_stats(args) -> int:
 
 
 def _cmd_trace(args) -> int:
-    doc = _load(args.input) if args.input else None
+    doc = _load_anchored(args.input) if args.input else None
     if doc is not None:
         spans = doc.get("spans", {})
         events = doc.get("trace_events", doc.get("traceEvents", []))
@@ -70,6 +127,51 @@ def _cmd_trace(args) -> int:
         with open(args.out, "w") as f:
             json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
         print(f"wrote {len(events)} chrome trace events -> {args.out}")
+    return 0
+
+
+def _cmd_timeline(args) -> int:
+    from sparkucx_tpu.utils.export import merge_timeline
+    if args.input is not None:
+        docs = [_load_anchored(p) for p in _expand_inputs(args.input)]
+    else:
+        docs = [_live_snapshot()]
+    doc = merge_timeline(docs)
+    out = args.out or "timeline.json"
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    n = sum(1 for ev in doc["traceEvents"] if ev.get("ph") != "M")
+    print(f"wrote {n} events across {doc['metadata']['processes']} "
+          f"process track(s) -> {out}")
+    return 0
+
+
+def _cmd_doctor(args) -> int:
+    from sparkucx_tpu.utils.doctor import (GRADES, diagnose,
+                                           render_findings)
+    if args.input is not None:
+        docs = [_load_anchored(p) if args.strict_anchor else _load(p)
+                for p in _expand_inputs(args.input)]
+        findings = diagnose(docs)
+    else:
+        # live: fold in the node's registry + pool watermark when a node
+        # is up in this process, else the process-global registries alone
+        # (exchange reports belong to a manager — facade users get them
+        # through ShuffleService.doctor())
+        from sparkucx_tpu.runtime.node import TpuNode
+        node = TpuNode._instance
+        if node is not None and not node._closed:
+            findings = diagnose(node.telemetry_snapshot())
+        else:
+            findings = diagnose(_live_snapshot())
+    if args.format == "json":
+        print(json.dumps([f.to_dict() for f in findings], indent=1))
+    else:
+        sys.stdout.write(render_findings(findings))
+    if args.fail_on:
+        floor = GRADES.index(args.fail_on)
+        if any(GRADES.index(f.grade) >= floor for f in findings):
+            return 3
     return 0
 
 
@@ -94,11 +196,43 @@ def main(argv=None) -> int:
                          help="flight-recorder / snapshot JSON")
     p_trace.add_argument("--out", default=None,
                          help="write chrome traceEvents JSON here")
+    p_tl = sub.add_parser(
+        "timeline",
+        help="merge per-process dumps into one clock-aligned Perfetto "
+             "timeline (a track per process)")
+    p_tl.add_argument("--input", nargs="*", default=None,
+                      help="snapshot/flight dump files or dump "
+                           "directories (default: this process, live)")
+    p_tl.add_argument("--out", default=None,
+                      help="output path (default timeline.json)")
+    p_doc = sub.add_parser(
+        "doctor",
+        help="automated diagnosis: graded findings + the conf key to "
+             "turn, from live telemetry or dumps")
+    p_doc.add_argument("--input", nargs="*", default=None,
+                       help="snapshot/flight dump files or dump "
+                            "directories; several aggregate "
+                            "cluster-wide (default: this process)")
+    p_doc.add_argument("--format", default="text",
+                       choices=("text", "json"))
+    p_doc.add_argument("--fail-on", default=None,
+                       choices=("warn", "critical"),
+                       help="exit 3 when a finding of this grade or "
+                            "worse fired (CI gate)")
+    p_doc.add_argument("--strict-anchor", action="store_true",
+                       help="also reject anchor-less dumps (doctor "
+                            "rules don't need span alignment, so "
+                            "pre-anchor dumps are diagnosable by "
+                            "default)")
     args = ap.parse_args(argv)
     if args.cmd == "stats":
         return _cmd_stats(args)
     if args.cmd == "trace":
         return _cmd_trace(args)
+    if args.cmd == "timeline":
+        return _cmd_timeline(args)
+    if args.cmd == "doctor":
+        return _cmd_doctor(args)
     return _cmd_keys(args)
 
 

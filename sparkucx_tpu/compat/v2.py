@@ -233,10 +233,18 @@ class ShuffleServiceV2:
                 # dispatching reader runs the collective, plus the cache
                 # lookup), not just the first collective — the manager's
                 # read() already observes the dispatcher's. Spark charges
-                # each reduce task's reporter the same way.
-                from sparkucx_tpu.utils.metrics import H_FETCH_WAIT
+                # each reduce task's reporter the same way. Same
+                # warmup split as read(): a reader that blocked behind a
+                # COMPILE-BEARING dispatch waited out the compile too —
+                # its wait must not poison the steady-state distribution
+                # the doctor's straggler rule keys on.
+                from sparkucx_tpu.utils.metrics import (H_FETCH_FIRST,
+                                                        H_FETCH_WAIT)
+                rep = self.manager.report(sid)
+                compiled = rep is not None and rep.stepcache_programs > 0
                 self.node.metrics.observe(
-                    H_FETCH_WAIT, (time.perf_counter() - t0) * 1e3)
+                    H_FETCH_FIRST if compiled else H_FETCH_WAIT,
+                    (time.perf_counter() - t0) * 1e3)
                 self.node.metrics.inc("shuffle.read.cached.count", 1)
             return res
 
@@ -258,6 +266,13 @@ class ShuffleServiceV2:
         the host-adapter contract."""
         from sparkucx_tpu.service import _collect_stats
         return _collect_stats(self.node, self.manager, format)
+
+    def doctor(self, format: str = "findings"):
+        """Automated telemetry diagnosis — same rule engine and schema
+        as the v1 facade (service._doctor): the diagnostic surface does
+        not drift with the host-adapter contract either."""
+        from sparkucx_tpu.service import _doctor
+        return _doctor(self.node, self.manager, format)
 
     def __enter__(self) -> "ShuffleServiceV2":
         return self

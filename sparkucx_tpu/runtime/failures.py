@@ -87,6 +87,12 @@ class _NullFlightRecorder:
     def on_epoch_bump(self, epoch: int) -> None:
         pass
 
+    def begin_trace(self, trace_id: str) -> None:
+        pass
+
+    def end_trace(self, trace_id: str) -> None:
+        pass
+
     def dump(self, reason: str, extra: Optional[Dict] = None):
         return None
 
@@ -142,11 +148,31 @@ class FlightRecorder:
         # process-global one (the node appends its per-node registry)
         self.metrics_sources: list = []
         self._epoch = time.time()
+        # Exchanges currently in flight, newest last — ring events
+        # recorded while one is open carry its trace id, so a crash dump
+        # links straight to the exchange's row in gather_reports and its
+        # track in the merged timeline (manager.begin/end around each
+        # read). A stack, not a single slot: concurrent reads from
+        # different threads overlap.
+        self._inflight_traces: list = []
 
     # -- recording --------------------------------------------------------
+    def begin_trace(self, trace_id: str) -> None:
+        with self._lock:
+            self._inflight_traces.append(trace_id)
+
+    def end_trace(self, trace_id: str) -> None:
+        with self._lock:
+            try:
+                self._inflight_traces.remove(trace_id)
+            except ValueError:
+                pass
+
     def record(self, kind: str, **data) -> None:
         try:
             with self._lock:
+                if self._inflight_traces and "trace" not in data:
+                    data["trace"] = self._inflight_traces[-1]
                 self._events.append(
                     {"t": round(time.time() - self._epoch, 6),
                      "kind": kind, **data})
@@ -184,6 +210,7 @@ class FlightRecorder:
             with self._lock:
                 events = list(self._events)
                 providers = list(self._providers)
+                inflight = list(self._inflight_traces)
             contexts: Dict = {}
             for fn in providers:
                 try:
@@ -195,6 +222,8 @@ class FlightRecorder:
                 "reason": reason,
                 "ts": time.time(),
                 "pid": os.getpid(),
+                "anchor": GLOBAL_TRACER.anchor(),
+                "in_flight_traces": inflight,
                 "events": events,
                 "counters": {},
                 "histograms": {},
@@ -203,11 +232,25 @@ class FlightRecorder:
                 "dropped_spans": GLOBAL_TRACER.dropped,
                 "contexts": contexts,
             }
+            from sparkucx_tpu.utils.export import \
+                merge_histogram_snapshots
             for m in [GLOBAL_METRICS] + list(self.metrics_sources):
                 doc["counters"].update(m.snapshot())
-                doc["histograms"].update(m.histograms())
+                merge_histogram_snapshots(doc["histograms"],
+                                          m.histograms())
             if extra:
                 doc.update(extra)
+            # The postmortem diagnoses ITSELF: the doctor's graded
+            # findings ride in the dump, so the first thing an operator
+            # reads is "compile churn, turn a2a.capBucketGrowth", not a
+            # wall of counters. The manager's context provider exposes
+            # exchange reports under "contexts", where the doctor's
+            # report rules expect a fallback lookup.
+            try:
+                from sparkucx_tpu.utils.doctor import diagnose
+                doc["findings"] = [f.to_dict() for f in diagnose(doc)]
+            except Exception as e:
+                doc["findings"] = [f"<doctor failed: {e!r}>"]
             os.makedirs(self.out_dir, exist_ok=True)
             slug = "".join(c if c.isalnum() else "-"
                            for c in reason.lower())[:40].strip("-")

@@ -123,9 +123,54 @@ class TpuNode:
             flight=self.flight)
         self.epochs = EpochManager()
         self.epochs.on_bump(self.flight.on_epoch_bump)
+        # Cluster clock anchors: every process's wall↔perf pair,
+        # allgathered at connect (every process constructs its node in
+        # lockstep, so the collective is safe here) — the alignment data
+        # merge_timeline needs to put N monotonic span clocks on one
+        # wall-clock axis. Single-process: just the local anchor.
+        self.cluster_anchors = self._gather_anchors()
         self._closed = False
         log.info("TpuNode up: %d devices, mesh axes %s",
                  len(jax.devices()), self.mesh.axis_names)
+
+    def telemetry_snapshot(self, reports=None) -> dict:
+        """THE canonical live-snapshot shape for this process: both
+        registries (process-global + node), the tracer, the arena
+        watermark and the process identity — one seam so the facades,
+        the CLI's live mode, the bench's doctor pass and the cluster
+        harness cannot drift on which fields a doctor rule can rely on.
+        ``reports`` is the manager's exchange-report list when the
+        caller owns a manager (the node itself does not)."""
+        from sparkucx_tpu.utils.export import collect_snapshot
+        from sparkucx_tpu.utils.metrics import GLOBAL_METRICS
+        return collect_snapshot(
+            [GLOBAL_METRICS, self.metrics], tracer=self.tracer,
+            reports=reports,
+            extra={"pool": self.pool.stats(),
+                   "process_id": self.process_id,
+                   # the connect-time anchor table: ONE process's dump
+                   # can place every peer's clock on the shared wall
+                   # axis even when the peers' own dumps are missing
+                   # (a crashed peer's flight dump may never land)
+                   "cluster_anchors": self.cluster_anchors})
+
+    def _gather_anchors(self) -> list:
+        if self.is_distributed:
+            from sparkucx_tpu.shuffle.distributed import \
+                gather_clock_anchors
+            try:
+                return gather_clock_anchors(self.tracer)
+            except Exception as e:
+                # best-effort: some backends lack cross-process
+                # collectives (jax 0.4.x CPU without gloo) — timeline
+                # merging then falls back to per-dump anchors; a node
+                # must never fail to BOOT over alignment metadata
+                log.warning("clock-anchor allgather unavailable (%s); "
+                            "cluster timeline will align from per-dump "
+                            "anchors", e)
+        a = self.tracer.anchor()
+        a["process_id"] = self.process_id
+        return [a]
 
     # -- singleton management --------------------------------------------
     @classmethod
@@ -206,6 +251,18 @@ class TpuNode:
             self.mesh, timeout_ms=self.conf.connection_timeout_ms,
             flight=self.flight)
         self.registry.clear()
+        # Fresh membership, fresh alignment data. Single-process: a
+        # local re-anchor. Distributed: NO collective here — remesh runs
+        # precisely when a peer is dead, and an allgather over the old
+        # process set would hang on it; keep only the local anchor (the
+        # recovery controller re-bootstraps a fresh world, whose
+        # __init__ re-gathers cluster-wide).
+        if self.is_distributed:
+            a = self.tracer.anchor()
+            a["process_id"] = self.process_id
+            self.cluster_anchors = [a]
+        else:
+            self.cluster_anchors = self._gather_anchors()
         epoch = self.epochs.bump(reason or "remesh")
         log.warning("remesh: %d devices, epoch %d (%s)",
                     self.mesh.devices.size, epoch, reason or "requested")

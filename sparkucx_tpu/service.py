@@ -40,18 +40,33 @@ def _collect_stats(node: TpuNode, manager: TpuShuffleManager,
     by both facade generations so the scrape seam cannot drift with the
     host-adapter contract. ``json`` returns the snapshot dict;
     ``prometheus`` text exposition."""
-    from sparkucx_tpu.utils.export import (collect_snapshot,
-                                           render_prometheus)
-    from sparkucx_tpu.utils.metrics import GLOBAL_METRICS
-    doc = collect_snapshot(
-        [GLOBAL_METRICS, node.metrics], tracer=node.tracer,
-        reports=manager.exchange_reports())
+    from sparkucx_tpu.utils.export import render_prometheus
+    doc = node.telemetry_snapshot(reports=manager.exchange_reports())
     if format == "json":
         return doc
     if format == "prometheus":
         return render_prometheus(doc)
     raise ValueError(f"unknown stats format {format!r}; "
                      f"want json|prometheus")
+
+
+def _doctor(node: TpuNode, manager: TpuShuffleManager,
+            format: str = "findings"):
+    """One doctor pass over this process's telemetry — the rule engine
+    (utils/doctor.py) run on the same canonical snapshot ``stats()``
+    serves, shared by both facade generations. ``format="findings"``
+    returns :class:`~sparkucx_tpu.utils.doctor.Finding` objects;
+    ``"json"`` their dicts; ``"text"`` the rendered report."""
+    from sparkucx_tpu.utils.doctor import diagnose, render_findings
+    findings = diagnose(_collect_stats(node, manager, "json"))
+    if format == "findings":
+        return findings
+    if format == "json":
+        return [f.to_dict() for f in findings]
+    if format == "text":
+        return render_findings(findings)
+    raise ValueError(f"unknown doctor format {format!r}; "
+                     f"want findings|json|text")
 
 
 def _start_dumper(conf: TpuShuffleConf, stats_fn):
@@ -138,6 +153,13 @@ class ShuffleService:
         exposition ready to serve from a /metrics endpoint or drop in a
         textfile-collector dir."""
         return _collect_stats(self.node, self.manager, format)
+
+    def doctor(self, format: str = "findings"):
+        """Automated diagnosis of this process's telemetry: graded
+        findings (straggler / skew / retry storm / compile churn / pool
+        pressure / overflow loops) with evidence and the conf key to
+        turn — see :mod:`sparkucx_tpu.utils.doctor`."""
+        return _doctor(self.node, self.manager, format)
 
     def __enter__(self) -> "ShuffleService":
         return self

@@ -75,6 +75,44 @@ def allgather_blob(blob: np.ndarray) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(blob))
 
 
+def allgather_json(obj) -> list:
+    """COLLECTIVE: allgather one JSON-able object per process; returns
+    the per-process list (process order). Two allgather rounds — length,
+    then max-padded payload — over the same metadata-plane channel the
+    schema agreement rides. The telemetry plane's cross-process wire:
+    gather_reports, gather_spans and the connect-time clock-anchor
+    exchange all speak through here, so the framing cannot drift
+    between them. Entries that fail to decode come back as {} (a
+    telemetry gather must degrade, not hang the job)."""
+    import json as _json
+    raw = np.frombuffer(_json.dumps(obj).encode(), dtype=np.uint8)
+    lens = allgather_blob(np.array([raw.size], dtype=np.int64))[:, 0]
+    cap = max(int(lens.max()), 1)
+    buf = np.zeros(cap, dtype=np.uint8)
+    buf[:raw.size] = raw
+    gathered = allgather_blob(buf)                      # [nproc, cap]
+    out = []
+    for row, n in zip(gathered, lens):
+        try:
+            out.append(_json.loads(bytes(row[:int(n)]).decode()))
+        except ValueError:
+            out.append({})
+    return out
+
+
+def gather_clock_anchors(tracer=None) -> list:
+    """COLLECTIVE: every process's wall↔perf anchor pair
+    (:meth:`Tracer.anchor` + process index), gathered at connect/remesh
+    so per-process monotonic span clocks can be aligned into one
+    cluster timeline (utils/export.merge_timeline). Every process must
+    call it — the usual SPMD discipline."""
+    import jax
+    from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+    a = (tracer or GLOBAL_TRACER).anchor()
+    a["process_id"] = jax.process_index()
+    return allgather_json(a)
+
+
 class DistributedReaderResult(ShuffleReaderResult):
     """Partial, process-local view: only partitions on local shards are
     readable (the Spark-reducer contract). Layout is partition-major

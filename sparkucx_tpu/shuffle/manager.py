@@ -20,13 +20,13 @@ embeds the driver table's {address, rkey}
 from __future__ import annotations
 
 import dataclasses
-import json
+import os
 import threading
 import time
 import weakref
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import ClassVar, Dict, List, Optional
 
 import numpy as np
 
@@ -45,8 +45,10 @@ from sparkucx_tpu.shuffle.reader import (
 from sparkucx_tpu.shuffle.writer import MapOutputWriter
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
-                                        GLOBAL_METRICS, H_FETCH_WAIT,
-                                        H_PEER_BYTES, H_PEER_ROWS)
+                                        GLOBAL_METRICS, H_FETCH_FIRST,
+                                        H_FETCH_WAIT, H_PEER_BYTES,
+                                        H_PEER_ROWS)
+from sparkucx_tpu.utils.trace import format_trace_id
 
 log = get_logger("shuffle.manager")
 
@@ -74,6 +76,11 @@ class ExchangeReport:
     num_maps: int
     num_partitions: int
     partitioner: str
+    # cluster-correlation key s<sid>.e<epoch>.x<seq> (trace.format_trace_id):
+    # the same id stamps this report, the read's spans, and any flight
+    # events recorded while the exchange was in flight — one grep joins a
+    # crash dump to its row in gather_reports and its timeline track
+    trace_id: str = ""
     process_id: int = 0
     distributed: bool = False
     hierarchical: bool = False
@@ -99,9 +106,23 @@ class ExchangeReport:
     _hits0: float = field(default=0.0, repr=False)
     _prog0: float = field(default=0.0, repr=False)
 
+    # public field names, resolved once: to_dict runs per report per
+    # doctor/stats/dump pass, and dataclasses.asdict's recursive deepcopy
+    # made it the single hottest piece of a doctor pass (bench --stage
+    # obs-overhead doctor_pass_ms)
+    _PUBLIC_FIELDS: ClassVar[tuple] = ()
+
     def to_dict(self) -> Dict:
-        return {k: v for k, v in dataclasses.asdict(self).items()
-                if not k.startswith("_")}
+        cls = type(self)
+        if not cls._PUBLIC_FIELDS:
+            cls._PUBLIC_FIELDS = tuple(
+                f.name for f in dataclasses.fields(cls)
+                if not f.name.startswith("_"))
+        out = {}
+        for name in cls._PUBLIC_FIELDS:
+            v = getattr(self, name)
+            out[name] = list(v) if isinstance(v, list) else v
+        return out
 
 
 @dataclass
@@ -156,6 +177,9 @@ class TpuShuffleManager:
         # snapshot pre-bump writers.
         self._gen = 0
         self._active_reads: Dict[int, int] = {}
+        # monotone exchange counter — the seq component of trace ids
+        # (reads are collective, so it advances in lockstep cluster-wide)
+        self._exchange_seq = 0
         self._lock = threading.Lock()
         # Admission control (a2a.maxBytesInFlight): combined footprint of
         # in-flight submitted exchanges; submit() blocks past the cap
@@ -284,10 +308,20 @@ class TpuShuffleManager:
         rep._hits0 = GLOBAL_METRICS.get(COMPILE_HITS)
         rep._prog0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
         with self._lock:
+            # Exchange sequence: reads are collective and execute in the
+            # same order on every process, so this per-process counter
+            # agrees cluster-wide — the seq third of the trace id.
+            self._exchange_seq += 1
+            rep.trace_id = format_trace_id(
+                handle.shuffle_id, self.node.epochs.current,
+                self._exchange_seq)
             self._reports[handle.shuffle_id] = rep
             self._reports.move_to_end(handle.shuffle_id)
             while len(self._reports) > REPORT_CAPACITY:
                 self._reports.popitem(last=False)
+        # ring events recorded while this exchange is in flight carry its
+        # trace id (ended by on_done, or the submit failure paths)
+        self.node.flight.begin_trace(rep.trace_id)
         return rep
 
     def report(self, shuffle_id: int) -> Optional[ExchangeReport]:
@@ -321,20 +355,30 @@ class TpuShuffleManager:
         local = rep.to_dict() if rep is not None else {}
         if not self.node.is_distributed:
             return [local] if local else []
-        from sparkucx_tpu.shuffle.distributed import allgather_blob
-        raw = np.frombuffer(json.dumps(local).encode(), dtype=np.uint8)
-        lens = allgather_blob(np.array([raw.size], dtype=np.int64))[:, 0]
-        cap = int(lens.max())
-        buf = np.zeros(cap, dtype=np.uint8)
-        buf[:raw.size] = raw
-        gathered = allgather_blob(buf)                  # [nproc, cap]
-        out = []
-        for row, n in zip(gathered, lens):
-            try:
-                out.append(json.loads(bytes(row[:int(n)]).decode()))
-            except ValueError:
-                out.append({})
-        return out
+        from sparkucx_tpu.shuffle.distributed import allgather_json
+        return allgather_json(local)
+
+    def gather_spans(self) -> List[Dict]:
+        """COLLECTIVE (distributed mode): every process's span buffer as
+        chrome trace events plus its clock anchor — the input of
+        ``utils.export.merge_timeline`` (one Perfetto doc, a track per
+        process, clock-aligned through the anchors). Same two-round
+        allgather channel as :meth:`gather_reports`. Single-process:
+        just the local capture. Every process must call it (SPMD
+        discipline); a process with tracing off contributes an empty
+        event list but still a valid anchor."""
+        tracer = self.node.tracer
+        local = {
+            "process_id": self.node.process_id,
+            "pid": os.getpid(),
+            "anchor": tracer.anchor(),
+            "events": tracer.chrome_events(),
+            "dropped_spans": tracer.dropped,
+        }
+        if not self.node.is_distributed:
+            return [local]
+        from sparkucx_tpu.shuffle.distributed import allgather_json
+        return allgather_json(local)
 
     # -- lifecycle --------------------------------------------------------
     def register_shuffle(self, shuffle_id: int, num_maps: int,
@@ -667,20 +711,40 @@ class TpuShuffleManager:
                                   f"shuffle {handle.shuffle_id}")
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
-        if self.node.is_distributed:
-            # collective: every process must pass the same combine/ordered
-            # values (same SPMD discipline as calling read() at all)
-            # hist=H_FETCH_WAIT: the fetch-wait DISTRIBUTION per read —
-            # what Spark's incFetchWaitTime flattens into a sum
-            with self.node.metrics.timeit("shuffle.read",
-                                          hist=H_FETCH_WAIT):
+        # Fetch-wait DISTRIBUTION per read — what Spark's incFetchWaitTime
+        # flattens into a sum. Compile-bearing reads (fresh step-cache
+        # programs in this read's report) observe into H_FETCH_FIRST
+        # instead: the first exchange of a plan shape pays XLA compile
+        # in-band, and one 3000 ms warmup read in the wait histogram
+        # poisons every outlier rule keyed on it (BENCH_r05 fetch_p99).
+        # The split happens HERE, after result(), because the report's
+        # step-cache delta is only final once on_done ran.
+        metrics = self.node.metrics
+        t0 = time.perf_counter()
+        try:
+            if self.node.is_distributed:
+                # collective: every process must pass the same
+                # combine/ordered values (same SPMD discipline as
+                # calling read() at all)
                 return self._submit_distributed(
                     handle, timeout, combine=combine, ordered=ordered,
                     combine_sum_words=combine_sum_words).result()
-        with self.node.metrics.timeit("shuffle.read", hist=H_FETCH_WAIT):
             return self._submit_local(
                 handle, timeout, combine=combine, ordered=ordered,
                 combine_sum_words=combine_sum_words).result()
+        finally:
+            ms = (time.perf_counter() - t0) * 1e3
+            metrics.inc("shuffle.read.ms", ms)
+            metrics.inc("shuffle.read.count", 1)
+            # failure included: a read that compiled and THEN died still
+            # carried the compile in its wall time — it must not land in
+            # the steady-state wait distribution either (on_done has
+            # already finalized the report's step-cache delta; a read
+            # that died before its report exists observes as wait)
+            rep = self.report(handle.shuffle_id)
+            compiled = rep is not None and rep.stepcache_programs > 0
+            metrics.observe(H_FETCH_FIRST if compiled else H_FETCH_WAIT,
+                            ms)
 
     def read_partitions(self, handle: ShuffleHandle, start: int, end: int,
                         timeout: Optional[float] = None,
@@ -748,6 +812,9 @@ class TpuShuffleManager:
                 handle, timeout, combine, ordered, combine_sum_words, rep)
         except BaseException as e:
             rep.error = rep.error or repr(e)[:300]
+            # a read that dies before arming never reaches on_done — the
+            # exchange is no longer in flight, close its flight trace
+            self.node.flight.end_trace(rep.trace_id)
             raise
 
     def _submit_local_staged(self, handle: ShuffleHandle, timeout: float,
@@ -816,7 +883,8 @@ class TpuShuffleManager:
                 [sum(k.shape[0] for k, _ in outs) for outs in shard_outputs],
                 dtype=np.int64)
             t_plan = time.perf_counter()
-            with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
+            with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id,
+                             trace=rep.trace_id):
                 plan = make_plan(nvalid, Pn, handle.num_partitions,
                                  self.conf, partitioner=handle.partitioner,
                                  bounds=handle.bounds)
@@ -833,7 +901,8 @@ class TpuShuffleManager:
             self._report_volume(rep, plan, nvalid, width,
                                 part_rows=table.sizes.sum(axis=0))
             t_pack = time.perf_counter()
-            with tracer.span("shuffle.pack", rows=int(nvalid.sum())):
+            with tracer.span("shuffle.pack", rows=int(nvalid.sum()),
+                             trace=rep.trace_id):
                 shard_rows, stage_buf = self._pack_shards(
                     shard_outputs, plan.cap_in, width, has_vals)
             rep.pack_ms = (time.perf_counter() - t_pack) * 1e3
@@ -866,7 +935,8 @@ class TpuShuffleManager:
             with tracer.span("shuffle.dispatch",
                              shuffle_id=handle.shuffle_id,
                              rows=int(nvalid.sum()), width=width,
-                             hierarchical=self.hierarchical):
+                             hierarchical=self.hierarchical,
+                             trace=rep.trace_id):
                 vt = val_tail if has_vals else None
                 if self.hierarchical and plan.impl == "pallas":
                     # the pallas transport is flat-only: run it over the
@@ -978,6 +1048,9 @@ class TpuShuffleManager:
                     report.completed = True
                 else:
                     report.error = report.error or "exchange failed"
+                # the exchange is settled either way — flight-ring events
+                # from here on belong to the next exchange
+                self.node.flight.end_trace(report.trace_id)
 
         def arm(pending):
             handle_box["pending"] = weakref.ref(pending)
@@ -1177,6 +1250,7 @@ class TpuShuffleManager:
                 handle, timeout, combine, ordered, combine_sum_words, rep)
         except BaseException as e:
             rep.error = rep.error or repr(e)[:300]
+            self.node.flight.end_trace(rep.trace_id)
             raise
 
     def _submit_distributed_impl(self, handle: ShuffleHandle,
@@ -1340,7 +1414,8 @@ class TpuShuffleManager:
         nvalid = allgather_sizes(nvalid_local, shard_ids, Pn)
         validate_row_sizes(nvalid.reshape(1, -1))
         t_plan = time.perf_counter()
-        with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id):
+        with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id,
+                         trace=rep.trace_id if rep is not None else ""):
             plan = make_plan(nvalid, Pn, handle.num_partitions, self.conf,
                              partitioner=handle.partitioner,
                              bounds=handle.bounds)
@@ -1361,7 +1436,8 @@ class TpuShuffleManager:
             self._report_volume(rep, plan, nvalid, width,
                                 local_rows=int(nvalid_local.sum()))
         t_pack = time.perf_counter()
-        with tracer.span("shuffle.pack", rows=int(nvalid_local.sum())):
+        with tracer.span("shuffle.pack", rows=int(nvalid_local.sum()),
+                         trace=rep.trace_id if rep is not None else ""):
             local_rows, stage_buf = self._pack_shards(
                 shard_outputs, plan.cap_in, width, has_vals)
         if rep is not None:
@@ -1397,7 +1473,9 @@ class TpuShuffleManager:
                              shuffle_id=handle.shuffle_id,
                              rows=int(nvalid.sum()), width=width,
                              hierarchical=self.hierarchical,
-                             distributed=True):
+                             distributed=True,
+                             trace=rep.trace_id if rep is not None
+                             else ""):
                 vt = val_tail if has_vals else None
                 # flat-only transport: pallas on a multi-slice mesh rides
                 # the flattened alias mesh, same as the local path

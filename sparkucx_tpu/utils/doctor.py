@@ -1,0 +1,470 @@
+"""Shuffle doctor — a rule engine that turns telemetry into graded findings.
+
+The reference's whole diagnostic story is four grep-able log lines (SURVEY
+§5: map-publish overhead, per-request completion ms, per-endpoint fetch
+bytes+ms, fetch-wait into Spark's reporter) — the operator stares at logs
+and concludes "peer 3 is a straggler". PR 2 replaced the log lines with a
+telemetry plane (histograms, ExchangeReports, flight recorder); this module
+is the layer the plane was built for: rules that read local or gathered
+snapshots and emit :class:`Finding`\\ s — graded info/warn/critical, with
+the evidence values and the conf key to turn — the "diagnose, don't just
+record" move Ray's state observability and Dapper-style correlated tracing
+made standard for distributed data planes (PAPERS.md).
+
+Inputs are the canonical snapshot documents everything else already
+produces (``export.collect_snapshot``, periodic dumps, flight postmortems,
+``manager.gather_reports``) — one doc for a process-local diagnosis, a
+list of docs for a cluster-wide one. Histograms aggregate exactly across
+processes (``Histogram.from_snapshot`` + ``merge`` — same fixed bucket
+ladder everywhere), counters sum, and exchange reports concatenate with
+process attribution, so a rule never has to care whether it is looking at
+one process or thirty-two.
+
+Rules (each names its remediation conf key):
+
+================  =======================================  =====================================
+rule              fires on                                 conf key
+================  =======================================  =====================================
+straggler_peer    per-peer bytes / per-process group_ms    spark.shuffle.tpu.network.timeoutMs
+                  outlier vs cluster median; warmup
+                  (compile-bearing) reads are excluded
+                  via the first_wait split
+partition_skew    ExchangeReport skew_ratio                spark.shuffle.tpu.a2a.capacityFactor
+retry_storm       failure.retry.ms observation count       spark.shuffle.tpu.failure.maxAttempts
+compile_churn     step-cache miss ratio                    spark.shuffle.tpu.a2a.capBucketGrowth
+pool_pressure     arena in_use vs allocated watermark      spark.shuffle.tpu.memory.preAllocateBuffers
+overflow_loop     overflow retries despite the cap hint    spark.shuffle.tpu.a2a.capacityFactor
+cold_start        first_wait p50 ≫ steady-state wait p50   spark.shuffle.tpu.compile.cacheEnabled
+================  =======================================  =====================================
+
+The same :class:`Finding` schema carries ``bench.py --stage regress``
+output, so perf regressions and runtime anomalies read identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
+                                        COMPILE_SECONDS, H_FETCH_FIRST,
+                                        H_FETCH_WAIT, H_RETRY_MS,
+                                        Histogram)
+
+GRADES = ("info", "warn", "critical")
+_GRADE_ORDER = {g: i for i, g in enumerate(GRADES)}
+
+
+@dataclass
+class Finding:
+    """One graded diagnosis: what fired, the evidence values that made it
+    fire, and the remediation knob. ``trace_ids`` link back to the
+    exchanges involved — the same ids on the timeline tracks and in
+    flight-ring events."""
+
+    rule: str
+    grade: str                     # info | warn | critical
+    summary: str
+    evidence: Dict[str, Any] = field(default_factory=dict)
+    conf_key: Optional[str] = None
+    remediation: str = ""
+    trace_ids: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if self.grade not in GRADES:
+            raise ValueError(f"grade {self.grade!r} not in {GRADES}")
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass(frozen=True)
+class Thresholds:
+    """Rule trip points. Deliberately conservative defaults: a healthy
+    cluster must diagnose CLEAN (the zero-findings golden test), so every
+    rule pairs its ratio with a minimum-signal floor."""
+
+    straggler_ratio: float = 3.0       # outlier vs cluster median
+    straggler_min_ms: float = 50.0     # ignore sub-noise group_ms spreads
+    straggler_min_reads: int = 4       # wait-histogram signal floor
+    skew_warn: float = 4.0             # ExchangeReport.skew_ratio
+    skew_critical: float = 16.0
+    retry_warn: int = 3                # failure.retry.ms observations
+    retry_critical: int = 10
+    churn_min_programs: int = 4        # below this, compiles are startup
+    churn_miss_ratio: float = 0.5      # programs / (programs + hits)
+    pool_pressure_ratio: float = 0.9   # in_use / allocated
+    pool_min_allocated: int = 8        # tiny pools are never "pressure"
+    overflow_warn_exchanges: int = 2   # hint should have absorbed by then
+    cold_start_ratio: float = 10.0     # first_wait p50 / wait p50
+
+
+# -- snapshot normalization ------------------------------------------------
+@dataclass
+class ClusterView:
+    """N per-process snapshot docs folded into one diagnosable view."""
+
+    counters: Dict[str, float]
+    histograms: Dict[str, Histogram]
+    reports: List[Dict]            # each with "process_id" attribution
+    pools: List[Dict]              # per-process arena stats, if present
+    processes: int = 1
+
+
+def _reports_of(doc: Dict) -> List[Dict]:
+    """Exchange reports from any producer's schema: live snapshots carry
+    ``exchange_reports``; flight postmortems nest them under
+    ``contexts.exchange_reports`` (the provider key)."""
+    reps = doc.get("exchange_reports")
+    if reps is None:
+        reps = (doc.get("contexts") or {}).get("exchange_reports")
+    return [r for r in (reps or []) if isinstance(r, dict)]
+
+
+def build_view(snapshots: Union[Dict, Iterable[Dict]]) -> ClusterView:
+    """Normalize one doc or a list of per-process docs into a
+    :class:`ClusterView`. Exact aggregation: histogram buckets add
+    (same fixed ladder), counters sum, reports concatenate. Multiple
+    captures of the SAME process (a dump dir holding its metrics
+    snapshot AND its flight postmortem, each embedding the same
+    cumulative registries) collapse to one first — summing them would
+    silently halve every rule's threshold."""
+    if isinstance(snapshots, dict):
+        snapshots = [snapshots]
+    from sparkucx_tpu.utils.export import dedupe_process_docs
+    docs = dedupe_process_docs(snapshots)
+    counters: Dict[str, float] = {}
+    hists: Dict[str, Histogram] = {}
+    reports: List[Dict] = []
+    pools: List[Dict] = []
+    for i, doc in enumerate(docs):
+        pid = doc.get("process_id", doc.get("pid", i))
+        for name, v in (doc.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0.0) + float(v)
+        for name, snap in (doc.get("histograms") or {}).items():
+            h = Histogram.from_snapshot(snap, name)
+            if name in hists:
+                hists[name].merge(h)
+            else:
+                hists[name] = h
+        for r in _reports_of(doc):
+            r = dict(r)
+            r.setdefault("process_id", pid)
+            reports.append(r)
+        if isinstance(doc.get("pool"), dict):
+            pools.append({"process_id": pid, **doc["pool"]})
+    return ClusterView(counters, hists, reports, pools,
+                       processes=max(1, len(docs)))
+
+
+def _median(vals: List[float]) -> float:
+    s = sorted(vals)
+    n = len(s)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _completed(view: ClusterView) -> List[Dict]:
+    return [r for r in view.reports if r.get("completed")]
+
+
+def _steady(reports: List[Dict]) -> List[Dict]:
+    """Warmup-free reports: a read whose step-cache delta shows fresh
+    programs paid XLA compile in-band (the H_FETCH_FIRST population) —
+    its timings say nothing about peers and are excluded from every
+    outlier rule."""
+    return [r for r in reports if not r.get("stepcache_programs")]
+
+
+# -- rules -----------------------------------------------------------------
+def _rule_straggler(view: ClusterView, th: Thresholds) -> List[Finding]:
+    out: List[Finding] = []
+    steady = _steady(_completed(view))
+    # (a) per-peer byte imbalance within an exchange: the overloaded peer
+    # is the one every other process ends up waiting on
+    worst = None
+    for r in steady:
+        pb = [float(x) for x in (r.get("peer_bytes") or []) if x >= 0]
+        med = _median(pb)
+        if len(pb) >= 2 and med > 0:
+            ratio = max(pb) / med
+            if worst is None or ratio > worst[0]:
+                worst = (ratio, pb.index(max(pb)), r)
+    if worst is not None and worst[0] >= th.straggler_ratio:
+        ratio, peer, r = worst
+        out.append(Finding(
+            rule="straggler_peer",
+            grade="critical" if ratio >= 2 * th.straggler_ratio
+            else "warn",
+            summary=(f"peer {peer} carries {ratio:.1f}x the median "
+                     f"per-peer bytes in shuffle {r.get('shuffle_id')} "
+                     f"— every other peer waits on it"),
+            evidence={"peer": peer, "ratio": round(ratio, 2),
+                      "peer_bytes": r.get("peer_bytes"),
+                      "shuffle_id": r.get("shuffle_id")},
+            conf_key="spark.shuffle.tpu.network.timeoutMs",
+            remediation=("rebalance map placement so no peer stages a "
+                         "multiple of the median; if the imbalance is "
+                         "inherent, raise "
+                         "spark.shuffle.tpu.network.timeoutMs so slow "
+                         "exchanges fail soft, and consider "
+                         "a2a.maxBytesInFlight backpressure"),
+            trace_ids=[r.get("trace_id", "")]))
+    # (b) cluster mode: one process's group (collective + regroup) phase
+    # an outlier vs the cluster median for the SAME exchange
+    by_trace: Dict[str, List[Dict]] = {}
+    for r in steady:
+        if r.get("trace_id"):
+            by_trace.setdefault(r["trace_id"], []).append(r)
+    for trace, rs in sorted(by_trace.items()):
+        if len(rs) < 2:
+            continue
+        gms = [float(r.get("group_ms", 0.0)) for r in rs]
+        med = _median(gms)
+        mx = max(gms)
+        if med > 0 and mx >= th.straggler_min_ms \
+                and mx / med >= th.straggler_ratio:
+            slow = rs[gms.index(mx)]
+            out.append(Finding(
+                rule="straggler_peer",
+                grade="critical" if mx / med >= 2 * th.straggler_ratio
+                else "warn",
+                summary=(f"process {slow.get('process_id')} spent "
+                         f"{mx:.0f} ms in exchange {trace} vs cluster "
+                         f"median {med:.0f} ms — straggler host"),
+                evidence={"process_id": slow.get("process_id"),
+                          "group_ms": round(mx, 1),
+                          "cluster_median_ms": round(med, 1),
+                          "ratio": round(mx / med, 2)},
+                conf_key="spark.shuffle.tpu.network.timeoutMs",
+                remediation=("inspect that host (thermal/preemption/"
+                             "network); remesh without it if persistent "
+                             "— its timeline track shows where the "
+                             "time went"),
+                trace_ids=[trace]))
+    # (c) wait-distribution spread as supporting evidence (warmup-free by
+    # construction: compile-bearing reads observe into first_wait_ms)
+    hw = view.histograms.get(H_FETCH_WAIT)
+    if hw is not None and hw.count >= th.straggler_min_reads:
+        p50, p99 = hw.quantile(0.5), hw.quantile(0.99)
+        if p50 > 0 and p99 / p50 >= th.straggler_ratio \
+                and p99 >= th.straggler_min_ms:
+            out.append(Finding(
+                rule="straggler_peer", grade="info",
+                summary=(f"fetch-wait p99 {p99:.0f} ms is "
+                         f"{p99 / p50:.1f}x p50 {p50:.1f} ms over "
+                         f"{hw.count} steady-state reads — intermittent "
+                         f"slow exchanges"),
+                evidence={"p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+                          "reads": hw.count},
+                conf_key="spark.shuffle.tpu.trace.enabled",
+                remediation=("enable tracing and pull the merged "
+                             "timeline (python -m sparkucx_tpu "
+                             "timeline) to see which peer the slow "
+                             "reads wait on")))
+    return out
+
+
+def _rule_skew(view: ClusterView, th: Thresholds) -> List[Finding]:
+    worst = None
+    for r in _completed(view):
+        s = float(r.get("skew_ratio", 0.0))
+        if worst is None or s > worst[0]:
+            worst = (s, r)
+    if worst is None or worst[0] < th.skew_warn:
+        return []
+    s, r = worst
+    return [Finding(
+        rule="partition_skew",
+        grade="critical" if s >= th.skew_critical else "warn",
+        summary=(f"shuffle {r.get('shuffle_id')}: hottest partition "
+                 f"holds {s:.1f}x the mean rows "
+                 f"({r.get('num_partitions')} partitions) — one shard "
+                 f"serializes the exchange"),
+        evidence={"skew_ratio": round(s, 2),
+                  "shuffle_id": r.get("shuffle_id"),
+                  "num_partitions": r.get("num_partitions"),
+                  "partitioner": r.get("partitioner")},
+        conf_key="spark.shuffle.tpu.a2a.capacityFactor",
+        remediation=("repartition or salt the hot key; raising "
+                     "spark.shuffle.tpu.a2a.capacityFactor buys headroom "
+                     "(HBM for overflow retries) but does not fix the "
+                     "imbalance"),
+        trace_ids=[r.get("trace_id", "")])]
+
+
+def _rule_retry_storm(view: ClusterView, th: Thresholds) -> List[Finding]:
+    h = view.histograms.get(H_RETRY_MS)
+    n = h.count if h is not None else 0
+    if n < th.retry_warn:
+        return []
+    return [Finding(
+        rule="retry_storm",
+        grade="critical" if n >= th.retry_critical else "warn",
+        summary=(f"{n} failed attempts burned "
+                 f"{h.sum:.0f} ms in retry latency (p99 "
+                 f"{h.quantile(0.99):.0f} ms) — the control plane is "
+                 f"retrying its way through a persistent fault"),
+        evidence={"retries": n, "total_ms": round(h.sum, 1),
+                  "p50_ms": round(h.quantile(0.5), 2),
+                  "p99_ms": round(h.quantile(0.99), 2)},
+        conf_key="spark.shuffle.tpu.failure.maxAttempts",
+        remediation=("find the faulting site in the flight ring (retry "
+                     "events carry the trace id); if the fault is "
+                     "genuinely transient, raise failure.backoffMs so "
+                     "retries stop stampeding; lowering "
+                     "failure.maxAttempts fails faster instead"))]
+
+
+def _rule_compile_churn(view: ClusterView,
+                        th: Thresholds) -> List[Finding]:
+    programs = view.counters.get(COMPILE_PROGRAMS, 0.0)
+    hits = view.counters.get(COMPILE_HITS, 0.0)
+    total = programs + hits
+    if programs < th.churn_min_programs or total <= 0:
+        return []
+    miss = programs / total
+    if miss < th.churn_miss_ratio:
+        return []
+    secs = view.counters.get(COMPILE_SECONDS, 0.0)
+    return [Finding(
+        rule="compile_churn",
+        grade="critical" if miss >= 0.8 else "warn",
+        summary=(f"{programs:.0f} distinct exchange programs compiled "
+                 f"vs {hits:.0f} cache hits ({miss:.0%} miss, "
+                 f"{secs:.1f} s of compile) — plan shapes are churning "
+                 f"the step cache"),
+        evidence={"programs": int(programs), "hits": int(hits),
+                  "miss_ratio": round(miss, 3),
+                  "compile_seconds": round(secs, 2)},
+        conf_key="spark.shuffle.tpu.a2a.capBucketGrowth",
+        remediation=("raise spark.shuffle.tpu.a2a.capBucketGrowth (wider "
+                     "capacity buckets, fewer distinct shapes) and keep "
+                     "a2a.capBuckets on; the persistent compile cache "
+                     "(compile.cacheEnabled) amortizes what remains "
+                     "across processes"))]
+
+
+def _rule_pool_pressure(view: ClusterView,
+                        th: Thresholds) -> List[Finding]:
+    out: List[Finding] = []
+    for p in view.pools:
+        allocated = float(p.get("allocated", 0))
+        in_use = float(p.get("in_use", 0))
+        prealloc = float(p.get("preallocated", 0))
+        if allocated < th.pool_min_allocated:
+            continue
+        ratio = in_use / allocated if allocated else 0.0
+        if ratio < th.pool_pressure_ratio:
+            continue
+        out.append(Finding(
+            rule="pool_pressure",
+            grade="warn",
+            summary=(f"process {p.get('process_id')}: {in_use:.0f} of "
+                     f"{allocated:.0f} arena blocks in use "
+                     f"({ratio:.0%} high-watermark, {prealloc:.0f} "
+                     f"preallocated) — the pinned pool is running at "
+                     f"its ceiling"),
+            evidence={"process_id": p.get("process_id"),
+                      "in_use": int(in_use), "allocated": int(allocated),
+                      "preallocated": int(prealloc),
+                      "ratio": round(ratio, 3)},
+            conf_key="spark.shuffle.tpu.memory.preAllocateBuffers",
+            remediation=("preallocate the hot size classes "
+                         "(memory.preAllocateBuffers=size:count,...) and "
+                         "raise memory.minAllocationSize; if growth is "
+                         "unbounded, cap concurrent exchanges with "
+                         "a2a.maxBytesInFlight")))
+    return out
+
+
+def _rule_overflow_loop(view: ClusterView,
+                        th: Thresholds) -> List[Finding]:
+    over = [r for r in view.reports if int(r.get("retries", 0)) > 0]
+    if len(over) < th.overflow_warn_exchanges:
+        return []
+    total = sum(int(r.get("retries", 0)) for r in over)
+    return [Finding(
+        rule="overflow_loop",
+        grade="warn",
+        summary=(f"{len(over)} exchanges paid {total} overflow retries "
+                 f"(capacity growth + recompile) — the learned cap hint "
+                 f"is not absorbing the skew"),
+        evidence={"exchanges": len(over), "total_retries": total,
+                  "shuffle_ids": sorted({r.get("shuffle_id")
+                                         for r in over}),
+                  "plan_buckets": [r.get("plan_bucket") for r in over]},
+        conf_key="spark.shuffle.tpu.a2a.capacityFactor",
+        remediation=("raise spark.shuffle.tpu.a2a.capacityFactor so the "
+                     "first plan provisions the skewed shape; "
+                     "a2a.capBucketGrowth > 1.25 also widens each "
+                     "retry's jump"),
+        trace_ids=sorted({r.get("trace_id", "") for r in over}))]
+
+
+def _rule_cold_start(view: ClusterView, th: Thresholds) -> List[Finding]:
+    hf = view.histograms.get(H_FETCH_FIRST)
+    hw = view.histograms.get(H_FETCH_WAIT)
+    if hf is None or hw is None or not hf.count or not hw.count:
+        return []
+    f50, w50 = hf.quantile(0.5), hw.quantile(0.5)
+    if w50 <= 0 or f50 / w50 < th.cold_start_ratio:
+        return []
+    return [Finding(
+        rule="cold_start",
+        grade="info",
+        summary=(f"compile-bearing reads cost {f50:.0f} ms p50 vs "
+                 f"{w50:.1f} ms steady-state ({f50 / w50:.0f}x) across "
+                 f"{hf.count} first reads — in-band XLA compile"),
+        evidence={"first_wait_p50_ms": round(f50, 1),
+                  "steady_p50_ms": round(w50, 2),
+                  "first_reads": hf.count},
+        conf_key="spark.shuffle.tpu.compile.cacheEnabled",
+        remediation=("keep compile.cacheEnabled on (persistent cache "
+                     "amortizes across restarts) and warmup() handles "
+                     "while map tasks run so compile overlaps the map "
+                     "phase"))]
+
+
+_RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
+          _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
+          _rule_cold_start)
+
+
+def diagnose(snapshots: Union[Dict, Iterable[Dict]],
+             thresholds: Optional[Thresholds] = None) -> List[Finding]:
+    """Run every rule over one snapshot doc (process-local diagnosis) or
+    a list of per-process docs (cluster-wide), most severe first. The
+    zero-findings result IS the healthy verdict — rules carry
+    minimum-signal floors so an idle or balanced cluster diagnoses
+    clean."""
+    th = thresholds or Thresholds()
+    view = build_view(snapshots)
+    findings: List[Finding] = []
+    for rule in _RULES:
+        findings.extend(rule(view, th))
+    findings.sort(key=lambda f: (-_GRADE_ORDER[f.grade], f.rule))
+    return findings
+
+
+def render_findings(findings: List[Finding]) -> str:
+    """Human-readable findings report (the CLI's default output)."""
+    if not findings:
+        return "doctor: no findings — telemetry looks healthy\n"
+    lines = [f"doctor: {len(findings)} finding(s)"]
+    for f in findings:
+        lines.append(f"[{f.grade.upper():>8}] {f.rule}: {f.summary}")
+        if f.evidence:
+            ev = ", ".join(f"{k}={v}" for k, v in f.evidence.items())
+            lines.append(f"           evidence: {ev}")
+        if f.conf_key:
+            lines.append(f"           turn: {f.conf_key}")
+        if f.remediation:
+            lines.append(f"           fix: {f.remediation}")
+        ts = [t for t in f.trace_ids if t]
+        if ts:
+            lines.append(f"           traces: {', '.join(ts)}")
+    return "\n".join(lines) + "\n"

@@ -53,6 +53,24 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def merge_histogram_snapshots(into: Dict[str, Dict],
+                              new: Dict[str, Dict]) -> None:
+    """Fold ``new``'s histogram snapshots into ``into`` in place. Name
+    collisions MERGE (exact — same fixed bucket ladder) rather than
+    last-writer-wins: every registry pre-creates the well-known
+    histograms, so plain dict.update would let a later registry's EMPTY
+    compile.step.duration_s clobber the populated one the step cache
+    observed into the process-global registry."""
+    from sparkucx_tpu.utils.metrics import Histogram
+    for name, snap in new.items():
+        prev = into.get(name)
+        if prev is None or not prev.get("count"):
+            into[name] = snap
+        elif snap.get("count"):
+            into[name] = Histogram.from_snapshot(prev, name).merge(
+                Histogram.from_snapshot(snap, name)).snapshot()
+
+
 def collect_snapshot(metrics: Union[Metrics, Iterable[Metrics]],
                      tracer: Optional[Tracer] = None,
                      reports: Optional[List[Dict]] = None,
@@ -60,29 +78,159 @@ def collect_snapshot(metrics: Union[Metrics, Iterable[Metrics]],
     """Build the canonical snapshot document.
 
     ``metrics`` may be one registry or several (the node's registry plus
-    the process-global one the step cache reports into) — counters and
-    histograms merge, later registries winning name collisions."""
+    the process-global one the step cache reports into) — counters
+    merge with later registries winning name collisions (each counter
+    name has ONE owning registry), histograms merge exactly (see
+    :func:`merge_histogram_snapshots`)."""
     if isinstance(metrics, Metrics):
         metrics = [metrics]
     counters: Dict[str, float] = {}
     histograms: Dict[str, Dict] = {}
     for m in metrics:
         counters.update(m.snapshot())
-        histograms.update(m.histograms())
+        merge_histogram_snapshots(histograms, m.histograms())
     doc = {
         "ts": time.time(),
         "pid": os.getpid(),
         "counters": counters,
         "histograms": histograms,
     }
+    # Clock anchor: doc["ts"] is wall time while spans are perf_counter
+    # epochs — without the wall↔perf pair an offline consumer can only
+    # misalign multi-process dumps (satellite: the stats/trace/timeline
+    # CLIs now REJECT anchor-less inputs instead). The tracer owns the
+    # span epoch, so the anchor comes from it; anchor-less snapshots do
+    # not exist anymore, only pre-PR dumps lack the key.
+    from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+    anchor_src = tracer if tracer is not None else GLOBAL_TRACER
+    doc["anchor"] = anchor_src.anchor()
     if tracer is not None:
         doc["spans"] = tracer.summary()
         doc["dropped_spans"] = tracer.dropped
+        # raw chrome events ride along so a dump directory is a timeline
+        # source (`python -m sparkucx_tpu timeline --input <dir>`); empty
+        # when the tracer is off — the common production setting
+        doc["trace_events"] = tracer.chrome_events()
     if reports is not None:
         doc["exchange_reports"] = reports
     if extra:
         doc.update(extra)
     return doc
+
+
+def require_anchor(doc: Dict, source: str = "dump") -> Dict:
+    """The wall↔perf anchor of a snapshot/dump doc, or a loud error.
+    Anchor-less dumps (pre-anchor writers, hand-edited files) cannot be
+    placed on a shared timeline; silently treating their span epochs as
+    wall time misaligns every track, so offline consumers fail fast."""
+    a = doc.get("anchor")
+    if not isinstance(a, dict) or "wall_epoch" not in a:
+        raise ValueError(
+            f"{source} carries no clock anchor (no 'anchor.wall_epoch' "
+            f"key): written by a pre-anchor version? Re-capture the dump "
+            f"— span timestamps cannot be aligned without the wall<->perf "
+            f"anchor pair")
+    return a
+
+
+def dedupe_process_docs(docs: Iterable[Dict]) -> List[Dict]:
+    """Collapse multiple captures of the SAME process into one doc. A
+    dump directory typically holds both a process's rolling metrics
+    snapshot and its flight postmortem(s), each embedding the same
+    cumulative registries and span ring — summing them would double-
+    count every counter/histogram (halving the doctor's thresholds) and
+    render every span twice on fabricated tracks. Processes are keyed
+    by (process_id, pid); within a key the doc with the latest ts (tie:
+    most trace events) wins — registries are cumulative, so latest is a
+    superset — and exchange reports from the dropped docs fold in,
+    deduplicated by trace id, so a postmortem-only report survives."""
+    groups: Dict = {}
+    order: List = []
+    for i, doc in enumerate(docs):
+        key = (doc.get("process_id"), doc.get("pid"))
+        if key == (None, None):
+            key = ("__unkeyed__", i)
+        if key not in groups:
+            groups[key] = []
+            order.append(key)
+        groups[key].append(doc)
+
+    def _reports_in(doc):
+        reps = doc.get("exchange_reports")
+        if reps is None:
+            reps = (doc.get("contexts") or {}).get("exchange_reports")
+        return [r for r in (reps or []) if isinstance(r, dict)]
+
+    out: List[Dict] = []
+    for key in order:
+        group = groups[key]
+        if len(group) == 1:
+            out.append(group[0])
+            continue
+        best = max(group, key=lambda d: (
+            d.get("ts", 0.0),
+            len(d.get("trace_events", d.get("events", [])))))
+        merged = dict(best)
+        seen, reports = set(), []
+        for doc in group:
+            for r in _reports_in(doc):
+                rk = r.get("trace_id") or json.dumps(
+                    r, sort_keys=True, default=repr)
+                if rk not in seen:
+                    seen.add(rk)
+                    reports.append(r)
+        if reports:
+            # the flat key shadows any contexts.exchange_reports copy
+            # (doctor's _reports_of prefers it), so nothing double-reads
+            merged["exchange_reports"] = reports
+        out.append(merged)
+    return out
+
+
+def merge_timeline(docs: Iterable[Dict]) -> Dict:
+    """Merge per-process span captures into ONE Chrome/Perfetto trace doc
+    with a track (pid) per process, clock-aligned via each capture's
+    wall↔perf anchor.
+
+    Each doc needs an ``anchor`` (see :func:`require_anchor`) and chrome
+    events under ``trace_events`` (snapshots, flight postmortems) or
+    ``events`` (``manager.gather_spans()`` blobs). Event timestamps are
+    per-process perf offsets; the merge rebases them onto a shared
+    wall-clock zero (the earliest span epoch across processes), so a
+    fetch that waited on a straggler peer visibly overlaps that peer's
+    late dispatch in the merged view."""
+    docs = dedupe_process_docs(docs)
+    if not docs:
+        raise ValueError("merge_timeline: no input docs")
+    anchors = [require_anchor(d, f"timeline input {i}")
+               for i, d in enumerate(docs)]
+    t0 = min(a["wall_epoch"] for a in anchors)
+    # Track identity: the jax process index when the captures are from
+    # distinct cluster members, else the OS pid (N single-process dumps
+    # all claim process_id 0 — they must not collapse onto one track),
+    # else the input index.
+    procs = [d.get("process_id") for d in docs]
+    ospids = [d.get("pid") for d in docs]
+    if None not in procs and len(set(procs)) == len(docs):
+        tracks = [(int(p), f"process {int(p)}") for p in procs]
+    elif None not in ospids and len(set(ospids)) == len(docs):
+        tracks = [(int(o), f"pid {int(o)}") for o in ospids]
+    else:
+        tracks = [(i, f"track {i}") for i in range(len(docs))]
+    events: List[Dict] = []
+    for (doc, a, (pid, label)) in zip(docs, anchors, tracks):
+        shift_us = (a["wall_epoch"] - t0) * 1e6
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": label}})
+        for ev in doc.get("trace_events", doc.get("events", [])):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"processes": len(docs),
+                         "wall_epoch_zero": t0}}
 
 
 def render_json(doc: Dict, indent: int = 1) -> str:

@@ -35,13 +35,19 @@ COMPILE_SECONDS = "compile.step.seconds"     # first-invocation wall secs
 # reason as the compile counters; every registry pre-creates them so an
 # exporter always has the full surface even before the first shuffle.
 H_FETCH_WAIT = "shuffle.read.wait_ms"        # per-read fetch-wait (ms)
+# Compile-bearing reads land HERE, not in H_FETCH_WAIT: the first read of
+# a plan shape pays XLA compile in-band (BENCH_r05: fetch_p99=3003 ms vs
+# p50=1.7 from exactly this), which would poison any straggler/outlier
+# rule keyed on the wait distribution. A read is "first" when its
+# ExchangeReport shows fresh step-cache programs (stepcache_programs > 0).
+H_FETCH_FIRST = "shuffle.read.first_wait_ms"
 H_PEER_ROWS = "shuffle.peer.rows"            # rows per peer per exchange
 H_PEER_BYTES = "shuffle.peer.bytes"          # bytes per peer per exchange
 H_RETRY_MS = "failure.retry.ms"              # failed-attempt latency (ms)
 H_COMPILE_SECS = "compile.step.duration_s"   # per-program compile seconds
 
-WELL_KNOWN_HISTOGRAMS = (H_FETCH_WAIT, H_PEER_ROWS, H_PEER_BYTES,
-                         H_RETRY_MS, H_COMPILE_SECS)
+WELL_KNOWN_HISTOGRAMS = (H_FETCH_WAIT, H_FETCH_FIRST, H_PEER_ROWS,
+                         H_PEER_BYTES, H_RETRY_MS, H_COMPILE_SECS)
 
 
 class Histogram:
@@ -112,42 +118,114 @@ class Histogram:
                 return min(max(est, self.min), self.max)
         return self.max
 
+    def _percentiles_locked(self) -> Dict[str, float]:
+        if self.count == 0:
+            return {"count": 0.0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                    "mean": 0.0, "p50": 0.0, "p99": 0.0}
+        return {
+            "count": float(self.count),
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": self.sum / self.count,
+            "p50": self._quantile_locked(0.50),
+            "p99": self._quantile_locked(0.99),
+        }
+
     def percentiles(self) -> Dict[str, float]:
         with self._lock:
-            if self.count == 0:
-                return {"count": 0.0, "sum": 0.0, "min": 0.0, "max": 0.0,
-                        "mean": 0.0, "p50": 0.0, "p99": 0.0}
-            return {
-                "count": float(self.count),
-                "sum": self.sum,
-                "min": self.min,
-                "max": self.max,
-                "mean": self.sum / self.count,
-                "p50": self._quantile_locked(0.50),
-                "p99": self._quantile_locked(0.99),
-            }
+            return self._percentiles_locked()
+
+    def _buckets_locked(self) -> List[Tuple[float, int]]:
+        out: List[Tuple[float, int]] = []
+        cum = self._nonpos
+        if self._nonpos:
+            out.append((0.0, cum))
+        for idx in sorted(self._counts):
+            cum += self._counts[idx]
+            out.append((self.GROWTH ** idx, cum))
+        out.append((math.inf, self.count))
+        return out
 
     def buckets(self) -> List[Tuple[float, int]]:
         """Cumulative ``(upper_bound, count_leq)`` pairs over occupied
         buckets plus the +Inf terminal — the Prometheus histogram series
         shape (utils/export.py renders these as ``_bucket{le=...}``)."""
         with self._lock:
-            out: List[Tuple[float, int]] = []
-            cum = self._nonpos
-            if self._nonpos:
-                out.append((0.0, cum))
-            for idx in sorted(self._counts):
-                cum += self._counts[idx]
-                out.append((self.GROWTH ** idx, cum))
-            out.append((math.inf, self.count))
-            return out
+            return self._buckets_locked()
 
     def snapshot(self) -> Dict:
         """percentiles() plus the bucket series — the JSON-able full
-        state an exporter or flight-recorder dump embeds."""
-        snap = self.percentiles()
-        snap["buckets"] = [[le, c] for le, c in self.buckets()]
+        state an exporter or flight-recorder dump embeds. The bucket
+        bounds are exact ladder values (GROWTH**k survives a JSON float
+        round-trip bit-for-bit), so :meth:`from_snapshot` reconstructs
+        the histogram losslessly — the property the doctor's
+        cluster-wide aggregation (merge over per-process dumps) rides.
+        ONE lock acquisition for both halves: a concurrent observe
+        between percentiles and buckets would otherwise publish a +Inf
+        bucket that disagrees with ``count`` — invalid Prometheus
+        exposition and a skewed from_snapshot reconstruction."""
+        with self._lock:
+            snap = self._percentiles_locked()
+            snap["buckets"] = [[le, c]
+                               for le, c in self._buckets_locked()]
         return snap
+
+    # to_snapshot is the doctor-facing name; snapshot() predates it
+    to_snapshot = snapshot
+
+    @classmethod
+    def from_snapshot(cls, snap: Dict, name: str = "") -> "Histogram":
+        """Rebuild a histogram from :meth:`snapshot` output (a dump
+        written by another process, possibly dead). Per-bucket counts
+        come from differencing the cumulative series; the bucket index
+        from inverting the exact ladder bound."""
+        h = cls(name)
+        count = int(snap.get("count", 0))
+        if count == 0:
+            return h
+        h.count = count
+        h.sum = float(snap.get("sum", 0.0))
+        h.min = float(snap.get("min", 0.0))
+        h.max = float(snap.get("max", 0.0))
+        prev = 0
+        for le, cum in snap.get("buckets", []):
+            le, cum = float(le), int(cum)
+            c, prev = cum - prev, cum
+            if c <= 0:
+                continue
+            if le <= 0.0:
+                h._nonpos += c
+            elif le == math.inf:
+                # terminal diff should be 0 for a well-formed snapshot;
+                # a truncated bucket list attributes the tail to max
+                idx = h._index(h.max if h.max > 0 else 1.0)
+                h._counts[idx] = h._counts.get(idx, 0) + c
+            else:
+                idx = int(round(math.log(le) / cls._LOG_G))
+                h._counts[idx] = h._counts.get(idx, 0) + c
+        return h
+
+    def merge(self, other: "Histogram") -> "Histogram":
+        """Fold ``other``'s observations into this histogram (exact —
+        same fixed ladder, so bucket counts add). The cluster-wide
+        aggregation primitive: N per-process dumps merge into ONE
+        distribution the doctor's rules evaluate. Returns self."""
+        with other._lock:
+            counts = dict(other._counts)
+            nonpos, count = other._nonpos, other.count
+            osum, omin, omax = other.sum, other.min, other.max
+        with self._lock:
+            for idx, c in counts.items():
+                self._counts[idx] = self._counts.get(idx, 0) + c
+            self._nonpos += nonpos
+            self.count += count
+            self.sum += osum
+            if omin < self.min:
+                self.min = omin
+            if omax > self.max:
+                self.max = omax
+        return self
 
 
 class Timer:

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import os
 import threading
 import time
 from collections import defaultdict, deque
@@ -37,6 +38,16 @@ from typing import Any, Dict, List, Optional
 from sparkucx_tpu.utils.logging import get_logger
 
 log = get_logger("trace")
+
+
+def format_trace_id(shuffle_id: int, epoch: int, seq: int) -> str:
+    """The cluster-correlation key ``(shuffle_id, epoch, exchange_seq)``
+    as one grep-able token, ``s<sid>.e<epoch>.x<seq>``. Reads are
+    collective and execute in the same order on every process (the SPMD
+    discipline), so the per-process exchange sequence number agrees
+    cluster-wide — the same trace id names the same exchange in every
+    process's spans, reports and flight events."""
+    return f"s{shuffle_id}.e{epoch}.x{seq}"
 
 
 @dataclass
@@ -155,6 +166,29 @@ class Tracer:
                 self._dropped += 1
             self._spans.append(s)
 
+    # -- clock anchoring ---------------------------------------------------
+    def anchor(self) -> Dict[str, float]:
+        """The wall↔perf anchor pair that makes this process's span
+        timestamps comparable across processes. Span ``start_us`` is
+        ``perf_counter`` relative to the tracer's private epoch — a
+        monotonic clock with an arbitrary per-process zero — so two
+        processes' spans cannot be merged without knowing where each
+        epoch sits on the (NTP-shared) wall clock. ``wall_epoch`` is
+        exactly that: the wall time at span ts=0, sampled as an adjacent
+        (time.time, perf_counter) pair so the conversion error is one
+        scheduler quantum, not the process's lifetime drift. Embedded in
+        every snapshot/dump (export.collect_snapshot) and allgathered at
+        connect (runtime/node.py) so offline timeline merging is exact."""
+        perf = time.perf_counter()
+        wall = time.time()
+        return {
+            "wall": wall,                       # the sample pair itself
+            "perf": perf,
+            "perf_epoch": self._epoch,          # span ts=0 in perf time
+            "wall_epoch": wall - (perf - self._epoch),  # span ts=0, wall
+            "pid": float(os.getpid()),
+        }
+
     # -- inspection -------------------------------------------------------
     def spans(self, name: Optional[str] = None) -> List[Span]:
         with self._lock:
@@ -214,13 +248,24 @@ class Tracer:
     # -- export -----------------------------------------------------------
     def chrome_events(self) -> List[Dict[str, Any]]:
         """The span buffer as Chrome trace-event dicts (the 'X' events of
-        a ``traceEvents`` list) — shared by the file export and the
-        flight recorder's postmortem embed."""
-        return [{
-            "name": s.name, "ph": "X", "ts": s.start_us, "dur": s.dur_us,
-            "pid": 0, "tid": s.tid,
-            "args": {k: _jsonable(v) for k, v in s.attrs.items()},
-        } for s in self.spans()]
+        a ``traceEvents`` list) — shared by the file export, snapshot
+        embedding, and the flight recorder's postmortem. Runs per
+        snapshot/doctor pass over the full ring, so the conversion skips
+        the per-attr sanitizer pass when every attr is already a
+        primitive (the overwhelmingly common case)."""
+        out: List[Dict[str, Any]] = []
+        prim = (str, int, float, bool)
+        for s in self.spans():
+            attrs = s.attrs
+            if attrs and any(type(v) not in prim and v is not None
+                             for v in attrs.values()):
+                attrs = {k: _jsonable(v) for k, v in attrs.items()}
+            else:
+                attrs = dict(attrs)    # events must not alias the span
+            out.append({
+                "name": s.name, "ph": "X", "ts": s.start_us,
+                "dur": s.dur_us, "pid": 0, "tid": s.tid, "args": attrs})
+        return out
 
     def export_chrome_trace(self, path: str) -> int:
         """Write the span buffer as a Chrome trace-event JSON file, loadable
@@ -258,6 +303,12 @@ class Tracer:
 
 
 def _jsonable(v):
+    # fast path: span attrs are overwhelmingly primitives, and a doctor/
+    # snapshot pass renders every buffered span — a json.dumps probe per
+    # attr dominated chrome_events() (bench --stage obs-overhead
+    # doctor_pass_ms)
+    if v is None or type(v) in (str, int, float, bool):
+        return v
     try:
         json.dumps(v)
         return v

@@ -73,9 +73,13 @@ def pytest_sessionfinish(session, exitstatus):
             metrics.append(node.metrics)
             # a live enabled recorder has the richer event ring — flush
             # it INTO the upload dir (its own out_dir is a temp path the
-            # workflow never uploads)
-            node.flight.out_dir = out
-            node.flight.dump(f"tier-1 failure (exit {exitstatus})")
+            # workflow never uploads). Guarded: with the recorder off
+            # (the default), node.flight is the __slots__ null object —
+            # assigning out_dir on it raises and would abort the whole
+            # artifact collection.
+            if node.flight.enabled:
+                node.flight.out_dir = out
+                node.flight.dump(f"tier-1 failure (exit {exitstatus})")
         rec.dump(f"tier-1 failure (exit {exitstatus})")
         doc = collect_snapshot(metrics, tracer=GLOBAL_TRACER)
         doc["pytest_exitstatus"] = int(exitstatus)

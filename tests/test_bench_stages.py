@@ -110,3 +110,9 @@ def test_obs_overhead_measure_small(mesh8):
     assert rec["overhead_disabled_pct"] >= 0
     # the disabled-path estimate must be microseconds, not milliseconds
     assert rec["telemetry_us_per_exchange"] < 1000
+    # the doctor-pass extension (PR 3): measured, amortized over the
+    # report-ring window, findings counted — same no-gate-here rationale
+    assert rec["doctor_pass_ms"] > 0
+    assert rec["doctor_window_exchanges"] >= 6
+    assert rec["doctor_overhead_pct"] >= 0
+    assert rec["doctor_findings"] >= 0

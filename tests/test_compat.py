@@ -262,10 +262,16 @@ def test_v2_partition_readers_share_one_exchange(base_conf):
 
 def test_v2_cached_readers_record_their_own_fetch_wait(base_conf):
     """Each PartitionReader records its OWN fetch wait: the dispatcher
-    through the manager's read histogram, every cached reader through
+    through the manager's read histograms, every cached reader through
     the facade's cached path — N readers produce N observations, the
-    per-reduce-task accounting Spark's reporter contract implies."""
-    from sparkucx_tpu.utils.metrics import H_FETCH_WAIT
+    per-reduce-task accounting Spark's reporter contract implies. The
+    warmup split applies to BOTH: when the dispatch compiled, readers
+    that blocked behind it waited out the compile too, so every one of
+    that shuffle's observations lands in first_wait_ms, keeping the
+    steady-state wait distribution clean for the doctor."""
+    from sparkucx_tpu.shuffle.stepcache import GLOBAL_STEP_CACHE
+    from sparkucx_tpu.utils.metrics import H_FETCH_FIRST, H_FETCH_WAIT
+    GLOBAL_STEP_CACHE.clear()      # the dispatch WILL compile
     conf = dict(base_conf, **{"spark.shuffle.tpu.compat.version": "v2"})
     with sparkucx_tpu.connect(conf, use_env=False) as svc:
         R, M = 8, 2
@@ -276,12 +282,15 @@ def test_v2_cached_readers_record_their_own_fetch_wait(base_conf):
             w.write(rng.integers(0, 1 << 31, size=100).astype(np.int64))
             w.commit()
         hist = svc.node.metrics.histogram(H_FETCH_WAIT)
-        assert hist.count == 0
+        first = svc.node.metrics.histogram(H_FETCH_FIRST)
+        assert hist.count == 0 and first.count == 0
         readers = R
         for r in range(readers):
             list(svc.reader(h, r, r + 1))
-        # 1 dispatching reader (manager.read) + (R-1) cached readers
-        assert hist.count == readers
+        # 1 dispatching reader + (R-1) cached readers, ALL tagged as
+        # compile-bearing (the dispatch compiled this shape fresh)
+        assert first.count == readers
+        assert hist.count == 0
         assert svc.node.metrics.get("shuffle.read.cached.count") == \
             readers - 1
         # still ONE collective underneath

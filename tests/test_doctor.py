@@ -1,0 +1,629 @@
+"""Doctor + timeline tests — the analysis layer over the telemetry plane.
+
+Golden-finding tests build synthetic snapshots that trip exactly one rule
+each (plus a healthy-cluster fixture asserting ZERO findings — the
+doctor's "all clear" is a contract, not an absence of code paths);
+histogram merge/round-trip property tests pin the exact-aggregation
+claim vs numpy; timeline tests pin anchor-based clock alignment and the
+anchor-less rejection; regress tests pin the bench-diff findings schema.
+"""
+
+import contextlib
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.utils.doctor import (Finding, Thresholds, build_view,
+                                       diagnose, render_findings)
+from sparkucx_tpu.utils.metrics import (H_FETCH_FIRST, H_FETCH_WAIT,
+                                        H_RETRY_MS, Histogram, Metrics)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import bench  # noqa: E402
+
+
+# -- synthetic snapshot builders -------------------------------------------
+def _anchor():
+    import time
+    perf = time.perf_counter()
+    wall = time.time()
+    return {"wall": wall, "perf": perf, "perf_epoch": perf,
+            "wall_epoch": wall, "pid": 1.0}
+
+
+def _hist_snap(values, name="h"):
+    h = Histogram(name)
+    for v in values:
+        h.observe(float(v))
+    return h.snapshot()
+
+
+def _report(sid=1, trace="s1.e0.x1", process_id=0, peer_rows=None,
+            skew=1.0, retries=0, programs=0, group_ms=10.0,
+            completed=True):
+    peer_rows = peer_rows if peer_rows is not None else [100] * 8
+    return {
+        "shuffle_id": sid, "trace_id": trace, "process_id": process_id,
+        "num_maps": 8, "num_partitions": 8, "partitioner": "hash",
+        "peer_rows": list(peer_rows),
+        "peer_bytes": [r * 8 for r in peer_rows],
+        "skew_ratio": skew, "retries": retries,
+        "stepcache_programs": programs, "stepcache_hits": 4,
+        "group_ms": group_ms, "plan_bucket": [128, 256],
+        "completed": completed,
+    }
+
+
+def _healthy_doc():
+    """Balanced cluster, steady state: every rule must stay quiet."""
+    return {
+        "anchor": _anchor(), "process_id": 0,
+        "counters": {"compile.step.programs": 2.0,
+                     "compile.step.hits": 98.0,
+                     "shuffle.read.count": 50.0},
+        "histograms": {
+            H_FETCH_WAIT: _hist_snap([10.0 + i % 3 for i in range(50)]),
+            # present but under the 10x cold-start ratio vs wait p50
+            H_FETCH_FIRST: _hist_snap([80.0]),
+        },
+        "exchange_reports": [
+            _report(sid=i, trace=f"s{i}.e0.x{i}") for i in range(1, 5)],
+        "pool": {"requests": 100, "allocated": 4096, "preallocated": 64,
+                 "in_use": 12},
+    }
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- healthy baseline ------------------------------------------------------
+def test_healthy_cluster_zero_findings():
+    assert diagnose(_healthy_doc()) == []
+    text = render_findings([])
+    assert "healthy" in text
+
+
+def test_empty_process_zero_findings():
+    """A fresh process (pre-registered empty histograms, no reports)
+    diagnoses clean — rules need signal, not just keys."""
+    m = Metrics()
+    from sparkucx_tpu.utils.export import collect_snapshot
+    assert diagnose(collect_snapshot(m)) == []
+
+
+# -- one golden fixture per rule -------------------------------------------
+def test_straggler_peer_bytes_outlier():
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_report(
+        sid=9, trace="s9.e0.x9", peer_rows=[100, 100, 100, 100,
+                                            100, 100, 100, 1000]))
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["straggler_peer"]
+    f = fs[0]
+    assert f.grade in ("warn", "critical")
+    assert f.evidence["peer"] == 7
+    assert f.conf_key == "spark.shuffle.tpu.network.timeoutMs"
+    assert "s9.e0.x9" in f.trace_ids
+
+
+def test_straggler_process_group_ms_outlier():
+    """Cluster mode: gathered reports for the SAME exchange, one process
+    far over the cluster median group time."""
+    docs = []
+    for p in range(4):
+        doc = {"anchor": _anchor(), "process_id": p, "counters": {},
+               "histograms": {},
+               "exchange_reports": [_report(
+                   sid=3, trace="s3.e0.x7", process_id=p,
+                   group_ms=2000.0 if p == 2 else 100.0)]}
+        docs.append(doc)
+    fs = diagnose(docs)
+    assert _rules_of(fs) == ["straggler_peer"]
+    f = fs[0]
+    assert f.grade == "critical"          # 20x median, >= 2x ratio
+    assert f.evidence["process_id"] == 2
+    assert f.trace_ids == ["s3.e0.x7"]
+
+
+def test_straggler_ignores_warmup_reads():
+    """The same outlier shape must NOT fire when the outlier report is a
+    compile-bearing (warmup) read — the first-wait split exists exactly
+    so the doctor can discard these."""
+    docs = []
+    for p in range(4):
+        doc = {"anchor": _anchor(), "process_id": p, "counters": {},
+               "histograms": {},
+               "exchange_reports": [_report(
+                   sid=3, trace="s3.e0.x7", process_id=p,
+                   programs=1,            # <- compiled during this read
+                   group_ms=2000.0 if p == 2 else 100.0)]}
+        docs.append(doc)
+    assert diagnose(docs) == []
+
+
+def test_partition_skew_grades():
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_report(sid=5, trace="s5.e0.x5",
+                                           skew=6.0))
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["partition_skew"]
+    assert fs[0].grade == "warn"
+    assert fs[0].conf_key == "spark.shuffle.tpu.a2a.capacityFactor"
+    doc["exchange_reports"].append(_report(sid=6, trace="s6.e0.x6",
+                                           skew=32.0))
+    fs = diagnose(doc)
+    assert fs[0].grade == "critical"      # most severe first
+    assert fs[0].evidence["skew_ratio"] == 32.0
+    assert fs[0].trace_ids == ["s6.e0.x6"]
+
+
+def test_retry_storm():
+    doc = _healthy_doc()
+    doc["histograms"][H_RETRY_MS] = _hist_snap([50.0] * 12)
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["retry_storm"]
+    assert fs[0].grade == "critical"      # 12 >= retry_critical
+    assert fs[0].evidence["retries"] == 12
+    assert fs[0].conf_key == "spark.shuffle.tpu.failure.maxAttempts"
+
+
+def test_compile_churn():
+    doc = _healthy_doc()
+    doc["counters"]["compile.step.programs"] = 40.0
+    doc["counters"]["compile.step.hits"] = 10.0
+    doc["counters"]["compile.step.seconds"] = 80.0
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["compile_churn"]
+    assert fs[0].grade == "critical"      # 80% miss
+    assert fs[0].conf_key == "spark.shuffle.tpu.a2a.capBucketGrowth"
+    assert fs[0].evidence["compile_seconds"] == 80.0
+
+
+def test_pool_pressure():
+    doc = _healthy_doc()
+    doc["pool"] = {"requests": 500, "allocated": 64, "preallocated": 8,
+                   "in_use": 62}
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["pool_pressure"]
+    assert fs[0].conf_key == \
+        "spark.shuffle.tpu.memory.preAllocateBuffers"
+    assert fs[0].evidence["in_use"] == 62
+
+
+def test_overflow_loop():
+    doc = _healthy_doc()
+    doc["exchange_reports"].extend([
+        _report(sid=7, trace="s7.e0.x7", retries=2),
+        _report(sid=8, trace="s8.e0.x8", retries=1)])
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["overflow_loop"]
+    assert fs[0].evidence["total_retries"] == 3
+    assert fs[0].conf_key == "spark.shuffle.tpu.a2a.capacityFactor"
+
+
+def test_cold_start_info():
+    doc = _healthy_doc()
+    doc["histograms"][H_FETCH_FIRST] = _hist_snap([3000.0, 2800.0])
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["cold_start"]
+    assert fs[0].grade == "info"
+    assert fs[0].conf_key == "spark.shuffle.tpu.compile.cacheEnabled"
+
+
+def test_findings_sorted_and_jsonable():
+    doc = _healthy_doc()
+    doc["histograms"][H_FETCH_FIRST] = _hist_snap([3000.0])   # info
+    doc["exchange_reports"].append(_report(sid=6, trace="t", skew=32.0))
+    fs = diagnose(doc)
+    grades = [f.grade for f in fs]
+    order = {"critical": 0, "warn": 1, "info": 2}
+    assert grades == sorted(grades, key=order.__getitem__)
+    json.dumps([f.to_dict() for f in fs])
+    text = render_findings(fs)
+    assert "spark.shuffle.tpu.a2a.capacityFactor" in text
+    with pytest.raises(ValueError):
+        Finding(rule="x", grade="fatal", summary="nope")
+
+
+def test_cluster_view_aggregates_exactly():
+    """Counters sum, histograms merge exactly, reports concatenate with
+    process attribution."""
+    docs = []
+    for p in range(3):
+        docs.append({
+            "process_id": p,
+            "counters": {"c": 2.0},
+            "histograms": {"h": _hist_snap([10.0 * (p + 1)] * 4)},
+            "exchange_reports": [_report(sid=p, process_id=p)],
+        })
+    view = build_view(docs)
+    assert view.processes == 3
+    assert view.counters["c"] == 6.0
+    assert view.histograms["h"].count == 12
+    assert view.histograms["h"].max == pytest.approx(30.0, rel=0.05)
+    assert sorted(r["process_id"] for r in view.reports) == [0, 1, 2]
+
+
+# -- histogram round-trip / merge vs numpy ---------------------------------
+@pytest.mark.parametrize("dist", ["lognormal", "uniform", "exponential"])
+def test_histogram_snapshot_roundtrip_exact(dist, rng):
+    draws = {
+        "lognormal": lambda: rng.lognormal(3.0, 1.5, size=5000),
+        "uniform": lambda: rng.uniform(0.1, 1000.0, size=5000),
+        "exponential": lambda: rng.exponential(50.0, size=5000),
+    }[dist]()
+    h = Histogram("t")
+    for v in draws:
+        h.observe(v)
+    snap = json.loads(json.dumps(h.to_snapshot()))   # through the wire
+    h2 = Histogram.from_snapshot(snap, "t")
+    assert h2.count == h.count
+    assert h2.sum == pytest.approx(h.sum)
+    assert h2.min == h.min and h2.max == h.max
+    for q in (0.5, 0.9, 0.99):
+        assert h2.quantile(q) == pytest.approx(h.quantile(q))
+    assert h2.buckets() == h.buckets()               # bit-exact ladder
+
+
+def test_histogram_merge_matches_union(rng):
+    """merge(a, b) must equal observing the union — and both track the
+    numpy quantiles of the combined sample within the ladder bound."""
+    a_draws = rng.lognormal(2.0, 1.0, size=4000)
+    b_draws = rng.exponential(200.0, size=4000)
+    ha, hb, hu = Histogram("a"), Histogram("b"), Histogram("u")
+    for v in a_draws:
+        ha.observe(v)
+        hu.observe(v)
+    for v in b_draws:
+        hb.observe(v)
+        hu.observe(v)
+    ha.merge(hb)
+    assert ha.count == hu.count
+    assert ha.sum == pytest.approx(hu.sum)
+    assert ha.buckets() == hu.buckets()
+    union = np.concatenate([a_draws, b_draws])
+    for q in (0.5, 0.99):
+        ref = float(np.quantile(union, q))
+        assert abs(ha.quantile(q) - ref) / ref < 0.10
+    # merging preserves non-positive bucket + min/max
+    hn, hm = Histogram("n"), Histogram("m")
+    hn.observe(-1.0)
+    hm.observe(5.0)
+    hn.merge(hm)
+    assert hn.count == 2 and hn.min == -1.0 and hn.max == 5.0
+
+
+def test_histogram_empty_roundtrip_and_merge():
+    h = Histogram.from_snapshot(Histogram("e").to_snapshot())
+    assert h.count == 0 and h.quantile(0.5) == 0.0
+    h2 = Histogram("x")
+    h2.observe(3.0)
+    h2.merge(h)                                      # empty merge no-op
+    assert h2.count == 1
+
+
+# -- timeline merging ------------------------------------------------------
+def _span_doc(process_id, wall_epoch, events):
+    return {"process_id": process_id,
+            "anchor": {"wall": wall_epoch, "perf": 0.0,
+                       "perf_epoch": 0.0, "wall_epoch": wall_epoch,
+                       "pid": float(100 + process_id)},
+            "trace_events": events}
+
+
+def test_merge_timeline_clock_aligns_tracks():
+    from sparkucx_tpu.utils.export import merge_timeline
+    # process 1's clock epoch started 2.5 s after process 0's; the same
+    # wall moment is ts=3.0s on p0 and ts=0.5s on p1
+    ev0 = [{"name": "x", "ph": "X", "ts": 3.0e6, "dur": 1000.0,
+            "pid": 0, "tid": 1, "args": {"trace": "s1.e0.x1"}}]
+    ev1 = [{"name": "x", "ph": "X", "ts": 0.5e6, "dur": 1000.0,
+            "pid": 0, "tid": 1, "args": {"trace": "s1.e0.x1"}}]
+    doc = merge_timeline([_span_doc(0, 1000.0, ev0),
+                          _span_doc(1, 1002.5, ev1)])
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 2
+    by_pid = {e["pid"]: e for e in xs}
+    assert set(by_pid) == {0, 1}                   # a track per process
+    assert by_pid[0]["ts"] == pytest.approx(by_pid[1]["ts"])
+    meta = [e for e in doc["traceEvents"] if e.get("ph") == "M"]
+    assert {m["args"]["name"] for m in meta} == \
+        {"process 0", "process 1"}
+
+
+def test_merge_timeline_rejects_anchorless():
+    from sparkucx_tpu.utils.export import merge_timeline, require_anchor
+    with pytest.raises(ValueError, match="anchor"):
+        merge_timeline([{"process_id": 0, "trace_events": []}])
+    with pytest.raises(ValueError, match="anchor"):
+        require_anchor({"ts": 1.0}, "x.json")
+
+
+def test_cli_timeline_and_anchor_rejection(tmp_path):
+    from sparkucx_tpu.__main__ import main as cli_main
+    d0 = _span_doc(0, 1000.0, [{"name": "a", "ph": "X", "ts": 1e6,
+                                "dur": 50.0, "pid": 0, "tid": 1,
+                                "args": {}}])
+    d1 = _span_doc(1, 1001.0, [{"name": "b", "ph": "X", "ts": 2e6,
+                                "dur": 50.0, "pid": 0, "tid": 1,
+                                "args": {}}])
+    dump_dir = tmp_path / "dumps"
+    dump_dir.mkdir()
+    (dump_dir / "metrics_100.json").write_text(json.dumps(d0))
+    (dump_dir / "metrics_101.json").write_text(json.dumps(d1))
+    out = tmp_path / "tl.json"
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["timeline", "--input", str(dump_dir),
+                       "--out", str(out)])
+    assert rc == 0
+    doc = json.loads(out.read_text())
+    assert doc["metadata"]["processes"] == 2
+    # anchor-less dump: loud rejection, not silent misalignment — for
+    # timeline AND the stats/trace renderers
+    bad = tmp_path / "old.json"
+    bad.write_text(json.dumps({"counters": {}, "trace_events": []}))
+    for argv in (["timeline", "--input", str(bad)],
+                 ["stats", "--input", str(bad)],
+                 ["trace", "--input", str(bad)]):
+        with pytest.raises(ValueError, match="anchor"):
+            cli_main(argv)
+
+
+def test_same_process_dumps_deduped_not_double_counted():
+    """A dump dir holding a process's metrics snapshot AND its flight
+    postmortem (the CI failure-artifact shape) must diagnose as ONE
+    process: 2 real retries must not read as 4 and trip retry_storm,
+    and a postmortem-only exchange report still survives the dedup."""
+    from sparkucx_tpu.utils.export import dedupe_process_docs
+    snap = {"pid": 777, "ts": 100.0,
+            "counters": {"x": 2.0},
+            "histograms": {H_RETRY_MS: _hist_snap([5.0, 5.0])},
+            "exchange_reports": [_report(sid=1, trace="s1.e0.x1")]}
+    flight = {"pid": 777, "ts": 101.0,
+              "counters": {"x": 2.0},
+              "histograms": {H_RETRY_MS: _hist_snap([5.0, 5.0])},
+              "contexts": {"exchange_reports": [
+                  _report(sid=1, trace="s1.e0.x1"),
+                  _report(sid=2, trace="s2.e0.x2")]}}
+    docs = dedupe_process_docs([snap, flight])
+    assert len(docs) == 1
+    view = build_view([snap, flight])
+    assert view.counters["x"] == 2.0                  # not 4.0
+    assert view.histograms[H_RETRY_MS].count == 2     # not 4
+    assert {r["trace_id"] for r in view.reports} == \
+        {"s1.e0.x1", "s2.e0.x2"}                      # union, deduped
+    assert diagnose([snap, flight]) == []             # below retry_warn
+    # distinct processes (cluster gather) stay separate
+    other = dict(snap, pid=778, process_id=1)
+    assert len(dedupe_process_docs([snap, other])) == 2
+
+
+def test_timeline_dedupes_same_process_captures():
+    """The same span ring embedded in two dumps of one process renders
+    ONCE on one track, not twice on two fabricated tracks."""
+    from sparkucx_tpu.utils.export import merge_timeline
+    ev = [{"name": "a", "ph": "X", "ts": 1e6, "dur": 50.0, "pid": 0,
+           "tid": 1, "args": {}}]
+    snap = dict(_span_doc(0, 1000.0, ev), pid=777, ts=100.0)
+    flight = dict(_span_doc(0, 1000.0, ev), pid=777, ts=101.0)
+    del snap["process_id"], flight["process_id"]
+    doc = merge_timeline([snap, flight])
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 1 and doc["metadata"]["processes"] == 1
+
+
+def test_multi_registry_snapshot_merges_histograms():
+    """Pre-registered empty histograms in a later registry must not
+    clobber an earlier registry's populated one (and two populated ones
+    merge exactly) — the compile.step.duration_s visibility bug."""
+    from sparkucx_tpu.utils.export import collect_snapshot
+    from sparkucx_tpu.utils.metrics import H_COMPILE_SECS
+    a, b = Metrics(), Metrics()
+    a.observe(H_COMPILE_SECS, 5.0)           # step cache's registry
+    doc = collect_snapshot([a, b])           # b pre-registers it empty
+    assert doc["histograms"][H_COMPILE_SECS]["count"] == 1
+    b.observe(H_COMPILE_SECS, 7.0)
+    doc = collect_snapshot([a, b])
+    h = doc["histograms"][H_COMPILE_SECS]
+    assert h["count"] == 2 and h["max"] == 7.0 and h["min"] == 5.0
+
+
+def test_cli_empty_input_errors_not_healthy(tmp_path):
+    """`doctor --input <empty glob>` must error, not silently diagnose
+    this fresh CLI process and print 'healthy'."""
+    from sparkucx_tpu.__main__ import main as cli_main
+    for argv in (["doctor", "--input"], ["timeline", "--input"]):
+        with pytest.raises(FileNotFoundError, match="no paths"):
+            cli_main(argv)
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError, match="no metrics"):
+        cli_main(["doctor", "--input", str(empty)])
+
+
+def test_cli_doctor_dumps_and_fail_on(tmp_path):
+    from sparkucx_tpu.__main__ import main as cli_main
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_report(sid=6, trace="s6.e0.x6",
+                                           skew=32.0))
+    p = tmp_path / "snap.json"
+    p.write_text(json.dumps(doc))
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["doctor", "--input", str(p)])
+    assert rc == 0                                  # report-only default
+    out = buf.getvalue()
+    assert "partition_skew" in out and "capacityFactor" in out
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert cli_main(["doctor", "--input", str(p),
+                         "--fail-on", "critical"]) == 3
+    # json format parses and carries the schema fields
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        cli_main(["doctor", "--input", str(p), "--format", "json"])
+    fs = json.loads(buf.getvalue())
+    assert fs and {"rule", "grade", "evidence", "conf_key"} <= set(fs[0])
+    # live mode runs clean on a fresh process state
+    with contextlib.redirect_stdout(io.StringIO()):
+        assert cli_main(["doctor"]) == 0
+
+
+# -- end-to-end through the facade -----------------------------------------
+def test_service_doctor_on_skewed_workload(mesh8, rng):
+    """The acceptance shape: a synthetic skew + compile-churn workload
+    through the REAL stack emits the expected graded findings on both
+    facades, with trace ids linking back to gather_reports."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.service import ShuffleService
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense",
+                           "spark.shuffle.tpu.io.format": "raw"},
+                          use_env=False)
+    with ShuffleService(conf) as svc:
+        R, M, N = 8, 4, 512
+        h = svc.register_shuffle(71, M, R, partitioner="direct")
+        for m in range(M):
+            svc.write(h, m, np.zeros(N, dtype=np.int64))  # all -> part 0
+        svc.read(h)
+        fs = svc.doctor()
+        rules = _rules_of(fs)
+        assert "partition_skew" in rules
+        skewf = next(f for f in fs if f.rule == "partition_skew")
+        # all rows in 1 of 8 partitions -> max/mean == 8 -> warn tier
+        assert skewf.grade == "warn"
+        rep = svc.manager.report(71)
+        assert rep.trace_id and rep.trace_id in skewf.trace_ids
+        assert svc.doctor("text").startswith("doctor:")
+        json.dumps(svc.doctor("json"))
+
+
+def test_exchange_reports_carry_trace_ids(manager_factory, rng):
+    from sparkucx_tpu.utils.trace import format_trace_id
+    mgr = manager_factory()
+    seen = []
+    for sid in (11, 12):
+        h = mgr.register_shuffle(sid, 2, 4)
+        for m in range(2):
+            w = mgr.get_writer(h, m)
+            w.write(rng.integers(0, 1 << 30, size=32, dtype=np.int64))
+            w.commit(4)
+        mgr.read(h)
+        seen.append(mgr.report(sid).trace_id)
+        mgr.unregister_shuffle(sid)
+    assert seen[0] == format_trace_id(11, 0, 1)
+    assert seen[1] == format_trace_id(12, 0, 2)   # seq is monotone
+    # gather_spans: local capture carries anchor + events schema
+    blobs = mgr.gather_spans()
+    assert len(blobs) == 1
+    assert "wall_epoch" in blobs[0]["anchor"]
+
+
+def test_flight_ring_and_postmortem_carry_trace_ids(manager_factory,
+                                                    tmp_path, rng):
+    """Flight-recorder correlation: ring events recorded while an
+    exchange is in flight carry its trace id, and the postmortem embeds
+    the anchor + its own doctor findings — a crash dump links straight
+    to its row in gather_reports and its timeline track."""
+    mgr = manager_factory({
+        "spark.shuffle.tpu.flightRecorder.enabled": "true",
+        "spark.shuffle.tpu.flightRecorder.dir": str(tmp_path)})
+    mgr.node.faults.arm("fetch", fail_count=1)   # one retried attempt
+    h = mgr.register_shuffle(21, 2, 4)
+    for m in range(2):
+        w = mgr.get_writer(h, m)
+        w.write(rng.integers(0, 1 << 30, size=32, dtype=np.int64))
+        w.commit(4)
+    mgr.read(h)
+    tid = mgr.report(21).trace_id
+    assert tid
+    path = mgr.node.flight.dump("doctor correlation test")
+    doc = json.loads(open(path).read())
+    assert "wall_epoch" in doc["anchor"]          # timeline-mergeable
+    assert isinstance(doc["findings"], list)      # self-diagnosing dump
+    tagged = [e for e in doc["events"] if e.get("trace") == tid]
+    assert tagged, f"no ring event carries {tid}"
+    assert any(e["kind"] == "retry" for e in tagged)
+    assert doc["in_flight_traces"] == []          # read completed
+    # the dump's reports context carries the same id (the join key)
+    reps = doc["contexts"]["exchange_reports"]
+    assert any(r.get("trace_id") == tid for r in reps)
+
+
+def test_v2_facade_doctor(mesh8, rng):
+    """The diagnostic surface must not drift with the host-adapter
+    contract: v2 exposes the same doctor() as v1."""
+    import sparkucx_tpu
+    from sparkucx_tpu.compat.v2 import (ShuffleDependency,
+                                        ShuffleServiceV2)
+    conf = {"spark.shuffle.tpu.a2a.impl": "dense",
+            "spark.shuffle.tpu.compat.version": "v2"}
+    with sparkucx_tpu.connect(conf, use_env=False) as svc:
+        assert isinstance(svc, ShuffleServiceV2)
+        h = svc.register(ShuffleDependency(31, 2, 4))
+        for m in range(2):
+            w = svc.writer(h, m, attempt_id=0)
+            w.write(rng.integers(0, 1 << 30, size=32, dtype=np.int64))
+            w.commit()
+        list(svc.reader(h))
+        fs = svc.doctor()
+        assert isinstance(fs, list)
+        assert svc.doctor("text").startswith("doctor:")
+
+
+# -- regression gating (bench --stage regress) -----------------------------
+def test_regress_compare_goldens():
+    base = {"metric": "m", "detail": {
+        "exchange_p50_ms": 10.0, "rate_gbps": 4.0, "compiles": 3,
+        "tiny_us": 1.0, "mystery": 7.0}}
+    cand = {"metric": "m", "detail": {
+        "exchange_p50_ms": 30.0,      # 3x slower -> critical
+        "rate_gbps": 2.0,             # halved -> warn (50%)
+        "compiles": 3,                # unchanged
+        "tiny_us": 2.0,               # 100% but < 0.05 ms floor
+        "mystery": 100.0}}            # unknown direction -> skipped
+    findings, compared, skipped = bench.regress_compare(base, cand)
+    by_metric = {f.evidence["metric"]: f for f in findings}
+    assert by_metric["detail.exchange_p50_ms"].grade == "critical"
+    assert by_metric["detail.rate_gbps"].grade == "warn"
+    assert "detail.tiny_us" not in by_metric          # noise floor
+    assert "detail.mystery" not in by_metric          # no guessed sign
+    assert skipped >= 1
+    assert all(f.rule == "perf_regression" for f in findings)
+    # improvement shows as info
+    findings2, _, _ = bench.regress_compare(cand, base)
+    assert any(f.rule == "perf_improvement" and f.grade == "info"
+               for f in findings2)
+
+
+def test_regress_stage_writes_findings_doc(tmp_path, capsys):
+    """Two artifacts in, one findings doc out — the acceptance shape."""
+    import argparse
+    base = tmp_path / "base.json"
+    cand = tmp_path / "cand.json"
+    base.write_text(json.dumps(
+        {"metric": "coldstart", "detail": {"first_ms": 100.0,
+                                           "compiles": 3}}))
+    cand.write_text(json.dumps(
+        {"metric": "coldstart", "detail": {"first_ms": 400.0,
+                                           "compiles": 19}}))
+    args = argparse.Namespace(
+        baseline=str(base), candidate=str(cand),
+        regress_warn_pct=50.0, regress_critical_pct=150.0,
+        gate_regress=False, regress_out=str(tmp_path / "regress.json"))
+    assert bench.stage_regress(args) == 0        # non-blocking default
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["metric"] == "bench_regress"
+    assert out["regressions"] == 2
+    assert not out["ok"]                          # critical fired
+    grades = {f["evidence"]["metric"]: f["grade"]
+              for f in out["findings"]}
+    assert grades["detail.first_ms"] == "critical"
+    assert grades["detail.compiles"] == "critical"
+    args.gate_regress = True
+    assert bench.stage_regress(args) == 2         # gated mode blocks

@@ -21,6 +21,14 @@ def _run(*extra):
         capture_output=True, text=True, timeout=900)
     assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
     assert "CLUSTER E2E: PASS" in proc.stdout
+    # telemetry job (buildlib/e2e_worker.py): every process's gathered
+    # spans merged into one clock-aligned timeline (tracks overlap within
+    # the anchor tolerance) and the cluster doctor ran over the
+    # allgathered snapshots — both workers must report it
+    assert proc.stdout.count("TIMELINE ALIGNED OK") >= 2, \
+        proc.stdout[-3000:]
+    assert proc.stdout.count("CLUSTER DOCTOR OK") >= 2, \
+        proc.stdout[-3000:]
 
 
 def test_two_process_cluster_groupby():

@@ -17,8 +17,8 @@ import pytest
 
 from sparkucx_tpu.utils.export import (collect_snapshot, prom_name,
                                        render_json, render_prometheus)
-from sparkucx_tpu.utils.metrics import (H_FETCH_WAIT, H_PEER_BYTES,
-                                        H_PEER_ROWS,
+from sparkucx_tpu.utils.metrics import (H_FETCH_FIRST, H_FETCH_WAIT,
+                                        H_PEER_BYTES, H_PEER_ROWS,
                                         WELL_KNOWN_HISTOGRAMS, Histogram,
                                         Metrics)
 
@@ -272,6 +272,14 @@ def test_exchange_report_ring_bounded_and_gather(manager_factory, rng):
 
 
 def test_fetch_wait_histogram_per_read(manager_factory, rng):
+    """Every read observes exactly one fetch-wait — but compile-bearing
+    reads (fresh step-cache programs) land in first_wait_ms, keeping the
+    steady-state wait distribution clean for the doctor's outlier rules
+    (the BENCH_r05 fetch_p99=3003-vs-p50=1.7 conflation fix)."""
+    # the step cache is process-global: drop any program an earlier test
+    # compiled for this shape, so read 1 deterministically compiles
+    from sparkucx_tpu.shuffle.stepcache import GLOBAL_STEP_CACHE
+    GLOBAL_STEP_CACHE.clear()
     mgr = manager_factory()
     for sid in (1, 2, 3):
         h = mgr.register_shuffle(sid, 2, 4)
@@ -281,9 +289,17 @@ def test_fetch_wait_histogram_per_read(manager_factory, rng):
             w.commit(4)
         mgr.read(h)
         mgr.unregister_shuffle(sid)
-    hist = mgr.node.metrics.histogram(H_FETCH_WAIT)
-    assert hist.count == 3                    # one observation per read
-    assert hist.max >= hist.quantile(0.5) > 0
+    wait = mgr.node.metrics.histogram(H_FETCH_WAIT)
+    first = mgr.node.metrics.histogram(H_FETCH_FIRST)
+    # one observation per read, split by whether the read compiled
+    # (read 1 compiles the shape; read 2 re-compiles under the learned
+    # cap hint; read 3 is a pure step-cache hit)
+    assert wait.count + first.count == 3
+    assert first.count >= 1                   # the first read compiled
+    assert wait.count >= 1                    # steady state reached
+    assert wait.max >= wait.quantile(0.5) > 0
+    # the warmup read pays in-band compile: its wait dwarfs steady state
+    assert first.max > wait.max
 
 
 # -- service stats + CLI ---------------------------------------------------
