@@ -1466,16 +1466,181 @@ def stage_obs_overhead(args) -> int:
     return 0 if out["ok"] else 2
 
 
+def pipeline_measure(rows_per_map=1 << 16, maps=8, partitions=16,
+                     val_words=16, wave_rows=None, depth=2, reps=3,
+                     seed=0):
+    """A/B the wave-pipelined exchange (a2a.waveRows) against single-shot
+    on the SAME staged rows — the overlap artifact behind
+    ``--stage pipeline``.
+
+    Both arms run the full manager lifecycle (register → write → read →
+    drain every partition) on the CPU mesh with the dense impl; the waved
+    arm additionally reports overlap efficiency (pack-hidden fraction:
+    how much of the total pack time ran while an earlier wave's
+    collective was in flight) and both report the pool's pinned-byte
+    high-watermark over the timed window — the bounded-footprint claim,
+    measured rather than asserted. Step-cache program deltas prove the
+    one-program-per-wave-shape contract (delta 1 on the first waved
+    exchange no matter how many waves it split into, 0 once warm).
+    In-process and CPU-safe; tests run it at tiny shapes."""
+    import time as _time
+
+    import numpy as np
+
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    from sparkucx_tpu.utils.metrics import COMPILE_PROGRAMS, GLOBAL_METRICS
+
+    rng = np.random.default_rng(seed)
+    keys = [rng.integers(-(1 << 62), 1 << 62, size=rows_per_map)
+            for _ in range(maps)]
+    vals = [rng.integers(-(1 << 30), 1 << 30,
+                         size=(rows_per_map, val_words)).astype(np.int32)
+            for _ in range(maps)]
+    if wave_rows is None:
+        # ~8 waves over the balanced per-shard share (8 virtual devices)
+        per_shard = rows_per_map * maps // 8
+        wave_rows = max(2048, per_shard // 8)
+
+    sid_box = [70000]
+
+    def run_mode(overrides):
+        conf = TpuShuffleConf({
+            "spark.shuffle.tpu.a2a.impl": "dense", **overrides},
+            use_env=False)
+        node = TpuNode.start(conf)
+        mgr = TpuShuffleManager(node, conf)
+
+        def one_exchange():
+            sid = sid_box[0]
+            sid_box[0] += 1
+            h = mgr.register_shuffle(sid, maps, partitions)
+            for m in range(maps):
+                w = mgr.get_writer(h, m)
+                w.write(keys[m], vals[m])
+                w.commit(partitions)
+            res = mgr.read(h)
+            for r in range(partitions):
+                res.partition(r)
+            rep = mgr.report(sid)
+            mgr.unregister_shuffle(sid)
+            return rep
+
+        try:
+            prog0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+            one_exchange()                     # warmup: compile
+            programs_first = int(
+                GLOBAL_METRICS.get(COMPILE_PROGRAMS) - prog0)
+            node.pool.reset_peak_bytes()
+            prog1 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+            times = []
+            rep = None
+            for _ in range(reps):
+                t0 = _time.perf_counter()
+                rep = one_exchange()
+                times.append((_time.perf_counter() - t0) * 1e3)
+            peak = node.pool.stats()["peak_bytes"]
+            programs_timed = int(
+                GLOBAL_METRICS.get(COMPILE_PROGRAMS) - prog1)
+        finally:
+            mgr.stop()
+            node.close()
+        times.sort()
+        out = {"e2e_ms_median": round(times[len(times) // 2], 2),
+               "e2e_ms_min": round(times[0], 2),
+               "peak_pinned_bytes": int(peak),
+               "pack_ms": round(rep.pack_ms, 2),
+               "group_ms": round(rep.group_ms, 2),
+               "programs_first_exchange": programs_first,
+               "programs_timed": programs_timed}
+        if rep.waves:
+            hidden = rep.wave_pack_hidden_ms
+            out.update(
+                waves=rep.waves,
+                wave_rows=rep.wave_rows,
+                wave_depth=int(conf.wave_depth),
+                pack_hidden_ms=round(hidden, 2),
+                pack_hidden_fraction=round(
+                    hidden / rep.pack_ms, 3) if rep.pack_ms else 0.0,
+                wave_block_bytes=8 * rep.plan_bucket[0]
+                * (2 + val_words) * 4,
+                wave_retries=rep.retries,
+                # overlap proof, machine-readable: every steady-state
+                # wave's pack started before the previous wave's result
+                # was forced
+                overlap_proven=all(
+                    cur["pack_start_ms"] < prv["forced_ms"]
+                    for prv, cur in zip(rep.wave_timeline[:-1],
+                                        rep.wave_timeline[1:])))
+        return out
+
+    single = run_mode({})
+    waved = run_mode({
+        "spark.shuffle.tpu.a2a.waveRows": str(int(wave_rows)),
+        "spark.shuffle.tpu.a2a.waveDepth": str(int(depth))})
+    return {
+        "shape": {"rows_per_map": rows_per_map, "maps": maps,
+                  "partitions": partitions, "val_words": val_words,
+                  "wave_rows": int(wave_rows), "depth": depth,
+                  "reps": reps},
+        "single": single,
+        "waved": waved,
+        "speedup": round(single["e2e_ms_median"]
+                         / max(waved["e2e_ms_median"], 1e-9), 3),
+        "peak_pinned_saved_bytes": int(single["peak_pinned_bytes"]
+                                       - waved["peak_pinned_bytes"]),
+    }
+
+
+def stage_pipeline(args) -> int:
+    """``--stage pipeline``: prove the wave pipeline's three claims on a
+    pack-dominated CPU shape — (1) waved end-to-end beats single-shot
+    with pack-hidden fraction > 50%, (2) peak pinned bytes drop to the
+    bounded wave-block working set, (3) one compiled wave program serves
+    every wave (compile.step.programs delta = 1 on the first waved
+    exchange, 0 warm). Prints ONE JSON line and writes
+    bench_runs/pipeline.json — a baseline artifact of the CI regress
+    stage, like obs_overhead.json."""
+    out = {"metric": "pipeline",
+           "detail": pipeline_measure(
+               rows_per_map=1 << (args.rows_log2 or 16),
+               val_words=args.val_words, reps=args.reps)}
+    d = out["detail"]
+    w = d["waved"]
+    out["ok"] = bool(
+        d["speedup"] > 1.0
+        and w.get("pack_hidden_fraction", 0.0) > 0.5
+        and w["peak_pinned_bytes"] < d["single"]["peak_pinned_bytes"]
+        and w["programs_first_exchange"] == 1
+        and w["programs_timed"] == 0
+        and w.get("overlap_proven", False))
+    out["telemetry"] = _telemetry_blob()
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_runs", "pipeline.json")
+    try:
+        os.makedirs(os.path.dirname(artifact), exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(out, f, indent=1)
+        out["artifact"] = os.path.relpath(
+            artifact, os.path.dirname(os.path.abspath(__file__)))
+    except OSError as e:
+        out["artifact_error"] = str(e)[:200]
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 2
+
+
 # -- regression gating (--stage regress) ------------------------------------
 # Suffix → direction heuristics over dotted metric paths. -1 = lower is
 # better (an increase is a regression), +1 = higher is better. Unknown
 # directions are SKIPPED, not guessed: a wrong-signed "regression" is
 # worse than no finding.
 _LOWER_BETTER = ("_ms", "_us", "_s", "_secs", "_seconds", "_pct",
-                 "compiles", "dropped", "retries", "misses")
+                 "compiles", "dropped", "retries", "misses",
+                 "peak_pinned_bytes")
 _HIGHER_BETTER = ("gbps", "gbps_per_chip", "value", "hits", "rate",
                   "speedup", "bandwidth", "x_faster", "vs_baseline",
-                  "rows_per_s", "programs_saved")
+                  "rows_per_s", "programs_saved", "hidden_fraction")
 # Metrics their OWN stage documents as context-only / unresolvable under
 # shared-CPU drift — diffing them produces alarms about the machine, not
 # the code: the A/B medians and every derived percentage/microbench that
@@ -1747,7 +1912,8 @@ def main() -> None:
                          "form since r5; stable = 1-key stable sort — "
                          "the conf default)")
     ap.add_argument("--stage", default=None,
-                    choices=("coldstart", "obs-overhead", "regress"),
+                    choices=("coldstart", "obs-overhead", "regress",
+                             "pipeline"),
                     help="run ONE dedicated stage instead of the ladder: "
                          "coldstart = compile-cost artifact (persistent "
                          "cache cold-vs-warm across processes + "
@@ -1756,7 +1922,10 @@ def main() -> None:
                          "exchange loop (disabled + doctor pass must "
                          "each be <1%); regress = diff a bench artifact "
                          "against a prior one into doctor-schema "
-                         "findings. All CPU-measurable")
+                         "findings; pipeline = wave-pipelined vs "
+                         "single-shot A/B (overlap efficiency, bounded "
+                         "pinned footprint, one-program-per-shape). All "
+                         "CPU-measurable")
     ap.add_argument("--baseline", default=None,
                     help="regress stage: prior artifact to diff against "
                          "(default bench_runs/obs_overhead.json)")
@@ -1804,7 +1973,8 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         sys.exit({"coldstart": stage_coldstart,
                   "obs-overhead": stage_obs_overhead,
-                  "regress": stage_regress}[args.stage](args))
+                  "regress": stage_regress,
+                  "pipeline": stage_pipeline}[args.stage](args))
 
     fallback = None
     if args.platform == "auto" and not args.no_fallback:

@@ -89,6 +89,7 @@ class TpuShuffleConf:
         "spill_threshold", "spill_dir", "a2a_impl", "sort_impl",
         "sort_strips", "combine_compaction", "fetch_granularity",
         "capacity_factor", "cap_buckets", "cap_bucket_growth",
+        "wave_rows", "wave_depth", "pack_threads",
         "max_bytes_in_flight", "compile_cache_enabled",
         "compile_cache_dir", "compile_min_compile_time_secs",
         "mesh_ici_axis", "mesh_dcn_axis", "num_slices", "num_processes",
@@ -498,6 +499,52 @@ class TpuShuffleConf:
             raise ValueError(
                 f"spark.shuffle.tpu.compile.minCompileTimeSecs={v}: "
                 f"want >= 0")
+        return v
+
+    @property
+    def wave_rows(self) -> int:
+        """Wave-pipelined exchange: split the read into fixed-size waves
+        of at most this many rows PER SHARD and run a software pipeline —
+        pack wave i+1 on the host while wave i's collective is in flight
+        and wave i-1 drains D2H. 0 (default) = single-shot (the whole
+        shuffle is one pack + one program launch). Because wave shape is
+        fixed, every wave of a shuffle hits ONE compiled program, pinned
+        staging is bounded by ``a2a.waveDepth`` wave blocks instead of
+        the full shuffle, and an overflow retry regrows and re-runs only
+        the offending wave (shuffle/manager.py PendingWaveShuffle)."""
+        v = self.get_int("a2a.waveRows", 0)
+        if v < 0:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.waveRows={v}: want >= 0 (0 = off)")
+        return v
+
+    @property
+    def wave_depth(self) -> int:
+        """Wave pipeline depth: how many waves may be in flight at once
+        (and how many recycled pinned pack blocks the pipeline holds).
+        2 (default) is the classic depth-2 software pipeline — pack,
+        collective, and drain each own a stage; 1 degenerates to
+        serial per-wave execution (bounded memory, no overlap)."""
+        from sparkucx_tpu.shuffle.plan import WAVE_DEPTH_RANGE
+        v = self.get_int("a2a.waveDepth", 2)
+        if not WAVE_DEPTH_RANGE[0] <= v <= WAVE_DEPTH_RANGE[1]:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.waveDepth={v}: want "
+                f"{WAVE_DEPTH_RANGE[0]}..{WAVE_DEPTH_RANGE[1]}")
+        return v
+
+    @property
+    def pack_threads(self) -> int:
+        """Worker threads of the manager's persistent pack executor
+        (``_pack_shards`` fan-out — numpy copies release the GIL, so the
+        host-bound fuse parallelizes). 0 (default) = coresPerProcess.
+        The doctor's ``pipeline_stall`` rule points here when wave packs
+        run slower than the collective they should hide behind."""
+        v = self.get_int("a2a.packThreads", 0)
+        if v < 0:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.packThreads={v}: want >= 0 "
+                f"(0 = coresPerProcess)")
         return v
 
     @property

@@ -38,7 +38,8 @@ class ArenaBuffer:
     refcount that lets many sliced blocks share one fetch buffer
     (ref: OnBlocksFetchCallback.java:45-53, RegisteredMemory.java:17-34)."""
 
-    __slots__ = ("pool", "ptr", "capacity", "requested", "_np")
+    __slots__ = ("pool", "ptr", "capacity", "requested", "_np",
+                 "_returned")
 
     def __init__(self, pool: "HostMemoryPool", ptr, capacity: int, requested: int):
         self.pool = pool
@@ -46,6 +47,9 @@ class ArenaBuffer:
         self.capacity = capacity
         self.requested = requested
         self._np: Optional[np.ndarray] = None
+        # byte-watermark bookkeeping: flipped by pool.put() exactly once
+        # so a double-put cannot decrement the in-use byte gauge twice
+        self._returned = False
 
     def array(self) -> np.ndarray:
         """Zero-copy uint8 view of the whole block."""
@@ -86,6 +90,15 @@ class HostMemoryPool:
         self.min_block = self._round_pow2(self.conf.min_buffer_size)
         self.slab_size = self.conf.min_allocation_size
         self._closed = False
+        # Pinned-byte watermark, tracked python-side at the get/put seam
+        # for BOTH arena backends (the native arena counts blocks, not
+        # bytes). retain/release refcounts deliberately do not move it:
+        # the gauge answers "how much pinned staging is checked out",
+        # which is the get/put discipline — the number the wave pipeline's
+        # bounded-footprint claim is graded on (bench --stage pipeline).
+        self._bytes_lock = threading.Lock()
+        self._in_use_bytes = 0
+        self._peak_bytes = 0
         self._lib = load_native()
         if self._lib is not None:
             self._arena = self._lib.sxt_arena_create(
@@ -111,6 +124,12 @@ class HostMemoryPool:
         return b
 
     # -- public API -------------------------------------------------------
+    def _bytes_out(self, cap: int) -> None:
+        with self._bytes_lock:
+            self._in_use_bytes += cap
+            if self._in_use_bytes > self._peak_bytes:
+                self._peak_bytes = self._in_use_bytes
+
     def get(self, size: int) -> ArenaBuffer:
         if self._closed:
             raise RuntimeError("pool is closed")
@@ -121,6 +140,7 @@ class HostMemoryPool:
             ptr = self._lib.sxt_get(self._arena, size)
             if not ptr:
                 raise MemoryError(f"native arena OOM for {size} bytes")
+            self._bytes_out(cap)
             return ArenaBuffer(self, ptr, cap, size)
         with self._py_lock:
             self._py_stats[0] += 1
@@ -134,10 +154,16 @@ class HostMemoryPool:
                 self._py_stats[1] += 1
             self._py_refs[key] = 1
             self._py_stats[3] += 1
-            return ArenaBuffer(self, key, cap, size)
+        self._bytes_out(cap)
+        return ArenaBuffer(self, key, cap, size)
 
     def put(self, buf: ArenaBuffer) -> None:
         buf.release()
+        # after release: a double-put raises there before reaching this
+        if not buf._returned:
+            buf._returned = True
+            with self._bytes_lock:
+                self._in_use_bytes -= buf.capacity
 
     def preallocate(self, size: int, count: int) -> None:
         if self._arena is not None:
@@ -163,7 +189,22 @@ class HostMemoryPool:
         else:
             with self._py_lock:
                 vals = list(self._py_stats)
-        return dict(zip(("requests", "allocated", "preallocated", "in_use"), vals))
+        st = dict(zip(("requests", "allocated", "preallocated", "in_use"),
+                      vals))
+        with self._bytes_lock:
+            st["in_use_bytes"] = self._in_use_bytes
+            st["peak_bytes"] = self._peak_bytes
+        return st
+
+    def reset_peak_bytes(self) -> int:
+        """Reset the byte high-watermark to the current in-use level and
+        return the PRIOR peak — the measure-a-window primitive the
+        pipeline bench uses to attribute peak pinned bytes to one A/B
+        arm instead of whichever arm ran first."""
+        with self._bytes_lock:
+            prior = self._peak_bytes
+            self._peak_bytes = self._in_use_bytes
+        return prior
 
     def close(self) -> None:
         if self._closed:

@@ -100,6 +100,35 @@ def allgather_json(obj) -> list:
     return out
 
 
+def agree_wave_count(local_waves: int) -> int:
+    """COLLECTIVE: agree on the wave count of a wave-pipelined exchange
+    (``a2a.waveRows``) so every process runs the same number of per-wave
+    collectives in lockstep. The proposal is already identical everywhere
+    by construction — it derives from the allgathered global size row
+    (plan.wave_count) — so this round exists to FAIL FAST on the one way
+    it can diverge: a process booted with a different ``a2a.waveRows``
+    conf, which would otherwise desync the SPMD group into a hang on
+    wave W+1. The manager therefore calls it on EVERY distributed read
+    (a waves-off or below-threshold process proposes 1): on/off conf
+    divergence is the likeliest drift and must raise too, not just
+    nonzero-vs-nonzero. Mismatch raises on every process together (the
+    verdict rides the allgather, like the completeness barrier's
+    timeout bit)."""
+    # reshape, not [:, 0]: single-process process_allgather returns the
+    # row without a leading nproc axis
+    got = np.asarray(
+        allgather_blob(np.array([local_waves], dtype=np.int64))
+    ).reshape(-1)
+    w = int(got.max())
+    if (got != w).any():
+        raise RuntimeError(
+            f"wave-count mismatch across processes: {got.tolist()} — "
+            f"spark.shuffle.tpu.a2a.waveRows must be identical on every "
+            f"process (collective reads derive waves from the same "
+            f"global size row)")
+    return w
+
+
 def gather_clock_anchors(tracer=None) -> list:
     """COLLECTIVE: every process's wall↔perf anchor pair
     (:meth:`Tracer.anchor` + process index), gathered at connect/remesh

@@ -34,10 +34,13 @@ from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.meta.registry import ShuffleEntry
 from sparkucx_tpu.meta.segments import validate_row_sizes
 from sparkucx_tpu.runtime.node import TpuNode
-from sparkucx_tpu.shuffle.plan import ShufflePlan, make_plan
+from sparkucx_tpu.shuffle.plan import (ShufflePlan, make_plan, wave_count,
+                                       wave_step_plan)
 from sparkucx_tpu.shuffle.reader import (
     KEY_WORDS,
     ShuffleReaderResult,
+    WavedShuffleReaderResult,
+    drain_wave_result,
     pack_rows,
     submit_shuffle,
     value_words,
@@ -47,7 +50,7 @@ from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
                                         GLOBAL_METRICS, H_FETCH_FIRST,
                                         H_FETCH_WAIT, H_PEER_BYTES,
-                                        H_PEER_ROWS)
+                                        H_PEER_ROWS, H_WAVE_GAP)
 from sparkucx_tpu.utils.trace import format_trace_id
 
 log = get_logger("shuffle.manager")
@@ -99,6 +102,19 @@ class ExchangeReport:
     stepcache_hits: int = 0
     stepcache_programs: int = 0
     plan_bucket: List[int] = field(default_factory=list)
+    # Wave-pipelined exchange (a2a.waveRows): wave split plus the
+    # per-wave timeline — one entry per wave, {wave, rows, pack_start_ms,
+    # pack_ms, dispatch_ms, hidden, forced_ms, wait_ms, retries}, times
+    # relative to read start. ``hidden`` is MEASURED, not structural: it
+    # marks a pack that finished while an earlier wave's collective was
+    # provably still running (done() polled false after the pack), so
+    # its cost is off the critical path; the overlap-proof test and the
+    # doctor's pipeline_stall rule both read this record. 0/empty =
+    # single-shot.
+    waves: int = 0
+    wave_rows: int = 0
+    wave_pack_hidden_ms: float = 0.0
+    wave_timeline: List[Dict] = field(default_factory=list)
     completed: bool = False
     error: Optional[str] = None
     # bookkeeping, excluded from to_dict()
@@ -166,6 +182,17 @@ class TpuShuffleManager:
         # workload pays the overflow-retry recompile once, then every later
         # shuffle of the same shape starts at the capacity that worked.
         self._cap_hints: Dict[tuple, int] = {}
+        # same idea for WAVE plans ((cap_key, wave cap_in) -> settled
+        # cap_out): a wave that overflowed grows once, then every later
+        # wave — this exchange's AND later same-shape exchanges' — starts
+        # at the capacity that worked (no per-exchange re-overflow)
+        self._wave_cap_hints: Dict[tuple, int] = {}
+        # Persistent pack executor (a2a.packThreads), built lazily by
+        # _pack_executor() and shut down in stop(): _pack_shards used to
+        # spawn/tear down a ThreadPoolExecutor PER READ, whose cost
+        # forced a 16 MiB amortization guard — and the wave pipeline
+        # packs N-waves times per read, multiplying that spawn cost.
+        self._pack_pool = None
         # writers dropped by an epoch bump, kept alive until no read that
         # could still touch their buffers remains (see _on_epoch_bump)
         self._graveyard: list = []          # [(dropped_at_gen, writers)]
@@ -900,6 +927,18 @@ class TpuShuffleManager:
                                  if has_vals else 0)
             self._report_volume(rep, plan, nvalid, width,
                                 part_rows=table.sizes.sum(axis=0))
+            # Wave-pipelined mode (a2a.waveRows): instead of one giant
+            # pack + one monolithic program, split the staged rows into
+            # fixed-shape waves and run a software pipeline inside the
+            # pending handle's result() — pack wave i+1 while wave i's
+            # collective is in flight and wave i-1 drains D2H.
+            if self.conf.wave_rows > 0 and self._waves_eligible(plan):
+                W = wave_count(nvalid, self.conf.wave_rows)
+                if W > 1:
+                    return self._submit_waved(
+                        handle, shard_outputs, nvalid, plan, width,
+                        has_vals, val_tail if has_vals else None,
+                        val_dtype, rep, timeout, W, distributed=False)
             t_pack = time.perf_counter()
             with tracer.span("shuffle.pack", rows=int(nvalid.sum()),
                              trace=rep.trace_id):
@@ -1220,15 +1259,19 @@ class TpuShuffleManager:
             rows[p, off:] = 0
 
         try:
-            workers = max(1, min(len(slot_outputs),
-                                 self.conf.cores_per_process))
-            # threads only when the copy is big enough to amortize pool
-            # spawn/teardown (tiny shuffles are the common test shape)
-            if workers > 1 and rows.nbytes >= (16 << 20):
-                from concurrent.futures import ThreadPoolExecutor
-                with ThreadPoolExecutor(max_workers=workers) as ex:
-                    list(ex.map(lambda p: fill(p, pack_threads=1),
-                                range(len(slot_outputs))))
+            # the persistent executor makes fan-out dispatch ~µs, so the
+            # old 16 MiB spawn-amortization guard shrinks to a modest
+            # floor that only filters shapes where the copy itself is
+            # cheaper than waking the workers (tiny test shuffles).
+            # Worker count comes from conf (the same expression
+            # _pack_executor sizes the pool with), so a single-core
+            # process never even builds the pool.
+            workers = self.conf.pack_threads or self.conf.cores_per_process
+            if workers > 1 and len(slot_outputs) > 1 \
+                    and rows.nbytes >= (1 << 20):
+                ex = self._pack_executor()
+                list(ex.map(lambda p: fill(p, pack_threads=1),
+                            range(len(slot_outputs))))
             else:
                 for p in range(len(slot_outputs)):
                     fill(p)
@@ -1238,6 +1281,100 @@ class TpuShuffleManager:
             self.node.pool.put(buf)
             raise
         return rows, buf
+
+    def _pack_executor(self):
+        """The manager's persistent pack thread pool (lazily built, shut
+        down in stop()). Sized by ``a2a.packThreads`` (0 = coresPerProcess)
+        — the knob the doctor's pipeline_stall rule points at when wave
+        packs run slower than the collective they should hide behind."""
+        with self._lock:
+            if self._pack_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                workers = self.conf.pack_threads \
+                    or self.conf.cores_per_process
+                self._pack_pool = ThreadPoolExecutor(
+                    max_workers=max(1, int(workers)),
+                    thread_name_prefix="sxt-pack")
+            return self._pack_pool
+
+    # -- wave-pipelined exchange (a2a.waveRows) ----------------------------
+    def _waves_eligible(self, plan: ShufflePlan) -> bool:
+        """Whether a2a.waveRows applies to this read. Pure conf/plan
+        facts — identical on every process, so the distributed branch
+        decision stays in SPMD lockstep without a collective."""
+        if self.hierarchical:
+            log.info("a2a.waveRows set but the hierarchical two-stage "
+                     "exchange is active — single-shot read (waves ride "
+                     "the flat exchange only)")
+            return False
+        if plan.impl == "pallas":
+            log.info("a2a.waveRows set with impl='pallas' — single-shot "
+                     "read (the remote-DMA transport owns its own "
+                     "chunk-aligned flow control)")
+            return False
+        return True
+
+    def _submit_waved(self, handle: ShuffleHandle, slot_outputs,
+                      nvalid: np.ndarray, plan: ShufflePlan, width: int,
+                      has_vals: bool, val_tail, val_dtype,
+                      rep: ExchangeReport, timeout: Optional[float],
+                      num_waves: int, distributed: bool,
+                      shard_ids=None) -> "PendingWaveShuffle":
+        """Build the pending handle for a wave-pipelined read. Packs are
+        DEFERRED into result() (where the pipeline drives them overlapped
+        with the collectives), so this path re-registers its own
+        in-flight-read guard — the caller's guard window closes when this
+        returns, but writer-owned memory is walked until the LAST wave's
+        pack."""
+        wave_rows = self.conf.wave_rows
+        outer = dataclasses.replace(plan, wave_rows=wave_rows,
+                                    num_waves=num_waves)
+        wplan = wave_step_plan(outer, self.conf)
+        with self._lock:
+            hint = self._wave_cap_hints.get(
+                (self._cap_key(handle), wplan.cap_in), 0)
+        if hint > wplan.cap_out:
+            # a same-shape exchange already settled its wave capacity —
+            # start there instead of re-paying the overflow recompile
+            wplan = dataclasses.replace(wplan, cap_out=hint)
+        rep.waves = num_waves
+        rep.wave_rows = wave_rows
+        rep.plan_bucket = [int(wplan.cap_in), int(wplan.cap_out)]
+        depth = max(1, min(self.conf.wave_depth, num_waves))
+        # Admission: the pipeline's whole point is a bounded footprint —
+        # `depth` pinned wave blocks plus up to `depth` waves' device
+        # buffers, NOT the full shuffle (same estimate discipline as
+        # _exchange_footprint; identical on every process by
+        # construction, like the single-shot distributed path).
+        # _make_admitter adds ONE wave's device term itself, so the
+        # stage argument carries the other depth-1 — an undrained wave
+        # pins its send+recv matrices until drain_wave_result, and the
+        # reservation must say so or the backpressure cap silently
+        # loosens by a factor of depth.
+        block_bytes = len(slot_outputs) * wplan.cap_in * width * 4
+        device_wave = (wplan.cap_in + wplan.cap_out) * width * 4 \
+            * wplan.num_shards
+        admit, release_admitted = self._make_admitter(
+            wplan, width,
+            depth * block_bytes + (depth - 1) * device_wave,
+            None if distributed else timeout)
+        local_rows = sum(int(k.shape[0])
+                         for outs in slot_outputs for k, _ in outs)
+        read_gen = self._read_started()
+        try:
+            log.info("wave-pipelined read: shuffle %d, %d waves x %d "
+                     "rows/shard (depth %d, wave plan cap_in=%d "
+                     "cap_out=%d)", handle.shuffle_id, num_waves,
+                     wave_rows, depth, wplan.cap_in, wplan.cap_out)
+            return PendingWaveShuffle(
+                self, handle, outer, wplan, depth, slot_outputs, nvalid,
+                width, has_vals, val_tail, val_dtype, rep, read_gen,
+                admit, release_admitted, local_rows, distributed,
+                shard_ids)
+        except BaseException:
+            self._read_finished(read_gen)
+            release_admitted()
+            raise
 
     # -- the multi-process read path --------------------------------------
     def _submit_distributed(self, handle: ShuffleHandle, timeout: float,
@@ -1435,6 +1572,25 @@ class TpuShuffleManager:
             # process shares by construction)
             self._report_volume(rep, plan, nvalid, width,
                                 local_rows=int(nvalid_local.sum()))
+        # Wave-pipelined mode, multi-process: the wave count derives from
+        # the ALLGATHERED global size row (identical math everywhere), and
+        # agree_wave_count allgathers the verdict so a divergent
+        # a2a.waveRows conf fails fast on every process together instead
+        # of desyncing the SPMD group mid-pipeline. The agreement runs on
+        # EVERY distributed read — a waves-off process proposes 1 — or a
+        # process booted with waveRows=0 would skip straight into the
+        # single-shot collective while its peers enter the wave loop
+        # (exactly the desync the guard exists to prevent; one tiny
+        # allgather rides the same metadata plane as the barriers above).
+        from sparkucx_tpu.shuffle.distributed import agree_wave_count
+        eligible = self.conf.wave_rows > 0 and self._waves_eligible(plan)
+        W = wave_count(nvalid, self.conf.wave_rows) if eligible else 1
+        W = agree_wave_count(W if eligible and W > 1 else 1)
+        if W > 1:
+            return self._submit_waved(
+                handle, shard_outputs, nvalid, plan, width, has_vals,
+                val_tail if has_vals else None, val_dtype, rep, None,
+                W, distributed=True, shard_ids=shard_ids)
         t_pack = time.perf_counter()
         with tracer.span("shuffle.pack", rows=int(nvalid_local.sum()),
                          trace=rep.trace_id if rep is not None else ""):
@@ -1589,6 +1745,10 @@ class TpuShuffleManager:
             ids = list(self._writers.keys())
             graveyard, self._graveyard = self._graveyard, []
         self._release_writer_batches([ws for _, ws in graveyard])
+        with self._lock:
+            pack_pool, self._pack_pool = self._pack_pool, None
+        if pack_pool is not None:
+            pack_pool.shutdown(wait=True)
         for sid in ids:
             self.unregister_shuffle(sid)
         # A drain that timed out leaves reads active: the unregister loop
@@ -1600,3 +1760,307 @@ class TpuShuffleManager:
         with self._lock:
             leftover, self._graveyard = self._graveyard, []
         self._release_writer_batches([ws for _, ws in leftover])
+
+
+def _slice_slot_outputs(slot_outputs, lo: int, hi: int):
+    """Row range [lo, hi) of each slot's concatenated staged sequence, as
+    ZERO-COPY views into the writer-owned arrays — one wave's share of the
+    staged map outputs. Returns (sliced_slot_outputs, per_slot_counts);
+    callers must hold the manager's in-flight-read guard for as long as
+    the views are live (they alias arena/mmap memory)."""
+    out, counts = [], []
+    for outs in slot_outputs:
+        sliced = []
+        off = 0
+        for keys, values in outs:
+            n = keys.shape[0]
+            s, e = max(lo - off, 0), min(hi - off, n)
+            if s < e:
+                sliced.append((keys[s:e],
+                               None if values is None else values[s:e]))
+            off += n
+        out.append(sliced)
+        counts.append(sum(int(k.shape[0]) for k, _ in sliced))
+    return out, np.asarray(counts, dtype=np.int64)
+
+
+class PendingWaveShuffle:
+    """Future-like handle for a WAVE-PIPELINED exchange (a2a.waveRows).
+
+    ``result()`` drives a depth-D software pipeline over the staged map
+    outputs: wave *i+1* is packed on the host (persistent pack executor,
+    recycled HostMemoryPool blocks) while wave *i*'s collective is in
+    flight and wave *i-1* drains D2H — the streaming fetch window of the
+    reference's reader (maxBlocksInFlight over a lazy request queue,
+    ref: UcxShuffleReader.scala:56-70 / compat/spark_3_0 fetch iterator),
+    rebuilt over compiled-program launches instead of block requests.
+
+    Invariants the pipeline keeps:
+
+    * every wave dispatches the SAME compiled program (wave_step_plan —
+      fixed shape, one step-cache entry per shape family);
+    * an overflow retry regrows and re-runs ONLY the offending wave
+      (PendingShuffle's own retry loop), and later waves start at the
+      grown capacity;
+    * pinned staging never exceeds ``depth`` wave blocks — the pool
+      recycles the block a drained wave released into the next pack;
+    * multi-process: every process drives the identical wave sequence in
+      lockstep (wave count agreed collectively at submit), so the
+      per-wave collectives — including retry consensus — stay SPMD-safe.
+
+    ``done()`` is a local poll (False until result() ran: packs are
+    deferred into the drive so they can overlap the collectives)."""
+
+    def __init__(self, mgr: TpuShuffleManager, handle: ShuffleHandle,
+                 outer_plan: ShufflePlan, wave_plan: ShufflePlan,
+                 depth: int, slot_outputs, nvalid: np.ndarray, width: int,
+                 has_vals: bool, val_tail, val_dtype, rep: ExchangeReport,
+                 read_gen: int, admit, release_admitted, local_rows: int,
+                 distributed: bool, shard_ids=None):
+        self._mgr = mgr
+        self._handle = handle
+        self._outer_plan = outer_plan
+        self._wave_plan = wave_plan
+        self._depth = depth
+        self._slot_outputs = slot_outputs
+        self._nvalid = nvalid
+        self._width = width
+        self._has_vals = has_vals
+        self._val_tail = val_tail
+        self._val_dtype = val_dtype
+        self._rep = rep
+        self._read_gen = read_gen
+        self._guard_open = True
+        self._admit = admit
+        self._release_admitted = release_admitted
+        self._local_rows = local_rows
+        self._distributed = distributed
+        self._shard_ids = list(shard_ids) if shard_ids is not None else None
+        self._num_waves = outer_plan.num_waves
+        self._wave_rows = outer_plan.wave_rows
+        self._result = None
+        self._dead = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def done(self) -> bool:
+        return self._result is not None or self._dead
+
+    def _finish_guard(self) -> None:
+        if self._guard_open:
+            self._guard_open = False
+            # drop the staged-output views FIRST: they alias writer
+            # memory the guard is about to stop protecting
+            self._slot_outputs = None
+            self._mgr._read_finished(self._read_gen)
+
+    def __del__(self):
+        # abandoned handle: nothing was dispatched (packs defer into
+        # result()), but the read guard and any queued admission must not
+        # leak — a parked graveyard batch would otherwise never free
+        try:
+            if self._result is None and not self._dead:
+                self._finish_guard()
+                self._release_admitted()
+                self._mgr.node.flight.end_trace(self._rep.trace_id)
+        except Exception:
+            pass
+
+    def result(self) -> ShuffleReaderResult:
+        if self._result is not None:
+            return self._result
+        if self._dead:
+            raise RuntimeError(
+                "wave exchange handle is dead: a previous result() failed "
+                "and its buffers were released — re-submit the shuffle")
+        rep = self._rep
+        try:
+            res = self._drive()
+        except BaseException as e:
+            self._dead = True
+            self._release_admitted()
+            rep.error = rep.error or repr(e)[:300]
+            rep.stepcache_hits = int(
+                GLOBAL_METRICS.get(COMPILE_HITS) - rep._hits0)
+            rep.stepcache_programs = int(
+                GLOBAL_METRICS.get(COMPILE_PROGRAMS) - rep._prog0)
+            self._mgr.node.flight.end_trace(rep.trace_id)
+            raise
+        self._result = res
+        return res
+
+    # -- the pipeline ------------------------------------------------------
+    def _drive(self) -> ShuffleReaderResult:
+        from collections import deque
+        mgr = self._mgr
+        rep = self._rep
+        tracer = mgr.node.tracer
+        t_read0 = time.perf_counter()
+        inflight: "deque" = deque()       # (wave_idx, pending)
+        timeline: List[Dict] = []
+        wave_results: List = [None] * self._num_waves
+        retries_total = 0
+        pack_total = dispatch_total = pack_hidden = 0.0
+        if self._admit is not None and not self._admit(False):
+            self._admit(True)             # blocks until capacity frees
+        try:
+            for i in range(self._num_waves):
+                while len(inflight) >= self._depth:
+                    retries_total += self._drain_oldest(
+                        inflight, wave_results, timeline, t_read0)
+                oldest = inflight[0][1] if inflight else None
+                t0 = time.perf_counter()
+                with tracer.span("shuffle.wave",
+                                 shuffle_id=self._handle.shuffle_id,
+                                 wave=i, trace=rep.trace_id):
+                    sliced, wnv = _slice_slot_outputs(
+                        self._slot_outputs, i * self._wave_rows,
+                        (i + 1) * self._wave_rows)
+                    shard_rows, buf = mgr._pack_shards(
+                        sliced, self._wave_plan.cap_in, self._width,
+                        self._has_vals)
+                    t1 = time.perf_counter()
+                    if i == self._num_waves - 1:
+                        # last pack done: writer memory is no longer
+                        # walked — close the guard window before the
+                        # drains so a concurrent remesh need not park
+                        # the writers for the pipeline tail
+                        self._finish_guard()
+                    if not rep._t_dispatched:
+                        rep._t_dispatched = t1
+                    try:
+                        pending = self._dispatch_wave(shard_rows, wnv,
+                                                      buf)
+                    except BaseException:
+                        # no pending exists: the pinned block has no
+                        # owner yet (same rule as the single-shot path)
+                        mgr.node.pool.put(buf)
+                        raise
+                t2 = time.perf_counter()
+                # MEASURED overlap, not structural: a pack counts as
+                # hidden only when the oldest in-flight collective is
+                # provably still running AFTER the pack finished (done()
+                # poll) — a pack-bound pipeline whose collectives finish
+                # mid-pack must not report itself hidden (that is the
+                # pipeline_stall condition). Partial overlap counts as
+                # not hidden, so the hidden fraction is a lower bound.
+                hidden = oldest is not None and not oldest.done()
+                pack_ms = (t1 - t0) * 1e3
+                pack_total += pack_ms
+                if hidden:
+                    pack_hidden += pack_ms
+                dispatch_total += (t2 - t1) * 1e3
+                timeline.append({
+                    "wave": i, "rows": int(wnv.sum()),
+                    "pack_start_ms": round((t0 - t_read0) * 1e3, 3),
+                    "pack_ms": round(pack_ms, 3),
+                    "dispatch_ms": round((t2 - t1) * 1e3, 3),
+                    "hidden": hidden,
+                    "forced_ms": 0.0, "wait_ms": 0.0, "retries": 0})
+                inflight.append((i, pending))
+            while inflight:
+                retries_total += self._drain_oldest(
+                    inflight, wave_results, timeline, t_read0)
+        except BaseException:
+            # settle every in-flight wave before propagating: their
+            # exactly-once on_done returns the pinned blocks, and a
+            # distributed peer must not be left mid-collective with
+            # this process gone quiet
+            while inflight:
+                _, p = inflight.popleft()
+                try:
+                    p.result()
+                except Exception:
+                    pass
+            raise
+        finally:
+            self._finish_guard()
+        self._release_admitted()
+        res = WavedShuffleReaderResult(wave_results, self._outer_plan,
+                                       self._val_tail, self._val_dtype)
+        self._finalize(res, timeline, retries_total, pack_total,
+                       pack_hidden, dispatch_total)
+        return res
+
+    def _dispatch_wave(self, shard_rows: np.ndarray, wnv: np.ndarray,
+                       buf):
+        mgr = self._mgr
+        pool = mgr.node.pool
+
+        def on_done(result, _b=buf):
+            # per-wave exactly-once release: the pool's free list hands
+            # this block to the NEXT wave's pack — the recycled-block
+            # discipline that bounds pinned staging at `depth` blocks
+            pool.put(_b)
+
+        if self._distributed:
+            from sparkucx_tpu.shuffle.distributed import \
+                submit_shuffle_distributed
+            return submit_shuffle_distributed(
+                mgr.exchange_mesh, mgr.axis, self._wave_plan, shard_rows,
+                wnv, self._shard_ids, self._val_tail, self._val_dtype,
+                on_done=on_done)
+        return submit_shuffle(
+            mgr.exchange_mesh, mgr.axis, self._wave_plan, shard_rows,
+            wnv, self._val_tail, self._val_dtype, on_done=on_done)
+
+    def _drain_oldest(self, inflight, wave_results, timeline,
+                      t_read0: float) -> int:
+        """Force the oldest in-flight wave: block on its result (the
+        per-wave overflow retry loop lives inside), pull its receive
+        buffers host-side NOW (freeing HBM for the waves behind it), and
+        record the wait. Returns the wave's retry count."""
+        i, pending = inflight.popleft()
+        t0 = time.perf_counter()
+        res = pending.result()
+        wait_ms = (time.perf_counter() - t0) * 1e3
+        drain_wave_result(res)
+        entry = timeline[i]
+        entry["forced_ms"] = round((t0 - t_read0) * 1e3, 3)
+        entry["wait_ms"] = round(wait_ms, 3)
+        retries = int(getattr(pending, "_attempt", 0))
+        entry["retries"] = retries
+        wave_results[i] = res
+        used = getattr(res, "cap_out_used", None)
+        if used and int(used) > self._wave_plan.cap_out:
+            # this wave overflowed and grew: later waves start at the
+            # capacity that worked — ONE regrow per exchange, not one
+            # per wave (and only the offending wave ever re-ran)
+            self._wave_plan = dataclasses.replace(
+                self._wave_plan, cap_out=int(used))
+        return retries
+
+    def _finalize(self, res, timeline, retries_total: int,
+                  pack_total: float, pack_hidden: float,
+                  dispatch_total: float) -> None:
+        mgr = self._mgr
+        rep = self._rep
+        rep.pack_ms = pack_total
+        rep.dispatch_ms = dispatch_total
+        rep.wave_pack_hidden_ms = round(pack_hidden, 3)
+        rep.wave_timeline = timeline
+        rep.retries = retries_total
+        if rep._t_dispatched:
+            rep.group_ms = (time.perf_counter()
+                            - rep._t_dispatched) * 1e3
+        rep.stepcache_hits = int(
+            GLOBAL_METRICS.get(COMPILE_HITS) - rep._hits0)
+        rep.stepcache_programs = int(
+            GLOBAL_METRICS.get(COMPILE_PROGRAMS) - rep._prog0)
+        rep.completed = True
+        mgr.node.flight.end_trace(rep.trace_id)
+        metrics = mgr.node.metrics
+        metrics.inc("shuffle.rows", float(self._local_rows))
+        metrics.inc("shuffle.bytes",
+                    float(self._local_rows) * self._width * 4)
+        if retries_total:
+            metrics.inc("shuffle.retries", float(retries_total))
+        # wave wait-gap distribution: pack time NOT covered by the
+        # previous wave's collective — sustained positive gaps mean the
+        # device idles on the host pack (doctor: pipeline_stall)
+        for k in range(1, len(timeline)):
+            metrics.observe(H_WAVE_GAP, max(
+                0.0, timeline[k]["pack_ms"] - timeline[k - 1]["wait_ms"]))
+        with mgr._lock:
+            key = (mgr._cap_key(self._handle), self._wave_plan.cap_in)
+            if self._wave_plan.cap_out > mgr._wave_cap_hints.get(key, 0):
+                mgr._wave_cap_hints[key] = self._wave_plan.cap_out

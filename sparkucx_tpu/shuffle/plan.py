@@ -80,6 +80,15 @@ class ShufflePlan:
     # AOT compile from a CPU host would otherwise bake the interpreter
     # into the TPU program).
     pallas_interpret: Optional[bool] = None
+    # Wave-pipelined exchange (a2a.waveRows, shuffle/manager.py): the
+    # OUTER descriptive plan of a waved read carries the wave split here
+    # — rows per shard per wave and the agreed wave count. The plan each
+    # wave actually DISPATCHES (wave_step_plan) keeps both at their
+    # defaults, so the compiled-program signature never varies with how
+    # many waves a particular shuffle happened to split into (one
+    # program per wave-shape family, not one per exchange).
+    wave_rows: int = 0
+    num_waves: int = 1
 
     def grown(self) -> "ShufflePlan":
         """Next plan after an overflow: double the receive capacity."""
@@ -115,6 +124,12 @@ _MEASURED_STRIPS: dict = {}
 # Valid a2a.sortStrips bounds — ONE constant shared by conf validation
 # and bench's parse-time check so the two cannot drift.
 STRIPS_RANGE = (1, 4096)
+
+# Valid a2a.waveDepth bounds (the STRIPS_RANGE discipline: one constant
+# shared by conf validation and the pipeline). Depth 1 = serial waves
+# (bounded memory, no overlap); past ~8 the pinned-block working set
+# grows without hiding any more latency (three pipeline stages exist).
+WAVE_DEPTH_RANGE = (1, 8)
 
 # Valid a2a.capBucketGrowth bounds (the STRIPS_RANGE discipline: one
 # constant shared by conf validation and the quantizer). Growth close to
@@ -245,3 +260,41 @@ def make_plan(
         combine_compaction=conf.combine_compaction,
         bounds=bounds,
     )
+
+
+def wave_count(shard_rows: np.ndarray, wave_rows: int) -> int:
+    """Waves a staged row distribution splits into at ``wave_rows`` rows
+    per shard per wave: ceil(max staged rows / wave_rows). Every shard
+    uses the same count (trailing waves of a lighter shard are empty) so
+    the pipeline stays in lockstep — the distributed path allgathers this
+    number (shuffle/distributed.agree_wave_count) purely to fail fast on
+    divergent ``a2a.waveRows`` conf; the arithmetic itself is already
+    identical everywhere because ``shard_rows`` is the global size row."""
+    if wave_rows <= 0:
+        return 1
+    mx = int(np.max(shard_rows, initial=0))
+    return max(1, -(-mx // int(wave_rows)))
+
+
+def wave_step_plan(plan: ShufflePlan, conf: Optional[TpuShuffleConf]
+                   = None) -> ShufflePlan:
+    """The plan ONE wave of a waved exchange dispatches.
+
+    Derived from the outer plan's ``wave_rows``: cap_in is the (bucketed)
+    wave size, cap_out the balanced wave share times capacityFactor —
+    both independent of this exchange's total rows or wave count, so
+    every wave of every same-shaped shuffle lands on ONE compiled program
+    (the acceptance contract: compile.step.programs delta = 1 per shape
+    family). Wave fields are reset to their defaults: the step signature
+    must not vary with ``num_waves``, and a wave plan whose shape happens
+    to equal a single-shot plan's SHARES that program."""
+    import dataclasses
+    conf = conf or TpuShuffleConf()
+    if plan.wave_rows <= 0:
+        raise ValueError("wave_step_plan needs a plan with wave_rows > 0")
+    cap_in = bucket_cap_conf(_round_up(plan.wave_rows), conf)
+    cap_out = bucket_cap_conf(
+        _round_up(int(np.ceil(plan.wave_rows * conf.capacity_factor))),
+        conf)
+    return dataclasses.replace(plan, cap_in=cap_in, cap_out=cap_out,
+                               wave_rows=0, num_waves=1)

@@ -58,6 +58,20 @@ def _blocked_map(num_partitions: int, num_devices: int):
     return blocked_partition_map(num_partitions, num_devices)
 
 
+def _concat_blocks(blocks) -> np.ndarray:
+    """Dense concatenation of row blocks via ONE preallocated destination
+    + sliced copies (no temp-list np.concatenate) — the multi-run
+    partition-block builder, shared by the run-index path and the waved
+    result's plain-mode merge."""
+    total = sum(b.shape[0] for b in blocks)
+    out = np.empty((total, blocks[0].shape[1]), blocks[0].dtype)
+    off = 0
+    for b in blocks:
+        out[off:off + b.shape[0]] = b
+        off += b.shape[0]
+    return out
+
+
 def _device_bounds(num_partitions: int, num_devices: int) -> np.ndarray:
     """Static [P+1] partition-range boundaries of the blocked map: device d
     owns partitions [bounds[d], bounds[d+1])."""
@@ -525,6 +539,11 @@ class ShuffleReaderResult:
         self._val_dtype = val_dtype
         self._align_chunk = align_chunk
         self._runidx: dict = {}
+        # dense multi-run partition blocks, built once per partition:
+        # repeated partition(r) calls used to re-concatenate the same
+        # runs every time (the copy IS the cost — run lookup is prefix
+        # sums). Single-run partitions stay uncached views.
+        self._block_cache: dict = {}
         # receive capacity the exchange actually ran with (after any
         # overflow retries) — the manager feeds it back as the next plan's
         # starting capacity for this shuffle shape
@@ -558,7 +577,12 @@ class ShuffleReaderResult:
         return True
 
     def _partition_block(self, r: int, shard: int) -> np.ndarray:
-        """Dense [n, width] rows of partition r (host array)."""
+        """Dense [n, width] rows of partition r (host array).
+
+        Multi-run blocks are built ONCE (one preallocated destination,
+        sliced copies — no temp-list concatenate) and cached: every
+        repeat ``partition(r)`` used to re-copy the same runs. Single-run
+        partitions return a zero-copy view, which needs no cache."""
         rows = self._shard_rows(shard)
         runs = self._runs(shard).runs(r)
         if not runs:
@@ -566,7 +590,12 @@ class ShuffleReaderResult:
         if len(runs) == 1:
             s, n = runs[0]
             return rows[s:s + n]
-        return np.concatenate([rows[s:s + n] for s, n in runs])
+        got = self._block_cache.get(r)
+        if got is not None:
+            return got
+        out = _concat_blocks([rows[s:s + n] for s, n in runs])
+        self._block_cache[r] = out
+        return out
 
     def partition(self, r: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """(keys, values) of reduce partition r, densely packed.
@@ -641,6 +670,7 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
         self._val_dtype = val_dtype
         self._seg = None
         self._runidx: dict = {}
+        self._block_cache: dict = {}       # r -> dense multi-run block
         self._shards: dict = {}            # shard -> np [cap_out, width]
         self.cap_out_used: Optional[int] = cap_out
         self.recv_rows_needed: Optional[int] = None
@@ -815,6 +845,167 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
             # every partition is host-side (cached blocks) — drop the
             # device buffers, same HBM-release point as shard mode
             self._rows_dev = None
+        return block
+
+
+def merge_sorted_rows(blocks) -> np.ndarray:
+    """Merge per-wave key-sorted packed row blocks into one key-sorted
+    block (host). Each block is already sorted by signed int64 key (the
+    device keysort's order), so one argsort over the concatenation
+    restores the ``ordered`` contract across waves — key order only; tie
+    order among equal keys is unspecified, exactly like the device sort."""
+    rows = np.concatenate(blocks)
+    keys = np.ascontiguousarray(
+        rows[:, :KEY_WORDS]).view(np.int64).ravel()
+    return rows[np.argsort(keys, kind="stable")]
+
+
+def combine_packed_rows(blocks, val_words_n: int, val_dtype,
+                        sum_words: int = 0) -> np.ndarray:
+    """Merge per-wave COMBINED packed row blocks by key (host) — the
+    cross-wave half of combine-by-key. Each wave's block already holds
+    one key-sorted row per distinct key (the device combine ran map- and
+    reduce-side within the wave); a key that appeared in several waves
+    has one row per wave here, and this pass sums them.
+
+    Numerics match the device combiner's store semantics: integer sums
+    are exact modulo the declared dtype's width (accumulating in any
+    wider integer then casting is the same ring arithmetic as the
+    device's int32 lanes), floats accumulate in float32 and store back
+    to the declared dtype. ``sum_words`` transport words are summed, the
+    rest of the value row is CARRIED (per-key-constant payload — any
+    representative is THE value, same contract as ops/aggregate)."""
+    rows = blocks[0] if len(blocks) == 1 else np.concatenate(blocks)
+    n = rows.shape[0]
+    if n == 0:
+        return rows
+    keys = np.ascontiguousarray(
+        rows[:, :KEY_WORDS]).view(np.int64).ravel()
+    order = np.argsort(keys, kind="stable")
+    rows = rows[order]
+    keys = keys[order]
+    starts_mask = np.empty(n, dtype=bool)
+    starts_mask[0] = True
+    np.not_equal(keys[1:], keys[:-1], out=starts_mask[1:])
+    starts = np.flatnonzero(starts_mask)
+    # representative row per key carries the key words AND the carried
+    # payload lanes; only the summed lanes are overwritten below
+    out = rows[starts].copy()
+    vdt = np.dtype(val_dtype)
+    sw = sum_words if sum_words > 0 else val_words_n
+    if sw:
+        vals = np.ascontiguousarray(
+            rows[:, KEY_WORDS:KEY_WORDS + sw]).view(vdt)
+        acc_dt = np.float32 if np.issubdtype(vdt, np.floating) \
+            else np.int64
+        acc = np.add.reduceat(vals.astype(acc_dt), starts,
+                              axis=0).astype(vdt)
+        out[:, KEY_WORDS:KEY_WORDS + sw] = \
+            np.ascontiguousarray(acc).view(np.int32)
+    return out
+
+
+def drain_wave_result(res) -> None:
+    """Drain one completed wave: pull every locally-addressable shard's
+    receive buffer (and the seg matrix) host-side NOW — the D2H stage of
+    the wave pipeline. LazyShuffleReaderResult drops its device arrays
+    once every shard is host-cached, so after this the wave holds no HBM
+    and the collectives behind it in the pipeline have the device memory
+    to themselves. Host-resident results (the distributed view) are
+    already drained — no-op."""
+    if isinstance(res, LazyShuffleReaderResult):
+        res._seg_matrix(0)
+        for s in range(res._num_shards):
+            try:
+                res._shard_rows(s)
+            except KeyError:
+                pass        # shard not addressable on this process
+
+
+class WavedShuffleReaderResult(ShuffleReaderResult):
+    """Composed host-side view over the W per-wave results of a
+    wave-pipelined exchange (manager.PendingWaveShuffle).
+
+    Each wave was a complete mini-exchange over a fixed-size slice of
+    the staged rows, so partition r's rows are the union of its rows in
+    every wave — served as W x NS contiguous runs through each wave's
+    OWN run index (the existing ``_RunIndex`` arithmetic with the sender
+    axis effectively stacked to senders x waves; no receive-side sort
+    ever happened, per wave or across them). Cross-wave semantics:
+
+    * plain    — runs concatenate wave-major (row order within a
+                 partition is unspecified, as in single-shot);
+    * ordered  — per-wave key-sorted runs merge by key on the host
+                 (``merge_sorted_rows``);
+    * combine  — per-wave combined rows merge-by-key with the summed /
+                 carried lane split (``combine_packed_rows``), restoring
+                 one row per distinct key.
+
+    Merged partition blocks land in the base class's block cache, so
+    repeat ``partition(r)`` calls pay the merge once. Everything is
+    host-resident by construction (the pipeline drained every wave
+    before assembling this), so ``partitions_ready`` is index order."""
+
+    def __init__(self, wave_results, plan: ShufflePlan, val_shape,
+                 val_dtype):
+        if not wave_results:
+            raise ValueError("waved result needs at least one wave")
+        self._waves = list(wave_results)
+        self._plan = plan
+        self.num_partitions = plan.num_partitions
+        self._part_to_shard = wave_results[0]._part_to_shard
+        self._val_shape = val_shape
+        self._val_dtype = val_dtype
+        self._block_cache: dict = {}
+        self.waves = len(wave_results)
+        # wave capacities live on the per-wave results; the manager's
+        # single-shot cap-hint learner must not ratchet on wave shapes
+        self.cap_out_used = None
+        self.recv_rows_needed = None
+
+    def wave_results(self):
+        """The per-wave results, in wave order — each a complete view of
+        that wave's slice (streaming consumers can fold partial
+        partitions wave by wave)."""
+        return list(self._waves)
+
+    def is_local(self, r: int) -> bool:
+        return self._waves[0].is_local(r)
+
+    def partition(self, r: int):
+        if not self.is_local(r):
+            # same reducer contract as the distributed view: non-local
+            # partitions fail loudly, never return wrong data
+            raise KeyError(
+                f"partition {r} lives on shard "
+                f"{int(self._part_to_shard[r])}, not on this process")
+        return super().partition(r)
+
+    def partitions(self):
+        for r in range(self.num_partitions):
+            if self.is_local(r):
+                yield r, self.partition(r)
+
+    def _partition_block(self, r: int, shard: int) -> np.ndarray:
+        got = self._block_cache.get(r)
+        if got is not None:
+            return got
+        blocks = [b for b in (w._partition_block(r, shard)
+                              for w in self._waves) if b.shape[0]]
+        if not blocks:
+            return self._waves[0]._partition_block(r, shard)
+        if self._plan.combine and len(blocks) > 1:
+            block = combine_packed_rows(
+                blocks, self._plan.combine_words,
+                np.dtype(self._plan.combine_dtype),
+                self._plan.combine_sum_words)
+        elif self._plan.ordered and len(blocks) > 1:
+            block = merge_sorted_rows(blocks)
+        elif len(blocks) == 1:
+            block = blocks[0]
+        else:
+            block = _concat_blocks(blocks)
+        self._block_cache[r] = block
         return block
 
 

@@ -35,6 +35,9 @@ compile_churn     step-cache miss ratio                    spark.shuffle.tpu.a2a
 pool_pressure     arena in_use vs allocated watermark      spark.shuffle.tpu.memory.preAllocateBuffers
 overflow_loop     overflow retries despite the cap hint    spark.shuffle.tpu.a2a.capacityFactor
 cold_start        first_wait p50 ≫ steady-state wait p50   spark.shuffle.tpu.compile.cacheEnabled
+pipeline_stall    waved reads where the per-wave pack      spark.shuffle.tpu.a2a.waveRows
+                  outruns the collective it should hide
+                  behind (wait-gap ≈ 0 while packs cost)
 ================  =======================================  =====================================
 
 The same :class:`Finding` schema carries ``bench.py --stage regress``
@@ -50,7 +53,7 @@ from typing import Any, Dict, Iterable, List, Optional, Union
 from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
                                         COMPILE_SECONDS, H_FETCH_FIRST,
                                         H_FETCH_WAIT, H_RETRY_MS,
-                                        Histogram)
+                                        H_WAVE_GAP, Histogram)
 
 GRADES = ("info", "warn", "critical")
 _GRADE_ORDER = {g: i for i, g in enumerate(GRADES)}
@@ -98,6 +101,10 @@ class Thresholds:
     pool_min_allocated: int = 8        # tiny pools are never "pressure"
     overflow_warn_exchanges: int = 2   # hint should have absorbed by then
     cold_start_ratio: float = 10.0     # first_wait p50 / wait p50
+    stall_min_waves: int = 3           # pipeline verdicts need a few waves
+    stall_min_pack_ms: float = 2.0     # sub-noise packs are never a stall
+    stall_wait_frac: float = 0.25      # wait p50 below this x pack p50
+    #                                    = the collective finished early
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -429,9 +436,64 @@ def _rule_cold_start(view: ClusterView, th: Thresholds) -> List[Finding]:
                      "phase"))]
 
 
+def _rule_pipeline_stall(view: ClusterView,
+                         th: Thresholds) -> List[Finding]:
+    """Wave-pipelined reads (a2a.waveRows) where the host pack is the
+    bottleneck: a drained wave's wait is ~zero (the collective finished
+    long before it was forced — the device idled) while the steady-state
+    packs cost real milliseconds. The wave wait-gap histogram
+    (shuffle.wave.gap_ms) carries the same signal as a distribution."""
+    worst = None
+    for r in _completed(view):
+        tl = r.get("wave_timeline") or []
+        if int(r.get("waves", 0)) < th.stall_min_waves \
+                or len(tl) < th.stall_min_waves:
+            continue
+        # wave 0's pack is never hidden by construction; judge the
+        # steady-state tail only
+        steady = tl[1:]
+        p_pack = _median([float(t.get("pack_ms", 0.0)) for t in steady])
+        p_wait = _median([float(t.get("wait_ms", 0.0)) for t in steady])
+        if p_pack < th.stall_min_pack_ms:
+            continue
+        if p_wait > th.stall_wait_frac * p_pack:
+            continue            # collective still outlives the pack
+        ratio = p_pack / max(p_wait, 1e-6)
+        if worst is None or ratio > worst[0]:
+            worst = (ratio, p_pack, p_wait, r)
+    if worst is None:
+        return []
+    ratio, p_pack, p_wait, r = worst
+    ev = {"shuffle_id": r.get("shuffle_id"),
+          "waves": int(r.get("waves", 0)),
+          "wave_rows": int(r.get("wave_rows", 0)),
+          "pack_p50_ms": round(p_pack, 2),
+          "wait_p50_ms": round(p_wait, 2)}
+    hg = view.histograms.get(H_WAVE_GAP)
+    if hg is not None and hg.count:
+        ev["gap_p50_ms"] = round(hg.quantile(0.5), 2)
+        ev["gap_count"] = hg.count
+    return [Finding(
+        rule="pipeline_stall",
+        grade="warn",
+        summary=(f"shuffle {r.get('shuffle_id')}: wave packs "
+                 f"(p50 {p_pack:.1f} ms) outrun the collective "
+                 f"(drain wait p50 {p_wait:.2f} ms over "
+                 f"{int(r.get('waves', 0))} waves) — the device idles "
+                 f"between waves waiting on the host pack"),
+        evidence=ev,
+        conf_key="spark.shuffle.tpu.a2a.waveRows",
+        remediation=("raise spark.shuffle.tpu.a2a.waveRows (bigger waves "
+                     "amortize per-wave pack overhead) or raise "
+                     "a2a.packThreads so the persistent pack executor "
+                     "keeps up; if packs stay dominant, the shape is "
+                     "host-bound — a2a.waveDepth > 2 buys nothing"),
+        trace_ids=[r.get("trace_id", "")])]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
-          _rule_cold_start)
+          _rule_cold_start, _rule_pipeline_stall)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

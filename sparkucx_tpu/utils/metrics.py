@@ -45,9 +45,17 @@ H_PEER_ROWS = "shuffle.peer.rows"            # rows per peer per exchange
 H_PEER_BYTES = "shuffle.peer.bytes"          # bytes per peer per exchange
 H_RETRY_MS = "failure.retry.ms"              # failed-attempt latency (ms)
 H_COMPILE_SECS = "compile.step.duration_s"   # per-program compile seconds
+# Wave-pipelined exchange (a2a.waveRows): per wave i >= 1, the pack time
+# NOT covered by the previous wave's in-flight collective —
+# max(0, pack_ms[i] - wait_ms[i-1]). A healthy pipeline observes ~0 (the
+# collective outlives the pack, packs are fully hidden); sustained
+# positive gaps mean the device idles between waves waiting on the host
+# pack — the doctor's pipeline_stall signal (a2a.waveRows/packThreads).
+H_WAVE_GAP = "shuffle.wave.gap_ms"
 
 WELL_KNOWN_HISTOGRAMS = (H_FETCH_WAIT, H_FETCH_FIRST, H_PEER_ROWS,
-                         H_PEER_BYTES, H_RETRY_MS, H_COMPILE_SECS)
+                         H_PEER_BYTES, H_RETRY_MS, H_COMPILE_SECS,
+                         H_WAVE_GAP)
 
 
 class Histogram:

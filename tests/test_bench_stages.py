@@ -116,3 +116,24 @@ def test_obs_overhead_measure_small(mesh8):
     assert rec["doctor_window_exchanges"] >= 6
     assert rec["doctor_overhead_pct"] >= 0
     assert rec["doctor_findings"] >= 0
+
+
+def test_pipeline_measure_small(mesh8):
+    """The pipeline stage's measurement core at a tiny shape: both arms
+    run, the waved arm waves with a full timeline, the structural
+    contracts hold (one program for all waves, overlap proven, peak
+    pinned below single-shot). The e2e-speedup gate itself belongs to
+    the bench stage at the full pack-dominated shape — asserting a
+    timing win at 2k rows would couple the suite to CI load noise."""
+    rec = bench.pipeline_measure(rows_per_map=2048, maps=4, partitions=8,
+                                 val_words=4, wave_rows=256, depth=2,
+                                 reps=1)
+    w, s = rec["waved"], rec["single"]
+    assert s["programs_timed"] == 0 and w["programs_timed"] == 0
+    assert w["waves"] >= 2
+    assert w["programs_first_exchange"] == 1          # one program, W waves
+    assert w["overlap_proven"] is True
+    assert 0.0 <= w["pack_hidden_fraction"] <= 1.0
+    assert w["pack_hidden_ms"] <= w["pack_ms"] + 1e-6
+    assert w["peak_pinned_bytes"] < s["peak_pinned_bytes"]
+    assert rec["speedup"] > 0

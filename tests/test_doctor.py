@@ -216,6 +216,63 @@ def test_cold_start_info():
     assert fs[0].conf_key == "spark.shuffle.tpu.compile.cacheEnabled"
 
 
+def _wave_report(sid=9, trace="s9.e0.x9", waves=6, pack_ms=8.0,
+                 wait_ms=0.05):
+    """A waved report whose steady-state packs cost ``pack_ms`` and whose
+    drains waited ``wait_ms`` — the pipeline_stall inputs."""
+    r = _report(sid=sid, trace=trace)
+    r["waves"] = waves
+    r["wave_rows"] = 4096
+    tl = []
+    t = 0.0
+    for i in range(waves):
+        tl.append({"wave": i, "rows": 4096,
+                   "pack_start_ms": round(t, 3),
+                   "pack_ms": pack_ms, "dispatch_ms": 0.5,
+                   "hidden": i > 0,
+                   "forced_ms": round(t + pack_ms + 0.5, 3),
+                   "wait_ms": wait_ms, "retries": 0})
+        t += pack_ms + 0.5 + wait_ms
+    r["wave_timeline"] = tl
+    r["wave_pack_hidden_ms"] = pack_ms * (waves - 1)
+    return r
+
+
+def test_pipeline_stall_pack_bound():
+    """Waves whose packs outrun the collective (drain wait ~0 while packs
+    cost ms) — the device idles between waves: pipeline_stall fires and
+    points at a2a.waveRows/packThreads."""
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(
+        _wave_report(sid=9, trace="s9.e0.x9", pack_ms=8.0, wait_ms=0.05))
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["pipeline_stall"]
+    assert fs[0].grade == "warn"
+    assert fs[0].conf_key == "spark.shuffle.tpu.a2a.waveRows"
+    assert "packThreads" in fs[0].remediation
+    assert fs[0].evidence["pack_p50_ms"] == 8.0
+    assert fs[0].trace_ids == ["s9.e0.x9"]
+
+
+def test_pipeline_stall_quiet_when_collective_bound():
+    """A healthy pipeline — the collective outlives each pack (drain
+    waits dominate) — must not fire, and neither must too-few waves or
+    sub-noise packs."""
+    doc = _healthy_doc()
+    # collective-bound: waits far exceed the stall fraction of packs
+    doc["exchange_reports"].append(
+        _wave_report(sid=9, trace="s9.e0.x9", pack_ms=8.0, wait_ms=20.0))
+    # too few waves for a verdict
+    doc["exchange_reports"].append(
+        _wave_report(sid=10, trace="s10.e0.x10", waves=2, pack_ms=9.0,
+                     wait_ms=0.0))
+    # sub-noise packs: nothing worth hiding
+    doc["exchange_reports"].append(
+        _wave_report(sid=11, trace="s11.e0.x11", pack_ms=0.3,
+                     wait_ms=0.0))
+    assert diagnose(doc) == []
+
+
 def test_findings_sorted_and_jsonable():
     doc = _healthy_doc()
     doc["histograms"][H_FETCH_FIRST] = _hist_snap([3000.0])   # info

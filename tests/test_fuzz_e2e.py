@@ -186,3 +186,116 @@ def test_random_varlen_job_roundtrip(manager, seed):
         assert got == truth, f"seed {seed}: varlen totals differ"
     finally:
         manager.unregister_shuffle(sid)
+
+
+# -- wave-pipelined equivalence sweep --------------------------------------
+@pytest.fixture(scope="module")
+def waved_manager():
+    """Module-scoped manager with small waves forced on, so every job in
+    the sweep splits into several waves (the staged shapes here run a few
+    hundred rows per shard)."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense",
+                           "spark.shuffle.tpu.a2a.waveRows": "48"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    m = TpuShuffleManager(node, conf)
+    yield m
+    m.stop()
+    node.close()
+
+
+@pytest.mark.parametrize("seed", range(FUZZ_SEEDS))
+def test_random_job_waved_equals_single_shot(waved_manager, seed):
+    """Fuzz equivalence of waved vs single-shot results: the same seeded
+    job runs through the wave pipeline and is checked against a host
+    oracle exactly like the single-shot sweep above — same key sets,
+    same per-key value multisets, key order under ordered, one summed
+    row per key under combine. The modes and key spaces are stratified
+    over the seed the same way, so waves compose with every read mode."""
+    manager = waved_manager
+    rng = np.random.default_rng(10_000 + seed)
+    M = int(rng.integers(1, 7))
+    R = int(rng.integers(1, 20))
+    key_lo, key_hi = ((0, 37) if seed % 2 else (-(1 << 62), 1 << 62))
+    mode = (seed // 2) % 3          # 0 plain, 1 ordered, 2 combine
+    vdt, vtail = (np.int32, (2,)) if mode == 2 else \
+        VAL_SCHEMAS[int(rng.integers(0, len(VAL_SCHEMAS)))]
+
+    sid = 62_000 + seed
+    h = manager.register_shuffle(sid, M, R)
+    try:
+        oracle = {}
+        total = 0
+        for m in range(M):
+            w = manager.get_writer(h, m)
+            for _ in range(int(rng.integers(1, 4))):
+                n = int(rng.integers(0, 300))
+                keys = rng.integers(key_lo, key_hi, size=n)
+                if vdt is None:
+                    vals = None
+                else:
+                    info = np.iinfo(vdt) if not np.issubdtype(
+                        vdt, np.floating) else None
+                    vals = (rng.normal(size=(n,) + vtail).astype(vdt)
+                            if info is None else
+                            rng.integers(info.min, info.max,
+                                         size=(n,) + vtail).astype(vdt))
+                w.write(keys, vals)
+                for i, k in enumerate(keys):
+                    rec = tuple(np.asarray(vals[i]).ravel().tolist()) \
+                        if vals is not None else ()
+                    oracle.setdefault(int(k), []).append(rec)
+                total += n
+            if m == 0 and total == 0 and vdt is not None:
+                w.write(np.array([1], np.int64),
+                        np.ones((1,) + vtail, dtype=vdt))
+                oracle.setdefault(1, []).append(
+                    tuple(np.ones(int(np.prod(vtail or (1,)))).tolist()))
+                total += 1
+            w.commit(R)
+
+        if mode == 2:
+            res = manager.read(h, combine="sum")
+            want = {k: np.sum(np.asarray(v, dtype=np.int64), axis=0)
+                    for k, v in oracle.items()}
+            seen = set()
+            for r, (ks, vs) in res.partitions():
+                assert list(ks) == sorted(ks), f"seed {seed} part {r}"
+                for i, k in enumerate(ks):
+                    k = int(k)
+                    assert k not in seen, f"seed {seed}: dup key {k}"
+                    seen.add(k)
+                    np.testing.assert_array_equal(
+                        vs[i].astype(np.int64),
+                        want[k].astype(vdt).astype(np.int64),
+                        err_msg=f"seed {seed}, key {k}")
+            assert seen == set(oracle), f"seed {seed}: key sets differ"
+            return
+
+        res = manager.read(h, ordered=(mode == 1))
+        got = {}
+        nrows = 0
+        for r, (ks, vs) in res.partitions():
+            if mode == 1:
+                assert list(ks) == sorted(ks), f"seed {seed}: part {r}"
+            for i, k in enumerate(ks):
+                rec = tuple(np.asarray(vs[i]).ravel().tolist()) \
+                    if vs is not None else ()
+                got.setdefault(int(k), []).append(rec)
+            nrows += len(ks)
+        assert nrows == total, f"seed {seed}: rows {nrows} != {total}"
+        assert set(got) == set(oracle), f"seed {seed}: key sets differ"
+        for k in oracle:
+            assert sorted(got[k]) == sorted(oracle[k]), \
+                f"seed {seed}, key {k}"
+        # the sweep is only meaningful if jobs actually waved: at least
+        # the bigger shapes must have split (tiny draws may not)
+        rep = manager.report(sid)
+        if total > 48 * 8:
+            assert rep.waves >= 2, f"seed {seed}: never waved ({total})"
+    finally:
+        manager.unregister_shuffle(sid)
