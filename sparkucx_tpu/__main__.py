@@ -97,9 +97,28 @@ def _live_snapshot() -> dict:
     return collect_snapshot(GLOBAL_METRICS, tracer=GLOBAL_TRACER)
 
 
+def _fetch_live(url: str) -> dict:
+    """Pull a running node's /snapshot (utils/live.py server) — the
+    CLI's remote-live mode: ``stats``/``doctor`` against another
+    process's scrape endpoint instead of a dump file. The JSON snapshot
+    (not /metrics) is fetched so both renderers and the full rule
+    engine run on the canonical document."""
+    import urllib.request
+    target = url.rstrip("/")
+    if not target.endswith("/snapshot"):
+        target += "/snapshot"
+    with urllib.request.urlopen(target, timeout=10) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
 def _cmd_stats(args) -> int:
     from sparkucx_tpu.utils.export import render_json, render_prometheus
-    doc = _load_anchored(args.input) if args.input else _live_snapshot()
+    if args.live_url:
+        doc = _fetch_live(args.live_url)
+    elif args.input:
+        doc = _load_anchored(args.input)
+    else:
+        doc = _live_snapshot()
     if args.format == "prometheus":
         sys.stdout.write(render_prometheus(doc))
     else:
@@ -149,7 +168,13 @@ def _cmd_timeline(args) -> int:
 def _cmd_doctor(args) -> int:
     from sparkucx_tpu.utils.doctor import (GRADES, diagnose,
                                            render_findings)
-    if args.input is not None:
+    if getattr(args, "live_url", None):
+        # doctor over a remote node's live endpoint: diagnose the
+        # fetched snapshot LOCALLY so --fail-on grades the same way as
+        # dump mode (the /doctor endpoint itself serves the same
+        # findings for humans/scrapers)
+        findings = diagnose([_fetch_live(args.live_url)])
+    elif args.input is not None:
         docs = [_load_anchored(p) if args.strict_anchor else _load(p)
                 for p in _expand_inputs(args.input)]
         findings = diagnose(docs)
@@ -189,6 +214,10 @@ def main(argv=None) -> int:
     p_stats.add_argument("--input", default=None,
                          help="metrics dump / flight-recorder JSON "
                               "(default: this process, live)")
+    p_stats.add_argument("--live-url", default=None,
+                         help="scrape a running node's live endpoint "
+                              "(metrics.httpPort server), e.g. "
+                              "http://127.0.0.1:9400")
     p_stats.add_argument("--format", default="prometheus",
                          choices=("prometheus", "json"))
     p_trace = sub.add_parser("trace", help="span summary + chrome export")
@@ -213,6 +242,9 @@ def main(argv=None) -> int:
                        help="snapshot/flight dump files or dump "
                             "directories; several aggregate "
                             "cluster-wide (default: this process)")
+    p_doc.add_argument("--live-url", default=None,
+                       help="diagnose a running node over its live "
+                            "endpoint (metrics.httpPort server)")
     p_doc.add_argument("--format", default="text",
                        choices=("text", "json"))
     p_doc.add_argument("--fail-on", default=None,

@@ -154,6 +154,10 @@ class ShuffleServiceV2:
             self.node.metrics.add_reporter(metrics_reporter)
         from sparkucx_tpu.service import _start_dumper
         self._dumper = _start_dumper(conf, self.stats)
+        # same live-provider upgrade as the v1 facade (service.py): the
+        # scrape/doctor seams must not drift with the adapter contract
+        self.node.telemetry_provider = lambda: self.stats("json")
+        self.node.doctor_provider = lambda: self.doctor("findings")
         log.info("ShuffleServiceV2 up: %d devices", self.node.num_devices)
 
     # -- lifecycle ---------------------------------------------------------
@@ -255,6 +259,7 @@ class ShuffleServiceV2:
         if self._metrics_reporter is not None:
             self.node.metrics.remove_reporter(self._metrics_reporter)
             self._metrics_reporter = None
+        self.node.reset_providers()
         self.manager.stop()
         self.node.close()
 

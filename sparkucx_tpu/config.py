@@ -122,6 +122,28 @@ class TpuShuffleConf:
                            "here (off when unset; utils/export.py)",
         "metrics.dumpIntervalSecs": "seconds between periodic metrics "
                                     "dumps (default 60)",
+        "metrics.httpPort": "live telemetry server (utils/live.py): "
+                            "unset = off, 0 = auto-assign, else that "
+                            "port — serves /metrics /snapshot /doctor "
+                            "/healthz",
+        "metrics.httpHost": "live telemetry server bind host (default "
+                            "127.0.0.1 — loopback unless opted out)",
+        "devmon.enabled": "device memory sampler (runtime/devmon.py): "
+                          "HBM + pool watermark gauges on a cadence "
+                          "(default off, null-object)",
+        "devmon.intervalMs": "devmon sampling interval in ms (default "
+                             "1000)",
+        "doctor.watchIntervalSecs": "anomaly watcher: run the doctor "
+                                    "over live telemetry every N secs; "
+                                    "first critical finding triggers a "
+                                    "deep capture (default 0 = off)",
+        "doctor.captureMs": "profiler window length of a watcher deep "
+                            "capture (default 200 ms)",
+        "doctor.captureDir": "where watcher captures land (default: "
+                             "the flight recorder dir)",
+        "compile.costCapture": "harvest XLA cost/memory analysis per "
+                               "compiled exchange program "
+                               "(shuffle/stepcache.py; default on)",
         "flightRecorder.enabled": "crash flight recorder: ring of recent "
                                   "telemetry events + postmortem JSON on "
                                   "retry exhaustion / DeviceUnhealthy / "

@@ -93,6 +93,9 @@ class _NullFlightRecorder:
     def end_trace(self, trace_id: str) -> None:
         pass
 
+    def current_trace(self) -> Optional[str]:
+        return None
+
     def dump(self, reason: str, extra: Optional[Dict] = None):
         return None
 
@@ -168,6 +171,14 @@ class FlightRecorder:
             except ValueError:
                 pass
 
+    def current_trace(self) -> Optional[str]:
+        """Newest in-flight exchange trace id, or None. The devmon
+        sampler stamps each HBM sample with it so a timeline can overlay
+        memory pressure against the wave that caused it."""
+        with self._lock:
+            return self._inflight_traces[-1] if self._inflight_traces \
+                else None
+
     def record(self, kind: str, **data) -> None:
         try:
             with self._lock:
@@ -226,6 +237,7 @@ class FlightRecorder:
                 "in_flight_traces": inflight,
                 "events": events,
                 "counters": {},
+                "gauges": {},
                 "histograms": {},
                 "spans": GLOBAL_TRACER.summary(),
                 "trace_events": GLOBAL_TRACER.chrome_events(),
@@ -236,6 +248,7 @@ class FlightRecorder:
                 merge_histogram_snapshots
             for m in [GLOBAL_METRICS] + list(self.metrics_sources):
                 doc["counters"].update(m.snapshot())
+                doc["gauges"].update(m.gauges())
                 merge_histogram_snapshots(doc["histograms"],
                                           m.histograms())
             if extra:
@@ -491,6 +504,9 @@ class HealthMonitor:
         self.mesh = mesh
         self.timeout_ms = timeout_ms
         self.flight = flight
+        # optional fn(bad_devices: list) fired when assert_healthy trips
+        # — the node routes it into its /healthz verdict (utils/live.py)
+        self.on_unhealthy = None
 
     def probe(self) -> Dict[str, bool]:
         """{device_str: alive} via an independent tiny op per device."""
@@ -526,6 +542,12 @@ class HealthMonitor:
         if bad:
             self.flight.record("device_unhealthy", devices=bad)
             self.flight.dump(f"DeviceUnhealthy: {bad}")
+            if self.on_unhealthy is not None:
+                try:
+                    self.on_unhealthy(bad)
+                except Exception:
+                    log.debug("on_unhealthy callback failed",
+                              exc_info=True)
             raise DeviceUnhealthy(f"devices failed liveness probe: {bad}")
 
     @staticmethod

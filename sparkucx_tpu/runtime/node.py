@@ -28,6 +28,7 @@ multi-device (tests, single chip) skips that step.
 from __future__ import annotations
 
 import atexit
+import os
 import threading
 from typing import Optional
 
@@ -130,6 +131,63 @@ class TpuNode:
         # wall-clock axis. Single-process: just the local anchor.
         self.cluster_anchors = self._gather_anchors()
         self._closed = False
+        # -- device-plane observability ---------------------------------
+        # Health verdict behind /healthz: clear until an epoch bump (a
+        # remesh drops registered shuffles — not ready until the operator
+        # re-registers and calls mark_healthy) or a failed device probe.
+        self._health_lock = threading.Lock()
+        self._unhealthy_reason: Optional[str] = None
+        self.health.on_unhealthy = self._on_device_unhealthy
+        self.epochs.on_bump(self._on_epoch_health)
+        # Cost capture master switch (shuffle/stepcache.py harvest of
+        # XLA cost/memory analysis per compiled program; on by default —
+        # off keeps the records, nulls the fields).
+        from sparkucx_tpu.shuffle import stepcache as _stepcache
+        _stepcache.COST_CAPTURE = conf.get_bool("compile.costCapture",
+                                                True)
+        # the memory_analysis probe re-compiles the lowered module —
+        # only affordable when the persistent compile cache can turn
+        # that into a deserialize; with the cache disabled/unavailable
+        # the probe would re-pay the full XLA compile inside the first
+        # read, so it degrades to cost_analysis-only (null memory
+        # fields, the documented partial-record shape)
+        _stepcache.MEMORY_PROBE = self.compile_cache_dir is not None
+        # Device memory sampler (runtime/devmon.py): daemon thread
+        # publishing HBM + pool gauges; null object when off, like the
+        # flight recorder.
+        from sparkucx_tpu.runtime.devmon import (NULL_DEVMON,
+                                                 DeviceMonitor,
+                                                 DoctorWatcher)
+        if conf.get_bool("devmon.enabled", False):
+            self.devmon = DeviceMonitor(
+                self,
+                interval_s=conf.get_float("devmon.intervalMs",
+                                          1000.0) / 1e3).start()
+        else:
+            self.devmon = NULL_DEVMON
+        # Pluggable telemetry providers: the node serves its own
+        # snapshot/diagnosis by default; a facade swaps in its richer
+        # pair (exchange reports included) at connect and restores at
+        # stop — the live server and doctor watcher read THROUGH these,
+        # so they upgrade transparently.
+        self.telemetry_provider = self.telemetry_snapshot
+        self.doctor_provider = self._default_doctor
+        from sparkucx_tpu.utils.live import start_from_conf
+        self.live = start_from_conf(
+            conf, lambda: self.telemetry_provider(),
+            lambda: self.doctor_provider(), self.health_status)
+        # Anomaly-triggered deep capture (doctor.watchIntervalSecs):
+        # rolling doctor pass; first critical finding => bounded
+        # profiler window + tagged flight postmortem.
+        watch_s = conf.get_float("doctor.watchIntervalSecs", 0.0)
+        if watch_s > 0:
+            self.watcher = DoctorWatcher(
+                self, watch_s,
+                profile_ms=conf.get_float("doctor.captureMs", 200.0),
+                capture_dir=conf.get(
+                    "spark.shuffle.tpu.doctor.captureDir")).start()
+        else:
+            self.watcher = None
         log.info("TpuNode up: %d devices, mesh axes %s",
                  len(jax.devices()), self.mesh.axis_names)
 
@@ -143,16 +201,90 @@ class TpuNode:
         caller owns a manager (the node itself does not)."""
         from sparkucx_tpu.utils.export import collect_snapshot
         from sparkucx_tpu.utils.metrics import GLOBAL_METRICS
+        # pool watermarks ride as GAUGES (set semantics — Prometheus
+        # must not type a value that goes down as a counter); the flat
+        # "pool" dict below keeps its keys for the doctor's build_view.
+        # ONE stats() call feeds both.
+        pool_stats = self.pool.stats()
+        self.publish_pool_gauges(pool_stats)
         return collect_snapshot(
             [GLOBAL_METRICS, self.metrics], tracer=self.tracer,
             reports=reports,
-            extra={"pool": self.pool.stats(),
+            extra={"pool": pool_stats,
                    "process_id": self.process_id,
                    # the connect-time anchor table: ONE process's dump
                    # can place every peer's clock on the shared wall
                    # axis even when the peers' own dumps are missing
                    # (a crashed peer's flight dump may never land)
                    "cluster_anchors": self.cluster_anchors})
+
+    def publish_pool_gauges(self, stats: Optional[dict] = None) -> None:
+        """Arena watermarks -> ``pool.*`` gauges in this node's registry
+        (the set-not-add migration: in_use and peak go DOWN — on put()
+        and reset_peak_bytes() — so exporting them through counters lied
+        to every rate() query)."""
+        st = stats if stats is not None else self.pool.stats()
+        for key in ("in_use", "in_use_bytes", "peak_bytes", "allocated",
+                    "preallocated"):
+            if key in st:
+                self.metrics.set_gauge(f"pool.{key}", st[key])
+
+    def _default_doctor(self):
+        """The node's own diagnosis (no manager, so no exchange
+        reports) — the doctor_provider default a facade upgrades."""
+        from sparkucx_tpu.utils.doctor import diagnose
+        return diagnose(self.telemetry_snapshot())
+
+    def reset_providers(self) -> None:
+        """Restore the default telemetry/doctor providers (facade
+        stop() calls this so a dead manager is not kept reachable
+        through the live server's closures)."""
+        self.telemetry_provider = self.telemetry_snapshot
+        self.doctor_provider = self._default_doctor
+
+    # -- health (the /healthz verdict) ------------------------------------
+    def mark_unhealthy(self, reason: str) -> None:
+        with self._health_lock:
+            self._unhealthy_reason = reason
+
+    def mark_healthy(self) -> None:
+        """Operator acknowledgment: shuffles re-registered after a
+        remesh / the flagged device replaced — serve traffic again."""
+        with self._health_lock:
+            self._unhealthy_reason = None
+
+    def _on_device_unhealthy(self, bad) -> None:
+        self.mark_unhealthy(f"DeviceUnhealthy: {bad}")
+
+    def _on_epoch_health(self, epoch: int) -> None:
+        self.mark_unhealthy(
+            f"epoch bumped to {epoch}: registered shuffles dropped — "
+            f"re-register and mark_healthy()")
+
+    def health_status(self) -> dict:
+        """The /healthz body: ``ok`` plus the evidence (epoch, device
+        count, the reason when degraded)."""
+        with self._health_lock:
+            reason = self._unhealthy_reason
+        closed = self._closed
+        return {
+            "ok": not closed and reason is None,
+            "epoch": self.epochs.current,
+            "devices": self.num_devices,
+            "process_id": self.process_id,
+            "reason": "node closed" if closed else reason,
+        }
+
+    def flight_capture_dir(self) -> str:
+        """Where the doctor watcher parks deep captures: next to the
+        flight recorder's postmortems when it is on, a per-pid temp dir
+        otherwise."""
+        d = getattr(self.flight, "out_dir", None)
+        if d:
+            return d
+        import tempfile
+        return os.path.join(tempfile.gettempdir(),
+                            f"sparkucx_tpu_capture_{os.getpid()}")
 
     def _gather_anchors(self) -> list:
         if self.is_distributed:
@@ -250,6 +382,7 @@ class TpuNode:
         self.health = HealthMonitor(
             self.mesh, timeout_ms=self.conf.connection_timeout_ms,
             flight=self.flight)
+        self.health.on_unhealthy = self._on_device_unhealthy
         self.registry.clear()
         # Fresh membership, fresh alignment data. Single-process: a
         # local re-anchor. Distributed: NO collective here — remesh runs
@@ -276,6 +409,15 @@ class TpuNode:
         if self._closed:
             return
         self._closed = True
+        # device-plane monitors first: their threads read the pool and
+        # registries this teardown is about to drop
+        if self.watcher is not None:
+            self.watcher.stop()
+        self.devmon.stop()
+        if self.live is not None:
+            self.live.stop()
+        self.reset_providers()
+        self.epochs.remove_listener(self._on_epoch_health)
         self.flight.uninstall_abort_hook()
         self.metrics.remove_reporter(self.flight.metrics_reporter)
         self.epochs.remove_listener(self.flight.on_epoch_bump)

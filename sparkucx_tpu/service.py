@@ -116,6 +116,14 @@ class ShuffleService:
         if metrics_reporter is not None:
             self.node.metrics.add_reporter(metrics_reporter)
         self._dumper = _start_dumper(conf, self.stats)
+        # Upgrade the node's live-telemetry providers to THIS facade's
+        # richer pair (exchange reports ride along): the scrape server
+        # (/snapshot, /doctor — utils/live.py) and the doctor watcher
+        # read through node.telemetry_provider/doctor_provider, so they
+        # serve the same documents stats()/doctor() return. stop()
+        # restores the node defaults.
+        self.node.telemetry_provider = lambda: self.stats("json")
+        self.node.doctor_provider = lambda: self.doctor("findings")
         log.info("ShuffleService up: io=%s, %d devices",
                  self.io_format, self.node.num_devices)
 
@@ -138,6 +146,8 @@ class ShuffleService:
         if self._metrics_reporter is not None:
             self.node.metrics.remove_reporter(self._metrics_reporter)
             self._metrics_reporter = None
+        # the live server must not keep serving through a dead manager
+        self.node.reset_providers()
         self.manager.stop()
         self.node.close()
 

@@ -280,6 +280,9 @@ class PendingDistributedShuffle(PendingExchangeBase):
                                     self._axis, cur, self._width)
         else:
             step = _build_step(self._mesh, self._axis, cur, self._width)
+        # device-plane join point, same as PendingShuffle._dispatch: the
+        # manager reads cost_record off the final dispatched program
+        self._step = step
         payload = jax.make_array_from_process_local_data(
             self._sharding,
             self._local_rows.reshape(self._L * self._cap_in, self._width))

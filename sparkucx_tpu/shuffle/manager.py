@@ -48,9 +48,10 @@ from sparkucx_tpu.shuffle.reader import (
 from sparkucx_tpu.shuffle.writer import MapOutputWriter
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
-                                        GLOBAL_METRICS, H_FETCH_FIRST,
-                                        H_FETCH_WAIT, H_PEER_BYTES,
-                                        H_PEER_ROWS, H_WAVE_GAP)
+                                        GLOBAL_METRICS, H_BW,
+                                        H_FETCH_FIRST, H_FETCH_WAIT,
+                                        H_PEER_BYTES, H_PEER_ROWS,
+                                        H_WAVE_GAP)
 from sparkucx_tpu.utils.trace import format_trace_id
 
 log = get_logger("shuffle.manager")
@@ -115,6 +116,20 @@ class ExchangeReport:
     wave_rows: int = 0
     wave_pack_hidden_ms: float = 0.0
     wave_timeline: List[Dict] = field(default_factory=list)
+    # Device-plane join (shuffle/stepcache.py harvest): the XLA cost/
+    # memory record of the compiled program this exchange dispatched —
+    # flops, bytes accessed, argument/output/temp HBM footprint — fields
+    # null on backends without the analyses, the record itself present
+    # for every warm-compiled program. ``model_bytes_gbps`` (when byte
+    # counts exist) is the cost-model byte-movement rate the dispatch
+    # achieved — the roofline comparison the array-redistribution model
+    # (arxiv 2112.01075) supports.
+    device_cost: Optional[Dict] = None
+    # Achieved collective bandwidth: global payload bytes over group_ms
+    # (dispatch-start -> completion). Always filled on completion;
+    # observed into shuffle.collective.bw_gbps only for steady-state
+    # (non-compile-bearing) reads — the same split as fetch-wait.
+    bw_gbps: float = 0.0
     completed: bool = False
     error: Optional[str] = None
     # bookkeeping, excluded from to_dict()
@@ -137,7 +152,11 @@ class ExchangeReport:
         out = {}
         for name in cls._PUBLIC_FIELDS:
             v = getattr(self, name)
-            out[name] = list(v) if isinstance(v, list) else v
+            if isinstance(v, list):
+                v = list(v)
+            elif isinstance(v, dict):
+                v = dict(v)
+            out[name] = v
         return out
 
 
@@ -1041,6 +1060,38 @@ class TpuShuffleManager:
             metrics.observe(H_PEER_ROWS, float(r))
             metrics.observe(H_PEER_BYTES, float(b))
 
+    def _finish_device_plane(self, rep: ExchangeReport, step, width: int,
+                             completed: bool) -> None:
+        """Complete a report's device-plane fields at read settlement:
+        ``device_cost`` from the dispatched step's stepcache harvest (a
+        record exists for every warm-compiled program; its fields may be
+        null on backends without the XLA analyses) and ``bw_gbps`` =
+        global payload bytes / group wall. Steady-state reads observe the
+        figure into ``shuffle.collective.bw_gbps``; compile-bearing reads
+        keep the field but stay out of the distribution — an in-band XLA
+        compile inside group_ms says nothing about the link (the
+        H_FETCH_WAIT/H_FETCH_FIRST discipline). Never raises."""
+        try:
+            rec = getattr(step, "cost_record", None)
+            if rec is not None:
+                dc = dict(rec)
+                if completed and rep.group_ms > 0 \
+                        and dc.get("bytes_accessed"):
+                    # the cost-model byte-movement rate this dispatch
+                    # achieved — the roofline the compile-time model
+                    # supports (bytes / (group_ms*1e-3 s) / 1e9)
+                    dc["model_bytes_gbps"] = round(
+                        dc["bytes_accessed"] / (rep.group_ms * 1e6), 6)
+                rep.device_cost = dc
+            if completed and rep.group_ms > 0:
+                gbps = rep.rows_global * width * 4 / (rep.group_ms * 1e6)
+                rep.bw_gbps = round(gbps, 6)
+                if not rep.stepcache_programs:
+                    self.node.metrics.observe(H_BW, gbps)
+        except Exception:
+            log.debug("device-plane report completion failed",
+                      exc_info=True)
+
     def _arm_read_callbacks(self, stage_buf, release_admitted, handle,
                             global_rows: int, local_rows: int, width: int,
                             report: Optional[ExchangeReport] = None):
@@ -1083,6 +1134,12 @@ class TpuShuffleManager:
                     GLOBAL_METRICS.get(COMPILE_HITS) - report._hits0)
                 report.stepcache_programs = int(
                     GLOBAL_METRICS.get(COMPILE_PROGRAMS) - report._prog0)
+                # device-plane join: the dispatched program's cost record
+                # (stepcache harvest; final program after any retry
+                # regrow) plus the achieved-bandwidth figure
+                self._finish_device_plane(
+                    report, getattr(pend, "_step", None), width,
+                    completed=result is not None)
                 if result is not None:
                     report.completed = True
                 else:
@@ -1840,6 +1897,10 @@ class PendingWaveShuffle:
         self._wave_rows = outer_plan.wave_rows
         self._result = None
         self._dead = False
+        # last drained wave's compiled step — every wave shares ONE
+        # program by construction, so its cost record speaks for the
+        # whole exchange (device-plane join in _finalize)
+        self._last_step = None
 
     # -- lifecycle ---------------------------------------------------------
     def done(self) -> bool:
@@ -2013,6 +2074,7 @@ class PendingWaveShuffle:
         t0 = time.perf_counter()
         res = pending.result()
         wait_ms = (time.perf_counter() - t0) * 1e3
+        self._last_step = getattr(pending, "_step", None)
         drain_wave_result(res)
         entry = timeline[i]
         entry["forced_ms"] = round((t0 - t_read0) * 1e3, 3)
@@ -2046,6 +2108,8 @@ class PendingWaveShuffle:
             GLOBAL_METRICS.get(COMPILE_HITS) - rep._hits0)
         rep.stepcache_programs = int(
             GLOBAL_METRICS.get(COMPILE_PROGRAMS) - rep._prog0)
+        mgr._finish_device_plane(rep, self._last_step, self._width,
+                                 completed=True)
         rep.completed = True
         mgr.node.flight.end_trace(rep.trace_id)
         metrics = mgr.node.metrics

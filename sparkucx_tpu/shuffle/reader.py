@@ -1153,6 +1153,10 @@ class PendingShuffle(PendingExchangeBase):
         from sparkucx_tpu.io.dlpack import stage_to_device
         width = self._rows_host.shape[2]
         step = self._build_step(self._plan)
+        # the device-plane join point: the manager reads this step's
+        # cost_record (stepcache harvest) into ExchangeReport.device_cost
+        # at on_done — after a retry regrow this is the FINAL program
+        self._step = step
         # one DMA from the pinned pack buffer, already mesh-sharded — no
         # pageable bounce, no resharding copy (round-1 weak #3)
         rows_flat = stage_to_device(

@@ -38,6 +38,13 @@ cold_start        first_wait p50 ≫ steady-state wait p50   spark.shuffle.tpu.c
 pipeline_stall    waved reads where the per-wave pack      spark.shuffle.tpu.a2a.waveRows
                   outruns the collective it should hide
                   behind (wait-gap ≈ 0 while packs cost)
+hbm_pressure      devmon HBM in-use sampled near the       spark.shuffle.tpu.a2a.waveRows
+                  device limit (per-device gauges from
+                  runtime/devmon.py)
+bw_underutil...   steady-state achieved collective bw      spark.shuffle.tpu.a2a.waveDepth
+                  p50 ≪ the best bw the SAME link
+                  demonstrated, while the collective
+                  dominates the exchange wall
 ================  =======================================  =====================================
 
 The same :class:`Finding` schema carries ``bench.py --stage regress``
@@ -51,9 +58,11 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Union
 
 from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
-                                        COMPILE_SECONDS, H_FETCH_FIRST,
+                                        COMPILE_SECONDS, G_HBM_IN_USE,
+                                        G_HBM_LIMIT, H_BW, H_FETCH_FIRST,
                                         H_FETCH_WAIT, H_RETRY_MS,
-                                        H_WAVE_GAP, Histogram)
+                                        H_WAVE_GAP, Histogram,
+                                        parse_labeled)
 
 GRADES = ("info", "warn", "critical")
 _GRADE_ORDER = {g: i for i, g in enumerate(GRADES)}
@@ -105,6 +114,14 @@ class Thresholds:
     stall_min_pack_ms: float = 2.0     # sub-noise packs are never a stall
     stall_wait_frac: float = 0.25      # wait p50 below this x pack p50
     #                                    = the collective finished early
+    hbm_warn_ratio: float = 0.90       # sampled in_use / limit
+    hbm_critical_ratio: float = 0.97
+    hbm_min_limit_bytes: float = 64e6  # toy/virtual devices never "press"
+    bw_min_exchanges: int = 6          # bw verdicts need a distribution
+    bw_ratio: float = 4.0              # best observed bw / p50
+    bw_min_gbps: float = 0.05          # below this the link never showed
+    #                                    real throughput — timing noise on
+    #                                    tiny exchanges, not utilization
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -116,6 +133,10 @@ class ClusterView:
     histograms: Dict[str, Histogram]
     reports: List[Dict]            # each with "process_id" attribution
     pools: List[Dict]              # per-process arena stats, if present
+    gauges: List[Dict] = field(default_factory=list)
+    #                              # per-process {"process_id", "values"}
+    #                              # — gauges are point-in-time, so they
+    #                              # attribute, never sum
     processes: int = 1
 
 
@@ -145,6 +166,7 @@ def build_view(snapshots: Union[Dict, Iterable[Dict]]) -> ClusterView:
     hists: Dict[str, Histogram] = {}
     reports: List[Dict] = []
     pools: List[Dict] = []
+    gauges: List[Dict] = []
     for i, doc in enumerate(docs):
         pid = doc.get("process_id", doc.get("pid", i))
         for name, v in (doc.get("counters") or {}).items():
@@ -161,7 +183,10 @@ def build_view(snapshots: Union[Dict, Iterable[Dict]]) -> ClusterView:
             reports.append(r)
         if isinstance(doc.get("pool"), dict):
             pools.append({"process_id": pid, **doc["pool"]})
-    return ClusterView(counters, hists, reports, pools,
+        if isinstance(doc.get("gauges"), dict) and doc["gauges"]:
+            gauges.append({"process_id": pid,
+                           "values": dict(doc["gauges"])})
+    return ClusterView(counters, hists, reports, pools, gauges,
                        processes=max(1, len(docs)))
 
 
@@ -491,9 +516,130 @@ def _rule_pipeline_stall(view: ClusterView,
         trace_ids=[r.get("trace_id", "")])]
 
 
+def _rule_hbm_pressure(view: ClusterView,
+                       th: Thresholds) -> List[Finding]:
+    """Device-plane memory pressure: the devmon sampler saw a device's
+    HBM in-use near its limit. The remediation is to stream — waves
+    bound device buffers at depth x one wave instead of the whole
+    shuffle — and to keep cap bucketing from over-provisioning. Quiet
+    without devmon gauges (off by default) and on toy limits."""
+    out: List[Finding] = []
+    for g in view.gauges:
+        vals = g["values"]
+        per_dev: Dict[str, Dict[str, float]] = {}
+        for key, v in vals.items():
+            base, labels = parse_labeled(key)
+            if labels is None or "device" not in labels:
+                continue
+            if base in (G_HBM_IN_USE, G_HBM_LIMIT):
+                per_dev.setdefault(labels["device"], {})[base] = float(v)
+        worst = None
+        for dev, dv in sorted(per_dev.items()):
+            in_use = dv.get(G_HBM_IN_USE)
+            limit = dv.get(G_HBM_LIMIT)
+            if not in_use or not limit \
+                    or limit < th.hbm_min_limit_bytes:
+                continue
+            ratio = in_use / limit
+            if ratio < th.hbm_warn_ratio:
+                continue
+            if worst is None or ratio > worst[0]:
+                worst = (ratio, dev, in_use, limit)
+        if worst is None:
+            continue
+        ratio, dev, in_use, limit = worst
+        out.append(Finding(
+            rule="hbm_pressure",
+            grade="critical" if ratio >= th.hbm_critical_ratio
+            else "warn",
+            summary=(f"process {g.get('process_id')}: device {dev} HBM "
+                     f"{in_use / 1e9:.2f} of {limit / 1e9:.2f} GB in "
+                     f"use ({ratio:.0%}) — the next exchange's receive "
+                     f"buffers may not fit"),
+            evidence={"process_id": g.get("process_id"), "device": dev,
+                      "in_use_bytes": int(in_use),
+                      "limit_bytes": int(limit),
+                      "ratio": round(ratio, 4)},
+            conf_key="spark.shuffle.tpu.a2a.waveRows",
+            remediation=("stream the read: set a2a.waveRows so device "
+                         "buffers are bounded at waveDepth x one wave "
+                         "instead of the whole shuffle; keep "
+                         "a2a.capBuckets on with a modest "
+                         "capBucketGrowth so capacities aren't "
+                         "over-provisioned, and lower "
+                         "a2a.capacityFactor if headroom is the "
+                         "culprit")))
+    return out
+
+
+def _rule_bw_underutilization(view: ClusterView,
+                              th: Thresholds) -> List[Finding]:
+    """Achieved collective bandwidth (steady-state exchanges only — the
+    manager keeps compile-bearing reads out of the histogram) sits far
+    below what the SAME link already demonstrated: the self-referential
+    roofline, usable without knowing the fabric's spec sheet. Fires only
+    when the best observation shows real throughput (bw_min_gbps floor —
+    tiny exchanges measure timing noise, not links) and carries the
+    worst collective-dominated exchange as evidence when one is still in
+    the report ring."""
+    h = view.histograms.get(H_BW)
+    if h is None or h.count < th.bw_min_exchanges:
+        return []
+    p50 = h.quantile(0.5)
+    best = h.max
+    if p50 <= 0 or best < th.bw_min_gbps or best / p50 < th.bw_ratio:
+        return []
+    ev = {"bw_p50_gbps": round(p50, 4), "bw_best_gbps": round(best, 4),
+          "ratio": round(best / p50, 2), "exchanges": h.count}
+    trace_ids: List[str] = []
+    # supporting evidence: the slowest steady exchange where the
+    # collective (group phase) dominated the wall — wait-bound, exactly
+    # the shape deeper pipelining (waveDepth) or faster packs fix
+    worst = None
+    for r in _steady(_completed(view)):
+        bw = float(r.get("bw_gbps", 0.0) or 0.0)
+        gms = float(r.get("group_ms", 0.0))
+        host = float(r.get("pack_ms", 0.0)) + float(
+            r.get("dispatch_ms", 0.0))
+        if bw <= 0 or gms <= 0 or gms < 2 * host:
+            continue
+        if worst is None or bw < worst[0]:
+            worst = (bw, r)
+    if worst is not None:
+        bw, r = worst
+        ev.update(worst_shuffle_id=r.get("shuffle_id"),
+                  worst_bw_gbps=round(bw, 4),
+                  worst_group_ms=round(float(r.get("group_ms", 0.0)), 1))
+        if r.get("device_cost") and \
+                r["device_cost"].get("model_bytes_gbps"):
+            # the compile-time byte-movement model's rate for the same
+            # dispatch (arxiv 2112.01075's roofline, where available)
+            ev["worst_model_bytes_gbps"] = \
+                r["device_cost"]["model_bytes_gbps"]
+        if r.get("trace_id"):
+            trace_ids.append(r["trace_id"])
+    return [Finding(
+        rule="bw_underutilization",
+        grade="warn",
+        summary=(f"steady-state collective bandwidth p50 "
+                 f"{p50:.2f} GB/s is {best / p50:.1f}x below the "
+                 f"{best:.2f} GB/s this link already demonstrated "
+                 f"(over {h.count} exchanges) — the fabric is idling "
+                 f"while exchanges wait"),
+        evidence=ev,
+        conf_key="spark.shuffle.tpu.a2a.waveDepth",
+        remediation=("deepen the wave pipeline (a2a.waveDepth) so a "
+                     "collective is always in flight, and raise "
+                     "a2a.packThreads so host packs keep feeding it; "
+                     "if slow exchanges correlate with one peer, see "
+                     "straggler_peer first"),
+        trace_ids=trace_ids)]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
-          _rule_cold_start, _rule_pipeline_stall)
+          _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
+          _rule_bw_underutilization)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

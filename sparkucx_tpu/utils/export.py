@@ -26,7 +26,8 @@ import time
 from typing import Dict, Iterable, List, Optional, Union
 
 from sparkucx_tpu.utils.logging import get_logger
-from sparkucx_tpu.utils.metrics import Metrics
+from sparkucx_tpu.utils.metrics import (Metrics, escape_label_value,
+                                        parse_labeled)
 from sparkucx_tpu.utils.trace import Tracer
 
 log = get_logger("export")
@@ -37,8 +38,35 @@ _BAD_CHARS = re.compile(r"[^a-zA-Z0-9_]")
 
 def prom_name(name: str) -> str:
     """Metric name -> Prometheus-legal series name (dots/dashes become
-    underscores, namespaced under ``sparkucx_tpu_``)."""
+    underscores, namespaced under ``sparkucx_tpu_``). Illegal characters
+    are SANITIZED, never emitted: device indices and doctor rule names
+    become metric identities in the device plane, and a hostile-looking
+    name must not corrupt the scrape."""
     return PROM_PREFIX + _BAD_CHARS.sub("_", name)
+
+
+def prom_series(identity: str) -> str:
+    """Metric identity (possibly carrying a ``labeled()`` block, e.g.
+    ``devmon.hbm.in_use{device="0"}``) -> exposition series reference:
+    sanitized base name + sanitized label keys + escaped label values.
+    An identity whose label block does not parse as canonical
+    ``k="v"`` pairs is treated as a plain (hostile) name and sanitized
+    wholesale — junk braces become underscores instead of exposition
+    syntax."""
+    base, labels = parse_labeled(identity)
+    if labels is None:
+        return prom_name(identity)
+    inner = ",".join(
+        f'{_BAD_CHARS.sub("_", k)}="{escape_label_value(v)}"'
+        for k, v in labels.items())
+    return f"{prom_name(base)}{{{inner}}}"
+
+
+def prom_family(identity: str) -> str:
+    """The family name an identity's samples belong to (label block
+    stripped) — what the ``# TYPE`` line names."""
+    base, labels = parse_labeled(identity)
+    return prom_name(base if labels is not None else identity)
 
 
 def _fmt(v: float) -> str:
@@ -86,13 +114,18 @@ def collect_snapshot(metrics: Union[Metrics, Iterable[Metrics]],
         metrics = [metrics]
     counters: Dict[str, float] = {}
     histograms: Dict[str, Dict] = {}
+    gauges: Dict[str, float] = {}
     for m in metrics:
         counters.update(m.snapshot())
         merge_histogram_snapshots(histograms, m.histograms())
+        # gauges are point-in-time: later registries win collisions,
+        # same one-owning-registry rule as counters
+        gauges.update(m.gauges())
     doc = {
         "ts": time.time(),
         "pid": os.getpid(),
         "counters": counters,
+        "gauges": gauges,
         "histograms": histograms,
     }
     # Clock anchor: doc["ts"] is wall time while spans are perf_counter
@@ -251,6 +284,20 @@ def render_prometheus(doc: Dict) -> str:
         n = prom_name(name)
         lines.append(f"# TYPE {n} counter")
         lines.append(f"{n} {_fmt(doc['counters'][name])}")
+    # gauges: set-semantics values (devmon HBM watermarks, pool in-use)
+    # with first-class label support. Grouped by FAMILY, not identity
+    # sort order: the exposition format requires one TYPE line per
+    # family with all of its series adjacent, and a labeled identity
+    # ("{" sorts above alphanumerics) could otherwise interleave with a
+    # longer-named sibling family.
+    gauges = doc.get("gauges", {})
+    families: Dict[str, List[str]] = {}
+    for name in gauges:
+        families.setdefault(prom_family(name), []).append(name)
+    for fam in sorted(families):
+        lines.append(f"# TYPE {fam} gauge")
+        for name in sorted(families[fam]):
+            lines.append(f"{prom_series(name)} {_fmt(gauges[name])}")
     for name in sorted(doc.get("histograms", {})):
         h = doc["histograms"][name]
         n = prom_name(name)

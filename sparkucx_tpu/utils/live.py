@@ -1,0 +1,165 @@
+"""Live telemetry service — the scrape endpoint the dump files emulate.
+
+PR-2/PR-3 made the telemetry plane queryable, but every consumer had to
+poll dump files or run a CLI against them — a PULL surface with a disk
+in the middle. The reference leans on Spark's UI for exactly this role
+(a live HTTP pull of executor state); this module is the stack's own:
+a stdlib-http background server (no dependencies — the container rule)
+serving four endpoints off the node's pluggable telemetry providers:
+
+========== ==========================================================
+endpoint   serves
+========== ==========================================================
+/metrics   Prometheus text exposition of the live snapshot — point a
+           scraper at it; counters, gauges (devmon HBM/pool), full
+           histogram bucket series + p50/p99/max companions
+/snapshot  the canonical JSON snapshot document (the same shape the
+           periodic dumper writes and ``TpuNode.telemetry_snapshot``
+           returns — one seam, no drift)
+/doctor    the doctor's graded findings as JSON — the same list
+           ``service.doctor()`` returns
+/healthz   200/503 liveness: node open, no epoch bump pending
+           re-registration, no device flagged unhealthy; body carries
+           the epoch and reason
+========== ==========================================================
+
+Conf: ``spark.shuffle.tpu.metrics.httpPort`` — unset = off (default),
+``0`` = bind an ephemeral port (tests, sidecar discovery via
+``node.live.url``), positive = that port. ``metrics.httpHost`` defaults
+to 127.0.0.1: a telemetry plane must opt IN to non-loopback exposure.
+Started/stopped by ``TpuNode.start``/``close`` on both facades.
+
+Every request renders from a provider callable under try/except — a
+scrape must never take down (or be taken down by) a shuffle.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from sparkucx_tpu.utils.logging import get_logger
+
+log = get_logger("live")
+
+
+class LiveTelemetryServer:
+    """The background HTTP server. ``snapshot_fn`` returns the canonical
+    snapshot dict; ``doctor_fn`` a findings list (objects with
+    ``to_dict`` or plain dicts); ``health_fn`` a dict with at least
+    ``ok: bool``."""
+
+    def __init__(self, snapshot_fn: Callable[[], Dict],
+                 doctor_fn: Callable[[], list],
+                 health_fn: Callable[[], Dict],
+                 port: int = 0, host: str = "127.0.0.1"):
+        self._snapshot_fn = snapshot_fn
+        self._doctor_fn = doctor_fn
+        self._health_fn = health_fn
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # scrape chatter must not spam the shuffle's stderr
+            def log_message(self, fmt, *args):  # noqa: N802
+                log.debug("live %s", fmt % args)
+
+            def do_GET(self):  # noqa: N802
+                outer._route(self)
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="sparkucx-live-http", daemon=True)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "LiveTelemetryServer":
+        self._thread.start()
+        log.info("live telemetry server up at %s "
+                 "(/metrics /snapshot /doctor /healthz)", self.url)
+        return self
+
+    def stop(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            log.debug("live server shutdown failed", exc_info=True)
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+
+    # -- request handling --------------------------------------------------
+    def _route(self, req) -> None:
+        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                from sparkucx_tpu.utils.export import render_prometheus
+                body = render_prometheus(self._snapshot_fn())
+                self._send(req, 200, body,
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/snapshot":
+                from sparkucx_tpu.utils.export import render_json
+                self._send(req, 200, render_json(self._snapshot_fn()),
+                           "application/json")
+            elif path == "/doctor":
+                findings = self._doctor_fn()
+                body = json.dumps(
+                    [f.to_dict() if hasattr(f, "to_dict") else f
+                     for f in findings], indent=1)
+                self._send(req, 200, body, "application/json")
+            elif path == "/healthz":
+                h = self._health_fn()
+                self._send(req, 200 if h.get("ok") else 503,
+                           json.dumps(h, default=repr),
+                           "application/json")
+            else:
+                self._send(req, 404, json.dumps(
+                    {"error": f"unknown path {path!r}", "paths": [
+                        "/metrics", "/snapshot", "/doctor", "/healthz"]}),
+                    "application/json")
+        except Exception as e:
+            log.debug("live request %s failed", path, exc_info=True)
+            try:
+                self._send(req, 500, json.dumps({"error": repr(e)[:300]}),
+                           "application/json")
+            except Exception:
+                pass  # client went away mid-error; nothing to serve
+
+    @staticmethod
+    def _send(req, status: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        req.send_response(status)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)
+
+
+def start_from_conf(conf, snapshot_fn, doctor_fn,
+                    health_fn) -> Optional[LiveTelemetryServer]:
+    """Build+start the server from ``metrics.httpPort`` (None when the
+    key is unset — off is the default — or the bind fails: a node must
+    never fail to BOOT over its observability port, the same rule as the
+    clock-anchor allgather)."""
+    raw = conf.get("spark.shuffle.tpu.metrics.httpPort")
+    if raw is None or str(raw).strip() == "":
+        return None
+    try:
+        port = int(str(raw).strip())
+        if port < 0:
+            return None
+        host = conf.get("spark.shuffle.tpu.metrics.httpHost",
+                        "127.0.0.1")
+        return LiveTelemetryServer(snapshot_fn, doctor_fn, health_fn,
+                                   port=port, host=host).start()
+    except Exception as e:
+        log.warning("live telemetry server unavailable "
+                    "(metrics.httpPort=%r): %s — continuing without a "
+                    "scrape endpoint", raw, e)
+        return None

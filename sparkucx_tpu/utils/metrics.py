@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import contextlib
 import math
+import re
 import threading
 import time
 from collections import defaultdict
@@ -29,6 +30,15 @@ from typing import Dict, List, Optional, Tuple
 COMPILE_PROGRAMS = "compile.step.programs"   # distinct step programs built
 COMPILE_HITS = "compile.step.hits"           # step-cache lookups served
 COMPILE_SECONDS = "compile.step.seconds"     # first-invocation wall secs
+
+# Device-plane cost capture (shuffle/stepcache.py harvest of XLA
+# cost_analysis/memory_analysis at compile time): cumulative totals over
+# every program whose record captured — the byte-movement model arxiv
+# 2112.01075 shows XLA exposes precisely enough to roofline an exchange.
+COMPILE_PROG_CAPTURED = "compile.program.captured"   # programs w/ a record
+COMPILE_PROG_FLOPS = "compile.program.flops"         # summed model flops
+COMPILE_PROG_BYTES = "compile.program.bytes_accessed"
+COMPILE_PROG_TEMP = "compile.program.temp_bytes"     # summed HBM scratch
 
 # Histogram names — the telemetry plane's distribution metrics. Declared
 # here (not at the observation sites) for the same no-spelling-drift
@@ -52,10 +62,77 @@ H_COMPILE_SECS = "compile.step.duration_s"   # per-program compile seconds
 # positive gaps mean the device idles between waves waiting on the host
 # pack — the doctor's pipeline_stall signal (a2a.waveRows/packThreads).
 H_WAVE_GAP = "shuffle.wave.gap_ms"
+# Achieved collective bandwidth per steady-state exchange: global payload
+# bytes / (dispatch-start .. completion). Compile-bearing reads are
+# EXCLUDED (same discipline as the H_FETCH_WAIT/H_FETCH_FIRST split —
+# in-band XLA compile lands inside group_ms and would crater the
+# distribution's tail), so the histogram answers "what does this link
+# actually sustain", the number the doctor's bw_underutilization rule
+# grades p50 against the best observed exchange with.
+H_BW = "shuffle.collective.bw_gbps"
 
 WELL_KNOWN_HISTOGRAMS = (H_FETCH_WAIT, H_FETCH_FIRST, H_PEER_ROWS,
                          H_PEER_BYTES, H_RETRY_MS, H_COMPILE_SECS,
-                         H_WAVE_GAP)
+                         H_WAVE_GAP, H_BW)
+
+# Device-memory gauge families (runtime/devmon.py sampler; per local
+# device index, encoded as a label via :func:`labeled`): ONE place for
+# the names so the sampler, the doctor's hbm_pressure rule and the
+# tests cannot drift on spelling.
+G_HBM_IN_USE = "devmon.hbm.in_use"
+G_HBM_LIMIT = "devmon.hbm.limit"
+G_HBM_PEAK = "devmon.hbm.peak"
+
+
+# -- labeled metric identities (gauges) -------------------------------------
+def escape_label_value(value) -> str:
+    """Prometheus exposition label-value escaping: backslash, quote and
+    newline. Applied when a label is ENCODED into a metric identity
+    (``labeled``), so the canonical key itself is exposition-legal and a
+    hostile-looking value (device paths, rule names) can never corrupt a
+    scrape. utils/export.py re-exports this as part of its hardening
+    surface."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+_LABELED_RE = re.compile(r"^([^{}\n]+)\{(.*)\}$", re.S)
+_LABEL_ITEM_RE = re.compile(r'([A-Za-z_][A-Za-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE_RE = re.compile(r"\\(.)")
+
+
+def labeled(name: str, **labels) -> str:
+    """Canonical labeled-metric identity: ``name{k="v",...}`` with sorted
+    keys and escaped values — ONE encoding shared by the gauge registry,
+    the JSON snapshot (keys must be stable for the doctor's build_view)
+    and the Prometheus exporter (which emits the label block verbatim
+    after sanitizing the name parts)."""
+    if not labels:
+        return name
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def parse_labeled(name: str):
+    """Inverse of :func:`labeled`: ``(base, {k: v})`` with UNescaped
+    values, or ``(name, None)`` when the identity carries no parseable
+    label block (including hostile brace garbage — the exporter then
+    sanitizes the whole string as a plain name)."""
+    m = _LABELED_RE.match(name)
+    if not m:
+        return name, None
+    base, inner = m.groups()
+    items = _LABEL_ITEM_RE.findall(inner)
+    if not items:
+        return name, None
+    out = {}
+    for k, v in items:
+        # ONE pass: sequential str.replace would mangle a literal
+        # backslash adjacent to 'n' ("\\n" must stay backslash+n)
+        out[k] = _UNESCAPE_RE.sub(
+            lambda m: "\n" if m.group(1) == "n" else m.group(1), v)
+    return base, out
 
 
 class Histogram:
@@ -264,6 +341,16 @@ class Metrics:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
+        # Gauges: SET semantics (last write wins), the kind counters
+        # cannot fake — a watermark exported as a counter reads as
+        # monotonic to Prometheus and every rate()/increase() query over
+        # it lies the moment the value goes down. Keys may carry a label
+        # block (``labeled(name, device=0)``); utils/export.py renders
+        # them with their own ``# TYPE ... gauge`` line. Reporters do NOT
+        # see gauge sets: the devmon sampler re-publishes watermarks on a
+        # cadence, and pushing every re-set through the flight recorder's
+        # ring would evict the actual events the ring exists to keep.
+        self._gauges: Dict[str, float] = {}
         self._reporters = []
         self._broken = set()
         # pre-create the declared distribution metrics so exporters and
@@ -325,6 +412,27 @@ class Metrics:
             reporters = list(self._reporters)
         h.observe(value)
         self._report(name, value, reporters)
+
+    def set_gauge(self, name: str, value) -> None:
+        """Publish a point-in-time value (HBM in use, pool watermark).
+        ``value=None`` clears the gauge — an unsampleable source (CPU
+        backend without memory_stats) must not leave a stale number
+        behind for a scrape to trust."""
+        with self._lock:
+            if value is None:
+                self._gauges.pop(name, None)
+            else:
+                self._gauges[name] = float(value)
+
+    def get_gauge(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def gauges(self) -> Dict[str, float]:
+        """{identity: value} — identities are plain names or the
+        ``labeled()`` canonical form; the exporter-facing view."""
+        with self._lock:
+            return dict(self._gauges)
 
     def get(self, name: str) -> float:
         with self._lock:

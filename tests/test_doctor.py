@@ -273,6 +273,102 @@ def test_pipeline_stall_quiet_when_collective_bound():
     assert diagnose(doc) == []
 
 
+def _hbm_gauges(in_use, limit, device=0):
+    from sparkucx_tpu.utils.metrics import (G_HBM_IN_USE, G_HBM_LIMIT,
+                                            labeled)
+    return {labeled(G_HBM_IN_USE, device=device): in_use,
+            labeled(G_HBM_LIMIT, device=device): limit}
+
+
+def test_hbm_pressure_fires_on_near_limit_device():
+    doc = _healthy_doc()
+    doc["gauges"] = _hbm_gauges(30.5e9, 32e9, device=3)    # ~95%
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["hbm_pressure"]
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.evidence["device"] == "3"
+    assert f.evidence["ratio"] == pytest.approx(30.5 / 32, abs=1e-3)
+    assert f.conf_key == "spark.shuffle.tpu.a2a.waveRows"
+    assert "waveRows" in f.remediation
+    # critical past the hard ceiling
+    doc["gauges"] = _hbm_gauges(31.6e9, 32e9)              # ~99%
+    assert diagnose(doc)[0].grade == "critical"
+
+
+def test_hbm_pressure_quiet_when_healthy_or_sub_noise():
+    doc = _healthy_doc()
+    # healthy: half the HBM free
+    doc["gauges"] = _hbm_gauges(16e9, 32e9)
+    assert diagnose(doc) == []
+    # sub-noise: a toy/virtual device limit never counts as pressure
+    doc["gauges"] = _hbm_gauges(0.99e6, 1e6)
+    assert diagnose(doc) == []
+    # partial sample (no limit reported — the CPU shape): quiet
+    from sparkucx_tpu.utils.metrics import G_HBM_IN_USE, labeled
+    doc["gauges"] = {labeled(G_HBM_IN_USE, device=0): 1e9}
+    assert diagnose(doc) == []
+
+
+def _bw_doc(bw_values, with_report=True):
+    from sparkucx_tpu.utils.metrics import H_BW
+    doc = _healthy_doc()
+    doc["histograms"][H_BW] = _hist_snap(list(bw_values))
+    if with_report:
+        # a collective-dominated steady exchange as supporting evidence
+        r = _report(sid=9, trace="s9.e0.x9", group_ms=400.0)
+        r["bw_gbps"] = min(bw_values)
+        r["pack_ms"] = 20.0
+        r["dispatch_ms"] = 5.0
+        doc["exchange_reports"].append(r)
+    return doc
+
+
+def test_bw_underutilization_fires_on_wide_spread():
+    """p50 far below the best bw the same link demonstrated, with a
+    collective-dominated exchange in the ring: warn, pointing at the
+    pipeline depth."""
+    doc = _bw_doc([0.2] * 8 + [2.0] * 2)
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["bw_underutilization"]
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.evidence["bw_best_gbps"] == pytest.approx(2.0, rel=0.1)
+    assert f.evidence["ratio"] >= 4.0
+    assert f.evidence["worst_shuffle_id"] == 9
+    assert f.conf_key == "spark.shuffle.tpu.a2a.waveDepth"
+    assert "packThreads" in f.remediation
+    assert "s9.e0.x9" in f.trace_ids
+
+
+def test_bw_underutilization_quiet_goldens():
+    # healthy: a tight distribution is a utilized link
+    assert diagnose(_bw_doc([1.0, 1.1, 0.9, 1.0, 1.05, 0.95],
+                            with_report=False)) == []
+    # sub-noise: the spread is wide but the link never demonstrated
+    # real throughput (tiny exchanges time noise, not bandwidth)
+    assert diagnose(_bw_doc([0.001] * 8 + [0.01] * 2,
+                            with_report=False)) == []
+    # signal floor: too few exchanges for a verdict
+    assert diagnose(_bw_doc([0.2, 2.0], with_report=False)) == []
+
+
+def test_gauges_attribute_per_process_in_cluster_view():
+    """build_view keeps gauges per process (point-in-time values must
+    attribute, never sum) and hbm_pressure names the pressed process."""
+    docs = []
+    for p in range(3):
+        doc = {"anchor": _anchor(), "process_id": p, "counters": {},
+               "histograms": {},
+               "gauges": _hbm_gauges(31e9 if p == 2 else 4e9, 32e9)}
+        docs.append(doc)
+    view = build_view(docs)
+    assert len(view.gauges) == 3
+    fs = diagnose(docs)
+    assert _rules_of(fs) == ["hbm_pressure"]
+    assert fs[0].evidence["process_id"] == 2
+
+
 def test_findings_sorted_and_jsonable():
     doc = _healthy_doc()
     doc["histograms"][H_FETCH_FIRST] = _hist_snap([3000.0])   # info
