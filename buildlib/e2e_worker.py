@@ -393,11 +393,105 @@ def main() -> int:
           f"({len(findings)} finding(s): "
           f"{sorted({f.rule for f in findings})})", flush=True)
 
+    # seventh job: the RAGGED WAVE contract across processes — FLAT
+    # meshes only: the wave pipeline is ineligible on the hierarchical
+    # two-stage exchange (manager._waves_eligible), so under --slices>1
+    # every process would single-shot and the wave assertions (and the
+    # divergence drill, where both waveRows confs propose W=1) are
+    # vacuous — skip rather than fail the multi-slice run.
+    wvcheck = 0
+    if num_slices == 1:
+        from sparkucx_tpu.shuffle.distributed import agree_wave_sizes
+        conf_w = TpuShuffleConf({
+            "spark.shuffle.tpu.coordinator.address": coordinator,
+            "spark.shuffle.tpu.numProcesses": str(nprocs),
+            "spark.shuffle.tpu.a2a.impl": "dense",
+            "spark.shuffle.tpu.mesh.numSlices": str(num_slices),
+            "spark.shuffle.tpu.a2a.waveRows": "256",
+        }, use_env=False)
+        mgr_w = TpuShuffleManager(node, conf_w)
+        hw = mgr_w.register_shuffle(13, num_maps, R)
+        for m in my_maps:
+            w = mgr_w.get_writer(hw, m)
+            k, v = map_data(m)
+            w.write(k, v)
+            w.commit(R)
+        resw = mgr_w.read(hw)
+        for r, (gk, gv) in resw.partitions():
+            wk = allk[parts == r]
+            got = sorted(zip(gk.tolist(), map(tuple, gv.tolist())))
+            want = sorted(zip(wk.tolist(),
+                              map(tuple, allv[parts == r].tolist())))
+            assert got == want, f"waved partition {r} mismatch on {proc_id}"
+            wvcheck += 1
+        repw = mgr_w.report(13)
+        total_rows = num_maps * pairs_per_map
+        width = 2 + 2                       # int64 key + (2,) int32 value
+        assert repw.waves >= 2, f"waved job never waved: {repw.waves}"
+        assert sum(repw.wave_payload_rows) == total_rows, \
+            f"per-wave real rows {repw.wave_payload_rows} != {total_rows}"
+        assert repw.payload_bytes == total_rows * width * 4
+        assert repw.pad_ratio >= 1.0
+        # the agreed [W] vector and the accounting are identical cluster-wide
+        reps_w = mgr_w.gather_reports(13)
+        assert len(reps_w) == nprocs
+        views = {(tuple(r.get("wave_payload_rows", [])),
+                  int(r.get("payload_bytes", 0)),
+                  int(r.get("wire_bytes", 0))) for r in reps_w if r}
+        assert len(views) == 1, f"wave accounting diverged: {views}"
+        mgr_w.unregister_shuffle(13)
+        mgr_w.stop()
+        print(f"worker {proc_id}: WAVED RAGGED READ OK ({repw.waves} waves, "
+              f"pad_ratio {repw.pad_ratio})", flush=True)
+
+        if nprocs > 1:
+            # (b1) divergent occupancy view: every process proposes a
+            # different per-wave vector — all must raise together
+            raised = 0
+            try:
+                agree_wave_sizes(np.array([100 + proc_id], dtype=np.int64))
+            except RuntimeError:
+                raised = 1
+            verdict = allgather_blob(np.array([raised], dtype=np.int64))
+            assert int(np.asarray(verdict).sum()) == nprocs, \
+                f"occupancy divergence not raised everywhere: {verdict}"
+            # (b2) divergent waveRows conf: waves-on vs waves-off processes —
+            # the wave-count agreement (runs on EVERY distributed read) must
+            # raise on all of them, not desync the group
+            conf_d = TpuShuffleConf({
+                "spark.shuffle.tpu.coordinator.address": coordinator,
+                "spark.shuffle.tpu.numProcesses": str(nprocs),
+                "spark.shuffle.tpu.a2a.impl": "dense",
+                "spark.shuffle.tpu.mesh.numSlices": str(num_slices),
+                "spark.shuffle.tpu.a2a.waveRows":
+                    "256" if proc_id == 0 else "0",
+            }, use_env=False)
+            mgr_d = TpuShuffleManager(node, conf_d)
+            hd = mgr_d.register_shuffle(14, num_maps, R)
+            for m in my_maps:
+                w = mgr_d.get_writer(hd, m)
+                k, v = map_data(m)
+                w.write(k, v)
+                w.commit(R)
+            raised = 0
+            try:
+                mgr_d.read(hd)
+            except RuntimeError as e:
+                assert "wave-count mismatch" in str(e), e
+                raised = 1
+            verdict = allgather_blob(np.array([raised], dtype=np.int64))
+            assert int(np.asarray(verdict).sum()) == nprocs, \
+                f"conf divergence not raised everywhere: {verdict}"
+            mgr_d.unregister_shuffle(14)
+            mgr_d.stop()
+            print(f"worker {proc_id}: WAVE DIVERGENCE FENCED OK", flush=True)
+
     mgr.stop()
     node.close()
     print(f"worker {proc_id}/{nprocs}: verified {checked} local "
           f"partitions of {R} OK (+{ccheck} combined, {ocheck} ordered, "
-          f"{pcheck} pipelined, {vcheck} varlen)", flush=True)
+          f"{pcheck} pipelined, {vcheck} varlen, {wvcheck} waved)",
+          flush=True)
     return 0
 
 

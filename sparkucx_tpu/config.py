@@ -377,19 +377,20 @@ class TpuShuffleConf:
     # -- TPU-only keys ----------------------------------------------------
     @property
     def a2a_impl(self) -> str:
-        """Collective implementation: auto | native | dense | gather.
-
-        native = jax.lax.ragged_all_to_all (TPU ICI); dense = padded
-        all_to_all (portable); gather = all_gather oracle (tests)."""
-        v = self._get("a2a.impl", "auto")
-        from sparkucx_tpu.shuffle.alltoall import IMPLS
-        # 'pallas' = the first-party remote-DMA transport (plain flat
-        # reads; shuffle/reader._pallas_step_body)
-        allowed = ("auto",) + IMPLS + ("pallas",)
-        if v not in allowed:
-            raise ValueError(
-                f"spark.shuffle.tpu.a2a.impl={v!r}: want one of {allowed}")
-        return v
+        """Collective implementation: auto | native | dense | gather |
+        pallas. ``auto`` is ragged-first: it resolves to ``native``
+        (jax.lax.ragged_all_to_all — true per-peer row counts on the
+        wire) wherever the backend carries the op, with automatic dense
+        fallback elsewhere (alltoall.backend_supports_ragged is the
+        capability gate). dense = padded all_to_all (portable); gather =
+        all_gather oracle (tests/tiny tables); pallas = the first-party
+        remote-DMA transport (ops/pallas/ragged_a2a.py, dispatched by
+        shuffle/reader._pallas_step_body). The allowed set lives in ONE
+        place — shuffle/alltoall.ALLOWED_IMPLS — shared with
+        select_impl, so conf validation and the dispatch can't drift."""
+        from sparkucx_tpu.shuffle.alltoall import validate_impl
+        return validate_impl(self._get("a2a.impl", "auto"),
+                             conf_key=PREFIX + "a2a.impl")
 
     @property
     def sort_impl(self) -> str:

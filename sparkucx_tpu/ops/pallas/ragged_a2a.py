@@ -1,4 +1,12 @@
-"""First-party ragged all-to-all — Pallas remote-DMA transport (experimental).
+"""First-party ragged all-to-all — the Pallas remote-DMA transport.
+
+Production-gated as ``spark.shuffle.tpu.a2a.impl=pallas`` (the allowed set
+lives in shuffle/alltoall.ALLOWED_IMPLS; shuffle/reader._pallas_step_body
+dispatches it): a ragged transport in its own right — per-peer segments
+travel at their chunk-aligned real sizes, never padded to a static peer
+capacity — for backends/jax generations where the stock
+``jax.lax.ragged_all_to_all`` is unavailable or loses to per-segment DMA
+(round-2: ~23 ms of bookkeeping on an 80 MB single-device exchange).
 
 This is the framework's own collective: per-peer one-sided DMA writes over
 ICI, the direct TPU analog of the reference's UCX data plane (one-sided
@@ -47,6 +55,25 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANES = 128  # int32 lane tiling of HBM DMA slices
+
+
+def _compiler_params(**kw):
+    """Pallas compiler-params across jax generations: the class was
+    renamed TPUCompilerParams -> CompilerParams; same fields either way.
+    Feature-detected so the production-gated transport imports (and its
+    capability can be probed) on both."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
+def interpret_supported() -> bool:
+    """Whether THIS jax can run the kernel in TPU INTERPRET mode
+    (cross-device DMA simulation with race detection) — requires
+    ``pltpu.InterpretParams``; older generations' boolean interpret mode
+    cannot simulate the remote copies (dynamic ``pl.ds`` sizes). The gate
+    tests/bench consult before scheduling an interpret run."""
+    return hasattr(pltpu, "InterpretParams")
 
 
 def chunk_rows_for(width: int) -> int:
@@ -228,10 +255,16 @@ def pallas_ragged_all_to_all(
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
     )
+    if interpret and not interpret_supported():
+        raise NotImplementedError(
+            "Pallas INTERPRET mode for the remote-DMA kernel needs "
+            "pltpu.InterpretParams (newer jax); this jax can only "
+            "compile the kernel for a real TPU — gate callers on "
+            "interpret_supported()")
     out_flat = pl.pallas_call(
         functools.partial(_kernel, num_devices=num_devices),
         out_shape=jax.ShapeDtypeStruct((m_out, LANES), jnp.int32),
-        compiler_params=pltpu.CompilerParams(collective_id=0),
+        compiler_params=_compiler_params(collective_id=0),
         grid_spec=grid_spec,
         interpret=pltpu.InterpretParams(detect_races=True)
         if interpret else False,

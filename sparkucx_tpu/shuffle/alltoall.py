@@ -16,17 +16,30 @@ per-block host round-trips. This preserves the reference's headline property
 ("the mapper's CPU is never involved in serving a fetch") in its TPU form:
 no host code runs per block — the whole exchange is one XLA op on the wire.
 
-Three interchangeable implementations (conf key ``spark.shuffle.tpu.a2a.impl``):
+Four production implementations (conf key ``spark.shuffle.tpu.a2a.impl``),
+ragged-first: ``auto`` resolves to the ragged native collective wherever
+the backend carries the op, so real bytes — never padded caps — are the
+default wire contract (ROADMAP item 1; Ragged Paged Attention makes the
+same case at the kernel level):
 
-``native``  — ``jax.lax.ragged_all_to_all``. The real ICI path on TPU.
+``native``  — ``jax.lax.ragged_all_to_all``. True per-peer row counts on
+              the wire: each device ships exactly its [P] size row's worth
+              of rows, pad_ratio ≈ 1.0 by construction.
 ``dense``   — pad each peer segment to a static per-peer capacity and use
               ``jax.lax.all_to_all``, then recompact. Portable (XLA:CPU has
-              no ragged-all-to-all thunk); also the fallback shape when a
-              skew-bounded exchange compiles better.
+              no ragged-all-to-all thunk) — the automatic fallback where
+              the native op is missing; its wire cost is P x the padded
+              peer capacity regardless of occupancy, which the real-bytes
+              accounting (plan.ragged_layout, ExchangeReport.pad_ratio,
+              doctor rule ``padding_waste``) makes visible.
 ``gather``  — ``all_gather`` everything and slice locally. O(P·cap) memory;
               the test oracle, and the DCN-friendly shape for tiny tables.
+``pallas``  — the first-party remote-DMA transport
+              (ops/pallas/ragged_a2a.py), integrated at the READER level
+              (chunk-aligned segment layout); validated here, dispatched by
+              shuffle/reader._pallas_step_body.
 
-All three share static shapes (SURVEY.md §7 hard part (a)): callers choose
+All share static buffer shapes (SURVEY.md §7 hard part (a)): callers choose
 ``out_capacity`` (and ``peer_capacity`` for dense) via the conf's
 ``capacityFactor``; overflow is *reported*, never silently truncated.
 """
@@ -42,20 +55,70 @@ import jax.numpy as jnp
 
 from sparkucx_tpu.meta.segments import exchange_plan
 
+# The transports ragged_shuffle dispatches itself (dense receive contract).
 IMPLS = ("native", "dense", "gather")
+# Every production impl, including the reader-integrated pallas transport —
+# THE source of truth for what a2a.impl accepts (config.py validates
+# through validate_impl below; no second copy to drift).
+ALL_IMPLS = IMPLS + ("pallas",)
+ALLOWED_IMPLS = ("auto",) + ALL_IMPLS
+
+A2A_IMPL_KEY = "spark.shuffle.tpu.a2a.impl"
+
+
+def validate_impl(impl: str, conf_key: str = A2A_IMPL_KEY) -> str:
+    """The one validation seam for the a2a implementation set: config.py,
+    select_impl and the bench CLI all accept exactly ``ALLOWED_IMPLS``,
+    and the error names the conf key to turn."""
+    if impl not in ALLOWED_IMPLS:
+        raise ValueError(
+            f"{conf_key}={impl!r}: want one of {ALLOWED_IMPLS} "
+            f"(auto resolves to 'native' where the backend has "
+            f"jax.lax.ragged_all_to_all, else 'dense')")
+    return impl
+
+
+def has_ragged_all_to_all() -> bool:
+    """Whether this jax generation carries the native ragged collective —
+    the capability half of the gate shuffle/aot.py probes before burning
+    a topology bring-up on an op that cannot trace."""
+    return hasattr(jax.lax, "ragged_all_to_all")
+
+
+def backend_supports_ragged(backend: Optional[str] = None) -> bool:
+    """THE capability gate for ``a2a.impl=auto``: the backend has an XLA
+    thunk for ragged-all-to-all (TPU/GPU) AND this jax exposes the op.
+    CPU always says no (no thunk), so auto falls back to dense there."""
+    backend = backend or jax.default_backend()
+    return backend in ("tpu", "gpu") and has_ragged_all_to_all()
 
 
 def select_impl(impl: str, backend: Optional[str] = None) -> str:
-    """Resolve 'auto' to the best implementation for the backend.
+    """Resolve 'auto' to the best implementation for the backend:
+    ragged-native wherever :func:`backend_supports_ragged`, with
+    automatic dense fallback elsewhere (an op-less jax on a TPU backend
+    degrades to dense rather than dying at trace time).
 
     The reference's analog decision is UCX picking RDMA vs TCP vs shm
     transports under the same API (ref: README.md:2-3)."""
     if impl != "auto":
-        if impl not in IMPLS:
-            raise ValueError(f"unknown a2a impl {impl!r}; want one of {IMPLS}")
-        return impl
-    backend = backend or jax.default_backend()
-    return "native" if backend in ("tpu", "gpu") else "dense"
+        return validate_impl(impl)
+    return "native" if backend_supports_ragged(backend) else "dense"
+
+
+def resolved_wire_impl(impl: str, num_shards: int,
+                       backend: Optional[str] = None) -> str:
+    """The transport an exchange with this (impl, shard count) actually
+    rides — including the 1-shard ``local`` move ragged_shuffle takes
+    under 'auto' — for reports and real-bytes accounting
+    (plan.ragged_layout). Mirrors ragged_shuffle's dispatch exactly so
+    the accounting can never claim a transport the data plane didn't
+    run."""
+    if impl == "pallas":
+        return "pallas"
+    if impl == "auto" and num_shards == 1:
+        return "local"
+    return select_impl(impl, backend)
 
 
 @dataclass

@@ -34,9 +34,14 @@ def _require_ragged_op(report: dict) -> bool:
     generation without ``jax.lax.ragged_all_to_all`` the compile would
     burn the whole topology bring-up (minutes on a slow libtpu) before
     dying at trace time. Report it in milliseconds instead; callers see
-    ``unsupported`` and can skip rather than fail."""
-    import jax
-    if hasattr(jax.lax, "ragged_all_to_all"):
+    ``unsupported`` and can skip rather than fail.
+
+    The op probe itself lives in shuffle/alltoall
+    (``has_ragged_all_to_all`` — the same gate ``a2a.impl=auto``
+    resolution rides), so the AOT proofs and the production impl
+    selection can never disagree about what this jax carries."""
+    from sparkucx_tpu.shuffle.alltoall import has_ragged_all_to_all
+    if has_ragged_all_to_all():
         return True
     report.update(ok=False, unsupported=True,
                   error="jax.lax.ragged_all_to_all unavailable on this "

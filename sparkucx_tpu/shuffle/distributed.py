@@ -129,6 +129,30 @@ def agree_wave_count(local_waves: int) -> int:
     return w
 
 
+def agree_wave_sizes(wave_sizes: np.ndarray) -> np.ndarray:
+    """COLLECTIVE: agree on the PER-WAVE real row counts of a ragged
+    waved exchange (the [W] vector ``plan.wave_payload_rows`` derives
+    from the global size row). Like :func:`agree_wave_count`, the
+    proposal is identical everywhere by construction — this round exists
+    to FAIL FAST on the one way it can diverge: a process whose view of
+    the staged occupancy differs (stale size row after a raced remesh/
+    unregister, or a conf divergence that survived the wave-count
+    agreement), which would otherwise dispatch per-wave collectives with
+    inconsistent size rows and desync — or silently corrupt — the mesh.
+    Mismatch raises on every process together (the verdict rides the
+    allgather). Returns the agreed vector."""
+    mine = np.asarray(wave_sizes, dtype=np.int64).reshape(-1)
+    got = np.asarray(allgather_blob(mine)).reshape(-1, mine.shape[0])
+    if (got != got[0]).any():
+        raise RuntimeError(
+            f"per-wave occupancy mismatch across processes: "
+            f"{got.tolist()} — every process must derive the same "
+            f"per-wave real row counts from the allgathered size row "
+            f"(stale staged outputs or divergent "
+            f"spark.shuffle.tpu.a2a.waveRows conf)")
+    return got[0]
+
+
 def gather_clock_anchors(tracer=None) -> list:
     """COLLECTIVE: every process's wall↔perf anchor pair
     (:meth:`Tracer.anchor` + process index), gathered at connect/remesh

@@ -34,8 +34,9 @@ from sparkucx_tpu.config import TpuShuffleConf
 from sparkucx_tpu.meta.registry import ShuffleEntry
 from sparkucx_tpu.meta.segments import validate_row_sizes
 from sparkucx_tpu.runtime.node import TpuNode
-from sparkucx_tpu.shuffle.plan import (ShufflePlan, make_plan, wave_count,
-                                       wave_step_plan)
+from sparkucx_tpu.shuffle.plan import (ShufflePlan, make_plan,
+                                       ragged_layout, wave_count,
+                                       wave_payload_rows, wave_step_plan)
 from sparkucx_tpu.shuffle.reader import (
     KEY_WORDS,
     ShuffleReaderResult,
@@ -96,6 +97,17 @@ class ExchangeReport:
     rows_global: int = 0
     rows_local: int = 0
     bytes_local: int = 0
+    # Real-bytes accounting (plan.RaggedLayout — the ragged data plane's
+    # wire contract): ``payload_bytes`` is the REAL global payload,
+    # ``wire_bytes`` what the resolved transport moved over the fabric
+    # for it, ``pad_ratio`` their quotient (1.0 = every wire byte was a
+    # real byte — the ragged-native contract; dense pays ~P x
+    # capacityFactor). ``impl`` above is the RESOLVED transport (never
+    # 'auto'), so the figures always name the path that ran. Overflow
+    # retries refresh wire_bytes/pad_ratio from the final (regrown) plan.
+    payload_bytes: int = 0
+    wire_bytes: int = 0
+    pad_ratio: float = 0.0
     peer_rows: List[int] = field(default_factory=list)
     peer_bytes: List[int] = field(default_factory=list)
     skew_ratio: float = 0.0
@@ -103,6 +115,10 @@ class ExchangeReport:
     stepcache_hits: int = 0
     stepcache_programs: int = 0
     plan_bucket: List[int] = field(default_factory=list)
+    # Waved reads: [W] REAL global rows each wave moved (the occupancy
+    # the pipeline shipped, vs cap_in rows provisioned per wave) — the
+    # per-wave view of the payload/wire split above. Empty = single-shot.
+    wave_payload_rows: List[int] = field(default_factory=list)
     # Wave-pipelined exchange (a2a.waveRows): wave split plus the
     # per-wave timeline — one entry per wave, {wave, rows, pack_start_ms,
     # pack_ms, dispatch_ms, hidden, forced_ms, wait_ms, retries}, times
@@ -1037,8 +1053,19 @@ class TpuShuffleManager:
         """Fill the report's volume/skew/plan fields and feed the
         per-peer distribution histograms — one observation per peer per
         exchange, the per-endpoint bytes log of the reference
-        (OnBlocksFetchCallback.java:55-56) as a live distribution."""
-        rep.impl = plan.impl
+        (OnBlocksFetchCallback.java:55-56) as a live distribution.
+
+        The real-bytes accounting (payload/wire/pad_ratio) and the
+        RESOLVED transport come from the plan's ragged layout descriptor
+        — one contract shared with the data plane itself, so the report
+        can never claim a wire cost the transport didn't pay. Initial
+        figures; an overflow retry (regrown cap) refreshes them at
+        on_done, and the waved path re-derives them per wave."""
+        layout = ragged_layout(plan, nvalid, width)
+        rep.impl = layout.impl
+        rep.payload_bytes = layout.payload_bytes
+        rep.wire_bytes = layout.wire_bytes
+        rep.pad_ratio = layout.pad_ratio
         rep.plan_bucket = [int(plan.cap_in), int(plan.cap_out)]
         # plain-python arithmetic over the (tiny, per-peer) lists: numpy
         # reductions on 8-element arrays cost more in dispatch than the
@@ -1060,13 +1087,31 @@ class TpuShuffleManager:
             metrics.observe(H_PEER_ROWS, float(r))
             metrics.observe(H_PEER_BYTES, float(b))
 
+    @staticmethod
+    def _set_wave_wire(rep: ExchangeReport, wplan: ShufflePlan,
+                       wave_sizes, width: int) -> None:
+        """Waved wire accounting: sum the per-wave layout costs under the
+        (current) wave plan. rep.payload_bytes was set by _report_volume
+        from the full size row and is the denominator either way."""
+        wire = sum(
+            ragged_layout(wplan, np.asarray([int(s)]), width).wire_bytes
+            for s in wave_sizes)
+        rep.wire_bytes = int(wire)
+        rep.pad_ratio = round(wire / rep.payload_bytes, 6) \
+            if rep.payload_bytes else 0.0
+
     def _finish_device_plane(self, rep: ExchangeReport, step, width: int,
                              completed: bool) -> None:
         """Complete a report's device-plane fields at read settlement:
         ``device_cost`` from the dispatched step's stepcache harvest (a
         record exists for every warm-compiled program; its fields may be
         null on backends without the XLA analyses) and ``bw_gbps`` =
-        global payload bytes / group wall. Steady-state reads observe the
+        REAL global payload bytes / group wall — always the ragged
+        layout's payload figure, never a padded-cap product, so the rate
+        is comparable across transports (a dense exchange that moved 16x
+        the payload in padding still reports the payload rate — the
+        padding shows up in pad_ratio, not as phantom bandwidth).
+        Steady-state reads observe the
         figure into ``shuffle.collective.bw_gbps``; compile-bearing reads
         keep the field but stay out of the distribution — an in-band XLA
         compile inside group_ms says nothing about the link (the
@@ -1084,7 +1129,8 @@ class TpuShuffleManager:
                         dc["bytes_accessed"] / (rep.group_ms * 1e6), 6)
                 rep.device_cost = dc
             if completed and rep.group_ms > 0:
-                gbps = rep.rows_global * width * 4 / (rep.group_ms * 1e6)
+                payload = rep.payload_bytes or rep.rows_global * width * 4
+                gbps = payload / (rep.group_ms * 1e6)
                 rep.bw_gbps = round(gbps, 6)
                 if not rep.stepcache_programs:
                     self.node.metrics.observe(H_BW, gbps)
@@ -1130,6 +1176,33 @@ class TpuShuffleManager:
                     report.group_ms = (time.perf_counter()
                                        - report._t_dispatched) * 1e3
                 report.retries = int(retries)
+                if retries and pend is not None \
+                        and getattr(pend, "_plan", None) is not None:
+                    # the overflow retry regrew the plan: wire accounting
+                    # must reflect the capacities the FINAL dispatch
+                    # padded to, not the ones the first attempt overflowed
+                    lay = ragged_layout(pend._plan,
+                                        np.asarray(report.peer_rows),
+                                        width)
+                    report.wire_bytes = lay.wire_bytes
+                    report.pad_ratio = lay.pad_ratio
+                if result is not None and report.payload_bytes:
+                    # cumulative real-vs-wire volume counters — the
+                    # Prometheus view of the per-report pad_ratio. The
+                    # report fields are GLOBAL figures; counters sum
+                    # across processes in doctor.build_view (the
+                    # shuffle.rows/bytes discipline above), so each
+                    # process accounts its LOCAL share — its own staged
+                    # payload and its own shards' wire segments — and
+                    # the cluster sum reconstructs the global exactly.
+                    self.node.metrics.inc(
+                        "shuffle.payload.bytes",
+                        float(report.rows_local) * width * 4)
+                    frac = len(self.node.local_shard_ids) \
+                        / max(self.node.num_devices, 1)
+                    self.node.metrics.inc(
+                        "shuffle.wire.bytes",
+                        float(report.wire_bytes) * frac)
                 report.stepcache_hits = int(
                     GLOBAL_METRICS.get(COMPILE_HITS) - report._hits0)
                 report.stepcache_programs = int(
@@ -1394,9 +1467,26 @@ class TpuShuffleManager:
             # a same-shape exchange already settled its wave capacity —
             # start there instead of re-paying the overflow recompile
             wplan = dataclasses.replace(wplan, cap_out=hint)
+        # Ragged wave contract: the [W] REAL per-wave row counts derive
+        # from the global size row (identical on every process). In
+        # distributed mode they are AGREED collectively, agree_wave_count
+        # style — a process with a divergent occupancy view (stale staged
+        # outputs, raced unregister) fails fast on every process together
+        # instead of desyncing the per-wave collectives mid-pipeline.
+        wave_sizes = wave_payload_rows(nvalid, wave_rows, num_waves)
+        if distributed:
+            from sparkucx_tpu.shuffle.distributed import agree_wave_sizes
+            wave_sizes = agree_wave_sizes(wave_sizes)
         rep.waves = num_waves
         rep.wave_rows = wave_rows
+        rep.wave_payload_rows = [int(x) for x in wave_sizes]
         rep.plan_bucket = [int(wplan.cap_in), int(wplan.cap_out)]
+        # wave wire accounting: the pipeline dispatches W exchanges of the
+        # wave plan's shape — wire cost is per wave (a padded transport
+        # pays its caps every wave, occupancy notwithstanding; the ragged
+        # native collective pays each wave's real rows). Refreshed in
+        # _finalize once any overflow regrow settles the final wave plan.
+        self._set_wave_wire(rep, wplan, wave_sizes, width)
         depth = max(1, min(self.conf.wave_depth, num_waves))
         # Admission: the pipeline's whole point is a bounded footprint —
         # `depth` pinned wave blocks plus up to `depth` waves' device
@@ -1427,7 +1517,7 @@ class TpuShuffleManager:
                 self, handle, outer, wplan, depth, slot_outputs, nvalid,
                 width, has_vals, val_tail, val_dtype, rep, read_gen,
                 admit, release_admitted, local_rows, distributed,
-                shard_ids)
+                shard_ids, wave_sizes=wave_sizes)
         except BaseException:
             self._read_finished(read_gen)
             release_admitted()
@@ -1873,7 +1963,7 @@ class PendingWaveShuffle:
                  depth: int, slot_outputs, nvalid: np.ndarray, width: int,
                  has_vals: bool, val_tail, val_dtype, rep: ExchangeReport,
                  read_gen: int, admit, release_admitted, local_rows: int,
-                 distributed: bool, shard_ids=None):
+                 distributed: bool, shard_ids=None, wave_sizes=None):
         self._mgr = mgr
         self._handle = handle
         self._outer_plan = outer_plan
@@ -1895,6 +1985,10 @@ class PendingWaveShuffle:
         self._shard_ids = list(shard_ids) if shard_ids is not None else None
         self._num_waves = outer_plan.num_waves
         self._wave_rows = outer_plan.wave_rows
+        # [W] agreed REAL rows per wave (ragged wave contract) — drives
+        # the report's wire accounting; None only from legacy callers
+        self._wave_sizes = None if wave_sizes is None \
+            else np.asarray(wave_sizes, dtype=np.int64)
         self._result = None
         self._dead = False
         # last drained wave's compiled step — every wave shares ONE
@@ -2108,6 +2202,13 @@ class PendingWaveShuffle:
             GLOBAL_METRICS.get(COMPILE_HITS) - rep._hits0)
         rep.stepcache_programs = int(
             GLOBAL_METRICS.get(COMPILE_PROGRAMS) - rep._prog0)
+        if self._wave_sizes is not None:
+            # settle the wire accounting under the FINAL wave plan (an
+            # overflow regrow mid-pipeline raised cap_out for the waves
+            # behind it; charging every wave the settled capacity is the
+            # steady-state cost later same-shape exchanges pay)
+            mgr._set_wave_wire(rep, self._wave_plan, self._wave_sizes,
+                               self._width)
         mgr._finish_device_plane(rep, self._last_step, self._width,
                                  completed=True)
         rep.completed = True
@@ -2116,6 +2217,16 @@ class PendingWaveShuffle:
         metrics.inc("shuffle.rows", float(self._local_rows))
         metrics.inc("shuffle.bytes",
                     float(self._local_rows) * self._width * 4)
+        if rep.payload_bytes:
+            # LOCAL shares, like shuffle.rows/bytes above: counters sum
+            # across processes in build_view, so the cluster total must
+            # reconstruct the global payload/wire exactly once
+            metrics.inc("shuffle.payload.bytes",
+                        float(self._local_rows) * self._width * 4)
+            frac = len(mgr.node.local_shard_ids) \
+                / max(mgr.node.num_devices, 1)
+            metrics.inc("shuffle.wire.bytes",
+                        float(rep.wire_bytes) * frac)
         if retries_total:
             metrics.inc("shuffle.retries", float(retries_total))
         # wave wait-gap distribution: pack time NOT covered by the

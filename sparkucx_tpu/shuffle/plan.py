@@ -262,6 +262,89 @@ def make_plan(
     )
 
 
+@dataclass(frozen=True)
+class RaggedLayout:
+    """Wire-contract descriptor of one exchange — the real-bytes half of
+    the ragged data plane (ROADMAP item 1). Derived host-side from the
+    plan plus the [P] size row (the same row the pack phase publishes and
+    ``meta/segments.exchange_plan`` all-gathers on device), so the
+    accounting and the transport read one contract:
+
+    * ``payload_*`` — the REAL staged rows/bytes (what the consumer gets);
+    * ``wire_*``    — what the resolved transport moves over the fabric:
+      the payload itself for the ragged-native collective and the 1-shard
+      local move, ``P² x cap`` padded segments for dense/gather, and the
+      chunk-aligned upper bound for the pallas remote-DMA transport;
+    * ``pad_ratio`` — wire/payload: 1.0 means every byte on the wire was a
+      real byte; dense at uniform occupancy pays ~P x capacityFactor, and
+      skew (which grows cap_out) only inflates it further — the figure
+      ``bench --stage ragged`` sweeps and the doctor's ``padding_waste``
+      rule grades.
+
+    Hierarchical (two-stage ICI/DCN) exchanges ride the same formula per
+    stage; the descriptor reports the flat single-collective cost (a lower
+    bound — each row crosses twice there), with the report's
+    ``hierarchical`` flag carrying the context."""
+
+    impl: str          # resolved transport: native|dense|gather|pallas|local
+    num_shards: int
+    width: int
+    payload_rows: int
+    wire_rows: int
+    payload_bytes: int
+    wire_bytes: int
+    pad_ratio: float   # wire/payload; 0.0 for an empty exchange
+
+
+def ragged_layout(plan: ShufflePlan, shard_rows, width: int,
+                  backend: Optional[str] = None) -> RaggedLayout:
+    """Build the :class:`RaggedLayout` for one exchange (or one wave of a
+    waved exchange — pass the wave plan and that wave's real rows).
+    ``shard_rows`` is any array whose sum is the exchange's real staged
+    rows (the [P] size row on the full read path)."""
+    from sparkucx_tpu.shuffle.alltoall import resolved_wire_impl
+    impl = resolved_wire_impl(plan.impl, plan.num_shards, backend)
+    payload = int(np.sum(np.asarray(shard_rows, dtype=np.int64)))
+    P = plan.num_shards
+    if impl in ("native", "local"):
+        # true per-peer counts on the wire (the [P] size-row allgather
+        # rides along at P² ints — noise next to any real payload)
+        wire = payload
+    elif impl == "dense":
+        # every shard ships P segments padded to peer_capacity (= cap_out
+        # on the production path), occupancy notwithstanding
+        wire = P * P * plan.cap_out
+    elif impl == "gather":
+        # each shard's whole cap_in send buffer replicates to all P peers
+        wire = P * P * plan.cap_in
+    else:  # pallas: segments round up to the 128-lane chunk — upper bound
+        from sparkucx_tpu.ops.pallas.ragged_a2a import chunk_rows_for
+        wire = payload + P * P * (chunk_rows_for(width) - 1)
+    payload_bytes = payload * width * 4
+    wire_bytes = wire * width * 4
+    pad = round(wire_bytes / payload_bytes, 6) if payload_bytes else 0.0
+    return RaggedLayout(impl=impl, num_shards=P, width=width,
+                        payload_rows=payload, wire_rows=wire,
+                        payload_bytes=payload_bytes, wire_bytes=wire_bytes,
+                        pad_ratio=pad)
+
+
+def wave_payload_rows(shard_rows: np.ndarray, wave_rows: int,
+                      num_waves: int) -> np.ndarray:
+    """[W] REAL global rows each wave of a waved exchange moves: wave i
+    takes rows [i*wave_rows, (i+1)*wave_rows) of every shard's staged
+    sequence, so its occupancy is the clipped remainder per shard. Pure
+    arithmetic over the global size row — identical on every process by
+    construction, which is exactly why ``distributed.agree_wave_sizes``
+    can fail fast on any divergent view instead of desyncing the mesh."""
+    rows = np.asarray(shard_rows, dtype=np.int64)
+    out = np.zeros(num_waves, dtype=np.int64)
+    for i in range(num_waves):
+        out[i] = int(np.clip(rows - i * int(wave_rows), 0,
+                             int(wave_rows)).sum())
+    return out
+
+
 def wave_count(shard_rows: np.ndarray, wave_rows: int) -> int:
     """Waves a staged row distribution splits into at ``wave_rows`` rows
     per shard per wave: ceil(max staged rows / wave_rows). Every shard

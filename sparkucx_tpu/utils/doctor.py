@@ -45,6 +45,11 @@ bw_underutil...   steady-state achieved collective bw      spark.shuffle.tpu.a2a
                   p50 ≪ the best bw the SAME link
                   demonstrated, while the collective
                   dominates the exchange wall
+padding_waste     ExchangeReport pad_ratio (wire bytes /   spark.shuffle.tpu.a2a.impl
+                  real payload bytes, plan.RaggedLayout)
+                  over threshold with a min-wire-bytes
+                  floor — the transport ships padded
+                  caps, not real bytes
 ================  =======================================  =====================================
 
 The same :class:`Finding` schema carries ``bench.py --stage regress``
@@ -122,6 +127,15 @@ class Thresholds:
     bw_min_gbps: float = 0.05          # below this the link never showed
     #                                    real throughput — timing noise on
     #                                    tiny exchanges, not utilization
+    # padding_waste: wire bytes / real payload bytes (plan.RaggedLayout).
+    # A P=8 dense exchange at the default capacityFactor pays ~16x even
+    # perfectly balanced — warn territory (the ragged-capable transport
+    # is the fix); critical is reserved for skew-amplified waste (regrown
+    # caps multiplying the padded wire). The min-wire floor keeps tiny
+    # test exchanges out (PR-5 discipline: ratios need a signal floor).
+    pad_warn_ratio: float = 4.0
+    pad_critical_ratio: float = 32.0
+    pad_min_wire_bytes: float = 1e6
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -636,10 +650,61 @@ def _rule_bw_underutilization(view: ClusterView,
         trace_ids=trace_ids)]
 
 
+def _rule_padding_waste(view: ClusterView,
+                        th: Thresholds) -> List[Finding]:
+    """The wire carries padding, not bytes: a completed exchange's
+    ``pad_ratio`` (wire bytes over real payload bytes, from the plan's
+    RaggedLayout descriptor) sits over threshold while the wire moved
+    enough bytes to matter. The padded dense fallback at any realistic
+    skew is exactly this shape — the remediation is the ragged-capable
+    transport where the backend has it, capacity tuning where it
+    doesn't. Fires once, on the worst offender."""
+    worst = None
+    for r in _completed(view):
+        ratio = float(r.get("pad_ratio") or 0.0)
+        wire = float(r.get("wire_bytes") or 0.0)
+        if wire < th.pad_min_wire_bytes or ratio < th.pad_warn_ratio:
+            continue
+        if worst is None or ratio > worst[0]:
+            worst = (ratio, r)
+    if worst is None:
+        return []
+    ratio, r = worst
+    payload = float(r.get("payload_bytes") or 0.0)
+    waves = int(r.get("waves") or 0)
+    return [Finding(
+        rule="padding_waste",
+        grade="critical" if ratio >= th.pad_critical_ratio else "warn",
+        summary=(f"shuffle {r.get('shuffle_id')} ({r.get('impl')}"
+                 f"{', waved' if waves else ''}) moved "
+                 f"{float(r.get('wire_bytes', 0)) / 1e6:.1f} MB on the "
+                 f"wire for {payload / 1e6:.1f} MB of real payload "
+                 f"({ratio:.1f}x padding) — the transport ships padded "
+                 f"caps, not real bytes"),
+        evidence={"shuffle_id": r.get("shuffle_id"),
+                  "impl": r.get("impl"),
+                  "pad_ratio": round(ratio, 2),
+                  "payload_bytes": int(payload),
+                  "wire_bytes": int(r.get("wire_bytes") or 0),
+                  "skew_ratio": round(float(r.get("skew_ratio", 0.0)), 2),
+                  "plan_bucket": r.get("plan_bucket"),
+                  "waves": waves},
+        conf_key="spark.shuffle.tpu.a2a.impl",
+        remediation=("run a ragged-capable transport: a2a.impl=auto "
+                     "resolves to the native ragged collective wherever "
+                     "the backend carries jax.lax.ragged_all_to_all "
+                     "(pad_ratio ~= 1.0), and a2a.impl=pallas is the "
+                     "first-party chunk-aligned alternative; on "
+                     "dense-only backends, lower a2a.capacityFactor and "
+                     "keep a2a.capBucketGrowth modest so padded caps "
+                     "track real occupancy"),
+        trace_ids=[r.get("trace_id", "")])]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
-          _rule_bw_underutilization)
+          _rule_bw_underutilization, _rule_padding_waste)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

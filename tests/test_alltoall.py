@@ -121,12 +121,39 @@ def test_overflow_flagged(mesh8, rng, impl):
     assert np.asarray(ovf).reshape(PDEV).all()
 
 
-def test_select_impl():
+def test_select_impl(monkeypatch):
+    """'auto' is ragged-first BEHIND the capability gate: native needs
+    both a TPU/GPU backend AND a jax that carries the op; everything
+    else falls back to dense automatically (never a trace-time death on
+    an op-less jax). The error for junk names cites the conf key."""
+    import jax
+
+    from sparkucx_tpu.shuffle.alltoall import (backend_supports_ragged,
+                                               has_ragged_all_to_all,
+                                               resolved_wire_impl,
+                                               validate_impl)
     assert select_impl("dense") == "dense"
-    assert select_impl("auto", backend="tpu") == "native"
-    assert select_impl("auto", backend="cpu") == "dense"
-    with pytest.raises(ValueError):
+    assert select_impl("auto", backend="cpu") == "dense"   # no CPU thunk
+    assert select_impl("auto", backend="tpu") == \
+        ("native" if has_ragged_all_to_all() else "dense")
+    assert not backend_supports_ragged("cpu")
+    if not has_ragged_all_to_all():
+        # simulate a ragged-capable jax: the gate (not the backend name
+        # alone) decides
+        monkeypatch.setattr(jax.lax, "ragged_all_to_all",
+                            lambda *a, **k: None, raising=False)
+        assert select_impl("auto", backend="tpu") == "native"
+        assert select_impl("auto", backend="cpu") == "dense"
+    with pytest.raises(ValueError, match="spark.shuffle.tpu.a2a.impl"):
         select_impl("bogus")
+    with pytest.raises(ValueError, match="spark.shuffle.tpu.a2a.impl"):
+        validate_impl("rdma")
+    assert validate_impl("pallas") == "pallas"
+    # the accounting resolver mirrors ragged_shuffle's dispatch exactly,
+    # including the 1-shard local move and the reader-level pallas path
+    assert resolved_wire_impl("auto", 1) == "local"
+    assert resolved_wire_impl("pallas", 8) == "pallas"
+    assert resolved_wire_impl("gather", 8) == "gather"
 
 
 def test_permutation_identity(mesh8, rng):

@@ -137,3 +137,29 @@ def test_pipeline_measure_small(mesh8):
     assert w["pack_hidden_ms"] <= w["pack_ms"] + 1e-6
     assert w["peak_pinned_bytes"] < s["peak_pinned_bytes"]
     assert rec["speedup"] > 0
+
+
+def test_ragged_measure_small(mesh8):
+    """The ragged stage's measurement core at a tiny shape: the dense arm
+    measures skew-proportional padding, the ragged arm holds the
+    real-bytes contract (pad_ratio 1.0) at every level, and the GB/s
+    figures are computed on real payload bytes. The e2e ragged>=dense
+    gate belongs to the stage on native-op backends only."""
+    rec = bench.ragged_measure(rows_per_map=512, maps=4, partitions=8,
+                               val_words=4, reps=1)
+    lv = rec["levels"]
+    for s in ("uniform", "zipf", "onehot"):
+        level = lv[s]
+        assert level["dense"]["measured"] is True
+        assert level["dense"]["impl"] == "dense"
+        assert level["dense"]["pad_ratio"] > 1.0
+        assert level["dense"]["bw"]["gbps_real_bytes"] > 0
+        assert level["ragged"]["pad_ratio"] <= 1.000001
+        assert level["ragged"]["impl"] in ("native", "local")
+        assert 0.0 < level["wire_savings_rate"] < 1.0
+        assert level["ragged"]["payload_mb"] == level["dense"]["payload_mb"]
+    # waste must grow with skew: the regrown caps multiply the padding
+    assert lv["onehot"]["dense"]["pad_ratio"] \
+        > lv["uniform"]["dense"]["pad_ratio"]
+    assert rec["native_supported"] == \
+        ("ragged_vs_dense_speedup" in lv["zipf"])

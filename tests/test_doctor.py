@@ -353,6 +353,72 @@ def test_bw_underutilization_quiet_goldens():
     assert diagnose(_bw_doc([0.2, 2.0], with_report=False)) == []
 
 
+def _pad_report(sid=9, trace="s9.e0.x9", pad_ratio=8.0, payload_mb=4.0,
+                impl="dense", waves=0):
+    """A completed exchange whose wire carried ``pad_ratio`` x its real
+    payload — the padding_waste inputs (plan.RaggedLayout accounting)."""
+    r = _report(sid=sid, trace=trace)
+    r["impl"] = impl
+    r["payload_bytes"] = int(payload_mb * 1e6)
+    r["wire_bytes"] = int(payload_mb * 1e6 * pad_ratio)
+    r["pad_ratio"] = pad_ratio
+    r["waves"] = waves
+    return r
+
+
+def test_padding_waste_fires_on_padded_dense_wire():
+    """A dense exchange shipping 8x its payload in padded caps: warn,
+    pointing at the ragged-capable transport conf."""
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_pad_report(pad_ratio=8.0))
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["padding_waste"]
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.evidence["pad_ratio"] == 8.0
+    assert f.evidence["impl"] == "dense"
+    assert f.conf_key == "spark.shuffle.tpu.a2a.impl"
+    assert "ragged" in f.remediation
+    assert "s9.e0.x9" in f.trace_ids
+
+
+def test_padding_waste_critical_on_skew_amplified_waste():
+    """Skew-regrown caps multiplying the padded wire grade critical, and
+    the WORST offender is the one reported."""
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_pad_report(sid=9, pad_ratio=8.0))
+    doc["exchange_reports"].append(
+        _pad_report(sid=10, trace="s10.e0.x10", pad_ratio=40.0, waves=4))
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["padding_waste"]
+    f = fs[0]
+    assert f.grade == "critical"
+    assert f.evidence["shuffle_id"] == 10
+    assert f.evidence["waves"] == 4
+    assert "waved" in f.summary
+
+
+def test_padding_waste_quiet_goldens():
+    # ragged-native path: every wire byte is a real byte — quiet
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(
+        _pad_report(pad_ratio=1.0, impl="native"))
+    assert diagnose(doc) == []
+    # modest padding below the warn threshold — quiet
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_pad_report(pad_ratio=2.5))
+    assert diagnose(doc) == []
+    # sub-noise: huge ratio but the wire moved almost nothing (tiny test
+    # exchange under the min-wire floor, PR-5 discipline)
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(
+        _pad_report(pad_ratio=64.0, payload_mb=0.01))
+    assert diagnose(doc) == []
+    # reports with no accounting (pre-ragged dumps) — quiet, not a crash
+    doc = _healthy_doc()
+    assert diagnose(doc) == []
+
+
 def test_gauges_attribute_per_process_in_cluster_view():
     """build_view keeps gauges per process (point-in-time values must
     attribute, never sum) and hbm_pressure names the pressed process."""

@@ -299,3 +299,109 @@ def test_random_job_waved_equals_single_shot(waved_manager, seed):
             assert rep.waves >= 2, f"seed {seed}: never waved ({total})"
     finally:
         manager.unregister_shuffle(sid)
+
+
+# -- ragged-plane stratified sweep: impl x waves x skew ---------------------
+# The ISSUE-6 parity matrix: every production transport (dense fallback,
+# gather oracle shape, native ragged where the backend carries the op,
+# the first-party pallas remote-DMA transport under INTERPRET race
+# detection) x {single-shot, waved} x a skew ladder (uniform / zipf /
+# one-hot) against the host oracle — plus the real-bytes accounting
+# invariants on every report (payload == staged bytes, pad_ratio >= 1).
+SKEW_LEVELS = ("uniform", "zipf", "onehot")
+SWEEP_IMPLS = ("dense", "gather", "native", "pallas")
+
+
+def _skewed_keys(rng, skew, n):
+    if skew == "uniform":
+        return rng.integers(-(1 << 62), 1 << 62, size=n).astype(np.int64)
+    if skew == "zipf":
+        # heavy-head duplicate keys: hashing concentrates them onto few
+        # partitions (the realistic hot-key shape)
+        return rng.zipf(1.5, size=n).astype(np.int64) % 1000
+    return np.full(n, 7, dtype=np.int64)           # one-hot: one partition
+
+
+@pytest.fixture(scope="module")
+def sweep_managers(manager):
+    """Per-(impl, waved) managers sharing the module node (manager conf
+    is what make_plan reads, so transports/waves differ per manager
+    without re-bootstrapping the runtime)."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    cache = {}
+
+    def get(impl, waved):
+        key = (impl, waved)
+        if key not in cache:
+            cmap = {"spark.shuffle.tpu.a2a.impl": impl}
+            if waved:
+                cmap["spark.shuffle.tpu.a2a.waveRows"] = "48"
+            conf = TpuShuffleConf(cmap, use_env=False)
+            cache[key] = TpuShuffleManager(manager.node, conf)
+        return cache[key]
+
+    yield get
+    for m in cache.values():
+        m.stop()
+
+
+@pytest.mark.parametrize("skew", SKEW_LEVELS)
+@pytest.mark.parametrize("waved", (False, True), ids=("single", "waved"))
+@pytest.mark.parametrize("impl", SWEEP_IMPLS)
+def test_ragged_sweep_vs_oracle(sweep_managers, impl, waved, skew):
+    from sparkucx_tpu.shuffle.alltoall import backend_supports_ragged
+    if impl == "native" and not backend_supports_ragged():
+        pytest.skip("backend lacks a jax.lax.ragged_all_to_all thunk "
+                    "(alltoall.backend_supports_ragged) — the dense "
+                    "fallback legs of this sweep cover it here")
+    if impl == "pallas":
+        from sparkucx_tpu.ops.pallas.ragged_a2a import interpret_supported
+        if not interpret_supported():
+            pytest.skip("pltpu.InterpretParams unavailable on this jax — "
+                        "remote-DMA interpret simulation cannot run")
+    m = sweep_managers(impl, waved)
+    seed = (SWEEP_IMPLS.index(impl) * 100
+            + SKEW_LEVELS.index(skew) * 10 + int(waved))
+    rng = np.random.default_rng(70_000 + seed)
+    M, R, n = 4, 16, 250
+    sid = 72_000 + seed
+    h = m.register_shuffle(sid, M, R)
+    try:
+        oracle = {}
+        total = 0
+        for mid in range(M):
+            k = _skewed_keys(rng, skew, n)
+            v = rng.integers(0, 1 << 30, size=(n, 2)).astype(np.int32)
+            w = m.get_writer(h, mid)
+            w.write(k, v)
+            w.commit(R)
+            for i, kk in enumerate(k):
+                oracle.setdefault(int(kk), []).append(tuple(v[i]))
+            total += n
+        res = m.read(h)
+        got = {}
+        nrows = 0
+        for r, (ks, vs) in res.partitions():
+            for i, kk in enumerate(ks):
+                got.setdefault(int(kk), []).append(tuple(vs[i]))
+            nrows += len(ks)
+        assert nrows == total
+        assert set(got) == set(oracle)
+        for kk in oracle:
+            assert sorted(got[kk]) == sorted(oracle[kk]), f"key {kk}"
+        # real-bytes accounting invariants, every transport and mode
+        rep = m.report(sid)
+        width = 2 + 2                       # KEY_WORDS + 2 value words
+        assert rep.impl == impl             # resolved, never 'auto'
+        assert rep.payload_bytes == total * width * 4
+        assert rep.pad_ratio >= 1.0
+        assert rep.pad_ratio == pytest.approx(
+            rep.wire_bytes / rep.payload_bytes, abs=1e-5)
+        if impl == "native":
+            assert rep.pad_ratio == 1.0     # real bytes on the wire
+        if waved and impl != "pallas":      # pallas owns its flow control
+            assert rep.waves >= 2, "sweep shape must actually wave"
+            assert sum(rep.wave_payload_rows) == total
+    finally:
+        m.unregister_shuffle(sid)

@@ -1,0 +1,270 @@
+"""Ragged data plane — real-bytes accounting and the ragged wave contract.
+
+The wire contract of ISSUE 6 / ROADMAP item 1: true per-peer row counts
+are what the exchange ships (``plan.RaggedLayout`` is the descriptor both
+the transport dispatch and the report accounting read), so every
+``ExchangeReport`` can say how many wire bytes carried real payload
+(``pad_ratio``). These tests pin the layout formulas per transport, the
+[W] per-wave occupancy split, the collective per-wave agreement's
+fail-fast, and the report fields end-to-end through the manager on the
+dense fallback (the only multi-shard transport XLA:CPU carries).
+"""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.shuffle.plan import (ShufflePlan, RaggedLayout,
+                                       ragged_layout, wave_payload_rows)
+
+
+def _plan(impl, P=8, cap_in=256, cap_out=128, **kw):
+    return ShufflePlan(num_shards=P, num_partitions=16, cap_in=cap_in,
+                       cap_out=cap_out, impl=impl, **kw)
+
+
+# -- layout formulas per transport -----------------------------------------
+def test_layout_native_ships_real_bytes_at_any_skew():
+    """The native ragged collective's wire cost IS the payload — skewing
+    the same total across peers changes nothing (pad_ratio 1.0 by
+    construction)."""
+    for rows in ([100] * 8, [793, 1, 1, 1, 1, 1, 1, 1], [800] + [0] * 7):
+        lay = ragged_layout(_plan("native"), np.asarray(rows), width=10)
+        assert lay.impl == "native"
+        assert lay.wire_rows == lay.payload_rows == 800
+        assert lay.payload_bytes == 800 * 10 * 4
+        assert lay.pad_ratio == 1.0
+
+
+def test_layout_dense_pays_caps_not_occupancy():
+    """Dense ships P segments padded to cap_out from each of P shards —
+    the wire cost is a pure function of the plan, not the real rows."""
+    for rows in ([100] * 8, [800] + [0] * 7):
+        lay = ragged_layout(_plan("dense", cap_out=128), np.asarray(rows),
+                            width=10)
+        assert lay.impl == "dense"
+        assert lay.wire_rows == 8 * 8 * 128
+        assert lay.payload_rows == 800
+        assert lay.pad_ratio == pytest.approx(8 * 8 * 128 / 800, rel=1e-6)
+
+
+def test_layout_gather_replicates_send_buffers():
+    lay = ragged_layout(_plan("gather", cap_in=256), np.asarray([10] * 8),
+                        width=4)
+    assert lay.impl == "gather"
+    assert lay.wire_rows == 8 * 8 * 256
+
+
+def test_layout_pallas_chunk_aligned_upper_bound():
+    """The remote-DMA transport moves chunk-aligned segments: real rows
+    plus at most (chunk-1) alignment rows per (sender, peer) pair."""
+    from sparkucx_tpu.ops.pallas.ragged_a2a import chunk_rows_for
+    lay = ragged_layout(_plan("pallas"), np.asarray([100] * 8), width=10)
+    assert lay.impl == "pallas"
+    chunk = chunk_rows_for(10)
+    assert lay.wire_rows == 800 + 8 * 8 * (chunk - 1)
+    assert lay.pad_ratio > 1.0
+
+
+def test_layout_auto_single_shard_is_local_identity():
+    """1-shard 'auto' takes the local move: no collective, no padding."""
+    lay = ragged_layout(_plan("auto", P=1), np.asarray([640]), width=6)
+    assert lay.impl == "local"
+    assert lay.pad_ratio == 1.0
+    assert lay.wire_bytes == 640 * 6 * 4
+
+
+def test_layout_auto_resolves_through_capability_gate():
+    """'auto' accounting mirrors the dispatch: dense on CPU (no ragged
+    thunk), native wherever the gate says the backend carries the op."""
+    rows = np.asarray([50] * 8)
+    lay = ragged_layout(_plan("auto"), rows, width=4, backend="cpu")
+    assert lay.impl == "dense"
+    from sparkucx_tpu.shuffle.alltoall import has_ragged_all_to_all
+    lay_tpu = ragged_layout(_plan("auto"), rows, width=4, backend="tpu")
+    assert lay_tpu.impl == ("native" if has_ragged_all_to_all()
+                            else "dense")
+
+
+def test_layout_empty_exchange():
+    lay = ragged_layout(_plan("dense"), np.zeros(8, np.int64), width=4)
+    assert lay.payload_bytes == 0 and lay.pad_ratio == 0.0
+    assert isinstance(lay, RaggedLayout)
+
+
+def test_conf_rejects_unknown_impl_naming_key():
+    """Satellite: ONE validation seam — the conf error cites the conf key
+    and the allowed set (shuffle/alltoall.ALLOWED_IMPLS)."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    # construction is the validation checkpoint (config.py fail-fast)
+    with pytest.raises(ValueError, match="spark.shuffle.tpu.a2a.impl"):
+        TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "rdma"},
+                       use_env=False)
+    for ok in ("auto", "native", "dense", "gather", "pallas"):
+        assert TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": ok},
+                              use_env=False).a2a_impl == ok
+
+
+# -- per-wave occupancy split ----------------------------------------------
+def test_wave_payload_rows_clipped_remainders():
+    rows = np.asarray([100, 30, 0, 75])
+    got = wave_payload_rows(rows, wave_rows=32, num_waves=4)
+    # wave i moves rows [32i, 32(i+1)) of each shard's staged sequence
+    want = [32 + 30 + 0 + 32, 32 + 0 + 0 + 32, 32 + 0 + 0 + 11,
+            4 + 0 + 0 + 0]
+    assert got.tolist() == want
+    assert int(got.sum()) == int(rows.sum())
+
+
+def test_wave_payload_rows_total_invariant():
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        rows = rng.integers(0, 500, size=8)
+        wave_rows = int(rng.integers(1, 200))
+        W = max(1, -(-int(rows.max()) // wave_rows))
+        got = wave_payload_rows(rows, wave_rows, W)
+        assert int(got.sum()) == int(rows.sum())
+        assert (got >= 0).all()
+
+
+# -- collective per-wave agreement -----------------------------------------
+def test_agree_wave_sizes_single_process_identity():
+    from sparkucx_tpu.shuffle.distributed import agree_wave_sizes
+    got = agree_wave_sizes(np.asarray([96, 96, 13]))
+    assert got.tolist() == [96, 96, 13]
+
+
+def test_agree_wave_sizes_divergent_view_fails_fast(monkeypatch):
+    """A process whose occupancy view differs (stale size row) must raise
+    — on every process, since the verdict rides the allgather. Simulated
+    here by stubbing the allgather to return divergent proposals."""
+    import sparkucx_tpu.shuffle.distributed as dist
+    monkeypatch.setattr(
+        dist, "allgather_blob",
+        lambda blob: np.stack([np.asarray(blob),
+                               np.asarray(blob) + 1]))
+    with pytest.raises(RuntimeError, match="per-wave occupancy mismatch"):
+        dist.agree_wave_sizes(np.asarray([96, 96, 13]))
+
+
+def test_agree_wave_count_divergent_conf_fails_fast(monkeypatch):
+    """The wave-COUNT agreement (runs on every distributed read) raises
+    on divergent a2a.waveRows conf the same way."""
+    import sparkucx_tpu.shuffle.distributed as dist
+    monkeypatch.setattr(
+        dist, "allgather_blob",
+        lambda blob: np.stack([np.asarray(blob).reshape(-1),
+                               np.asarray(blob).reshape(-1) * 2]))
+    with pytest.raises(RuntimeError, match="wave-count mismatch"):
+        dist.agree_wave_count(3)
+
+
+# -- end-to-end: report accounting through the manager ---------------------
+def _run_job(m, sid, maps=4, R=16, rows=300, val_words=2, rng_seed=0,
+             keys=None, **read_kw):
+    rng = np.random.default_rng(rng_seed)
+    h = m.register_shuffle(sid, maps, R)
+    total = 0
+    for mid in range(maps):
+        k = keys[mid] if keys is not None else \
+            rng.integers(0, 1 << 40, size=rows).astype(np.int64)
+        v = rng.integers(0, 1 << 30,
+                         size=(k.shape[0], val_words)).astype(np.int32)
+        w = m.get_writer(h, mid)
+        w.write(k, v)
+        w.commit(R)
+        total += k.shape[0]
+    res = m.read(h, **read_kw)
+    for r in range(R):
+        res.partition(r)
+    rep = m.report(sid)
+    m.unregister_shuffle(sid)
+    return rep, total
+
+
+def test_report_real_bytes_dense_single_shot(manager_factory):
+    """Dense single-shot: payload is the real staged rows, wire is the
+    plan's P² x cap_out padded cost, pad_ratio their quotient — and
+    bw_gbps divides REAL payload bytes by the group wall (the small-fix
+    half: no padded-cap phantom bandwidth)."""
+    m = manager_factory()
+    metrics = m.node.metrics
+    pay0 = metrics.get("shuffle.payload.bytes")
+    wire0 = metrics.get("shuffle.wire.bytes")
+    rep, total = _run_job(m, 71001, rng_seed=3)
+    width = 2 + 2                                  # KEY_WORDS + val words
+    P = m.node.num_devices
+    assert rep.impl == "dense"
+    assert rep.payload_bytes == total * width * 4
+    cap_out = rep.plan_bucket[1]
+    assert rep.wire_bytes == P * P * cap_out * width * 4
+    assert rep.pad_ratio == pytest.approx(
+        rep.wire_bytes / rep.payload_bytes, abs=1e-5)
+    assert rep.pad_ratio > 1.0
+    assert rep.bw_gbps == round(
+        rep.payload_bytes / (rep.group_ms * 1e6), 6)
+    # cumulative counters mirror the per-report figures
+    assert metrics.get("shuffle.payload.bytes") - pay0 \
+        == rep.payload_bytes
+    assert metrics.get("shuffle.wire.bytes") - wire0 == rep.wire_bytes
+
+
+def test_report_wire_refreshed_after_overflow_regrow(manager_factory):
+    """An overflow retry regrows cap_out; the settled report must charge
+    the wire at the FINAL plan's capacities, not the first attempt's."""
+    m = manager_factory({"spark.shuffle.tpu.a2a.capacityFactor": "1.05",
+                         "spark.shuffle.tpu.a2a.capBuckets": "false"})
+    # one-hot: every key lands in one partition -> one receiving shard
+    # overflows the balanced share and the plan must regrow
+    keys = [np.full(400, 7, dtype=np.int64) for _ in range(4)]
+    rep, total = _run_job(m, 71002, keys=keys)
+    assert rep.retries >= 1
+    P = m.node.num_devices
+    width = 4
+    assert rep.payload_bytes == total * width * 4
+    # wire reflects a cap at least one doubling past the initial bucket
+    assert rep.wire_bytes >= P * P * rep.plan_bucket[1] * 2 * width * 4
+    assert rep.pad_ratio == pytest.approx(
+        rep.wire_bytes / rep.payload_bytes, abs=1e-5)
+
+
+def test_report_real_bytes_waved(manager_factory):
+    """Waved reads: the [W] real per-wave rows ride the report, their sum
+    is the global payload, and the wire charges every wave the wave
+    plan's padded cost (dense) — wire == W x P² x wave cap_out."""
+    m = manager_factory({"spark.shuffle.tpu.a2a.waveRows": "48"})
+    rep, total = _run_job(m, 71003, rows=220, rng_seed=5)
+    assert rep.waves >= 2
+    assert len(rep.wave_payload_rows) == rep.waves
+    assert sum(rep.wave_payload_rows) == total == rep.rows_global
+    width = 4
+    P = m.node.num_devices
+    assert rep.payload_bytes == total * width * 4
+    wave_cap_out = rep.plan_bucket[1]       # waved: wave plan bucket
+    assert rep.wire_bytes == rep.waves * P * P * wave_cap_out * width * 4
+    assert rep.pad_ratio == pytest.approx(
+        rep.wire_bytes / rep.payload_bytes, abs=1e-5)
+    assert rep.bw_gbps == round(
+        rep.payload_bytes / (rep.group_ms * 1e6), 6)
+
+
+def test_waved_report_native_accounting_is_real_bytes():
+    """The waved wire formula through a ragged-capable plan charges each
+    wave its REAL rows (unit-level: CPU has no native thunk to run)."""
+    from sparkucx_tpu.shuffle.manager import (ExchangeReport,
+                                              TpuShuffleManager)
+    rep = ExchangeReport(shuffle_id=1, num_maps=1, num_partitions=8,
+                         partitioner="hash")
+    rep.payload_bytes = 300 * 4 * 4
+    wplan = _plan("native", cap_in=128, cap_out=64)
+    TpuShuffleManager._set_wave_wire(rep, wplan, [128, 128, 44], width=4)
+    assert rep.wire_bytes == 300 * 4 * 4
+    assert rep.pad_ratio == 1.0
+
+
+def test_report_to_dict_carries_ragged_fields(manager_factory):
+    rep, _ = _run_job(manager_factory(), 71004, maps=2, rows=50)
+    d = rep.to_dict()
+    for k in ("payload_bytes", "wire_bytes", "pad_ratio",
+              "wave_payload_rows", "impl"):
+        assert k in d
+    assert d["impl"] == "dense"          # resolved transport, never 'auto'
