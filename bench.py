@@ -1962,6 +1962,277 @@ def stage_ragged(args) -> int:
     return 0 if out["ok"] else 2
 
 
+def chaos_measure(rows_per_map=1 << 12, maps=4, partitions=16,
+                  val_words=4, impls=("dense",), timeout_ms=2000.0,
+                  seed=0):
+    """The fault-injection matrix behind ``--stage chaos``: every armed
+    FaultInjector site x failure.policy (failfast|replay) x read mode
+    (single-shot|waved) x impl, each cell verified hang-free and
+    oracle-correct.
+
+    Cell contract (the acceptance bar of the robustness arc): with ONE
+    fault armed, a read either (a) surfaces a TYPED transient error
+    within the deadline envelope — failfast, the reference's
+    FetchFailed-to-Spark posture — after which a clean re-read returns
+    oracle bytes, or (b) transparently absorbs the fault — replay policy
+    for exchange-path faults (``ExchangeReport.replays >= 1``, same
+    compiled plan family as the clean run), the retry plane for
+    metadata-fetch faults, re-staging for map-commit faults — and
+    returns oracle bytes directly. No cell may block past
+    ``failure.collectiveTimeoutMs`` + probe slack. A separate watchdog
+    drill runs the deadline fence against a genuinely hung step and
+    checks PeerLostError lands on time with the leaked-thread census
+    accounting for the abandoned worker."""
+    import time as _time
+
+    import numpy as np
+
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.failures import (InjectedFault,
+                                               PeerLostError,
+                                               TransientError)
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.runtime.watchdog import Watchdog
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+
+    rng = np.random.default_rng(seed)
+    keys = [rng.integers(-(1 << 62), 1 << 62, size=rows_per_map)
+            for _ in range(maps)]
+    vals = [rng.integers(-(1 << 30), 1 << 30,
+                         size=(rows_per_map, val_words)).astype(np.int32)
+            for _ in range(maps)]
+    total_rows = rows_per_map * maps
+    # ~4 waves over the balanced per-shard share (8 virtual devices)
+    wave_rows = max(64, rows_per_map * maps // 8 // 4)
+    sid_box = [80000]
+
+    def stage(mgr):
+        sid = sid_box[0]
+        sid_box[0] += 1
+        h = mgr.register_shuffle(sid, maps, partitions)
+        for m in range(maps):
+            w = mgr.get_writer(h, m)
+            w.write(keys[m], vals[m])
+            w.commit(partitions)
+        return h
+
+    def canonical(res):
+        """Per-partition rows sorted by (key, value row) — the host
+        oracle identity: partitioning and content, order-free."""
+        out = []
+        rows = 0
+        for r in range(partitions):
+            k, v = res.partition(r)
+            rows += k.shape[0]
+            order = np.lexsort(tuple(v.T[::-1]) + (k,)) if k.size \
+                else np.array([], dtype=np.int64)
+            out.append((k[order], v[order]))
+        return rows, out
+
+    def same(a, b):
+        ra, pa = a
+        rb, pb = b
+        if ra != rb:
+            return False
+        return all(np.array_equal(ka, kb) and np.array_equal(va, vb)
+                   for (ka, va), (kb, vb) in zip(pa, pb))
+
+    # the per-cell wall ceiling: the collective deadline plus the probe
+    # join (HealthMonitor deadline + the watchdog's slack second) plus
+    # generous CPU-jit slack — the "hang-free" line every cell must beat
+    envelope_ms = timeout_ms + timeout_ms + 1000.0 + 30_000.0
+
+    cells = []
+    ok = True
+    for impl in impls:
+        for mode in ("single", "waved"):
+            sites = ["publish", "fetch", "exchange"]
+            if mode == "waved":
+                sites.append("wave")
+            for policy in ("failfast", "replay"):
+                conf_map = {
+                    "spark.shuffle.tpu.a2a.impl": impl,
+                    "spark.shuffle.tpu.failure.policy": policy,
+                    "spark.shuffle.tpu.failure.replayBudget": "2",
+                    "spark.shuffle.tpu.failure.collectiveTimeoutMs":
+                        str(timeout_ms),
+                    # bound the probe join too (network.timeoutMs sizes
+                    # HealthMonitor's deadline, 120 s default) — the
+                    # envelope below budgets timeout_ms for it, same
+                    # conf discipline as buildlib/e2e_worker.py
+                    "spark.shuffle.tpu.network.timeoutMs":
+                        str(int(timeout_ms)),
+                }
+                # CI telemetry path (same env contract as tests/
+                # conftest.py tier-1): with the dir set, every cell runs
+                # with the flight recorder ON so a failing cell leaves
+                # its postmortems where the workflow uploads them
+                ci_dir = os.environ.get("SPARKUCX_TPU_CI_TELEMETRY_DIR")
+                if ci_dir:
+                    conf_map["spark.shuffle.tpu.flightRecorder.enabled"] \
+                        = "true"
+                    conf_map["spark.shuffle.tpu.flightRecorder.dir"] = \
+                        ci_dir
+                if mode == "waved":
+                    conf_map["spark.shuffle.tpu.a2a.waveRows"] = \
+                        str(wave_rows)
+                    conf_map["spark.shuffle.tpu.a2a.waveDepth"] = "2"
+                conf = TpuShuffleConf(conf_map, use_env=False)
+                node = TpuNode.start(conf)
+                mgr = TpuShuffleManager(node, conf)
+                try:
+                    h0 = stage(mgr)
+                    res = mgr.read(h0)
+                    oracle = canonical(res)
+                    clean_rep = mgr.report(h0.shuffle_id)
+                    clean_family = clean_rep.plan_family
+                    mgr.unregister_shuffle(h0.shuffle_id)
+                    assert oracle[0] == total_rows, \
+                        f"clean read lost rows: {oracle[0]}"
+                    for site in sites:
+                        cell = {"impl": impl, "mode": mode,
+                                "policy": policy, "site": site}
+                        t0 = _time.perf_counter()
+                        try:
+                            node.faults.arm(site, fail_count=1)
+                            if site == "publish":
+                                # map-commit fault: staging dies typed;
+                                # the host framework re-runs the map
+                                # task — here, a fresh staging pass
+                                try:
+                                    stage(mgr)
+                                    cell["outcome"] = "no_fire"
+                                except InjectedFault:
+                                    cell["outcome"] = "staging_error"
+                                node.faults.disarm(site)
+                                h = stage(mgr)
+                                got = canonical(mgr.read(h))
+                                cell["bytes_ok"] = same(got, oracle)
+                                cell["replays"] = 0
+                            else:
+                                h = stage(mgr)
+                                try:
+                                    got = canonical(mgr.read(h))
+                                    rep = mgr.report(h.shuffle_id)
+                                    cell["replays"] = int(rep.replays)
+                                    cell["bytes_ok"] = same(got, oracle)
+                                    cell["family_stable"] = \
+                                        rep.plan_family == clean_family
+                                    if site == "fetch":
+                                        # one transient is the retry
+                                        # plane's job under EITHER policy
+                                        cell["outcome"] = "absorbed_retry"
+                                    else:
+                                        cell["outcome"] = "replayed" \
+                                            if rep.replays else "no_fire"
+                                except TransientError as e:
+                                    cell["outcome"] = "typed_error"
+                                    cell["error_type"] = type(e).__name__
+                                    node.faults.disarm(site)
+                                    got = canonical(mgr.read(h))
+                                    cell["bytes_ok"] = same(got, oracle)
+                                    cell["replays"] = 0
+                            fired = node.faults.stats().get(site, (0, 0))
+                            cell["fault_fired"] = fired[1] >= 1
+                        finally:
+                            node.faults.disarm(site)
+                        cell["wall_ms"] = round(
+                            (_time.perf_counter() - t0) * 1e3, 1)
+                        cell["hang_free"] = cell["wall_ms"] < envelope_ms
+                        expect = {
+                            "publish": ("staging_error",),
+                            "fetch": ("absorbed_retry",),
+                            "exchange": ("replayed",)
+                            if policy == "replay" else ("typed_error",),
+                            "wave": ("replayed",)
+                            if policy == "replay" else ("typed_error",),
+                        }[site]
+                        cell["ok"] = bool(
+                            cell["outcome"] in expect
+                            and cell["fault_fired"]
+                            and cell["hang_free"]
+                            and cell.get("bytes_ok", False)
+                            # the replay-stability contract: an absorbed
+                            # fault must land on the SAME compiled plan
+                            # family as the clean run (learned caps
+                            # carry over) — a recompiling replay is a
+                            # regression this gate must catch
+                            and cell.get("family_stable", True)
+                            and (cell["outcome"] != "replayed"
+                                 or cell["replays"] >= 1))
+                        ok &= cell["ok"]
+                        cells.append(cell)
+                finally:
+                    mgr.stop()
+                    node.close()
+
+    # watchdog drill: a genuinely hung step must become PeerLostError
+    # within the deadline, and the abandoned worker must show up in the
+    # leaked census — the in-process stand-in for the killed-peer e2e
+    # drill (buildlib/e2e_worker.py job 8 runs the real thing)
+    wd = Watchdog(200.0)
+    t0 = _time.perf_counter()
+    try:
+        wd.call(_time.sleep, 5.0, what="chaos drill hang")
+        hung_outcome = "returned"
+    except PeerLostError:
+        hung_outcome = "peer_lost"
+    wd_wall = (_time.perf_counter() - t0) * 1e3
+    watchdog = {
+        "timeout_ms": 200.0,
+        "outcome": hung_outcome,
+        "wall_ms": round(wd_wall, 1),
+        "on_time": wd_wall < 200.0 + 2000.0,
+        "leaked_threads": wd.leaked(),
+        "armed_after": len(wd.armed()),
+        "ok": bool(hung_outcome == "peer_lost"
+                   and wd_wall < 200.0 + 2000.0
+                   and wd.leaked() == 1
+                   and not wd.armed()),
+    }
+    ok &= watchdog["ok"]
+
+    return {
+        "shape": {"rows_per_map": rows_per_map, "maps": maps,
+                  "partitions": partitions, "val_words": val_words,
+                  "wave_rows": wave_rows, "impls": list(impls),
+                  "collective_timeout_ms": timeout_ms},
+        "cells": cells,
+        "cells_ok": sum(1 for c in cells if c["ok"]),
+        "cells_total": len(cells),
+        "watchdog": watchdog,
+        "ok": bool(ok),
+    }
+
+
+def stage_chaos(args) -> int:
+    """``--stage chaos``: run the fault-injection matrix (FaultInjector
+    sites x failfast/replay x single-shot/waved x impl) plus the
+    watchdog hang drill, and write bench_runs/chaos.json — a committed
+    CI regress baseline like pipeline.json. Every cell must be
+    hang-free and end in a typed error or oracle-correct bytes; exit 2
+    otherwise. ``--smoke`` keeps the CI shape (small rows, dense only)."""
+    impls = ("dense",) if args.smoke or args.a2a_impl is None \
+        else (args.a2a_impl,)
+    detail = chaos_measure(
+        rows_per_map=1 << (args.rows_log2 or (10 if args.smoke else 12)),
+        val_words=args.val_words, impls=impls)
+    out = {"metric": "chaos", "detail": detail, "ok": detail["ok"]}
+    out["telemetry"] = _telemetry_blob()
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_runs", "chaos.json")
+    try:
+        os.makedirs(os.path.dirname(artifact), exist_ok=True)
+        with open(artifact, "w") as f:
+            json.dump(out, f, indent=1)
+        out["artifact"] = os.path.relpath(
+            artifact, os.path.dirname(os.path.abspath(__file__)))
+    except OSError as e:
+        out["artifact_error"] = str(e)[:200]
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 2
+
+
 # -- regression gating (--stage regress) ------------------------------------
 # Suffix → direction heuristics over dotted metric paths. -1 = lower is
 # better (an increase is a regression), +1 = higher is better. Unknown
@@ -2253,7 +2524,7 @@ def main() -> None:
                          "the conf default)")
     ap.add_argument("--stage", default=None,
                     choices=("coldstart", "obs-overhead", "regress",
-                             "pipeline", "devplane", "ragged"),
+                             "pipeline", "devplane", "ragged", "chaos"),
                     help="run ONE dedicated stage instead of the ladder: "
                          "coldstart = compile-cost artifact (persistent "
                          "cache cold-vs-warm across processes + "
@@ -2271,7 +2542,11 @@ def main() -> None:
                          "real-bytes A/B across a skew sweep (pad_ratio "
                          "~= 1.0 on the ragged path vs dense "
                          "skew-proportional waste, GB/s on real payload "
-                         "bytes). All CPU-measurable")
+                         "bytes); chaos = fault-injection matrix (sites "
+                         "x failfast/replay x single/waved x impl) + "
+                         "watchdog hang drill — every cell hang-free "
+                         "and typed-error or oracle-correct. All "
+                         "CPU-measurable")
     ap.add_argument("--baseline", default=None,
                     help="regress stage: prior artifact to diff against "
                          "(default bench_runs/obs_overhead.json)")
@@ -2322,7 +2597,8 @@ def main() -> None:
                   "regress": stage_regress,
                   "pipeline": stage_pipeline,
                   "devplane": stage_devplane,
-                  "ragged": stage_ragged}[args.stage](args))
+                  "ragged": stage_ragged,
+                  "chaos": stage_chaos}[args.stage](args))
 
     fallback = None
     if args.platform == "auto" and not args.no_fallback:

@@ -21,6 +21,21 @@ collective. The controller then re-runs the WHOLE map set on the
 survivors in a fresh world (run_cluster.py --recovery), the
 stage-resubmission analog: JAX's process set is static, so membership
 change = new world + new epoch (SURVEY.md §7 hard part (e)).
+
+Chaos mode (SPARKUCX_TPU_CHAOS_PHASE=1): the killed-peer WATCHDOG
+drill — the hard half of executor loss, where the survivors get NO
+notification at all. All members stage + report STAGED; the survivors
+then enter the collective read immediately while the victim never
+joins (and is SIGKILLed by the controller mid-rendezvous). Without the
+deadline fence every survivor would park in the metadata allgather
+forever; with ``failure.collectiveTimeoutMs`` armed the watchdog must
+convert the hang into :class:`PeerLostError` INSIDE the deadline
+envelope (timeout + probe + slack) on every survivor — the
+UCP_ERR_HANDLING_MODE_PEER verdict (ref: UcxNode.java:134), rebuilt
+host-side. The controller then re-runs the whole map set on the
+survivors in a fresh world (the remesh-and-replay half: distributed
+replay IS re-bootstrap + ledger-served re-run, see
+manager._replay_after_failure) and verifies oracle-correct bytes.
 """
 
 from __future__ import annotations
@@ -36,6 +51,7 @@ def main() -> int:
     coordinator = os.environ["SPARKUCX_TPU_COORDINATOR"]
     devices_per_proc = int(os.environ.get("SPARKUCX_TPU_LOCAL_DEVICES", "4"))
     recovery_phase = os.environ.get("SPARKUCX_TPU_RECOVERY_PHASE", "")
+    chaos_phase = os.environ.get("SPARKUCX_TPU_CHAOS_PHASE", "")
     victim = int(os.environ.get("SPARKUCX_TPU_VICTIM", "-1"))
     loss_file = os.environ.get("SPARKUCX_TPU_LOSS_FILE", "")
 
@@ -57,7 +73,7 @@ def main() -> int:
     from sparkucx_tpu.shuffle.writer import _hash32_np
 
     num_slices = int(os.environ.get("SPARKUCX_TPU_NUM_SLICES", "1"))
-    conf = TpuShuffleConf({
+    conf_map = {
         "spark.shuffle.tpu.coordinator.address": coordinator,
         "spark.shuffle.tpu.numProcesses": str(nprocs),
         "spark.shuffle.tpu.a2a.impl": "dense",
@@ -67,7 +83,19 @@ def main() -> int:
         # span recording on: the telemetry job below gathers every
         # process's spans and proves the merged timeline clock-aligns
         "spark.shuffle.tpu.trace.enabled": "true",
-    }, use_env=False)
+    }
+    if chaos_phase == "1":
+        # the drill's whole point: a deadline on every rendezvous. The
+        # probe bound (network.timeoutMs, which sizes HealthMonitor's
+        # per-device join) stays ABOVE the collective deadline so the
+        # watchdog, not a result-wait timeout, owns the verdict; both
+        # well under the controller's phase budget.
+        conf_map.update({
+            "spark.shuffle.tpu.failure.collectiveTimeoutMs":
+                os.environ.get("SPARKUCX_TPU_CHAOS_TIMEOUT_MS", "6000"),
+            "spark.shuffle.tpu.network.timeoutMs": "10000",
+        })
+    conf = TpuShuffleConf(conf_map, use_env=False)
     try:
         node = TpuNode.start(conf, distributed=True, process_id=proc_id)
     except Exception as e:
@@ -180,6 +208,52 @@ def main() -> int:
         # the old world's collectives are unusable with a dead member;
         # exit without the collective shutdown barrier (orphaned world),
         # the controller re-runs the job on a fresh one
+        os._exit(0)
+
+    if chaos_phase == "1":
+        from sparkucx_tpu.runtime.failures import PeerLostError
+
+        # Unlike the recovery drill there is NO loss notification: the
+        # survivors walk straight into the collective read and the
+        # victim never joins. The deadline fence is the only thing
+        # between them and an eternal park in the metadata allgather.
+        print(f"worker {proc_id}: STAGED", flush=True)
+        deadline = time.monotonic() + 300
+        if proc_id == victim:
+            # never enter the read; wait to be SIGKILLed mid-rendezvous
+            while time.monotonic() < deadline:
+                time.sleep(0.1)
+            print("ERROR: victim was never killed", flush=True)
+            os._exit(3)
+        t0 = time.monotonic()
+        try:
+            mgr.read(h)
+            print("ERROR: collective read returned with a dead peer",
+                  flush=True)
+            os._exit(4)
+        except PeerLostError as e:
+            wall_ms = (time.monotonic() - t0) * 1e3
+            # the acceptance envelope: collective deadline + probe join
+            # (probe bound + the watchdog's slack second) + CPU-jit slack
+            envelope_ms = (conf.collective_timeout_ms
+                           + conf.connection_timeout_ms + 1000.0
+                           + 30_000.0)
+            if wall_ms > envelope_ms:
+                print(f"ERROR: PeerLostError landed LATE: {wall_ms:.0f}"
+                      f" ms > envelope {envelope_ms:.0f} ms", flush=True)
+                os._exit(4)
+            if node.watchdog.expiries < 1:
+                print("ERROR: PeerLostError without a watchdog expiry",
+                      flush=True)
+                os._exit(4)
+            print(f"worker {proc_id}: PEER-LOST FENCED OK "
+                  f"({wall_ms:.0f} ms, {node.watchdog.leaked()} leaked "
+                  f"worker(s); {e})", flush=True)
+        # orphaned world (dead member, abandoned collective): exit
+        # without the shutdown barrier; the controller remeshes by
+        # re-running the map set on a fresh survivor world and verifies
+        # oracle bytes there — distributed replay IS re-bootstrap + the
+        # ledger-served re-run (manager._replay_after_failure)
         os._exit(0)
 
     res = mgr.read(h)               # collective across all processes

@@ -102,6 +102,68 @@ def reap(procs, logs, deadline, expect_rc=None) -> bool:
     return ok
 
 
+def wait_all_staged(procs, logs, nprocs, deadline) -> bool:
+    """Block until every member's log reports STAGED (scanning SEPARATE
+    read handles: Popen(stdout=logf) shares the file description with
+    the child, so seeking the writer's handle would corrupt the log).
+    False when a member dies before staging or the deadline passes."""
+    staged = set()
+    while len(staged) < nprocs:
+        for pid, lf in enumerate(logs):
+            if pid in staged:
+                continue
+            with open(lf.name) as rf:
+                if "STAGED" in rf.read():
+                    staged.add(pid)
+        dead = [pid for pid, p in enumerate(procs)
+                if pid not in staged and p.poll() is not None]
+        if dead or time.monotonic() > deadline:
+            print(f"staging failed: staged={sorted(staged)} "
+                  f"dead-before-staging={dead}")
+            reap(procs, logs, time.monotonic() + 5)   # dump logs
+            return False
+        time.sleep(0.1)
+    return True
+
+
+def rerun_on_survivors(args, num_maps, all_logs) -> bool:
+    """The remesh-and-replay half shared by the recovery and chaos
+    drills: a fresh world of nprocs-1 survivors re-runs the SAME map set
+    (lost maps redistribute, like Spark rescheduling a dead executor's
+    tasks) and the workers verify every partition against the host
+    oracle. The back-to-back rendezvous is the known load-sensitive
+    site — a classified bootstrap flake retries once on a fresh port;
+    anything else fails outright."""
+    procs, logs = [], []
+    try:
+        for attempt in range(2):
+            procs, logs = [], []
+            coordinator = f"localhost:{free_port()}"
+            for pid in range(args.nprocs - 1):
+                p, f = spawn(pid, args.nprocs - 1, coordinator,
+                             args.devices, 1,
+                             {"SPARKUCX_TPU_NUM_MAPS": str(num_maps)})
+                procs.append(p)
+                logs.append(f)
+                all_logs.append(f)
+            # fresh budget per attempt: a first attempt that hung to the
+            # shared deadline would leave the retry ~1 s and guarantee
+            # its failure — exactly the flake the retry exists to absorb
+            ok = reap(procs, logs, time.monotonic() + args.timeout)
+            if ok or attempt == 1 or not rendezvous_failed(logs):
+                break
+            print("survivor-rerun bootstrap flake (RENDEZVOUS FAILED in "
+                  "a worker log); retrying once on a fresh port")
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        return ok
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
 def run_recovery(args) -> int:
     """Worker-loss drill: lose a member mid-job, fence the stale epoch on
     the survivors, re-run the whole map set on a fresh (smaller) world —
@@ -131,25 +193,8 @@ def run_recovery(args) -> int:
         # controller then notices the death (the driver's RPC-disconnect
         # callback analog, ref: rpc/RpcConnectionCallback.java:91-98) and
         # signals the survivors.
-        # Scan through SEPARATE read handles: Popen(stdout=logf) shares the
-        # file description (and offset) with the child, so seeking the
-        # writer's handle mid-run would corrupt the log.
-        staged = set()
-        while len(staged) < args.nprocs:
-            for pid, lf in enumerate(logs):
-                if pid in staged:
-                    continue
-                with open(lf.name) as rf:
-                    if "STAGED" in rf.read():
-                        staged.add(pid)
-            dead = [pid for pid, p in enumerate(procs)
-                    if pid not in staged and p.poll() is not None]
-            if dead or time.monotonic() > deadline:
-                print(f"staging failed: staged={sorted(staged)} "
-                      f"dead-before-staging={dead}")
-                reap(procs, logs, time.monotonic() + 5)   # dump logs
-                return 1
-            time.sleep(0.1)
+        if not wait_all_staged(procs, logs, args.nprocs, deadline):
+            return 1
         procs[victim].kill()
         procs[victim].wait()
         with open(loss_file, "w") as f:
@@ -170,32 +215,9 @@ def run_recovery(args) -> int:
             print("CLUSTER RECOVERY: FAIL (phase 1)")
             return 1
 
-        # phase 2: fresh world of survivors re-runs the SAME map set
-        # (lost maps redistribute) and verifies the full result. The
-        # second back-to-back rendezvous is the known load-sensitive
-        # site — a classified bootstrap flake retries once on a fresh
-        # port; anything else fails outright.
-        for attempt in range(2):
-            procs, logs = [], []
-            coordinator = f"localhost:{free_port()}"
-            for pid in range(args.nprocs - 1):
-                p, f = spawn(pid, args.nprocs - 1, coordinator,
-                             args.devices, 1,
-                             {"SPARKUCX_TPU_NUM_MAPS": str(num_maps)})
-                procs.append(p)
-                logs.append(f)
-                all_logs.append(f)
-            # fresh budget per attempt: a first attempt that hung to the
-            # shared deadline would leave the retry ~1 s and guarantee
-            # its failure — exactly the flake the retry exists to absorb
-            ok = reap(procs, logs, time.monotonic() + args.timeout)
-            if ok or attempt == 1 or not rendezvous_failed(logs):
-                break
-            print("phase-2 bootstrap flake (RENDEZVOUS FAILED in a "
-                  "worker log); retrying once on a fresh port")
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
+        # phase 2: fresh world of survivors re-runs the SAME map set and
+        # verifies the full result
+        ok = rerun_on_survivors(args, num_maps, all_logs)
         print("CLUSTER RECOVERY:", "PASS" if ok else "FAIL")
         return 0 if ok else 1
     finally:
@@ -212,6 +234,75 @@ def run_recovery(args) -> int:
         shutil.rmtree(loss_dir, ignore_errors=True)
 
 
+def run_chaos(args) -> int:
+    """Killed-peer WATCHDOG drill (job 8): lose a member WITHOUT any
+    notification while the survivors are already inside the collective
+    read — the failure class the recovery drill's loss-file signal
+    deliberately avoids. Phase 1 asserts every survivor converts the
+    hang into PeerLostError inside the deadline envelope
+    (failure.collectiveTimeoutMs + probe + slack) and exits clean;
+    phase 2 re-runs the whole map set on a fresh survivor world and
+    verifies oracle-correct bytes — detect (deadline) -> probe ->
+    remesh (fresh world) -> replay -> verify."""
+    assert args.nprocs >= 3, "chaos drill needs >= 3 processes"
+    victim = args.nprocs - 1
+    num_maps = 2 * args.nprocs
+    deadline = time.monotonic() + args.timeout
+    procs, logs = [], []
+    all_logs = []                 # both phases; the finally cleans these
+    try:
+        # phase 1: full membership; the victim parks after staging and
+        # is SIGKILLed while the survivors sit in the fenced rendezvous
+        coordinator = f"localhost:{free_port()}"
+        for pid in range(args.nprocs):
+            p, f = spawn(pid, args.nprocs, coordinator, args.devices, 1,
+                         {"SPARKUCX_TPU_CHAOS_PHASE": "1",
+                          "SPARKUCX_TPU_VICTIM": str(victim),
+                          "SPARKUCX_TPU_NUM_MAPS": str(num_maps)})
+            procs.append(p)
+            logs.append(f)
+            all_logs.append(f)
+        if not wait_all_staged(procs, logs, args.nprocs, deadline):
+            return 1
+        # survivors are now entering (or already parked in) the
+        # collective read; give the park a moment to be real, then kill
+        time.sleep(1.0)
+        procs[victim].kill()
+        procs[victim].wait()
+        import signal
+        ok = reap(procs, logs, deadline,
+                  expect_rc={victim: -signal.SIGKILL})
+        fenced = 0
+        for pid, lf in enumerate(logs):
+            if pid == victim:
+                continue
+            lf.seek(0)
+            fenced += 1 if "PEER-LOST FENCED OK" in lf.read() else 0
+        if fenced != args.nprocs - 1:
+            print(f"only {fenced}/{args.nprocs - 1} survivors hit the "
+                  f"deadline fence")
+            ok = False
+        if not ok:
+            print("CLUSTER CHAOS: FAIL (phase 1)")
+            return 1
+
+        # phase 2: remesh-and-replay — fresh survivor world, same map
+        # set, oracle-verified bytes
+        ok = rerun_on_survivors(args, num_maps, all_logs)
+        print("CLUSTER CHAOS:", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in all_logs:
+            try:
+                f.close()
+                os.unlink(f.name)
+            except OSError:
+                pass
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nprocs", type=int, default=2)
@@ -222,11 +313,18 @@ def main() -> int:
     ap.add_argument("--recovery", action="store_true",
                     help="worker-loss drill: kill one member mid-job, "
                          "fence + re-run on the survivors")
+    ap.add_argument("--chaos", action="store_true",
+                    help="killed-peer watchdog drill: kill one member "
+                         "MID-RENDEZVOUS with no notification; the "
+                         "survivors must hit the collective deadline "
+                         "(PeerLostError), then re-run on a fresh world")
     ap.add_argument("--timeout", type=float, default=480.0)
     args = ap.parse_args()
 
     if args.recovery:
         return run_recovery(args)
+    if args.chaos:
+        return run_chaos(args)
 
     procs, all_logs = [], []
     try:

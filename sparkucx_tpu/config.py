@@ -93,7 +93,9 @@ class TpuShuffleConf:
         "max_bytes_in_flight", "compile_cache_enabled",
         "compile_cache_dir", "compile_min_compile_time_secs",
         "mesh_ici_axis", "mesh_dcn_axis", "num_slices", "num_processes",
-        "cores_per_process", "connection_timeout_ms")
+        "cores_per_process", "connection_timeout_ms",
+        "collective_timeout_ms", "failure_policy", "replay_budget",
+        "max_backoff_ms")
     # Namespace keys consumed OUTSIDE config.py (grep-verified), plus the
     # prefix families. A spark.shuffle.tpu.* key matching none of these is
     # a probable typo and gets a warning (not an error: a host engine may
@@ -615,6 +617,64 @@ class TpuShuffleConf:
         """Peer/metadata wait timeout (ref: UcxWorkerWrapper.scala:133-140,
         spark.network.timeout)."""
         return self.get_int("network.timeoutMs", 120_000)
+
+    @property
+    def collective_timeout_ms(self) -> float:
+        """Deadline on every distributed rendezvous and in-flight
+        collective wait (runtime/watchdog.py): past it, the watchdog
+        probes device liveness, dumps a flight postmortem and raises
+        PeerLostError instead of hanging the survivors on a dead peer —
+        the UCP_ERR_HANDLING_MODE_PEER analog (ref: UcxNode.java:134).
+        0 (default) = off; also caps the retry plane's total backoff
+        budget when set."""
+        v = self.get_float("failure.collectiveTimeoutMs", 0.0)
+        if v < 0:
+            raise ValueError(
+                f"spark.shuffle.tpu.failure.collectiveTimeoutMs={v}: "
+                f"want >= 0 (0 = off)")
+        return v
+
+    @property
+    def failure_policy(self) -> str:
+        """What read()/submit() do when an exchange dies or a remesh
+        invalidates its handle: ``failfast`` (default — typed errors
+        surface to the caller; the host framework owns recovery, the
+        reference's Spark-delegation posture) or ``replay`` — the
+        manager keeps a recovery ledger across epoch bumps (shuffles
+        whose local staged writer blocks are intact re-register under
+        the new epoch) and transparently re-plans + re-runs the exchange
+        on the surviving mesh, up to ``failure.replayBudget`` times (the
+        FetchFailed -> stage-retry analog, in-framework)."""
+        v = self._get("failure.policy", "failfast")
+        if v not in ("failfast", "replay"):
+            raise ValueError(
+                f"spark.shuffle.tpu.failure.policy={v!r}: want "
+                f"failfast|replay")
+        return v
+
+    @property
+    def replay_budget(self) -> int:
+        """Replays a shuffle may spend under ``failure.policy=replay``
+        (stale-handle re-pins after a remesh plus transient-failure
+        re-runs, cumulative per shuffle). Exhaustion falls back to
+        failfast — the bounded-stage-retry analog of
+        spark.stage.maxConsecutiveAttempts."""
+        v = self.get_int("failure.replayBudget", 2)
+        if v < 0:
+            raise ValueError(
+                f"spark.shuffle.tpu.failure.replayBudget={v}: want >= 0")
+        return v
+
+    @property
+    def max_backoff_ms(self) -> float:
+        """Ceiling on any single retry backoff sleep (RetryPolicy's
+        decorrelated-jitter schedule grows toward it). Keeps a long
+        retry budget from degenerating into multi-minute sleeps."""
+        v = self.get_float("failure.maxBackoffMs", 10_000.0)
+        if v <= 0:
+            raise ValueError(
+                f"spark.shuffle.tpu.failure.maxBackoffMs={v}: want > 0")
+        return v
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"TpuShuffleConf({dict(self.items())})"

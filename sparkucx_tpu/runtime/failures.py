@@ -47,6 +47,14 @@ from sparkucx_tpu.utils.metrics import GLOBAL_METRICS, H_RETRY_MS
 
 log = get_logger("runtime.failures")
 
+# Per-process jitter entropy (RetryPolicy decorrelated backoff): seeded
+# from OS entropy so N SPMD processes draw DIFFERENT schedules — the
+# whole point; a pid/time seed could still collide across a simultaneous
+# fleet restart.
+import random as _random  # noqa: E402
+
+_JITTER_RNG = _random.Random()
+
 
 # -- errors ---------------------------------------------------------------
 class TransientError(RuntimeError):
@@ -55,6 +63,17 @@ class TransientError(RuntimeError):
 
 class InjectedFault(TransientError):
     """Raised by the fault injector at an armed site."""
+
+
+class PeerLostError(TransientError):
+    """A collective outlived ``failure.collectiveTimeoutMs``: a peer is
+    unreachable or dead (runtime/watchdog.py). The TPU analog of the
+    reference's peer-error-handling verdict — UCX endpoints in
+    UCP_ERR_HANDLING_MODE_PEER turn a dead peer into an endpoint error
+    (ref: UcxNode.java:134) that Spark converts into FetchFailed + stage
+    retry; here the watchdog turns a hang into this TRANSIENT error so
+    the replay policy (shuffle/manager.py) or the recovery controller
+    can remesh and re-run instead of deadlocking the survivors."""
 
 
 class StaleEpochError(RuntimeError):
@@ -428,7 +447,24 @@ class RetryPolicy:
     max_attempts: int = 3
     backoff_ms: float = 10.0
     backoff_factor: float = 2.0
+    # Decorrelated jitter (default on): every SPMD process runs the SAME
+    # deterministic policy, so a cluster-wide transient blip used to wake
+    # all N processes on the identical schedule — a synchronized retry
+    # storm hammering whatever just recovered. Jittered, each process
+    # draws its next delay from [backoff_ms, 3*previous] (the classic
+    # decorrelated-jitter recurrence), capped at ``max_backoff_ms``.
+    jitter: bool = True
+    max_backoff_ms: float = 10_000.0
+    # Optional TOTAL budget across all attempts (failure.collectiveTimeoutMs
+    # when the watchdog is armed): a retry schedule must not outlive the
+    # collective deadline, or the control plane would still be backing off
+    # while the data plane has already declared the peer lost. None = no
+    # total deadline (the attempts bound alone).
+    total_deadline_ms: Optional[float] = None
     retryable: Tuple[type, ...] = (TransientError,)
+    # jitter entropy; None = the per-process module RNG (seeded from OS
+    # entropy, so processes genuinely decorrelate). Tests inject their own.
+    rng: Optional[object] = field(default=None, compare=False, repr=False)
     # telemetry seams: failed-attempt latencies observe into ``metrics``
     # (H_RETRY_MS histogram; default the process-global registry), and an
     # exhausted budget flushes the flight recorder's postmortem —
@@ -443,12 +479,38 @@ class RetryPolicy:
             raise ValueError(
                 f"max_attempts must be >= 1 (1 = no retries), got "
                 f"{self.max_attempts}")
+        if self.max_backoff_ms < self.backoff_ms:
+            raise ValueError(
+                f"max_backoff_ms={self.max_backoff_ms} < "
+                f"backoff_ms={self.backoff_ms}")
+
+    def next_delay_ms(self, prev_ms: Optional[float]) -> float:
+        """The sleep before the next attempt, from the previous one
+        (None = first retry). Exposed so the schedule itself is testable
+        without timing sleeps: deterministic geometric backoff with
+        jitter off, the decorrelated-jitter recurrence
+        ``uniform(base, 3 * prev)`` with it on — both capped at
+        ``max_backoff_ms``."""
+        if prev_ms is None:
+            first = self.backoff_ms
+            if self.jitter:
+                rng = self.rng if self.rng is not None else _JITTER_RNG
+                first = rng.uniform(self.backoff_ms,
+                                    self.backoff_ms * self.backoff_factor)
+            return min(first, self.max_backoff_ms)
+        if not self.jitter:
+            return min(prev_ms * self.backoff_factor, self.max_backoff_ms)
+        rng = self.rng if self.rng is not None else _JITTER_RNG
+        return min(rng.uniform(self.backoff_ms, prev_ms * 3.0),
+                   self.max_backoff_ms)
 
     def run(self, fn: Callable, *args, on_retry: Optional[Callable] = None,
             **kwargs):
         metrics = self.metrics if self.metrics is not None \
             else GLOBAL_METRICS
-        delay = self.backoff_ms / 1e3
+        deadline = None if not self.total_deadline_ms else \
+            time.monotonic() + self.total_deadline_ms / 1e3
+        delay_ms: Optional[float] = None
         for attempt in range(1, self.max_attempts + 1):
             t0 = time.perf_counter()
             try:
@@ -472,24 +534,93 @@ class RetryPolicy:
                         f"retry budget exhausted after {attempt} "
                         f"attempts: {e!r}")
                     raise
+                delay_ms = self.next_delay_ms(delay_ms)
+                if deadline is not None and \
+                        time.monotonic() + delay_ms / 1e3 >= deadline:
+                    # the next sleep would outlive the total budget: stop
+                    # retrying NOW — a retry schedule must not outlast
+                    # the collective deadline it exists to stay inside
+                    self.flight.dump(
+                        f"retry deadline exhausted after {attempt} "
+                        f"attempts ({self.total_deadline_ms:.0f} ms "
+                        f"budget): {e!r}")
+                    raise
                 log.info("attempt %d/%d failed (%s); retrying in %.0f ms",
-                         attempt, self.max_attempts, e, delay * 1e3)
+                         attempt, self.max_attempts, e, delay_ms)
                 if on_retry is not None:
                     on_retry(attempt, e)
-                time.sleep(delay)
-                delay *= self.backoff_factor
+                time.sleep(delay_ms / 1e3)
 
     @classmethod
     def from_conf(cls, conf, metrics=None,
                   flight=NULL_FLIGHT_RECORDER) -> "RetryPolicy":
+        # the collective timeout doubles as the retry plane's total
+        # deadline: once the watchdog would have declared the peer lost,
+        # backing off further is just a slower hang
+        collective_ms = conf.get_float("failure.collectiveTimeoutMs", 0.0)
+        backoff = conf.get_float("failure.backoffMs", 10.0)
         return cls(
             max_attempts=conf.get_int("failure.maxAttempts", 3),
-            backoff_ms=conf.get_float("failure.backoffMs", 10.0),
+            backoff_ms=backoff,
+            # the cap never undercuts the base (a base above the default
+            # cap just runs flat)
+            max_backoff_ms=max(
+                conf.get_float("failure.maxBackoffMs", 10_000.0), backoff),
+            total_deadline_ms=collective_ms if collective_ms > 0 else None,
             metrics=metrics, flight=flight,
         )
 
 
 # -- health --------------------------------------------------------------
+class ThreadLeakCensus:
+    """Accounting for daemon threads abandoned in a wedged device op or a
+    dead collective — the one census both leak sites share (HealthMonitor
+    probe threads, runtime/watchdog.py fence workers), so aging-out and
+    warn-once policy cannot drift between them.
+
+    Each parked thread is tracked under a key; finished threads age out
+    on every access. The census warns EXACTLY once, the first time its
+    size reaches ``warn_at`` — a recovering process must not drown its
+    own logs (one message per leak would)."""
+
+    def __init__(self, warn_at: int, warning: str, logger=None):
+        self._lock = threading.Lock()
+        self._items: Dict[str, threading.Thread] = {}
+        self._warn_at = int(warn_at)
+        self._warning = warning          # one %d slot: the census size
+        self._logger = logger if logger is not None else log
+        self._warned = False
+
+    def _sweep_locked(self) -> None:
+        self._items = {k: t for k, t in self._items.items()
+                       if t.is_alive()}
+
+    def count(self) -> int:
+        with self._lock:
+            self._sweep_locked()
+            return len(self._items)
+
+    def keys(self) -> set:
+        """Keys of threads still parked (e.g. devices to skip)."""
+        with self._lock:
+            self._sweep_locked()
+            return set(self._items)
+
+    def add(self, key: str, thread: threading.Thread) -> int:
+        """Track one abandoned thread; returns the census size after the
+        sweep+add (the number the caller reports in its postmortem)."""
+        with self._lock:
+            self._sweep_locked()
+            self._items[key] = thread
+            n = len(self._items)
+            warn = n >= self._warn_at and not self._warned
+            if warn:
+                self._warned = True
+        if warn:
+            self._logger.warning(self._warning, n)
+        return n
+
+
 class HealthMonitor:
     """Device-liveness probes + numeric health checks.
 
@@ -507,34 +638,66 @@ class HealthMonitor:
         # optional fn(bad_devices: list) fired when assert_healthy trips
         # — the node routes it into its /healthz verdict (utils/live.py)
         self.on_unhealthy = None
+        # Probe threads that outlived their deadline, by device. A
+        # timed-out daemon thread stays PARKED in the wedged device op
+        # holding its device reference — re-probing that device would
+        # stack one more hung thread per probe (one per watchdog expiry,
+        # forever). Track them, warn ONCE, and skip the device until its
+        # thread returns (it stays marked dead meanwhile).
+        self._stuck = ThreadLeakCensus(
+            warn_at=1,
+            warning=("%d probe thread(s) exceeded the "
+                     f"{timeout_ms:.0f} ms deadline and remain parked "
+                     "holding device references; those devices stay "
+                     "marked dead and will not be re-probed until the "
+                     "threads return (further leaks are silenced)"))
 
-    def probe(self) -> Dict[str, bool]:
-        """{device_str: alive} via an independent tiny op per device."""
+    def _run_one(self, dev, out, idx) -> None:
+        """One device's liveness op (seam: tests wedge a device here)."""
         import jax
         import jax.numpy as jnp
+        try:
+            x = jax.device_put(jnp.ones((8,), jnp.float32), dev)
+            out[idx] = bool(np.isfinite(np.asarray(x.sum())))
+        except Exception as e:
+            log.warning("probe failed on %s: %s", dev, e)
+            out[idx] = False
 
+    @property
+    def leaked_probe_threads(self) -> int:
+        """Probe threads still parked in a wedged device op (finished
+        ones age out) — the census tests and the doctor read."""
+        return self._stuck.count()
+
+    def probe(self) -> Dict[str, bool]:
+        """{device_str: alive} via an independent tiny op per device.
+        A device whose PREVIOUS probe thread is still stuck is reported
+        dead without spawning another thread into the same wedge."""
         devices = list(self.mesh.devices.reshape(-1))
         results: Dict[str, bool] = {}
         deadline = time.monotonic() + self.timeout_ms / 1e3
 
-        def run_one(dev, out, idx):
-            try:
-                x = jax.device_put(jnp.ones((8,), jnp.float32), dev)
-                out[idx] = bool(np.isfinite(np.asarray(x.sum())))
-            except Exception as e:
-                log.warning("probe failed on %s: %s", dev, e)
-                out[idx] = False
-
-        out = [False] * len(devices)
-        threads = [threading.Thread(target=run_one, args=(d, out, i),
+        skip = self._stuck.keys()
+        probed = [d for d in devices if str(d) not in skip]
+        out = [False] * len(probed)
+        threads = [threading.Thread(target=self._run_one, args=(d, out, i),
                                     daemon=True)
-                   for i, d in enumerate(devices)]
+                   for i, d in enumerate(probed)]
         for t in threads:
             t.start()
         for t in threads:
             t.join(max(0.0, deadline - time.monotonic()))
-        for d, ok, t in zip(devices, out, threads):
-            results[str(d)] = ok and not t.is_alive()
+        leaked_now = []
+        for d, ok, t in zip(probed, out, threads):
+            alive = ok and not t.is_alive()
+            results[str(d)] = alive
+            if t.is_alive():
+                leaked_now.append((str(d), t))
+        for d in devices:
+            if str(d) in skip:
+                results[str(d)] = False
+        for d, t in leaked_now:
+            self._stuck.add(d, t)
         return results
 
     def assert_healthy(self) -> None:

@@ -122,6 +122,17 @@ class TpuNode:
         self.health = HealthMonitor(
             self.mesh, timeout_ms=conf.connection_timeout_ms,
             flight=self.flight)
+        # Collective watchdog (failure.collectiveTimeoutMs): the deadline
+        # fence around every distributed rendezvous and in-flight
+        # collective wait — installed process-global so the module-level
+        # collectives in shuffle/distributed.py fence themselves (the
+        # GLOBAL_TRACER pattern). 0 = disabled instance, call sites stay
+        # unconditional.
+        from sparkucx_tpu.runtime.watchdog import configure_from_conf \
+            as _configure_watchdog
+        self.watchdog = _configure_watchdog(
+            conf, health=self.health, flight=self.flight,
+            metrics=self.metrics)
         self.epochs = EpochManager()
         self.epochs.on_bump(self.flight.on_epoch_bump)
         # Cluster clock anchors: every process's wall↔perf pair,
@@ -383,6 +394,9 @@ class TpuNode:
             self.mesh, timeout_ms=self.conf.connection_timeout_ms,
             flight=self.flight)
         self.health.on_unhealthy = self._on_device_unhealthy
+        # the watchdog probes through the CURRENT monitor — a stale one
+        # would probe devices the remesh just removed
+        self.watchdog.health = self.health
         self.registry.clear()
         # Fresh membership, fresh alignment data. Single-process: a
         # local re-anchor. Distributed: NO collective here — remesh runs
@@ -417,6 +431,13 @@ class TpuNode:
         if self.live is not None:
             self.live.stop()
         self.reset_providers()
+        # drop the process-global fence if it is ours (a later node
+        # installs its own): dead-node health/flight refs must not
+        # outlive the node through the module global
+        from sparkucx_tpu.runtime.watchdog import (current_watchdog,
+                                                   set_global_watchdog)
+        if current_watchdog() is self.watchdog:
+            set_global_watchdog(None)
         self.epochs.remove_listener(self._on_epoch_health)
         self.flight.uninstall_abort_hook()
         self.metrics.remove_reporter(self.flight.metrics_reporter)

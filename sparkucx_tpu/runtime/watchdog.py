@@ -1,0 +1,264 @@
+"""Collective watchdog — deadlines everywhere a peer can hang us.
+
+The reference never hangs on a dead peer: UCX endpoints run in
+``UCP_ERR_HANDLING_MODE_PEER`` (ref: UcxNode.java:134), so a lost
+executor surfaces as an endpoint error that the RPC callback rethrows
+(ref: RpcConnectionCallback.java:91-98) and Spark converts into
+FetchFailed + stage retry. JAX's SPMD collectives have no such mode — a
+dead process leaves every survivor parked inside ``process_allgather``
+or a dispatched collective FOREVER, which is the one failure class the
+epoch fencing (runtime/failures.EpochManager) cannot reach: the fence
+only trips at the next validation point, and a hung collective never
+gets there.
+
+This module is the missing error-handling mode, rebuilt host-side:
+
+* :class:`Watchdog.call` runs a blocking collective step on a watched
+  thread and joins it against ``failure.collectiveTimeoutMs``. On expiry
+  it fires the :class:`HealthMonitor` probe (the active liveness check),
+  records a flight-recorder postmortem tagged with the stuck exchange's
+  trace id, and raises :class:`PeerLostError` — a ``TransientError``, so
+  the replay policy (shuffle/manager.py) and RetryPolicy treat it as
+  recoverable. Never silently: every expiry lands in the metrics plane
+  (``failure.peer_timeout.count``) and the flight ring.
+* The abandoned worker thread is TRACKED, not forgotten: it stays parked
+  in the dead collective holding whatever references the runtime gave it
+  (the same leak shape HealthMonitor's probe threads had), and
+  ``leaked()`` reports the census so tests and the doctor can see a
+  process accumulating corpses. One warning, then silence — a recovering
+  process must not drown its own logs.
+
+Armed at every distributed rendezvous (``shuffle/distributed.py``:
+allgather channels, agreement rounds, the completeness barrier) and at
+the in-flight collective wait of :class:`PendingDistributedShuffle` —
+the full set of places a peer's death can park this process. Off by
+default (``failure.collectiveTimeoutMs=0``): the disabled path is a
+single float compare and a direct call, so single-process reads pay
+nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from sparkucx_tpu.runtime.failures import (NULL_FLIGHT_RECORDER,
+                                           PeerLostError,
+                                           ThreadLeakCensus)
+from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.metrics import (C_PEER_TIMEOUT, C_PROBE_DEAD,
+                                        GLOBAL_METRICS)
+
+log = get_logger("runtime.watchdog")
+
+
+class Watchdog:
+    """Deadline fence for blocking collective steps.
+
+    ``timeout_ms <= 0`` disables it: ``call`` runs the function inline
+    on the caller's thread (zero overhead, exact single-process
+    semantics). Enabled, the function runs on a fresh daemon thread and
+    the caller joins with the deadline — the only portable way to put a
+    timeout on a C-level collective that Python cannot interrupt. A
+    timed-out thread is abandoned IN the collective (the process's view
+    of that world is broken anyway; recovery is a remesh / fresh world,
+    the Spark stage-retry analog) but tracked via :meth:`leaked`.
+    """
+
+    def __init__(self, timeout_ms: float = 0.0, health=None,
+                 flight=NULL_FLIGHT_RECORDER, metrics=None,
+                 name: str = "watchdog"):
+        self.timeout_ms = float(timeout_ms)
+        self.health = health          # runtime.failures.HealthMonitor
+        self.flight = flight
+        self.metrics = metrics
+        self.name = name
+        self._lock = threading.Lock()
+        self._armed: List[dict] = []     # stack: nested fenced sections
+        # one leaked worker is NORMAL operation (each expiry abandons
+        # exactly one); the census warns when they start ACCUMULATING
+        self._leaked = ThreadLeakCensus(
+            warn_at=2, logger=log,
+            warning=("%d watchdog worker threads are parked in dead "
+                     "collectives (each holds its payload references "
+                     "until process exit); further leaks are silenced — "
+                     "remesh or restart the world instead of retrying "
+                     "into it"))
+        self._probe_lock = threading.Lock()
+        self._probe_thread: Optional[threading.Thread] = None
+        self.expiries = 0                # total deadline hits (tests/CI)
+
+    @property
+    def enabled(self) -> bool:
+        return self.timeout_ms > 0
+
+    # -- observability -----------------------------------------------------
+    def armed(self) -> List[dict]:
+        """Currently fenced sections, oldest first — each
+        ``{what, trace, deadline}``. Nested exchanges stack."""
+        with self._lock:
+            return [dict(e) for e in self._armed]
+
+    def leaked(self) -> int:
+        """Worker threads abandoned in a dead collective and still
+        parked. Finished threads age out of the census."""
+        return self._leaked.count()
+
+    # -- the fence ---------------------------------------------------------
+    def call(self, fn: Callable, *args, what: str = "collective",
+             trace: Optional[str] = None, timeout_ms: Optional[float]
+             = None, **kwargs):
+        """Run ``fn(*args, **kwargs)`` under the deadline; returns its
+        value, re-raises its exception, or raises :class:`PeerLostError`
+        on expiry (after probe + postmortem). ``trace`` defaults to the
+        flight recorder's newest in-flight exchange — the same id on the
+        exchange's report, spans and flight events, so the postmortem
+        names WHICH exchange was stuck."""
+        limit = self.timeout_ms if timeout_ms is None else float(timeout_ms)
+        if limit <= 0:
+            return fn(*args, **kwargs)
+        if trace is None:
+            trace = self.flight.current_trace()
+        box: dict = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                box["result"] = fn(*args, **kwargs)
+            except BaseException as e:   # noqa: BLE001 — relayed below
+                box["error"] = e
+            finally:
+                done.set()
+
+        entry = {"what": what, "trace": trace or "",
+                 "deadline": time.monotonic() + limit / 1e3}
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"sxt-fence-{what[:24]}")
+        with self._lock:
+            self._armed.append(entry)
+        try:
+            t.start()
+            done.wait(limit / 1e3)
+            if not done.is_set():
+                # expiry runs while the entry is STILL armed: the
+                # postmortem's stuck_sections must name the section
+                # that blew the deadline (and its nesting), not just
+                # the fences that happened to surround it
+                self._expired(what, trace, t, limit)
+        finally:
+            with self._lock:
+                try:
+                    self._armed.remove(entry)
+                except ValueError:
+                    pass
+        if not done.is_set():
+            raise PeerLostError(
+                f"collective deadline expired: {what!r} blocked "
+                f">{limit:.0f} ms"
+                + (f" in exchange {trace}" if trace else "")
+                + " — a peer is unreachable or dead "
+                "(spark.shuffle.tpu.failure.collectiveTimeoutMs); "
+                "remesh over the survivors and replay, or re-bootstrap "
+                "the world")
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
+
+    # -- expiry path -------------------------------------------------------
+    def _expired(self, what: str, trace: Optional[str], t: threading.Thread,
+                 limit: float) -> None:
+        """Probe, record, dump — never raise anything but the caller's
+        PeerLostError (telemetry must not mask the verdict)."""
+        self.expiries += 1
+        metrics = self.metrics if self.metrics is not None else GLOBAL_METRICS
+        try:
+            metrics.inc(C_PEER_TIMEOUT, 1.0)
+        except Exception:
+            pass
+        n_leaked = self._leaked.add(str(id(t)), t)
+        with self._lock:
+            stuck = [dict(e) for e in self._armed]
+        verdict = self._probe_once()
+        dead = sorted(d for d, ok in (verdict or {}).items() if not ok)
+        if dead:
+            try:
+                metrics.inc(C_PROBE_DEAD, float(len(dead)))
+            except Exception:
+                pass
+        log.error("collective deadline expired after %.0f ms at %s "
+                  "(trace %s); probe verdict: %s", limit, what,
+                  trace or "-", verdict if verdict is not None
+                  else "unavailable")
+        self.flight.record("peer_timeout", what=what, trace=trace or "",
+                           timeout_ms=limit, dead_devices=dead,
+                           leaked_threads=n_leaked)
+        self.flight.dump(
+            f"PeerLostError: {what} blocked >{limit:.0f} ms",
+            extra={"peer_timeout": {
+                "what": what, "trace": trace or "", "timeout_ms": limit,
+                "probe": verdict, "dead_devices": dead,
+                "stuck_sections": stuck, "leaked_threads": n_leaked}})
+
+    def _probe_once(self):
+        """One bounded liveness probe. A probe whose previous run is
+        still stuck must NOT stack another hung thread per expiry
+        (HealthMonitor.probe's per-device threads are deadline-joined
+        but a wedged backend can park the probe itself) — skip and
+        report None until it returns."""
+        if self.health is None:
+            return None
+        with self._probe_lock:
+            if self._probe_thread is not None \
+                    and self._probe_thread.is_alive():
+                log.warning("previous device probe is still stuck; "
+                            "skipping re-probe (verdict unavailable)")
+                return None
+            box: dict = {}
+
+            def run():
+                try:
+                    box["verdict"] = self.health.probe()
+                except Exception as e:
+                    log.warning("probe failed during watchdog expiry: %s",
+                                e)
+
+            t = threading.Thread(target=run, daemon=True,
+                                 name="sxt-fence-probe")
+            self._probe_thread = t
+            t.start()
+        # the probe is itself deadline-bounded (HealthMonitor joins each
+        # device thread against its timeout); give it that long plus slack
+        t.join(max(1.0, getattr(self.health, "timeout_ms", 1e3) / 1e3
+                   + 1.0))
+        return box.get("verdict")
+
+
+# Disabled instance: the process-global default. TpuNode swaps in a
+# configured Watchdog at init (and restores this at close) so the
+# module-level collectives in shuffle/distributed.py fence themselves
+# without threading a handle through every call signature — the
+# GLOBAL_TRACER pattern.
+NULL_WATCHDOG = Watchdog(0.0)
+_GLOBAL = NULL_WATCHDOG
+
+
+def set_global_watchdog(wd: Optional[Watchdog]) -> None:
+    global _GLOBAL
+    _GLOBAL = wd if wd is not None else NULL_WATCHDOG
+
+
+def current_watchdog() -> Watchdog:
+    return _GLOBAL
+
+
+def configure_from_conf(conf, health=None, flight=NULL_FLIGHT_RECORDER,
+                        metrics=None) -> Watchdog:
+    """Build (and install as process-global) the node's watchdog from
+    ``spark.shuffle.tpu.failure.collectiveTimeoutMs``. 0 = disabled —
+    the returned instance still exists so call sites stay
+    unconditional."""
+    wd = Watchdog(conf.collective_timeout_ms, health=health,
+                  flight=flight, metrics=metrics)
+    set_global_watchdog(wd)
+    return wd

@@ -61,18 +61,33 @@ def allgather_sizes(local_vals: np.ndarray, shard_ids: Sequence[int],
     sum-allgather so every process holds the full size row — the
     driver-table fetch (ref: UcxWorkerWrapper.scala:176-196) as a
     collective."""
-    from jax.experimental import multihost_utils
     row = np.zeros(num_shards, dtype=np.int64)
     row[list(shard_ids)] = np.asarray(local_vals, dtype=np.int64)
-    gathered = multihost_utils.process_allgather(row)   # [nproc, num_shards]
+    # [nproc, num_shards]; rides the watchdog-fenced channel
+    gathered = allgather_blob(row, what="size-row allgather")
     return gathered.sum(axis=0)
 
 
-def allgather_blob(blob: np.ndarray) -> np.ndarray:
+def allgather_blob(blob: np.ndarray,
+                   what: str = "metadata allgather") -> np.ndarray:
     """[nproc, ...] stack of one small host array per process (schema
-    agreement checks)."""
+    agreement checks).
+
+    THE metadata-plane wire — size rows, schema agreement, wave
+    agreement, completeness barriers, overflow verdicts and the
+    telemetry gathers all frame through here — and therefore THE place
+    a dead peer parks every survivor. The call is deadline-fenced by
+    the process watchdog (``failure.collectiveTimeoutMs``,
+    runtime/watchdog.py): on expiry it raises
+    :class:`~sparkucx_tpu.runtime.failures.PeerLostError` after a
+    liveness probe and a flight postmortem, instead of hanging forever.
+    With the watchdog off (the default) this is a direct call."""
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(blob))
+
+    from sparkucx_tpu.runtime.watchdog import current_watchdog
+    return current_watchdog().call(
+        lambda: np.asarray(multihost_utils.process_allgather(blob)),
+        what=what)
 
 
 def allgather_json(obj) -> list:
@@ -328,11 +343,19 @@ class PendingDistributedShuffle(PendingExchangeBase):
             # the SPMD group diverges. The flat exchange's flag is a
             # mesh-wide psum, but the hierarchical flag (r1|r2) is only
             # uniform within a slice — so allgather the local verdicts
-            # and OR them globally.
-            mine = any(bool(np.asarray(s.data).any())
-                       for s in ovf.addressable_shards)
+            # and OR them globally. Materializing the flag BLOCKS until
+            # the dispatched collective completes — the in-flight wait a
+            # dead peer parks forever — so it rides the watchdog fence
+            # like the metadata allgathers (PeerLostError past the
+            # deadline, never a silent hang).
+            from sparkucx_tpu.runtime.watchdog import current_watchdog
+            mine = current_watchdog().call(
+                lambda: any(bool(np.asarray(s.data).any())
+                            for s in ovf.addressable_shards),
+                what="exchange completion wait")
             ovf_global = bool(allgather_blob(
-                np.array([1 if mine else 0], dtype=np.int64)).any())
+                np.array([1 if mine else 0], dtype=np.int64),
+                what="overflow verdict").any())
             if not ovf_global:
                 if cur.combine or cur.ordered or self._hier_mesh is not None:
                     # SHARDED seg output — collect this process's rows:

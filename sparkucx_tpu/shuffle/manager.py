@@ -48,7 +48,10 @@ from sparkucx_tpu.shuffle.reader import (
 )
 from sparkucx_tpu.shuffle.writer import MapOutputWriter
 from sparkucx_tpu.utils.logging import get_logger
-from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
+from sparkucx_tpu.runtime.failures import (PeerLostError, StaleEpochError,
+                                           TransientError)
+from sparkucx_tpu.utils.metrics import (C_REPLAY_MS, C_REPLAYS,
+                                        COMPILE_HITS, COMPILE_PROGRAMS,
                                         GLOBAL_METRICS, H_BW,
                                         H_FETCH_FIRST, H_FETCH_WAIT,
                                         H_PEER_BYTES, H_PEER_ROWS,
@@ -115,6 +118,12 @@ class ExchangeReport:
     stepcache_hits: int = 0
     stepcache_programs: int = 0
     plan_bucket: List[int] = field(default_factory=list)
+    # Compiled-program family of the dispatched plan (plan.family(),
+    # stringified) — the replay-stability contract: a replayed exchange
+    # whose learned caps carried over reports the SAME family as the
+    # pre-fault run, i.e. the replay re-packed and re-dispatched but did
+    # not recompile. The chaos drill diffs this across the fault matrix.
+    plan_family: str = ""
     # Waved reads: [W] REAL global rows each wave moved (the occupancy
     # the pipeline shipped, vs cap_in rows provisioned per wave) — the
     # per-wave view of the payload/wire split above. Empty = single-shot.
@@ -146,6 +155,14 @@ class ExchangeReport:
     # observed into shuffle.collective.bw_gbps only for steady-state
     # (non-compile-bearing) reads — the same split as fetch-wait.
     bw_gbps: float = 0.0
+    # Failure-domain accounting (failure.policy=replay): how many times
+    # this read transparently re-planned + re-ran the exchange (stale-
+    # handle re-pins through the recovery ledger plus transient-failure
+    # re-runs) and the wall the FAILED attempts burned. 0/0.0 on the
+    # failfast policy and on clean reads — the doctor's replay_storm
+    # rule grades these against failure.replayBudget.
+    replays: int = 0
+    replay_ms: float = 0.0
     completed: bool = False
     error: Optional[str] = None
     # bookkeeping, excluded from to_dict()
@@ -231,6 +248,20 @@ class TpuShuffleManager:
         # writers dropped by an epoch bump, kept alive until no read that
         # could still touch their buffers remains (see _on_epoch_bump)
         self._graveyard: list = []          # [(dropped_at_gen, writers)]
+        # -- recovery ledger (failure.policy=replay) ----------------------
+        # Registration shapes by shuffle id — what re-registration under
+        # a new epoch needs (the registry entry may be gone: remesh
+        # clears it BEFORE bump listeners run).
+        self._shapes: Dict[int, Dict] = {}
+        # Shuffles the last epoch bump carried over: sid -> {entry,
+        # epoch}. A stale handle re-pins through this instead of
+        # StaleEpochError when the policy allows.
+        self._replayed: Dict[int, Dict] = {}
+        # Cumulative replays spent per shuffle (re-pins + re-runs);
+        # past failure.replayBudget the shuffle falls back to failfast.
+        self._replay_counts: Dict[int, int] = {}
+        self._policy = self.conf.failure_policy
+        self._replay_budget = self.conf.replay_budget
         # In-flight reads by the manager GENERATION they registered under.
         # The generation (not the node epoch) keys the guard because it is
         # mutated under the same lock that clears _writers — the node
@@ -288,9 +319,21 @@ class TpuShuffleManager:
 
     def _on_epoch_bump(self, epoch: int) -> None:
         self._bind_mesh()
+        # Recovery ledger (failure.policy=replay): an epoch bump no
+        # longer unconditionally drops every shuffle. The staged writer
+        # blocks on THIS process are host memory — a membership change
+        # did nothing to them (Spark's map outputs survive executor loss
+        # the same way: durable local files) — so shuffles whose local
+        # staged state is fully intact re-register under the new epoch
+        # and stale handles re-pin through _resolve_handle instead of
+        # dying on StaleEpochError. Anything partial drops as before.
+        survivors = self._ledger_candidates() \
+            if self._policy == "replay" else {}
         with self._lock:
-            dropped = list(self._writers.values())
-            self._writers.clear()
+            dropped = [ws for sid, ws in self._writers.items()
+                       if sid not in survivors]
+            self._writers = {sid: ws for sid, ws in self._writers.items()
+                             if sid in survivors}
             # DEFERRED release: a read that passed epoch validation just
             # before this bump may still be copying staged arena arrays /
             # spill mmap views — releasing now would hand its buffers to
@@ -305,10 +348,171 @@ class TpuShuffleManager:
                 self._graveyard.append((self._gen, dropped))
             to_free = self._collect_free_graveyard_locked()
         self._release_writer_batches(to_free)
-        log.warning("manager rebound to epoch %d: mesh %s, shuffle state "
-                    "dropped — re-register and re-run live shuffles",
-                    epoch, dict(zip(self.node.mesh.axis_names,
-                                    self.node.mesh.devices.shape)))
+        carried = [sid for sid in sorted(survivors)
+                   if self._reregister_shuffle(sid, epoch)]
+        mesh_desc = dict(zip(self.node.mesh.axis_names,
+                             self.node.mesh.devices.shape))
+        if carried:
+            log.warning(
+                "manager rebound to epoch %d: mesh %s; %d shuffle(s) "
+                "re-registered from the recovery ledger (%s) — stale "
+                "handles replay transparently; %d dropped", epoch,
+                mesh_desc, len(carried), carried, len(dropped))
+        else:
+            log.warning(
+                "manager rebound to epoch %d: mesh %s, shuffle state "
+                "dropped — re-register and re-run live shuffles", epoch,
+                mesh_desc)
+
+    # -- recovery ledger (failure.policy=replay) ---------------------------
+    def _ledger_candidates(self) -> Dict[int, Dict]:
+        """Shuffles whose LOCAL staged writer blocks are intact — every
+        map committed, none released — the re-registration precondition.
+        A partially-staged shuffle drops as before: an uncommitted map's
+        rows are unrecoverable without re-running its task, which is the
+        host framework's job."""
+        with self._lock:
+            snap = {sid: dict(ws) for sid, ws in self._writers.items()}
+        out: Dict[int, Dict] = {}
+        for sid, ws in snap.items():
+            shape = self._shapes.get(sid)
+            if not shape or not ws:
+                continue
+            committed = {m for m, w in ws.items()
+                        if w.committed and not w.released}
+            if committed == set(range(shape["num_maps"])):
+                out[sid] = ws
+        return out
+
+    def _reregister_shuffle(self, sid: int, epoch: int) -> bool:
+        """Re-register one ledger survivor under the new epoch: fresh
+        registry entry (the remesh cleared the old one), the committed
+        size rows copied over from the old entry the writers still hold,
+        writers re-pointed. On ANY failure the shuffle is dropped the
+        pre-ledger way (graveyard + release) — a half-re-registered
+        shuffle must not serve reads."""
+        try:
+            shape = self._shapes[sid]
+            with self._lock:
+                ws = dict(self._writers.get(sid, {}))
+            old_entry = next(iter(ws.values())).entry
+            reg = self.node.registry
+            reg.unregister(sid)     # no-op when remesh already cleared it
+            entry = reg.register(sid, shape["num_maps"],
+                                 shape["num_partitions"],
+                                 shape["partitioner"], shape["bounds"])
+            for m in sorted(ws):
+                entry.publish(m, old_entry.fetch_record(m))
+                ws[m].entry = entry
+            with self._lock:
+                self._replayed[sid] = {"entry": entry, "epoch": epoch}
+            return True
+        except Exception as e:
+            log.error("recovery ledger could not re-register shuffle %d "
+                      "(%s) — dropping it", sid, e)
+            with self._lock:
+                ws = self._writers.pop(sid, None)
+                to_free = []
+                if ws:
+                    self._gen += 1
+                    self._graveyard.append((self._gen, [ws]))
+                    to_free = self._collect_free_graveyard_locked()
+            self._release_writer_batches(to_free)
+            return False
+
+    def _spend_replay(self, sid: int) -> bool:
+        """Consume one unit of the shuffle's replay budget; False once
+        exhausted (the caller falls back to failfast)."""
+        with self._lock:
+            spent = self._replay_counts.get(sid, 0)
+            if spent >= self._replay_budget:
+                log.error("shuffle %d replay budget exhausted (%d/%d) — "
+                          "failing fast", sid, spent, self._replay_budget)
+                return False
+            self._replay_counts[sid] = spent + 1
+        return True
+
+    def _resolve_handle(self, handle: ShuffleHandle) -> int:
+        """Pin a handle to the current epoch. Returns 1 when it was
+        transparently re-pinned through the recovery ledger (counts as a
+        replay), 0 when already current; raises StaleEpochError when the
+        policy / ledger / budget cannot save it — the failfast default
+        is exactly the old validate."""
+        cur = self.node.epochs.current
+        if handle.epoch == cur:
+            return 0
+        sid = handle.shuffle_id
+        with self._lock:
+            rec = self._replayed.get(sid)
+        if self._policy != "replay" or rec is None \
+                or rec["epoch"] != cur:
+            self.node.epochs.validate(handle.epoch, f"shuffle {sid}")
+            return 0              # unreachable: validate raises on stale
+        if not self._spend_replay(sid):
+            raise StaleEpochError(
+                f"shuffle {sid} pinned to epoch {handle.epoch}, mesh is "
+                f"at {cur}, and its replay budget "
+                f"({self._replay_budget}) is spent — re-register and "
+                f"re-run, or raise spark.shuffle.tpu.failure.replayBudget")
+        handle.entry = rec["entry"]
+        handle.epoch = cur
+        log.warning("shuffle %d re-pinned to epoch %d through the "
+                    "recovery ledger (staged state intact) — replaying "
+                    "on the surviving mesh", sid, cur)
+        return 1
+
+    def _replay_after_failure(self, handle: ShuffleHandle, err) -> bool:
+        """Whether read() may transparently re-run the exchange after a
+        transient failure. Single-process only: a distributed replay
+        decision taken on one process would desync the SPMD group — in
+        multi-process mode the typed error surfaces to the recovery
+        controller (buildlib/run_cluster.py), which re-bootstraps an
+        agreed world; the ledger then serves the re-pin in the fresh
+        manager. A PeerLostError additionally remeshes over the probe's
+        survivors first (the bump routes this shuffle through the
+        ledger)."""
+        if self._policy != "replay" or self.node.is_distributed:
+            return False
+        if not self._spend_replay(handle.shuffle_id):
+            return False
+        if isinstance(err, PeerLostError):
+            try:
+                self.node.remesh(
+                    reason=f"replay shuffle {handle.shuffle_id} after "
+                           f"{type(err).__name__}")
+            except Exception as e:
+                log.error("replay remesh failed (%s); failing fast", e)
+                return False
+            # The unit spent above covers this replay END TO END: re-pin
+            # the handle through the ledger here, or the retry loop's
+            # _resolve_handle would charge (and count) a SECOND unit for
+            # the same fault — replayBudget=1 could then never absorb a
+            # single peer loss, and one blip would read as a storm.
+            cur = self.node.epochs.current
+            with self._lock:
+                rec = self._replayed.get(handle.shuffle_id)
+            if rec is None or rec["epoch"] != cur:
+                log.error("staged state for shuffle %d did not survive "
+                          "the replay remesh; failing fast",
+                          handle.shuffle_id)
+                return False
+            handle.entry = rec["entry"]
+            handle.epoch = cur
+        self.node.flight.record("replay", shuffle_id=handle.shuffle_id,
+                                error=repr(err)[:200])
+        log.warning("replaying shuffle %d after transient failure: %r",
+                    handle.shuffle_id, err)
+        return True
+
+    def _account_replays(self, handle: ShuffleHandle, replays: int,
+                         replay_ms: float) -> None:
+        rep = self.report(handle.shuffle_id)
+        if rep is not None:
+            rep.replays = int(replays)
+            rep.replay_ms = round(replay_ms, 3)
+        self.node.metrics.inc(C_REPLAYS, float(replays))
+        if replay_ms:
+            self.node.metrics.inc(C_REPLAY_MS, float(replay_ms))
 
     # -- in-flight read tracking (graveyard release condition) -------------
     def _collect_free_graveyard_locked(self) -> list:
@@ -474,6 +678,14 @@ class TpuShuffleManager:
                                             bounds)
         with self._lock:
             self._writers[shuffle_id] = {}
+            # recovery-ledger shape record: re-registration after a
+            # remesh needs it (the registry entry dies with the epoch);
+            # a fresh registration resets the replay bookkeeping
+            self._shapes[shuffle_id] = {
+                "num_maps": num_maps, "num_partitions": num_partitions,
+                "partitioner": partitioner, "bounds": bounds}
+            self._replayed.pop(shuffle_id, None)
+            self._replay_counts.pop(shuffle_id, None)
         log.info("registered shuffle %d: %d maps x %d partitions "
                  "(table %d B)", shuffle_id, num_maps, num_partitions,
                  len(entry.table))
@@ -768,9 +980,17 @@ class TpuShuffleManager:
         pipeline's stock aggregate+sort (ref: compat/spark_2_4/
         UcxShuffleReader.scala:80-144) executed on the accelerator, with
         proportionally less ICI traffic and D2H volume. Needs a numeric
-        value schema."""
-        self.node.epochs.validate(handle.epoch,
-                                  f"shuffle {handle.shuffle_id}")
+        value schema.
+
+        Under ``failure.policy=replay`` a transient failure (injected
+        fault, PeerLostError from the watchdog) or a stale-epoch handle
+        whose staged state survived the remesh is absorbed HERE: the
+        whole exchange re-plans and re-runs on the surviving mesh —
+        waved reads restart as a whole exchange, per-wave learned caps
+        carry over (``_wave_cap_hints`` outlive the attempt) — up to
+        ``failure.replayBudget`` times, with the replay count and the
+        failed attempts' wall on the final ExchangeReport. The failfast
+        default keeps the old typed-error contract exactly."""
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
         # Fetch-wait DISTRIBUTION per read — what Spark's incFetchWaitTime
@@ -782,18 +1002,42 @@ class TpuShuffleManager:
         # The split happens HERE, after result(), because the report's
         # step-cache delta is only final once on_done ran.
         metrics = self.node.metrics
+        # Pin the handle BEFORE the metrics window opens: a failfast
+        # StaleEpochError here keeps the pre-replay contract exactly —
+        # no read.count / read.ms / near-zero wait sample for a read
+        # that never started (the loop's resolve is a no-op on the
+        # first pass; it only re-pins when an external bump races a
+        # replayed attempt).
+        replays = self._resolve_handle(handle)
         t0 = time.perf_counter()
+        replay_ms = 0.0
         try:
-            if self.node.is_distributed:
-                # collective: every process must pass the same
-                # combine/ordered values (same SPMD discipline as
-                # calling read() at all)
-                return self._submit_distributed(
-                    handle, timeout, combine=combine, ordered=ordered,
-                    combine_sum_words=combine_sum_words).result()
-            return self._submit_local(
-                handle, timeout, combine=combine, ordered=ordered,
-                combine_sum_words=combine_sum_words).result()
+            while True:
+                t_attempt = time.perf_counter()
+                try:
+                    replays += self._resolve_handle(handle)
+                    if self.node.is_distributed:
+                        # collective: every process must pass the same
+                        # combine/ordered values (same SPMD discipline
+                        # as calling read() at all)
+                        res = self._submit_distributed(
+                            handle, timeout, combine=combine,
+                            ordered=ordered,
+                            combine_sum_words=combine_sum_words).result()
+                    else:
+                        res = self._submit_local(
+                            handle, timeout, combine=combine,
+                            ordered=ordered,
+                            combine_sum_words=combine_sum_words).result()
+                    break
+                except TransientError as e:
+                    replay_ms += (time.perf_counter() - t_attempt) * 1e3
+                    if not self._replay_after_failure(handle, e):
+                        raise
+                    replays += 1
+            if replays:
+                self._account_replays(handle, replays, replay_ms)
+            return res
         finally:
             ms = (time.perf_counter() - t0) * 1e3
             metrics.inc("shuffle.read.ms", ms)
@@ -849,18 +1093,27 @@ class TpuShuffleManager:
         Multi-process: submit() is COLLECTIVE, like read() — every
         process must call submit() and later result() in the same order.
         done() stays a local poll; the overflow consensus (and any retry)
-        runs inside result(), where all processes are present."""
-        self.node.epochs.validate(handle.epoch,
-                                  f"shuffle {handle.shuffle_id}")
+        runs inside result(), where all processes are present.
+
+        Under ``failure.policy=replay`` a stale handle whose staged state
+        survived the remesh transparently re-pins to the new epoch here
+        (like read()); mid-flight transient failures surface to the
+        caller — the async contract has no place to loop."""
+        replayed = self._resolve_handle(handle)
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
         if self.node.is_distributed:
-            return self._submit_distributed(
+            pending = self._submit_distributed(
                 handle, timeout, combine=combine, ordered=ordered,
                 combine_sum_words=combine_sum_words)
-        return self._submit_local(
-            handle, timeout, combine=combine, ordered=ordered,
-            combine_sum_words=combine_sum_words)
+        else:
+            pending = self._submit_local(
+                handle, timeout, combine=combine, ordered=ordered,
+                combine_sum_words=combine_sum_words)
+        if replayed:
+            # after _submit_*: the fresh report now exists in the ring
+            self._account_replays(handle, replayed, 0.0)
+        return pending
 
     def _submit_local(self, handle: ShuffleHandle, timeout: float,
                       combine: Optional[str] = None,
@@ -1067,6 +1320,7 @@ class TpuShuffleManager:
         rep.wire_bytes = layout.wire_bytes
         rep.pad_ratio = layout.pad_ratio
         rep.plan_bucket = [int(plan.cap_in), int(plan.cap_out)]
+        rep.plan_family = str(plan.family())
         # plain-python arithmetic over the (tiny, per-peer) lists: numpy
         # reductions on 8-element arrays cost more in dispatch than the
         # math, and this runs on every read (bench --stage obs-overhead)
@@ -1481,6 +1735,7 @@ class TpuShuffleManager:
         rep.wave_rows = wave_rows
         rep.wave_payload_rows = [int(x) for x in wave_sizes]
         rep.plan_bucket = [int(wplan.cap_in), int(wplan.cap_out)]
+        rep.plan_family = str(wplan.family())
         # wave wire accounting: the pipeline dispatches W exchanges of the
         # wave plan's shape — wire cost is per wave (a padded transport
         # pays its caps every wave, occupancy notwithstanding; the ragged
@@ -1509,6 +1764,10 @@ class TpuShuffleManager:
                          for outs in slot_outputs for k, _ in outs)
         read_gen = self._read_started()
         try:
+            # same injection site as the single-shot dispatch: the waved
+            # branch returns before _submit_*_staged's check, so without
+            # this the chaos matrix's waved x exchange cell never fires
+            self.node.faults.check("exchange")
             log.info("wave-pipelined read: shuffle %d, %d waves x %d "
                      "rows/shard (depth %d, wave plan cap_in=%d "
                      "cap_out=%d)", handle.shuffle_id, num_waves,
@@ -1860,6 +2119,9 @@ class TpuShuffleManager:
         prevent. With no read in flight they free immediately."""
         with self._lock:
             writers = self._writers.pop(shuffle_id, {})
+            self._shapes.pop(shuffle_id, None)
+            self._replayed.pop(shuffle_id, None)
+            self._replay_counts.pop(shuffle_id, None)
             self._gen += 1
             if writers:
                 self._graveyard.append((self._gen, [writers]))
@@ -2059,6 +2321,11 @@ class PendingWaveShuffle:
             self._admit(True)             # blocks until capacity frees
         try:
             for i in range(self._num_waves):
+                # per-wave injection site: a fault mid-pipeline settles
+                # the in-flight waves (the except path below), then the
+                # replay policy restarts the WHOLE exchange — per-wave
+                # learned caps carry over through mgr._wave_cap_hints
+                mgr.node.faults.check("wave")
                 while len(inflight) >= self._depth:
                     retries_total += self._drain_oldest(
                         inflight, wave_results, timeline, t_read0)

@@ -95,6 +95,24 @@ class ShufflePlan:
         import dataclasses
         return dataclasses.replace(self, cap_out=self.cap_out * 2)
 
+    def family(self) -> tuple:
+        """Compiled-program family key: every field that shapes the
+        compiled step EXCEPT the waved read's outer split
+        (``wave_rows``/``num_waves`` never reach a dispatched program —
+        see ``wave_step_plan``) and ``max_retries`` (a host-loop bound).
+
+        This is the replay-stability contract (failure.policy=replay):
+        a re-run exchange whose learned caps carried over lands on the
+        SAME family — i.e. replay costs a re-pack and a re-dispatch, not
+        a recompile. The manager stamps it on replay flight events and
+        the chaos drill asserts it held across the fault matrix."""
+        return (self.num_shards, self.num_partitions, self.cap_in,
+                self.cap_out, self.impl, self.partitioner, self.sort_impl,
+                self.sort_strips, self.combine, self.combine_words,
+                self.combine_dtype, self.combine_sum_words,
+                self.combine_compaction, self.ordered, self.bounds,
+                self.pallas_interpret)
+
     def strips_active(self) -> bool:
         """True when the single-shard strip-sorted plain path runs —
         THE activation predicate, shared by the step that writes the

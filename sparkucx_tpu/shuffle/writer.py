@@ -262,6 +262,13 @@ class MapOutputWriter:
     def committed(self) -> bool:
         return self._committed
 
+    @property
+    def released(self) -> bool:
+        """Whether release() dropped the staged rows — a released writer
+        is NOT recoverable state (the manager's recovery ledger checks
+        this before carrying a shuffle across an epoch bump)."""
+        return self._released
+
     def commit(self, num_partitions: int) -> np.ndarray:
         """Compute and publish this map output's size row; returns it.
 

@@ -62,11 +62,12 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Union
 
-from sparkucx_tpu.utils.metrics import (COMPILE_HITS, COMPILE_PROGRAMS,
-                                        COMPILE_SECONDS, G_HBM_IN_USE,
-                                        G_HBM_LIMIT, H_BW, H_FETCH_FIRST,
-                                        H_FETCH_WAIT, H_RETRY_MS,
-                                        H_WAVE_GAP, Histogram,
+from sparkucx_tpu.utils.metrics import (C_PEER_TIMEOUT, C_PROBE_DEAD,
+                                        C_REPLAYS, COMPILE_HITS,
+                                        COMPILE_PROGRAMS, COMPILE_SECONDS,
+                                        G_HBM_IN_USE, G_HBM_LIMIT, H_BW,
+                                        H_FETCH_FIRST, H_FETCH_WAIT,
+                                        H_RETRY_MS, H_WAVE_GAP, Histogram,
                                         parse_labeled)
 
 GRADES = ("info", "warn", "critical")
@@ -127,6 +128,18 @@ class Thresholds:
     bw_min_gbps: float = 0.05          # below this the link never showed
     #                                    real throughput — timing noise on
     #                                    tiny exchanges, not utilization
+    # peer_timeout: the watchdog (failure.collectiveTimeoutMs) declared a
+    # collective dead. ONE expiry is already a finding — a hang the fence
+    # converted into a typed error is never noise — critical once
+    # expiries repeat or the probe confirmed dead devices.
+    peer_timeout_critical: int = 3
+    # replay_storm: exchanges burning their replay budget. A single
+    # replay is the policy doing its job (quiet); repeated replays mean
+    # the fault is persistent and failfast + operator attention beats
+    # silently re-running (half the default failure.replayBudget=2 per
+    # the report-window rule, summed across the retained reports).
+    replay_warn: int = 2
+    replay_critical: int = 4
     # padding_waste: wire bytes / real payload bytes (plan.RaggedLayout).
     # A P=8 dense exchange at the default capacityFactor pays ~16x even
     # perfectly balanced — warn territory (the ragged-capable transport
@@ -701,10 +714,98 @@ def _rule_padding_waste(view: ClusterView,
         trace_ids=[r.get("trace_id", "")])]
 
 
+def _rule_peer_timeout(view: ClusterView,
+                       th: Thresholds) -> List[Finding]:
+    """The collective watchdog fired: a distributed rendezvous or an
+    in-flight collective outlived ``failure.collectiveTimeoutMs`` and
+    was converted into PeerLostError instead of hanging the survivors.
+    Evidence is the probe verdict the expiry path gathered
+    (``failure.probe.dead`` — devices the liveness probe found dead) and
+    the stuck exchanges' trace ids (their reports carry the typed error).
+    Never gated by a noise floor: a deadline expiry is a real event by
+    construction — the fence already filtered the noise."""
+    n = int(view.counters.get(C_PEER_TIMEOUT, 0.0))
+    if n < 1:
+        return []
+    dead = int(view.counters.get(C_PROBE_DEAD, 0.0))
+    stuck = [r for r in view.reports
+             if "PeerLostError" in str(r.get("error") or "")]
+    trace_ids = sorted({r.get("trace_id", "") for r in stuck
+                        if r.get("trace_id")})
+    return [Finding(
+        rule="peer_timeout",
+        grade="critical" if n >= th.peer_timeout_critical or dead > 0
+        else "warn",
+        summary=(f"{n} collective deadline expir{'ies' if n != 1 else 'y'}"
+                 f" — a peer stopped answering mid-exchange"
+                 + (f"; the liveness probe found {dead} dead device(s)"
+                    if dead else
+                    " (probe found no dead local device: suspect a "
+                    "remote process or the fabric)")),
+        evidence={"timeouts": n, "probe_dead_devices": dead,
+                  "stuck_exchanges": [r.get("shuffle_id") for r in stuck]},
+        conf_key="spark.shuffle.tpu.failure.collectiveTimeoutMs",
+        remediation=("remesh over the survivors (node.remesh / the "
+                     "recovery controller) and replay — "
+                     "failure.policy=replay automates both; if the peer "
+                     "is alive but slow, raise "
+                     "failure.collectiveTimeoutMs above its worst "
+                     "honest exchange"),
+        trace_ids=trace_ids)]
+
+
+def _rule_replay_storm(view: ClusterView,
+                       th: Thresholds) -> List[Finding]:
+    """Exchanges are living on the replay policy: the retained report
+    window shows replays at or past half the default budget — each one a
+    full re-plan + re-pack + re-dispatch of the whole exchange. One
+    replay is the policy absorbing a blip (quiet); a storm means the
+    underlying fault is persistent and the job is paying exchange-sized
+    retries to hide it."""
+    replayed = [r for r in view.reports if int(r.get("replays", 0)) > 0]
+    window = sum(int(r.get("replays", 0)) for r in replayed)
+    # the cumulative counter floors the window: replays whose reports
+    # were evicted from the retained ring still count
+    total = max(window, int(view.counters.get(C_REPLAYS, 0.0)))
+    if total < th.replay_warn:
+        return []
+    burned = sum(float(r.get("replay_ms", 0.0)) for r in replayed)
+    evicted = total - window
+    if replayed:
+        where = (f"across {len(replayed)} shuffle(s) "
+                 f"({burned:.0f} ms burned in failed attempts)"
+                 + (f", {evicted} more outside the retained report "
+                    f"window" if evicted else ""))
+    else:
+        # counter-only evidence: the replayed reports themselves were
+        # evicted — say so instead of claiming "0 shuffles, 0 ms"
+        where = ("all outside the retained report window "
+                 "(cumulative shuffle.replay.count)")
+    return [Finding(
+        rule="replay_storm",
+        grade="critical" if total >= th.replay_critical else "warn",
+        summary=(f"{total} exchange replays {where} — the "
+                 f"replay policy is absorbing a persistent fault"),
+        evidence={"replays": total, "window_replays": window,
+                  "shuffle_ids": sorted({r.get("shuffle_id")
+                                         for r in replayed}),
+                  "replay_ms": round(burned, 1)},
+        conf_key="spark.shuffle.tpu.failure.policy",
+        remediation=("find the recurring fault (peer_timeout / flight "
+                     "ring 'replay' events name it); if it cannot be "
+                     "fixed, failure.policy=failfast surfaces it to the "
+                     "host framework instead of silently re-running, "
+                     "and failure.replayBudget bounds what each shuffle "
+                     "may spend"),
+        trace_ids=sorted({r.get("trace_id", "") for r in replayed
+                          if r.get("trace_id")}))]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
-          _rule_bw_underutilization, _rule_padding_waste)
+          _rule_bw_underutilization, _rule_padding_waste,
+          _rule_peer_timeout, _rule_replay_storm)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

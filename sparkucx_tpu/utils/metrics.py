@@ -75,6 +75,14 @@ WELL_KNOWN_HISTOGRAMS = (H_FETCH_WAIT, H_FETCH_FIRST, H_PEER_ROWS,
                          H_PEER_BYTES, H_RETRY_MS, H_COMPILE_SECS,
                          H_WAVE_GAP, H_BW)
 
+# Failure-domain counters (runtime/watchdog.py, shuffle/manager.py replay
+# policy): ONE place for the names so the watchdog, the replay loop, the
+# doctor's peer_timeout/replay_storm rules and the tests cannot drift.
+C_PEER_TIMEOUT = "failure.peer_timeout.count"  # watchdog deadline expiries
+C_PROBE_DEAD = "failure.probe.dead"            # devices a probe found dead
+C_REPLAYS = "shuffle.replay.count"             # exchange replays executed
+C_REPLAY_MS = "shuffle.replay.ms"              # wall burned by failed tries
+
 # Device-memory gauge families (runtime/devmon.py sampler; per local
 # device index, encoded as a label via :func:`labeled`): ONE place for
 # the names so the sampler, the doctor's hbm_pressure rule and the

@@ -163,3 +163,31 @@ def test_ragged_measure_small(mesh8):
         > lv["uniform"]["dense"]["pad_ratio"]
     assert rec["native_supported"] == \
         ("ragged_vs_dense_speedup" in lv["zipf"])
+
+
+def test_chaos_measure_small(mesh8):
+    """The chaos stage's measurement core at a tiny shape: every cell of
+    the fault matrix ends hang-free in its expected outcome (typed error
+    under failfast, absorbed replay with oracle bytes under replay), and
+    the watchdog drill converts a genuine hang into PeerLostError on
+    time with the abandoned worker accounted in the leaked census."""
+    rec = bench.chaos_measure(rows_per_map=256, maps=2, partitions=8,
+                              val_words=2, timeout_ms=2000.0)
+    assert rec["ok"] is True
+    # dense x {single: 3 sites, waved: 4 sites} x {failfast, replay}
+    assert rec["cells_total"] == 14
+    assert rec["cells_ok"] == rec["cells_total"]
+    for c in rec["cells"]:
+        assert c["hang_free"], c
+        assert c["fault_fired"], c
+        assert c["bytes_ok"], c
+    replayed = [c for c in rec["cells"] if c["policy"] == "replay"
+                and c["site"] in ("exchange", "wave")]
+    assert replayed and all(c["replays"] >= 1 for c in replayed)
+    failfast = [c for c in rec["cells"] if c["policy"] == "failfast"
+                and c["site"] in ("exchange", "wave")]
+    assert failfast and all(c["outcome"] == "typed_error"
+                            for c in failfast)
+    wd = rec["watchdog"]
+    assert wd["outcome"] == "peer_lost" and wd["on_time"]
+    assert wd["leaked_threads"] == 1 and wd["armed_after"] == 0

@@ -419,6 +419,113 @@ def test_padding_waste_quiet_goldens():
     assert diagnose(doc) == []
 
 
+def _peer_lost_report(sid=11, trace="s11.e0.x11"):
+    r = _report(sid=sid, trace=trace, completed=False)
+    r["error"] = ("PeerLostError: collective 'metadata allgather' "
+                  "outlived failure.collectiveTimeoutMs=500")
+    return r
+
+
+def test_peer_timeout_fires_on_watchdog_expiry():
+    """One deadline expiry is already a warn — the fence filtered the
+    noise by construction — with the stuck exchange's trace id and the
+    probe verdict as evidence."""
+    doc = _healthy_doc()
+    doc["counters"]["failure.peer_timeout.count"] = 1.0
+    doc["exchange_reports"].append(_peer_lost_report())
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["peer_timeout"]
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.evidence["timeouts"] == 1
+    assert f.evidence["probe_dead_devices"] == 0
+    assert 11 in f.evidence["stuck_exchanges"]
+    assert "s11.e0.x11" in f.trace_ids
+    assert f.conf_key == "spark.shuffle.tpu.failure.collectiveTimeoutMs"
+    assert "remesh" in f.remediation
+
+
+def test_peer_timeout_critical_goldens():
+    # a probe-confirmed dead device escalates even a single expiry
+    doc = _healthy_doc()
+    doc["counters"]["failure.peer_timeout.count"] = 1.0
+    doc["counters"]["failure.probe.dead"] = 2.0
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["peer_timeout"]
+    assert fs[0].grade == "critical"
+    assert fs[0].evidence["probe_dead_devices"] == 2
+    assert "2 dead device" in fs[0].summary
+    # so does a repeat offender even with healthy local probes — and the
+    # summary redirects suspicion at the remote process / the fabric
+    doc = _healthy_doc()
+    doc["counters"]["failure.peer_timeout.count"] = 3.0
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["peer_timeout"]
+    assert fs[0].grade == "critical"
+    assert "remote process or the fabric" in fs[0].summary
+
+
+def test_peer_timeout_quiet_without_expiry():
+    """No watchdog expiry: quiet even with probe.dead noise from an
+    unrelated health check — the deadline counter is the only trigger
+    (the rule has no noise floor BECAUSE the fence already is one)."""
+    doc = _healthy_doc()
+    doc["counters"]["failure.probe.dead"] = 1.0
+    assert diagnose(doc) == []
+
+
+def _replayed_report(sid=12, trace="s12.e1.x12", replays=1,
+                     replay_ms=40.0):
+    r = _report(sid=sid, trace=trace)
+    r["replays"] = replays
+    r["replay_ms"] = replay_ms
+    return r
+
+
+def test_replay_storm_fires_and_grades():
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_replayed_report(replays=2))
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["replay_storm"]
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.evidence["replays"] == 2
+    assert 12 in f.evidence["shuffle_ids"]
+    assert f.conf_key == "spark.shuffle.tpu.failure.policy"
+    assert "s12.e1.x12" in f.trace_ids
+    # budget-sized totals across shuffles grade critical, with the wall
+    # burned in failed attempts summed as evidence
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_replayed_report(replays=2))
+    doc["exchange_reports"].append(
+        _replayed_report(sid=13, trace="s13.e2.x13", replays=2,
+                         replay_ms=60.0))
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["replay_storm"]
+    assert fs[0].grade == "critical"
+    assert fs[0].evidence["replays"] == 4
+    assert fs[0].evidence["replay_ms"] == 100.0
+
+
+def test_replay_storm_counter_backstop():
+    """Replays whose reports were evicted from the retained ring still
+    count: the cumulative shuffle.replay.count counter floors the
+    report-window sum."""
+    doc = _healthy_doc()
+    doc["counters"]["shuffle.replay.count"] = 5.0
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["replay_storm"]
+    assert fs[0].grade == "critical"
+    assert fs[0].evidence["replays"] == 5
+
+
+def test_replay_storm_quiet_on_single_absorbed_blip():
+    # one replay is the policy doing its job (sub-noise) — quiet
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_replayed_report(replays=1))
+    assert diagnose(doc) == []
+
+
 def test_gauges_attribute_per_process_in_cluster_view():
     """build_view keeps gauges per process (point-in-time values must
     attribute, never sum) and hbm_pressure names the pressed process."""

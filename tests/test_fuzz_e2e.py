@@ -405,3 +405,78 @@ def test_ragged_sweep_vs_oracle(sweep_managers, impl, waved, skew):
             assert sum(rep.wave_payload_rows) == total
     finally:
         m.unregister_shuffle(sid)
+
+
+# -- fault-injected replay sweep (ISSUE-7) ----------------------------------
+# failure.policy=replay under armed fault.exchange.failCount (and the
+# waved pipeline's wave site): every replayed exchange must come back
+# oracle-correct — a re-plan + re-pack + re-dispatch on the same staged
+# state is invisible to the reader except for the report's replay
+# accounting. Budget sits above the sweep's worst failCount so the
+# policy, not exhaustion, decides.
+@pytest.fixture(scope="module")
+def replay_managers(manager):
+    """Per-mode replay-policy managers sharing the module node (the
+    fault injector lives on the node; each leg arms/disarms itself)."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    cache = {}
+
+    def get(waved):
+        if waved not in cache:
+            cmap = {"spark.shuffle.tpu.a2a.impl": "dense",
+                    "spark.shuffle.tpu.failure.policy": "replay",
+                    "spark.shuffle.tpu.failure.replayBudget": "4"}
+            if waved:
+                cmap["spark.shuffle.tpu.a2a.waveRows"] = "48"
+            conf = TpuShuffleConf(cmap, use_env=False)
+            cache[waved] = TpuShuffleManager(manager.node, conf)
+        return cache[waved]
+
+    yield get
+    for m in cache.values():
+        m.stop()
+
+
+@pytest.mark.parametrize("waved", (False, True), ids=("single", "waved"))
+@pytest.mark.parametrize("fail_count", (1, 2, 3))
+def test_replayed_exchange_bytes_match_oracle(replay_managers, waved,
+                                              fail_count):
+    m = replay_managers(waved)
+    site = "wave" if waved else "exchange"
+    seed = fail_count * 10 + int(waved)
+    rng = np.random.default_rng(90_000 + seed)
+    M, R, n = 3, 8, 120
+    sid = 93_000 + seed
+    h = m.register_shuffle(sid, M, R)
+    m.node.faults.arm(site, fail_count=fail_count)
+    try:
+        oracle = {}
+        for mid in range(M):
+            k = rng.integers(0, 1 << 20, size=n).astype(np.int64)
+            v = rng.integers(0, 1 << 30, size=(n, 2)).astype(np.int32)
+            w = m.get_writer(h, mid)
+            w.write(k, v)
+            w.commit(R)
+            for i, kk in enumerate(k):
+                oracle.setdefault(int(kk), []).append(tuple(v[i]))
+        res = m.read(h)                    # faults absorbed, not raised
+        got = {}
+        nrows = 0
+        for r, (ks, vs) in res.partitions():
+            for i, kk in enumerate(ks):
+                got.setdefault(int(kk), []).append(tuple(vs[i]))
+            nrows += len(ks)
+        assert nrows == M * n
+        assert set(got) == set(oracle)
+        for kk in oracle:
+            assert sorted(got[kk]) == sorted(oracle[kk]), f"key {kk}"
+        rep = m.report(sid)
+        assert rep.replays == fail_count   # one re-run per injected hit
+        assert rep.replay_ms > 0.0
+        assert rep.error is None and rep.completed
+        if waved:
+            assert rep.waves >= 2, "sweep shape must actually wave"
+    finally:
+        m.node.faults.disarm(site)
+        m.unregister_shuffle(sid)

@@ -53,6 +53,89 @@ def test_remesh_rejects_empty():
         node.close()
 
 
+def test_ledger_replays_staged_state_across_remesh(manager_factory, rng):
+    """failure.policy=replay: an epoch bump no longer drops a fully
+    staged shuffle — the recovery ledger re-registers it under the new
+    epoch, the stale handle re-pins transparently, and the exchange
+    replays on the surviving mesh to oracle-correct bytes with the
+    replay accounted on the report."""
+    import jax
+
+    mgr = manager_factory({"spark.shuffle.tpu.failure.policy": "replay"})
+    node = mgr.node
+    h = mgr.register_shuffle(60, num_maps=3, num_partitions=8)
+    keys = {m: rng.integers(0, 1 << 20, size=100).astype(np.int64)
+            for m in range(3)}
+    for m in range(3):
+        w = mgr.get_writer(h, m)
+        w.write(keys[m])
+        w.commit(8)
+
+    node.remesh(devices=jax.devices()[:6], reason="2 devices lost")
+    res = mgr.read(h)                     # stale handle replays, no raise
+    got = np.sort(np.concatenate([k for _, (k, _) in res.partitions()]))
+    want = np.sort(np.concatenate(list(keys.values())))
+    assert got.tolist() == want.tolist()
+    rep = mgr.report(60)
+    assert rep.replays >= 1
+    assert h.epoch == node.epochs.current  # handle re-pinned, reusable
+    total = sum(k.shape[0] for _, (k, _) in mgr.read(h).partitions())
+    assert total == 300                    # second read needs no replay
+    mgr.unregister_shuffle(60)
+
+
+def test_ledger_budget_exhausted_across_repeat_remesh(manager_factory,
+                                                     rng):
+    """The budget is cumulative per shuffle across bumps: one re-pin per
+    failure.replayBudget=1, then the next remesh fails the handle typed
+    — exactly the failfast contract, surfaced late instead of never."""
+    mgr = manager_factory({
+        "spark.shuffle.tpu.failure.policy": "replay",
+        "spark.shuffle.tpu.failure.replayBudget": "1"})
+    h = mgr.register_shuffle(61, num_maps=1, num_partitions=4)
+    w = mgr.get_writer(h, 0)
+    w.write(rng.integers(0, 1 << 20, size=32).astype(np.int64))
+    w.commit(4)
+    mgr.node.epochs.bump("first loss")
+    total = sum(k.shape[0] for _, (k, _) in mgr.read(h).partitions())
+    assert total == 32                     # budget spent on this re-pin
+    mgr.node.epochs.bump("second loss")
+    with pytest.raises(StaleEpochError, match="replay budget"):
+        mgr.read(h)
+    mgr.unregister_shuffle(61)
+
+
+def test_failfast_remesh_still_fences_stale_handles(manager_factory, rng):
+    """The default policy keeps the old contract bit-for-bit: a remesh
+    drops even fully staged shuffles and stale handles die typed —
+    nothing replays behind the host framework's back."""
+    mgr = manager_factory()                # failfast default
+    h = mgr.register_shuffle(62, num_maps=2, num_partitions=4)
+    for m in range(2):
+        w = mgr.get_writer(h, m)
+        w.write(rng.integers(0, 1 << 20, size=16).astype(np.int64))
+        w.commit(4)
+    mgr.node.epochs.bump("device loss")
+    with pytest.raises(StaleEpochError):
+        mgr.read(h)
+    assert mgr.report(62) is None or mgr.report(62).replays == 0
+
+
+def test_partially_staged_shuffle_drops_from_ledger(manager_factory, rng):
+    """Replay policy, but one map never committed: its rows are
+    unrecoverable without re-running the map task (the host framework's
+    job), so the bump drops the whole shuffle exactly as before."""
+    mgr = manager_factory({"spark.shuffle.tpu.failure.policy": "replay"})
+    h = mgr.register_shuffle(63, num_maps=2, num_partitions=4)
+    w = mgr.get_writer(h, 0)
+    w.write(rng.integers(0, 1 << 20, size=16).astype(np.int64))
+    w.commit(4)
+    mgr.get_writer(h, 1)                   # staged but never committed
+    mgr.node.epochs.bump("loss mid-stage")
+    with pytest.raises(StaleEpochError):
+        mgr.read(h)
+
+
 def test_epoch_bump_releases_writer_buffers(manager_factory, rng, tmp_path):
     """A remesh drops shuffle state; the dropped writers' pinned arena
     blocks must return to the pool and their spill files must be deleted

@@ -1,0 +1,279 @@
+"""Collective watchdog (runtime/watchdog.py): the deadline fence that
+converts a peer-death hang into PeerLostError — arm/expire/disarm,
+nested fenced sections, probe-on-expiry, flight correlation, the leaked
+worker-thread census, the disabled fast path, and the process-global
+install/uninstall the node drives."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.runtime.failures import (FlightRecorder, PeerLostError,
+                                           TransientError)
+from sparkucx_tpu.runtime.watchdog import (NULL_WATCHDOG, Watchdog,
+                                           configure_from_conf,
+                                           current_watchdog,
+                                           set_global_watchdog)
+from sparkucx_tpu.utils.metrics import (C_PEER_TIMEOUT, C_PROBE_DEAD,
+                                        Metrics)
+
+
+@pytest.fixture(autouse=True)
+def _restore_global():
+    """Every test leaves the process-global fence as it found it."""
+    before = current_watchdog()
+    yield
+    set_global_watchdog(before if before is not NULL_WATCHDOG else None)
+
+
+# -- arm / run / disarm ------------------------------------------------------
+def test_disabled_runs_inline_on_caller_thread():
+    wd = Watchdog(0.0)
+    assert not wd.enabled
+    tid = []
+    assert wd.call(lambda: tid.append(threading.get_ident()) or 41) == 41
+    assert tid == [threading.get_ident()]      # no worker thread at all
+    assert wd.armed() == [] and wd.leaked() == 0
+
+
+def test_enabled_returns_value_and_disarms():
+    wd = Watchdog(5_000.0)
+    seen = []
+    assert wd.call(lambda: seen.append(wd.armed()) or "ok",
+                   what="happy path") == "ok"
+    # armed WHILE running, empty after
+    assert len(seen[0]) == 1 and seen[0][0]["what"] == "happy path"
+    assert wd.armed() == [] and wd.expiries == 0 and wd.leaked() == 0
+
+
+def test_worker_exception_is_relayed():
+    wd = Watchdog(5_000.0)
+    with pytest.raises(ValueError, match="boom"):
+        wd.call(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    assert wd.armed() == [] and wd.expiries == 0
+
+
+def test_expiry_raises_peer_lost_on_time_and_counts():
+    metrics = Metrics()
+    wd = Watchdog(150.0, metrics=metrics)
+    release = threading.Event()
+    t0 = time.perf_counter()
+    with pytest.raises(PeerLostError, match="collectiveTimeoutMs"):
+        wd.call(release.wait, what="dead allgather")
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    assert wall_ms < 150.0 + 2_000.0          # the deadline held
+    assert wd.expiries == 1
+    assert metrics.get(C_PEER_TIMEOUT) == 1.0
+    assert isinstance(PeerLostError("x"), TransientError)  # replayable
+    # the abandoned worker is in the census until it returns...
+    assert wd.leaked() == 1 and wd.armed() == []
+    release.set()
+    deadline = time.monotonic() + 5
+    while wd.leaked() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert wd.leaked() == 0                    # ...then ages out
+
+
+def test_per_call_timeout_override():
+    wd = Watchdog(60_000.0)
+    release = threading.Event()
+    try:
+        with pytest.raises(PeerLostError):
+            wd.call(release.wait, what="override", timeout_ms=100.0)
+    finally:
+        release.set()
+
+
+def test_nested_fenced_sections_stack():
+    wd = Watchdog(5_000.0)
+    depths = []
+
+    def inner():
+        depths.append([e["what"] for e in wd.armed()])
+        return 2
+
+    def outer():
+        return wd.call(inner, what="inner exchange") + 1
+
+    assert wd.call(outer, what="outer exchange") == 3
+    assert depths == [["outer exchange", "inner exchange"]]
+    assert wd.armed() == []
+
+
+def test_inner_expiry_fails_the_outer_section_typed():
+    """A nested hang surfaces as PeerLostError through BOTH fences —
+    the outer section must re-raise the inner verdict, not convert it
+    into its own expiry (its worker finished: finished = disarmed)."""
+    wd = Watchdog(200.0)
+    release = threading.Event()
+    try:
+        with pytest.raises(PeerLostError):
+            # outer deadline is far out: the INNER fence must trip and
+            # its typed verdict relay through the outer worker
+            wd.call(lambda: wd.call(release.wait, what="inner"),
+                    what="outer", timeout_ms=30_000.0)
+        assert wd.expiries == 1                # inner only
+        assert wd.leaked() == 1                # inner's worker
+    finally:
+        release.set()
+
+
+# -- expiry side effects: probe, flight, census ------------------------------
+class _StubHealth:
+    def __init__(self, verdict, delay_s=0.0):
+        self.verdict = verdict
+        self.delay_s = delay_s
+        self.timeout_ms = 500.0
+        self.probes = 0
+
+    def probe(self):
+        self.probes += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return dict(self.verdict)
+
+
+def test_expiry_fires_probe_and_counts_dead_devices():
+    metrics = Metrics()
+    health = _StubHealth({"cpu:0": True, "cpu:1": False, "cpu:2": False})
+    wd = Watchdog(100.0, health=health, metrics=metrics)
+    release = threading.Event()
+    try:
+        with pytest.raises(PeerLostError):
+            wd.call(release.wait, what="probe drill")
+    finally:
+        release.set()
+    assert health.probes == 1
+    assert metrics.get(C_PROBE_DEAD) == 2.0
+
+
+def test_stuck_probe_is_not_restacked():
+    """A probe parked in a wedged backend must not gain a sibling on
+    every expiry — the second expiry skips re-probing (verdict
+    unavailable) instead of stacking hung threads."""
+    gate = threading.Event()
+
+    class _WedgedHealth(_StubHealth):
+        def probe(self):
+            self.probes += 1
+            gate.wait(10.0)
+            return dict(self.verdict)
+
+    health = _WedgedHealth({"cpu:0": False})
+    wd = Watchdog(100.0, health=health)
+    release = threading.Event()
+    try:
+        for _ in range(2):
+            with pytest.raises(PeerLostError):
+                wd.call(release.wait, what="wedged probe")
+        assert health.probes == 1              # second expiry skipped it
+    finally:
+        gate.set()
+        release.set()
+
+
+def test_expiry_dumps_postmortem_with_trace_and_verdict(tmp_path):
+    rec = FlightRecorder(out_dir=str(tmp_path))
+    health = _StubHealth({"cpu:0": False})
+    wd = Watchdog(100.0, health=health, flight=rec, metrics=Metrics())
+    rec.begin_trace("s7.e0.x3")
+    release = threading.Event()
+    try:
+        with pytest.raises(PeerLostError, match="s7.e0.x3"):
+            wd.call(release.wait, what="fenced allgather")
+    finally:
+        release.set()
+        rec.end_trace("s7.e0.x3")
+    assert len(rec.dumps) == 1
+    doc = json.loads(open(rec.dumps[0]).read())
+    pm = doc["peer_timeout"]
+    # the postmortem names WHICH exchange was stuck and what the probe saw
+    assert pm["trace"] == "s7.e0.x3"
+    assert pm["what"] == "fenced allgather"
+    assert pm["dead_devices"] == ["cpu:0"]
+    assert pm["leaked_threads"] == 1
+    # the expired section itself is in the stuck snapshot — the expiry
+    # runs BEFORE the fence disarms, so the postmortem names what blew
+    # the deadline, not just whatever fences surrounded it
+    assert [s["what"] for s in pm["stuck_sections"]] == ["fenced allgather"]
+    assert pm["stuck_sections"][0]["trace"] == "s7.e0.x3"
+    kinds = [e["kind"] for e in doc["events"]]
+    assert "peer_timeout" in kinds
+
+
+def test_telemetry_failure_does_not_mask_the_verdict():
+    """A broken probe/flight plane still yields PeerLostError — the
+    typed verdict is the contract; telemetry is best-effort."""
+
+    class _ExplodingHealth:
+        timeout_ms = 100.0
+
+        def probe(self):
+            raise RuntimeError("probe plane down")
+
+    wd = Watchdog(100.0, health=_ExplodingHealth())
+    release = threading.Event()
+    try:
+        with pytest.raises(PeerLostError):
+            wd.call(release.wait, what="broken telemetry")
+    finally:
+        release.set()
+
+
+# -- the process-global fence ------------------------------------------------
+def test_configure_from_conf_installs_global():
+    conf = TpuShuffleConf(
+        {"spark.shuffle.tpu.failure.collectiveTimeoutMs": "1234"},
+        use_env=False)
+    wd = configure_from_conf(conf)
+    assert current_watchdog() is wd
+    assert wd.enabled and wd.timeout_ms == 1234.0
+    set_global_watchdog(None)
+    assert current_watchdog() is NULL_WATCHDOG
+
+
+def test_conf_zero_disables_but_call_sites_stay_unconditional():
+    conf = TpuShuffleConf({}, use_env=False)
+    wd = configure_from_conf(conf)
+    assert current_watchdog() is wd and not wd.enabled
+    assert wd.call(lambda: "direct") == "direct"
+
+
+def test_allgather_blob_rides_the_global_fence():
+    """The metadata-plane wire frames through the installed watchdog:
+    a spy fence sees the allgather's section name."""
+    from sparkucx_tpu.shuffle.distributed import allgather_blob
+
+    class _Spy(Watchdog):
+        def __init__(self):
+            super().__init__(0.0)
+            self.sections = []
+
+        def call(self, fn, *a, what="collective", **kw):
+            self.sections.append(what)
+            return super().call(fn, *a, what=what, **kw)
+
+    spy = _Spy()
+    set_global_watchdog(spy)
+    out = allgather_blob(np.arange(4, dtype=np.int64))
+    assert np.asarray(out).reshape(-1).tolist() == [0, 1, 2, 3]
+    assert "metadata allgather" in spy.sections
+
+
+def test_node_installs_and_close_uninstalls():
+    from sparkucx_tpu.runtime.node import TpuNode
+    conf = TpuShuffleConf(
+        {"spark.shuffle.tpu.failure.collectiveTimeoutMs": "30000"},
+        use_env=False)
+    node = TpuNode.start(conf)
+    try:
+        assert current_watchdog() is node.watchdog
+        assert node.watchdog.enabled
+        assert node.watchdog.health is node.health
+    finally:
+        node.close()
+    assert current_watchdog() is NULL_WATCHDOG
