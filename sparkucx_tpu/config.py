@@ -86,7 +86,8 @@ class TpuShuffleConf:
     _TYPED_PROPS = (
         "coordinator_address", "meta_buffer_size", "min_buffer_size",
         "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
-        "spill_threshold", "spill_dir", "a2a_impl", "sort_impl",
+        "spill_threshold", "spill_dir", "a2a_impl", "a2a_wire",
+        "wire_error_sample_rows", "sort_impl",
         "sort_strips", "combine_compaction", "fetch_granularity",
         "capacity_factor", "cap_buckets", "cap_bucket_growth",
         "wave_rows", "wave_depth", "pack_threads",
@@ -393,6 +394,36 @@ class TpuShuffleConf:
         from sparkucx_tpu.shuffle.alltoall import validate_impl
         return validate_impl(self._get("a2a.impl", "auto"),
                              conf_key=PREFIX + "a2a.impl")
+
+    @property
+    def a2a_wire(self) -> str:
+        """Wire-compression tier, ORTHOGONAL to ``a2a.impl``: raw (exact
+        int32 lanes — the default), int8 (float32 value lanes ride as
+        stochastically-rounded int8 + one f32 scale per row inside the
+        compiled step; keys/partition/size lanes stay exact; ~0.3x the
+        raw wire bytes at wide value rows), or lossless (bit-exact
+        byte-plane+deflate re-encoding of host-staged blocks on the wave
+        drain path). int8 needs a float32 value schema and a real wire
+        move — ineligible reads fall back to raw and the ExchangeReport
+        says so. The allowed set lives in ONE place —
+        shuffle/alltoall.ALLOWED_WIRES — like the impl set."""
+        from sparkucx_tpu.shuffle.alltoall import validate_wire
+        return validate_wire(self._get("a2a.wire", "raw"),
+                             conf_key=PREFIX + "a2a.wire")
+
+    @property
+    def wire_error_sample_rows(self) -> int:
+        """Rows the manager samples per int8-wire exchange to estimate
+        the dequantization error (relative RMS of a round-to-nearest
+        int8 pass over staged float values) — feeds
+        ``ExchangeReport.wire_dequant_error`` and the doctor's
+        ``wire_dequant_error`` rule. 0 disables the estimate."""
+        v = self.get_int("a2a.wireErrorSampleRows", 256)
+        if v < 0:
+            raise ValueError(
+                f"spark.shuffle.tpu.a2a.wireErrorSampleRows={v}: want "
+                f">= 0 (0 = off)")
+        return v
 
     @property
     def sort_impl(self) -> str:

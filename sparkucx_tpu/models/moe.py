@@ -36,7 +36,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from sparkucx_tpu.ops.partition import counts_from_sorted
 from sparkucx_tpu.shuffle.alltoall import (
-    exchange, exchange_quantized, ragged_shuffle)
+    exchange, exchange_quantized, int8_wire_words, ragged_shuffle,
+    wire_noise_seed)
 
 
 @dataclass(frozen=True)
@@ -47,12 +48,28 @@ class MoEConfig:
     tokens_per_shard: int = 64     # static per-(dp,ep)-shard token count
     capacity_factor: float = 2.0   # exchange + expert capacity headroom
     impl: str = "auto"             # data-plane implementation
-    wire: str = "f32"              # f32 | int8 (wire-quantized dispatch:
-                                   # 4x fewer ICI bytes, STE gradients)
+    # Wire tier of the dispatch/combine collectives — the MODEL-side
+    # face of the production a2a.wire contract: "raw" moves exact f32
+    # rows, "int8" rides the same stochastic-int8+per-row-scale lane
+    # format the a2a.wire=int8 read path ships (4x fewer ICI bytes, STE
+    # gradients). "f32" is accepted as a legacy alias of "raw".
+    wire: str = "raw"
 
     @property
     def recv_capacity(self) -> int:
         return max(8, int(self.tokens_per_shard * self.capacity_factor))
+
+    @property
+    def wire_int8(self) -> bool:
+        if self.wire in ("raw", "f32"):
+            return False
+        if self.wire == "int8":
+            return True
+        raise ValueError(
+            f"MoEConfig.wire={self.wire!r}: want raw|int8 (the a2a.wire "
+            f"tiers the exchange carries; 'f32' = legacy raw alias — "
+            f"lossless is a host-staging tier, meaningless inside a "
+            f"compiled training step)")
 
 
 def init_params(rng: jax.Array, cfg: MoEConfig) -> Dict[str, jnp.ndarray]:
@@ -107,15 +124,20 @@ def _moe_shard(params, x, seed, *, cfg: MoEConfig, ep_axis: str,
     counts = counts_from_sorted(jnp.take(dest, order),
                                 ep_size).astype(jnp.int32)
     seed = jnp.asarray(seed, jnp.int32).reshape(())
-    if cfg.wire == "int8":
-        recv = exchange_quantized(x_sorted, counts, seed * 2, ep_axis,
+    if cfg.wire_int8:
+        # stream 0 of the shared seed discipline (alltoall.wire_noise_seed)
+        # — the combine below takes stream 1, and each exchange's backward
+        # pass derives stream 3 of ITS seed, so no two moves in one step
+        # ever reuse a rounding-noise realization
+        recv = exchange_quantized(x_sorted, counts,
+                                  wire_noise_seed(seed, 0), ep_axis,
                                   cap_out, cfg.impl)
     else:
         recv = exchange(x_sorted, counts, ep_axis, cap_out, cfg.impl)
 
     # -- local expert assignment of received tokens ----------------------
     shard_id = jax.lax.axis_index(ep_axis)
-    if cfg.wire == "int8":
+    if cfg.wire_int8:
         # lossy wire: the expert id must travel WITH the token as lossless
         # integer rows (its own small exchange) — recomputing argmax on
         # dequantized rows would disagree with the sender whenever the
@@ -173,9 +195,10 @@ def _moe_shard(params, x, seed, *, cfg: MoEConfig, ep_axis: str,
     out_recv = jnp.take(out_sorted, jnp.argsort(eorder), axis=0)
     # reverse exchange: send back what we received (sizes = what each peer
     # sent us); result arrives in our original destination-sorted layout
-    if cfg.wire == "int8":
+    if cfg.wire_int8:
         back = exchange_quantized(out_recv, recv_sizes.astype(jnp.int32),
-                                  seed * 2 + 1, ep_axis, T, cfg.impl)
+                                  wire_noise_seed(seed, 1), ep_axis, T,
+                                  cfg.impl)
     else:
         back = exchange(out_recv, recv_sizes.astype(jnp.int32), ep_axis,
                         T, cfg.impl)                    # [T, D]
@@ -184,20 +207,84 @@ def _moe_shard(params, x, seed, *, cfg: MoEConfig, ep_axis: str,
     return out @ params["wout"]
 
 
+def exchange_traffic(cfg: MoEConfig, tokens: int) -> Tuple[int, int]:
+    """(payload_bytes, wire_bytes) ONE forward's dispatch+combine
+    collectives move for ``tokens`` global tokens — the same
+    payload-vs-achieved-wire split the production ExchangeReport
+    carries, from the same lane arithmetic
+    (``alltoall.int8_wire_words``): every token row crosses the ep axis
+    twice (dispatch + combine) at d_model f32 lanes, and the int8 tier
+    additionally ships the exact expert-id rows (one int32 lane)."""
+    payload = 2 * tokens * cfg.d_model * 4
+    if not cfg.wire_int8:
+        return payload, payload
+    # the int8 tier runs a THIRD collective — the exact expert-id rows
+    # (one int32 lane each): a real exchange whose payload equals its
+    # wire cost, counted on BOTH sides so the cumulative wire/payload
+    # quotient stays internally consistent
+    ids = tokens * 4
+    wire = 2 * tokens * int8_wire_words(cfg.d_model) * 4 + ids
+    return payload + ids, wire
+
+
+def _record_exchange_traffic(cfg: MoEConfig, x,
+                             backward: bool = False) -> None:
+    """Host-side telemetry hook: MoE dispatch traffic lands in the SAME
+    cumulative counters the production read path feeds
+    (``shuffle.payload.bytes`` / ``shuffle.wire.bytes`` — summed across
+    processes by doctor.build_view), plus its own ``moe.exchange.*``
+    attribution, so expert-parallel traffic shows up in stats/doctor
+    like every other exchange instead of bypassing the plane. No-op at
+    trace time (a jitted caller accounts through its own host wrapper —
+    make_train_step) and never raises into the model."""
+    if isinstance(x, jax.core.Tracer):
+        return
+    try:
+        from sparkucx_tpu.runtime.node import TpuNode
+        from sparkucx_tpu.utils.metrics import GLOBAL_METRICS
+        node = TpuNode._instance
+        metrics = node.metrics if node is not None \
+            and not getattr(node, "_closed", True) else GLOBAL_METRICS
+        tokens = int(x.shape[0])
+        payload, wire = exchange_traffic(cfg, tokens)
+        if backward and cfg.wire_int8:
+            # the exact expert-id exchange is integer routing metadata —
+            # it has no backward counterpart, only the two quantized
+            # value moves differentiate
+            payload -= tokens * 4
+            wire -= tokens * 4
+        metrics.inc("shuffle.payload.bytes", float(payload))
+        metrics.inc("shuffle.wire.bytes", float(wire))
+        metrics.inc("moe.exchange.count", 2.0)
+        metrics.inc("moe.exchange.rows", float(2 * tokens))
+    except Exception:
+        pass
+
+
 def forward(params, x, mesh: Mesh, cfg: MoEConfig,
             dp_axis: str = "dp", ep_axis: str = "ep", seed=0):
     """Full-model forward under shard_map. x: [B, D] global tokens,
     B = dp*ep*tokens_per_shard. ``seed``: step counter for the wire-
-    quantization noise stream (ignored for f32 wire)."""
+    quantization noise stream (ignored for the raw wire)."""
+    _record_exchange_traffic(cfg, x)
+    return _forward_fn(cfg, mesh, dp_axis, ep_axis)(
+        params, x, jnp.asarray(seed, jnp.int32).reshape(1))
+
+
+@functools.lru_cache(maxsize=64)
+def _forward_fn(cfg: MoEConfig, mesh: Mesh, dp_axis: str, ep_axis: str):
+    """ONE jitted shard_map callable per (cfg, mesh, axes) — rebuilding
+    the closure per forward() call hands pjit a fresh function identity
+    every time, so nothing ever hits the executable cache and every
+    eager forward re-traces (tens of seconds on CPU SPMD)."""
     ep_size = dict(zip(mesh.axis_names, mesh.devices.shape))[ep_axis]
     fn = functools.partial(_moe_shard, cfg=cfg, ep_axis=ep_axis,
                            ep_size=ep_size)
-    sm = jax.shard_map(
+    return jax.jit(jax.shard_map(
         fn, mesh=mesh,
         in_specs=(param_specs(cfg, dp_axis, ep_axis), P((dp_axis, ep_axis)),
                   P()),
-        out_specs=P((dp_axis, ep_axis)))
-    return sm(params, x, jnp.asarray(seed, jnp.int32).reshape(1))
+        out_specs=P((dp_axis, ep_axis))))
 
 
 def loss_fn(params, x, y, mesh, cfg, dp_axis="dp", ep_axis="ep", seed=0):
@@ -222,7 +309,7 @@ def make_train_step(mesh: Mesh, cfg: MoEConfig, lr: float = 1e-3,
     # donate params + optimizer state: the updated pytrees reuse the same
     # HBM instead of holding two copies live across the update
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def step(params, opt_state, x, y, step_idx=None):
+    def _jit_step(params, opt_state, x, y, step_idx=None):
         # the wire-quantization noise stream must advance every step; by
         # default ride the optimizer's own step counter so plain
         # step(params, opt_state, x, y) callers get fresh noise for free
@@ -239,5 +326,15 @@ def make_train_step(mesh: Mesh, cfg: MoEConfig, lr: float = 1e-3,
         updates, opt_state = opt.update(grads, opt_state)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
+
+    def step(params, opt_state, x, y, step_idx=None):
+        # host wrapper: the telemetry hook is a no-op under tracing, so
+        # a jitted step would never account — record per INVOCATION
+        # here (fwd + the transposed bwd exchange = 2x the forward's
+        # traffic, the gradient-compression cost on the same tier)
+        out = _jit_step(params, opt_state, x, y, step_idx)
+        _record_exchange_traffic(cfg, x)
+        _record_exchange_traffic(cfg, x, backward=True)
+        return out
 
     return init, step

@@ -52,6 +52,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from sparkucx_tpu.meta.segments import exchange_plan
 
@@ -64,6 +65,111 @@ ALL_IMPLS = IMPLS + ("pallas",)
 ALLOWED_IMPLS = ("auto",) + ALL_IMPLS
 
 A2A_IMPL_KEY = "spark.shuffle.tpu.a2a.impl"
+
+# Wire-compression tiers (conf key ``spark.shuffle.tpu.a2a.wire``) — the
+# ORTHOGONAL axis to a2a.impl: the impl picks the collective, the wire
+# tier picks how many bytes each row costs on it (EQuARX's thesis:
+# in-collective quantization buys 2-4x effective bandwidth; PAPERS.md).
+#
+# ``raw``      — int32 transport lanes verbatim (the PR-6 contract).
+# ``int8``     — float32 VALUE lanes ride as stochastic-rounded int8 + one
+#                f32 scale per row, packed into int32 lanes inside the
+#                compiled step; key/partition/size lanes stay exact int
+#                lanes. Lossy (one rounding step per element, unbiased).
+# ``lossless`` — byte-plane + deflate re-encoding of host-staged blocks
+#                on the wave drain path (shuffle/wire.py); bit-exact, the
+#                device collective itself is untouched (Exoshuffle's
+#                library-level-policy posture: the tier lives where the
+#                payload is already host-bound).
+ALLOWED_WIRES = ("raw", "int8", "lossless")
+
+A2A_WIRE_KEY = "spark.shuffle.tpu.a2a.wire"
+
+# Distinct noise streams one training/read step may draw from the same
+# base seed (forward dispatch, forward combine, spare, backward) — the
+# seed discipline every int8 wire move shares (wire_noise_seed below).
+WIRE_SEED_STREAMS = 4
+
+
+def validate_wire(wire: str, conf_key: str = A2A_WIRE_KEY) -> str:
+    """The one validation seam for the wire-compression tier set:
+    config.py and the bench CLI accept exactly ``ALLOWED_WIRES``, and the
+    error names the conf key to turn (the validate_impl discipline)."""
+    if wire not in ALLOWED_WIRES:
+        raise ValueError(
+            f"{conf_key}={wire!r}: want one of {ALLOWED_WIRES} "
+            f"(raw = exact int32 lanes, int8 = quantized float value "
+            f"lanes + per-row scale, lossless = host-side byte-plane "
+            f"compression of staged blocks)")
+    return wire
+
+
+def wire_noise_seed(seed, stream: int):
+    """Derive noise stream ``stream`` (< WIRE_SEED_STREAMS) from a base
+    step seed — THE seed discipline for every int8 wire move sharing one
+    step counter: the MoE dispatch/combine pair, the backward pass's
+    gradient compression, and any caller threading its own counter all
+    space their streams through here, so no two moves in one step ever
+    reuse a rounding-noise realization. Works on traced jnp scalars and
+    host ints alike (int32 ring arithmetic either way)."""
+    import jax.numpy as _jnp
+    if isinstance(seed, (int, np.integer)):
+        return int((int(seed) * WIRE_SEED_STREAMS + int(stream))
+                   & 0x7FFFFFFF)
+    return (_jnp.asarray(seed, _jnp.int32) * WIRE_SEED_STREAMS
+            + _jnp.int32(stream))
+
+
+def int8_wire_words(value_words: int) -> int:
+    """int32 lanes ``value_words`` float32 value lanes cost on the int8
+    wire: the int8 payload packed 4-per-word plus ONE f32 row scale —
+    the lane arithmetic shared by wire_pack_rows/wire_unpack_rows, the
+    plan accounting (plan.wire_row_words) and the MoE traffic recorder,
+    so the format and its accounting cannot drift."""
+    return -(-int(value_words) // 4) + 1
+
+
+def wire_pack_rows(rows: jnp.ndarray, wire_words: int, seed,
+                   quant_impl: str = "auto") -> jnp.ndarray:
+    """Narrow the trailing ``wire_words`` float32-bit-pattern lanes of an
+    int32 row matrix to the int8 wire format, leaving the leading lanes
+    (keys) exact: [n, W] -> [n, W - wire_words + int8_wire_words(...)].
+    Stochastic rounding draws from ``seed`` (a traced int32 scalar — the
+    caller threads a step counter so every exchange sees fresh noise)."""
+    from sparkucx_tpu.ops.pallas.quant import quantize_rows
+    n, width = rows.shape
+    head = width - wire_words
+    exact = rows[:, :head]
+    vals = jax.lax.bitcast_convert_type(rows[:, head:], jnp.float32)
+    q, scale = quantize_rows(vals, seed, impl=quant_impl)
+    pad = (-wire_words) % 4
+    if pad:
+        q = jnp.concatenate([q, jnp.zeros((n, pad), jnp.int8)], axis=1)
+    qi = jax.lax.bitcast_convert_type(
+        q.reshape(n, -1, 4), jnp.int32).reshape(n, -1)
+    si = jax.lax.bitcast_convert_type(scale, jnp.int32).reshape(n, 1)
+    return jnp.concatenate([exact, qi, si], axis=1)
+
+
+def wire_unpack_rows(rows: jnp.ndarray, width: int,
+                     wire_words: int) -> jnp.ndarray:
+    """Inverse of :func:`wire_pack_rows` (up to the rounding noise):
+    expand the int8 wire lanes back to float32 bit patterns in int32
+    lanes — [n, W'] -> [n, ``width``]. Zero wire rows (transport padding
+    past the delivered total) decode to zero rows."""
+    from sparkucx_tpu.ops.pallas.quant import dequantize_rows
+    n = rows.shape[0]
+    head = width - wire_words
+    qw = -(-wire_words // 4)
+    q = jax.lax.bitcast_convert_type(
+        rows[:, head:head + qw].reshape(n, qw, 1), jnp.int8
+    ).reshape(n, qw * 4)[:, :wire_words]
+    scale = jax.lax.bitcast_convert_type(
+        rows[:, head + qw:head + qw + 1], jnp.float32)
+    vals = dequantize_rows(q, scale, jnp.float32)
+    return jnp.concatenate(
+        [rows[:, :head], jax.lax.bitcast_convert_type(vals, jnp.int32)],
+        axis=1)
 
 
 def validate_impl(impl: str, conf_key: str = A2A_IMPL_KEY) -> str:
@@ -335,28 +441,18 @@ def exchange_quantized(data: jnp.ndarray, local_sizes: jnp.ndarray,
 
 
 def _quantized_move(data, local_sizes, axis_name, out_capacity, impl, seed):
-    from sparkucx_tpu.ops.pallas.quant import dequantize_rows, quantize_rows
+    # the SAME int8 wire-lane format the production a2a.wire=int8 read
+    # path ships (wire_pack_rows/wire_unpack_rows): all-value rows here,
+    # key-prefixed rows there — one layout, one accounting formula
     in_dtype = data.dtype
     n, w = data.shape
-    pad = (-w) % 4
-    if pad:
-        data = jnp.concatenate(
-            [data, jnp.zeros((n, pad), data.dtype)], axis=1)
-    q, scale = quantize_rows(data, seed)            # int8 [n, w+pad], f32 [n,1]
-    packed = jnp.concatenate([
-        jax.lax.bitcast_convert_type(
-            q.reshape(n, -1, 4), jnp.int32).reshape(n, -1),
-        jax.lax.bitcast_convert_type(scale, jnp.int32).reshape(n, 1),
-    ], axis=1)
+    rows = jax.lax.bitcast_convert_type(
+        data.astype(jnp.float32), jnp.int32)
+    packed = wire_pack_rows(rows, w, seed)
     r = ragged_shuffle(packed, local_sizes, axis_name,
                        out_capacity=out_capacity, impl=impl)
-    qw = packed.shape[1] - 1
-    q_out = jax.lax.bitcast_convert_type(
-        r.data[:, :qw].reshape(out_capacity, qw, 1), jnp.int8
-    ).reshape(out_capacity, qw * 4)[:, :w]
-    s_out = jax.lax.bitcast_convert_type(
-        r.data[:, qw:], jnp.float32)                # [cap, 1]
-    out = dequantize_rows(q_out, s_out, jnp.float32)
+    out = jax.lax.bitcast_convert_type(
+        wire_unpack_rows(r.data, w, w), jnp.float32)
     poison = jnp.where(r.overflow[0], jnp.nan, 0.0)
     return (out + poison).astype(in_dtype), r.recv_sizes
 
@@ -371,11 +467,12 @@ def _exchange_quantized_fwd(data, local_sizes, seed, axis_name,
 
 def _exchange_quantized_bwd(axis_name, out_capacity, impl, res, g):
     local_sizes, recv_sizes, seed, cap_in = res
-    # independent noise stream for the gradient compression; the output
-    # dtype matches the primal input (the forward casts back), so the
-    # cotangent g already carries the right dtype through _quantized_move
+    # independent noise stream for the gradient compression (the shared
+    # seed discipline: stream 3 = backward); the output dtype matches the
+    # primal input (the forward casts back), so the cotangent g already
+    # carries the right dtype through _quantized_move
     gb, _ = _quantized_move(g, recv_sizes, axis_name, cap_in, impl,
-                            seed ^ jnp.int32(0x5DEECE6))
+                            wire_noise_seed(seed, 3))
     return gb, jnp.zeros_like(local_sizes), jnp.zeros_like(seed)
 
 

@@ -39,10 +39,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from sparkucx_tpu.shuffle.plan import ShufflePlan
+from sparkucx_tpu.shuffle.plan import ShufflePlan, wire_row_words
 from sparkucx_tpu.shuffle.reader import (
     PendingExchangeBase, ShuffleReaderResult, _blocked_map, _build_step,
-    max_recv_rows)
+    max_recv_rows, seeded_nvalid)
 from sparkucx_tpu.utils.logging import get_logger
 
 log = get_logger("shuffle.distributed")
@@ -289,11 +289,16 @@ class PendingDistributedShuffle(PendingExchangeBase):
 
     def __init__(self, mesh, axis, plan, local_rows, local_nvalid,
                  shard_ids, val_shape, val_dtype, hier_mesh, dcn_axis,
-                 on_done=None, admit=None):
+                 on_done=None, admit=None, wire_seed: int = 0):
         self._mesh, self._axis = mesh, axis
         self._plan = plan
         self._local_rows, self._local_nvalid = local_rows, local_nvalid
         self._shard_ids = list(shard_ids)
+        # int8-wire noise base — the manager's exchange seq, identical
+        # on every process by the collective-read lockstep; per-shard
+        # streams derive from GLOBAL shard ids (seeded_nvalid), so the
+        # noise a shard draws never depends on process placement
+        self._wire_seed = int(wire_seed)
         self._val_shape, self._val_dtype = val_shape, val_dtype
         self._hier_mesh, self._dcn_axis = hier_mesh, dcn_axis
         L, cap_in, width = local_rows.shape
@@ -327,7 +332,9 @@ class PendingDistributedShuffle(PendingExchangeBase):
             self._local_rows.reshape(self._L * self._cap_in, self._width))
         nvalid = jax.make_array_from_process_local_data(
             self._sharding,
-            self._local_nvalid.astype(np.int32).reshape(self._L))
+            seeded_nvalid(cur, self._local_nvalid,
+                          self._wire_seed + self._attempt,
+                          shard_ids=self._shard_ids))
         self._out = step(payload, nvalid)
 
     def _result_inner(self):
@@ -380,7 +387,9 @@ class PendingDistributedShuffle(PendingExchangeBase):
                                                  or cur.ordered):
                     from sparkucx_tpu.ops.pallas.ragged_a2a import \
                         chunk_rows_for
-                    align_chunk = chunk_rows_for(self._width)
+                    # wire-aware: the step aligned on the WIRE row width
+                    align_chunk = chunk_rows_for(
+                        wire_row_words(cur, self._width))
                 elif cur.strips_active():
                     # degenerate 1-shard cluster: step_body takes the
                     # strip fast path (see reader.py resolve)
@@ -426,10 +435,11 @@ def submit_shuffle_distributed(
     dcn_axis: Optional[str] = None,
     on_done=None,
     admit=None,
+    wire_seed: int = 0,
 ) -> PendingDistributedShuffle:
     """Dispatch the multi-process exchange without blocking (collective:
     see :class:`PendingDistributedShuffle`)."""
     return PendingDistributedShuffle(
         mesh, axis, plan, local_rows, local_nvalid, shard_ids,
         val_shape, val_dtype, hier_mesh, dcn_axis, on_done=on_done,
-        admit=admit)
+        admit=admit, wire_seed=wire_seed)

@@ -111,6 +111,23 @@ class ExchangeReport:
     payload_bytes: int = 0
     wire_bytes: int = 0
     pad_ratio: float = 0.0
+    # Wire-compression tier (a2a.wire) accounting: ``wire`` is the
+    # RESOLVED tier this exchange rode (never the conf ask — an int8
+    # request on an int-valued schema resolves to 'raw' and says so
+    # here, the resolved-impl discipline). On int8, ``wire_bytes`` above
+    # already reports the ACHIEVED (narrowed) wire cost — pad_ratio can
+    # sit below 1.0 — and ``wire_dequant_error`` carries the sampled
+    # relative-RMS estimate of the rounding loss (shuffle/wire.py; 0.0
+    # when sampling is off). ``effective_bw_gbps`` is the EQuARX figure:
+    # the link rate a RAW exchange would have needed to match this wall
+    # (= bw_gbps x raw/wire row-width gain; equals bw_gbps off-tier).
+    # ``lossless_*``: measured byte-plane+deflate size of the
+    # host-staged blocks on the lossless drain path vs the real payload.
+    wire: str = "raw"
+    wire_dequant_error: float = 0.0
+    effective_bw_gbps: float = 0.0
+    lossless_bytes: int = 0
+    lossless_ratio: float = 0.0
     peer_rows: List[int] = field(default_factory=list)
     peer_bytes: List[int] = field(default_factory=list)
     skew_ratio: float = 0.0
@@ -169,6 +186,12 @@ class ExchangeReport:
     _t_dispatched: float = field(default=0.0, repr=False)
     _hits0: float = field(default=0.0, repr=False)
     _prog0: float = field(default=0.0, repr=False)
+    # raw/wire row-width gain of the int8 tier (1.0 elsewhere) — feeds
+    # effective_bw_gbps at settlement
+    _wire_gain: float = field(default=1.0, repr=False)
+    # exchange sequence (the x<seq> of the trace id) — the int8 noise
+    # base every dispatch of this read derives its streams from
+    _seq: int = field(default=0, repr=False)
 
     # public field names, resolved once: to_dict runs per report per
     # doctor/stats/dump pass, and dataclasses.asdict's recursive deepcopy
@@ -273,6 +296,9 @@ class TpuShuffleManager:
         # monotone exchange counter — the seq component of trace ids
         # (reads are collective, so it advances in lockstep cluster-wide)
         self._exchange_seq = 0
+        # warn-once latch: a2a.wire=lossless on a single-shot read is an
+        # inert codec (it rides the wave drain path only)
+        self._warned_inert_lossless = False
         self._lock = threading.Lock()
         # Admission control (a2a.maxBytesInFlight): combined footprint of
         # in-flight submitted exchanges; submit() blocks past the cap
@@ -578,6 +604,7 @@ class TpuShuffleManager:
             # same order on every process, so this per-process counter
             # agrees cluster-wide — the seq third of the trace id.
             self._exchange_seq += 1
+            rep._seq = self._exchange_seq
             rep.trace_id = format_trace_id(
                 handle.shuffle_id, self.node.epochs.current,
                 self._exchange_seq)
@@ -946,6 +973,11 @@ class TpuShuffleManager:
             from sparkucx_tpu.shuffle.reader import _build_step
             step = _build_step(self.exchange_mesh, self.axis, plan, width)
             sharding = NamedSharding(self.exchange_mesh, PSpec(self.axis))
+        # seeded (int8-wire) steps take [count, seed] per shard — warm
+        # with the widened zero row so the warmed program's signature
+        # matches the real dispatch exactly (reader.seeded_nvalid)
+        from sparkucx_tpu.shuffle.plan import plan_takes_seed
+        lanes = 2 if plan_takes_seed(plan) else 1
         if self.node.is_distributed:
             # only local shards are addressable: assemble the global array
             # from process-local zero blocks, like the real dispatch
@@ -953,12 +985,13 @@ class TpuShuffleManager:
             payload = _jax.make_array_from_process_local_data(
                 sharding, np.zeros((L * plan.cap_in, width), np.int32))
             nvalid = _jax.make_array_from_process_local_data(
-                sharding, np.zeros(L, np.int32))
+                sharding, np.zeros(L * lanes, np.int32))
         else:
             Pn = plan.num_shards
             payload = stage_to_device(
                 np.zeros((Pn * plan.cap_in, width), np.int32), sharding)
-            nvalid = stage_to_device(np.zeros(Pn, np.int32), sharding)
+            nvalid = stage_to_device(np.zeros(Pn * lanes, np.int32),
+                                     sharding)
         out = step(payload, nvalid)
         _jax.block_until_ready(out)
 
@@ -1215,6 +1248,7 @@ class TpuShuffleManager:
                                  if has_vals else 0)
             self._report_volume(rep, plan, nvalid, width,
                                 part_rows=table.sizes.sum(axis=0))
+            self._estimate_wire_error(rep, plan, shard_outputs)
             # Wave-pipelined mode (a2a.waveRows): instead of one giant
             # pack + one monolithic program, split the staged rows into
             # fixed-shape waves and run a software pipeline inside the
@@ -1227,6 +1261,7 @@ class TpuShuffleManager:
                         handle, shard_outputs, nvalid, plan, width,
                         has_vals, val_tail if has_vals else None,
                         val_dtype, rep, timeout, W, distributed=False)
+            self._note_inert_lossless(plan)
             t_pack = time.perf_counter()
             with tracer.span("shuffle.pack", rows=int(nvalid.sum()),
                              trace=rep.trace_id):
@@ -1276,7 +1311,8 @@ class TpuShuffleManager:
                     pending = submit_shuffle(
                         self.exchange_mesh, self.axis, plan,
                         shard_rows, nvalid, vt, val_dtype,
-                        on_done=on_done, admit=admit)
+                        on_done=on_done, admit=admit,
+                        wire_seed=rep._seq)
                 elif self.hierarchical:
                     from sparkucx_tpu.shuffle.hierarchical import \
                         submit_shuffle_hierarchical
@@ -1288,7 +1324,8 @@ class TpuShuffleManager:
                     pending = submit_shuffle(
                         self.exchange_mesh, self.axis, plan,
                         shard_rows, nvalid, vt, val_dtype,
-                        on_done=on_done, admit=admit)
+                        on_done=on_done, admit=admit,
+                        wire_seed=rep._seq)
             rep.dispatch_ms = (time.perf_counter()
                                - rep._t_dispatched) * 1e3
             arm(pending)
@@ -1319,6 +1356,12 @@ class TpuShuffleManager:
         rep.payload_bytes = layout.payload_bytes
         rep.wire_bytes = layout.wire_bytes
         rep.pad_ratio = layout.pad_ratio
+        rep.wire = layout.wire
+        # raw/wire row-width gain — the effective-bandwidth multiplier
+        # the int8 tier earns (1.0 on raw/lossless; the lossless codec
+        # is host-side and must not claim link bandwidth)
+        rep._wire_gain = (width * 4 / layout.wire_row_bytes) \
+            if layout.wire == "int8" and layout.wire_row_bytes else 1.0
         rep.plan_bucket = [int(plan.cap_in), int(plan.cap_out)]
         rep.plan_family = str(plan.family())
         # plain-python arithmetic over the (tiny, per-peer) lists: numpy
@@ -1340,6 +1383,40 @@ class TpuShuffleManager:
         for r, b in zip(rep.peer_rows, rep.peer_bytes):
             metrics.observe(H_PEER_ROWS, float(r))
             metrics.observe(H_PEER_BYTES, float(b))
+
+    def _estimate_wire_error(self, rep: ExchangeReport,
+                             plan: ShufflePlan, slot_outputs) -> None:
+        """Sample the staged float values of an int8-wire read and stamp
+        the dequantization-error estimate (relative RMS of a
+        round-to-nearest int8 pass, shuffle/wire.py) on the report — the
+        evidence the doctor's ``wire_dequant_error`` rule grades.
+        Bounded by ``a2a.wireErrorSampleRows`` (0 = off); never raises
+        into the read path."""
+        from sparkucx_tpu.shuffle.plan import plan_takes_seed
+        limit = self.conf.wire_error_sample_rows
+        if not plan_takes_seed(plan) or limit <= 0:
+            return
+        try:
+            from sparkucx_tpu.shuffle.wire import estimate_dequant_error
+            sample, left = [], limit
+            for outs in slot_outputs:
+                for _keys, vals in outs:
+                    if vals is None or not vals.shape[0]:
+                        continue
+                    take = min(left, vals.shape[0])
+                    sample.append(np.asarray(
+                        vals[:take], dtype=np.float32).reshape(take, -1))
+                    left -= take
+                    if left <= 0:
+                        break
+                if left <= 0:
+                    break
+            if sample:
+                rep.wire_dequant_error = round(
+                    estimate_dequant_error(np.concatenate(sample),
+                                           sample_rows=limit), 6)
+        except Exception:
+            log.debug("wire dequant-error sampling failed", exc_info=True)
 
     @staticmethod
     def _set_wave_wire(rep: ExchangeReport, wplan: ShufflePlan,
@@ -1386,6 +1463,11 @@ class TpuShuffleManager:
                 payload = rep.payload_bytes or rep.rows_global * width * 4
                 gbps = payload / (rep.group_ms * 1e6)
                 rep.bw_gbps = round(gbps, 6)
+                # EQuARX's effective-bandwidth figure: the payload rate
+                # scaled by the raw/wire row-width gain — what a RAW
+                # exchange would have needed from the link to match this
+                # wall. Equals bw_gbps off the int8 tier.
+                rep.effective_bw_gbps = round(gbps * rep._wire_gain, 6)
                 if not rep.stepcache_programs:
                     self.node.metrics.observe(H_BW, gbps)
         except Exception:
@@ -1481,17 +1563,56 @@ class TpuShuffleManager:
         return on_done, arm
 
     # -- capacity learning -------------------------------------------------
-    @staticmethod
-    def _decorated_plan(plan: ShufflePlan, combine, ordered: bool,
+    def _resolve_wire(self, plan: ShufflePlan, has_vals: bool, val_tail,
+                      val_dtype) -> tuple:
+        """Resolve the conf's ``a2a.wire`` ask against what THIS read can
+        actually compress — the (wire, wire_words) pair the plan is
+        stamped with. ``int8`` demands float32 value lanes (keys and int
+        payloads stay exact by the contract) and a real wire move: the
+        hierarchical two-stage exchange, a 1-shard axis (the local move)
+        and the strip-sorted fast path (no collective at all) all
+        resolve to raw — the report's ``wire`` field says which tier
+        ran, never which was asked for. ``lossless`` is dtype-agnostic
+        (bit-exact host codec). Resolution is pure conf/plan/schema
+        facts — identical on every process, SPMD-safe without a
+        collective (the _waves_eligible discipline)."""
+        wire = self.conf.a2a_wire
+        if wire == "raw":
+            return "raw", 0
+        if wire == "lossless":
+            return "lossless", 0
+        reason = None
+        if self.hierarchical:
+            reason = "the hierarchical two-stage exchange is active"
+        elif plan.num_shards == 1 or plan.strips_active():
+            reason = "no wire move exists on this path (1-shard/strips)"
+        elif not has_vals:
+            reason = "keys-only payload (key lanes stay exact)"
+        elif np.dtype(val_dtype) != np.float32:
+            reason = (f"value dtype {np.dtype(val_dtype).str} is not "
+                      f"float32 (int lanes stay exact)")
+        if reason is not None:
+            log.info("a2a.wire=int8 resolves to raw for this read: %s",
+                     reason)
+            return "raw", 0
+        return "int8", value_words(val_tail, val_dtype)
+
+    def _decorated_plan(self, plan: ShufflePlan, combine, ordered: bool,
                         has_vals: bool, val_tail, val_dtype,
                         combine_sum_words: int = 0) -> ShufflePlan:
-        """Validate and stamp the combine/ordered read options onto a
-        plan (shared by the single- and multi-process read paths).
-        combine implies ordered output, so it takes precedence.
-        ``combine_sum_words`` > 0 sums only that many leading transport
-        words of the value row and CARRIES the rest per key (varlen
-        payloads — io/varlen.py)."""
+        """Validate and stamp the combine/ordered read options AND the
+        resolved wire tier onto a plan (shared by the single- and
+        multi-process read paths, and warmup — so a warmed program and
+        the read that follows agree on the full compiled-step family,
+        wire mode included). combine implies ordered output, so it takes
+        precedence. ``combine_sum_words`` > 0 sums only that many
+        leading transport words of the value row and CARRIES the rest
+        per key (varlen payloads — io/varlen.py)."""
         import dataclasses
+        wire, wire_words = self._resolve_wire(plan, has_vals, val_tail,
+                                              val_dtype)
+        plan = dataclasses.replace(plan, wire=wire,
+                                   wire_words=wire_words)
         if combine:
             from sparkucx_tpu.ops.aggregate import check_combinable
             check_combinable(val_tail if has_vals else None,
@@ -1647,13 +1768,10 @@ class TpuShuffleManager:
             # old 16 MiB spawn-amortization guard shrinks to a modest
             # floor that only filters shapes where the copy itself is
             # cheaper than waking the workers (tiny test shuffles).
-            # Worker count comes from conf (the same expression
-            # _pack_executor sizes the pool with), so a single-core
-            # process never even builds the pool.
-            workers = self.conf.pack_threads or self.conf.cores_per_process
-            if workers > 1 and len(slot_outputs) > 1 \
-                    and rows.nbytes >= (1 << 20):
-                ex = self._pack_executor()
+            ex = self._pack_executor_if_parallel() \
+                if len(slot_outputs) > 1 and rows.nbytes >= (1 << 20) \
+                else None
+            if ex is not None:
                 list(ex.map(lambda p: fill(p, pack_threads=1),
                             range(len(slot_outputs))))
             else:
@@ -1680,6 +1798,31 @@ class TpuShuffleManager:
                     max_workers=max(1, int(workers)),
                     thread_name_prefix="sxt-pack")
             return self._pack_pool
+
+    def _note_inert_lossless(self, plan: ShufflePlan) -> None:
+        """``a2a.wire=lossless`` on a read that runs single-shot: the
+        codec engages on the wave drain path only, so nothing will be
+        compressed and the report will show ``lossless_bytes=0``. Warn
+        ONCE (not per read) so the inert conf is visible — the int8
+        tier's ineligible-read log discipline, without re-stamping the
+        plan (wavedness depends on per-read row counts, and flip-
+        flopping the wire family per read size would churn programs)."""
+        if plan.wire == "lossless" and not self._warned_inert_lossless:
+            self._warned_inert_lossless = True
+            log.warning(
+                "a2a.wire=lossless configured but this read runs "
+                "single-shot — the codec rides the wave drain path only "
+                "(set spark.shuffle.tpu.a2a.waveRows); such reads "
+                "report lossless_bytes=0")
+
+    def _pack_executor_if_parallel(self):
+        """The pack fan-out policy in ONE place (staged pack fill, the
+        lossless drain codec): the shared executor when conf sizes it
+        above one worker (``a2a.packThreads``, 0 = coresPerProcess),
+        else None — callers serialize inline and a single-core process
+        never builds the pool."""
+        workers = self.conf.pack_threads or self.conf.cores_per_process
+        return self._pack_executor() if workers > 1 else None
 
     # -- wave-pipelined exchange (a2a.waveRows) ----------------------------
     def _waves_eligible(self, plan: ShufflePlan) -> bool:
@@ -1978,6 +2121,7 @@ class TpuShuffleManager:
             # process shares by construction)
             self._report_volume(rep, plan, nvalid, width,
                                 local_rows=int(nvalid_local.sum()))
+            self._estimate_wire_error(rep, plan, shard_outputs)
         # Wave-pipelined mode, multi-process: the wave count derives from
         # the ALLGATHERED global size row (identical math everywhere), and
         # agree_wave_count allgathers the verdict so a divergent
@@ -1997,6 +2141,7 @@ class TpuShuffleManager:
                 handle, shard_outputs, nvalid, plan, width, has_vals,
                 val_tail if has_vals else None, val_dtype, rep, None,
                 W, distributed=True, shard_ids=shard_ids)
+        self._note_inert_lossless(plan)
         t_pack = time.perf_counter()
         with tracer.span("shuffle.pack", rows=int(nvalid_local.sum()),
                          trace=rep.trace_id if rep is not None else ""):
@@ -2054,7 +2199,8 @@ class TpuShuffleManager:
                     nvalid_local, shard_ids, vt, val_dtype,
                     hier_mesh=self.node.mesh if hier else None,
                     dcn_axis=self.conf.mesh_dcn_axis if hier else None,
-                    on_done=on_done, admit=admit)
+                    on_done=on_done, admit=admit,
+                    wire_seed=rep._seq if rep is not None else 0)
             if rep is not None:
                 rep.dispatch_ms = (time.perf_counter()
                                    - rep._t_dispatched) * 1e3
@@ -2257,6 +2403,9 @@ class PendingWaveShuffle:
         # program by construction, so its cost record speaks for the
         # whole exchange (device-plane join in _finalize)
         self._last_step = None
+        # a2a.wire=lossless drain accounting: [raw_bytes, compressed]
+        # summed over every drained wave's host blocks
+        self._lossless = [0, 0]
 
     # -- lifecycle ---------------------------------------------------------
     def done(self) -> bool:
@@ -2351,7 +2500,7 @@ class PendingWaveShuffle:
                         rep._t_dispatched = t1
                     try:
                         pending = self._dispatch_wave(shard_rows, wnv,
-                                                      buf)
+                                                      buf, i)
                     except BaseException:
                         # no pending exists: the pinned block has no
                         # owner yet (same rule as the single-shot path)
@@ -2404,9 +2553,13 @@ class PendingWaveShuffle:
         return res
 
     def _dispatch_wave(self, shard_rows: np.ndarray, wnv: np.ndarray,
-                       buf):
+                       buf, wave_i: int):
         mgr = self._mgr
         pool = mgr.node.pool
+        # per-wave int8 noise base: the exchange seq spaces reads, the
+        # wave index spaces waves within one — every wave of every read
+        # draws a distinct stream, identically on every process
+        wseed = (self._rep._seq * 100_003 + wave_i) & 0x7FFFFFFF
 
         def on_done(result, _b=buf):
             # per-wave exactly-once release: the pool's free list hands
@@ -2420,10 +2573,11 @@ class PendingWaveShuffle:
             return submit_shuffle_distributed(
                 mgr.exchange_mesh, mgr.axis, self._wave_plan, shard_rows,
                 wnv, self._shard_ids, self._val_tail, self._val_dtype,
-                on_done=on_done)
+                on_done=on_done, wire_seed=wseed)
         return submit_shuffle(
             mgr.exchange_mesh, mgr.axis, self._wave_plan, shard_rows,
-            wnv, self._val_tail, self._val_dtype, on_done=on_done)
+            wnv, self._val_tail, self._val_dtype, on_done=on_done,
+            wire_seed=wseed)
 
     def _drain_oldest(self, inflight, wave_results, timeline,
                       t_read0: float) -> int:
@@ -2437,6 +2591,22 @@ class PendingWaveShuffle:
         wait_ms = (time.perf_counter() - t0) * 1e3
         self._last_step = getattr(pending, "_step", None)
         drain_wave_result(res)
+        if self._wave_plan.wire == "lossless" \
+                and hasattr(res, "compress_host_blocks"):
+            # the lossless tier's home: the wave is host-bound NOW and
+            # may wait behind depth-1 others — re-encode its blocks
+            # (byte-plane + deflate) through the pack executor, and
+            # record ACHIEVED bytes for the report. Distributed wave
+            # results are already host-resident partial views with no
+            # block store — they pass through untouched.
+            try:
+                ex = self._mgr._pack_executor_if_parallel()
+                raw_b, comp_b = res.compress_host_blocks(ex)
+                self._lossless[0] += raw_b
+                self._lossless[1] += comp_b
+            except Exception:
+                log.debug("lossless drain codec failed; wave kept raw",
+                          exc_info=True)
         entry = timeline[i]
         entry["forced_ms"] = round((t0 - t_read0) * 1e3, 3)
         entry["wait_ms"] = round(wait_ms, 3)
@@ -2476,6 +2646,13 @@ class PendingWaveShuffle:
             # steady-state cost later same-shape exchanges pay)
             mgr._set_wave_wire(rep, self._wave_plan, self._wave_sizes,
                                self._width)
+        if self._lossless[1]:
+            # measured (achieved) host-plane compression of the drained
+            # waves, vs the REAL payload — the lossless tier's figure
+            rep.lossless_bytes = int(self._lossless[1])
+            rep.lossless_ratio = round(
+                self._lossless[1] / rep.payload_bytes, 6) \
+                if rep.payload_bytes else 0.0
         mgr._finish_device_plane(rep, self._last_step, self._width,
                                  completed=True)
         rep.completed = True

@@ -80,6 +80,21 @@ class ShufflePlan:
     # AOT compile from a CPU host would otherwise bake the interpreter
     # into the TPU program).
     pallas_interpret: Optional[bool] = None
+    # Wire-compression tier (a2a.wire, alltoall.ALLOWED_WIRES) — the
+    # compiled-step contract half: 'int8' makes the step quantize the
+    # trailing ``wire_words`` float32 value lanes to int8 + a per-row
+    # scale word before the collective and dequantize on receive (keys /
+    # partition / size lanes stay exact int lanes); 'lossless' leaves
+    # the step untouched (the tier is the host-side byte-plane codec on
+    # the drain path) but still keys the program, so the wire mode is
+    # part of the compiled-program family by construction. The manager
+    # resolves the conf tier per read (_decorated_plan): int8 demands
+    # float32 value lanes and a real wire move, else the plan falls back
+    # to 'raw' and the report says so.
+    wire: str = "raw"
+    # float32 value lanes the int8 wire narrows (= value_words for an
+    # f32 schema); 0 on every other tier.
+    wire_words: int = 0
     # Wave-pipelined exchange (a2a.waveRows, shuffle/manager.py): the
     # OUTER descriptive plan of a waved read carries the wave split here
     # — rows per shard per wave and the agreed wave count. The plan each
@@ -111,7 +126,7 @@ class ShufflePlan:
                 self.sort_strips, self.combine, self.combine_words,
                 self.combine_dtype, self.combine_sum_words,
                 self.combine_compaction, self.ordered, self.bounds,
-                self.pallas_interpret)
+                self.pallas_interpret, self.wire, self.wire_words)
 
     def strips_active(self) -> bool:
         """True when the single-shard strip-sorted plain path runs —
@@ -280,6 +295,28 @@ def make_plan(
     )
 
 
+def plan_takes_seed(plan: ShufflePlan) -> bool:
+    """Whether this plan's compiled step consumes a noise seed — i.e.
+    the int8 wire tier is active. THE predicate every dispatch site
+    shares (PendingShuffle, the distributed pending, warmup): a seeded
+    step widens its per-shard nvalid input to [count, seed], and the
+    stage side and the trace side must agree on which plans do that."""
+    return plan.wire == "int8" and plan.wire_words > 0
+
+
+def wire_row_words(plan: ShufflePlan, width: int) -> int:
+    """int32 lanes ONE row of this plan costs on the wire: ``width``
+    verbatim on the raw/lossless tiers; on int8, the exact head lanes
+    plus the packed int8 value lanes plus the f32 scale word
+    (alltoall.int8_wire_words — one lane formula shared with the packing
+    kernel). The accounting (ragged_layout), the pallas chunk alignment
+    and the step's transport width all read this."""
+    if not plan_takes_seed(plan):
+        return int(width)
+    from sparkucx_tpu.shuffle.alltoall import int8_wire_words
+    return int(width) - plan.wire_words + int8_wire_words(plan.wire_words)
+
+
 @dataclass(frozen=True)
 class RaggedLayout:
     """Wire-contract descriptor of one exchange — the real-bytes half of
@@ -312,6 +349,16 @@ class RaggedLayout:
     payload_bytes: int
     wire_bytes: int
     pad_ratio: float   # wire/payload; 0.0 for an empty exchange
+    # Wire-compression tier (plan.wire): ``wire_row_bytes`` is what ONE
+    # wire row costs on this tier (= width*4 on raw/lossless; narrower
+    # on int8 — packed int8 value lanes + the scale word), so
+    # ``wire_bytes`` above already reports ACHIEVED (compressed) wire
+    # bytes and int8 pad_ratio can legitimately sit below 1.0.
+    # ``scale_bytes`` is the per-row scale/metadata overhead the int8
+    # tier ships inside that figure.
+    wire: str = "raw"
+    wire_row_bytes: int = 0
+    scale_bytes: int = 0
 
 
 def ragged_layout(plan: ShufflePlan, shard_rows, width: int,
@@ -324,6 +371,9 @@ def ragged_layout(plan: ShufflePlan, shard_rows, width: int,
     impl = resolved_wire_impl(plan.impl, plan.num_shards, backend)
     payload = int(np.sum(np.asarray(shard_rows, dtype=np.int64)))
     P = plan.num_shards
+    # wire tier narrows the per-row cost BEFORE the transport multiplies
+    # it: every impl below ships rows of row_w lanes, not `width`
+    row_w = wire_row_words(plan, width)
     if impl in ("native", "local"):
         # true per-peer counts on the wire (the [P] size-row allgather
         # rides along at P² ints — noise next to any real payload)
@@ -337,14 +387,16 @@ def ragged_layout(plan: ShufflePlan, shard_rows, width: int,
         wire = P * P * plan.cap_in
     else:  # pallas: segments round up to the 128-lane chunk — upper bound
         from sparkucx_tpu.ops.pallas.ragged_a2a import chunk_rows_for
-        wire = payload + P * P * (chunk_rows_for(width) - 1)
+        wire = payload + P * P * (chunk_rows_for(row_w) - 1)
     payload_bytes = payload * width * 4
-    wire_bytes = wire * width * 4
+    wire_bytes = wire * row_w * 4
     pad = round(wire_bytes / payload_bytes, 6) if payload_bytes else 0.0
+    scale = wire * 4 if plan_takes_seed(plan) else 0
     return RaggedLayout(impl=impl, num_shards=P, width=width,
                         payload_rows=payload, wire_rows=wire,
                         payload_bytes=payload_bytes, wire_bytes=wire_bytes,
-                        pad_ratio=pad)
+                        pad_ratio=pad, wire=plan.wire,
+                        wire_row_bytes=row_w * 4, scale_bytes=scale)
 
 
 def wave_payload_rows(shard_rows: np.ndarray, wave_rows: int,

@@ -44,8 +44,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from sparkucx_tpu.ops.partition import (
     blocked_partition_map, destination_sort, hash_partition)
-from sparkucx_tpu.shuffle.alltoall import ragged_shuffle
-from sparkucx_tpu.shuffle.plan import ShufflePlan
+from sparkucx_tpu.shuffle.alltoall import (ragged_shuffle, wire_pack_rows,
+                                           wire_unpack_rows)
+from sparkucx_tpu.shuffle.plan import (ShufflePlan, plan_takes_seed,
+                                       wire_row_words)
 from sparkucx_tpu.utils.logging import get_logger
 
 log = get_logger("shuffle.reader")
@@ -93,6 +95,48 @@ def _make_part_fn(plan: ShufflePlan, R: int):
     return part_fn
 
 
+def seeded_nvalid(plan: ShufflePlan, nvalid: np.ndarray, base_seed: int,
+                  shard_ids=None) -> np.ndarray:
+    """The host half of the seeded-step contract: a plan on the int8
+    wire (plan_takes_seed) widens its per-shard nvalid input from
+    ``[count]`` to ``[count, seed]`` — the noise seed rides the SAME
+    staged, P(axis)-sharded lane as the count, so the step signature
+    never grows a separately-sharded argument (one compiled program per
+    shape family, wire mode included). Seeds are derived per GLOBAL
+    shard (``base*P + shard_id``, int32 ring), so every shard draws a
+    distinct stream and the arithmetic is identical on every process of
+    a collective read by construction. Raw/lossless plans pass through
+    untouched."""
+    nv = np.asarray(nvalid, dtype=np.int32).reshape(-1)
+    if not plan_takes_seed(plan):
+        return nv
+    ids = np.arange(nv.shape[0], dtype=np.int64) if shard_ids is None \
+        else np.asarray(shard_ids, dtype=np.int64)
+    seeds = (np.int64(base_seed) * plan.num_shards + ids) & 0x7FFFFFFF
+    return np.stack([nv.astype(np.int64), seeds],
+                    axis=1).reshape(-1).astype(np.int32)
+
+
+def _wire_ragged_shuffle(plan: ShufflePlan, send, sizes, axis, seed):
+    """One collective on the plan's wire tier: int8 narrows the value
+    lanes around ragged_shuffle (quantize on send, dequantize on
+    receive — the key lanes and the [P] size row stay exact), every
+    other tier is ragged_shuffle verbatim. The delivered rows are
+    full-width either way, so everything downstream of the collective
+    (receive-side combine/keysort, run arithmetic, unpack) is
+    wire-oblivious."""
+    if seed is None:
+        return ragged_shuffle(send, sizes, axis,
+                              out_capacity=plan.cap_out, impl=plan.impl)
+    width = send.shape[1]
+    packed = wire_pack_rows(send, plan.wire_words, seed)
+    r = ragged_shuffle(packed, sizes, axis, out_capacity=plan.cap_out,
+                       impl=plan.impl)
+    data = wire_unpack_rows(r.data, width, plan.wire_words)
+    from sparkucx_tpu.shuffle.alltoall import ShuffleResult
+    return ShuffleResult(data, r.recv_sizes, r.total, r.overflow)
+
+
 def step_body(plan: ShufflePlan, axis: str):
     """The per-shard exchange step (call under shard_map over ``axis``).
 
@@ -123,6 +167,7 @@ def step_body(plan: ShufflePlan, axis: str):
     # a numpy constant inlines as a literal at trace time
     bounds = _device_bounds(R, Pn)
     part_fn = _make_part_fn(plan, R)
+    seeded = plan_takes_seed(plan)
 
     def dev_counts(rcounts):
         # per-device segment sizes = partition-count sums over each
@@ -132,7 +177,11 @@ def step_body(plan: ShufflePlan, axis: str):
         return jnp.take(cum, bounds[1:]) - jnp.take(cum, bounds[:-1])
 
     def step(payload, nvalid):
-        # payload [cap_in, width] int32, col 0 = key_lo; nvalid [1]
+        # payload [cap_in, width] int32, col 0 = key_lo; nvalid [1] — or
+        # [count, seed] on the int8 wire (seeded_nvalid: the noise seed
+        # rides the same staged lane as the count)
+        seed = nvalid[1] if seeded else None
+        nvalid = nvalid[:1]
         part = part_fn(payload)
         if plan.strips_active():
             # single shard, plain: no wire move is needed (the send
@@ -185,8 +234,8 @@ def step_body(plan: ShufflePlan, axis: str):
             send, rcounts = destination_sort(payload, part, nvalid[0], R,
                                              method=plan.sort_impl)
 
-        r = ragged_shuffle(send, dev_counts(rcounts), axis,
-                           out_capacity=plan.cap_out, impl=plan.impl)
+        r = _wire_ragged_shuffle(plan, send, dev_counts(rcounts), axis,
+                                 seed)
 
         if plan.combine:
             if Pn == 1:
@@ -259,9 +308,17 @@ def _pallas_step_body(plan: ShufflePlan, axis: str):
         align_rows, chunk_rows_for, pallas_ragged_all_to_all)
     from sparkucx_tpu.ops.partition import partition_major_sort_aligned
 
+    seeded = plan_takes_seed(plan)
+
     def step(payload, nvalid):
+        seed = nvalid[1] if seeded else None
+        nvalid = nvalid[:1]
         width = payload.shape[1]
-        chunk = chunk_rows_for(width)
+        # chunk alignment follows the WIRE row width: the kernel moves
+        # packed (narrower) rows on the int8 tier, and the run-index
+        # align_chunk downstream derives from the same wire_row_words
+        # seam — one formula, no desync
+        chunk = chunk_rows_for(wire_row_words(plan, width))
         part = part_fn(payload)
         if plan.combine:
             # map-side combine first — one row per distinct (partition,
@@ -285,6 +342,10 @@ def _pallas_step_body(plan: ShufflePlan, axis: str):
         if pad:
             srows = jnp.concatenate(
                 [srows, jnp.zeros((pad, width), srows.dtype)])
+        if seeded:
+            # int8 wire: the remote DMA moves packed rows; alignment pad
+            # rows quantize to zeros and decode back to zeros
+            srows = wire_pack_rows(srows, plan.wire_words, seed)
         cap_eff = int(align_rows(plan.cap_out, chunk)) + Pn * chunk
         # interpret resolves at trace time from the backend UNLESS the
         # plan pins it (plan.pallas_interpret) — an AOT compile from a
@@ -296,6 +357,10 @@ def _pallas_step_body(plan: ShufflePlan, axis: str):
         out, recv_real, recv_off, total_al = pallas_ragged_all_to_all(
             srows, dev_counts, axis, out_capacity=cap_eff,
             num_devices=Pn, interpret=interpret)
+        if seeded:
+            # dequantize right off the DMA: everything downstream (the
+            # densify combine/keysort, the run index) sees full rows
+            out = wire_unpack_rows(out, width, plan.wire_words)
         ovf = (total_al < 0)
         if not (plan.combine or plan.ordered):
             seg = jax.lax.all_gather(rcounts, axis)      # [P, R] real
@@ -339,11 +404,14 @@ def _build_step(mesh: Mesh, axis: str, plan: ShufflePlan, width: int):
     builder and manager.warmup. The pipeline itself is
     :func:`step_body`."""
     from sparkucx_tpu.shuffle.stepcache import GLOBAL_STEP_CACHE
+    # the plan rides the key whole, so the wire tier (plan.wire — part
+    # of plan.family too) names its own compiled program per shape
+    # family: raw and int8 runs of one shape never collide on a step
     return GLOBAL_STEP_CACHE.get(
         ("flat", mesh, axis, plan, width),
         lambda: _build_step_uncached(mesh, axis, plan, width),
         {"kind": "flat", "cap_in": plan.cap_in, "cap_out": plan.cap_out,
-         "width": width, "impl": plan.impl})
+         "width": width, "impl": plan.impl, "wire": plan.wire})
 
 
 def _build_step_uncached(mesh: Mesh, axis: str, plan: ShufflePlan,
@@ -712,6 +780,14 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
     def _shard_rows(self, shard: int) -> np.ndarray:
         with self._fetch_lock:
             got = self._shards.get(shard)
+            if got is not None and not isinstance(got, np.ndarray):
+                # a2a.wire=lossless parked this shard as a compressed
+                # block (compress_host_blocks); first consumer touch
+                # restores the exact bytes and keeps them — the codec's
+                # win is the UNTOUCHED waves waiting in the pipeline
+                from sparkucx_tpu.shuffle.wire import decode_block
+                got = decode_block(got)
+                self._shards[shard] = got
             if got is None:
                 dev = self._shard_dev(shard)
                 if dev is None:
@@ -723,6 +799,42 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
                     # so the HBM is free for the next shuffle's exchange
                     self._rows_dev = None
             return got
+
+    def compress_host_blocks(self, executor=None):
+        """``a2a.wire=lossless``: re-encode every host-materialized
+        shard block as byte-plane + deflate (shuffle/wire.py) — called
+        by the wave pipeline right after a wave drains, optionally
+        fanned out over the manager's pack executor (the codec rides
+        the same thread pool as the pack stage, per the tier's
+        host-side contract). Blocks decompress transparently on first
+        consumer touch (:meth:`_shard_rows`). Returns
+        ``(raw_bytes, compressed_bytes)`` — ACHIEVED figures for the
+        report's lossless accounting; (0, 0) when nothing was
+        host-resident to encode."""
+        from sparkucx_tpu.shuffle.wire import encode_block
+        with self._fetch_lock:
+            todo = [(s, a) for s, a in self._shards.items()
+                    if isinstance(a, np.ndarray)]
+        if not todo:
+            return (0, 0)
+
+        def enc(item):
+            s, a = item
+            return s, encode_block(a)
+
+        done = list(executor.map(enc, todo)) if executor is not None \
+            else [enc(t) for t in todo]
+        raw = comp = 0
+        with self._fetch_lock:
+            for s, blk in done:
+                # swapping under a concurrent reader is safe: any view a
+                # consumer already holds keeps its base array alive, and
+                # the block restores bit-identical bytes on next touch
+                if self._shards.get(s) is not None:
+                    self._shards[s] = blk
+                raw += blk.raw_bytes
+                comp += blk.nbytes
+        return raw, comp
 
     def partitions_ready(self, poll_s: float = 0.002):
         """Arrival-order iteration: shards whose transfer already
@@ -1134,7 +1246,8 @@ class PendingShuffle(PendingExchangeBase):
     def __init__(self, build_step, sharding, plan: ShufflePlan,
                  shard_rows: np.ndarray, shard_nvalid: np.ndarray,
                  val_shape, val_dtype, on_done=None,
-                 per_shard_segs: bool = False, admit=None):
+                 per_shard_segs: bool = False, admit=None,
+                 wire_seed: int = 0):
         self._build_step = build_step
         self._sharding = sharding
         self._plan = plan
@@ -1143,6 +1256,10 @@ class PendingShuffle(PendingExchangeBase):
         self._nvalid_host = shard_nvalid
         self._val_shape = val_shape
         self._val_dtype = val_dtype
+        # int8-wire noise base (the manager threads its exchange seq —
+        # identical on every process); each overflow retry offsets it so
+        # the re-run draws fresh rounding noise
+        self._wire_seed = int(wire_seed)
         self._on_done = None
         self._result: Optional[ShuffleReaderResult] = None
         self._attempt = 0
@@ -1162,7 +1279,9 @@ class PendingShuffle(PendingExchangeBase):
         rows_flat = stage_to_device(
             self._rows_host.reshape(-1, width), self._sharding)
         nvalid = stage_to_device(
-            self._nvalid_host.astype(np.int32).reshape(-1), self._sharding)
+            seeded_nvalid(self._plan, self._nvalid_host,
+                          self._wire_seed + self._attempt),
+            self._sharding)
         self._out = step(rows_flat, nvalid)
 
     def _result_inner(self) -> ShuffleReaderResult:
@@ -1190,9 +1309,12 @@ class PendingShuffle(PendingExchangeBase):
         if self._plan.impl == "pallas" and not (self._plan.combine
                                                 or self._plan.ordered):
             # plain pallas delivers the chunk-aligned layout; combine/
-            # ordered densify on device and use the normal [1, R] contract
+            # ordered densify on device and use the normal [1, R]
+            # contract. Chunk follows the WIRE row width — the same
+            # wire_row_words seam the step aligned with
             from sparkucx_tpu.ops.pallas.ragged_a2a import chunk_rows_for
-            align_chunk = chunk_rows_for(self._rows_host.shape[2])
+            align_chunk = chunk_rows_for(
+                wire_row_words(self._plan, self._rows_host.shape[2]))
         elif self._plan.strips_active():
             # strip-sorted single-shard layout: each of the S virtual
             # senders occupies one strip_rows-sized region (step_body's
@@ -1231,11 +1353,16 @@ def submit_shuffle(
     val_dtype,
     on_done=None,
     admit=None,
+    wire_seed: int = 0,
 ) -> PendingShuffle:
     """Dispatch the exchange without blocking (see :class:`PendingShuffle`).
 
     shard_rows   — [P, cap_in, width] fused int32 rows per shard
     shard_nvalid — [P] valid row counts
+    wire_seed    — int8-wire noise base (ignored on other tiers); the
+                   manager threads its exchange sequence through it so
+                   every exchange — and every wave of one — draws a
+                   fresh stochastic-rounding realization
     """
     from jax.sharding import NamedSharding
     width = shard_rows.shape[2]
@@ -1243,6 +1370,7 @@ def submit_shuffle(
         lambda p: _build_step(mesh, axis, p, width),
         NamedSharding(mesh, P(axis)), plan, shard_rows, shard_nvalid,
         val_shape, val_dtype, on_done=on_done, admit=admit,
+        wire_seed=wire_seed,
         # combined/ordered output is one run per partition: the seg matrix
         # is each shard's own [1, R] counts, sharded like the rows
         per_shard_segs=bool(plan.combine or plan.ordered))

@@ -50,6 +50,12 @@ padding_waste     ExchangeReport pad_ratio (wire bytes /   spark.shuffle.tpu.a2a
                   over threshold with a min-wire-bytes
                   floor — the transport ships padded
                   caps, not real bytes
+wire_dequant...   int8-wire exchanges whose sampled        spark.shuffle.tpu.a2a.wire
+                  dequantization-error estimate (relative
+                  RMS vs the payload, shuffle/wire.py)
+                  sits over threshold with a min-payload
+                  floor — the lossy tier is rounding away
+                  signal (outlier-dominated rows)
 ================  =======================================  =====================================
 
 The same :class:`Finding` schema carries ``bench.py --stage regress``
@@ -149,6 +155,16 @@ class Thresholds:
     pad_warn_ratio: float = 4.0
     pad_critical_ratio: float = 32.0
     pad_min_wire_bytes: float = 1e6
+    # wire_dequant_error: sampled relative-RMS loss of the int8 wire
+    # tier (ExchangeReport.wire_dequant_error, shuffle/wire.py). A
+    # well-conditioned payload estimates ~0.005 regardless of magnitude
+    # (the per-row scale absorbs it) — warn starts at 10x that, critical
+    # where a quarter of the signal energy is rounding noise. The
+    # min-payload floor keeps tiny test exchanges out (the PR-5 ratio+
+    # floor discipline).
+    dequant_warn_rel: float = 0.05
+    dequant_critical_rel: float = 0.25
+    dequant_min_payload_bytes: float = 1e6
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -714,6 +730,57 @@ def _rule_padding_waste(view: ClusterView,
         trace_ids=[r.get("trace_id", "")])]
 
 
+def _rule_wire_dequant(view: ClusterView,
+                       th: Thresholds) -> List[Finding]:
+    """The int8 wire tier is rounding away signal: a completed
+    ``wire=int8`` exchange's sampled dequantization-error estimate
+    (relative RMS of a round-to-nearest int8 pass over staged float
+    values — shuffle/wire.py, stamped by the manager per exchange) sits
+    over threshold while the exchange moved enough payload to matter.
+    Outlier-dominated rows are the classic cause: one huge element
+    stretches the per-row scale so the int8 grid quantizes everything
+    else to junk. Fires once, on the worst offender — the remediation
+    is an exact tier (raw device lanes, or the lossless host codec)."""
+    worst = None
+    for r in _completed(view):
+        if r.get("wire") != "int8":
+            continue
+        err = float(r.get("wire_dequant_error") or 0.0)
+        if float(r.get("payload_bytes") or 0.0) \
+                < th.dequant_min_payload_bytes:
+            continue
+        if err < th.dequant_warn_rel:
+            continue
+        if worst is None or err > worst[0]:
+            worst = (err, r)
+    if worst is None:
+        return []
+    err, r = worst
+    return [Finding(
+        rule="wire_dequant_error",
+        grade="critical" if err >= th.dequant_critical_rel else "warn",
+        summary=(f"shuffle {r.get('shuffle_id')} ({r.get('impl')}, "
+                 f"wire=int8) sampled dequantization error is "
+                 f"{err:.3f} relative RMS "
+                 f"({err / 0.005:.0f}x the well-conditioned ~0.005) — "
+                 f"the lossy wire tier is rounding away signal this "
+                 f"payload cannot absorb"),
+        evidence={"shuffle_id": r.get("shuffle_id"),
+                  "impl": r.get("impl"),
+                  "wire_dequant_error": round(err, 4),
+                  "payload_bytes": int(r.get("payload_bytes") or 0),
+                  "wire_bytes": int(r.get("wire_bytes") or 0),
+                  "pad_ratio": round(float(r.get("pad_ratio", 0.0)), 2)},
+        conf_key="spark.shuffle.tpu.a2a.wire",
+        remediation=("move this workload to an exact tier: a2a.wire=raw "
+                     "(exact int32 lanes) or a2a.wire=lossless (host-"
+                     "side byte-plane compression, bit-exact round-"
+                     "trip); if the error is driven by rare outlier "
+                     "rows, normalize or clip values before staging so "
+                     "the per-row amax stops stretching the int8 grid"),
+        trace_ids=[r.get("trace_id", "")])]
+
+
 def _rule_peer_timeout(view: ClusterView,
                        th: Thresholds) -> List[Finding]:
     """The collective watchdog fired: a distributed rendezvous or an
@@ -805,7 +872,7 @@ _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
           _rule_bw_underutilization, _rule_padding_waste,
-          _rule_peer_timeout, _rule_replay_storm)
+          _rule_wire_dequant, _rule_peer_timeout, _rule_replay_storm)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

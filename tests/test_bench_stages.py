@@ -165,6 +165,33 @@ def test_ragged_measure_small(mesh8):
         ("ragged_vs_dense_speedup" in lv["zipf"])
 
 
+def test_wire_measure_small(mesh8):
+    """The wire stage's measurement core at a tiny shape: raw/lossless
+    bit-exact, int8 oracle-bounded with the ≤0.30x wire-narrowing the
+    lane arithmetic guarantees at the 64-lane contract row, the
+    lossless codec measuring real bytes on the waved drain path, and 0
+    warm recompiles per (shape family, wire mode). Bandwidth figures
+    are context-only (CPU wall clock at tiny payloads)."""
+    rec = bench.wire_measure(rows_per_map=512, maps=4, partitions=8,
+                             reps=1)
+    arms = rec["arms"]
+    assert arms["raw"]["wire"] == "raw" and arms["raw"]["exact"]
+    assert arms["int8"]["wire"] == "int8"
+    assert arms["int8"]["bounded"]
+    # the 4x-lane-width-minus-scale-overhead arithmetic: 19/66 lanes
+    assert arms["int8"]["wire_mb"] <= 0.30 * arms["raw"]["wire_mb"]
+    assert 0.0 < arms["int8"]["wire_dequant_error"] < 0.05
+    assert arms["int8"]["bw"]["effective_gbps"] \
+        >= arms["int8"]["bw"]["gbps_real_bytes"]
+    assert arms["lossless"]["wire"] == "lossless"
+    assert arms["lossless"]["exact"]               # bit-exact round-trip
+    assert arms["lossless"]["waves"] >= 2
+    assert arms["lossless"]["lossless_mb"] > 0.0
+    assert 0.0 < arms["lossless"]["lossless_ratio"] < 1.0
+    assert all(a["programs_warm"] == 0 for a in arms.values())
+    assert 0.0 < rec["int8_wire_savings_rate"] < 1.0
+
+
 def test_chaos_measure_small(mesh8):
     """The chaos stage's measurement core at a tiny shape: every cell of
     the fault matrix ends hang-free in its expected outcome (typed error
@@ -175,8 +202,14 @@ def test_chaos_measure_small(mesh8):
                               val_words=2, timeout_ms=2000.0)
     assert rec["ok"] is True
     # dense x {single: 3 sites, waved: 4 sites} x {failfast, replay}
-    assert rec["cells_total"] == 14
+    # plus the wire-compressed int8 x waved x replay cell
+    assert rec["cells_total"] == 15
     assert rec["cells_ok"] == rec["cells_total"]
+    wire_cells = [c for c in rec["cells"] if c.get("wire") == "int8"]
+    assert len(wire_cells) == 1
+    wc = wire_cells[0]
+    assert wc["outcome"] == "replayed" and wc["replays"] >= 1
+    assert wc["wire_held"] and wc["family_stable"] and wc["bytes_ok"]
     for c in rec["cells"]:
         assert c["hang_free"], c
         assert c["fault_fired"], c

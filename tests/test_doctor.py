@@ -419,6 +419,73 @@ def test_padding_waste_quiet_goldens():
     assert diagnose(doc) == []
 
 
+def _wire_report(sid=12, trace="s12.e0.x12", err=0.08, payload_mb=4.0,
+                 wire="int8"):
+    """A completed int8-wire exchange whose sampled dequantization-error
+    estimate is ``err`` — the wire_dequant_error inputs (the manager's
+    shuffle/wire.py sampling pass)."""
+    r = _report(sid=sid, trace=trace)
+    r["impl"] = "dense"
+    r["wire"] = wire
+    r["wire_dequant_error"] = err
+    r["payload_bytes"] = int(payload_mb * 1e6)
+    r["wire_bytes"] = int(payload_mb * 1e6 * 0.3)
+    r["pad_ratio"] = 0.3
+    return r
+
+
+def test_wire_dequant_fires_on_lossy_payload():
+    """An int8-wire exchange rounding away 8% of the signal energy:
+    warn, pointing at the exact tiers."""
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_wire_report(err=0.08))
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["wire_dequant_error"]
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.evidence["wire_dequant_error"] == 0.08
+    assert f.evidence["impl"] == "dense"
+    assert f.conf_key == "spark.shuffle.tpu.a2a.wire"
+    assert "lossless" in f.remediation and "raw" in f.remediation
+    assert "s12.e0.x12" in f.trace_ids
+
+
+def test_wire_dequant_critical_reports_worst_offender():
+    """A quarter of the signal energy lost grades critical, and the
+    WORST offender is the one reported."""
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_wire_report(sid=12, err=0.08))
+    doc["exchange_reports"].append(
+        _wire_report(sid=13, trace="s13.e0.x13", err=0.4))
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["wire_dequant_error"]
+    f = fs[0]
+    assert f.grade == "critical"
+    assert f.evidence["shuffle_id"] == 13
+    assert "s13.e0.x13" in f.trace_ids
+
+
+def test_wire_dequant_quiet_goldens():
+    # well-conditioned payload: the estimate sits at the ~0.005 floor
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_wire_report(err=0.004))
+    assert diagnose(doc) == []
+    # raw exchange with a (stale/meaningless) error field — the rule
+    # grades the int8 tier only
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_wire_report(err=0.4, wire="raw"))
+    assert diagnose(doc) == []
+    # sub-noise: lossy but the exchange moved almost nothing (tiny test
+    # shuffle under the min-payload floor, the PR-5 discipline)
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(
+        _wire_report(err=0.4, payload_mb=0.01))
+    assert diagnose(doc) == []
+    # pre-wire dumps (no wire field at all) — quiet, not a crash
+    doc = _healthy_doc()
+    assert diagnose(doc) == []
+
+
 def _peer_lost_report(sid=11, trace="s11.e0.x11"):
     r = _report(sid=sid, trace=trace, completed=False)
     r["error"] = ("PeerLostError: collective 'metadata allgather' "

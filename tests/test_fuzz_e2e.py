@@ -407,6 +407,118 @@ def test_ragged_sweep_vs_oracle(sweep_managers, impl, waved, skew):
         m.unregister_shuffle(sid)
 
 
+# -- compressed-wire stratified sweep: wire x impl x waves x skew -----------
+# The ISSUE-8 exactness matrix: both wire tiers x the CPU-runnable
+# transports x {single-shot, waved} x the skew ladder, against a
+# per-key host oracle. ``lossless`` must round-trip BIT-EXACT (the
+# byte-plane codec's contract; the waved legs actually exercise it —
+# the tier's home is the wave drain path); ``int8`` must land every key
+# exactly (key lanes are exact by the wire contract) with values inside
+# the one-rounding-step per-row bound (amax/127). Values are a
+# deterministic function of the key so duplicate (skewed) keys stay
+# matchable under the lossy tier.
+WIRE_MODES = ("int8", "lossless")
+WIRE_VW = 8
+
+
+def _wire_values(k):
+    base = (np.asarray(k, dtype=np.int64) % 1009).astype(np.float32)
+    cols = np.arange(WIRE_VW, dtype=np.float32)
+    return base[:, None] * 0.37 + cols[None, :] * 1.5 + 1.0
+
+
+@pytest.fixture(scope="module")
+def wire_managers(manager):
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    cache = {}
+
+    def get(wire, impl, waved):
+        key = (wire, impl, waved)
+        if key not in cache:
+            cmap = {"spark.shuffle.tpu.a2a.impl": impl,
+                    "spark.shuffle.tpu.a2a.wire": wire}
+            if waved:
+                cmap["spark.shuffle.tpu.a2a.waveRows"] = "48"
+            conf = TpuShuffleConf(cmap, use_env=False)
+            cache[key] = TpuShuffleManager(manager.node, conf)
+        return cache[key]
+
+    yield get
+    for m in cache.values():
+        m.stop()
+
+
+@pytest.mark.parametrize("skew", SKEW_LEVELS)
+@pytest.mark.parametrize("waved", (False, True), ids=("single", "waved"))
+@pytest.mark.parametrize("impl", ("dense", "gather"))
+@pytest.mark.parametrize("wire", WIRE_MODES)
+def test_wire_sweep_vs_oracle(wire_managers, wire, impl, waved, skew):
+    from sparkucx_tpu.shuffle.alltoall import int8_wire_words
+    if impl == "gather" and skew != "uniform":
+        pytest.skip(
+            "gather is the cross-impl lane oracle — the full skew ladder "
+            "rides dense (every skew level lands a new cap bucket = a "
+            "fresh compile, so repeating the ladder on the oracle "
+            "transport buys only tier-1 compile time)")
+    m = wire_managers(wire, impl, waved)
+    seed = (WIRE_MODES.index(wire) * 1000 + SKEW_LEVELS.index(skew) * 10
+            + int(waved) + (0 if impl == "dense" else 100))
+    rng = np.random.default_rng(80_000 + seed)
+    M, R, n = 4, 16, 250
+    sid = 82_000 + seed
+    h = m.register_shuffle(sid, M, R)
+    try:
+        total = 0
+        for mid in range(M):
+            k = _skewed_keys(rng, skew, n)
+            w = m.get_writer(h, mid)
+            w.write(k, _wire_values(k))
+            w.commit(R)
+            total += n
+        res = m.read(h)
+        nrows = 0
+        for r, (ks, vs) in res.partitions():
+            nrows += len(ks)
+            want = _wire_values(ks)
+            if wire == "lossless":
+                assert np.array_equal(vs, want), f"partition {r}"
+            else:
+                step = np.abs(want).max(axis=1, keepdims=True) / 127.0 \
+                    + 1e-5
+                assert (np.abs(vs - want) <= step).all(), \
+                    f"partition {r}: worst {np.abs(vs - want).max()}"
+        assert nrows == total
+        # wire accounting invariants, per tier
+        rep = m.report(sid)
+        width = 2 + WIRE_VW
+        assert rep.wire == wire             # resolved, never the ask
+        assert rep.payload_bytes == total * width * 4
+        if wire == "int8":
+            row_w = width - WIRE_VW + int8_wire_words(WIRE_VW)
+            P = m.node.num_devices
+            cap = rep.plan_bucket[1] if impl == "dense" \
+                else rep.plan_bucket[0]
+            if not rep.retries:
+                # an overflow regrow refreshes wire_bytes from the
+                # FINAL (grown) plan while plan_bucket keeps the
+                # initial one — the formula is checkable only retry-free
+                if rep.waves:
+                    assert rep.wire_bytes == \
+                        rep.waves * P * P * cap * row_w * 4
+                else:
+                    assert rep.wire_bytes == P * P * cap * row_w * 4
+            assert 0.0 < rep.wire_dequant_error < 0.05
+        elif rep.waves:
+            # the waved legs must actually run the codec and measure it
+            assert rep.lossless_bytes > 0
+            assert 0.0 < rep.lossless_ratio < 1.0
+        if waved and total > 48 * 8:
+            assert rep.waves >= 2, "sweep shape must actually wave"
+    finally:
+        m.unregister_shuffle(sid)
+
+
 # -- fault-injected replay sweep (ISSUE-7) ----------------------------------
 # failure.policy=replay under armed fault.exchange.failCount (and the
 # waved pipeline's wave site): every replayed exchange must come back
