@@ -22,6 +22,21 @@ survivors in a fresh world (run_cluster.py --recovery), the
 stage-resubmission analog: JAX's process set is static, so membership
 change = new world + new epoch (SURVEY.md §7 hard part (e)).
 
+Restart mode (SPARKUCX_TPU_RESTART_PHASE=1|2, job 9): the durable-
+ledger drill. Phase 1: every member commits its map outputs through a
+manager with ``failure.ledgerDir`` (each commit seals its spill files +
+manifest torn-write-proof), reports STAGED, and PARKS — the controller
+SIGKILLs the whole world AFTER commit (an abrupt crash, no clean
+shutdown; the atomic seal at commit is what makes this survivable).
+The controller then corrupts one sealed block in worker 0's ledger.
+Phase 2: a fresh world on the SAME ledger dirs — each restarted
+manager's scan validates manifests + checksums, re-registers the
+shuffle from disk and serves intact maps with ZERO recompute; the
+corrupted block is quarantined and ONLY that map re-stages; the
+distributed exchange then completes to oracle bytes. This is the
+external-shuffle-service role (a dead executor's files served without
+re-running its tasks), done as an application-level contract.
+
 Chaos mode (SPARKUCX_TPU_CHAOS_PHASE=1): the killed-peer WATCHDOG
 drill — the hard half of executor loss, where the survivors get NO
 notification at all. All members stage + report STAGED; the survivors
@@ -45,6 +60,96 @@ import sys
 import time
 
 
+def _restart_drill(node, base_conf_map, proc_id: int, nprocs: int,
+                   phase: str) -> int:
+    """Job 9 body: phase 1 commits durably and parks for the SIGKILL;
+    phase 2 recovers from the same ledger, re-stages ONLY quarantined
+    maps, and verifies the exchange to oracle bytes."""
+    import time as _time
+
+    import numpy as np
+
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    from sparkucx_tpu.shuffle.writer import _hash32_np
+
+    ledger_dir = os.environ["SPARKUCX_TPU_LEDGER_DIR"]
+    num_maps = int(os.environ.get("SPARKUCX_TPU_NUM_MAPS", 2 * nprocs))
+    conf_map = dict(base_conf_map)
+    conf_map["spark.shuffle.tpu.failure.ledgerDir"] = ledger_dir
+    conf = TpuShuffleConf(conf_map, use_env=False)
+    mgr = TpuShuffleManager(node, conf)
+    R = 4 * node.num_devices
+    key_space = 1000
+    pairs_per_map = 600
+    my_maps = [m for m in range(num_maps) if m % nprocs == proc_id]
+
+    def map_data(map_id: int):
+        rng = np.random.default_rng(1000 + map_id)
+        keys = rng.integers(0, key_space, size=pairs_per_map)\
+            .astype(np.int64)
+        vals = np.repeat(keys[:, None], 2, axis=1).astype(np.int32)
+        return keys, vals
+
+    if phase == "1":
+        h = mgr.register_shuffle(15, num_maps, R)
+        for m in my_maps:
+            w = mgr.get_writer(h, m)
+            k, v = map_data(m)
+            w.write(k, v)
+            w.commit(R)
+        # every commit is sealed on disk NOW — report and park for the
+        # abrupt SIGKILL (no clean shutdown: the whole point)
+        print(f"worker {proc_id}: STAGED", flush=True)
+        deadline = _time.monotonic() + 300
+        while _time.monotonic() < deadline:
+            _time.sleep(0.1)
+        print("ERROR: restart phase 1 was never killed", flush=True)
+        os._exit(3)
+
+    # phase 2: the restarted world. The manager's constructor already
+    # scanned the ledger — intact maps are registered and adoptable.
+    recovered = mgr.recovered_shuffles()
+    h = mgr.register_shuffle(15, num_maps, R)
+    restaged = []
+    for m in my_maps:
+        if not h.entry.present(m):
+            # quarantined (or never-committed) block: re-stage ONLY it
+            w = mgr.get_writer(h, m)
+            k, v = map_data(m)
+            w.write(k, v)
+            w.commit(R)
+            restaged.append(m)
+    intact = sorted(set(my_maps) - set(restaged))
+    print(f"worker {proc_id}: RESTAGED {restaged} (intact from ledger: "
+          f"{intact}; scan saw {recovered.get(15)})", flush=True)
+
+    res = mgr.read(h)               # collective across all processes
+
+    allk = np.concatenate([map_data(m)[0] for m in range(num_maps)])
+    allv = np.concatenate([map_data(m)[1] for m in range(num_maps)])
+    parts = _hash32_np(allk) % R
+    checked = 0
+    for r, (gk, gv) in res.partitions():
+        wk = allk[parts == r]
+        wv = allv[parts == r]
+        got = sorted(zip(gk.tolist(), map(tuple, gv.tolist())))
+        want = sorted(zip(wk.tolist(), map(tuple, wv.tolist())))
+        assert got == want, \
+            f"restart partition {r} mismatch on process {proc_id}"
+        checked += 1
+    qreport = os.path.join(ledger_dir, "quarantine_report.json")
+    if restaged:
+        assert os.path.exists(qreport), \
+            "quarantined blocks but no quarantine report"
+    print(f"worker {proc_id}: RESTART RECOVERED OK ({checked} "
+          f"partitions oracle-exact, {len(intact)} map(s) served from "
+          f"the ledger with zero recompute)", flush=True)
+    mgr.stop()
+    node.close()
+    return 0
+
+
 def main() -> int:
     proc_id = int(os.environ["SPARKUCX_TPU_PROC_ID"])
     nprocs = int(os.environ["SPARKUCX_TPU_NPROCS"])
@@ -52,6 +157,7 @@ def main() -> int:
     devices_per_proc = int(os.environ.get("SPARKUCX_TPU_LOCAL_DEVICES", "4"))
     recovery_phase = os.environ.get("SPARKUCX_TPU_RECOVERY_PHASE", "")
     chaos_phase = os.environ.get("SPARKUCX_TPU_CHAOS_PHASE", "")
+    restart_phase = os.environ.get("SPARKUCX_TPU_RESTART_PHASE", "")
     victim = int(os.environ.get("SPARKUCX_TPU_VICTIM", "-1"))
     loss_file = os.environ.get("SPARKUCX_TPU_LOSS_FILE", "")
 
@@ -110,6 +216,16 @@ def main() -> int:
         print(f"worker {proc_id}: bootstrap failed (non-rendezvous): "
               f"{e!r}", flush=True)
         return 1
+    if restart_phase:
+        # ninth job: the durable-ledger RESTART drill (see module doc).
+        # Branches BEFORE the default manager exists — the drill builds
+        # its own manager with failure.ledgerDir pointed at this
+        # worker's per-process ledger (staged state is process-local,
+        # like executor-local shuffle files), and that one manager owns
+        # the node's listener/executor lifecycle for the whole drill.
+        return _restart_drill(node, conf_map, proc_id, nprocs,
+                              restart_phase)
+
     mgr = TpuShuffleManager(node, conf)
 
     # NUM_MAPS override lets the recovery re-run execute the ORIGINAL

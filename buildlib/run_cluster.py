@@ -303,6 +303,104 @@ def run_chaos(args) -> int:
                 pass
 
 
+def run_restart(args) -> int:
+    """Durable-ledger restart drill (job 9): every member commits
+    through ``failure.ledgerDir`` and is SIGKILLed AFTER commit (abrupt
+    crash — the atomic commit seal is what makes it survivable); the
+    controller corrupts one sealed block in worker 0's ledger; a fresh
+    world on the SAME ledger dirs must re-register from disk, serve
+    intact maps with zero recompute, re-stage ONLY the quarantined
+    block, and complete the exchange to oracle bytes."""
+    import glob
+
+    num_maps = 2 * args.nprocs
+    base = tempfile.mkdtemp(prefix="sxt_restart_ledger_")
+    ledgers = [os.path.join(base, f"worker{pid}")
+               for pid in range(args.nprocs)]
+    deadline = time.monotonic() + args.timeout
+    procs, logs, all_logs = [], [], []
+    try:
+        # phase 1: commit durably, park, die by SIGKILL (all members)
+        coordinator = f"localhost:{free_port()}"
+        for pid in range(args.nprocs):
+            p, f = spawn(pid, args.nprocs, coordinator, args.devices, 1,
+                         {"SPARKUCX_TPU_RESTART_PHASE": "1",
+                          "SPARKUCX_TPU_LEDGER_DIR": ledgers[pid],
+                          "SPARKUCX_TPU_NUM_MAPS": str(num_maps)})
+            procs.append(p)
+            logs.append(f)
+            all_logs.append(f)
+        if not wait_all_staged(procs, logs, args.nprocs, deadline):
+            return 1
+        import signal
+        for p in procs:
+            p.kill()
+        ok = reap(procs, logs, deadline,
+                  expect_rc={pid: -signal.SIGKILL
+                             for pid in range(args.nprocs)})
+        if not ok:
+            print("CLUSTER RESTART: FAIL (phase 1)")
+            return 1
+
+        # corrupt ONE sealed block in worker 0's ledger — the
+        # quarantine leg: map 0 belongs to worker 0 (maps round-robin
+        # over processes)
+        vals = glob.glob(os.path.join(
+            ledgers[0], "shuffle_15", "shuffle_15_map_0.vals"))
+        if not vals:
+            print("CLUSTER RESTART: FAIL (no sealed block to corrupt; "
+                  f"ledger contents: {os.listdir(ledgers[0])})")
+            return 1
+        with open(vals[0], "r+b") as f:
+            f.seek(32)
+            b = f.read(1)
+            f.seek(32)
+            f.write(bytes([b[0] ^ 0xFF]))
+        print(f"controller: corrupted one byte in {vals[0]}")
+
+        # phase 2: fresh world, same ledgers — recover + verify
+        procs, logs = [], []
+        coordinator = f"localhost:{free_port()}"
+        for pid in range(args.nprocs):
+            p, f = spawn(pid, args.nprocs, coordinator, args.devices, 1,
+                         {"SPARKUCX_TPU_RESTART_PHASE": "2",
+                          "SPARKUCX_TPU_LEDGER_DIR": ledgers[pid],
+                          "SPARKUCX_TPU_NUM_MAPS": str(num_maps)})
+            procs.append(p)
+            logs.append(f)
+            all_logs.append(f)
+        ok = reap(procs, logs, time.monotonic() + args.timeout)
+        recovered = restaged_ok = 0
+        for pid, lf in enumerate(logs):
+            lf.seek(0)
+            out = lf.read()
+            recovered += 1 if "RESTART RECOVERED OK" in out else 0
+            want = "RESTAGED [0]" if pid == 0 else "RESTAGED []"
+            restaged_ok += 1 if want in out else 0
+        if recovered != args.nprocs:
+            print(f"only {recovered}/{args.nprocs} workers recovered")
+            ok = False
+        if restaged_ok != args.nprocs:
+            print(f"zero-recompute contract violated: only "
+                  f"{restaged_ok}/{args.nprocs} workers re-staged "
+                  f"exactly the quarantined set")
+            ok = False
+        print("CLUSTER RESTART:", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in all_logs:
+            try:
+                f.close()
+                os.unlink(f.name)
+            except OSError:
+                pass
+        import shutil
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nprocs", type=int, default=2)
@@ -318,6 +416,13 @@ def main() -> int:
                          "MID-RENDEZVOUS with no notification; the "
                          "survivors must hit the collective deadline "
                          "(PeerLostError), then re-run on a fresh world")
+    ap.add_argument("--restart", action="store_true",
+                    help="durable-ledger restart drill (job 9): SIGKILL "
+                         "every member AFTER commit, corrupt one sealed "
+                         "block, restart on the same failure.ledgerDir "
+                         "— intact maps serve with zero recompute, the "
+                         "corrupt block quarantines and re-stages, the "
+                         "exchange completes to oracle bytes")
     ap.add_argument("--timeout", type=float, default=480.0)
     args = ap.parse_args()
 
@@ -325,6 +430,8 @@ def main() -> int:
         return run_recovery(args)
     if args.chaos:
         return run_chaos(args)
+    if args.restart:
+        return run_restart(args)
 
     procs, all_logs = [], []
     try:

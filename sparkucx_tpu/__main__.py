@@ -143,8 +143,10 @@ def _cmd_trace(args) -> int:
         print(f"{name:<{w}}  "
               + "  ".join(f"{agg.get(c, 0.0):>9.2f}" for c in cols))
     if args.out:
-        with open(args.out, "w") as f:
-            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        from sparkucx_tpu.utils.atomicio import atomic_write_json
+        atomic_write_json(args.out,
+                          {"traceEvents": events, "displayTimeUnit": "ms"},
+                          indent=None)
         print(f"wrote {len(events)} chrome trace events -> {args.out}")
     return 0
 
@@ -157,8 +159,8 @@ def _cmd_timeline(args) -> int:
         docs = [_live_snapshot()]
     doc = merge_timeline(docs)
     out = args.out or "timeline.json"
-    with open(out, "w") as f:
-        json.dump(doc, f)
+    from sparkucx_tpu.utils.atomicio import atomic_write_json
+    atomic_write_json(out, doc, indent=None)
     n = sum(1 for ev in doc["traceEvents"] if ev.get("ph") != "M")
     print(f"wrote {n} events across {doc['metadata']['processes']} "
           f"process track(s) -> {out}")

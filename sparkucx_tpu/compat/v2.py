@@ -169,6 +169,15 @@ class ShuffleServiceV2:
             self._deps[dep.shuffle_id] = dep
         return h
 
+    def recovered_shuffles(self):
+        """Ledger-restored shuffles awaiting adoption by
+        :meth:`register` (see service.ShuffleService.recovered_shuffles
+        — the same manager surface): the v2 engine re-leases writers
+        only for the quarantined map ids; intact maps are already
+        committed (a writer lease for them is rejected first-commit-
+        wins, the zero-recompute contract)."""
+        return self.manager.recovered_shuffles()
+
     def unregister(self, shuffle_id: int) -> None:
         self.manager.unregister_shuffle(shuffle_id)
         # deps and read state drop under ONE guard so a racing

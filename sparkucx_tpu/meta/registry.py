@@ -47,11 +47,19 @@ class ShuffleEntry:
         self.slot = record_size(num_partitions)
         self.table = bytearray(self.slot * num_maps)
         self._present = np.zeros(num_maps, dtype=bool)
+        # Integrity plane (shuffle/integrity.py): per-map checksum
+        # records published BESIDE the size row at commit — the registry
+        # stores them opaquely (it is the metadata table, not the
+        # checksum policy). The read path re-verifies staged bytes
+        # against them at pack time (integrity.verify=staged|full).
+        self._integrity: Dict[int, object] = {}
         self._cv = threading.Condition()
 
-    def publish(self, map_id: int, sizes: np.ndarray) -> None:
+    def publish(self, map_id: int, sizes: np.ndarray,
+                integrity=None) -> None:
         """Mapper commit: write slot mapId (the putNonBlocking analog,
-        ref: CommonUcxShuffleBlockResolver.scala:91-98)."""
+        ref: CommonUcxShuffleBlockResolver.scala:91-98). ``integrity``
+        is the optional checksum record riding beside the size row."""
         if not (0 <= map_id < self.num_maps):
             raise IndexError(f"mapId {map_id} out of range [0,{self.num_maps})")
         if len(sizes) != self.num_partitions:
@@ -73,8 +81,23 @@ class ShuffleEntry:
                     f"published; its size row is immutable (first commit "
                     f"wins)")
             self.table[map_id * self.slot:(map_id + 1) * self.slot] = rec
+            if integrity is not None:
+                self._integrity[map_id] = integrity
             self._present[map_id] = True
             self._cv.notify_all()
+
+    def fetch_integrity(self, map_id: int):
+        """The checksum record published beside map ``map_id``'s size
+        row, or None (pre-integrity publisher / integrity.verify=off)."""
+        with self._cv:
+            return self._integrity.get(map_id)
+
+    def present(self, map_id: int) -> bool:
+        """Whether map ``map_id``'s size row is published — the restart
+        drill's zero-recompute query: a recovered worker re-stages only
+        the maps this returns False for."""
+        with self._cv:
+            return bool(self._present[map_id])
 
     def wait_complete(self, timeout: Optional[float] = None) -> bool:
         """Block until all map outputs are published (reducers' metadata

@@ -76,6 +76,25 @@ class PeerLostError(TransientError):
     can remesh and re-run instead of deadlocking the survivors."""
 
 
+class BlockCorruptionError(TransientError):
+    """A block failed checksum verification (shuffle/integrity.py): the
+    staged/spill bytes about to enter the exchange, or the drained
+    post-collective rows at ``integrity.verify=full``, no longer match
+    what ``commit()`` published. TRANSIENT by design — corruption is a
+    survivable fault under ``failure.policy=replay`` (one budget unit
+    re-verifies and re-runs; a flip that was in-flight recovers, a
+    rotten file keeps failing until the budget exhausts and this error
+    surfaces typed), never a silent wrong answer. The message names the
+    corrupt block."""
+
+
+class TruncatedBlockError(BlockCorruptionError):
+    """A spill/ledger file is shorter than its sealed sidecar/manifest
+    declares — a torn write or external truncation. Raised BEFORE mmap
+    so the reader gets a typed error naming the file, not a garbage or
+    short view."""
+
+
 class StaleEpochError(RuntimeError):
     """Work references a mesh epoch that a remesh has invalidated."""
 
@@ -348,11 +367,19 @@ class FaultInjector:
         spark.shuffle.tpu.fault.<site>.failCount = N   # fail first N hits
         spark.shuffle.tpu.fault.<site>.failRate  = p   # else fail w.p. p
         spark.shuffle.tpu.fault.<site>.delayMs   = ms  # latency injection
+        spark.shuffle.tpu.fault.<site>.offset    = b   # corrupt-site byte
         spark.shuffle.tpu.fault.seed             = s   # rate determinism
 
     Sites used by the framework: ``publish`` (map commit), ``fetch``
-    (metadata table fetch), ``exchange`` (the collective step). Tests may
-    invent their own sites freely."""
+    (metadata table fetch), ``exchange`` (the collective step), ``wave``
+    (per-wave pipeline step), ``spill`` (disk flush), and the CORRUPT
+    pair ``corrupt.staged`` / ``corrupt.spill`` — consumed through
+    :meth:`fire` rather than :meth:`check`: instead of raising, an armed
+    corrupt site tells the integrity plane to flip one bit into the
+    staged arena bytes / spill file at the armed ``offset`` so checksum
+    verification (shuffle/integrity.py) must DETECT it — the chaos
+    matrix drives detection→replay end to end. Tests may invent their
+    own sites freely."""
 
     def __init__(self, conf=None, seed: Optional[int] = None,
                  flight=NULL_FLIGHT_RECORDER):
@@ -361,6 +388,7 @@ class FaultInjector:
         self._fail_count: Dict[str, int] = {}
         self._fail_rate: Dict[str, float] = {}
         self._delay_ms: Dict[str, float] = {}
+        self._offset: Dict[str, int] = {}
         self._hits: Dict[str, int] = {}
         self._injected: Dict[str, int] = {}
         if conf is not None:
@@ -382,10 +410,12 @@ class FaultInjector:
                     self._fail_rate[site] = float(val)
                 elif knob == "delayms":
                     self._delay_ms[site] = float(val)
+                elif knob == "offset":
+                    self._offset[site] = int(val)
         self._rng = np.random.default_rng(seed or 0)
 
     def arm(self, site: str, fail_count: int = 0, fail_rate: float = 0.0,
-            delay_ms: float = 0.0) -> None:
+            delay_ms: float = 0.0, offset: Optional[int] = None) -> None:
         with self._lock:
             if fail_count:
                 self._fail_count[site] = fail_count
@@ -393,12 +423,15 @@ class FaultInjector:
                 self._fail_rate[site] = fail_rate
             if delay_ms:
                 self._delay_ms[site] = delay_ms
+            if offset is not None:
+                self._offset[site] = int(offset)
 
     def disarm(self, site: str) -> None:
         with self._lock:
             self._fail_count.pop(site, None)
             self._fail_rate.pop(site, None)
             self._delay_ms.pop(site, None)
+            self._offset.pop(site, None)
 
     @property
     def active(self) -> bool:
@@ -426,6 +459,32 @@ class FaultInjector:
         if fire:
             self.flight.record("fault", site=site)
             raise InjectedFault(f"injected fault at site {site!r}")
+
+    def fire(self, site: str) -> Optional[int]:
+        """Corrupt-site variant of :meth:`check`: when ``site`` is
+        armed, consume one firing and return the armed byte offset
+        (default 0) instead of raising — the integrity plane then flips
+        a bit at that offset into the staged/spill bytes so checksum
+        verification must detect it. None when not armed (zero work
+        when nothing is armed anywhere)."""
+        if not self.active:
+            return None
+        with self._lock:
+            self._hits[site] = self._hits.get(site, 0) + 1
+            fired = False
+            remaining = self._fail_count.get(site, 0)
+            if remaining > 0:
+                self._fail_count[site] = remaining - 1
+                fired = True
+            elif self._rng.random() < self._fail_rate.get(site, 0.0):
+                fired = True
+            if fired:
+                self._injected[site] = self._injected.get(site, 0) + 1
+            offset = self._offset.get(site, 0)
+        if fired:
+            self.flight.record("fault", site=site, offset=offset)
+            return offset
+        return None
 
     def stats(self) -> Dict[str, Tuple[int, int]]:
         """{site: (hits, injected)} — observability for tests/CI."""

@@ -139,6 +139,14 @@ class ShuffleService:
     def unregister_shuffle(self, shuffle_id: int) -> None:
         self.manager.unregister_shuffle(shuffle_id)
 
+    def recovered_shuffles(self):
+        """Shuffles the durable ledger (``failure.ledgerDir``) restored
+        at connect and that await adoption by :meth:`register_shuffle`:
+        {shuffle_id: {"intact": [...], "quarantined": [...]}} — the
+        host engine re-runs ONLY the quarantined maps, like Spark
+        re-scheduling only a lost executor's tasks."""
+        return self.manager.recovered_shuffles()
+
     def stop(self) -> None:
         if self._dumper is not None:
             self._dumper.stop()

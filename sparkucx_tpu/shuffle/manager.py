@@ -48,9 +48,15 @@ from sparkucx_tpu.shuffle.reader import (
 )
 from sparkucx_tpu.shuffle.writer import MapOutputWriter
 from sparkucx_tpu.utils.logging import get_logger
-from sparkucx_tpu.runtime.failures import (PeerLostError, StaleEpochError,
+from sparkucx_tpu.runtime.failures import (BlockCorruptionError,
+                                           PeerLostError, StaleEpochError,
                                            TransientError)
-from sparkucx_tpu.utils.metrics import (C_REPLAY_MS, C_REPLAYS,
+from sparkucx_tpu.utils.metrics import (C_INTEGRITY_CORRUPT,
+                                        C_INTEGRITY_CORRUPT_BLOCKS,
+                                        C_INTEGRITY_QUARANTINED,
+                                        C_INTEGRITY_RECOVERED,
+                                        C_INTEGRITY_VERIFIED,
+                                        C_REPLAY_MS, C_REPLAYS,
                                         COMPILE_HITS, COMPILE_PROGRAMS,
                                         GLOBAL_METRICS, H_BW,
                                         H_FETCH_FIRST, H_FETCH_WAIT,
@@ -180,9 +186,20 @@ class ExchangeReport:
     # rule grades these against failure.replayBudget.
     replays: int = 0
     replay_ms: float = 0.0
+    # Integrity plane (shuffle/integrity.py): the verify level this read
+    # actually ran — "staged" = the staged/spill bytes were re-checked
+    # against the commit checksums before entering the exchange, "full"
+    # = additionally the host-drained post-collective rows verified per
+    # partition against the published digest sums (key lanes only under
+    # the int8 wire — dequantized values are legitimately lossy).
+    # ``integrity_bytes`` counts what was verified. "" = off / no
+    # records published.
+    integrity: str = ""
+    integrity_bytes: int = 0
     completed: bool = False
     error: Optional[str] = None
     # bookkeeping, excluded from to_dict()
+    _full_done: bool = field(default=False, repr=False)
     _t_dispatched: float = field(default=0.0, repr=False)
     _hits0: float = field(default=0.0, repr=False)
     _prog0: float = field(default=0.0, repr=False)
@@ -285,6 +302,25 @@ class TpuShuffleManager:
         self._replay_counts: Dict[int, int] = {}
         self._policy = self.conf.failure_policy
         self._replay_budget = self.conf.replay_budget
+        # -- integrity plane (shuffle/integrity.py) -----------------------
+        self._integrity_level = self.conf.integrity_verify
+        # distributed full-verify: per-shuffle expected digest tables
+        # allgathered at submit, consumed by the post-collective check
+        self._full_expect: Dict[int, Dict] = {}
+        self._warned_integrity: set = set()     # warn-once latches
+        # -- durable ledger (failure.ledgerDir, shuffle/durable.py) -------
+        # Disk-backed twin of the replay ledger: commits seal to disk,
+        # and THIS constructor — a restarted process — scans the
+        # directory, validates manifests + checksums, re-registers
+        # intact shuffles and keeps them adoptable by register_shuffle
+        # with zero recompute (quarantined blocks excepted).
+        self._ledger = None
+        self._recovered: Dict[int, Dict] = {}
+        if self.conf.ledger_dir:
+            from sparkucx_tpu.shuffle.durable import ShuffleLedger
+            self._ledger = ShuffleLedger(self.conf.ledger_dir)
+            self._ledger.epoch = self.node.epochs.current
+            self._recover_from_ledger()
         # In-flight reads by the manager GENERATION they registered under.
         # The generation (not the node epoch) keys the guard because it is
         # mutated under the same lock that clears _writers — the node
@@ -345,6 +381,15 @@ class TpuShuffleManager:
 
     def _on_epoch_bump(self, epoch: int) -> None:
         self._bind_mesh()
+        if self._ledger is not None:
+            # manifests written from now on record the new epoch
+            self._ledger.epoch = epoch
+            # a remesh cleared the registry BEFORE this listener ran:
+            # ledger-recovered shuffles still awaiting adoption would
+            # otherwise hand out orphaned entries — re-register them
+            # under the new epoch (their sealed files are disk state a
+            # membership change did not touch)
+            self._refresh_recovered_registrations()
         # Recovery ledger (failure.policy=replay): an epoch bump no
         # longer unconditionally drops every shuffle. The staged writer
         # blocks on THIS process are host memory — a membership change
@@ -428,7 +473,10 @@ class TpuShuffleManager:
                                  shape["num_partitions"],
                                  shape["partitioner"], shape["bounds"])
             for m in sorted(ws):
-                entry.publish(m, old_entry.fetch_record(m))
+                # the integrity record rides the re-registration beside
+                # the size row — a replayed read must still verify
+                entry.publish(m, old_entry.fetch_record(m),
+                              integrity=old_entry.fetch_integrity(m))
                 ws[m].entry = entry
             with self._lock:
                 self._replayed[sid] = {"entry": entry, "epoch": epoch}
@@ -539,6 +587,345 @@ class TpuShuffleManager:
         self.node.metrics.inc(C_REPLAYS, float(replays))
         if replay_ms:
             self.node.metrics.inc(C_REPLAY_MS, float(replay_ms))
+
+    # -- restart recovery (failure.ledgerDir, shuffle/durable.py) ----------
+    def _recover_from_ledger(self) -> None:
+        """Scan the durable ledger at construction: each CRC-validated
+        manifest whose sealed files pass their checksums re-registers in
+        the registry under the CURRENT epoch — intact size rows (and
+        integrity records) published, corrupt blocks quarantined by the
+        scan. ``register_shuffle`` with a matching shape then ADOPTS the
+        recovered state instead of raising 'already registered', and
+        reads serve the sealed mmap views with zero recompute."""
+        reg = self.node.registry
+        for rs in self._ledger.scan():
+            sid = rs.shuffle_id
+            try:
+                reg.get(sid)
+                continue       # a live manager in this process owns it
+            except KeyError:
+                pass
+            try:
+                entry = reg.register(sid, rs.num_maps, rs.num_partitions,
+                                     rs.partitioner, rs.bounds)
+                for mid in sorted(rs.intact):
+                    rec, sizes = rs.intact[mid]
+                    entry.publish(mid, sizes, integrity=rec)
+            except Exception as e:
+                log.error("restart recovery: shuffle %d could not "
+                          "re-register (%s) — it will recompute", sid, e)
+                reg.unregister(sid)
+                continue
+            self._recovered[sid] = {"rs": rs, "entry": entry}
+            self.node.metrics.inc(C_INTEGRITY_RECOVERED,
+                                  float(len(rs.intact)))
+            if rs.quarantined:
+                self.node.metrics.inc(C_INTEGRITY_QUARANTINED,
+                                      float(len(rs.quarantined)))
+                self.node.flight.record(
+                    "block_quarantine", shuffle_id=sid,
+                    maps=list(rs.quarantined))
+            log.warning(
+                "restart recovery: shuffle %d re-registered from the "
+                "ledger (%d/%d maps intact served without recompute%s)",
+                sid, len(rs.intact), rs.num_maps,
+                f"; maps {rs.quarantined} quarantined — re-stage only "
+                f"those" if rs.quarantined else "")
+
+    def _refresh_recovered_registrations(self) -> None:
+        """Re-register recovered-but-unadopted shuffles whose registry
+        entries a remesh cleared (registry.clear runs before bump
+        listeners). Failure drops the recovery — the shuffle simply
+        recomputes, the no-ledger behavior."""
+        reg = self.node.registry
+        with self._lock:
+            pending = list(self._recovered.items())
+        for sid, rec in pending:
+            try:
+                reg.get(sid)
+                continue                      # entry survived
+            except KeyError:
+                pass
+            rs = rec["rs"]
+            try:
+                entry = reg.register(sid, rs.num_maps, rs.num_partitions,
+                                     rs.partitioner, rs.bounds)
+                for mid in sorted(rs.intact):
+                    irec, sizes = rs.intact[mid]
+                    entry.publish(mid, sizes, integrity=irec)
+                rec["entry"] = entry
+            except Exception as e:
+                log.error("recovered shuffle %d could not re-register "
+                          "after the remesh (%s) — it will recompute",
+                          sid, e)
+                with self._lock:
+                    self._recovered.pop(sid, None)
+
+    def recovered_shuffles(self) -> Dict[int, Dict]:
+        """{shuffle_id: {"intact": [...], "quarantined": [...]}} still
+        awaiting adoption by :meth:`register_shuffle` — the restart
+        drill's zero-recompute evidence."""
+        with self._lock:
+            return {sid: {"intact": sorted(rec["rs"].intact),
+                          "quarantined": list(rec["rs"].quarantined)}
+                    for sid, rec in self._recovered.items()}
+
+    def _adopt_recovered(self, rec: Dict, shuffle_id: int, num_maps: int,
+                         num_partitions: int, partitioner: str,
+                         bounds) -> Optional[ShuffleHandle]:
+        """Install a ledger-recovered shuffle as live state: committed
+        writers over the sealed file sets for every intact map (reads
+        consume their mmap views — zero recompute), nothing for
+        quarantined maps (``entry.present(m)`` is False there; the app
+        re-stages only those). Returns None on a shape mismatch — the
+        recovery is dropped and the caller registers fresh (a shuffle id
+        reused with a different shape is a different shuffle)."""
+        rs = rec["rs"]
+        want_bounds = tuple(int(x) for x in bounds) \
+            if bounds is not None else None
+        if (num_maps, num_partitions, partitioner, want_bounds) != \
+                (rs.num_maps, rs.num_partitions, rs.partitioner,
+                 rs.bounds):
+            log.warning(
+                "register_shuffle(%d): shape differs from the ledger's "
+                "(%dx%d %s vs %dx%d %s) — dropping the recovered state "
+                "and registering fresh", shuffle_id, num_maps,
+                num_partitions, partitioner, rs.num_maps,
+                rs.num_partitions, rs.partitioner)
+            self.node.registry.unregister(shuffle_id)
+            if self._ledger is not None:
+                self._ledger.forget(shuffle_id)
+            return None
+        entry = rec["entry"]
+        ws = {
+            mid: MapOutputWriter.recovered(
+                entry, mid, self.node.pool, rs.directory, irec,
+                partitioner=partitioner, bounds=want_bounds,
+                integrity_level=self._integrity_level)
+            for mid, (irec, _sizes) in rs.intact.items()}
+        with self._lock:
+            self._writers[shuffle_id] = ws
+            self._shapes[shuffle_id] = {
+                "num_maps": num_maps, "num_partitions": num_partitions,
+                "partitioner": partitioner, "bounds": want_bounds}
+            self._replayed.pop(shuffle_id, None)
+            self._replay_counts.pop(shuffle_id, None)
+        log.info(
+            "shuffle %d adopted from the recovery ledger: %d/%d maps "
+            "served from sealed spill files, %d to re-stage",
+            shuffle_id, len(ws), num_maps, num_maps - len(ws))
+        return ShuffleHandle(shuffle_id, num_maps, num_partitions, entry,
+                             partitioner, self.node.epochs.current,
+                             want_bounds)
+
+    # -- integrity verification (shuffle/integrity.py) ---------------------
+    def _warn_integrity_once(self, key: str, msg: str) -> None:
+        if key not in self._warned_integrity:
+            self._warned_integrity.add(key)
+            log.warning(msg)
+
+    def _note_corruption(self, shuffle_id: int, block: str, nbytes: int,
+                         detail: str) -> str:
+        """Account one detected corruption (counters + a flight-ring
+        event naming the corrupt block — the postmortem evidence) and
+        return the error message for the typed raise."""
+        self.node.metrics.inc(C_INTEGRITY_CORRUPT_BLOCKS, 1.0)
+        self.node.metrics.inc(C_INTEGRITY_CORRUPT, float(max(nbytes, 0)))
+        self.node.flight.record("block_corruption", shuffle_id=shuffle_id,
+                                block=block, bytes=int(nbytes),
+                                detail=detail[:200])
+        msg = (f"shuffle {shuffle_id}: block corruption detected in "
+               f"{block}: {detail} — staged bytes no longer match the "
+               f"checksums published at commit "
+               f"(spark.shuffle.tpu.integrity.verify="
+               f"{self._integrity_level}); failure.policy=replay spends "
+               f"one budget unit re-verifying and re-running")
+        log.error(msg)
+        return msg
+
+    def _verified_materialize(self, entry, map_id: int, w):
+        """Materialize one committed map output and re-verify its bytes
+        against the integrity record published at commit — the
+        pack-time staged verify (bytes are checked BEFORE they enter
+        the exchange). Home of the FaultInjector ``corrupt`` sites:
+        an armed ``corrupt.staged``/``corrupt.spill`` flips one bit
+        into the staged arena bytes / sealed spill file for exactly the
+        duration of this verification read (transient in-flight
+        corruption — detection always fires; the replay's re-verify
+        finds the bytes intact and recovers to oracle-exact output)."""
+        from sparkucx_tpu.shuffle import integrity as integ
+        faults = self.node.faults
+        token = None
+        try:
+            spilled = w._spill is not None
+            if not spilled and w._keys:
+                # only consult the injector when a flippable target
+                # exists: an empty map output must not CONSUME the
+                # armed firing while applying no flip — the cell's
+                # detection-always gate would read fault_fired=true
+                # with nothing to detect
+                off = faults.fire("corrupt.staged")
+                if off is not None:
+                    # pre-materialize: the arena batches are about to be
+                    # concatenated, and the flip must ride the copy
+                    token = integ.flip_array_byte(w._keys[0], off)
+            keys, values = w.materialize()
+            if spilled:
+                off = faults.fire("corrupt.spill")
+                if off is not None:
+                    # post-mmap: MAP_SHARED views observe the file flip
+                    # through the page cache
+                    token = integ.flip_file_byte(w._spill.keys_path, off)
+            rec = entry.fetch_integrity(map_id)
+            if rec is None:
+                # pre-integrity publisher (direct registry users,
+                # integrity.verify=off at commit time): nothing to
+                # check — -1 tells the caller this map does NOT count
+                # as verified (the report must not claim it was)
+                return keys, values, -1
+            try:
+                nbytes = integ.verify_staged(keys, values, rec)
+            except integ._StagedMismatch as e:
+                block = (os.path.basename(w._spill.keys_path)
+                         if spilled else f"map {map_id} staged arena")
+                raise BlockCorruptionError(self._note_corruption(
+                    entry.shuffle_id, f"map {map_id} ({block})",
+                    int(keys.nbytes)
+                    + (int(values.nbytes) if values is not None else 0),
+                    str(e))) from None
+            return keys, values, nbytes
+        finally:
+            if token is not None:
+                token.restore()
+
+    def _verify_full_result(self, handle: ShuffleHandle, res,
+                            combine: Optional[str] = None) -> None:
+        """The ``integrity.verify=full`` post-collective check: every
+        LOCAL reduce partition of the drained result re-digests
+        (order-independent row-digest sums, shuffle/integrity.py) and
+        must match the senders' published per-partition digest rows.
+        Raw/lossless wires verify the full rows; the int8 tier verifies
+        the exact key lanes (dequantized values are legitimately
+        lossy). Entirely host-side — the compiled program is untouched
+        at every level. Runs once per read (``_full_done``); combined
+        reads skip (the device merge legitimately rewrites rows).
+
+        Distributed note: a mismatch verdict is PROCESS-LOCAL (each
+        process drains only its partitions) and runs AFTER the
+        collective completed everywhere, so no peer is left mid-
+        rendezvous; the raise surfaces typed to the caller because
+        ``_replay_after_failure`` refuses distributed replays — the
+        recovery controller owns the coordinated re-run, the same
+        posture as every other distributed failure."""
+        if self._integrity_level != "full":
+            return
+        rep = self.report(handle.shuffle_id)
+        if rep is None or rep._full_done:
+            return
+        rep._full_done = True
+        if combine:
+            self._warn_integrity_once(
+                "full_combine",
+                "integrity.verify=full: combined reads verify at the "
+                "staged level only — combine-by-key legitimately "
+                "rewrites rows on device, so per-row digests cannot "
+                "survive it")
+            return
+        from sparkucx_tpu.shuffle.integrity import (aggregate_digests,
+                                                    digest_sum)
+        key_only = rep.wire == "int8"
+        if self.node.is_distributed:
+            st = self._full_expect.pop(handle.shuffle_id, None)
+            if st is None:
+                self._warn_integrity_once(
+                    "full_dist", "integrity.verify=full: no agreed "
+                    "digest table for this distributed read (a peer "
+                    "committed below the full level?) — staged verify "
+                    "only")
+                return
+            expected = st["key" if key_only else "full"]
+        else:
+            expected = aggregate_digests(handle.entry, handle.num_maps,
+                                         key_only)
+            if expected is None:
+                self._warn_integrity_once(
+                    "full_missing",
+                    "integrity.verify=full: commit published no digest "
+                    "rows (maps committed below the full level) — "
+                    "staged verify only for this shuffle")
+                return
+        verified = 0
+        for r in range(handle.num_partitions):
+            if not res.is_local(r):
+                continue
+            k, v = res.partition(r)
+            got = digest_sum(k, None if key_only else v)
+            if got != int(expected[r]):
+                raise BlockCorruptionError(self._note_corruption(
+                    handle.shuffle_id,
+                    f"reduce partition {r} (post-collective"
+                    f"{', key lanes' if key_only else ''})",
+                    int(k.nbytes) + (int(v.nbytes) if v is not None
+                                     and not key_only else 0),
+                    f"drained digest {got:#x} != published "
+                    f"{int(expected[r]):#x}"))
+            verified += int(k.nbytes) + (int(v.nbytes)
+                                         if v is not None
+                                         and not key_only else 0)
+        self.node.metrics.inc(C_INTEGRITY_VERIFIED, float(verified))
+        rep.integrity = "full"
+        rep.integrity_bytes += verified
+
+    def _stash_full_expect(self, handle: ShuffleHandle, writers) -> None:
+        """Distributed full verify: allgather every process's local
+        digest-row sums so each receiver holds the GLOBAL expected
+        table for its partitions. uint64 digests travel as four 16-bit
+        lanes — the blob channel rides jnp int32 arithmetic, which
+        silently truncates wider lanes (the e2e harness's established
+        caveat). One extra metadata-plane allgather per read, only at
+        the full level; any process lacking digest rows makes every
+        process skip together (SPMD-uniform verdict)."""
+        import numpy as _np
+        from sparkucx_tpu.shuffle.distributed import allgather_blob
+        R = handle.num_partitions
+        full = _np.zeros(R, dtype=_np.uint64)
+        key = _np.zeros(R, dtype=_np.uint64)
+        have = 1
+        for mid in writers:
+            rec = handle.entry.fetch_integrity(mid)
+            if rec is None or rec.digests is None:
+                have = 0
+                break
+            full += _np.asarray(rec.digests, dtype=_np.uint64)
+            key += _np.asarray(rec.key_digests, dtype=_np.uint64)
+
+        def lanes(u64):
+            out = _np.zeros(4 * R, dtype=_np.int64)
+            for i in range(4):
+                out[i::4] = ((u64 >> _np.uint64(16 * i))
+                             & _np.uint64(0xFFFF)).astype(_np.int64)
+            return out
+
+        blob = _np.concatenate([_np.array([have], dtype=_np.int64),
+                                lanes(full), lanes(key)])
+        gathered = allgather_blob(blob)              # [nproc, 1+8R]
+        if not int(gathered[:, 0].min()):
+            return                                    # someone lacks rows
+
+        def unlanes(rows):
+            acc = _np.zeros(R, dtype=_np.uint64)
+            for p in range(rows.shape[0]):
+                u = _np.zeros(R, dtype=_np.uint64)
+                for i in range(4):
+                    u |= rows[p, i::4].astype(_np.uint64) \
+                        << _np.uint64(16 * i)
+                acc += u
+            return acc
+
+        self._full_expect[handle.shuffle_id] = {
+            "full": unlanes(gathered[:, 1:1 + 4 * R]),
+            "key": unlanes(gathered[:, 1 + 4 * R:]),
+        }
 
     # -- in-flight read tracking (graveyard release condition) -------------
     def _collect_free_graveyard_locked(self) -> list:
@@ -700,6 +1087,19 @@ class TpuShuffleManager:
         if (partitioner == "range") != (bounds is not None):
             raise ValueError(
                 "partitioner='range' requires bounds (and only it)")
+        # Restart recovery (failure.ledgerDir): a shuffle the ledger scan
+        # validated is ADOPTED — committed writers over its sealed files,
+        # zero recompute of intact maps — instead of colliding with its
+        # own re-registration. Shape mismatch drops the recovery and
+        # registers fresh.
+        with self._lock:
+            rec = self._recovered.pop(shuffle_id, None)
+        if rec is not None:
+            h = self._adopt_recovered(rec, shuffle_id, num_maps,
+                                      num_partitions, partitioner,
+                                      bounds)
+            if h is not None:
+                return h
         entry = self.node.registry.register(shuffle_id, num_maps,
                                             num_partitions, partitioner,
                                             bounds)
@@ -727,12 +1127,18 @@ class TpuShuffleManager:
         if not (0 <= map_id < handle.num_maps):
             raise IndexError(
                 f"mapId {map_id} out of range [0,{handle.num_maps})")
+        # durable staging: with the ledger on, spills land in the
+        # shuffle's ledger dir and commit() seals + manifests them there
+        spill_dir = self._ledger.shuffle_dir(handle.shuffle_id) \
+            if self._ledger is not None else self.conf.spill_dir
         w = MapOutputWriter(handle.entry, map_id, self.node.pool,
                             partitioner=handle.partitioner,
                             faults=self.node.faults,
-                            spill_dir=self.conf.spill_dir,
+                            spill_dir=spill_dir,
                             spill_threshold=self.conf.spill_threshold,
-                            bounds=handle.bounds)
+                            bounds=handle.bounds,
+                            integrity_level=self._integrity_level,
+                            ledger=self._ledger)
         with self._lock:
             # First-commit-wins: a committed map output is immutable. A
             # speculative or retried map task may run again, but replacing
@@ -1062,6 +1468,12 @@ class TpuShuffleManager:
                             handle, timeout, combine=combine,
                             ordered=ordered,
                             combine_sum_words=combine_sum_words).result()
+                    # integrity.verify=full: the post-collective check
+                    # runs INSIDE the retry window — a corrupt drained
+                    # block is a TransientError the replay policy may
+                    # absorb (waved reads already verified in their
+                    # finalize; _full_done makes this a no-op there)
+                    self._verify_full_result(handle, res, combine)
                     break
                 except TransientError as e:
                     replay_ms += (time.perf_counter() - t_attempt) * 1e3
@@ -1216,7 +1628,8 @@ class TpuShuffleManager:
                     f"(writer replaced or released?)")
             shard_outputs, has_vals, val_tail, val_dtype = \
                 self._materialize_outputs(
-                    writers, Pn, lambda ordinal, map_id: map_id % Pn)
+                    writers, Pn, lambda ordinal, map_id: map_id % Pn,
+                    entry=handle.entry, rep=rep)
 
             # int32-range guard on what actually feeds the plan arithmetic:
             # the per-DEVICE aggregated transfer matrix, not the raw [M, R]
@@ -1279,7 +1692,8 @@ class TpuShuffleManager:
 
         on_done, arm = self._arm_read_callbacks(
             stage_buf, release_admitted, handle,
-            int(nvalid.sum()), int(nvalid.sum()), width, report=rep)
+            int(nvalid.sum()), int(nvalid.sum()), width, report=rep,
+            combine=combine)
 
         # Buffer ownership: until a pending handle exists, failures here
         # (the fault site, compile errors inside the first dispatch) must
@@ -1476,7 +1890,8 @@ class TpuShuffleManager:
 
     def _arm_read_callbacks(self, stage_buf, release_admitted, handle,
                             global_rows: int, local_rows: int, width: int,
-                            report: Optional[ExchangeReport] = None):
+                            report: Optional[ExchangeReport] = None,
+                            combine: Optional[str] = None):
         """(on_done, arm) pair shared by the local and distributed submit
         paths: exactly-once pinned-buffer + admission release, capacity
         learning, the reporter counters (rows/bytes local to this
@@ -1559,6 +1974,13 @@ class TpuShuffleManager:
 
         def arm(pending):
             handle_box["pending"] = weakref.ref(pending)
+            if self._integrity_level == "full":
+                # the post-collective digest check rides result() itself
+                # (reader.PendingExchangeBase), so async submit()/result()
+                # consumers verify exactly like read() — which then skips
+                # via the report's _full_done guard
+                pending._post_result = lambda res: \
+                    self._verify_full_result(handle, res, combine)
 
         return on_done, arm
 
@@ -1693,20 +2115,36 @@ class TpuShuffleManager:
                 self._cap_hints[key] = used / balanced
 
     # -- shared staging helpers -------------------------------------------
-    @staticmethod
-    def _materialize_outputs(writers, num_slots, slot_of):
+    def _materialize_outputs(self, writers, num_slots, slot_of,
+                             entry=None, rep=None):
         """Materialize committed map outputs into per-slot lists and agree
         on one value schema. ``slot_of(ordinal, map_id)`` places each map
         output (slots = shards single-process, local shards distributed).
 
+        With ``entry`` and ``integrity.verify != off``, every output is
+        RE-VERIFIED against the integrity record its commit published —
+        the pack-time staged check: bytes that no longer match raise
+        typed :class:`BlockCorruptionError` before they can enter the
+        exchange (``rep`` records the verified level + bytes).
+
         Returns (slot_outputs, has_vals, val_tail, val_dtype); raises on a
         mixed schema — bit-reinterpreting one writer's rows under another's
         schema would silently corrupt."""
+        verify = entry is not None and self._integrity_level != "off"
+        verified_bytes = 0
+        verified_maps = 0
         slot_outputs = [[] for _ in range(num_slots)]
         has_vals = False
         val_tail, val_dtype = None, None
         for ordinal, (map_id, w) in enumerate(sorted(writers.items())):
-            keys, values = w.materialize()
+            if verify:
+                keys, values, nb = self._verified_materialize(
+                    entry, map_id, w)
+                if nb >= 0:
+                    verified_bytes += nb
+                    verified_maps += 1
+            else:
+                keys, values = w.materialize()
             if values is not None and keys.shape[0]:
                 has_vals = True
                 if val_dtype is None:
@@ -1725,6 +2163,18 @@ class TpuShuffleManager:
                         raise ValueError(
                             "mixed schema: some map outputs have values, "
                             "others have keys only")
+        if verify:
+            if verified_bytes:
+                self.node.metrics.inc(C_INTEGRITY_VERIFIED,
+                                      float(verified_bytes))
+            if rep is not None and verified_maps:
+                # only maps that PUBLISHED records count as verified —
+                # a shuffle whose commits carried no integrity records
+                # (direct registry publishers, pre-integrity state)
+                # keeps integrity="" per the report contract rather
+                # than claiming a check that never ran
+                rep.integrity = self._integrity_level
+                rep.integrity_bytes += verified_bytes
         return slot_outputs, has_vals, val_tail, val_dtype
 
     def _pack_shards(self, slot_outputs, cap_in, width, has_vals):
@@ -2062,7 +2512,8 @@ class TpuShuffleManager:
 
         shard_outputs, has_vals, val_tail, val_dtype = \
             self._materialize_outputs(
-                writers, L, lambda ordinal, map_id: ordinal % L)
+                writers, L, lambda ordinal, map_id: ordinal % L,
+                entry=handle.entry, rep=rep)
         local_rows_n = sum(k.shape[0]
                            for outs in shard_outputs for k, _ in outs)
 
@@ -2099,6 +2550,10 @@ class TpuShuffleManager:
             dtype=np.int64)
         nvalid = allgather_sizes(nvalid_local, shard_ids, Pn)
         validate_row_sizes(nvalid.reshape(1, -1))
+        if self._integrity_level == "full" and not combine:
+            # one more metadata-plane collective, full level only: the
+            # receivers need the GLOBAL per-partition digest table
+            self._stash_full_expect(handle, writers)
         t_plan = time.perf_counter()
         with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id,
                          trace=rep.trace_id if rep is not None else ""):
@@ -2167,7 +2622,8 @@ class TpuShuffleManager:
 
         on_done, arm = self._arm_read_callbacks(
             stage_buf, release_admitted, handle,
-            int(nvalid.sum()), int(nvalid_local.sum()), width, report=rep)
+            int(nvalid.sum()), int(nvalid_local.sum()), width, report=rep,
+            combine=combine)
 
         # same ownership rule as the local path: the armed handle is the
         # sole releaser of the pack buffer
@@ -2254,7 +2710,8 @@ class TpuShuffleManager:
             self._read_finished(read_gen)
 
     # -- teardown ---------------------------------------------------------
-    def unregister_shuffle(self, shuffle_id: int) -> None:
+    def unregister_shuffle(self, shuffle_id: int,
+                           keep_durable: bool = False) -> None:
         """Release table + staged buffers
         (ref: CommonUcxShuffleManager.scala:73-77).
 
@@ -2262,18 +2719,27 @@ class TpuShuffleManager:
         remesh drop: a read between its writers snapshot and the end of
         pack may still be walking these buffers, and an inline release
         here would be the exact use-after-free the graveyard exists to
-        prevent. With no read in flight they free immediately."""
+        prevent. With no read in flight they free immediately.
+
+        ``keep_durable`` (stop()'s path) leaves the shuffle's ledger
+        state on disk: process shutdown must NOT destroy what the
+        ledger exists to carry across restarts. The default — explicit
+        application teardown — forgets it."""
         with self._lock:
             writers = self._writers.pop(shuffle_id, {})
             self._shapes.pop(shuffle_id, None)
             self._replayed.pop(shuffle_id, None)
             self._replay_counts.pop(shuffle_id, None)
+            self._recovered.pop(shuffle_id, None)
+            self._full_expect.pop(shuffle_id, None)
             self._gen += 1
             if writers:
                 self._graveyard.append((self._gen, [writers]))
             to_free = self._collect_free_graveyard_locked()
         self._release_writer_batches(to_free)
         self.node.registry.unregister(shuffle_id)
+        if self._ledger is not None and not keep_durable:
+            self._ledger.forget(shuffle_id)
 
     def stop(self, drain_timeout: float = 10.0) -> None:
         """Tear everything down (ref: CommonUcxShuffleManager.scala:82-91).
@@ -2305,7 +2771,15 @@ class TpuShuffleManager:
         if pack_pool is not None:
             pack_pool.shutdown(wait=True)
         for sid in ids:
-            self.unregister_shuffle(sid)
+            # shutdown keeps durable ledger state — surviving process
+            # death is the ledger's whole point
+            self.unregister_shuffle(sid, keep_durable=True)
+        # recovered-but-never-adopted shuffles hold registry entries the
+        # scan created; drop those too (their files stay on disk)
+        with self._lock:
+            leftover = list(self._recovered.keys())
+        for sid in leftover:
+            self.unregister_shuffle(sid, keep_durable=True)
         # A drain that timed out leaves reads active: the unregister loop
         # just RE-parked those writers in the graveyard keyed against the
         # still-live generations, where they would sit until process exit
@@ -2550,6 +3024,14 @@ class PendingWaveShuffle:
                                        self._val_tail, self._val_dtype)
         self._finalize(res, timeline, retries_total, pack_total,
                        pack_hidden, dispatch_total)
+        # integrity.verify=full: the host-drained wave blocks verify
+        # AFTER the collective completes, against the senders' published
+        # per-partition digest sums (accumulated across all waves — the
+        # digests are order- and wave-split-invariant by construction).
+        # Raises typed through result(), where the replay policy can
+        # absorb it; async waved consumers get the same check.
+        mgr._verify_full_result(self._handle, res,
+                                self._outer_plan.combine)
         return res
 
     def _dispatch_wave(self, shard_rows: np.ndarray, wnv: np.ndarray,

@@ -1213,6 +1213,14 @@ class PendingExchangeBase:
                 admit(True)
                 self._dispatch()
             res = self._result_inner()
+            # post-result hook (manager arms it at integrity.verify=full):
+            # the post-collective digest check runs INSIDE result() so
+            # async submit()/result() consumers get the same verification
+            # as read() — a raise here takes the failure path below like
+            # any other exchange error (typed, replay-absorbable)
+            hook = getattr(self, "_post_result", None)
+            if hook is not None:
+                hook(res)
         except Exception:
             # on_done fires exactly once and releases the pinned pack
             # buffer, so the handle cannot be retried — mark it dead for a

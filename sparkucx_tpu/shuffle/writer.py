@@ -33,8 +33,10 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from sparkucx_tpu.meta.registry import ShuffleEntry
+from sparkucx_tpu.runtime.failures import TruncatedBlockError
 from sparkucx_tpu.runtime.memory import ArenaBuffer, HostMemoryPool, \
     MappedFile
+from sparkucx_tpu.utils.atomicio import atomic_write_text, fsync_dir
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.metrics import Timer
 from sparkucx_tpu.utils.trace import GLOBAL_TRACER
@@ -52,7 +54,16 @@ class SpillFiles:
     file IS one int64 array, the whole vals file one [n, ...] array — so
     ``mmap`` + ``ndarray.view`` replaces the offset arithmetic the
     reference needs (ref: UnsafeUtils.java:48-65,
-    CommonUcxShuffleBlockResolver.scala:33-57)."""
+    CommonUcxShuffleBlockResolver.scala:33-57).
+
+    TORN-WRITE-PROOF: appends land in ``*.tmp`` files; :meth:`finish`
+    SEALS them — flush + fsync + atomic rename to the final names, the
+    ``.index`` sidecar written the same way (utils/atomicio) — so a
+    process killed mid-spill leaves only ``.tmp`` debris, never a
+    plausible-looking short file under the final name. :meth:`load`
+    validates the sealed file lengths against the sidecar BEFORE mmap:
+    truncation is a typed :class:`TruncatedBlockError` naming the file,
+    not a garbage view."""
 
     def __init__(self, directory: str, shuffle_id: int, map_id: int):
         os.makedirs(directory, exist_ok=True)
@@ -61,65 +72,132 @@ class SpillFiles:
         self.keys_path = stem + ".keys"
         self.vals_path = stem + ".vals"
         self.index_path = stem + ".index"
-        self._kf = open(self.keys_path, "ab")
-        self._vf = open(self.vals_path, "ab")
+        # "wb", not "ab": the stem is exclusively this writer's (first-
+        # commit-wins upstream), so leftover bytes from a crashed
+        # predecessor with the same name must be truncated, not extended
+        self._kf = open(self.keys_path + ".tmp", "wb")
+        self._vf = open(self.vals_path + ".tmp", "wb")
         self.rows = 0
+        self.sealed = False
         self._maps: List[MappedFile] = []
 
+    @classmethod
+    def open_sealed(cls, directory: str, shuffle_id: int,
+                    map_id: int) -> "SpillFiles":
+        """Adopt an already-sealed file set (restart recovery from the
+        durable ledger, shuffle/durable.py): no write fds, rows/schema
+        from the sealed sidecar; :meth:`load` serves the mmap views."""
+        obj = cls.__new__(cls)
+        stem = os.path.join(directory,
+                            f"shuffle_{shuffle_id}_map_{map_id}")
+        obj.keys_path = stem + ".keys"
+        obj.vals_path = stem + ".vals"
+        obj.index_path = stem + ".index"
+        obj._kf = obj._vf = None
+        obj.sealed = True
+        obj._maps = []
+        with open(obj.index_path) as f:
+            obj.rows = int(json.load(f)["rows"])
+        return obj
+
     def append(self, keys: np.ndarray, values: Optional[np.ndarray]) -> None:
+        if self.sealed:
+            raise RuntimeError(
+                f"{self.keys_path}: sealed spill files are immutable "
+                f"(append after finish)")
         self._kf.write(keys.tobytes())
         if values is not None:
             self._vf.write(values.tobytes())
         self.rows += keys.shape[0]
 
     def finish(self, val_tail, val_dtype) -> None:
-        """Flush + write the index sidecar; no further appends."""
-        self._kf.flush()
-        self._vf.flush()
-        with open(self.index_path, "w") as f:
-            json.dump({
-                "rows": self.rows,
-                "val_dtype": (np.dtype(val_dtype).str
-                              if val_dtype is not None else None),
-                "val_tail": list(val_tail) if val_tail is not None else None,
-            }, f)
+        """SEAL: flush + fsync + atomic rename tmp -> final, then the
+        ``.index`` sidecar (schema + row count) written atomically too.
+        Idempotent — recovered/cached file sets re-finish as a no-op.
+        After the seal the bytes are crash-durable: a SIGKILL one
+        instruction later leaves a fully valid file set."""
+        if self.sealed:
+            return
+        for f in (self._kf, self._vf):
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+        self._kf = self._vf = None
+        os.replace(self.keys_path + ".tmp", self.keys_path)
+        os.replace(self.vals_path + ".tmp", self.vals_path)
+        atomic_write_text(self.index_path, json.dumps({
+            "rows": self.rows,
+            "val_dtype": (np.dtype(val_dtype).str
+                          if val_dtype is not None else None),
+            "val_tail": list(val_tail) if val_tail is not None else None,
+        }))
+        fsync_dir(os.path.dirname(self.keys_path))
+        self.sealed = True
 
     def load(self) -> Tuple[np.ndarray, Optional[np.ndarray]]:
-        """mmap the files back as arrays (read-only views, page-cache
-        backed — RSS stays bounded)."""
+        """mmap the sealed files back as arrays (read-only views,
+        page-cache backed — RSS stays bounded). File lengths are
+        validated against the sidecar FIRST: a shorter-than-declared
+        file raises typed, naming the file, instead of returning a
+        short or garbage view."""
         with open(self.index_path) as f:
             idx = json.load(f)
         n = idx["rows"]
         keys = np.zeros(0, dtype=np.int64)
         values = None
         if n:
+            need = n * 8
+            got = os.path.getsize(self.keys_path)
+            if got != need:
+                raise TruncatedBlockError(
+                    f"{self.keys_path}: {got} B on disk but the sealed "
+                    f"sidecar declares {n} rows = {need} B — torn write "
+                    f"or external truncation")
             km = MappedFile(self.keys_path)
             self._maps.append(km)
-            keys = km.data[: n * 8].view(np.int64)
+            keys = km.data[:need].view(np.int64)
         if idx["val_dtype"] is not None:
             vdt = np.dtype(idx["val_dtype"])
             tail = tuple(idx["val_tail"])
             if n:
-                vm = MappedFile(self.vals_path)
-                self._maps.append(vm)
                 nbytes = n * int(np.prod(tail, dtype=np.int64) or 1) \
                     * vdt.itemsize
+                got = os.path.getsize(self.vals_path)
+                if got != nbytes:
+                    raise TruncatedBlockError(
+                        f"{self.vals_path}: {got} B on disk but the "
+                        f"sealed sidecar declares {n} x {vdt.str}{tail} "
+                        f"= {nbytes} B — torn write or external "
+                        f"truncation")
+                vm = MappedFile(self.vals_path)
+                self._maps.append(vm)
                 values = vm.data[:nbytes].view(vdt).reshape((n,) + tail)
             else:
                 values = np.zeros((0,) + tail, dtype=vdt)
         return keys, values
 
+    def drop_views(self) -> None:
+        """Close the mmaps only (keep files) — the integrity verifier's
+        reload seam after a quarantine move."""
+        for m in self._maps:
+            m.close()
+        self._maps.clear()
+
     def close(self, delete: bool = True) -> None:
         for f in (self._kf, self._vf):
+            if f is None:
+                continue
             try:
                 f.close()
             except OSError:  # pragma: no cover
                 pass
+        self._kf = self._vf = None
         for m in self._maps:
             m.close()
         self._maps.clear()
         if delete:
-            for p in (self.keys_path, self.vals_path, self.index_path):
+            for p in (self.keys_path, self.vals_path, self.index_path,
+                      self.keys_path + ".tmp", self.vals_path + ".tmp"):
                 try:
                     os.unlink(p)
                 except OSError:
@@ -144,7 +222,8 @@ class MapOutputWriter:
     def __init__(self, entry: ShuffleEntry, map_id: int,
                  pool: HostMemoryPool, partitioner: str = "hash",
                  faults=None, spill_dir: Optional[str] = None,
-                 spill_threshold: int = 0, bounds=None):
+                 spill_threshold: int = 0, bounds=None,
+                 integrity_level: str = "off", ledger=None):
         self.entry = entry
         self.map_id = map_id
         self.pool = pool
@@ -164,6 +243,21 @@ class MapOutputWriter:
         self._val_tail: Optional[Tuple[int, ...]] = None
         self._val_dtype = None
         self._spill_views = None  # cached (keys, values) mmap views
+        # -- integrity + durability plane --------------------------------
+        # integrity_level != "off": commit() computes an IntegrityRecord
+        # (shuffle/integrity.py) over the staged bytes and publishes it
+        # beside the size row; "full" additionally includes per-
+        # partition digest rows for the post-collective verify.
+        self._integrity_level = integrity_level
+        # the published record (tests / the manager's verify read it)
+        self.integrity = None
+        # durable ledger (shuffle/durable.py): commit() force-seals the
+        # staged output into the ledger's shuffle dir (spill_dir points
+        # there when the ledger is on) and records the manifest row.
+        # Durable spill files SURVIVE release()/stop() — deleting them
+        # is the ledger's job (explicit unregister), that is the point.
+        self._ledger = ledger
+        self._durable = ledger is not None
 
     def write(self, keys: np.ndarray,
               values: Optional[np.ndarray] = None) -> None:
@@ -294,33 +388,86 @@ class MapOutputWriter:
             self.faults.check("publish")
         with Timer() as t, GLOBAL_TRACER.span(
                 "shuffle.publish", map_id=self.map_id, rows=self.num_rows):
+            keys = values = parts = None
             if self.num_rows:
-                keys, _ = self.materialize()
-                if self.partitioner == "direct":
-                    if (keys < 0).any() or (keys >= num_partitions).any():
-                        bad = keys[(keys < 0) | (keys >= num_partitions)][:4]
-                        raise ValueError(
-                            f"direct partitioner: keys must be partition "
-                            f"ids in [0, {num_partitions}); got e.g. "
-                            f"{bad.tolist()}")
-                    parts = keys.astype(np.int64)
-                elif self.partitioner == "range":
-                    # host twin of ops/partition.range_partition_words —
-                    # searchsorted side='right' over the split points
-                    parts = np.searchsorted(
-                        np.asarray(self.bounds, dtype=np.int64), keys,
-                        side="right").astype(np.int64)
-                else:
-                    parts = (_hash32_np(keys)
-                             % np.uint32(num_partitions)).astype(np.int64)
+                if self._ledger is not None and self._spill is None:
+                    # durable commit: the staged bytes must be SEALED on
+                    # disk before the size row is published — a commit
+                    # the registry reports must survive a restart
+                    # (materialize() below runs finish(), the fsync +
+                    # atomic-rename seal)
+                    self._flush_to_disk()
+                keys, values = self.materialize()
+                parts = self.partition_of(keys, num_partitions)
                 sizes = np.bincount(parts, minlength=num_partitions)
             else:
                 sizes = np.zeros(num_partitions, dtype=np.int64)
-            self.entry.publish(self.map_id, sizes)
+            rec = None
+            if self._integrity_level != "off" or self._ledger is not None:
+                from sparkucx_tpu.shuffle.integrity import compute_record
+                rec = compute_record(
+                    keys, values, parts, num_partitions,
+                    with_digests=self._integrity_level == "full",
+                    # the crc32 disk checksums exist for the ledger's
+                    # manifest + restart scan; without a ledger only the
+                    # fold64 pair is consumed — skip the slower pass
+                    with_crc=self._ledger is not None)
+            self.entry.publish(self.map_id, sizes, integrity=rec)
+            self.integrity = rec
+            if self._ledger is not None:
+                self._ledger.record_commit(self.entry, self.map_id,
+                                           sizes, rec)
         self._committed = True
         log.debug("map %d publish overhead: %.2f ms (%d rows)",
                   self.map_id, t.ms, self.num_rows)
         return sizes
+
+    def partition_of(self, keys: np.ndarray,
+                     num_partitions: int) -> np.ndarray:
+        """Host-side partition ids for ``keys`` — the ONE partitioner
+        twin (bit-for-bit with the device routing) shared by the size
+        row, the integrity digests and tests."""
+        if self.partitioner == "direct":
+            if (keys < 0).any() or (keys >= num_partitions).any():
+                bad = keys[(keys < 0) | (keys >= num_partitions)][:4]
+                raise ValueError(
+                    f"direct partitioner: keys must be partition "
+                    f"ids in [0, {num_partitions}); got e.g. "
+                    f"{bad.tolist()}")
+            return keys.astype(np.int64)
+        if self.partitioner == "range":
+            # host twin of ops/partition.range_partition_words —
+            # searchsorted side='right' over the split points
+            return np.searchsorted(
+                np.asarray(self.bounds, dtype=np.int64), keys,
+                side="right").astype(np.int64)
+        return (_hash32_np(keys)
+                % np.uint32(num_partitions)).astype(np.int64)
+
+    @classmethod
+    def recovered(cls, entry: ShuffleEntry, map_id: int,
+                  pool: HostMemoryPool, directory: str, rec,
+                  partitioner: str = "hash", bounds=None,
+                  integrity_level: str = "staged") -> "MapOutputWriter":
+        """Adopt one checksum-validated map output from the durable
+        ledger (shuffle/durable.py restart scan): a COMMITTED writer
+        whose staged state is the sealed spill file set on disk — reads
+        consume its mmap views exactly like a live spill writer, with
+        zero recompute. ``rec`` is the manifest's IntegrityRecord (the
+        schema + checksums the read-path verify re-checks)."""
+        w = cls(entry, map_id, pool, partitioner=partitioner,
+                spill_dir=directory, spill_threshold=0, bounds=bounds,
+                integrity_level=integrity_level, ledger=None)
+        w._durable = True                # release() must keep the files
+        if rec.rows:
+            w._spill = SpillFiles.open_sealed(directory,
+                                              entry.shuffle_id, map_id)
+        if rec.val_dtype is not None:
+            w._val_tail = tuple(rec.val_tail or ())
+            w._val_dtype = np.dtype(rec.val_dtype)
+        w.integrity = rec
+        w._committed = True
+        return w
 
     def materialize(self) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """Concatenated (keys, values) staged by this writer. When spill is
@@ -350,7 +497,14 @@ class MapOutputWriter:
         ref: CommonUcxShuffleBlockResolver.scala:109-121).
 
         The writer is DEAD afterwards: write()/commit() raise. Idempotent
-        (the graveyard/stop paths may release a batch more than once)."""
+        (the graveyard/stop paths may release a batch more than once).
+
+        DURABLE writers (failure.ledgerDir) keep their sealed files on
+        disk: release() closes the mappings only — surviving process
+        death is the ledger's whole point (Spark's external shuffle
+        service keeps a dead executor's files the same way). Deleting
+        durable state is the explicit-unregister path's job
+        (shuffle/durable.ShuffleLedger.forget)."""
         self._released = True
         for b in self._staged:
             self.pool.put(b)
@@ -359,5 +513,5 @@ class MapOutputWriter:
         self._values.clear()
         if self._spill is not None:
             self._spill_views = None   # views die with the mappings
-            self._spill.close(delete=True)
+            self._spill.close(delete=not self._durable)
             self._spill = None

@@ -56,6 +56,12 @@ wire_dequant...   int8-wire exchanges whose sampled        spark.shuffle.tpu.a2a
                   sits over threshold with a min-payload
                   floor — the lossy tier is rounding away
                   signal (outlier-dominated rows)
+block_corrupt...  checksum verification caught corrupt     spark.shuffle.tpu.integrity.verify
+                  blocks (pack-time staged verify, full-
+                  level digest mismatch, or ledger-scan
+                  quarantine) — warn at one block,
+                  critical past the corrupt-counter floor
+                  or on any quarantine
 ================  =======================================  =====================================
 
 The same :class:`Finding` schema carries ``bench.py --stage regress``
@@ -68,7 +74,11 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Union
 
-from sparkucx_tpu.utils.metrics import (C_PEER_TIMEOUT, C_PROBE_DEAD,
+from sparkucx_tpu.utils.metrics import (C_INTEGRITY_CORRUPT,
+                                        C_INTEGRITY_CORRUPT_BLOCKS,
+                                        C_INTEGRITY_QUARANTINED,
+                                        C_INTEGRITY_VERIFIED,
+                                        C_PEER_TIMEOUT, C_PROBE_DEAD,
                                         C_REPLAYS, COMPILE_HITS,
                                         COMPILE_PROGRAMS, COMPILE_SECONDS,
                                         G_HBM_IN_USE, G_HBM_LIMIT, H_BW,
@@ -165,6 +175,14 @@ class Thresholds:
     dequant_warn_rel: float = 0.05
     dequant_critical_rel: float = 0.25
     dequant_min_payload_bytes: float = 1e6
+    # block_corruption: checksum verification (integrity.verify) caught
+    # blocks whose bytes no longer match their commit records, or the
+    # restart ledger quarantined blocks. ONE detected corruption is
+    # already a warning — the verifier filtered the noise by
+    # construction (the peer_timeout posture); the corrupt-counter
+    # floor below is the CRITICAL line: repeated corruptions (or any
+    # quarantine) mean rotting storage/memory, not a one-off flip.
+    corruption_critical_blocks: int = 3
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -868,11 +886,68 @@ def _rule_replay_storm(view: ClusterView,
                           if r.get("trace_id")}))]
 
 
+def _rule_block_corruption(view: ClusterView,
+                           th: Thresholds) -> List[Finding]:
+    """Checksum verification detected corruption: staged/spill bytes no
+    longer matched their commit records at pack time, a post-collective
+    digest mismatched at the full level, or the restart ledger
+    quarantined blocks whose files failed their manifest checksums.
+    Evidence pairs the cumulative counters with the retained reports
+    whose errors carry the typed BlockCorruptionError (the corrupt
+    block is named in the flight ring's ``block_corruption`` events).
+    Detection itself is never noise — the verifier compared real
+    checksums — so one block is a warning; the corrupt-counter floor
+    (``corruption_critical_blocks``) and ANY quarantine grade
+    critical: repeated corruption is rotting storage or memory, and
+    silently replaying over it forever hides a hardware problem."""
+    blocks = int(view.counters.get(C_INTEGRITY_CORRUPT_BLOCKS, 0.0))
+    quarantined = int(view.counters.get(C_INTEGRITY_QUARANTINED, 0.0))
+    corrupt_reports = [
+        r for r in view.reports
+        if "BlockCorruption" in str(r.get("error") or "")
+        or "TruncatedBlock" in str(r.get("error") or "")]
+    total = max(blocks, len(corrupt_reports)) + quarantined
+    if total < 1:
+        return []          # verified.bytes alone is health, not a finding
+    corrupt_bytes = int(view.counters.get(C_INTEGRITY_CORRUPT, 0.0))
+    verified = int(view.counters.get(C_INTEGRITY_VERIFIED, 0.0))
+    what = []
+    if blocks:
+        what.append(f"{blocks} block(s) failed checksum verification "
+                    f"({corrupt_bytes} corrupt bytes)")
+    if quarantined:
+        what.append(f"{quarantined} block(s) quarantined by the restart "
+                    f"ledger scan")
+    return [Finding(
+        rule="block_corruption",
+        grade="critical"
+        if blocks >= th.corruption_critical_blocks or quarantined
+        else "warn",
+        summary=(" and ".join(what) + " — corruption was DETECTED, not "
+                 "served; find out where the bytes rotted"),
+        evidence={"corrupt_blocks": blocks,
+                  "corrupt_bytes": corrupt_bytes,
+                  "quarantined_blocks": quarantined,
+                  "verified_bytes": verified,
+                  "shuffle_ids": sorted({r.get("shuffle_id")
+                                         for r in corrupt_reports})},
+        conf_key="spark.shuffle.tpu.integrity.verify",
+        remediation=("integrity.verify=full pins down WHERE (staged vs "
+                     "post-collective); failure.ledgerDir + "
+                     "failure.policy=replay make single corruptions "
+                     "survivable (one replay budget unit each) while "
+                     "quarantining rotten blocks; recurring corruption "
+                     "on one host is failing RAM/disk — drain it"),
+        trace_ids=sorted({r.get("trace_id", "") for r in corrupt_reports
+                          if r.get("trace_id")}))]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
           _rule_bw_underutilization, _rule_padding_waste,
-          _rule_wire_dequant, _rule_peer_timeout, _rule_replay_storm)
+          _rule_wire_dequant, _rule_peer_timeout, _rule_replay_storm,
+          _rule_block_corruption)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

@@ -321,16 +321,19 @@ def render_prometheus(doc: Dict) -> str:
     return "\n".join(lines) + "\n"
 
 
-def write_snapshot(doc: Dict, path: str) -> str:
-    """Atomic JSON snapshot write (tmp + rename): a scraper of the dump
-    directory must never read a torn file. The tmp name carries the
-    thread id too — PeriodicDumper.stop()'s final dump can overlap a
-    still-running background dump of the same path."""
-    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "w") as f:
-        f.write(render_json(doc))
-    os.replace(tmp, path)
-    return path
+def write_snapshot(doc: Dict, path: str, fsync: bool = True) -> str:
+    """Atomic JSON snapshot write via the shared utils/atomicio helper
+    (tmp + [fsync] + rename): a scraper of the dump directory must
+    never read a torn file, and a flight postmortem written by a DYING
+    process must survive the death that triggered it (fsync=True, the
+    default). The rolling periodic dump passes ``fsync=False`` — it is
+    rewritten every interval and only needs reader-atomicity, so it
+    must not pay recurring fsync stalls (the atomicio discipline). The
+    helper's tmp name carries pid + thread id — PeriodicDumper.stop()'s
+    final dump can overlap a still-running background dump of the same
+    path."""
+    from sparkucx_tpu.utils.atomicio import atomic_write_text
+    return atomic_write_text(path, render_json(doc), fsync=fsync)
 
 
 class PeriodicDumper:
@@ -362,7 +365,9 @@ class PeriodicDumper:
     def dump_once(self) -> Optional[str]:
         try:
             os.makedirs(self._dir, exist_ok=True)
-            return write_snapshot(self._collect(), self.path)
+            # rolling dump: reader-atomicity only, no fsync stalls
+            return write_snapshot(self._collect(), self.path,
+                                  fsync=False)
         except Exception:
             if not self._warned:
                 self._warned = True

@@ -83,6 +83,16 @@ C_PROBE_DEAD = "failure.probe.dead"            # devices a probe found dead
 C_REPLAYS = "shuffle.replay.count"             # exchange replays executed
 C_REPLAY_MS = "shuffle.replay.ms"              # wall burned by failed tries
 
+# Integrity-plane counters (shuffle/integrity.py, shuffle/manager.py
+# verify paths, shuffle/durable.py restart scan): ONE place for the
+# names so the verifiers, the doctor's block_corruption rule and the
+# tests cannot drift.
+C_INTEGRITY_VERIFIED = "shuffle.integrity.verified.bytes"
+C_INTEGRITY_CORRUPT = "shuffle.integrity.corrupt.bytes"
+C_INTEGRITY_CORRUPT_BLOCKS = "shuffle.integrity.corrupt.count"
+C_INTEGRITY_QUARANTINED = "shuffle.integrity.quarantined.count"
+C_INTEGRITY_RECOVERED = "shuffle.integrity.recovered.count"
+
 # Device-memory gauge families (runtime/devmon.py sampler; per local
 # device index, encoded as a label via :func:`labeled`): ONE place for
 # the names so the sampler, the doctor's hbm_pressure rule and the

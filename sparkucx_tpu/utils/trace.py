@@ -272,8 +272,8 @@ class Tracer:
         in Perfetto / chrome://tracing. Returns the number of events."""
         events = self.chrome_events()
         doc = {"traceEvents": events, "displayTimeUnit": "ms"}
-        with open(path, "w") as f:
-            json.dump(doc, f)
+        from sparkucx_tpu.utils.atomicio import atomic_write_json
+        atomic_write_json(path, doc, indent=None)
         dropped = self.dropped
         if dropped:
             log.warning("trace export dropped %d spans (capacity %d)",

@@ -202,8 +202,9 @@ def test_chaos_measure_small(mesh8):
                               val_words=2, timeout_ms=2000.0)
     assert rec["ok"] is True
     # dense x {single: 3 sites, waved: 4 sites} x {failfast, replay}
-    # plus the wire-compressed int8 x waved x replay cell
-    assert rec["cells_total"] == 15
+    # plus the wire-compressed int8 x waved x replay cell, plus the
+    # corrupt-site block (staged/spill x single/waved x both policies)
+    assert rec["cells_total"] == 23
     assert rec["cells_ok"] == rec["cells_total"]
     wire_cells = [c for c in rec["cells"] if c.get("wire") == "int8"]
     assert len(wire_cells) == 1
@@ -221,6 +222,34 @@ def test_chaos_measure_small(mesh8):
                 and c["site"] in ("exchange", "wave")]
     assert failfast and all(c["outcome"] == "typed_error"
                             for c in failfast)
+    # corrupt-site cells: detection is NEVER silent — every armed cell
+    # detected (typed BlockCorruptionError under failfast, one absorbed
+    # replay to oracle bytes under replay)
+    corrupt = [c for c in rec["cells"] if c["site"].startswith("corrupt.")]
+    assert len(corrupt) == 8
+    assert all(c["detected"] for c in corrupt)
+    assert all(c["outcome"] == "typed_error" for c in corrupt
+               if c["policy"] == "failfast")
+    assert all(c["replays"] == 1 for c in corrupt
+               if c["policy"] == "replay")
     wd = rec["watchdog"]
     assert wd["outcome"] == "peer_lost" and wd["on_time"]
     assert wd["leaked_threads"] == 1 and wd["armed_after"] == 0
+
+
+def test_integrity_measure_small(mesh8):
+    """The integrity stage's measurement core at a tiny shape: staged
+    verify overhead bounded (direct-measured), zero compiled-program
+    delta per verify level, corrupt-site detection + one-unit replay,
+    and restart recovery from a ledger dir with the quarantine leg."""
+    rec = bench.integrity_measure(rows_per_map=256, maps=2, partitions=8,
+                                  val_words=2, reps=3)
+    assert rec["ok"] is True
+    assert rec["programs_delta"]["staged"] == 0
+    assert rec["programs_delta"]["full"] == 0
+    assert rec["overhead"]["staged_overhead_pct"] < 3.0
+    assert rec["detection"]["failfast"] == "typed_error"
+    assert rec["detection"]["replay_replays"] == 1
+    assert rec["recovery"]["zero_recompute"] is True
+    assert rec["recovery"]["quarantine_only_map1"] is True
+    assert rec["recovery"]["quarantine_bytes_ok"] is True

@@ -586,6 +586,60 @@ def test_replay_storm_counter_backstop():
     assert fs[0].evidence["replays"] == 5
 
 
+def test_block_corruption_fires_warn_and_names_traces():
+    """One detected corruption is a warning (the verifier filtered the
+    noise by construction), with the corrupt counters and the typed
+    reports' trace ids as evidence, remediating toward
+    integrity.verify / failure.ledgerDir."""
+    doc = _healthy_doc()
+    doc["counters"]["shuffle.integrity.verified.bytes"] = 1e8
+    doc["counters"]["shuffle.integrity.corrupt.count"] = 1.0
+    doc["counters"]["shuffle.integrity.corrupt.bytes"] = 4096.0
+    rep = _report(sid=33, trace="s33.e0.x33", completed=False)
+    rep["error"] = ("BlockCorruptionError('shuffle 33: block corruption "
+                    "detected in map 1')")
+    doc["exchange_reports"].append(rep)
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["block_corruption"]
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.evidence["corrupt_blocks"] == 1
+    assert f.evidence["corrupt_bytes"] == 4096
+    assert 33 in f.evidence["shuffle_ids"]
+    assert "s33.e0.x33" in f.trace_ids
+    assert f.conf_key == "spark.shuffle.tpu.integrity.verify"
+    assert "failure.ledgerDir" in f.remediation
+
+
+def test_block_corruption_critical_goldens():
+    # repeated corruption past the corrupt-counter floor -> critical
+    doc = _healthy_doc()
+    doc["counters"]["shuffle.integrity.corrupt.count"] = 3.0
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["block_corruption"]
+    assert fs[0].grade == "critical"
+    # ANY ledger quarantine -> critical, even a single block
+    doc = _healthy_doc()
+    doc["counters"]["shuffle.integrity.quarantined.count"] = 1.0
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["block_corruption"]
+    assert fs[0].grade == "critical"
+    assert fs[0].evidence["quarantined_blocks"] == 1
+
+
+def test_block_corruption_quiet_goldens():
+    # healthy cluster with NO integrity counters: quiet (covered by the
+    # shared healthy fixture, asserted explicitly here)
+    assert diagnose(_healthy_doc()) == []
+    # sub-noise: terabytes VERIFIED with zero corrupt blocks is health,
+    # not a finding — verified.bytes alone never fires
+    doc = _healthy_doc()
+    doc["counters"]["shuffle.integrity.verified.bytes"] = 1e12
+    doc["counters"]["shuffle.integrity.corrupt.count"] = 0.0
+    doc["counters"]["shuffle.integrity.corrupt.bytes"] = 0.0
+    assert diagnose(doc) == []
+
+
 def test_replay_storm_quiet_on_single_absorbed_blip():
     # one replay is the policy doing its job (sub-noise) — quiet
     doc = _healthy_doc()

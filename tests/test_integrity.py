@@ -1,0 +1,486 @@
+"""Integrity plane + durable shuffle state (shuffle/integrity.py,
+shuffle/durable.py): checksummed blocks verified at pack time and after
+the collective, torn-write-proof spill seals, corrupt-site fault
+injection driving detection→replay, and restart recovery from the
+disk-backed ledger (failure.ledgerDir) with quarantine of
+checksum-failing blocks."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.runtime.failures import (BlockCorruptionError,
+                                           TruncatedBlockError)
+from sparkucx_tpu.shuffle import integrity as integ
+from sparkucx_tpu.utils.metrics import (C_INTEGRITY_CORRUPT_BLOCKS,
+                                        C_INTEGRITY_QUARANTINED,
+                                        C_INTEGRITY_RECOVERED,
+                                        C_INTEGRITY_VERIFIED)
+
+MAPS, R, ROWS, W = 2, 8, 512, 2
+
+
+@pytest.fixture()
+def data(rng):
+    keys = [rng.integers(-(1 << 62), 1 << 62, size=ROWS)
+            for _ in range(MAPS)]
+    vals = [rng.integers(-(1 << 30), 1 << 30,
+                         size=(ROWS, W)).astype(np.int32)
+            for _ in range(MAPS)]
+    return keys, vals
+
+
+def _stage(mgr, sid, keys, vals):
+    h = mgr.register_shuffle(sid, MAPS, R)
+    for m in range(MAPS):
+        w = mgr.get_writer(h, m)
+        w.write(keys[m], vals[m])
+        w.commit(R)
+    return h
+
+
+def _canonical(res):
+    out = []
+    for r in range(R):
+        k, v = res.partition(r)
+        order = np.lexsort(tuple(v.T[::-1]) + (k,)) if k.size \
+            else np.array([], dtype=np.int64)
+        out.append((k[order].tolist(), v[order].tolist()))
+    return out
+
+
+# -- primitives ------------------------------------------------------------
+def test_fold64_detects_any_bit_flip(rng):
+    a = rng.integers(-(1 << 62), 1 << 62, size=257)   # odd tail too
+    base = integ.fold64(a)
+    assert base == integ.fold64(a.copy())
+    b = a.copy().view(np.uint8)
+    for off in (0, 1000, b.nbytes - 1):
+        b[off] ^= 0x01
+        assert integ.fold64(b.view(np.int64)) != base
+        b[off] ^= 0x01
+    # length-bound: a truncated buffer folds differently even all-zero
+    assert integ.fold64(np.zeros(8, np.int64)) != \
+        integ.fold64(np.zeros(9, np.int64))
+
+
+def test_partition_digests_order_and_split_invariant(rng):
+    keys = rng.integers(0, 1 << 40, size=400)
+    vals = rng.standard_normal((400, 3)).astype(np.float32)
+    parts = rng.integers(0, R, size=400)
+    full, keyd = integ.partition_digests(keys, vals, parts, R)
+    # permutation invariance (the destination sort must not change it)
+    perm = rng.permutation(400)
+    full2, keyd2 = integ.partition_digests(keys[perm], vals[perm],
+                                           parts[perm], R)
+    assert full.tolist() == full2.tolist()
+    assert keyd.tolist() == keyd2.tolist()
+    # split invariance (the wave split sums to the same digests)
+    fa, _ = integ.partition_digests(keys[:150], vals[:150], parts[:150], R)
+    fb, _ = integ.partition_digests(keys[150:], vals[150:], parts[150:], R)
+    assert ((fa + fb) == full).all()
+    # receiver-side per-partition sum matches the published rows
+    r0 = parts == 3
+    assert integ.digest_sum(keys[r0], vals[r0]) == int(full[3])
+    # a value flip moves the full digest but not the key digest
+    vals2 = vals.copy()
+    vals2[7, 1] += 1.0
+    full3, keyd3 = integ.partition_digests(keys, vals2, parts, R)
+    assert full3.tolist() != full.tolist()
+    assert keyd3.tolist() == keyd.tolist()
+
+
+def test_integrity_record_roundtrip(rng):
+    keys = rng.integers(0, 1 << 40, size=64)
+    vals = rng.standard_normal((64, 2)).astype(np.float32)
+    parts = rng.integers(0, R, size=64)
+    rec = integ.compute_record(keys, vals, parts, R, with_digests=True)
+    back = integ.IntegrityRecord.from_dict(rec.to_dict())
+    assert back == rec
+    assert rec.val_dtype == "<f4" and rec.val_tail == (2,)
+    empty = integ.compute_record(None, None, None, R, with_digests=True)
+    assert empty.rows == 0 and empty.digests == [0] * R
+
+
+# -- commit publication + staged verify ------------------------------------
+def test_commit_publishes_record_and_read_verifies(manager_factory, data,
+                                                   rng):
+    keys, vals = data
+    m = manager_factory()
+    h = _stage(m, 1, keys, vals)
+    rec = h.entry.fetch_integrity(0)
+    assert rec is not None and rec.rows == ROWS
+    assert rec.keys_fold == integ.fold64(keys[0])
+    assert rec.keys_crc == 0            # disk crc is ledger-only work
+    assert rec.digests is None          # staged level: no digest rows
+    res = m.read(h)
+    rep = m.report(1)
+    assert rep.integrity == "staged"
+    assert rep.integrity_bytes == sum(k.nbytes for k in keys) \
+        + sum(v.nbytes for v in vals)
+    assert m.node.metrics.get(C_INTEGRITY_VERIFIED) >= rep.integrity_bytes
+    assert sum(res.partition(r)[0].shape[0] for r in range(R)) \
+        == MAPS * ROWS
+
+
+def test_verify_off_is_inert(manager_factory, data):
+    keys, vals = data
+    m = manager_factory({"spark.shuffle.tpu.integrity.verify": "off"})
+    h = _stage(m, 2, keys, vals)
+    assert h.entry.fetch_integrity(0) is None
+    m.node.faults.arm("corrupt.staged", fail_count=1)
+    m.read(h)                              # armed site never consulted
+    rep = m.report(2)
+    assert rep.integrity == "" and rep.integrity_bytes == 0
+    assert m.node.metrics.get(C_INTEGRITY_VERIFIED) == 0
+
+
+def test_corrupt_staged_failfast_typed_then_clean_reread(
+        manager_factory, data):
+    keys, vals = data
+    m = manager_factory()
+    h0 = _stage(m, 3, keys, vals)
+    want = _canonical(m.read(h0))
+    m.unregister_shuffle(3)
+    m.node.faults.arm("corrupt.staged", fail_count=1, offset=123)
+    h = _stage(m, 4, keys, vals)
+    with pytest.raises(BlockCorruptionError, match="map 0"):
+        m.read(h)
+    assert m.node.metrics.get(C_INTEGRITY_CORRUPT_BLOCKS) == 1
+    # the flip models TRANSIENT corruption: restored after detection,
+    # so a clean re-read returns oracle bytes
+    assert _canonical(m.read(h)) == want
+
+
+def test_corrupt_staged_replay_spends_one_unit(manager_factory, data):
+    keys, vals = data
+    m = manager_factory({"spark.shuffle.tpu.failure.policy": "replay"})
+    h0 = _stage(m, 5, keys, vals)
+    want = _canonical(m.read(h0))
+    m.unregister_shuffle(5)
+    m.node.faults.arm("corrupt.staged", fail_count=1, offset=123)
+    h = _stage(m, 6, keys, vals)
+    assert _canonical(m.read(h)) == want
+    rep = m.report(6)
+    assert rep.replays == 1
+    assert m.node.metrics.get(C_INTEGRITY_CORRUPT_BLOCKS) == 1
+
+
+def test_corrupt_spill_detected_through_mmap_views(manager_factory, data,
+                                                   tmp_path):
+    keys, vals = data
+    m = manager_factory({
+        "spark.shuffle.tpu.failure.policy": "replay",
+        "spark.shuffle.tpu.spill.threshold": "1k",
+        "spark.shuffle.tpu.spill.dir": str(tmp_path)})
+    m.node.faults.arm("corrupt.spill", fail_count=1, offset=777)
+    h = _stage(m, 7, keys, vals)
+    res = m.read(h)
+    rep = m.report(7)
+    assert rep.replays == 1                 # detected via the file flip
+    assert m.node.faults.stats()["corrupt.spill"][1] == 1
+    assert sum(res.partition(r)[0].shape[0] for r in range(R)) \
+        == MAPS * ROWS
+
+
+# -- full level ------------------------------------------------------------
+def test_full_verify_clean_and_tamper(manager_factory, data):
+    keys, vals = data
+    m = manager_factory({"spark.shuffle.tpu.integrity.verify": "full"})
+    h = _stage(m, 8, keys, vals)
+    rec = h.entry.fetch_integrity(0)
+    assert rec.digests is not None and len(rec.digests) == R
+    m.read(h)
+    rep = m.report(8)
+    assert rep.integrity == "full"
+    # tamper with one published digest: the post-collective check must
+    # catch the mismatch and name the partition
+    h2 = _stage(m, 9, keys, vals)
+    r2 = h2.entry.fetch_integrity(1)
+    r2.digests[5] = (r2.digests[5] + 1) & 0xFFFFFFFFFFFFFFFF
+    with pytest.raises(BlockCorruptionError, match="partition 5"):
+        m.read(h2)
+
+
+def test_full_verify_waved_and_int8(manager_factory, rng):
+    fkeys = [rng.integers(-(1 << 62), 1 << 62, size=ROWS)
+             for _ in range(MAPS)]
+    fvals = [(rng.standard_normal((ROWS, W)) * 8).astype(np.float32)
+             for _ in range(MAPS)]
+    # waved: digests accumulate across waves and verify at finalize
+    m = manager_factory({"spark.shuffle.tpu.integrity.verify": "full",
+                         "spark.shuffle.tpu.a2a.waveRows": "64"})
+    h = _stage(m, 10, fkeys, fvals)
+    m.read(h)
+    rep = m.report(10)
+    assert rep.waves >= 2 and rep.integrity == "full"
+    # int8 wire: values dequantize lossy — the exact KEY lanes verify
+    m = manager_factory({"spark.shuffle.tpu.integrity.verify": "full",
+                         "spark.shuffle.tpu.a2a.wire": "int8"})
+    h = _stage(m, 11, fkeys, fvals)
+    m.read(h)
+    rep = m.report(11)
+    assert rep.wire == "int8" and rep.integrity == "full"
+
+
+def test_no_records_keeps_report_unclaimed(manager_factory, data):
+    """A shuffle whose commits published no integrity records (direct
+    registry publishers, pre-integrity state) must not claim
+    verification ran: the report keeps integrity="" per its contract."""
+    keys, vals = data
+    m = manager_factory()
+    h = _stage(m, 17, keys, vals)
+    with h.entry._cv:
+        h.entry._integrity.clear()
+    m.read(h)
+    rep = m.report(17)
+    assert rep.integrity == "" and rep.integrity_bytes == 0
+
+
+def test_full_verify_covers_async_submit(manager_factory, data):
+    """The post-collective check rides result() itself (the pending's
+    _post_result hook), so async submit()/result() consumers verify
+    exactly like read() — a tampered digest fails the async path typed,
+    and a clean async read reports full."""
+    keys, vals = data
+    m = manager_factory({"spark.shuffle.tpu.integrity.verify": "full"})
+    h = _stage(m, 15, keys, vals)
+    res = m.submit(h).result()
+    assert m.report(15).integrity == "full"
+    assert sum(res.partition(r)[0].shape[0] for r in range(R)) \
+        == MAPS * ROWS
+    h2 = _stage(m, 16, keys, vals)
+    r2 = h2.entry.fetch_integrity(0)
+    r2.digests[2] = (r2.digests[2] ^ 0x1)
+    pending = m.submit(h2)
+    with pytest.raises(BlockCorruptionError, match="partition 2"):
+        pending.result()
+
+
+def test_full_verify_programs_invariant(manager_factory, data):
+    """Verification is host-side only: no verify level mints a compiled
+    program beyond what verify=off compiles for the same shape."""
+    from sparkucx_tpu.utils.metrics import COMPILE_PROGRAMS, GLOBAL_METRICS
+    keys, vals = data
+    m = manager_factory({"spark.shuffle.tpu.integrity.verify": "off"})
+    m.read(_stage(m, 12, keys, vals))
+    p0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+    for level, sid in (("staged", 13), ("full", 14)):
+        m = manager_factory(
+            {"spark.shuffle.tpu.integrity.verify": level})
+        m.read(_stage(m, sid, keys, vals))
+        assert GLOBAL_METRICS.get(COMPILE_PROGRAMS) == p0, level
+
+
+# -- restart recovery (failure.ledgerDir) ----------------------------------
+def test_restart_recovery_zero_recompute(manager_factory, data, tmp_path):
+    keys, vals = data
+    ledger = str(tmp_path / "ledger")
+    conf = {"spark.shuffle.tpu.failure.ledgerDir": ledger}
+    m = manager_factory(conf)
+    h = _stage(m, 20, keys, vals)
+    want = _canonical(m.read(h))
+    # commits sealed durable state: final-name files + manifest
+    sdir = os.path.join(ledger, "shuffle_20")
+    assert os.path.exists(os.path.join(sdir, "commit.manifest"))
+    assert len(glob.glob(os.path.join(sdir, "*.keys"))) == MAPS
+    assert not glob.glob(os.path.join(sdir, "*.tmp"))
+    # "restart": a fresh node + manager on the same ledger dir (stop()
+    # keeps durable state — the in-process equivalent of the cluster
+    # drill's SIGKILL-after-commit, which cannot run on this backend)
+    m2 = manager_factory(conf)
+    assert m2.recovered_shuffles() == {
+        20: {"intact": [0, 1], "quarantined": []}}
+    h2 = m2.register_shuffle(20, MAPS, R)
+    # zero recompute: every map is already committed and immutable
+    assert all(h2.entry.present(mm) for mm in range(MAPS))
+    with pytest.raises(RuntimeError, match="already committed"):
+        m2.get_writer(h2, 0)
+    assert _canonical(m2.read(h2)) == want
+    assert m2.node.metrics.get(C_INTEGRITY_RECOVERED) == MAPS
+    rep = m2.report(20)
+    assert rep.integrity == "staged"      # recovered blocks re-verify
+    # explicit unregister deletes the durable state
+    m2.unregister_shuffle(20)
+    assert not os.path.exists(sdir)
+
+
+def test_restart_recovery_quarantines_corrupt_block(manager_factory,
+                                                    data, tmp_path):
+    keys, vals = data
+    ledger = str(tmp_path / "ledger")
+    conf = {"spark.shuffle.tpu.failure.ledgerDir": ledger}
+    m = manager_factory(conf)
+    h = _stage(m, 21, keys, vals)
+    want = _canonical(m.read(h))
+    # rot one sealed block on disk between "restarts"
+    vpath = os.path.join(ledger, "shuffle_21", "shuffle_21_map_1.vals")
+    with open(vpath, "r+b") as f:
+        f.seek(40)
+        b = f.read(1)
+        f.seek(40)
+        f.write(bytes([b[0] ^ 0xFF]))
+    m2 = manager_factory(conf)
+    assert m2.recovered_shuffles()[21]["quarantined"] == [1]
+    assert m2.node.metrics.get(C_INTEGRITY_QUARANTINED) == 1
+    h2 = m2.register_shuffle(21, MAPS, R)
+    assert h2.entry.present(0) and not h2.entry.present(1)
+    # the quarantined files were moved aside, not served
+    assert not os.path.exists(vpath)
+    assert glob.glob(os.path.join(ledger, "shuffle_21", "quarantine",
+                                  "shuffle_21_map_1.vals.*"))
+    assert os.path.exists(os.path.join(ledger, "quarantine_report.json"))
+    # ONLY the quarantined map re-stages; the read is oracle-exact
+    w = m2.get_writer(h2, 1)
+    w.write(keys[1], vals[1])
+    w.commit(R)
+    assert _canonical(m2.read(h2)) == want
+
+
+def test_quarantine_not_double_counted_across_restarts(manager_factory,
+                                                       data, tmp_path):
+    """A quarantined block's manifest row drops at scan time: a SECOND
+    restart before the app re-stages it must not re-quarantine the
+    moved-aside files — counters and the report would otherwise inflate
+    with restart count instead of distinct corrupt blocks."""
+    import json
+    keys, vals = data
+    ledger = str(tmp_path / "ledger")
+    conf = {"spark.shuffle.tpu.failure.ledgerDir": ledger}
+    m = manager_factory(conf)
+    _stage(m, 25, keys, vals)
+    vpath = os.path.join(ledger, "shuffle_25", "shuffle_25_map_0.vals")
+    with open(vpath, "r+b") as f:
+        f.seek(8)
+        b = f.read(1)
+        f.seek(8)
+        f.write(bytes([b[0] ^ 0xFF]))
+    m2 = manager_factory(conf)
+    assert m2.recovered_shuffles()[25]["quarantined"] == [0]
+    assert m2.node.metrics.get(C_INTEGRITY_QUARANTINED) == 1
+    # restart AGAIN without re-staging: nothing new to quarantine
+    m3 = manager_factory(conf)
+    assert m3.recovered_shuffles()[25]["quarantined"] == []
+    assert m3.recovered_shuffles()[25]["intact"] == [1]
+    assert m3.node.metrics.get(C_INTEGRITY_QUARANTINED) == 0
+    report = json.load(open(os.path.join(ledger,
+                                         "quarantine_report.json")))
+    assert len(report["blocks"]) == 1
+
+
+def test_restart_recovery_shape_mismatch_registers_fresh(
+        manager_factory, data, tmp_path):
+    keys, vals = data
+    ledger = str(tmp_path / "ledger")
+    conf = {"spark.shuffle.tpu.failure.ledgerDir": ledger}
+    m = manager_factory(conf)
+    _stage(m, 22, keys, vals)
+    m2 = manager_factory(conf)
+    assert 22 in m2.recovered_shuffles()
+    # different partition count = a different shuffle: recovery drops,
+    # fresh registration proceeds, the stale ledger dir is forgotten
+    h = m2.register_shuffle(22, MAPS, 2 * R)
+    assert h.num_partitions == 2 * R
+    assert not h.entry.present(0)
+    assert not os.path.exists(os.path.join(ledger, "shuffle_22",
+                                           "commit.manifest"))
+
+
+def test_manifest_crc_tamper_ignores_shuffle(manager_factory, data,
+                                             tmp_path):
+    keys, vals = data
+    ledger = str(tmp_path / "ledger")
+    conf = {"spark.shuffle.tpu.failure.ledgerDir": ledger}
+    m = manager_factory(conf)
+    _stage(m, 23, keys, vals)
+    mpath = os.path.join(ledger, "shuffle_23", "commit.manifest")
+    body = open(mpath).read().replace('"rows": %d' % ROWS,
+                                      '"rows": %d' % (ROWS - 1), 1)
+    open(mpath, "w").write(body)
+    m2 = manager_factory(conf)
+    # a corrupt manifest recovers NOTHING (never trusted) — the app
+    # registers fresh and recomputes
+    assert 23 not in m2.recovered_shuffles()
+    h = m2.register_shuffle(23, MAPS, R)
+    assert not h.entry.present(0)
+
+
+def test_recovered_survive_remesh_before_adoption(manager_factory, data,
+                                                  tmp_path):
+    """A remesh BEFORE the app adopts a ledger-recovered shuffle clears
+    the registry; the bump listener must re-register the recovered
+    entries under the new epoch (their sealed files are disk state a
+    membership change did not touch) so adoption still serves them."""
+    keys, vals = data
+    ledger = str(tmp_path / "ledger")
+    conf = {"spark.shuffle.tpu.failure.ledgerDir": ledger}
+    m = manager_factory(conf)
+    h = _stage(m, 28, keys, vals)
+    want = _canonical(m.read(h))
+    m2 = manager_factory(conf)
+    assert 28 in m2.recovered_shuffles()
+    m2.node.remesh(reason="pre-adoption remesh")
+    h2 = m2.register_shuffle(28, MAPS, R)
+    assert all(h2.entry.present(mm) for mm in range(MAPS))
+    assert _canonical(m2.read(h2)) == want
+
+
+def test_corrupt_index_sidecar_quarantines(manager_factory, data,
+                                           tmp_path):
+    """The .index sidecar gets content validation at scan time too: a
+    bit-rotted sidecar quarantines its map (typed recompute path)
+    instead of crashing adoption untyped or mis-declaring row counts."""
+    keys, vals = data
+    ledger = str(tmp_path / "ledger")
+    conf = {"spark.shuffle.tpu.failure.ledgerDir": ledger}
+    m = manager_factory(conf)
+    _stage(m, 27, keys, vals)
+    ipath = os.path.join(ledger, "shuffle_27", "shuffle_27_map_0.index")
+    open(ipath, "w").write('{"rows": 7, "val_dtype": null, '
+                           '"val_tail": null}')
+    m2 = manager_factory(conf)              # must construct cleanly
+    rec = m2.recovered_shuffles()[27]
+    assert rec["quarantined"] == [0] and rec["intact"] == [1]
+
+
+def test_manifest_version_mismatch_degrades_to_recompute(
+        manager_factory, data, tmp_path):
+    """A CRC-valid manifest from a different format generation (fleet
+    downgrade / mixed versions) recovers NOTHING and must not fail
+    manager construction — recovery degrades to recompute, exactly
+    like no ledger at all."""
+    import json
+    from sparkucx_tpu.shuffle.durable import _manifest_crc
+    keys, vals = data
+    ledger = str(tmp_path / "ledger")
+    conf = {"spark.shuffle.tpu.failure.ledgerDir": ledger}
+    m = manager_factory(conf)
+    _stage(m, 26, keys, vals)
+    mpath = os.path.join(ledger, "shuffle_26", "commit.manifest")
+    doc = json.load(open(mpath))
+    doc["version"] = 99
+    doc["crc32"] = _manifest_crc(doc)       # valid CRC, foreign format
+    open(mpath, "w").write(json.dumps(doc, sort_keys=True))
+    m2 = manager_factory(conf)              # must construct cleanly
+    assert 26 not in m2.recovered_shuffles()
+    h = m2.register_shuffle(26, MAPS, R)
+    assert not h.entry.present(0)
+
+
+def test_epoch_bump_replay_carries_integrity_records(manager_factory,
+                                                     data):
+    """The PR-7 in-memory ledger path still verifies: a re-registered
+    shuffle's integrity records ride the epoch bump, so the replayed
+    read re-checks its staged bytes like any other."""
+    keys, vals = data
+    m = manager_factory({"spark.shuffle.tpu.failure.policy": "replay"})
+    h = _stage(m, 24, keys, vals)
+    want = _canonical(m.read(h))
+    m.node.epochs.bump("test remesh")
+    res = m.read(h)                      # transparent ledger re-pin
+    assert _canonical(res) == want
+    assert h.entry.fetch_integrity(0) is not None
+    rep = m.report(24)
+    assert rep.integrity == "staged" and rep.replays == 1

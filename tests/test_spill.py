@@ -100,6 +100,57 @@ def test_spill_mixed_schema_rejected(spill_manager, rng):
     m.unregister_shuffle(4)
 
 
+def test_spill_truncation_raises_typed_naming_file(spill_manager,
+                                                   tmp_path, rng):
+    """Regression: materialize used to trust the ``.index`` row count —
+    mmapping a shorter-than-declared ``.vals`` file returned a garbage/
+    short view. It must raise typed, BEFORE the mmap, naming the file."""
+    from sparkucx_tpu.runtime.failures import (BlockCorruptionError,
+                                               TruncatedBlockError)
+    m = spill_manager(threshold="1k")
+    Rp = 4
+    h = m.register_shuffle(30, 1, Rp)
+    w = m.get_writer(h, 0)
+    keys = rng.integers(0, 1 << 31, size=800).astype(np.int64)
+    w.write(keys, keys.astype(np.float64).reshape(-1, 1))
+    w.commit(Rp)
+    # seal happened at commit; now truncate the sealed .vals on disk
+    vals_path = w._spill.vals_path
+    w._spill.drop_views()
+    w._spill_views = None
+    with open(vals_path, "r+b") as f:
+        f.truncate(os.path.getsize(vals_path) - 512)
+    with pytest.raises(TruncatedBlockError, match="shuffle_30_map_0.vals"):
+        w.materialize()
+    # the typed error is a BlockCorruptionError (TransientError): the
+    # replay/doctor machinery treats torn files as corruption
+    assert issubclass(TruncatedBlockError, BlockCorruptionError)
+    m.unregister_shuffle(30)
+
+
+def test_spill_seal_is_torn_write_proof(spill_manager, tmp_path, rng):
+    """Appends land in .tmp files only; the seal (commit/materialize)
+    atomically renames them under the final names with the sidecar —
+    a crash BEFORE the seal leaves no plausible final-name files, and
+    sealed files reject further appends."""
+    m = spill_manager(threshold="1k")
+    h = m.register_shuffle(31, 1, 4)
+    w = m.get_writer(h, 0)
+    keys = rng.integers(0, 1 << 31, size=800).astype(np.int64)
+    w.write(keys)
+    stem = os.path.join(str(tmp_path), "shuffle_31_map_0")
+    assert os.path.exists(stem + ".keys.tmp")
+    assert not os.path.exists(stem + ".keys")     # unsealed: tmp only
+    w.commit(4)
+    assert os.path.exists(stem + ".keys")
+    assert not os.path.exists(stem + ".keys.tmp")
+    assert os.path.exists(stem + ".index")
+    with pytest.raises(RuntimeError, match="sealed"):
+        w._spill.append(keys, None)
+    m.unregister_shuffle(31)
+    assert not glob.glob(stem + "*")
+
+
 def test_spill_fault_site_armed(spill_manager, rng):
     """The spill valve is a fault site: an armed spill.* knob fires
     InjectedFault on the first flush (the disk-full drill), and the
