@@ -2426,6 +2426,172 @@ def integrity_measure(rows_per_map=1 << 12, maps=4, partitions=16,
     }
 
 
+def devread_measure(tokens=1 << 12, d_model=32, experts=16, maps=4,
+                    reps=3, seed=0):
+    """The device-resident consumption A/B behind ``--stage devread``:
+    MoE expert dispatch — token shuffle by expert id through
+    ``manager.read()`` — consumed by ONE jitted train step (forward +
+    backward + SGD over donated receive rows), device-sink vs
+    host-staged.
+
+    Per arm the SAME staged shuffle is re-read per rep (a committed
+    shuffle serves any number of exchanges), so the A/B isolates the
+    read->consume leg:
+
+    * device arm — ``read(sink="device")`` + ``result.consume(step)``:
+      the acceptance gates are ``shuffle.read.d2h.bytes`` delta == 0
+      across the whole warm loop, compile.step.programs delta <= 1 for
+      the (shape family, sink=device) pair with 0 warm recompiles, and
+      measured tokens/s >= the host arm (CPU artifact — the host arm
+      pays drain + repack + re-upload on every rep; device backends
+      gate a real win);
+    * host arm — ``read(sink="host")`` + ``models.moe
+      .host_staged_consume`` (the legacy round-trip: drain D2H, repack,
+      H2D, same step), whose ``shuffle.consume.h2d.bytes`` delta must
+      be > 0 — the doctor's host_roundtrip evidence.
+
+    Both arms run the SAME consumer program (same cap), so the delta is
+    purely the landing zone. In-process and CPU-safe."""
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.models import moe
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    from sparkucx_tpu.utils.metrics import (C_D2H, C_H2D, COMPILE_PROGRAMS,
+                                            GLOBAL_METRICS)
+
+    rng = np.random.default_rng(seed)
+    toks = rng.standard_normal((tokens, d_model)).astype(np.float32)
+    eids = rng.integers(0, experts, size=tokens)
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    mgr = TpuShuffleManager(node, conf)
+    mesh = mgr.exchange_mesh
+    cfg = moe.MoEConfig(d_model=d_model, d_hidden=2 * d_model,
+                        num_experts=experts)
+    width = 2 + d_model
+    out = {"tokens": tokens, "d_model": d_model, "experts": experts,
+           "maps": maps, "reps": reps}
+    try:
+        h = mgr.register_shuffle(91000, maps, experts,
+                                 partitioner="direct")
+        moe.stage_tokens_by_expert(mgr, h, toks, eids)
+
+        # -- device arm ---------------------------------------------------
+        prog0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+        res = mgr.read(h, sink="device")
+        cap = res.device_rows().shape[0] // node.num_devices
+        init, step = moe.make_device_dispatch_step(mesh, cfg, cap,
+                                                   axis=mgr.axis)
+        params = init(jax.random.PRNGKey(seed))
+
+        def consume(carry, rows, nv):
+            p, _ = carry
+            return step(p, rows, nv)
+
+        params, loss = res.consume(consume, (params, None))
+        jax.block_until_ready(loss)
+        programs_first = GLOBAL_METRICS.get(COMPILE_PROGRAMS) - prog0
+        d2h0 = GLOBAL_METRICS.get(C_D2H)
+        progw0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+        dev_times = []
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            r = mgr.read(h, sink="device")
+            params, loss = r.consume(consume, (params, None))
+            jax.block_until_ready(loss)
+            dev_times.append(_time.perf_counter() - t0)
+        dev = {
+            "rep_ms": [round(t * 1e3, 3) for t in dev_times],
+            "median_ms": round(sorted(dev_times)[reps // 2] * 1e3, 3),
+            "tokens_per_s": round(
+                tokens / sorted(dev_times)[reps // 2], 1),
+            "d2h_bytes_delta": GLOBAL_METRICS.get(C_D2H) - d2h0,
+            "programs_first_exchange": programs_first,
+            "programs_warm": GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+            - progw0,
+            "loss": float(loss),
+            "report_sink": mgr.report(h.shuffle_id).sink,
+            "report_d2h_bytes": mgr.report(h.shuffle_id).d2h_bytes,
+        }
+
+        # -- host-staged arm ----------------------------------------------
+        params_h = init(jax.random.PRNGKey(seed))
+        rh = mgr.read(h, sink="host")
+        params_h, hloss = moe.host_staged_consume(
+            rh, step, params_h, mesh, cap, width, axis=mgr.axis)
+        jax.block_until_ready(hloss)
+        h2d0 = GLOBAL_METRICS.get(C_H2D)
+        host_times = []
+        for _ in range(reps):
+            t0 = _time.perf_counter()
+            r = mgr.read(h, sink="host")
+            params_h, hloss = moe.host_staged_consume(
+                r, step, params_h, mesh, cap, width, axis=mgr.axis)
+            jax.block_until_ready(hloss)
+            host_times.append(_time.perf_counter() - t0)
+        host = {
+            "rep_ms": [round(t * 1e3, 3) for t in host_times],
+            "median_ms": round(sorted(host_times)[reps // 2] * 1e3, 3),
+            "tokens_per_s": round(
+                tokens / sorted(host_times)[reps // 2], 1),
+            "h2d_bytes_delta": GLOBAL_METRICS.get(C_H2D) - h2d0,
+            "loss": float(hloss),
+            "report_sink": mgr.report(h.shuffle_id).sink,
+            "report_d2h_bytes": mgr.report(h.shuffle_id).d2h_bytes,
+        }
+        mgr.unregister_shuffle(h.shuffle_id)
+    finally:
+        mgr.stop()
+        node.close()
+
+    speedup = host["median_ms"] / dev["median_ms"] \
+        if dev["median_ms"] else 0.0
+    gates = {
+        "device_d2h_zero": dev["d2h_bytes_delta"] == 0,
+        "device_report_sink": dev["report_sink"] == "device",
+        "one_program_per_family": dev["programs_first_exchange"] <= 1,
+        "zero_warm_recompiles": dev["programs_warm"] == 0,
+        "host_reuploads": host["h2d_bytes_delta"] > 0,
+        "host_drains": host["report_d2h_bytes"] > 0,
+        "device_at_least_host_tokens_per_s":
+            dev["tokens_per_s"] >= host["tokens_per_s"],
+    }
+    out.update(device=dev, host=host, speedup=round(speedup, 3),
+               gates=gates, ok=all(gates.values()))
+    return out
+
+
+def stage_devread(args) -> int:
+    """``--stage devread``: the device-resident consumption proof — MoE
+    tokens/s device-sink vs host-staged at the CI smoke shape, gating
+    d2h == 0, one program per (shape family, sink), zero warm
+    recompiles, and device tokens/s >= host. Writes
+    ``bench_runs/devread.json`` (a committed CI regress baseline, diffed
+    like pipeline/ragged/wire); exit 2 on any gate failing."""
+    detail = devread_measure(
+        tokens=1 << (args.rows_log2 or 12),
+        reps=max(3, args.reps))
+    out = {"metric": "devread", "detail": detail, "ok": detail["ok"]}
+    out["telemetry"] = _telemetry_blob()
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_runs", "devread.json")
+    try:
+        os.makedirs(os.path.dirname(artifact), exist_ok=True)
+        _write_artifact(artifact, out)
+        out["artifact"] = os.path.relpath(
+            artifact, os.path.dirname(os.path.abspath(__file__)))
+    except OSError as e:
+        out["artifact_error"] = str(e)[:200]
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 2
+
+
 def stage_integrity(args) -> int:
     """``--stage integrity``: prove the integrity-and-durability plane —
     staged verify under 3% of the exchange wall (direct-measured, the
@@ -2734,6 +2900,76 @@ def chaos_measure(rows_per_map=1 << 12, maps=4, partitions=16,
             and cell["fault_fired"] and cell["hang_free"]
             and cell["bytes_ok"] and cell["family_stable"]
             and cell["wire_held"])
+        ok &= cell["ok"]
+        cells.append(cell)
+    finally:
+        mgr.stop()
+        node.close()
+
+    # device-sink cell (ISSUE-10 device-resident consumption): read.sink=
+    # device x replay under an exchange-site fault — the fault fires in
+    # the dispatch window that would hand the receive buffers to the
+    # consumer. The replay must re-run to ORACLE (verified by consuming
+    # the device buffers through a donating pass-through step and
+    # reading the CONSUMER's outputs back — donation moved bits, not
+    # garbage), the report must still say sink=device with replays >= 1
+    # on the same plan family, and the consumer path must stay zero-D2H
+    # (the verification drain is measured OUTSIDE the gate window).
+    import jax as _jax
+
+    from sparkucx_tpu.utils.metrics import C_D2H, GLOBAL_METRICS
+    cell = {"impl": "dense", "mode": "single", "policy": "replay",
+            "site": "exchange", "sink": "device"}
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+        "spark.shuffle.tpu.failure.policy": "replay",
+        "spark.shuffle.tpu.failure.replayBudget": "2",
+        "spark.shuffle.tpu.failure.collectiveTimeoutMs": str(timeout_ms),
+        "spark.shuffle.tpu.network.timeoutMs": str(int(timeout_ms)),
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    mgr = TpuShuffleManager(node, conf)
+    try:
+        h0 = stage(mgr)
+        oracle = canonical(mgr.read(h0, sink="host"))
+        mgr.unregister_shuffle(h0.shuffle_id)
+        h1 = stage(mgr)
+        mgr.read(h1, sink="device").close()     # clean device family
+        clean_family = mgr.report(h1.shuffle_id).plan_family
+        mgr.unregister_shuffle(h1.shuffle_id)
+        t0 = _time.perf_counter()
+        node.faults.arm("exchange", fail_count=1)
+        try:
+            h = stage(mgr)
+            d2h0 = GLOBAL_METRICS.get(C_D2H)
+            res = mgr.read(h, sink="device")
+            passthru = _jax.jit(lambda rows, nv: rows,
+                                donate_argnums=(0,))
+            outs = res.consume(
+                lambda c, rows, nv: (c or []) + [passthru(rows, nv)])
+            _jax.block_until_ready(outs)
+            cell["d2h_consumer_path"] = \
+                GLOBAL_METRICS.get(C_D2H) - d2h0
+            rep = mgr.report(h.shuffle_id)
+            cell["replays"] = int(rep.replays)
+            cell["sink_held"] = rep.sink == "device"
+            cell["family_stable"] = rep.plan_family == clean_family
+            cell["outcome"] = "replayed" if rep.replays else "no_fire"
+            # oracle check through the CONSUMER's returned buffers
+            got = canonical(res.host_view(wave_rows=outs))
+            cell["bytes_ok"] = same(got, oracle)
+            fired = node.faults.stats().get("exchange", (0, 0))
+            cell["fault_fired"] = fired[1] >= 1
+        finally:
+            node.faults.disarm("exchange")
+        cell["wall_ms"] = round((_time.perf_counter() - t0) * 1e3, 1)
+        cell["hang_free"] = cell["wall_ms"] < envelope_ms
+        cell["ok"] = bool(
+            cell["outcome"] == "replayed" and cell["replays"] >= 1
+            and cell["fault_fired"] and cell["hang_free"]
+            and cell["bytes_ok"] and cell["family_stable"]
+            and cell["sink_held"]
+            and cell["d2h_consumer_path"] == 0)
         ok &= cell["ok"]
         cells.append(cell)
     finally:
@@ -3198,7 +3434,7 @@ def main() -> None:
     ap.add_argument("--stage", default=None,
                     choices=("coldstart", "obs-overhead", "regress",
                              "pipeline", "devplane", "ragged", "chaos",
-                             "wire", "integrity"),
+                             "wire", "integrity", "devread"),
                     help="run ONE dedicated stage instead of the ladder: "
                          "coldstart = compile-cost artifact (persistent "
                          "cache cold-vs-warm across processes + "
@@ -3230,7 +3466,11 @@ def main() -> None:
                          "program delta per verify level, corrupt-site "
                          "detection + one-unit replay, restart "
                          "recovery from failure.ledgerDir with a "
-                         "quarantine leg). All CPU-measurable")
+                         "quarantine leg); devread = device-resident "
+                         "consumption A/B (MoE tokens/s device-sink vs "
+                         "host-staged: d2h == 0, one program per "
+                         "(family, sink), 0 warm recompiles, device >= "
+                         "host). All CPU-measurable")
     ap.add_argument("--baseline", default=None,
                     help="regress stage: prior artifact to diff against "
                          "(default bench_runs/obs_overhead.json)")
@@ -3284,7 +3524,8 @@ def main() -> None:
                   "ragged": stage_ragged,
                   "chaos": stage_chaos,
                   "wire": stage_wire,
-                  "integrity": stage_integrity}[args.stage](args))
+                  "integrity": stage_integrity,
+                  "devread": stage_devread}[args.stage](args))
 
     fallback = None
     if args.platform == "auto" and not args.no_fallback:

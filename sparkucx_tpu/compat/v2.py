@@ -228,10 +228,16 @@ class ShuffleServiceV2:
             with self._results_guard:
                 res = self._results.get(sid)
             if res is None:
+                # sink pinned to host: the shared result is consumed by
+                # N range readers through the numpy partition contract —
+                # a conf-selected device sink would hand them a
+                # single-consumer device result (use read_device for
+                # the zero-D2H path)
                 res = self.manager.read(
                     handle, timeout=timeout,
                     combine=dep.combine, ordered=dep.ordered,
-                    combine_sum_words=dep.combine_sum_words)
+                    combine_sum_words=dep.combine_sum_words,
+                    sink="host")
                 with self._results_guard:
                     # cache only if OUR lock still maps this sid: an
                     # unregister that raced this read popped it (and a
@@ -338,6 +344,48 @@ class ShuffleServiceV2:
             return w
 
     # -- reduce side -------------------------------------------------------
+    def read_device(self, handle: ShuffleHandle,
+                    timeout: Optional[float] = None):
+        """Device-resident read (``read.sink=device``): the whole
+        exchange lands as sharded jax Arrays and returns a
+        :class:`~sparkucx_tpu.shuffle.reader.DeviceShuffleReaderResult`
+        whose ``consume()`` hands the buffers — donation-safe, zero
+        D2H — to a jitted consumer step. UNLIKE :meth:`reader`, the
+        result is single-consumer (consume takes the buffers) and is
+        therefore NOT cached/shared; the dependency's combine/ordered
+        options must be off (those merges are host-side — the manager
+        resolves them back to the host sink)."""
+        dep = self._deps.get(handle.shuffle_id)
+        if dep is None:
+            raise KeyError(f"shuffle {handle.shuffle_id} not registered "
+                           f"through this adapter")
+        if dep.combine or dep.ordered:
+            # fail CLOSED here rather than let the manager's host
+            # fallback hand this device-expecting caller a lazy result
+            # whose .consume() dies with a bare AttributeError
+            raise ValueError(
+                f"read_device on shuffle {handle.shuffle_id}: the "
+                f"dependency declares combine={dep.combine!r}/"
+                f"ordered={dep.ordered} — those merges are host-side; "
+                f"use reader() (the numpy contract) for this shuffle")
+        res = self.manager.read(handle, timeout=timeout,
+                                combine=dep.combine, ordered=dep.ordered,
+                                combine_sum_words=dep.combine_sum_words,
+                                sink="device")
+        if getattr(res, "sink", "host") != "device":
+            # the manager's resolve can demote for reasons this adapter
+            # cannot pre-check (conf read.sink=host pin, distributed,
+            # hierarchical mesh) — fail closed with the reason rather
+            # than hand a device-expecting caller a lazy result whose
+            # .consume() dies with a bare AttributeError
+            raise RuntimeError(
+                f"read_device on shuffle {handle.shuffle_id}: the "
+                f"manager resolved this read to the host sink (conf "
+                f"read.sink=host pin, distributed, or hierarchical "
+                f"mesh — see the warn-once log) — use reader() here, "
+                f"or lift the conf pin")
+        return res
+
     def reader(self, handle: ShuffleHandle, start: int = 0,
                end: Optional[int] = None,
                timeout: Optional[float] = None) -> PartitionReader:

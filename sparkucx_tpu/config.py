@@ -87,7 +87,7 @@ class TpuShuffleConf:
         "coordinator_address", "meta_buffer_size", "min_buffer_size",
         "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
         "spill_threshold", "spill_dir", "a2a_impl", "a2a_wire",
-        "wire_error_sample_rows", "sort_impl",
+        "read_sink", "wire_error_sample_rows", "sort_impl",
         "sort_strips", "combine_compaction", "fetch_granularity",
         "capacity_factor", "cap_buckets", "cap_bucket_growth",
         "wave_rows", "wave_depth", "pack_threads",
@@ -410,6 +410,26 @@ class TpuShuffleConf:
         from sparkucx_tpu.shuffle.alltoall import validate_wire
         return validate_wire(self._get("a2a.wire", "raw"),
                              conf_key=PREFIX + "a2a.wire")
+
+    @property
+    def read_sink(self) -> str:
+        """Where a completed exchange LANDS: ``host`` (drain receive
+        buffers D2H and serve numpy partition views — the historical
+        contract, required by the arrow/varlen egress and the lossless
+        drain codec), ``device`` (partitions stay sharded jax Arrays and
+        the result hands them — donation-safe, zero D2H — straight to a
+        jitted consumer step: reader.DeviceShuffleReaderResult.consume;
+        the MoE expert-dispatch path), or ``auto`` (default — host
+        unless the consumer declares a device sink per read,
+        ``manager.read(..., sink="device")``). The manager resolves the
+        tier per read: distributed / hierarchical / combine / ordered
+        reads need host-side merges and fall back to host with a
+        warn-once log, and the report's ``sink`` field names the tier
+        that actually ran (the resolved-impl discipline). The allowed
+        set lives in ONE place — shuffle/alltoall.ALLOWED_SINKS."""
+        from sparkucx_tpu.shuffle.alltoall import validate_sink
+        return validate_sink(self._get("read.sink", "auto"),
+                             conf_key=PREFIX + "read.sink")
 
     @property
     def wire_error_sample_rows(self) -> int:

@@ -329,9 +329,14 @@ def read_batches(manager, handle, key_column: str = "key",
                     f"columns one int32/float32 dtype); this shuffle's "
                     f"schema is {dts} — widened carriers are 8-byte and "
                     f"cannot combine on device")
+    # Arrow egress IS host materialization (RecordBatches are built from
+    # numpy partition views) — pin the host sink so a conf-selected
+    # read.sink=device cannot hand this path a device-resident result
+    # (the read_partitions / compat-v2 range-reader discipline)
     res = manager.read(handle, timeout=timeout, ordered=ordered,
                        combine=combine,
-                       combine_sum_words=combine_sum_words)
+                       combine_sum_words=combine_sum_words,
+                       sink="host")
     out = []
     for r, (k, v) in res.partitions():
         if k.shape[0]:

@@ -68,6 +68,53 @@ def _ring_attention_sharded(q, k, v, axis: str, causal: bool,
     return _finalize(o, m, l)
 
 
+def decode_qkv_rows(rows, nvalid, t: int, heads: int, head_dim: int):
+    """Decode one shard's packed shuffle receive rows into attention
+    shards ON DEVICE — the device-sink (``read.sink=device``) decode for
+    sequence-parallel consumers: key = global sequence position (the
+    range partitioner's routing key), value lanes = fused ``q|k|v``
+    float32 vectors per position. Rows arrive partition-grouped but
+    position-unordered, so one argsort over the key_lo lane restores
+    sequence order; invalid rows (past ``nvalid``) sort to the tail and
+    the static ``[:t]`` slice drops them. Returns ``(q, k, v)`` each
+    ``[1, heads, t, head_dim]`` — the shard shape ring/ulysses bodies
+    take. Shared by both consumers (one decode, no drift)."""
+    cap = rows.shape[0]
+    j = jnp.arange(cap, dtype=jnp.int32)
+    valid = j < nvalid[0]
+    pos = jnp.where(valid, rows[:, 0], jnp.int32(2**31 - 1))
+    order = jnp.argsort(pos)
+    fused = jax.lax.bitcast_convert_type(
+        jnp.take(rows, order, axis=0)[:t, 2:2 + 3 * heads * head_dim],
+        jnp.float32).reshape(t, 3, heads, head_dim)
+    qkv = jnp.transpose(fused, (1, 2, 0, 3))[:, None]   # [3,1,H,t,D]
+    return qkv[0], qkv[1], qkv[2]
+
+
+def ring_attention_consumer(mesh: Mesh, axis: str, tokens_per_shard: int,
+                            heads: int, head_dim: int,
+                            causal: bool = False,
+                            scale: Optional[float] = None):
+    """Device-sink consumer for ring attention: a jitted step (rows
+    DONATED) that decodes a device-resident shuffle result's receive
+    buffers — sequence shards routed by the range partitioner — and runs
+    the ICI-ring attention body without the bytes ever visiting the
+    host. Use as ``result.consume(lambda c, rows, nv: step(rows, nv))``;
+    returns ``[1, heads, T, head_dim]`` sequence-sharded output."""
+    from jax.sharding import PartitionSpec as PS
+
+    def body(rows, nvalid):
+        q, k, v = decode_qkv_rows(rows, nvalid, tokens_per_shard,
+                                  heads, head_dim)
+        return _ring_attention_sharded(q, k, v, axis, causal, scale)
+
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(PS(axis), PS(axis)),
+                       out_specs=PS(None, None, axis, None),
+                       check_vma=False)
+    return jax.jit(sm, donate_argnums=(0,))
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis: str = "sp", causal: bool = False,
                    scale: Optional[float] = None) -> jax.Array:
@@ -88,4 +135,4 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     return fn(q, k, v)
 
 
-__all__ = ["ring_attention"]
+__all__ = ["ring_attention", "ring_attention_consumer", "decode_qkv_rows"]

@@ -50,6 +50,40 @@ def _ulysses_sharded(q, k, v, axis: str, causal: bool,
     return to_seq(oh)
 
 
+def ulysses_attention_consumer(mesh: Mesh, axis: str,
+                               tokens_per_shard: int, heads: int,
+                               head_dim: int, causal: bool = False,
+                               scale: Optional[float] = None,
+                               block_q: int = 256, block_k: int = 512,
+                               impl: str = "auto"):
+    """Device-sink consumer for Ulysses attention: the jitted step (rows
+    DONATED) decodes a device-resident shuffle result's sequence shards
+    (``parallel.ring.decode_qkv_rows`` — one shared decode, no drift)
+    and runs the head<->sequence all-to-all attention body in HBM. Use
+    as ``result.consume(lambda c, rows, nv: step(rows, nv))``. Requires
+    ``heads %% axis size == 0`` like :func:`ulysses_attention`."""
+    from jax.sharding import PartitionSpec as PS
+
+    from sparkucx_tpu.parallel.ring import decode_qkv_rows
+    p = mesh.shape[axis]
+    if heads % p != 0:
+        raise ValueError(
+            f"heads={heads} not divisible by axis {axis}={p}; use "
+            f"ring_attention_consumer below the mesh size")
+
+    def body(rows, nvalid):
+        q, k, v = decode_qkv_rows(rows, nvalid, tokens_per_shard,
+                                  heads, head_dim)
+        return _ulysses_sharded(q, k, v, axis, causal, scale,
+                                block_q, block_k, impl)
+
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(PS(axis), PS(axis)),
+                       out_specs=PS(None, None, axis, None),
+                       check_vma=False)
+    return jax.jit(sm, donate_argnums=(0,))
+
+
 def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                       axis: str = "sp", causal: bool = False,
                       scale: Optional[float] = None, block_q: int = 256,
@@ -74,4 +108,4 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     return fn(q, k, v)
 
 
-__all__ = ["ulysses_attention"]
+__all__ = ["ulysses_attention", "ulysses_attention_consumer"]

@@ -221,7 +221,8 @@ class ShuffleService:
              timeout: Optional[float] = None,
              combine: Optional[str] = None,
              ordered: bool = False,
-             combine_sum_words: int = 0):
+             combine_sum_words: int = 0,
+             sink: Optional[str] = None):
         """Full exchange. arrow: list of per-partition RecordBatches;
         raw: the ShuffleReaderResult partition view. ``combine="sum"``
         runs device combine-by-key; ``ordered=True`` returns key-sorted
@@ -229,8 +230,15 @@ class ShuffleService:
         value words and carries the rest per key — REQUIRED when the
         value row holds a varlen payload next to the summed lane
         (io/varlen.py pack_counted_varbytes), or the combiner would sum
-        the payload bytes (manager.read docstring)."""
+        the payload bytes (manager.read docstring). ``sink="device"``
+        (raw format only — Arrow egress IS host materialization) returns
+        the device-resident result (manager.read docstring)."""
         if self.io_format == "arrow":
+            if sink == "device":
+                raise ValueError(
+                    "sink='device' requires io.format=raw: the Arrow "
+                    "egress materializes RecordBatches host-side by "
+                    "definition — the round-trip the device sink deletes")
             from sparkucx_tpu.io.arrow import read_batches
             return read_batches(self.manager, handle,
                                 key_column=self.key_column, timeout=timeout,
@@ -238,17 +246,20 @@ class ShuffleService:
                                 combine_sum_words=combine_sum_words)
         return self.manager.read(handle, timeout=timeout, combine=combine,
                                  ordered=ordered,
-                                 combine_sum_words=combine_sum_words)
+                                 combine_sum_words=combine_sum_words,
+                                 sink=sink)
 
     def submit(self, handle: ShuffleHandle,
                timeout: Optional[float] = None,
                combine: Optional[str] = None,
                ordered: bool = False,
-               combine_sum_words: int = 0):
+               combine_sum_words: int = 0,
+               sink: Optional[str] = None):
         """Asynchronous raw read (shuffle/reader.py PendingShuffle)."""
         return self.manager.submit(handle, timeout=timeout,
                                    combine=combine, ordered=ordered,
-                                   combine_sum_words=combine_sum_words)
+                                   combine_sum_words=combine_sum_words,
+                                   sink=sink)
 
 
 def connect(conf: Optional[Mapping[str, str]] = None, *,

@@ -85,6 +85,38 @@ ALLOWED_WIRES = ("raw", "int8", "lossless")
 
 A2A_WIRE_KEY = "spark.shuffle.tpu.a2a.wire"
 
+# Read-sink tiers (conf key ``spark.shuffle.tpu.read.sink``) — where a
+# completed exchange LANDS, orthogonal to impl and wire:
+#
+# ``host``   — the reader drains receive buffers D2H and serves numpy
+#              partition views (the historical contract; arrow/varlen IO
+#              and the lossless drain codec live here).
+# ``device`` — partitions stay sharded jax Arrays; the result hands them
+#              (donation-safe, zero D2H) straight to a jitted consumer
+#              step (DeviceShuffleReaderResult.consume) — MoE expert
+#              dispatch and the SP/EP attention consumers are the
+#              flagship shapes. Exoshuffle's thesis applied to the
+#              landing zone: the consumer, not the engine, dictates
+#              where bytes end up.
+# ``auto``   — host unless the consumer declares a device sink per read
+#              (read(sink="device")); the default.
+ALLOWED_SINKS = ("host", "device", "auto")
+
+READ_SINK_KEY = "spark.shuffle.tpu.read.sink"
+
+
+def validate_sink(sink: str, conf_key: str = READ_SINK_KEY) -> str:
+    """The one validation seam for the read-sink tier set: config.py,
+    the manager's per-read resolve and the bench CLI accept exactly
+    ``ALLOWED_SINKS`` (the validate_impl/validate_wire discipline)."""
+    if sink not in ALLOWED_SINKS:
+        raise ValueError(
+            f"{conf_key}={sink!r}: want one of {ALLOWED_SINKS} "
+            f"(host = drain results D2H, device = partitions stay "
+            f"sharded jax Arrays handed to a consumer step, auto = "
+            f"device when the consumer declares one per read)")
+    return sink
+
 # Distinct noise streams one training/read step may draw from the same
 # base seed (forward dispatch, forward combine, spare, backward) — the
 # seed discipline every int8 wire move shares (wire_noise_seed below).

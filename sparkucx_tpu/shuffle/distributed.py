@@ -394,12 +394,17 @@ class PendingDistributedShuffle(PendingExchangeBase):
                     # degenerate 1-shard cluster: step_body takes the
                     # strip fast path (see reader.py resolve)
                     align_chunk = cur.strip_rows()
+                local_payload = _local_shards_of(rows_out, self._shard_ids,
+                                                 cap_shard)
                 res = DistributedReaderResult(
-                    R, part_to_shard, self._shard_ids,
-                    _local_shards_of(rows_out, self._shard_ids,
-                                     cap_shard),
+                    R, part_to_shard, self._shard_ids, local_payload,
                     seg_host, self._val_shape, self._val_dtype,
                     align_chunk=align_chunk)
+                # the distributed path force-materializes its local
+                # shards host-side — honest d2h accounting (the device
+                # sink is single-process for now; manager._resolve_sink)
+                from sparkucx_tpu.shuffle.reader import _note_d2h
+                _note_d2h(res, int(local_payload.nbytes))
                 res.cap_out_used = cur.cap_out
                 if not (cur.combine or cur.ordered
                         or self._hier_mesh is not None):

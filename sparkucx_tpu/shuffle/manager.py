@@ -196,6 +196,18 @@ class ExchangeReport:
     # records published.
     integrity: str = ""
     integrity_bytes: int = 0
+    # Read-sink plane (read.sink, shuffle/reader.py): ``sink`` is the
+    # RESOLVED landing tier this read ran — "device" = partitions stayed
+    # sharded jax Arrays handed to the consumer (zero payload D2H by
+    # construction), "host" = the historical drain (the resolved-impl
+    # discipline: never the conf ask). ``d2h_bytes`` counts the PAYLOAD
+    # bytes this read's result actually pulled device-to-host — filled
+    # as the consumer touches partitions (a lazy result drains after
+    # completion, so the figure keeps accruing on the live report); 0 on
+    # the device path is the deleted-round-trip evidence the doctor's
+    # host_roundtrip rule and bench --stage devread grade.
+    sink: str = "host"
+    d2h_bytes: int = 0
     completed: bool = False
     error: Optional[str] = None
     # bookkeeping, excluded from to_dict()
@@ -335,6 +347,9 @@ class TpuShuffleManager:
         # warn-once latch: a2a.wire=lossless on a single-shot read is an
         # inert codec (it rides the wave drain path only)
         self._warned_inert_lossless = False
+        # warn-once latches for read-sink resolution (read.sink=device
+        # falling back to host, lossless-under-device-sink inertness)
+        self._warned_sink: set = set()
         self._lock = threading.Lock()
         # Admission control (a2a.maxBytesInFlight): combined footprint of
         # in-flight submitted exchanges; submit() blocks past the cap
@@ -823,6 +838,17 @@ class TpuShuffleManager:
         if rep is None or rep._full_done:
             return
         rep._full_done = True
+        if getattr(res, "sink", "host") == "device":
+            # the full-level digest check is host-side by design (it
+            # re-reads drained rows) — a device-sink result never
+            # drains, so forcing it here would re-pay the round-trip
+            # the sink deletes; staged verify already ran at pack time
+            self._warn_integrity_once(
+                "full_device",
+                "integrity.verify=full: device-sink reads verify at the "
+                "staged level only — the post-collective digest check "
+                "is host-side, and the device sink exists to not drain")
+            return
         if combine:
             self._warn_integrity_once(
                 "full_combine",
@@ -1281,7 +1307,8 @@ class TpuShuffleManager:
                rows_per_map=None, rows_per_shard=None,
                val_shape=None, val_dtype=None,
                combine: Optional[str] = None,
-               ordered: bool = False) -> ShufflePlan:
+               ordered: bool = False,
+               sink: Optional[str] = None) -> ShufflePlan:
         """Pre-trace + compile (and once-execute on empty inputs) the
         exchange step a later ``read()``/``submit()`` of this handle will
         dispatch — while map tasks are still running. The reference
@@ -1339,9 +1366,14 @@ class TpuShuffleManager:
                          partitioner=handle.partitioner,
                          bounds=handle.bounds)
         plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
-        plan = self._decorated_plan(plan, combine, ordered, has_vals,
-                                    val_tail if has_vals else None,
-                                    val_dtype)
+        plan = self._decorated_plan(
+            plan, combine, ordered, has_vals,
+            val_tail if has_vals else None, val_dtype,
+            # warm the program family the read will dispatch: sink keys
+            # the family (plan.family), so a device read must warm its
+            # own entry
+            sink=self._resolve_sink(sink, combine, ordered,
+                                    distributed=self.node.is_distributed))
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)
         with self.node.tracer.span("shuffle.warmup",
@@ -1406,7 +1438,8 @@ class TpuShuffleManager:
              timeout: Optional[float] = None,
              combine: Optional[str] = None,
              ordered: bool = False,
-             combine_sum_words: int = 0) -> ShuffleReaderResult:
+             combine_sum_words: int = 0,
+             sink: Optional[str] = None) -> ShuffleReaderResult:
         """Execute the full exchange for a shuffle and return partitioned
         results (the getReader + fetch-everything path, SURVEY.md §3.4).
 
@@ -1429,7 +1462,14 @@ class TpuShuffleManager:
         carry over (``_wave_cap_hints`` outlive the attempt) — up to
         ``failure.replayBudget`` times, with the replay count and the
         failed attempts' wall on the final ExchangeReport. The failfast
-        default keeps the old typed-error contract exactly."""
+        default keeps the old typed-error contract exactly.
+
+        ``sink="device"`` (or conf ``read.sink=device``) returns a
+        :class:`~sparkucx_tpu.shuffle.reader.DeviceShuffleReaderResult`:
+        partitions stay sharded jax Arrays handed — donation-safe, zero
+        D2H — to a jitted consumer step (``result.consume``); waved
+        reads land as per-wave device views chained through the same
+        fold. See ``_resolve_sink`` for the host fallbacks."""
         timeout = timeout if timeout is not None \
             else self.conf.connection_timeout_ms / 1e3
         # Fetch-wait DISTRIBUTION per read — what Spark's incFetchWaitTime
@@ -1457,17 +1497,19 @@ class TpuShuffleManager:
                     replays += self._resolve_handle(handle)
                     if self.node.is_distributed:
                         # collective: every process must pass the same
-                        # combine/ordered values (same SPMD discipline
-                        # as calling read() at all)
+                        # combine/ordered/sink values (same SPMD
+                        # discipline as calling read() at all)
                         res = self._submit_distributed(
                             handle, timeout, combine=combine,
                             ordered=ordered,
-                            combine_sum_words=combine_sum_words).result()
+                            combine_sum_words=combine_sum_words,
+                            sink=sink).result()
                     else:
                         res = self._submit_local(
                             handle, timeout, combine=combine,
                             ordered=ordered,
-                            combine_sum_words=combine_sum_words).result()
+                            combine_sum_words=combine_sum_words,
+                            sink=sink).result()
                     # integrity.verify=full: the post-collective check
                     # runs INSIDE the retry window — a corrupt drained
                     # block is a TransientError the replay policy may
@@ -1518,8 +1560,11 @@ class TpuShuffleManager:
             raise IndexError(
                 f"partition range [{start}, {end}) out of "
                 f"[0, {handle.num_partitions}]")
+        # range reads ARE host materialization (the caller iterates
+        # numpy views) — pin the host sink so read.sink=device conf
+        # cannot hand this iterator a device-resident result
         res = self.read(handle, timeout=timeout, combine=combine,
-                        ordered=ordered)
+                        ordered=ordered, sink="host")
         return ((r, res.partition(r)) for r in range(start, end)
                 if res.is_local(r))
 
@@ -1527,7 +1572,8 @@ class TpuShuffleManager:
                timeout: Optional[float] = None,
                combine: Optional[str] = None,
                ordered: bool = False,
-               combine_sum_words: int = 0):
+               combine_sum_words: int = 0,
+               sink: Optional[str] = None):
         """Asynchronous read: plan + pack on the host, DISPATCH the
         exchange, and return a :class:`shuffle.reader.PendingShuffle`
         without blocking — so the caller overlaps this shuffle's collective
@@ -1550,11 +1596,11 @@ class TpuShuffleManager:
         if self.node.is_distributed:
             pending = self._submit_distributed(
                 handle, timeout, combine=combine, ordered=ordered,
-                combine_sum_words=combine_sum_words)
+                combine_sum_words=combine_sum_words, sink=sink)
         else:
             pending = self._submit_local(
                 handle, timeout, combine=combine, ordered=ordered,
-                combine_sum_words=combine_sum_words)
+                combine_sum_words=combine_sum_words, sink=sink)
         if replayed:
             # after _submit_*: the fresh report now exists in the ring
             self._account_replays(handle, replayed, 0.0)
@@ -1563,13 +1609,15 @@ class TpuShuffleManager:
     def _submit_local(self, handle: ShuffleHandle, timeout: float,
                       combine: Optional[str] = None,
                       ordered: bool = False,
-                      combine_sum_words: int = 0):
+                      combine_sum_words: int = 0,
+                      sink: Optional[str] = None):
         # the report exists from read START: a read that dies in the
         # metadata fetch must still be explainable from the postmortem
         rep = self._new_report(handle, distributed=False)
         try:
             return self._submit_local_staged(
-                handle, timeout, combine, ordered, combine_sum_words, rep)
+                handle, timeout, combine, ordered, combine_sum_words, rep,
+                sink=sink)
         except BaseException as e:
             rep.error = rep.error or repr(e)[:300]
             # a read that dies before arming never reaches on_done — the
@@ -1579,8 +1627,12 @@ class TpuShuffleManager:
 
     def _submit_local_staged(self, handle: ShuffleHandle, timeout: float,
                              combine: Optional[str], ordered: bool,
-                             combine_sum_words: int, rep: ExchangeReport):
+                             combine_sum_words: int, rep: ExchangeReport,
+                             sink: Optional[str] = None):
         tracer = self.node.tracer
+        sink = self._resolve_sink(sink, combine, ordered,
+                                  distributed=False)
+        rep.sink = sink
         if not handle.entry.wait_complete(timeout):
             raise TimeoutError(
                 f"shuffle {handle.shuffle_id}: only "
@@ -1653,7 +1705,7 @@ class TpuShuffleManager:
             rep.plan_ms = (time.perf_counter() - t_plan) * 1e3
             plan = self._decorated_plan(plan, combine, ordered, has_vals,
                                         val_tail, val_dtype,
-                                        combine_sum_words)
+                                        combine_sum_words, sink=sink)
 
             # fuse key+value bytes into one int32 row matrix (bit views, no
             # value casts — jnp would silently truncate int64 with x64 off)
@@ -1904,12 +1956,22 @@ class TpuShuffleManager:
 
         def on_done(result):
             self.node.pool.put(stage_buf)
-            release_admitted()
+            if result is not None and \
+                    getattr(result, "sink", "host") == "device":
+                # HBM-residency admission: a device-sink result's
+                # receive buffers stay resident until the consumer takes
+                # them, so the reservation releases at consume()/close()
+                # — not here, where the host path's drain frees them
+                result._release_hbm = release_admitted
+            else:
+                release_admitted()
             if result is not None:
                 if hasattr(result, "fetch_granularity"):
                     # lazy results honor io.fetchGranularity (per-block
                     # device-sliced D2H vs whole-shard pulls)
                     result.fetch_granularity = self.conf.fetch_granularity
+                if report is not None:
+                    self._arm_d2h(result, report)
                 self._learn_cap(handle, result, global_rows)
                 self.node.metrics.inc("shuffle.rows", float(local_rows))
                 self.node.metrics.inc("shuffle.bytes",
@@ -1984,6 +2046,27 @@ class TpuShuffleManager:
 
         return on_done, arm
 
+    def _arm_d2h(self, result, rep: ExchangeReport) -> None:
+        """Join a result's device-to-host payload pulls onto its report:
+        lazy results drain AFTER completion (on consumer touch), so
+        ``d2h_bytes`` keeps accruing on the live report — the per-read
+        face of the cumulative ``shuffle.read.d2h.bytes`` counter. Pulls
+        that happened before arming (the distributed force-materialize)
+        flush from ``_d2h_early``. A device-sink result arms its inner
+        wave views too, so an explicit ``host_view()`` drain is charged
+        to the read that produced it."""
+        def cb(n, _rep=rep):
+            _rep.d2h_bytes += int(n)
+        early = getattr(result, "_d2h_early", 0)
+        if early:
+            result._d2h_early = 0
+            cb(early)
+        result._d2h_cb = cb
+        wv = getattr(result, "wave_views", None)
+        if wv is not None:
+            for v in wv():
+                v._d2h_cb = cb
+
     # -- capacity learning -------------------------------------------------
     def _resolve_wire(self, plan: ShufflePlan, has_vals: bool, val_tail,
                       val_dtype) -> tuple:
@@ -2019,9 +2102,68 @@ class TpuShuffleManager:
             return "raw", 0
         return "int8", value_words(val_tail, val_dtype)
 
+    def _warn_sink_once(self, key: str, msg: str) -> None:
+        if key not in self._warned_sink:
+            self._warned_sink.add(key)
+            log.warning(msg)
+
+    def _resolve_sink(self, requested: Optional[str],
+                      combine: Optional[str] = None, ordered: bool = False,
+                      distributed: bool = False) -> str:
+        """Resolve the read's landing tier from the per-read ask and the
+        ``read.sink`` conf — the _resolve_wire discipline: the report's
+        ``sink`` field names the tier that RAN, never the ask. Pure
+        conf/argument facts, identical on every process (collective
+        reads pass the same arguments by the SPMD contract), so the
+        branch decision needs no collective.
+
+        ``auto`` (conf default) = host unless the consumer declared a
+        device sink for this read; ``device`` makes device the default
+        ask; ``host`` pins the historical drain. A device ask falls back
+        to host — warn-once, naming the reason — where the result
+        cannot stay resident: distributed reads (the partial view
+        force-materializes local shards), the hierarchical two-stage
+        exchange, and combine/ordered reads (cross-run merges are
+        host-side)."""
+        from sparkucx_tpu.shuffle.alltoall import validate_sink
+        if requested is not None:
+            validate_sink(requested, conf_key="read(sink=...)")
+            if requested == "auto":
+                requested = None
+        conf = self.conf.read_sink
+        want = requested
+        if want is None:
+            want = "device" if conf == "device" else "host"
+        elif want == "device" and conf == "host":
+            self._warn_sink_once(
+                "conf_pins_host",
+                "read(sink='device') under spark.shuffle.tpu.read.sink="
+                "host — the conf pins the host drain; set read.sink=auto "
+                "(or device) to honor per-read device sinks")
+            want = "host"
+        if want != "device":
+            return "host"
+        reason = None
+        if distributed:
+            reason = ("distributed reads force-materialize their local "
+                      "shards (the device sink is single-process for now)")
+        elif self.hierarchical:
+            reason = "the hierarchical two-stage exchange drains host-side"
+        elif combine or ordered:
+            reason = ("combine/ordered results merge runs host-side "
+                      "(cross-wave/cross-sender key merges)")
+        if reason is not None:
+            self._warn_sink_once(
+                "fallback_" + reason[:24],
+                f"read.sink=device resolves to host for this read: "
+                f"{reason}")
+            return "host"
+        return "device"
+
     def _decorated_plan(self, plan: ShufflePlan, combine, ordered: bool,
                         has_vals: bool, val_tail, val_dtype,
-                        combine_sum_words: int = 0) -> ShufflePlan:
+                        combine_sum_words: int = 0,
+                        sink: str = "host") -> ShufflePlan:
         """Validate and stamp the combine/ordered read options AND the
         resolved wire tier onto a plan (shared by the single- and
         multi-process read paths, and warmup — so a warmed program and
@@ -2034,7 +2176,17 @@ class TpuShuffleManager:
         wire, wire_words = self._resolve_wire(plan, has_vals, val_tail,
                                               val_dtype)
         plan = dataclasses.replace(plan, wire=wire,
-                                   wire_words=wire_words)
+                                   wire_words=wire_words, sink=sink)
+        if sink == "device" and wire == "lossless":
+            # the lossless codec is a host-drain-path tier by contract;
+            # a device sink never drains, so it cannot engage — the
+            # plan keeps the stamp (program family) but the report will
+            # show lossless_bytes=0
+            self._warn_sink_once(
+                "lossless_device",
+                "a2a.wire=lossless with a device sink: the codec is "
+                "host-only (it rides the drain path) and will not run — "
+                "device-sink reads report lossless_bytes=0")
         if combine:
             from sparkucx_tpu.ops.aggregate import check_combinable
             check_combinable(val_tail if has_vals else None,
@@ -2075,9 +2227,13 @@ class TpuShuffleManager:
         # the hint-derived capacity is quantized by the SAME bucket
         # ladder as make_plan's, or learned hints would mint one fresh
         # compiled-step signature per observed skew factor — exactly the
-        # shape churn a2a.capBuckets exists to collapse
+        # shape churn a2a.capBuckets exists to collapse. The epsilon
+        # matters: a ratchet factor stored as used/balanced reproduces
+        # `used` with float noise (448 * (448/200)/448 = 448.000...06),
+        # and a bare ceil would climb one rung — and compile one fresh
+        # program — per same-shape read forever
         hint = bucket_cap_conf(
-            int(np.ceil(balanced * factor / 8.0)) * 8, self.conf)
+            int(np.ceil(balanced * factor / 8.0 - 1e-6)) * 8, self.conf)
         if hint > plan.cap_out:
             log.debug("seeding cap_out=%d from learned skew factor %.2f "
                       "(plan computed %d)", hint, factor, plan.cap_out)
@@ -2349,9 +2505,15 @@ class TpuShuffleManager:
         block_bytes = len(slot_outputs) * wplan.cap_in * width * 4
         device_wave = (wplan.cap_in + wplan.cap_out) * width * 4 \
             * wplan.num_shards
+        # Device sink: waves are NOT drained — every wave's receive
+        # buffer stays HBM-resident until the consumer folds it, so the
+        # reservation accounts ALL waves' device buffers (HBM residency),
+        # not the depth-bounded pipeline window the host drain earns.
+        # _make_admitter adds one wave's device term itself.
+        hbm_waves = num_waves if wplan.sink == "device" else depth
         admit, release_admitted = self._make_admitter(
             wplan, width,
-            depth * block_bytes + (depth - 1) * device_wave,
+            depth * block_bytes + (hbm_waves - 1) * device_wave,
             None if distributed else timeout)
         local_rows = sum(int(k.shape[0])
                          for outs in slot_outputs for k, _ in outs)
@@ -2379,7 +2541,12 @@ class TpuShuffleManager:
     def _submit_distributed(self, handle: ShuffleHandle, timeout: float,
                             combine: Optional[str] = None,
                             ordered: bool = False,
-                            combine_sum_words: int = 0):
+                            combine_sum_words: int = 0,
+                            sink: Optional[str] = None):
+        # the device sink is single-process for now: resolve (and
+        # warn-once) HERE, identically on every process — pure
+        # argument/conf facts, no collective needed
+        self._resolve_sink(sink, combine, ordered, distributed=True)
         rep = self._new_report(handle, distributed=True)
         try:
             return self._submit_distributed_impl(
@@ -3019,9 +3186,23 @@ class PendingWaveShuffle:
             raise
         finally:
             self._finish_guard()
-        self._release_admitted()
-        res = WavedShuffleReaderResult(wave_results, self._outer_plan,
-                                       self._val_tail, self._val_dtype)
+        if self._outer_plan.sink == "device":
+            # per-wave device views chained into the consumer: unwrap
+            # each wave's single-view device result into ONE outer
+            # device result whose consume() folds wave order. The
+            # admission reservation (HBM residency: every undrained
+            # wave's receive buffer) rides the outer result and releases
+            # at consume()/close().
+            from sparkucx_tpu.shuffle.reader import \
+                DeviceShuffleReaderResult
+            views = [w.wave_views()[0] for w in wave_results]
+            res = DeviceShuffleReaderResult(
+                views, self._outer_plan, self._val_tail, self._val_dtype)
+            res._release_hbm = self._release_admitted
+        else:
+            self._release_admitted()
+            res = WavedShuffleReaderResult(wave_results, self._outer_plan,
+                                           self._val_tail, self._val_dtype)
         self._finalize(res, timeline, retries_total, pack_total,
                        pack_hidden, dispatch_total)
         # integrity.verify=full: the host-drained wave blocks verify
@@ -3072,8 +3253,15 @@ class PendingWaveShuffle:
         res = pending.result()
         wait_ms = (time.perf_counter() - t0) * 1e3
         self._last_step = getattr(pending, "_step", None)
-        drain_wave_result(res)
-        if self._wave_plan.wire == "lossless" \
+        # charge this wave's d2h to the read's report (zero on the
+        # device sink unless host_view later forces a drain)
+        self._mgr._arm_d2h(res, self._rep)
+        if self._outer_plan.sink != "device":
+            drain_wave_result(res)
+        # device sink: the wave stays HBM-resident — no D2H drain; the
+        # consumer folds the per-wave views after result()
+        if self._outer_plan.sink != "device" \
+                and self._wave_plan.wire == "lossless" \
                 and hasattr(res, "compress_host_blocks"):
             # the lossless tier's home: the wave is host-bound NOW and
             # may wait behind depth-1 others — re-encode its blocks

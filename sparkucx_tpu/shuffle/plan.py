@@ -95,6 +95,16 @@ class ShufflePlan:
     # float32 value lanes the int8 wire narrows (= value_words for an
     # f32 schema); 0 on every other tier.
     wire_words: int = 0
+    # Read-sink tier (read.sink, alltoall.ALLOWED_SINKS minus 'auto' —
+    # the manager resolves per read): 'host' drains results D2H, 'device'
+    # keeps partitions as sharded jax Arrays handed straight to a
+    # consumer step (reader.DeviceShuffleReaderResult). Like 'lossless'
+    # on the wire axis, the compiled step body is sink-oblivious — the
+    # field still keys the program family so a host and a device read of
+    # one shape never collide on a step (the consumer donates the device
+    # read's output buffers; sharing the executable across sinks would
+    # let a donated-buffer alias bleed into the host path's result).
+    sink: str = "host"
     # Wave-pipelined exchange (a2a.waveRows, shuffle/manager.py): the
     # OUTER descriptive plan of a waved read carries the wave split here
     # — rows per shard per wave and the agreed wave count. The plan each
@@ -126,7 +136,8 @@ class ShufflePlan:
                 self.sort_strips, self.combine, self.combine_words,
                 self.combine_dtype, self.combine_sum_words,
                 self.combine_compaction, self.ordered, self.bounds,
-                self.pallas_interpret, self.wire, self.wire_words)
+                self.pallas_interpret, self.wire, self.wire_words,
+                self.sink)
 
     def strips_active(self) -> bool:
         """True when the single-shard strip-sorted plain path runs —

@@ -49,10 +49,30 @@ from sparkucx_tpu.shuffle.alltoall import (ragged_shuffle, wire_pack_rows,
 from sparkucx_tpu.shuffle.plan import (ShufflePlan, plan_takes_seed,
                                        wire_row_words)
 from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.metrics import C_D2H, GLOBAL_METRICS
 
 log = get_logger("shuffle.reader")
 
 KEY_WORDS = 2  # int64 key as two int32 columns [lo, hi]
+
+
+def _note_d2h(res, nbytes: int) -> None:
+    """Account one device-to-host payload pull by a reader result: the
+    cumulative ``shuffle.read.d2h.bytes`` counter (the figure the device
+    sink drives to ZERO — bench --stage devread gates it) plus the
+    owning read's ExchangeReport when the manager armed the callback
+    (``_d2h_cb``, set at on_done). Pulls that happen BEFORE arming (the
+    distributed force-materialize runs inside result()) park in
+    ``_d2h_early`` for the manager to flush. Payload only — tiny seg
+    matrices are metadata and deliberately excluded."""
+    if nbytes <= 0:
+        return
+    GLOBAL_METRICS.inc(C_D2H, float(nbytes))
+    cb = getattr(res, "_d2h_cb", None)
+    if cb is not None:
+        cb(int(nbytes))
+    else:
+        res._d2h_early = getattr(res, "_d2h_early", 0) + int(nbytes)
 
 
 @functools.lru_cache(maxsize=32)
@@ -743,6 +763,17 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
         self.cap_out_used: Optional[int] = cap_out
         self.recv_rows_needed: Optional[int] = None
         self.fetch_granularity: str = "shard"
+        # per-shard delivered totals, ON DEVICE (the step's [P] output):
+        # the device sink's consumer-side valid-row count — attached by
+        # the pending handle so the device view never pulls the seg
+        # matrix host-side just to learn occupancy
+        self._totals_dev = None
+        # fired exactly once when the device row buffers are DROPPED
+        # (every shard host-cached / every partition fetched) — the
+        # device sink's host_view() escape hatch hangs its HBM-residency
+        # admission release here, so a fully drained view stops charging
+        # a2a.maxBytesInFlight for memory that is already free
+        self._on_device_free = None
         self._part_cache: dict = {}        # r -> np [n, width] block
         # ONE result may be shared by concurrent readers (compat/v2
         # caches it per shuffle): the lazy fetch paths flip _seg_dev /
@@ -793,12 +824,42 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
                 if dev is None:
                     raise KeyError(f"shard {shard} not addressable here")
                 got = np.asarray(dev)
+                _note_d2h(self, got.nbytes)
                 self._shards[shard] = got
                 if len(self._shards) == self._num_shards:
                     # every shard is host-side; drop the device buffers
                     # so the HBM is free for the next shuffle's exchange
                     self._rows_dev = None
+                    self._fire_device_free()
             return got
+
+    def _fire_device_free(self) -> None:
+        cb, self._on_device_free = self._on_device_free, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def with_rows(self, rows_dev) -> "LazyShuffleReaderResult":
+        """A fresh lazy view over REPLACEMENT device rows sharing this
+        view's seg/layout metadata — the after-consume verification seam
+        of the device sink: a consumer step that passes the rows through
+        (donation notwithstanding) hands its output here, and reading it
+        back through the same run arithmetic proves the handoff moved
+        bits, not garbage (test_fuzz_e2e's device-sink leg)."""
+        with self._fetch_lock:
+            out = LazyShuffleReaderResult(
+                self.num_partitions, self._part_to_shard, rows_dev,
+                self._seg_dev, self._num_shards,
+                rows_dev.shape[0] // self._num_shards,
+                self._val_shape, self._val_dtype,
+                per_shard_segs=self._per_shard_segs,
+                align_chunk=self._align_chunk)
+            if self._seg_dev is None:
+                # seg already host-materialized here: share the matrix
+                out._seg = self._seg
+        return out
 
     def compress_host_blocks(self, executor=None):
         """``a2a.wire=lossless``: re-encode every host-materialized
@@ -949,7 +1010,9 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
                 start = min(s, cap - bucket)
                 sl = _jax.lax.dynamic_slice_in_dim(dev, start, bucket,
                                                    axis=0)
-                blocks.append(np.asarray(sl)[s - start:s - start + n])
+                host = np.asarray(sl)
+                _note_d2h(self, host.nbytes)
+                blocks.append(host[s - start:s - start + n])
             block = blocks[0] if len(blocks) == 1 \
                 else np.concatenate(blocks)
         self._part_cache[r] = block
@@ -957,6 +1020,7 @@ class LazyShuffleReaderResult(ShuffleReaderResult):
             # every partition is host-side (cached blocks) — drop the
             # device buffers, same HBM-release point as shard mode
             self._rows_dev = None
+            self._fire_device_free()
         return block
 
 
@@ -1119,6 +1183,189 @@ class WavedShuffleReaderResult(ShuffleReaderResult):
             block = _concat_blocks(blocks)
         self._block_cache[r] = block
         return block
+
+
+class DeviceShuffleReaderResult:
+    """Device-resident result of one exchange (``read.sink=device``) —
+    the read path with the host round-trip deleted.
+
+    Partitions never leave HBM: each wave's receive buffer stays the
+    sharded jax Array the compiled step produced (single-shot reads are
+    one wave), and :meth:`consume` chains them into a consumer step —
+    this result drops its OWN references to a wave's buffers before the
+    handoff, so a consumer jitted with ``donate_argnums`` may alias them
+    in place. Zero D2H by construction: ``shuffle.read.d2h.bytes`` does
+    not move (bench --stage devread gates the delta at 0), where the
+    host path pays a full drain plus the consumer's re-upload.
+
+    The admission reservation of the exchange (HBM residency — the
+    receive buffers live until the consumer takes them, unlike the host
+    path whose on_done frees them at drain) is released when the result
+    is consumed or closed (``_release_hbm``, armed by the manager).
+
+    ``host_view()`` is the escape hatch back to the numpy partition
+    contract: over the live buffers it COUNTS the d2h it forces; over
+    consumer-returned row arrays (``wave_rows=...``) it is the
+    after-consume verification seam."""
+
+    sink = "device"
+
+    def __init__(self, views, plan: ShufflePlan, val_shape, val_dtype):
+        if not views:
+            raise ValueError("device result needs at least one wave view")
+        self._views: Optional[list] = list(views)
+        self._plan = plan
+        self._val_shape = val_shape
+        self._val_dtype = val_dtype
+        self.num_partitions = plan.num_partitions
+        self.waves = len(views)
+        self.consumed = False
+        # manager-armed: admission release (HBM residency accounting)
+        self._release_hbm = None
+        # capacity-learning contract (manager._learn_cap): the plan
+        # capacity, like the lazy result; the true requirement is not
+        # observed — reading the seg matrix host-side would be the very
+        # metadata pull this sink exists to avoid paying per read
+        self.cap_out_used: Optional[int] = plan.cap_out if self.waves == 1 \
+            else None
+        self.recv_rows_needed: Optional[int] = None
+
+    def is_local(self, r: int) -> bool:
+        return self._views[0].is_local(r) if self._views else True
+
+    def wave_views(self):
+        """The per-wave device-holding views, wave order (metadata
+        handles — the buffers themselves are reachable via
+        ``device_rows``/``device_totals`` until consumed)."""
+        return list(self._views or [])
+
+    def _live_views(self) -> list:
+        if self.consumed or self._views is None:
+            raise RuntimeError(
+                "device result already consumed/closed: its buffers were "
+                "handed to the consumer step (donation) — re-read the "
+                "shuffle, or keep the consumer's outputs")
+        return self._views
+
+    def device_rows(self, wave: int = 0):
+        """Wave ``wave``'s receive buffer: [P*cap_shard, width] int32,
+        sharded over the exchange axis. Rows are the packed transport
+        format (keys + bit-cast value lanes) — consumers decode on
+        device (jax.lax.bitcast_convert_type), see models/moe.py."""
+        return self._live_views()[wave]._rows_dev
+
+    def device_totals(self, wave: int = 0):
+        """Wave ``wave``'s per-shard delivered row counts: [P] int32,
+        sharded like the rows — the consumer-side valid-row bound."""
+        return self._live_views()[wave]._totals_dev
+
+    def consume(self, fn, carry=None):
+        """Chain the consumer step over the per-wave device buffers:
+        ``carry = fn(carry, rows, totals)`` per wave, wave order. Before
+        each call this result DROPS its references to that wave's
+        buffers, so a consumer jitted with ``donate_argnums`` on the
+        rows argument aliases the HBM in place. After the last wave the
+        admission reservation is released. Returns the final carry."""
+        views = self._live_views()
+        try:
+            for v in views:
+                with v._fetch_lock:
+                    rows, totals = v._rows_dev, v._totals_dev
+                    v._rows_dev = None
+                    v._totals_dev = None
+                if rows is None:
+                    raise RuntimeError(
+                        "device wave buffers already taken — consume() "
+                        "ran concurrently or device_rows escaped")
+                carry = fn(carry, rows, totals)
+                del rows, totals
+        except BaseException:
+            # a consumer that dies mid-fold must not leave the REMAINING
+            # waves' receive buffers pinned while the finally below
+            # frees their admission reservation — drop the views so the
+            # HBM goes with the budget (the close() discipline)
+            self._views = None
+            raise
+        finally:
+            self.consumed = True
+            self._fire_release()
+        return carry
+
+    def host_view(self, wave_rows=None):
+        """A HOST-readable result (the numpy ``partition(r)`` contract).
+
+        Without arguments: over the LIVE device buffers — forces (and
+        counts, ``shuffle.read.d2h.bytes``) the drain the device sink
+        deferred; invalid after :meth:`consume`. With ``wave_rows`` (one
+        array per wave, shaped like ``device_rows``): over
+        consumer-returned buffers — the after-consume verification path,
+        valid any time."""
+        if wave_rows is None:
+            views = list(self._live_views())
+            # the escape hatch transfers the HBM-residency admission
+            # release to the DRAIN itself: once every view's device
+            # buffers drop (all shards host-side), the reservation
+            # frees — a fully drained device result must not keep
+            # charging a2a.maxBytesInFlight for memory that is free
+            remaining = [len(views)]
+            lock = threading.Lock()
+
+            def one_freed():
+                with lock:
+                    remaining[0] -= 1
+                    done = remaining[0] == 0
+                if done:
+                    self._fire_release()
+            for v in views:
+                v._on_device_free = one_freed
+        else:
+            base = self._views or []
+            if len(wave_rows) != len(base):
+                raise ValueError(
+                    f"wave_rows has {len(wave_rows)} entries for "
+                    f"{len(base)} waves")
+            views = [v.with_rows(r) for v, r in zip(base, wave_rows)]
+        if len(views) == 1:
+            return views[0]
+        return WavedShuffleReaderResult(views, self._plan,
+                                        self._val_shape, self._val_dtype)
+
+    def partition(self, r: int):
+        raise RuntimeError(
+            "device-sink results hold partitions in HBM — consume() them "
+            "into a jitted step, or host_view() for the numpy contract "
+            "(which re-pays the D2H this sink deletes); a numpy consumer "
+            "under conf read.sink=device should read(sink='host')")
+
+    # the numpy-iteration surface fails CLOSED with the same guidance —
+    # a host-contract consumer handed a device result by a conf-level
+    # read.sink=device must get the remediation, not an AttributeError
+    def partitions(self):
+        self.partition(0)
+
+    def partitions_ready(self, poll_s: float = 0.002):
+        self.partition(0)
+
+    def close(self) -> None:
+        """Drop the device buffers without consuming them (frees the HBM
+        and the admission reservation) — the abandon path."""
+        self.consumed = True
+        self._views = None
+        self._fire_release()
+
+    def _fire_release(self) -> None:
+        cb, self._release_hbm = self._release_hbm, None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self._fire_release()
+        except Exception:
+            pass
 
 
 class PendingExchangeBase:
@@ -1338,6 +1585,14 @@ class PendingShuffle(PendingExchangeBase):
         # inflated value would ratchet every same-shape pallas read into
         # a bigger plan (and a recompile) forever
         res.cap_out_used = self._plan.cap_out
+        res._totals_dev = total
+        if self._plan.sink == "device":
+            # device-resident sink: partitions stay the sharded arrays
+            # above — no drain, no seg pull (even the metadata read is
+            # deferred to an explicit host_view); the manager arms the
+            # HBM-residency release on the wrapper
+            return DeviceShuffleReaderResult(
+                [res], self._plan, self._val_shape, self._val_dtype)
         if not (self._plan.combine or self._plan.impl == "pallas"):
             # plain/ordered: the seg matrix carries true delivered counts
             # (combine's is post-merge; pallas consumes aligned slack) —

@@ -62,6 +62,13 @@ block_corrupt...  checksum verification caught corrupt     spark.shuffle.tpu.int
                   quarantine) — warn at one block,
                   critical past the corrupt-counter floor
                   or on any quarantine
+host_roundtrip    a device-sink-capable consumer ran a     spark.shuffle.tpu.read.sink
+                  compiled step over RE-UPLOADED bytes:
+                  host-sink reads drained payload D2H
+                  (report d2h_bytes, min-bytes floor)
+                  while the consumer pushed bytes back
+                  H2D (shuffle.consume.h2d.bytes) — the
+                  round-trip read.sink=device deletes
 ================  =======================================  =====================================
 
 The same :class:`Finding` schema carries ``bench.py --stage regress``
@@ -74,7 +81,7 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Union
 
-from sparkucx_tpu.utils.metrics import (C_INTEGRITY_CORRUPT,
+from sparkucx_tpu.utils.metrics import (C_D2H, C_H2D, C_INTEGRITY_CORRUPT,
                                         C_INTEGRITY_CORRUPT_BLOCKS,
                                         C_INTEGRITY_QUARANTINED,
                                         C_INTEGRITY_VERIFIED,
@@ -175,6 +182,16 @@ class Thresholds:
     dequant_warn_rel: float = 0.05
     dequant_critical_rel: float = 0.25
     dequant_min_payload_bytes: float = 1e6
+    # host_roundtrip: a device-sink-capable consumer (something pushed
+    # bytes BACK to device after a host drain — the h2d counter only
+    # moves when a consumer re-uploads) ran over host-sink reads that
+    # paid real payload D2H. The min-bytes floor keeps tiny test reads
+    # out (the PR-5 ratio+floor discipline); critical when the
+    # round-trip volume says the job is paying a PCIe/DMA tax on every
+    # exchange, or it repeats across several reads.
+    roundtrip_min_bytes: float = 1e6
+    roundtrip_critical_bytes: float = 64e6
+    roundtrip_critical_reads: int = 3
     # block_corruption: checksum verification (integrity.verify) caught
     # blocks whose bytes no longer match their commit records, or the
     # restart ledger quarantined blocks. ONE detected corruption is
@@ -942,12 +959,69 @@ def _rule_block_corruption(view: ClusterView,
                           if r.get("trace_id")}))]
 
 
+def _rule_host_roundtrip(view: ClusterView,
+                         th: Thresholds) -> List[Finding]:
+    """The consumer is on-device but the read path went through the
+    host: completed HOST-sink reads drained real payload bytes D2H
+    (``ExchangeReport.d2h_bytes``) while a consumer pushed bytes back up
+    (``shuffle.consume.h2d.bytes`` — the counter only moves when
+    something re-uploads after a drain, i.e. a device-sink-capable
+    consumer exists). That is the round-trip ``read.sink=device``
+    deletes: the engine downloaded what the consumer immediately
+    re-uploaded. Quiet without the h2d signal — a host-only pipeline
+    (arrow egress, numpy analytics) drains by design and gets no
+    finding for it."""
+    h2d = float(view.counters.get(C_H2D, 0.0))
+    if h2d <= 0:
+        return []
+    hosts = [r for r in _completed(view)
+             if r.get("sink", "host") != "device"
+             and float(r.get("d2h_bytes") or 0.0)
+             >= th.roundtrip_min_bytes]
+    if not hosts:
+        return []
+    d2h_total = sum(float(r.get("d2h_bytes") or 0.0) for r in hosts)
+    # the round-trip volume is what BOTH legs moved: bounded by the
+    # smaller side (a consumer may re-upload less than was drained)
+    roundtrip = min(d2h_total, h2d)
+    worst = max(hosts, key=lambda r: float(r.get("d2h_bytes") or 0.0))
+    grade = "critical" if (roundtrip >= th.roundtrip_critical_bytes
+                           or len(hosts) >= th.roundtrip_critical_reads) \
+        else "warn"
+    return [Finding(
+        rule="host_roundtrip",
+        grade=grade,
+        summary=(f"{len(hosts)} host-sink read(s) drained "
+                 f"{d2h_total / 1e6:.1f} MB device-to-host while the "
+                 f"consumer re-uploaded {h2d / 1e6:.1f} MB — the bytes "
+                 f"round-tripped through host memory between two "
+                 f"device residents"),
+        evidence={"host_sink_reads": len(hosts),
+                  "d2h_bytes": int(d2h_total),
+                  "h2d_bytes": int(h2d),
+                  "roundtrip_bytes": int(roundtrip),
+                  "worst_shuffle_id": worst.get("shuffle_id"),
+                  "worst_d2h_bytes": int(worst.get("d2h_bytes") or 0),
+                  "cumulative_d2h_bytes": int(
+                      view.counters.get(C_D2H, 0.0))},
+        conf_key="spark.shuffle.tpu.read.sink",
+        remediation=("read with a device sink so partitions stay "
+                     "sharded jax Arrays handed straight to the "
+                     "consumer step: spark.shuffle.tpu.read.sink=device "
+                     "(or per read, manager.read(sink='device') / "
+                     "DeviceShuffleReaderResult.consume) — d2h_bytes "
+                     "drops to 0 and the re-upload disappears; host "
+                     "sinks remain right for arrow/varlen egress and "
+                     "numpy consumers"),
+        trace_ids=[r.get("trace_id", "") for r in hosts[:4]])]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
           _rule_bw_underutilization, _rule_padding_waste,
           _rule_wire_dequant, _rule_peer_timeout, _rule_replay_storm,
-          _rule_block_corruption)
+          _rule_block_corruption, _rule_host_roundtrip)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

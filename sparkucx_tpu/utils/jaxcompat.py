@@ -22,6 +22,7 @@ import jax
 
 def install() -> None:
     """Idempotent; safe on any jax generation."""
+    _install_axis_size()
     if hasattr(jax, "shard_map"):
         return
     from jax.experimental.shard_map import shard_map as _shard_map
@@ -31,6 +32,22 @@ def install() -> None:
                           out_specs=out_specs, check_rep=check_vma, **kw)
 
     jax.shard_map = shard_map
+
+
+def _install_axis_size() -> None:
+    """``jax.lax.axis_size`` postdates the 0.4.x line this image bakes;
+    ``psum(1, axis_name)`` is the classic idiom it replaced and is
+    constant-folded to a static int under SPMD lowering, so callers that
+    build static structures from it (ring attention's permutation list,
+    the transformer's pipeline schedule) keep working. No-op on newer
+    jax."""
+    if hasattr(jax.lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size
 
 
 install()

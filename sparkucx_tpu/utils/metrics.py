@@ -93,6 +93,19 @@ C_INTEGRITY_CORRUPT_BLOCKS = "shuffle.integrity.corrupt.count"
 C_INTEGRITY_QUARANTINED = "shuffle.integrity.quarantined.count"
 C_INTEGRITY_RECOVERED = "shuffle.integrity.recovered.count"
 
+# Device-resident read plane (read.sink, shuffle/reader.py): ONE place
+# for the names so the reader's drain paths, the MoE host-staged
+# consumer, the doctor's host_roundtrip rule, and bench --stage devread
+# cannot drift. C_D2H counts PAYLOAD bytes pulled device-to-host by a
+# reader result (whole-shard drains, per-partition device slices, the
+# distributed force-materialize) — metadata (seg matrices) is excluded;
+# the device-sink acceptance gate is C_D2H delta == 0 across the
+# consumer loop. C_H2D counts bytes a consumer RE-UPLOADED to device
+# after a host drain (models/moe.host_staged_consume) — the round-trip
+# half the device sink deletes.
+C_D2H = "shuffle.read.d2h.bytes"
+C_H2D = "shuffle.consume.h2d.bytes"
+
 # Device-memory gauge families (runtime/devmon.py sampler; per local
 # device index, encoded as a label via :func:`labeled`): ONE place for
 # the names so the sampler, the doctor's hbm_pressure rule and the

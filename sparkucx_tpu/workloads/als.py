@@ -29,7 +29,7 @@ def _group_by_key(manager, shuffle_id, keys, payload, num_partitions,
                 w.write(np.ascontiguousarray(kchunks[m]),
                         np.ascontiguousarray(pchunks[m]))
             w.commit(num_partitions)
-        res = manager.read(h)
+        res = manager.read(h, sink="host")
         return [res.partition(r) for r in range(num_partitions)]
     finally:
         manager.unregister_shuffle(shuffle_id)

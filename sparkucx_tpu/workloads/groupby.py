@@ -34,7 +34,7 @@ def run_groupby(manager: TpuShuffleManager, *, num_mappers: int = 8,
             w.commit(num_partitions)
             expected_rows += pairs_per_mapper
             truth_keys.update(int(k) for k in keys)
-        res = manager.read(h)
+        res = manager.read(h, sink="host")
         distinct = set()
         rows = 0
         for r, (k, v) in res.partitions():

@@ -58,8 +58,8 @@ def run_join(manager: TpuShuffleManager, *, num_mappers: int = 4,
         sides[name] = (h, np.concatenate(all_k))
 
     try:
-        build_res = manager.read(sides["build"][0])
-        probe_res = manager.read(sides["probe"][0])
+        build_res = manager.read(sides["build"][0], sink="host")
+        probe_res = manager.read(sides["probe"][0], sink="host")
 
         # partition-local hash join + verification
         out_rows = 0
@@ -147,8 +147,8 @@ def run_join_varchar(manager: TpuShuffleManager, *, num_mappers: int = 4,
         sides[name] = (h, all_words)
 
     try:
-        build_res = manager.read(sides["build"][0])
-        probe_res = manager.read(sides["probe"][0])
+        build_res = manager.read(sides["build"][0], sink="host")
+        probe_res = manager.read(sides["probe"][0], sink="host")
 
         out_rows = 0
         for r in range(num_partitions):

@@ -58,7 +58,7 @@ def run_pagerank(manager: TpuShuffleManager, *, num_vertices: int = 64,
                 lo += chunk.size
                 w.commit(num_partitions)
             sums = np.zeros(num_vertices, dtype=np.float64)
-            res = manager.read(h, combine="sum")
+            res = manager.read(h, combine="sum", sink="host")
             for _, (ks, vs) in res.partitions():
                 if len(ks):
                     sums[ks] = vs[:, 0]

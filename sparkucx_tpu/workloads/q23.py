@@ -69,7 +69,7 @@ def run_q23(manager: TpuShuffleManager, *, num_mappers: int = 4,
         w.commit(num_partitions)
         store_sales.append(k)
     store_sales = np.concatenate(store_sales)
-    agg = manager.read(h1, combine="sum")
+    agg = manager.read(h1, combine="sum", sink="host")
 
     # per-partition frequent sets (the CTE result, partition-local)
     frequent_by_part = {}
@@ -94,7 +94,7 @@ def run_q23(manager: TpuShuffleManager, *, num_mappers: int = 4,
         probe_qty.append(q)
     probe_keys = np.concatenate(probe_keys)
     probe_qty = np.concatenate(probe_qty)[:, 0]
-    probe = manager.read(h2)
+    probe = manager.read(h2, sink="host")
 
     # ---- reduce: partition-local semi-join + grouped aggregation -------
     surviving_rows = 0

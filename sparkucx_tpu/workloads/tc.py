@@ -31,7 +31,7 @@ def _shuffle_pairs(manager: TpuShuffleManager, shuffle_id: int,
                 w.write(np.ascontiguousarray(chunk[:, key_col]),
                         np.ascontiguousarray(chunk))
             w.commit(num_partitions)
-        res = manager.read(h)
+        res = manager.read(h, sink="host")
         return [res.partition(r)[1] for r in range(num_partitions)]
     finally:
         manager.unregister_shuffle(shuffle_id)

@@ -57,7 +57,7 @@ def run_terasort(manager: TpuShuffleManager, *, num_mappers: int = 8,
                                   dtype=np.int64)
                 w.write(part, keys.reshape(-1, 1))
             w.commit(num_partitions)
-        res = manager.read(h, ordered=(mode == "range"))
+        res = manager.read(h, ordered=(mode == "range"), sink="host")
 
         out = []
         rows = 0

@@ -32,7 +32,7 @@ def run_wordcount(manager: TpuShuffleManager, *, num_mappers: int = 8,
             w.commit(num_partitions)
             for x in words:
                 truth[int(x)] = truth.get(int(x), 0) + 1
-        res = manager.read(h, combine="sum" if combine else None)
+        res = manager.read(h, combine="sum" if combine else None, sink="host")
         got: Dict[int, int] = {}
         for r, (k, v) in res.partitions():
             if combine and len(set(k.tolist())) != len(k):
@@ -90,7 +90,8 @@ def run_wordcount_text(manager: TpuShuffleManager, *, num_mappers: int = 4,
             w.write(keys, values)
             w.commit(num_partitions)
         res = manager.read(h, combine="sum" if combine else None,
-                           combine_sum_words=sum_words if combine else 0)
+                           combine_sum_words=sum_words if combine else 0,
+                           sink="host")
         got: Dict[str, int] = {}
         for r, (k, v) in res.partitions():
             if v is None or not k.shape[0]:

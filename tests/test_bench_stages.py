@@ -139,6 +139,30 @@ def test_pipeline_measure_small(mesh8):
     assert rec["speedup"] > 0
 
 
+def test_devread_measure_small(mesh8):
+    """The devread stage's measurement core at a tiny shape: the device
+    arm is zero-D2H with one compiled exchange and no warm recompiles,
+    the host arm pays the drain + re-upload (the host_roundtrip
+    evidence). The tokens/s comparison gate belongs to the bench stage
+    at the CI shape — a timing assertion at 1k tokens would couple the
+    suite to CI load noise."""
+    rec = bench.devread_measure(tokens=1024, d_model=16, experts=16,
+                                maps=4, reps=1)
+    dev, host = rec["device"], rec["host"]
+    assert dev["d2h_bytes_delta"] == 0
+    assert dev["report_sink"] == "device"
+    assert dev["report_d2h_bytes"] == 0
+    assert dev["programs_first_exchange"] <= 1
+    assert dev["programs_warm"] == 0
+    assert host["h2d_bytes_delta"] > 0
+    assert host["report_d2h_bytes"] > 0
+    assert host["report_sink"] == "host"
+    # identical params, identical staged tokens: the A/B arms must
+    # compute the SAME loss — the landing zone is the only difference
+    assert abs(dev["loss"] - host["loss"]) < 1e-5
+    assert rec["gates"]["device_d2h_zero"]
+
+
 def test_ragged_measure_small(mesh8):
     """The ragged stage's measurement core at a tiny shape: the dense arm
     measures skew-proportional padding, the ragged arm holds the
@@ -203,14 +227,22 @@ def test_chaos_measure_small(mesh8):
     assert rec["ok"] is True
     # dense x {single: 3 sites, waved: 4 sites} x {failfast, replay}
     # plus the wire-compressed int8 x waved x replay cell, plus the
-    # corrupt-site block (staged/spill x single/waved x both policies)
-    assert rec["cells_total"] == 23
+    # device-sink x replay cell (fault in the consumer-handoff window),
+    # plus the corrupt-site block (staged/spill x single/waved x both
+    # policies)
+    assert rec["cells_total"] == 24
     assert rec["cells_ok"] == rec["cells_total"]
     wire_cells = [c for c in rec["cells"] if c.get("wire") == "int8"]
     assert len(wire_cells) == 1
     wc = wire_cells[0]
     assert wc["outcome"] == "replayed" and wc["replays"] >= 1
     assert wc["wire_held"] and wc["family_stable"] and wc["bytes_ok"]
+    sink_cells = [c for c in rec["cells"] if c.get("sink") == "device"]
+    assert len(sink_cells) == 1
+    sc = sink_cells[0]
+    assert sc["outcome"] == "replayed" and sc["replays"] >= 1
+    assert sc["sink_held"] and sc["family_stable"]
+    assert sc["d2h_consumer_path"] == 0
     for c in rec["cells"]:
         assert c["hang_free"], c
         assert c["fault_fired"], c

@@ -66,6 +66,11 @@ def test_ulysses_attention_matches_reference(sp_mesh, causal):
     np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
 
 
+# slow-marked (tier-1 runs -m 'not slow'): newly alive under the
+# jaxcompat axis_size shim; the backward passes re-run the whole ring /
+# double all-to-all under lax.scan transpose on CPU SPMD (~10-17 s
+# each). The forward reference-match tests stay in tier-1.
+@pytest.mark.slow
 def test_ring_attention_grad(sp_mesh):
     q, k, v = _qkv(3)
 
@@ -82,6 +87,7 @@ def test_ring_attention_grad(sp_mesh):
                                    atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_ulysses_attention_grad(sp_mesh):
     q, k, v = _qkv(4)
 

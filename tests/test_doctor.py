@@ -647,6 +647,74 @@ def test_replay_storm_quiet_on_single_absorbed_blip():
     assert diagnose(doc) == []
 
 
+# -- host_roundtrip (read.sink) --------------------------------------------
+def _roundtrip_report(sid=13, trace="s13.e0.x13", d2h_mb=4.0,
+                      sink="host"):
+    r = _report(sid=sid, trace=trace)
+    r["sink"] = sink
+    r["d2h_bytes"] = int(d2h_mb * 1e6)
+    return r
+
+
+def test_host_roundtrip_fires_on_reuploaded_drain():
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_roundtrip_report())
+    doc["counters"]["shuffle.read.d2h.bytes"] = 4e6
+    doc["counters"]["shuffle.consume.h2d.bytes"] = 4e6
+    fs = [f for f in diagnose(doc) if f.rule == "host_roundtrip"]
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.conf_key == "spark.shuffle.tpu.read.sink"
+    assert f.evidence["roundtrip_bytes"] == int(4e6)
+    assert f.evidence["worst_shuffle_id"] == 13
+    assert "s13.e0.x13" in f.trace_ids
+
+
+def test_host_roundtrip_critical_goldens():
+    # (a) volume: one read round-tripping past the critical byte floor
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_roundtrip_report(d2h_mb=128.0))
+    doc["counters"]["shuffle.consume.h2d.bytes"] = 128e6
+    fs = [f for f in diagnose(doc) if f.rule == "host_roundtrip"]
+    assert fs and fs[0].grade == "critical"
+    # (b) repetition: several reads each paying the tax
+    doc = _healthy_doc()
+    for i in range(3):
+        doc["exchange_reports"].append(
+            _roundtrip_report(sid=20 + i, trace=f"s{20 + i}.e0.x1"))
+    doc["counters"]["shuffle.consume.h2d.bytes"] = 12e6
+    fs = [f for f in diagnose(doc) if f.rule == "host_roundtrip"]
+    assert fs and fs[0].grade == "critical"
+    assert fs[0].evidence["host_sink_reads"] == 3
+
+
+def test_host_roundtrip_quiet_goldens():
+    # device-sink read: d2h 0 on the report, no h2d — the fixed state
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(
+        _roundtrip_report(d2h_mb=0.0, sink="device"))
+    assert [f for f in diagnose(doc)
+            if f.rule == "host_roundtrip"] == []
+    # host-only consumer: big drains but NOTHING re-uploaded — draining
+    # is what host sinks are FOR (arrow egress, numpy analytics)
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_roundtrip_report(d2h_mb=256.0))
+    doc["counters"]["shuffle.read.d2h.bytes"] = 256e6
+    assert [f for f in diagnose(doc)
+            if f.rule == "host_roundtrip"] == []
+
+
+def test_host_roundtrip_sub_noise_floor():
+    # h2d present but every host read drained below the min-bytes floor
+    # — tiny test exchanges, not a round-trip tax
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_roundtrip_report(d2h_mb=0.1))
+    doc["counters"]["shuffle.consume.h2d.bytes"] = 1e5
+    assert [f for f in diagnose(doc)
+            if f.rule == "host_roundtrip"] == []
+
+
 def test_gauges_attribute_per_process_in_cluster_view():
     """build_view keeps gauges per process (point-in-time values must
     attribute, never sum) and hbm_pressure names the pressed process."""

@@ -592,3 +592,106 @@ def test_replayed_exchange_bytes_match_oracle(replay_managers, waved,
     finally:
         m.node.faults.disarm(site)
         m.unregister_shuffle(sid)
+
+
+# -- device-sink sweep (ISSUE-10) -------------------------------------------
+# read.sink=device across (impl x wire x single/waved x skew) vs the host
+# oracle, verified by materializing the device result AFTER the consumer
+# step consumed it: the consumer is a donating pass-through (the rows
+# buffer is donated to the jit, the standard device-sink handoff), and
+# host_view(wave_rows=outputs) reads the CONSUMER's buffers back through
+# the same run arithmetic — proving donation moved bits, not garbage.
+# Raw is bit-exact; int8 is bounded by one rounding step per row (the
+# wire-sweep contract). The consumer path itself must be zero-D2H.
+@pytest.fixture(scope="module")
+def sink_managers(manager):
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    cache = {}
+
+    def get(wire, impl, waved):
+        key = (wire, impl, waved)
+        if key not in cache:
+            cmap = {"spark.shuffle.tpu.a2a.impl": impl,
+                    "spark.shuffle.tpu.a2a.wire": wire}
+            if waved:
+                cmap["spark.shuffle.tpu.a2a.waveRows"] = "48"
+            conf = TpuShuffleConf(cmap, use_env=False)
+            cache[key] = TpuShuffleManager(manager.node, conf)
+        return cache[key]
+
+    yield get
+    for m in cache.values():
+        m.stop()
+
+
+@pytest.mark.parametrize("skew", SKEW_LEVELS)
+@pytest.mark.parametrize("waved", (False, True), ids=("single", "waved"))
+@pytest.mark.parametrize("impl", ("dense", "gather"))
+@pytest.mark.parametrize("wire", ("raw", "int8"))
+def test_device_sink_sweep_vs_oracle(sink_managers, wire, impl, waved,
+                                     skew):
+    import jax
+
+    from sparkucx_tpu.shuffle.reader import DeviceShuffleReaderResult
+    from sparkucx_tpu.utils.metrics import C_D2H, GLOBAL_METRICS
+    if impl == "gather" and (skew != "uniform" or waved):
+        pytest.skip("gather is the cross-impl oracle transport — the "
+                    "full skew ladder and the waved composition ride "
+                    "dense (the wire-sweep compile-budget discipline)")
+    if wire == "int8" and skew == "onehot":
+        pytest.skip("int8 x one-hot lands a fresh cap bucket per leg "
+                    "(a compile) without adding device-sink coverage — "
+                    "the wire sweep already pins int8 under one-hot")
+    m = sink_managers(wire, impl, waved)
+    seed = (SKEW_LEVELS.index(skew) * 100 + int(waved) * 10
+            + (0 if impl == "dense" else 1) + (0 if wire == "raw" else 5))
+    rng = np.random.default_rng(95_000 + seed)
+    M, R, n = 4, 16, 250
+    sid = 95_000 + seed
+    h = m.register_shuffle(sid, M, R)
+    try:
+        total = 0
+        for mid in range(M):
+            k = _skewed_keys(rng, skew, n)
+            w = m.get_writer(h, mid)
+            w.write(k, _wire_values(k))
+            w.commit(R)
+            total += n
+        # host oracle first: same staged state, the numpy contract
+        oracle = {r: np.sort(ks)
+                  for r, (ks, _vs) in m.read(h, sink="host").partitions()}
+        d0 = GLOBAL_METRICS.get(C_D2H)
+        res = m.read(h, sink="device")
+        assert isinstance(res, DeviceShuffleReaderResult)
+        rep = m.report(sid)
+        assert rep.sink == "device"
+        assert rep.wire == wire
+        passthru = jax.jit(lambda rows, nv: rows, donate_argnums=(0,))
+        outs = res.consume(
+            lambda c, rows, nv: (c or []) + [passthru(rows, nv)])
+        jax.block_until_ready(outs)
+        assert GLOBAL_METRICS.get(C_D2H) - d0 == 0, \
+            "device consumer path must not pull payload D2H"
+        assert rep.d2h_bytes == 0
+        if waved and total > 48 * 8:
+            assert rep.waves >= 2, "sweep shape must actually wave"
+            assert len(outs) == rep.waves
+        # AFTER-consume materialization through the consumer's outputs
+        hv = res.host_view(wave_rows=outs)
+        nrows = 0
+        for r, (ks, vs) in hv.partitions():
+            nrows += len(ks)
+            assert np.array_equal(np.sort(ks), oracle[r]), \
+                f"partition {r} keys diverge from host oracle"
+            want = _wire_values(ks)
+            if wire == "raw":
+                assert np.array_equal(vs, want), f"partition {r}"
+            else:
+                step = np.abs(want).max(axis=1, keepdims=True) / 127.0 \
+                    + 1e-5
+                assert (np.abs(vs - want) <= step).all(), \
+                    f"partition {r}: worst {np.abs(vs - want).max()}"
+        assert nrows == total
+    finally:
+        m.unregister_shuffle(sid)

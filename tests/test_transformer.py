@@ -60,6 +60,12 @@ def test_make_mesh_factorization():
     assert sizes == {"dp": 1, "pp": 2, "sp": 2, "tp": 1, "ep": 2}
 
 
+# slow-marked (tier-1 runs -m 'not slow'): this family was dead-on-entry
+# under jax 0.4.37 until the jaxcompat axis_size shim — the full train
+# steps trace fwd+bwd through every parallel axis on CPU SPMD (~15-20 s
+# EACH here); the forward-correctness oracles below stay in tier-1 and
+# CI's full run still executes these
+@pytest.mark.slow
 def test_train_step_bf16_mixed_precision():
     """bf16 compute with f32 master params: the step runs, the loss is
     finite and decreases — the standard TPU mixed-precision recipe."""
@@ -79,6 +85,7 @@ def test_train_step_bf16_mixed_precision():
     assert params["wqkv"].dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_train_step_loss_decreases():
     mesh = make_mesh(8)
     init, step = make_train_step(mesh, CFG, lr=1e-2)
