@@ -69,12 +69,51 @@ from typing import Optional
 BASELINE_GBPS = 3.0
 METRIC = "shuffle_read_GBps_per_chip"
 
+# Backend preflight honesty (ROADMAP caveat: the TPU backend silently
+# never came up in bench rounds 3-5, so three rounds of "perf evidence"
+# were CPU numbers wearing a TPU run's context). Every artifact now
+# carries what was REQUESTED and what actually RESOLVED, and
+# --require-backend turns a silent fallback into exit code 2 — a CPU
+# fallback can never masquerade as a TPU number again.
+PREFLIGHT = {"requested_backend": None, "resolved_backend": None}
+
+
+def record_backend(requested, resolved) -> dict:
+    PREFLIGHT["requested_backend"] = str(requested)
+    PREFLIGHT["resolved_backend"] = str(resolved)
+    return dict(PREFLIGHT)
+
+
+def check_required_backend(required) -> bool:
+    """The --require-backend gate: the RESOLVED backend must equal the
+    required one. Called after init (ladder) or at stage dispatch (the
+    dedicated stages pin CPU by design, so --require-backend=tpu fails
+    them fast instead of letting a CPU artifact carry a TPU claim)."""
+    if not required:
+        return True
+    return PREFLIGHT["resolved_backend"] == required
+
+
+def emit_backend_refusal(required) -> None:
+    """One machine-parseable line naming the fallback, exit-2 shaped."""
+    print(json.dumps({
+        "metric": METRIC, "value": 0, "unit": "GB/s",
+        "error": "backend fallback refused by --require-backend",
+        "requested_backend": PREFLIGHT["requested_backend"],
+        "resolved_backend": PREFLIGHT["resolved_backend"],
+        "required_backend": str(required),
+    }), flush=True)
+
 
 def _write_artifact(path: str, out: dict) -> str:
     """Every bench artifact lands torn-write-proof (temp + fsync +
     atomic rename, utils/atomicio): these files are the committed CI
     regress baselines — a bench killed mid-write must not leave a
-    half-JSON under a baseline's name for the next diff to choke on."""
+    half-JSON under a baseline's name for the next diff to choke on.
+    The backend preflight stamp rides every artifact (setdefault: a
+    stage that resolved its own backend facts keeps them)."""
+    out.setdefault("requested_backend", PREFLIGHT["requested_backend"])
+    out.setdefault("resolved_backend", PREFLIGHT["resolved_backend"])
     from sparkucx_tpu.utils.atomicio import atomic_write_json
     return atomic_write_json(path, out, indent=1)
 
@@ -187,6 +226,8 @@ class StageMonitor:
                 "value": round(self.best_value, 3),
                 "unit": "GB/s",
                 "vs_baseline": round(self.best_value / BASELINE_GBPS, 3),
+                "requested_backend": PREFLIGHT["requested_backend"],
+                "resolved_backend": PREFLIGHT["resolved_backend"],
                 "detail": detail,
             }
         finally:
@@ -530,6 +571,7 @@ def stage_init(mon, platform, retry_window_s: Optional[int] = None):
         print(f"# compilation cache unavailable: {e}", file=sys.stderr,
               flush=True)
     devs = jax.devices()
+    record_backend(platform, jax.default_backend())
     mon.end("init", backend=jax.default_backend(), devices=len(devs))
     return jax, devs
 
@@ -3356,6 +3398,260 @@ def stage_regress(args) -> int:
     return 0
 
 
+def tenancy_measure(minnow_rows=1 << 13, whale_rows=1 << 13,
+                    minnows=8, minnow_rounds=3, whale_reads=40,
+                    val_words=4, whale_deadline_s=120.0):
+    """The multi-tenant isolation proof behind ``--stage tenancy``:
+    1 whale + ``minnows`` minnow shuffles sharing one mesh, three cells:
+
+    * ``solo``    — minnow tenant alone (async plane): the uncontended
+                    p99 baseline.
+    * ``fair``    — the whale (batch priority) floods ``whale_reads``
+                    exchanges into admission AHEAD of the minnows (high
+                    priority) under deficit-round-robin fair share: the
+                    GATE cell. Minnow p99 must hold <= 2x solo while
+                    the whale still completes within its deadline, and
+                    the quota_starvation doctor rule stays QUIET.
+    * ``starved`` — the deliberately mis-configured golden cell: fair
+                    share OFF (tenant.fairShare=false — the strict-FIFO
+                    admission the engine had before tenancy). The same
+                    whale flood now parks every minnow behind the whole
+                    whale queue; the quota_starvation rule must FIRE
+                    naming both tenants and the hog's quota key.
+
+    All cells run the async facade plane (read_async futures) — the
+    lifecycle a serving tier actually uses — on the dense CPU
+    transport, under a 1-byte ``a2a.maxBytesInFlight`` so EVERY
+    exchange defers through the admission queue and exactly one
+    collective is in flight at a time. That serialization is the honest
+    CPU posture twice over: the claim under test is grant ORDER (the
+    scheduling contract), not bandwidth, and XLA:CPU 0.4.x wedges
+    nondeterministically on concurrently-dispatched collective programs
+    (the documented multiprocess-CPU env-gap family — on a TPU backend
+    the same code path admits minnows beside the whale under a real
+    byte cap). Minnow latency is client-perceived: executor queue +
+    admission wait + exchange."""
+    import numpy as np
+    from sparkucx_tpu.service import connect
+
+    rng = np.random.default_rng(7)
+
+    def base_conf(extra=None):
+        conf = {
+            "spark.shuffle.tpu.a2a.impl": "dense",
+            "spark.shuffle.tpu.io.format": "raw",
+            # every future must hold a worker (they block in admission,
+            # not on CPU) or the shared executor would itself become a
+            # FIFO head-of-line queue in front of the admission plane
+            "spark.shuffle.tpu.tenant.asyncWorkers": "64",
+            "spark.shuffle.tpu.tenant.minnow.priority": "high",
+            "spark.shuffle.tpu.tenant.whale.priority": "batch",
+            # serialize collectives through admission (see docstring)
+            "spark.shuffle.tpu.a2a.maxBytesInFlight": "1",
+        }
+        conf.update(extra or {})
+        return conf
+
+    def stage_minnows(svc, base_sid):
+        handles = []
+        for i in range(minnows):
+            h = svc.register_shuffle(base_sid + i, 2, 8,
+                                     tenant="minnow")
+            for m in range(2):
+                keys = rng.integers(0, 1 << 20, minnow_rows,
+                                    dtype=np.int64)
+                vals = rng.random((minnow_rows, val_words),
+                                  dtype=np.float32)
+                svc.write(h, m, keys, vals)
+            handles.append(h)
+        return handles
+
+    def stage_whale(svc, sid):
+        h = svc.register_shuffle(sid, 4, 8, tenant="whale")
+        for m in range(4):
+            keys = rng.integers(0, 1 << 20, whale_rows, dtype=np.int64)
+            vals = rng.random((whale_rows, val_words), dtype=np.float32)
+            svc.write(h, m, keys, vals)
+        return h
+
+    def run_cell(name, conf_extra, with_whale):
+        svc = connect(base_conf(conf_extra), use_env=False)
+        try:
+            t_cell = time.perf_counter()
+            mhs = stage_minnows(svc, 100)
+            whale_h = stage_whale(svc, 99) if with_whale else None
+            # warm both program families OUTSIDE the timed window (the
+            # H_FETCH_FIRST discipline: compile-bearing reads must not
+            # pollute a latency distribution)
+            svc.read(mhs[0])
+            if whale_h is not None:
+                svc.read(whale_h)
+            whale_futs = []
+            t_whale0 = time.perf_counter()
+            if whale_h is not None:
+                # the whale floods its reads into admission FIRST — the
+                # head-of-line scenario the fair-share queue exists for
+                for _ in range(whale_reads):
+                    whale_futs.append(svc.read_async(whale_h))
+                # let the flood actually REACH the admission queue
+                # before the minnows arrive (workers race through
+                # staging): the scenario is a batch job already queued
+                # when interactive traffic lands, not a photo finish
+                time.sleep(0.1)
+            # minnows arrive in double-buffered rounds (a serving
+            # tier's sustained request loop: the next round is issued
+            # while the previous drains, so minnow traffic is always
+            # present) while the whale queue drains — or doesn't get
+            # the chance to, under fair share. A starved-cell minnow CAN
+            # legitimately exceed the deadline (that is the failure mode
+            # on display): a timeout grades the cell through the p99 (at
+            # the deadline) instead of crashing the measurement.
+            minnow_timeouts = 0
+
+            def drain(batch):
+                nonlocal minnow_timeouts
+                for f in batch:
+                    try:
+                        f.result(timeout=whale_deadline_s)
+                    except Exception:
+                        minnow_timeouts += 1
+
+            minnow_futs = []
+            prev = None
+            for _r in range(minnow_rounds):
+                batch = [svc.read_async(h) for h in mhs]
+                if prev is not None:
+                    drain(prev)
+                minnow_futs.extend(batch)
+                prev = batch
+            drain(prev)
+            whale_done = True
+            t_drain0 = time.perf_counter()
+            for f in whale_futs:
+                try:
+                    f.result(timeout=max(
+                        1.0, whale_deadline_s
+                        - (time.perf_counter() - t_drain0)))
+                except Exception:
+                    whale_done = False
+            # the whale's wall: flood submission -> last read resolved
+            # (NOT the cell wall — staging/warmup/minnow phases are
+            # recorded separately in cell_wall_s)
+            whale_wall_s = time.perf_counter() - t_whale0
+            # client-perceived latency: executor queue + admission +
+            # exchange (what a serving tier's caller waits); a timed-out
+            # minnow charges the full deadline
+            lat = [(f.queued_ms + f.wall_ms) if f.done()
+                   else whale_deadline_s * 1e3 for f in minnow_futs]
+            quota_findings = [
+                f.to_dict() for f in svc.doctor("findings")
+                if f.rule == "quota_starvation"]
+            stats = svc.stats("json")
+            per_tenant = {
+                k: v for k, v in stats.get("counters", {}).items()
+                if "tenant=" in k}
+            admit_p99 = {
+                k.split('tenant="')[1].rstrip('"}'):
+                    round(h.get("p99", 0.0), 1)
+                for k, h in stats.get("histograms", {}).items()
+                if k.startswith("shuffle.admit.wait_ms{tenant=")}
+            return {
+                "minnow_p50_ms": round(float(np.percentile(lat, 50)), 3),
+                "minnow_p99_ms": round(float(np.percentile(lat, 99)), 3),
+                "minnow_reads": len(lat),
+                "minnow_timeouts": minnow_timeouts,
+                "whale_reads": len(whale_futs),
+                "whale_completed": whale_done,
+                "whale_wall_s": round(whale_wall_s, 3),
+                "cell_wall_s": round(time.perf_counter() - t_cell, 2),
+                "admit_wait_p99_ms": admit_p99,
+                "quota_starvation_findings": quota_findings,
+                "per_tenant_counters": per_tenant,
+            }
+        finally:
+            svc.stop()
+
+    solo = run_cell("solo", {}, with_whale=False)
+    fair = run_cell("fair", {}, with_whale=True)
+    starved = run_cell("starved", {
+        # mis-configured on purpose: strict-FIFO admission — the
+        # head-of-line starvation the fair-share queue deletes
+        "spark.shuffle.tpu.tenant.fairShare": "false",
+    }, with_whale=True)
+
+    solo_p99 = solo["minnow_p99_ms"] or 1e-6
+    isolation = fair["minnow_p99_ms"] / solo_p99
+    checks = {
+        # THE isolation proof: contended minnow p99 within 2x solo
+        "minnow_isolation": isolation <= 2.0,
+        "whale_completes": fair["whale_completed"],
+        "whale_within_deadline":
+            fair["whale_wall_s"] <= whale_deadline_s,
+        # golden cells: the rule fires mis-configured, stays quiet fair
+        "starved_cell_fires":
+            len(starved["quota_starvation_findings"]) > 0,
+        "fair_cell_quiet":
+            len(fair["quota_starvation_findings"]) == 0,
+        # per-tenant accounting flowed: labeled counters exist per cell
+        "per_tenant_counters_present":
+            any("minnow" in k for k in fair["per_tenant_counters"])
+            and any("whale" in k for k in fair["per_tenant_counters"]),
+    }
+    return {
+        "shape": {"minnow_rows": minnow_rows, "whale_rows": whale_rows,
+                  "minnows": minnows, "minnow_rounds": minnow_rounds,
+                  "whale_reads": whale_reads, "val_words": val_words},
+        "solo": solo, "fair": fair, "starved": starved,
+        "isolation_ratio": round(isolation, 3),
+        "starved_vs_solo": round(
+            starved["minnow_p99_ms"] / solo_p99, 3),
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+
+
+def stage_tenancy(args) -> int:
+    """``--stage tenancy``: the multi-tenant service-plane gate — 1
+    whale + 8 minnows through the async facade plane, minnow p99 under
+    fair-share contention <= 2x its solo baseline, whale completion
+    within deadline, quota_starvation firing mis-quota'd and quiet
+    fair (exit 2 on any violated check). Artifact:
+    ``bench_runs/tenancy.json``, committed as a CI regress baseline
+    like pipeline/wire/devread."""
+    small = bool(args.smoke or (args.rows_log2 or 13) <= 11)
+
+    def run():
+        return tenancy_measure(
+            whale_rows=1 << (args.rows_log2 or 13),
+            whale_reads=30 if small else 40,
+            whale_deadline_s=60.0 if small else 120.0)
+
+    out = run()
+    attempts = 1
+    if not out["ok"]:
+        # one disclosed retry: the p99 gates ride max-of-N samples on a
+        # shared CPU — a single scheduler hiccup in the wrong cell can
+        # blow the 2x gate without any engine regression. A REAL
+        # regression fails both attempts.
+        attempts = 2
+        out = run()
+    out["attempts"] = attempts
+    out["smoke"] = small
+    here = os.path.dirname(os.path.abspath(__file__))
+    artifact = os.path.join(here, "bench_runs", "tenancy.json")
+    try:
+        os.makedirs(os.path.dirname(artifact), exist_ok=True)
+        _write_artifact(artifact, out)
+        out["artifact"] = os.path.relpath(artifact, here)
+    except OSError as e:
+        out["artifact_error"] = str(e)[:200]
+    print(json.dumps({k: v for k, v in out.items()
+                      if k not in ("solo", "fair", "starved")}),
+          flush=True)
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 2
+
+
 def stage_exchange(mon, jax, name, seconds, native_ok, record=True,
                    force_impl=None, **kw):
     mon.begin(name, seconds)
@@ -3434,7 +3730,7 @@ def main() -> None:
     ap.add_argument("--stage", default=None,
                     choices=("coldstart", "obs-overhead", "regress",
                              "pipeline", "devplane", "ragged", "chaos",
-                             "wire", "integrity", "devread"),
+                             "wire", "integrity", "devread", "tenancy"),
                     help="run ONE dedicated stage instead of the ladder: "
                          "coldstart = compile-cost artifact (persistent "
                          "cache cold-vs-warm across processes + "
@@ -3470,7 +3766,12 @@ def main() -> None:
                          "consumption A/B (MoE tokens/s device-sink vs "
                          "host-staged: d2h == 0, one program per "
                          "(family, sink), 0 warm recompiles, device >= "
-                         "host). All CPU-measurable")
+                         "host); tenancy = multi-tenant isolation gate "
+                         "(1 whale + 8 minnows on the async facade "
+                         "plane: minnow p99 under fair-share contention "
+                         "<= 2x solo, whale completes within deadline, "
+                         "quota_starvation firing mis-quota'd / quiet "
+                         "fair). All CPU-measurable")
     ap.add_argument("--baseline", default=None,
                     help="regress stage: prior artifact to diff against "
                          "(default bench_runs/obs_overhead.json)")
@@ -3495,6 +3796,13 @@ def main() -> None:
                     help="cpu forces the CPU backend via jax.config before "
                          "any device touch (env alone is not enough with "
                          "the axon sitecustomize present)")
+    ap.add_argument("--require-backend", default=None,
+                    choices=("tpu", "cpu"),
+                    help="exit 2 unless the backend RESOLVES to this — "
+                         "a silent CPU fallback can then never "
+                         "masquerade as a TPU number (disables the CPU "
+                         "fallback ladder; every artifact also stamps "
+                         "requested_backend/resolved_backend)")
     ap.add_argument("--no-fallback", action="store_true",
                     help="do not retry on CPU if TPU init wedges")
     ap.add_argument("--init-retry-s", type=int, default=None,
@@ -3514,6 +3822,13 @@ def main() -> None:
         # deliberately CPU: the measurement is recompiles avoided or
         # telemetry microseconds, not bandwidth, so it lands even when
         # the TPU window is dark (VERDICT chip-outage plan B)
+        record_backend(args.platform, "cpu")
+        if not check_required_backend(args.require_backend):
+            # the dedicated stages PIN the CPU backend — requiring TPU
+            # of one is a contradiction that must fail fast, not emit
+            # a CPU artifact under a TPU ask
+            emit_backend_refusal(args.require_backend)
+            sys.exit(2)
         import jax
         jax.config.update("jax_platforms", "cpu")
         sys.exit({"coldstart": stage_coldstart,
@@ -3525,8 +3840,13 @@ def main() -> None:
                   "chaos": stage_chaos,
                   "wire": stage_wire,
                   "integrity": stage_integrity,
-                  "devread": stage_devread}[args.stage](args))
+                  "devread": stage_devread,
+                  "tenancy": stage_tenancy}[args.stage](args))
 
+    if args.require_backend:
+        # the fallback ladder EXISTS to swap backends silently — the
+        # one behavior --require-backend forbids
+        args.no_fallback = True
     fallback = None
     if args.platform == "auto" and not args.no_fallback:
         # rows_log2=16 on the CPU ladder: big enough that the differenced
@@ -3558,6 +3878,11 @@ def main() -> None:
                 sys.exit(0 if result.get("value", 0) > 0 else 2)
         mon.finish()
         mon.emit()
+        sys.exit(2)
+    if not check_required_backend(args.require_backend):
+        # resolution fell back (e.g. asked tpu, got cpu): refuse to
+        # measure — the whole point of the preflight
+        emit_backend_refusal(args.require_backend)
         sys.exit(2)
     try:
         stage_op(mon, jax)

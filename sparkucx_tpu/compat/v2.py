@@ -54,6 +54,11 @@ class ShuffleDependency:
     combine: Optional[str] = None
     combine_sum_words: int = 0
     ordered: bool = False
+    # tenancy (shuffle/tenancy.py): the tenant the shuffle registers
+    # under — None = the conf default (tenant.id). The v2 analog of
+    # Spark's per-app external shuffle service registration: the
+    # dependency object carries the app's identity with it.
+    tenant: Optional[str] = None
 
 
 class MapWriterV2:
@@ -158,13 +163,20 @@ class ShuffleServiceV2:
         # scrape/doctor seams must not drift with the adapter contract
         self.node.telemetry_provider = lambda: self.stats("json")
         self.node.doctor_provider = lambda: self.doctor("findings")
+        # async shuffle plane — same executor class and ordering
+        # contract as the v1 facade (service.py): the async surface
+        # must not drift with the adapter contract either
+        from sparkucx_tpu.shuffle.tenancy import AsyncShuffleExecutor
+        self._async = AsyncShuffleExecutor(
+            conf, self.manager._tenants, self.node.metrics,
+            distributed=self.node.is_distributed)
         log.info("ShuffleServiceV2 up: %d devices", self.node.num_devices)
 
     # -- lifecycle ---------------------------------------------------------
     def register(self, dep: ShuffleDependency) -> ShuffleHandle:
         h = self.manager.register_shuffle(
             dep.shuffle_id, dep.num_maps, dep.num_partitions,
-            dep.partitioner, bounds=dep.bounds)
+            dep.partitioner, bounds=dep.bounds, tenant=dep.tenant)
         with self._results_guard:
             self._deps[dep.shuffle_id] = dep
         return h
@@ -268,6 +280,8 @@ class ShuffleServiceV2:
             return res
 
     def stop(self) -> None:
+        # drain async reads before the manager they run through stops
+        self._async.stop()
         if self._dumper is not None:
             self._dumper.stop()
             self._dumper = None
@@ -385,6 +399,38 @@ class ShuffleServiceV2:
                 f"mesh — see the warn-once log) — use reader() here, "
                 f"or lift the conf pin")
         return res
+
+    # -- async shuffle lifecycle (shuffle/tenancy.py) ----------------------
+    def read_async(self, handle: ShuffleHandle, start: int = 0,
+                   end: Optional[int] = None,
+                   timeout: Optional[float] = None):
+        """:meth:`reader` resolved on the async plane: returns a
+        :class:`~sparkucx_tpu.shuffle.tenancy.ShuffleFuture` completing
+        with the range's ``batch()`` dict ({r: (keys, values)}) once the
+        shuffle's ONE shared exchange is done — N async readers of one
+        shuffle still trigger one collective (the _shared_result
+        contract). Per-tenant in-flight caps enforce at submit; the
+        distributed ordering contract is the v1 facade's (single worker,
+        submission order == collective order)."""
+        rd = self.reader(handle, start, end, timeout=timeout)
+        return self._async.submit(rd.batch, handle.tenant,
+                                  handle.shuffle_id, timeout=timeout)
+
+    def submit_async(self, handle: ShuffleHandle,
+                     timeout: Optional[float] = None):
+        """Whole-shuffle async read: a future of the shared
+        ShuffleReaderResult (every partition), the v2 spelling of the
+        v1 facade's ``submit_async``. Dispatch + resolution run on the
+        async worker; same caps and ordering contract."""
+        dep = self._deps.get(handle.shuffle_id)
+        if dep is None:
+            raise KeyError(f"shuffle {handle.shuffle_id} not registered "
+                           f"through this adapter")
+
+        def run():
+            return self._shared_result(handle, dep, timeout)
+        return self._async.submit(run, handle.tenant, handle.shuffle_id,
+                                  timeout=timeout)
 
     def reader(self, handle: ShuffleHandle, start: int = 0,
                end: Optional[int] = None,

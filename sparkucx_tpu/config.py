@@ -121,6 +121,17 @@ class TpuShuffleConf:
         "trace.enabled": "turn on the span tracer (utils/trace.py)",
         "trace.device": "also record device-time spans",
         "trace.capacity": "tracer ring-buffer size",
+        "metrics.reportCapacity": "ExchangeReport ring size per manager "
+                                  "(default 64; eviction is tenant-"
+                                  "aware — shuffle/manager.py)",
+        "tenant.*": "multi-tenant service plane (shuffle/tenancy.py): "
+                    "tenant.id (this process's default tenant), "
+                    "tenant.priority (high|normal|batch), "
+                    "tenant.fairShare (DRR admission on/off), "
+                    "tenant.asyncWorkers, and per-tenant overrides "
+                    "tenant.<id>.priority/.maxBytesInFlight/"
+                    ".maxInflightReads/.replayBudget/.integrity.verify/"
+                    ".waveDepth",
         "metrics.dumpDir": "periodic JSON metrics-snapshot dumps land "
                            "here (off when unset; utils/export.py)",
         "metrics.dumpIntervalSecs": "seconds between periodic metrics "

@@ -124,6 +124,15 @@ class ShuffleService:
         # restores the node defaults.
         self.node.telemetry_provider = lambda: self.stats("json")
         self.node.doctor_provider = lambda: self.doctor("findings")
+        # Async shuffle plane (shuffle/tenancy.py): the worker pool
+        # behind submit_async()/read_async() — lazy, so a facade that
+        # never goes async builds no threads. Shared tenant policy with
+        # the manager (ONE registry instance: quota decisions and async
+        # caps must read the same specs).
+        from sparkucx_tpu.shuffle.tenancy import AsyncShuffleExecutor
+        self._async = AsyncShuffleExecutor(
+            conf, self.manager._tenants, self.node.metrics,
+            distributed=self.node.is_distributed)
         log.info("ShuffleService up: io=%s, %d devices",
                  self.io_format, self.node.num_devices)
 
@@ -131,10 +140,15 @@ class ShuffleService:
     def register_shuffle(self, shuffle_id: int, num_maps: int,
                          num_partitions: int,
                          partitioner: str = "hash",
-                         bounds=None) -> ShuffleHandle:
+                         bounds=None,
+                         tenant: Optional[str] = None) -> ShuffleHandle:
+        """``tenant`` pins the shuffle to a tenant id (default: conf
+        ``tenant.id``) — admission quota, priority weight, replay
+        budget, integrity level and async in-flight caps all resolve
+        from it (shuffle/tenancy.py)."""
         return self.manager.register_shuffle(
             shuffle_id, num_maps, num_partitions, partitioner,
-            bounds=bounds)
+            bounds=bounds, tenant=tenant)
 
     def unregister_shuffle(self, shuffle_id: int) -> None:
         self.manager.unregister_shuffle(shuffle_id)
@@ -148,6 +162,10 @@ class ShuffleService:
         return self.manager.recovered_shuffles()
 
     def stop(self) -> None:
+        # drain the async plane FIRST: in-flight async reads hold arena
+        # buffers and admission reservations through the manager being
+        # stopped below
+        self._async.stop()
         if self._dumper is not None:
             self._dumper.stop()
             self._dumper = None
@@ -260,6 +278,38 @@ class ShuffleService:
                                    combine=combine, ordered=ordered,
                                    combine_sum_words=combine_sum_words,
                                    sink=sink)
+
+    # -- async shuffle lifecycle (shuffle/tenancy.py) ----------------------
+    def read_async(self, handle: ShuffleHandle, **kw):
+        """:meth:`read` on the async plane: returns a
+        :class:`~sparkucx_tpu.shuffle.tenancy.ShuffleFuture` resolving
+        to exactly what ``read(handle, **kw)`` returns (arrow batches or
+        the raw result, per ``io.format``), so a serving tier overlaps
+        many small exchanges without blocking a thread per shuffle.
+
+        Per-tenant in-flight caps (``tenant.<id>.maxInflightReads``)
+        are enforced HERE, at submit: a tenant at its cap blocks until
+        one of its reads resolves (backpressure, counted in
+        ``shuffle.submit.throttled.count{tenant=...}``). Distributed
+        mode executes futures strictly in submission order on one
+        worker — callers submitting in the same order on every process
+        (the standing SPMD discipline) keep the collective order
+        agreed; see AsyncShuffleExecutor."""
+        return self._async.submit(lambda: self.read(handle, **kw),
+                                  handle.tenant, handle.shuffle_id,
+                                  timeout=kw.get("timeout"))
+
+    def submit_async(self, handle: ShuffleHandle, **kw):
+        """:meth:`submit` + result on the async plane (raw format, like
+        ``submit``): the exchange dispatches and RESOLVES on the async
+        worker, and the returned future completes with the
+        ShuffleReaderResult. Same per-tenant caps and ordering contract
+        as :meth:`read_async`; unlike read_async this path skips the
+        replay retry loop — the async contract of ``submit`` itself."""
+        def run():
+            return self.manager.submit(handle, **kw).result()
+        return self._async.submit(run, handle.tenant, handle.shuffle_id,
+                                  timeout=kw.get("timeout"))
 
 
 def connect(conf: Optional[Mapping[str, str]] = None, *,

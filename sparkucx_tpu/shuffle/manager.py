@@ -51,23 +51,32 @@ from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.runtime.failures import (BlockCorruptionError,
                                            PeerLostError, StaleEpochError,
                                            TransientError)
-from sparkucx_tpu.utils.metrics import (C_INTEGRITY_CORRUPT,
+from sparkucx_tpu.shuffle.tenancy import (FairShareQueue, FifoAdmitQueue,
+                                          TenantRegistry)
+from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES,
+                                        C_INTEGRITY_CORRUPT,
                                         C_INTEGRITY_CORRUPT_BLOCKS,
                                         C_INTEGRITY_QUARANTINED,
                                         C_INTEGRITY_RECOVERED,
                                         C_INTEGRITY_VERIFIED,
                                         C_REPLAY_MS, C_REPLAYS,
                                         COMPILE_HITS, COMPILE_PROGRAMS,
-                                        GLOBAL_METRICS, H_BW,
+                                        G_TENANT_INFLIGHT,
+                                        GLOBAL_METRICS, H_ADMIT_CROSS,
+                                        H_ADMIT_WAIT, H_BW,
                                         H_FETCH_FIRST, H_FETCH_WAIT,
                                         H_PEER_BYTES, H_PEER_ROWS,
-                                        H_WAVE_GAP)
+                                        H_WAVE_GAP, labeled)
 from sparkucx_tpu.utils.trace import format_trace_id
 
 log = get_logger("shuffle.manager")
 
 # Most-recent ExchangeReports the manager retains (keyed by shuffle id,
-# LRU-evicted) — bounded like every other telemetry ring.
+# LRU-evicted) — bounded like every other telemetry ring. The DEFAULT of
+# the ``metrics.reportCapacity`` conf key; eviction is tenant-aware (see
+# _new_report): the ring is shared across all tenants, and a chatty
+# tenant must evict its OWN oldest reports, not flush another tenant's
+# out from under gather_reports/doctor before they are read.
 REPORT_CAPACITY = 64
 
 
@@ -208,6 +217,16 @@ class ExchangeReport:
     # host_roundtrip rule and bench --stage devread grade.
     sink: str = "host"
     d2h_bytes: int = 0
+    # Multi-tenant plane (shuffle/tenancy.py): the tenant this shuffle
+    # was registered under (conf tenant.id, or the register_shuffle
+    # override) — the join key between this report, the per-tenant
+    # labeled metrics (admit wait, payload/wire counters) and the
+    # doctor's quota_starvation rule. ``admit_wait_ms`` is the wall this
+    # read's reservation spent DEFERRED in the admission queue (0 for an
+    # immediate grant) — group_ms includes it when dispatch was
+    # deferred, so consumers wanting the pure exchange wall subtract it.
+    tenant: str = ""
+    admit_wait_ms: float = 0.0
     completed: bool = False
     error: Optional[str] = None
     # bookkeeping, excluded from to_dict()
@@ -263,6 +282,10 @@ class ShuffleHandle:
     # RangePartitioner analog — the caller samples them, like Spark's
     # reservoir sampling, and every process must pass the same tuple)
     bounds: Optional[tuple] = None
+    # tenancy: the tenant id the shuffle was registered under — every
+    # read of this handle is accounted, admitted and policy-resolved
+    # (replay budget, integrity level, wave depth) as this tenant
+    tenant: str = "default"
 
     def __post_init__(self):
         if self.num_maps <= 0 or self.num_partitions <= 0:
@@ -351,18 +374,42 @@ class TpuShuffleManager:
         # falling back to host, lossless-under-device-sink inertness)
         self._warned_sink: set = set()
         self._lock = threading.Lock()
+        # -- multi-tenant service plane (shuffle/tenancy.py) --------------
+        # Per-tenant policy (priority weights, quotas, replay/integrity
+        # overrides) resolved purely from conf; every shuffle carries its
+        # tenant on the handle from register_shuffle on.
+        self._tenants = TenantRegistry(self.conf)
         # Admission control (a2a.maxBytesInFlight): combined footprint of
         # in-flight submitted exchanges; submit() blocks past the cap
         # (ref: UcxShuffleReader.scala:56-70 — Spark's
-        # ShuffleBlockFetcherIterator throttles inflight bytes the same way)
+        # ShuffleBlockFetcherIterator throttles inflight bytes the same
+        # way). Deferred exchanges queue in a WEIGHTED FAIR-SHARE queue
+        # (deficit round-robin across tenants, priority classes as weight
+        # multipliers) instead of the historical FIFO, so a whale shuffle
+        # parked at the head cannot starve every minnow behind it;
+        # tenant.fairShare=false restores strict FIFO.
         self._inflight_bytes = 0
+        self._inflight_by_tenant: Dict[str, int] = {}
+        # admission grant sequencing (the cross-grants discriminator):
+        # total grants ever, and grants per tenant — both monotone,
+        # mutated under the cv lock only
+        self._grant_seq = 0
+        self._grant_count_by_tenant: Dict[str, int] = {}
         self._inflight_cv = threading.Condition(self._lock)
-        self._admit_queue: list = []   # FIFO tickets of deferred exchanges
+        self._admit_queue = FairShareQueue(self._tenants) \
+            if self._tenants.fair_share else FifoAdmitQueue()
         self._admit_ticket = 0
-        # Telemetry plane: latest ExchangeReport per shuffle id (LRU ring,
-        # survives unregister so a postmortem can still explain a shuffle
-        # that was torn down). The flight recorder pulls them at dump
-        # time through the exchange_reports context provider.
+        # concurrently-packing tenants (pack-executor fair share):
+        # tenant -> live pack count, guarded by _lock
+        self._packing: Dict[str, int] = {}
+        # Telemetry plane: latest ExchangeReport per shuffle id (ring of
+        # metrics.reportCapacity, survives unregister so a postmortem can
+        # still explain a shuffle that was torn down; eviction is
+        # tenant-aware — see _evict_reports_locked). The flight recorder
+        # pulls them at dump time through the exchange_reports provider.
+        self._report_capacity = max(
+            1, self.conf.get_int("metrics.reportCapacity",
+                                 REPORT_CAPACITY))
         self._reports: "OrderedDict[int, ExchangeReport]" = OrderedDict()
         self.node.flight.add_context_provider(self.exchange_reports)
         self._bind_mesh()
@@ -509,14 +556,44 @@ class TpuShuffleManager:
             self._release_writer_batches(to_free)
             return False
 
+    def _tenant_of(self, sid: int) -> str:
+        """The tenant a shuffle was registered under (the conf default
+        for shuffles that predate the registration record)."""
+        with self._lock:
+            shape = self._shapes.get(sid)
+        return (shape or {}).get("tenant") or self._tenants.default_id
+
+    def _integrity_for(self, tenant: Optional[str]) -> str:
+        """The integrity verify level for one tenant's shuffles: the
+        per-tenant ``tenant.<id>.integrity.verify`` override when set,
+        else the global ``integrity.verify``. Commit and read resolve
+        from the same tenant of the same shuffle, so records and checks
+        cannot disagree."""
+        spec = self._tenants.spec(tenant)
+        return spec.integrity_verify or self._integrity_level
+
+    def _replay_budget_for(self, sid: int):
+        """(budget, conf_key) for one shuffle: the tenant's
+        ``replayBudget`` override when set, else the global."""
+        tid = self._tenant_of(sid)
+        spec = self._tenants.spec(tid)
+        if spec.replay_budget is not None:
+            return spec.replay_budget, \
+                f"spark.shuffle.tpu.tenant.{tid}.replayBudget"
+        return self._replay_budget, \
+            "spark.shuffle.tpu.failure.replayBudget"
+
     def _spend_replay(self, sid: int) -> bool:
-        """Consume one unit of the shuffle's replay budget; False once
-        exhausted (the caller falls back to failfast)."""
+        """Consume one unit of the shuffle's replay budget (the tenant's
+        override when set); False once exhausted (the caller falls back
+        to failfast)."""
+        budget, conf_key = self._replay_budget_for(sid)
         with self._lock:
             spent = self._replay_counts.get(sid, 0)
-            if spent >= self._replay_budget:
-                log.error("shuffle %d replay budget exhausted (%d/%d) — "
-                          "failing fast", sid, spent, self._replay_budget)
+            if spent >= budget:
+                log.error("shuffle %d replay budget exhausted (%d/%d, "
+                          "%s) — failing fast", sid, spent, budget,
+                          conf_key)
                 return False
             self._replay_counts[sid] = spent + 1
         return True
@@ -538,11 +615,12 @@ class TpuShuffleManager:
             self.node.epochs.validate(handle.epoch, f"shuffle {sid}")
             return 0              # unreachable: validate raises on stale
         if not self._spend_replay(sid):
+            budget, conf_key = self._replay_budget_for(sid)
             raise StaleEpochError(
                 f"shuffle {sid} pinned to epoch {handle.epoch}, mesh is "
                 f"at {cur}, and its replay budget "
-                f"({self._replay_budget}) is spent — re-register and "
-                f"re-run, or raise spark.shuffle.tpu.failure.replayBudget")
+                f"({budget}) is spent — re-register and "
+                f"re-run, or raise {conf_key}")
         handle.entry = rec["entry"]
         handle.epoch = cur
         log.warning("shuffle %d re-pinned to epoch %d through the "
@@ -600,8 +678,13 @@ class TpuShuffleManager:
             rep.replays = int(replays)
             rep.replay_ms = round(replay_ms, 3)
         self.node.metrics.inc(C_REPLAYS, float(replays))
+        self.node.metrics.inc(labeled(C_REPLAYS, tenant=handle.tenant),
+                              float(replays))
         if replay_ms:
             self.node.metrics.inc(C_REPLAY_MS, float(replay_ms))
+            self.node.metrics.inc(
+                labeled(C_REPLAY_MS, tenant=handle.tenant),
+                float(replay_ms))
 
     # -- restart recovery (failure.ledgerDir, shuffle/durable.py) ----------
     def _recover_from_ledger(self) -> None:
@@ -687,7 +770,9 @@ class TpuShuffleManager:
 
     def _adopt_recovered(self, rec: Dict, shuffle_id: int, num_maps: int,
                          num_partitions: int, partitioner: str,
-                         bounds) -> Optional[ShuffleHandle]:
+                         bounds,
+                         tenant: Optional[str] = None
+                         ) -> Optional[ShuffleHandle]:
         """Install a ledger-recovered shuffle as live state: committed
         writers over the sealed file sets for every intact map (reads
         consume their mmap views — zero recompute), nothing for
@@ -711,18 +796,20 @@ class TpuShuffleManager:
             if self._ledger is not None:
                 self._ledger.forget(shuffle_id)
             return None
+        tid = self._tenants.resolve(tenant)
         entry = rec["entry"]
         ws = {
             mid: MapOutputWriter.recovered(
                 entry, mid, self.node.pool, rs.directory, irec,
                 partitioner=partitioner, bounds=want_bounds,
-                integrity_level=self._integrity_level)
+                integrity_level=self._integrity_for(tid))
             for mid, (irec, _sizes) in rs.intact.items()}
         with self._lock:
             self._writers[shuffle_id] = ws
             self._shapes[shuffle_id] = {
                 "num_maps": num_maps, "num_partitions": num_partitions,
-                "partitioner": partitioner, "bounds": want_bounds}
+                "partitioner": partitioner, "bounds": want_bounds,
+                "tenant": tid}
             self._replayed.pop(shuffle_id, None)
             self._replay_counts.pop(shuffle_id, None)
         log.info(
@@ -731,7 +818,7 @@ class TpuShuffleManager:
             shuffle_id, len(ws), num_maps, num_maps - len(ws))
         return ShuffleHandle(shuffle_id, num_maps, num_partitions, entry,
                              partitioner, self.node.epochs.current,
-                             want_bounds)
+                             want_bounds, tenant=tid)
 
     # -- integrity verification (shuffle/integrity.py) ---------------------
     def _warn_integrity_once(self, key: str, msg: str) -> None:
@@ -832,7 +919,7 @@ class TpuShuffleManager:
         ``_replay_after_failure`` refuses distributed replays — the
         recovery controller owns the coordinated re-run, the same
         posture as every other distributed failure."""
-        if self._integrity_level != "full":
+        if self._integrity_for(handle.tenant) != "full":
             return
         rep = self.report(handle.shuffle_id)
         if rep is None or rep._full_done:
@@ -1006,7 +1093,8 @@ class TpuShuffleManager:
             num_partitions=handle.num_partitions,
             partitioner=handle.partitioner,
             process_id=self.node.process_id, distributed=distributed,
-            hierarchical=self.hierarchical)
+            hierarchical=self.hierarchical,
+            tenant=handle.tenant)
         # step-cache counters are process-global; the delta between read
         # start and completion attributes compiles to this exchange
         # (approximate under concurrent reads, exact in the common case)
@@ -1023,12 +1111,33 @@ class TpuShuffleManager:
                 self._exchange_seq)
             self._reports[handle.shuffle_id] = rep
             self._reports.move_to_end(handle.shuffle_id)
-            while len(self._reports) > REPORT_CAPACITY:
-                self._reports.popitem(last=False)
+            while len(self._reports) > self._report_capacity:
+                self._evict_report_locked()
         # ring events recorded while this exchange is in flight carry its
         # trace id (ended by on_done, or the submit failure paths)
         self.node.flight.begin_trace(rep.trace_id)
         return rep
+
+    def _evict_report_locked(self) -> None:
+        """Evict ONE report, tenant-aware: the victim is the OLDEST
+        report of the tenant holding the most ring entries, so a chatty
+        tenant churns its own history instead of flushing another
+        tenant's reports out from under gather_reports/doctor before
+        they are read. One tenant degenerates to the historical LRU
+        exactly (its oldest == the global oldest)."""
+        counts: Dict[str, int] = {}
+        for r in self._reports.values():
+            counts[r.tenant] = counts.get(r.tenant, 0) + 1
+        if len(counts) <= 1:
+            self._reports.popitem(last=False)
+            return
+        # max count wins; ties resolve to whichever tenant owns the
+        # globally oldest entry (insertion order scan — deterministic)
+        top = max(counts.values())
+        for sid, r in self._reports.items():
+            if counts[r.tenant] == top:
+                self._reports.pop(sid)
+                return
 
     def report(self, shuffle_id: int) -> Optional[ExchangeReport]:
         """Latest ExchangeReport for a shuffle (None if never read or
@@ -1090,13 +1199,20 @@ class TpuShuffleManager:
     def register_shuffle(self, shuffle_id: int, num_maps: int,
                          num_partitions: int,
                          partitioner: str = "hash",
-                         bounds=None) -> ShuffleHandle:
+                         bounds=None,
+                         tenant: Optional[str] = None) -> ShuffleHandle:
         """Allocate the metadata table for a shuffle
         (ref: CommonUcxShuffleManager.scala:39-56). ``partitioner`` is the
         Spark Partitioner-SPI analog: 'hash' groups by key hash; 'direct'
         treats keys as precomputed partition ids; 'range' routes the full
         int64 key through the sorted split points in ``bounds``
-        (device-evaluated — Spark's RangePartitioner)."""
+        (device-evaluated — Spark's RangePartitioner).
+
+        ``tenant`` pins the shuffle to a tenant id (default: the conf
+        ``tenant.id``) — every read is then admitted, accounted and
+        policy-resolved (replay budget, integrity level, wave depth) as
+        that tenant (shuffle/tenancy.py). The per-tenant conf overrides
+        are VALIDATED here, at registration, not mid-read."""
         if bounds is not None:
             b = np.asarray(bounds, dtype=np.int64)
             # validate HERE, not at read time: a malformed bounds tuple
@@ -1113,6 +1229,11 @@ class TpuShuffleManager:
         if (partitioner == "range") != (bounds is not None):
             raise ValueError(
                 "partitioner='range' requires bounds (and only it)")
+        # tenancy: resolve + VALIDATE the tenant's policy now (a typo'd
+        # tenant.<id>.priority must fail registration, not the first
+        # read); the spec itself is re-resolved at each use site
+        tid = self._tenants.resolve(tenant)
+        self._tenants.spec(tid)
         # Restart recovery (failure.ledgerDir): a shuffle the ledger scan
         # validated is ADOPTED — committed writers over its sealed files,
         # zero recompute of intact maps — instead of colliding with its
@@ -1123,7 +1244,7 @@ class TpuShuffleManager:
         if rec is not None:
             h = self._adopt_recovered(rec, shuffle_id, num_maps,
                                       num_partitions, partitioner,
-                                      bounds)
+                                      bounds, tenant=tid)
             if h is not None:
                 return h
         entry = self.node.registry.register(shuffle_id, num_maps,
@@ -1136,15 +1257,16 @@ class TpuShuffleManager:
             # a fresh registration resets the replay bookkeeping
             self._shapes[shuffle_id] = {
                 "num_maps": num_maps, "num_partitions": num_partitions,
-                "partitioner": partitioner, "bounds": bounds}
+                "partitioner": partitioner, "bounds": bounds,
+                "tenant": tid}
             self._replayed.pop(shuffle_id, None)
             self._replay_counts.pop(shuffle_id, None)
         log.info("registered shuffle %d: %d maps x %d partitions "
-                 "(table %d B)", shuffle_id, num_maps, num_partitions,
-                 len(entry.table))
+                 "(table %d B, tenant %s)", shuffle_id, num_maps,
+                 num_partitions, len(entry.table), tid)
         return ShuffleHandle(shuffle_id, num_maps, num_partitions, entry,
                              partitioner, self.node.epochs.current,
-                             bounds)
+                             bounds, tenant=tid)
 
     def get_writer(self, handle: ShuffleHandle,
                    map_id: int) -> MapOutputWriter:
@@ -1163,7 +1285,8 @@ class TpuShuffleManager:
                             spill_dir=spill_dir,
                             spill_threshold=self.conf.spill_threshold,
                             bounds=handle.bounds,
-                            integrity_level=self._integrity_level,
+                            integrity_level=self._integrity_for(
+                                handle.tenant),
                             ledger=self._ledger)
         with self._lock:
             # First-commit-wins: a committed map output is immutable. A
@@ -1203,35 +1326,110 @@ class TpuShuffleManager:
         device = (plan.cap_in + plan.cap_out) * width * 4 * plan.num_shards
         return int(stage_bytes) + int(device)
 
-    def _fits_inflight_locked(self, nbytes: int, ticket=None) -> bool:
-        """Capacity check under the lock. FIFO fairness: a submit-time
-        attempt (ticket=None) must also yield to any already-deferred
-        exchange, or a later submit would steal capacity freed for an
-        earlier queued one and starve it (Spark's fetch iterator defers
-        requests FIFO for the same reason). The admitted-alone rule keeps
-        a bigger-than-cap exchange from deadlocking itself."""
+    def _tenant_fits_locked(self, tenant: str, nbytes: int) -> bool:
+        """Capacity predicate for ONE tenant's next reservation under the
+        lock: global room (the admitted-alone rule keeps a bigger-than-
+        cap exchange from deadlocking itself) AND the tenant's own quota
+        room (``tenant.<id>.maxBytesInFlight``; same alone rule per
+        tenant, so a quota smaller than one exchange still admits it
+        when the tenant has nothing else in flight)."""
         cap = self.conf.max_bytes_in_flight
-        if ticket is None and self._admit_queue:
+        if self._inflight_bytes and self._inflight_bytes + nbytes > cap:
             return False
-        if ticket is not None and (not self._admit_queue
-                                   or self._admit_queue[0] != ticket):
-            return False
-        return self._inflight_bytes == 0 or \
-            self._inflight_bytes + nbytes <= cap
+        quota = self._tenants.spec(tenant).max_bytes_in_flight
+        if quota > 0:
+            held = self._inflight_by_tenant.get(tenant, 0)
+            if held and held + nbytes > quota:
+                return False
+        return True
 
-    def _release_inflight(self, nbytes: int) -> None:
+    def _tenant_quota_blocked_locked(self, tenant: str,
+                                     nbytes: int) -> bool:
+        """True when GLOBAL room exists for this reservation but the
+        tenant's OWN quota refuses it — the one case the fair-share
+        queue may bypass the head for (a globally-blocked head must
+        keep the floor until in-flight bytes drain, or a big exchange
+        starves behind a stream of small ones)."""
+        cap = self.conf.max_bytes_in_flight
+        if self._inflight_bytes and self._inflight_bytes + nbytes > cap:
+            return False
+        quota = self._tenants.spec(tenant).max_bytes_in_flight
+        if quota <= 0:
+            return False
+        held = self._inflight_by_tenant.get(tenant, 0)
+        return bool(held) and held + nbytes > quota
+
+    def _fits_inflight_locked(self, nbytes: int, ticket=None,
+                              tenant: Optional[str] = None) -> bool:
+        """Admission check under the lock. A submit-time attempt
+        (ticket=None) must yield to any already-deferred exchange, or a
+        later submit would steal capacity freed for a queued one and
+        starve it (Spark's fetch iterator defers requests the same way);
+        a QUEUED ticket is admitted only when the fair-share queue's
+        deficit-round-robin scan selects it — across tenants the grant
+        order is weighted by priority class, within a tenant it stays
+        FIFO (submit order is the collective order)."""
+        tid = self._tenants.resolve(tenant)
+        if ticket is None:
+            if self._admit_queue:
+                return False
+            return self._tenant_fits_locked(tid, nbytes)
+        return self._admit_queue.grantable(
+            self._tenant_fits_locked,
+            self._tenant_quota_blocked_locked) == ticket
+
+    def _grant_inflight_locked(self, tenant: str, nbytes: int) -> None:
+        """Account one granted reservation (under the lock): global and
+        per-tenant in-flight bytes, the cumulative per-tenant grant
+        counter/sequence, and the point-in-time inflight gauge the
+        doctor's quota_starvation rule reads for the hog's held share."""
+        self._inflight_bytes += nbytes
+        held = self._inflight_by_tenant.get(tenant, 0) + nbytes
+        self._inflight_by_tenant[tenant] = held
+        # grant sequence numbers feed the cross-grants starvation
+        # discriminator: a deferred ticket snapshots them at enqueue and
+        # differences them at grant (see _make_admitter)
+        self._grant_seq += 1
+        self._grant_count_by_tenant[tenant] = \
+            self._grant_count_by_tenant.get(tenant, 0) + 1
+        metrics = self.node.metrics
+        metrics.inc(labeled(C_ADMIT_BYTES, tenant=tenant), float(nbytes))
+        metrics.set_gauge(labeled(G_TENANT_INFLIGHT, tenant=tenant),
+                          held)
+
+    def _release_inflight(self, nbytes: int,
+                          tenant: Optional[str] = None) -> None:
         if nbytes <= 0:
             return
+        tid = self._tenants.resolve(tenant)
         with self._inflight_cv:
             self._inflight_bytes -= nbytes
+            held = self._inflight_by_tenant.get(tid, 0) - nbytes
+            if held > 0:
+                self._inflight_by_tenant[tid] = held
+            else:
+                self._inflight_by_tenant.pop(tid, None)
+            self.node.metrics.set_gauge(
+                labeled(G_TENANT_INFLIGHT, tenant=tid), max(0, held))
             self._inflight_cv.notify_all()
 
     def _make_admitter(self, plan: ShufflePlan, width: int,
-                       stage_bytes: int, timeout: Optional[float]):
+                       stage_bytes: int, timeout: Optional[float],
+                       tenant: Optional[str] = None,
+                       report: Optional[ExchangeReport] = None):
         """(admit, release) pair for one exchange; ``admit(block)`` is
         handed to the pending handle (None when the cap is off), and
         ``release()`` is idempotent — safe from the exactly-once on_done
         AND the not-yet-armed failure path.
+
+        Tenancy: the reservation is accounted to ``tenant`` (the
+        handle's registration tenant), checked against the tenant's own
+        quota on top of the global cap, and — when deferred — granted in
+        the fair-share queue's deficit-round-robin order instead of
+        FIFO. Every grant observes its deferral wall into the labeled
+        ``shuffle.admit.wait_ms{tenant=...}`` histogram (0 for an
+        immediate grant), the distribution the doctor's quota_starvation
+        rule grades.
 
         ``timeout=None`` — wait without a deadline (the distributed path:
         a local wall-clock TimeoutError could fire on one process while a
@@ -1241,37 +1439,50 @@ class TpuShuffleManager:
         the same contract as result() itself)."""
         if self.conf.max_bytes_in_flight <= 0:
             return None, lambda: None
+        tid = self._tenants.resolve(tenant)
         nbytes = self._exchange_footprint(plan, width, stage_bytes)
-        state = {"reserved": 0, "ticket": None}
+        state = {"reserved": 0, "ticket": None, "queued_at": 0.0}
+        metrics = self.node.metrics
 
         def admit(block: bool) -> bool:
             import time as _time
             with self._inflight_cv:
                 if not block:
-                    if self._fits_inflight_locked(nbytes):
-                        self._inflight_bytes += nbytes
+                    if self._fits_inflight_locked(nbytes, tenant=tid):
+                        self._grant_inflight_locked(tid, nbytes)
                         state["reserved"] = nbytes
+                        metrics.observe(
+                            labeled(H_ADMIT_WAIT, tenant=tid), 0.0)
                         return True
-                    # queue FIFO; dispatch happens in result()
+                    # defer into the fair-share queue; dispatch happens
+                    # in result() once the DRR scan grants the ticket
                     ticket = self._admit_ticket
                     self._admit_ticket += 1
-                    self._admit_queue.append(ticket)
+                    self._admit_queue.enqueue(ticket, tid, nbytes)
                     state["ticket"] = ticket
+                    state["queued_at"] = _time.perf_counter()
+                    # cross-grants snapshot (see H_ADMIT_CROSS)
+                    state["seq0"] = self._grant_seq
+                    state["own0"] = \
+                        self._grant_count_by_tenant.get(tid, 0)
                     log.info("submit deferred by maxBytesInFlight=%d "
-                             "(in flight %d B, requesting %d B, queue "
-                             "depth %d)", self.conf.max_bytes_in_flight,
+                             "(tenant %s, in flight %d B, requesting "
+                             "%d B, queue depth %d)",
+                             self.conf.max_bytes_in_flight, tid,
                              self._inflight_bytes, nbytes,
-                             len(self._admit_queue))
+                             self._admit_queue.depth())
                     return False
                 ticket = state["ticket"]
                 deadline = None if timeout is None \
                     else _time.monotonic() + timeout
-                while not self._fits_inflight_locked(nbytes, ticket):
+                while not self._fits_inflight_locked(nbytes, ticket,
+                                                     tenant=tid):
                     if deadline is not None:
                         remaining = deadline - _time.monotonic()
                         if remaining <= 0:
                             raise TimeoutError(
-                                f"deferred exchange waited {timeout}s: "
+                                f"deferred exchange (tenant {tid}) "
+                                f"waited {timeout}s: "
                                 f"{self._inflight_bytes} B in flight "
                                 f"exceeds a2a.maxBytesInFlight="
                                 f"{self.conf.max_bytes_in_flight} and no "
@@ -1280,10 +1491,23 @@ class TpuShuffleManager:
                         self._inflight_cv.wait(min(remaining, 1.0))
                     else:
                         self._inflight_cv.wait(1.0)
-                self._admit_queue.remove(ticket)
+                self._admit_queue.pop(ticket, nbytes)
                 state["ticket"] = None
-                self._inflight_bytes += nbytes
+                # cross-grants BEFORE this grant lands in the counters:
+                # grants to OTHER tenants while this ticket waited
+                cross = (self._grant_seq - state.get("seq0", 0)) - (
+                    self._grant_count_by_tenant.get(tid, 0)
+                    - state.get("own0", 0))
+                self._grant_inflight_locked(tid, nbytes)
                 state["reserved"] = nbytes
+                waited = (_time.perf_counter()
+                          - state["queued_at"]) * 1e3
+                metrics.observe(labeled(H_ADMIT_WAIT, tenant=tid),
+                                waited)
+                metrics.observe(labeled(H_ADMIT_CROSS, tenant=tid),
+                                float(max(0, cross)))
+                if report is not None:
+                    report.admit_wait_ms += waited
                 self._inflight_cv.notify_all()
                 return True
 
@@ -1291,14 +1515,11 @@ class TpuShuffleManager:
             with self._inflight_cv:
                 if state["ticket"] is not None:
                     # abandoned while queued: unblock those behind it
-                    try:
-                        self._admit_queue.remove(state["ticket"])
-                    except ValueError:
-                        pass
+                    self._admit_queue.discard(state["ticket"])
                     state["ticket"] = None
                     self._inflight_cv.notify_all()
             n, state["reserved"] = state["reserved"], 0
-            self._release_inflight(n)
+            self._release_inflight(n, tenant=tid)
 
         return admit, release
 
@@ -1536,8 +1757,15 @@ class TpuShuffleManager:
             # that died before its report exists observes as wait)
             rep = self.report(handle.shuffle_id)
             compiled = rep is not None and rep.stepcache_programs > 0
-            metrics.observe(H_FETCH_FIRST if compiled else H_FETCH_WAIT,
-                            ms)
+            hist = H_FETCH_FIRST if compiled else H_FETCH_WAIT
+            metrics.observe(hist, ms)
+            # per-tenant face of the same pair: the labeled wait
+            # distribution is the isolation evidence (a starved minnow's
+            # p99 diverges from its solo baseline HERE first) and the
+            # per-tenant read counter its signal floor
+            metrics.observe(labeled(hist, tenant=handle.tenant), ms)
+            metrics.inc(labeled("shuffle.read.count",
+                                tenant=handle.tenant), 1)
 
     def read_partitions(self, handle: ShuffleHandle, start: int, end: int,
                         timeout: Optional[float] = None,
@@ -1731,7 +1959,8 @@ class TpuShuffleManager:
             with tracer.span("shuffle.pack", rows=int(nvalid.sum()),
                              trace=rep.trace_id):
                 shard_rows, stage_buf = self._pack_shards(
-                    shard_outputs, plan.cap_in, width, has_vals)
+                    shard_outputs, plan.cap_in, width, has_vals,
+                    tenant=handle.tenant)
             rep.pack_ms = (time.perf_counter() - t_pack) * 1e3
         finally:
             self._read_finished(read_gen)
@@ -1740,7 +1969,8 @@ class TpuShuffleManager:
         # pending handle's first dispatch; over the cap, the exchange
         # queues and dispatches in result() once capacity frees
         admit, release_admitted = self._make_admitter(
-            plan, width, stage_buf.requested, timeout)
+            plan, width, stage_buf.requested, timeout,
+            tenant=handle.tenant, report=rep)
 
         on_done, arm = self._arm_read_callbacks(
             stage_buf, release_admitted, handle,
@@ -2008,14 +2238,11 @@ class TpuShuffleManager:
                     # process accounts its LOCAL share — its own staged
                     # payload and its own shards' wire segments — and
                     # the cluster sum reconstructs the global exactly.
-                    self.node.metrics.inc(
-                        "shuffle.payload.bytes",
-                        float(report.rows_local) * width * 4)
                     frac = len(self.node.local_shard_ids) \
                         / max(self.node.num_devices, 1)
-                    self.node.metrics.inc(
-                        "shuffle.wire.bytes",
-                        float(report.wire_bytes) * frac)
+                    self._inc_volume(report.tenant,
+                                     float(report.rows_local) * width * 4,
+                                     float(report.wire_bytes) * frac)
                 report.stepcache_hits = int(
                     GLOBAL_METRICS.get(COMPILE_HITS) - report._hits0)
                 report.stepcache_programs = int(
@@ -2036,7 +2263,7 @@ class TpuShuffleManager:
 
         def arm(pending):
             handle_box["pending"] = weakref.ref(pending)
-            if self._integrity_level == "full":
+            if self._integrity_for(handle.tenant) == "full":
                 # the post-collective digest check rides result() itself
                 # (reader.PendingExchangeBase), so async submit()/result()
                 # consumers verify exactly like read() — which then skips
@@ -2045,6 +2272,18 @@ class TpuShuffleManager:
                     self._verify_full_result(handle, res, combine)
 
         return on_done, arm
+
+    def _inc_volume(self, tenant: str, payload: float,
+                    wire: float) -> None:
+        """Cumulative payload/wire byte counters, global AND labeled per
+        tenant — one helper so the single-shot and waved completion
+        paths cannot drift on the per-tenant accounting."""
+        metrics = self.node.metrics
+        metrics.inc("shuffle.payload.bytes", payload)
+        metrics.inc("shuffle.wire.bytes", wire)
+        tid = tenant or self._tenants.default_id
+        metrics.inc(labeled("shuffle.payload.bytes", tenant=tid), payload)
+        metrics.inc(labeled("shuffle.wire.bytes", tenant=tid), wire)
 
     def _arm_d2h(self, result, rep: ExchangeReport) -> None:
         """Join a result's device-to-host payload pulls onto its report:
@@ -2286,7 +2525,9 @@ class TpuShuffleManager:
         Returns (slot_outputs, has_vals, val_tail, val_dtype); raises on a
         mixed schema — bit-reinterpreting one writer's rows under another's
         schema would silently corrupt."""
-        verify = entry is not None and self._integrity_level != "off"
+        level = self._integrity_for(rep.tenant if rep is not None
+                                    else None)
+        verify = entry is not None and level != "off"
         verified_bytes = 0
         verified_maps = 0
         slot_outputs = [[] for _ in range(num_slots)]
@@ -2329,11 +2570,30 @@ class TpuShuffleManager:
                 # (direct registry publishers, pre-integrity state)
                 # keeps integrity="" per the report contract rather
                 # than claiming a check that never ran
-                rep.integrity = self._integrity_level
+                rep.integrity = level
                 rep.integrity_bytes += verified_bytes
         return slot_outputs, has_vals, val_tail, val_dtype
 
-    def _pack_shards(self, slot_outputs, cap_in, width, has_vals):
+    def _pack_share(self, tenant: str) -> int:
+        """Fair share of the pack executor for one tenant's fill
+        fan-out: with a single packing tenant, every worker; under
+        contention, workers split by priority weight (a batch whale
+        packing beside a high minnow gets ~1/5 of the slots instead of
+        all of them — the pack-slot half of the no-starvation
+        contract). Floor 1: a share of zero would serialize the tenant
+        entirely, which is a starvation of its own."""
+        workers = max(1, int(self.conf.pack_threads
+                             or self.conf.cores_per_process))
+        with self._lock:
+            contending = [t for t, n in self._packing.items() if n > 0]
+        if len(contending) <= 1:
+            return workers
+        weights = {t: self._tenants.spec(t).weight for t in contending}
+        total = sum(weights.values()) or 1
+        return max(1, (workers * weights.get(tenant, 1)) // total)
+
+    def _pack_shards(self, slot_outputs, cap_in, width, has_vals,
+                     tenant: Optional[str] = None):
         """Fuse key+value bytes into one [slots, cap_in, width] int32 row
         matrix (bit views, no value casts — jnp would silently truncate
         int64 with x64 off).
@@ -2344,7 +2604,13 @@ class TpuShuffleManager:
         register-once-serve-zero-copy property,
         ref: CommonUcxShuffleBlockResolver.scala:45-57). Returns
         (rows_view, arena_buf); the caller releases arena_buf when the
-        exchange is done."""
+        exchange is done.
+
+        ``tenant`` joins the pack-slot fair share: concurrent packs of
+        different tenants split the persistent executor's workers by
+        priority weight (``_pack_share``), so a whale's giant fill
+        cannot occupy every pack slot while a minnow's pack waits."""
+        tid = self._tenants.resolve(tenant)
         shape = (len(slot_outputs), cap_in, width)
         buf = self.node.pool.get(max(int(np.prod(shape)) * 4, 1))
         rows = buf.view().view(np.int32).reshape(shape)
@@ -2369,6 +2635,8 @@ class TpuShuffleManager:
             # prefix would cost a wasted full pass
             rows[p, off:] = 0
 
+        with self._lock:
+            self._packing[tid] = self._packing.get(tid, 0) + 1
         try:
             # the persistent executor makes fan-out dispatch ~µs, so the
             # old 16 MiB spawn-amortization guard shrinks to a modest
@@ -2378,8 +2646,33 @@ class TpuShuffleManager:
                 if len(slot_outputs) > 1 and rows.nbytes >= (1 << 20) \
                 else None
             if ex is not None:
-                list(ex.map(lambda p: fill(p, pack_threads=1),
-                            range(len(slot_outputs))))
+                share = self._pack_share(tid)
+                workers = max(1, int(self.conf.pack_threads
+                                     or self.conf.cores_per_process))
+                if share >= workers:
+                    # uncontended (the common case): the executor's own
+                    # worker count is the only bound — one continuous
+                    # fan-out, no added synchronization on the wave
+                    # pipeline's critical path
+                    list(ex.map(lambda p: fill(p, pack_threads=1),
+                                range(len(slot_outputs))))
+                else:
+                    # contending tenants: bound THIS pack's concurrent
+                    # fills to its fair share with a sliding window
+                    # (top-up on completion — a chunk barrier would
+                    # stall on each chunk's straggler)
+                    from concurrent.futures import (FIRST_COMPLETED,
+                                                    wait as _fwait)
+                    live = set()
+                    for p in range(len(slot_outputs)):
+                        live.add(ex.submit(fill, p, 1))
+                        if len(live) >= share:
+                            done, live = _fwait(
+                                live, return_when=FIRST_COMPLETED)
+                            for f in done:
+                                f.result()
+                    for f in live:
+                        f.result()
             else:
                 for p in range(len(slot_outputs)):
                     fill(p)
@@ -2388,6 +2681,13 @@ class TpuShuffleManager:
             # mid-pack must not strand the pinned block
             self.node.pool.put(buf)
             raise
+        finally:
+            with self._lock:
+                n = self._packing.get(tid, 1) - 1
+                if n > 0:
+                    self._packing[tid] = n
+                else:
+                    self._packing.pop(tid, None)
         return rows, buf
 
     def _pack_executor(self):
@@ -2491,7 +2791,13 @@ class TpuShuffleManager:
         # native collective pays each wave's real rows). Refreshed in
         # _finalize once any overflow regrow settles the final wave plan.
         self._set_wave_wire(rep, wplan, wave_sizes, width)
-        depth = max(1, min(self.conf.wave_depth, num_waves))
+        # pipeline depth: the tenant's waveDepth override wins (a batch
+        # tenant can be held to a shallower — cheaper-footprint —
+        # pipeline while a high tenant keeps the conf depth). Conf-
+        # derived per tenant, so it is identical on every process.
+        spec_depth = self._tenants.spec(handle.tenant).wave_depth
+        depth = max(1, min(spec_depth or self.conf.wave_depth,
+                           num_waves))
         # Admission: the pipeline's whole point is a bounded footprint —
         # `depth` pinned wave blocks plus up to `depth` waves' device
         # buffers, NOT the full shuffle (same estimate discipline as
@@ -2514,7 +2820,8 @@ class TpuShuffleManager:
         admit, release_admitted = self._make_admitter(
             wplan, width,
             depth * block_bytes + (hbm_waves - 1) * device_wave,
-            None if distributed else timeout)
+            None if distributed else timeout, tenant=handle.tenant,
+            report=rep)
         local_rows = sum(int(k.shape[0])
                          for outs in slot_outputs for k, _ in outs)
         read_gen = self._read_started()
@@ -2717,7 +3024,7 @@ class TpuShuffleManager:
             dtype=np.int64)
         nvalid = allgather_sizes(nvalid_local, shard_ids, Pn)
         validate_row_sizes(nvalid.reshape(1, -1))
-        if self._integrity_level == "full" and not combine:
+        if self._integrity_for(handle.tenant) == "full" and not combine:
             # one more metadata-plane collective, full level only: the
             # receivers need the GLOBAL per-partition digest table
             self._stash_full_expect(handle, writers)
@@ -2768,7 +3075,8 @@ class TpuShuffleManager:
         with tracer.span("shuffle.pack", rows=int(nvalid_local.sum()),
                          trace=rep.trace_id if rep is not None else ""):
             local_rows, stage_buf = self._pack_shards(
-                shard_outputs, plan.cap_in, width, has_vals)
+                shard_outputs, plan.cap_in, width, has_vals,
+                tenant=handle.tenant)
         if rep is not None:
             rep.pack_ms = (time.perf_counter() - t_pack) * 1e3
 
@@ -2785,7 +3093,8 @@ class TpuShuffleManager:
         nproc = max(1, self.conf.num_processes)
         stage_global = -(-Pn // nproc) * plan.cap_in * width * 4
         admit, release_admitted = self._make_admitter(
-            plan, width, stage_global, None)
+            plan, width, stage_global, None, tenant=handle.tenant,
+            report=rep)
 
         on_done, arm = self._arm_read_callbacks(
             stage_buf, release_admitted, handle,
@@ -3129,7 +3438,7 @@ class PendingWaveShuffle:
                         (i + 1) * self._wave_rows)
                     shard_rows, buf = mgr._pack_shards(
                         sliced, self._wave_plan.cap_in, self._width,
-                        self._has_vals)
+                        self._has_vals, tenant=self._handle.tenant)
                     t1 = time.perf_counter()
                     if i == self._num_waves - 1:
                         # last pack done: writer memory is no longer
@@ -3335,12 +3644,11 @@ class PendingWaveShuffle:
             # LOCAL shares, like shuffle.rows/bytes above: counters sum
             # across processes in build_view, so the cluster total must
             # reconstruct the global payload/wire exactly once
-            metrics.inc("shuffle.payload.bytes",
-                        float(self._local_rows) * self._width * 4)
             frac = len(mgr.node.local_shard_ids) \
                 / max(mgr.node.num_devices, 1)
-            metrics.inc("shuffle.wire.bytes",
-                        float(rep.wire_bytes) * frac)
+            mgr._inc_volume(rep.tenant,
+                            float(self._local_rows) * self._width * 4,
+                            float(rep.wire_bytes) * frac)
         if retries_total:
             metrics.inc("shuffle.retries", float(retries_total))
         # wave wait-gap distribution: pack time NOT covered by the

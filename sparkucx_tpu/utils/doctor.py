@@ -81,14 +81,16 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Union
 
-from sparkucx_tpu.utils.metrics import (C_D2H, C_H2D, C_INTEGRITY_CORRUPT,
+from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES, C_D2H, C_H2D,
+                                        C_INTEGRITY_CORRUPT,
                                         C_INTEGRITY_CORRUPT_BLOCKS,
                                         C_INTEGRITY_QUARANTINED,
                                         C_INTEGRITY_VERIFIED,
                                         C_PEER_TIMEOUT, C_PROBE_DEAD,
                                         C_REPLAYS, COMPILE_HITS,
                                         COMPILE_PROGRAMS, COMPILE_SECONDS,
-                                        G_HBM_IN_USE, G_HBM_LIMIT, H_BW,
+                                        G_HBM_IN_USE, G_HBM_LIMIT,
+                                        H_ADMIT_CROSS, H_ADMIT_WAIT, H_BW,
                                         H_FETCH_FIRST, H_FETCH_WAIT,
                                         H_RETRY_MS, H_WAVE_GAP, Histogram,
                                         parse_labeled)
@@ -200,6 +202,30 @@ class Thresholds:
     # floor below is the CRITICAL line: repeated corruptions (or any
     # quarantine) mean rotting storage/memory, not a one-off flip.
     corruption_critical_blocks: int = 3
+    # quota_starvation: one tenant's admission wait dwarfs its own
+    # exchange wall while another tenant holds more than its fair share
+    # of granted admission bytes. Signal floors per the PR-5 discipline:
+    # a starved verdict needs real waits (min ms + min admissions) and
+    # the hog needs real volume (min granted bytes) before a ratio can
+    # fire; ``quota_share`` is the granted-byte share past which a
+    # tenant counts as hogging (with >= 2 tenants active).
+    # cross-grants: how many admission grants OTHER tenants received
+    # while a ticket of this tenant waited (shuffle.admit.cross_grants
+    # histogram). THE starvation discriminator: a tenant queueing behind
+    # its own serialized reads observes ~0 regardless of how long it
+    # waits; a tenant parked behind a neighbor's flood observes the
+    # flood's length. Fair-share admission bounds it near the
+    # interleave ratio (a handful); strict-FIFO behind a whale queue
+    # sends it to the queue depth.
+    quota_cross_grants: float = 8.0
+    quota_cross_critical: float = 24.0
+    # the wait floor is deliberately high (~a third of a second):
+    # exchanges are ms-scale, and admission waits below this are
+    # ordinary backpressure, whoever caused them
+    quota_min_wait_ms: float = 300.0
+    quota_min_admits: int = 3          # labeled admit histogram floor
+    quota_share: float = 0.6           # hog's share of granted bytes
+    quota_min_bytes: float = 1e6       # total granted-byte floor
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -1016,12 +1042,110 @@ def _rule_host_roundtrip(view: ClusterView,
         trace_ids=[r.get("trace_id", "") for r in hosts[:4]])]
 
 
+def _labeled_series(mapping, base: str, label: str) -> Dict[str, Any]:
+    """{label value: entry} for every identity in ``mapping`` whose base
+    name is ``base`` and whose label block carries ``label`` — the
+    per-tenant join used by the quota rule (and any future labeled
+    rule)."""
+    out: Dict[str, Any] = {}
+    for name, v in mapping.items():
+        b, labels = parse_labeled(name)
+        if b == base and labels and label in labels:
+            out[labels[label]] = v
+    return out
+
+
+def _rule_quota_starvation(view: ClusterView,
+                           th: Thresholds) -> List[Finding]:
+    """One tenant is starving in admission while another hogs the
+    in-flight budget. Three signals, all required:
+
+    * cross-grants: while this tenant's tickets waited, OTHER tenants
+      were granted ``quota_cross_grants``+ exchanges past them
+      (``shuffle.admit.cross_grants{tenant=...}`` p99). This is the
+      discriminator — a tenant serialized behind its OWN reads observes
+      ~0 cross-grants no matter how long it waits, so self-backpressure
+      can never masquerade as starvation.
+    * real waits: admit-wait p99 over the ``quota_min_wait_ms`` floor —
+      being passed by a flood of sub-ms grants is rude, not harmful.
+    * a hog exists: some other tenant holds more than ``quota_share``
+      of every granted admission byte.
+
+    Names BOTH tenants and the hog's quota key: capping the hog (or
+    raising the starved tenant's priority class) is the fix — raising
+    the global cap merely moves the queue. Quiet under fair-share
+    health: DRR interleaves grants, so a minnow is passed by at most a
+    handful of whale exchanges, never the whale's whole queue."""
+    waits = _labeled_series(view.histograms, H_ADMIT_WAIT, "tenant")
+    cross = _labeled_series(view.histograms, H_ADMIT_CROSS, "tenant")
+    granted = _labeled_series(view.counters, C_ADMIT_BYTES, "tenant")
+    total_granted = sum(granted.values())
+    if len(waits) < 2 or total_granted < th.quota_min_bytes:
+        return []
+    # per-tenant exchange wall (evidence only): median completed-read
+    # wall, admission wait subtracted — group_ms includes the wait when
+    # dispatch was deferred
+    walls: Dict[str, List[float]] = {}
+    for r in _completed(view):
+        t = r.get("tenant") or ""
+        if t:
+            walls.setdefault(t, []).append(max(0.0, (
+                float(r.get("pack_ms", 0.0))
+                + float(r.get("group_ms", 0.0))
+                - float(r.get("admit_wait_ms", 0.0)))))
+    out: List[Finding] = []
+    for tid, h in sorted(waits.items()):
+        if h.count < th.quota_min_admits:
+            continue
+        p99 = h.quantile(0.99)
+        if p99 < th.quota_min_wait_ms:
+            continue
+        xh = cross.get(tid)
+        x99 = xh.quantile(0.99) if xh is not None and xh.count else 0.0
+        if x99 < th.quota_cross_grants:
+            continue
+        hogs = [(u, b) for u, b in granted.items() if u != tid]
+        if not hogs:
+            continue
+        hog, hog_bytes = max(hogs, key=lambda kv: kv[1])
+        share = hog_bytes / total_granted
+        if share <= th.quota_share:
+            continue
+        wall = _median(walls.get(tid, []))
+        out.append(Finding(
+            rule="quota_starvation",
+            grade="critical" if x99 >= th.quota_cross_critical
+            else "warn",
+            summary=(f"tenant {tid!r} is starved of admission: "
+                     f"{x99:.0f} grants to other tenants passed its "
+                     f"waiting reads (admit-wait p99 {p99:.0f} ms) "
+                     f"while tenant {hog!r} holds {share:.0%} of all "
+                     f"granted admission bytes"),
+            evidence={"starved_tenant": tid, "hog_tenant": hog,
+                      "cross_grants_p99": round(x99, 1),
+                      "admit_wait_p99_ms": round(p99, 1),
+                      "tenant_wall_ms": round(wall, 1),
+                      "hog_granted_bytes": int(hog_bytes),
+                      "hog_share": round(share, 3),
+                      "admits": int(h.count)},
+            conf_key=f"spark.shuffle.tpu.tenant.{hog}.maxBytesInFlight",
+            remediation=(f"cap tenant {hog!r} "
+                         f"(tenant.{hog}.maxBytesInFlight) or raise "
+                         f"tenant {tid!r}'s priority class "
+                         f"(tenant.{tid}.priority=high — a fair-share "
+                         f"weight multiplier); check tenant.fairShare "
+                         f"is on — FIFO admission starves by "
+                         f"arrival order")))
+    return out
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
           _rule_bw_underutilization, _rule_padding_waste,
           _rule_wire_dequant, _rule_peer_timeout, _rule_replay_storm,
-          _rule_block_corruption, _rule_host_roundtrip)
+          _rule_block_corruption, _rule_host_roundtrip,
+          _rule_quota_starvation)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

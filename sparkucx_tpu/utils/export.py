@@ -280,10 +280,19 @@ def render_prometheus(doc: Dict) -> str:
     histogram_quantile() before a human can read p99 defeats the
     point of carrying it live)."""
     lines: List[str] = []
-    for name in sorted(doc.get("counters", {})):
-        n = prom_name(name)
-        lines.append(f"# TYPE {n} counter")
-        lines.append(f"{n} {_fmt(doc['counters'][name])}")
+    # counters: first-class label support (per-tenant payload/wire/admit
+    # counters carry a ``labeled()`` block). Grouped by FAMILY like the
+    # gauges below — the exposition format wants ONE TYPE line per
+    # family with all of its series adjacent, and two tenants of one
+    # family must not each emit their own TYPE line.
+    counters = doc.get("counters", {})
+    cfamilies: Dict[str, List[str]] = {}
+    for name in counters:
+        cfamilies.setdefault(prom_family(name), []).append(name)
+    for fam in sorted(cfamilies):
+        lines.append(f"# TYPE {fam} counter")
+        for name in sorted(cfamilies[fam]):
+            lines.append(f"{prom_series(name)} {_fmt(counters[name])}")
     # gauges: set-semantics values (devmon HBM watermarks, pool in-use)
     # with first-class label support. Grouped by FAMILY, not identity
     # sort order: the exposition format requires one TYPE line per
@@ -298,17 +307,39 @@ def render_prometheus(doc: Dict) -> str:
         lines.append(f"# TYPE {fam} gauge")
         for name in sorted(families[fam]):
             lines.append(f"{prom_series(name)} {_fmt(gauges[name])}")
-    for name in sorted(doc.get("histograms", {})):
-        h = doc["histograms"][name]
-        n = prom_name(name)
-        lines.append(f"# TYPE {n} histogram")
-        for le, cum in h.get("buckets", []):
-            lines.append(f'{n}_bucket{{le="{_fmt(float(le))}"}} {int(cum)}')
-        lines.append(f"{n}_sum {_fmt(h.get('sum', 0.0))}")
-        lines.append(f"{n}_count {int(h.get('count', 0))}")
-        for q in ("p50", "p99", "max"):
-            lines.append(f"# TYPE {n}_{q} gauge")
-            lines.append(f"{n}_{q} {_fmt(h.get(q, 0.0))}")
+    # histograms: labeled identities (shuffle.read.wait_ms{tenant=...})
+    # merge their label block into every sample of the series — the
+    # ``le`` bound joins the identity's own labels — and share ONE
+    # family TYPE line with their unlabeled sibling.
+    hists = doc.get("histograms", {})
+    hfamilies: Dict[str, List[str]] = {}
+    for name in hists:
+        hfamilies.setdefault(prom_family(name), []).append(name)
+    for fam in sorted(hfamilies):
+        lines.append(f"# TYPE {fam} histogram")
+        qlines: List[str] = []
+        for name in sorted(hfamilies[fam]):
+            h = hists[name]
+            base, labels = parse_labeled(name)
+            inner = "".join(
+                f',{_BAD_CHARS.sub("_", k)}="{escape_label_value(v)}"'
+                for k, v in (labels or {}).items())
+            for le, cum in h.get("buckets", []):
+                lines.append(
+                    f'{fam}_bucket{{le="{_fmt(float(le))}"{inner}}} '
+                    f'{int(cum)}')
+            tail = f"{{{inner[1:]}}}" if inner else ""
+            lines.append(f"{fam}_sum{tail} {_fmt(h.get('sum', 0.0))}")
+            lines.append(f"{fam}_count{tail} {int(h.get('count', 0))}")
+            for q in ("p50", "p99", "max"):
+                qlines.append((f"{fam}_{q}",
+                               f"{fam}_{q}{tail} {_fmt(h.get(q, 0.0))}"))
+        seen_types = set()
+        for tname, line in qlines:
+            if tname not in seen_types:
+                seen_types.add(tname)
+                lines.append(f"# TYPE {tname} gauge")
+            lines.append(line)
     # span summary rides as gauges so a scrape sees phase timings without
     # needing the chrome trace (one family per aggregate field)
     for name in sorted(doc.get("spans", {})):

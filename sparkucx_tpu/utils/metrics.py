@@ -106,6 +106,30 @@ C_INTEGRITY_RECOVERED = "shuffle.integrity.recovered.count"
 C_D2H = "shuffle.read.d2h.bytes"
 C_H2D = "shuffle.consume.h2d.bytes"
 
+# Multi-tenant service plane (shuffle/tenancy.py, shuffle/manager.py
+# admission): ONE place for the names so the fair-share queue, the
+# facades' async plane, the doctor's quota_starvation rule and the
+# tests cannot drift. All three are LABELED per tenant
+# (``labeled(name, tenant=...)``): H_ADMIT_WAIT observes every
+# admission's deferral wall (0 for an immediate grant — the p99 must
+# see the whole distribution, not just the stalls), C_ADMIT_BYTES
+# accumulates granted reservation bytes (the fair-share evidence the
+# doctor grades a hog against), C_SUBMIT_THROTTLED counts async
+# submissions that hit tenant.<id>.maxInflightReads.
+H_ADMIT_WAIT = "shuffle.admit.wait_ms"
+# per deferred grant: how many grants OTHER tenants received between
+# this ticket's enqueue and its grant — the starvation discriminator.
+# A tenant queueing behind its own serialized reads observes ~0 here
+# no matter how long it waits; a tenant parked behind another tenant's
+# whole flood observes the flood's length. Scale-free (counts, not ms),
+# which is what lets the quota_starvation rule separate "busy with my
+# own work" from "starved by a neighbor" out of aggregates alone.
+H_ADMIT_CROSS = "shuffle.admit.cross_grants"
+C_ADMIT_BYTES = "shuffle.admit.bytes"
+C_SUBMIT_THROTTLED = "shuffle.submit.throttled.count"
+# point-in-time admission reservation per tenant (set-semantics gauge)
+G_TENANT_INFLIGHT = "shuffle.inflight.bytes"
+
 # Device-memory gauge families (runtime/devmon.py sampler; per local
 # device index, encoded as a label via :func:`labeled`): ONE place for
 # the names so the sampler, the doctor's hbm_pressure rule and the

@@ -84,6 +84,25 @@ def pytest_sessionfinish(session, exitstatus):
         doc = collect_snapshot(metrics, tracer=GLOBAL_TRACER)
         doc["pytest_exitstatus"] = int(exitstatus)
         write_snapshot(doc, os.path.join(out, "metrics_snapshot.json"))
+        # per-tenant slice of the same snapshot (tenant-labeled series
+        # + tenant-attributed exchange reports): the multi-tenant
+        # postmortem view, uploaded beside the flight dump so a tenancy
+        # regression is attributable without re-parsing the full doc
+        tenant_doc = {
+            "counters": {k: v for k, v in doc.get("counters", {}).items()
+                         if "tenant=" in k},
+            "histograms": {k: v
+                           for k, v in doc.get("histograms", {}).items()
+                           if "tenant=" in k},
+            "gauges": {k: v for k, v in doc.get("gauges", {}).items()
+                       if "tenant=" in k},
+            "exchange_reports": [
+                r for r in doc.get("exchange_reports", [])
+                if r.get("tenant")],
+        }
+        if any(tenant_doc.values()):
+            write_snapshot(tenant_doc,
+                           os.path.join(out, "tenant_metrics.json"))
     except Exception as e:  # artifact collection must never mask the run
         print(f"[conftest] telemetry artifact collection failed: {e!r}")
 

@@ -285,3 +285,72 @@ def test_integrity_measure_small(mesh8):
     assert rec["recovery"]["zero_recompute"] is True
     assert rec["recovery"]["quarantine_only_map1"] is True
     assert rec["recovery"]["quarantine_bytes_ok"] is True
+
+
+def test_tenancy_measure_small(mesh8):
+    """The tenancy stage's measurement core at a tiny shape: all three
+    cells run the async facade plane, per-tenant labeled counters flow,
+    and the report structure carries the gate inputs. The p99 GATES are
+    deliberately not asserted here — timing-derived at tiny shapes they
+    are noise; bench --stage tenancy (CI) runs the gated shape."""
+    rec = bench.tenancy_measure(minnow_rows=128, whale_rows=512,
+                                minnows=4, minnow_rounds=1,
+                                whale_reads=4, whale_deadline_s=60.0)
+    for cell in ("solo", "fair", "starved"):
+        d = rec[cell]
+        assert d["minnow_reads"] == 4
+        assert d["minnow_p99_ms"] > 0
+        assert "quota_starvation_findings" in d
+    assert rec["fair"]["whale_completed"] is True
+    assert rec["starved"]["whale_completed"] is True
+    per_tenant = rec["fair"]["per_tenant_counters"]
+    assert any("minnow" in k for k in per_tenant)
+    assert any("whale" in k for k in per_tenant)
+    assert set(rec["checks"]) == {
+        "minnow_isolation", "whale_completes", "whale_within_deadline",
+        "starved_cell_fires", "fair_cell_quiet",
+        "per_tenant_counters_present"}
+    assert rec["isolation_ratio"] > 0
+
+
+def test_backend_preflight_stamps_artifacts(tmp_path):
+    """Satellite: every artifact carries requested/resolved backend, and
+    --require-backend turns a resolution mismatch into a refusal."""
+    prior = dict(bench.PREFLIGHT)
+    try:
+        bench.record_backend("tpu", "cpu")
+        out = {"x": 1}
+        path = str(tmp_path / "a.json")
+        bench._write_artifact(path, out)
+        doc = json.load(open(path))
+        assert doc["requested_backend"] == "tpu"
+        assert doc["resolved_backend"] == "cpu"
+        # a stage that resolved its own backend facts keeps them
+        path2 = str(tmp_path / "b.json")
+        bench._write_artifact(path2, {"resolved_backend": "tpu"})
+        assert json.load(open(path2))["resolved_backend"] == "tpu"
+        # the gate: required tpu vs resolved cpu refuses
+        assert bench.check_required_backend(None) is True
+        assert bench.check_required_backend("cpu") is True
+        assert bench.check_required_backend("tpu") is False
+        bench.record_backend("tpu", "tpu")
+        assert bench.check_required_backend("tpu") is True
+    finally:
+        bench.PREFLIGHT.update(prior)
+
+
+def test_require_backend_tpu_refuses_cpu_stage(tmp_path):
+    """--require-backend=tpu on a CPU-pinned dedicated stage exits 2
+    with one machine-parseable refusal line instead of emitting a CPU
+    artifact under a TPU ask (the ROADMAP rounds 3-5 failure mode)."""
+    import subprocess
+    env = dict(os.environ)
+    p = subprocess.run(
+        [sys.executable, bench.__file__, "--stage", "tenancy",
+         "--require-backend", "tpu"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert p.returncode == 2, (p.stdout, p.stderr)
+    line = json.loads(p.stdout.strip().splitlines()[-1])
+    assert line["error"].startswith("backend fallback refused")
+    assert line["resolved_backend"] == "cpu"
+    assert line["required_backend"] == "tpu"

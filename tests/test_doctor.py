@@ -1142,3 +1142,77 @@ def test_regress_stage_writes_findings_doc(tmp_path, capsys):
     assert grades["detail.compiles"] == "critical"
     args.gate_regress = True
     assert bench.stage_regress(args) == 2         # gated mode blocks
+
+
+# -- quota_starvation goldens (multi-tenant service plane) -----------------
+def _tenant_doc(minnow_cross=20.0, minnow_wait=800.0, whale_share=0.9,
+                admits=6):
+    """Two-tenant snapshot: whale granted most admission bytes, minnow
+    waiting. Knobs select which rule conditions hold."""
+    from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES,
+                                            H_ADMIT_CROSS,
+                                            H_ADMIT_WAIT, labeled)
+    doc = _healthy_doc()
+    total = 100e6
+    doc["counters"][labeled(C_ADMIT_BYTES, tenant="whale")] = \
+        total * whale_share
+    doc["counters"][labeled(C_ADMIT_BYTES, tenant="minnow")] = \
+        total * (1.0 - whale_share)
+    doc["histograms"][labeled(H_ADMIT_WAIT, tenant="minnow")] = \
+        _hist_snap([minnow_wait] * admits)
+    doc["histograms"][labeled(H_ADMIT_WAIT, tenant="whale")] = \
+        _hist_snap([5.0] * admits)
+    doc["histograms"][labeled(H_ADMIT_CROSS, tenant="minnow")] = \
+        _hist_snap([minnow_cross] * admits)
+    doc["histograms"][labeled(H_ADMIT_CROSS, tenant="whale")] = \
+        _hist_snap([0.0] * admits)
+    # tenant-attributed completed reports give the evidence wall
+    for r in doc["exchange_reports"]:
+        r["tenant"] = "minnow"
+        r["pack_ms"] = 2.0
+        r["admit_wait_ms"] = 0.0
+    return doc
+
+
+def test_quota_starvation_fires_and_names_both_tenants():
+    fs = [f for f in diagnose(_tenant_doc())
+          if f.rule == "quota_starvation"]
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.evidence["starved_tenant"] == "minnow"
+    assert f.evidence["hog_tenant"] == "whale"
+    assert f.evidence["cross_grants_p99"] >= 8
+    assert f.conf_key == "spark.shuffle.tpu.tenant.whale.maxBytesInFlight"
+    assert "minnow" in f.summary and "whale" in f.summary
+    assert "priority" in f.remediation
+
+
+def test_quota_starvation_critical_on_deep_flood():
+    # a whole whale queue (>= quota_cross_critical grants) passed the
+    # minnow repeatedly — critical territory
+    fs = [f for f in diagnose(_tenant_doc(minnow_cross=30.0))
+          if f.rule == "quota_starvation"]
+    assert fs and fs[0].grade == "critical"
+
+
+def test_quota_starvation_quiet_goldens():
+    # (a) fair share working: long waits but only a couple of
+    # cross-grants — the minnow queued behind ITS OWN reads
+    assert [f for f in diagnose(_tenant_doc(minnow_cross=2.0))
+            if f.rule == "quota_starvation"] == []
+    # (b) no hog: waits + cross-grants but granted bytes are balanced
+    assert [f for f in diagnose(_tenant_doc(whale_share=0.5))
+            if f.rule == "quota_starvation"] == []
+    # (c) healthy single-tenant cluster: rule needs >= 2 tenants
+    assert [f for f in diagnose(_healthy_doc())
+            if f.rule == "quota_starvation"] == []
+
+
+def test_quota_starvation_sub_noise_floors():
+    # waits under the floor: being passed by fast grants is not harm
+    assert [f for f in diagnose(_tenant_doc(minnow_wait=100.0))
+            if f.rule == "quota_starvation"] == []
+    # too few admissions for a p99 verdict
+    assert [f for f in diagnose(_tenant_doc(admits=2))
+            if f.rule == "quota_starvation"] == []
