@@ -366,22 +366,31 @@ class ShuffleServiceV2:
         whose ``consume()`` hands the buffers — donation-safe, zero
         D2H — to a jitted consumer step. UNLIKE :meth:`reader`, the
         result is single-consumer (consume takes the buffers) and is
-        therefore NOT cached/shared; the dependency's combine/ordered
-        options must be off (those merges are host-side — the manager
-        resolves them back to the host sink)."""
+        therefore NOT cached/shared. The dependency's combine/ordered
+        options are device-legal (the merges run on device — the
+        exchange step's in-step merge single-shot, the compiled
+        cross-wave fold waved), so aggregation-shaped dependencies get
+        the zero-D2H path too."""
         dep = self._deps.get(handle.shuffle_id)
         if dep is None:
             raise KeyError(f"shuffle {handle.shuffle_id} not registered "
                            f"through this adapter")
-        if dep.combine or dep.ordered:
-            # fail CLOSED here rather than let the manager's host
-            # fallback hand this device-expecting caller a lazy result
-            # whose .consume() dies with a bare AttributeError
-            raise ValueError(
-                f"read_device on shuffle {handle.shuffle_id}: the "
-                f"dependency declares combine={dep.combine!r}/"
-                f"ordered={dep.ordered} — those merges are host-side; "
-                f"use reader() (the numpy contract) for this shuffle")
+        # pre-check the demotion causes that are pure manager facts —
+        # failing closed AFTER the read would pay the whole exchange
+        # collective just to discard the result
+        reason = None
+        if self.manager.conf.read_sink == "host":
+            reason = "conf read.sink=host pins the drain"
+        elif self.manager.node.is_distributed:
+            reason = "distributed reads force-materialize host-side"
+        elif self.manager.hierarchical:
+            reason = "the hierarchical two-stage exchange drains " \
+                     "host-side"
+        if reason is not None:
+            raise RuntimeError(
+                f"read_device on shuffle {handle.shuffle_id}: this "
+                f"read would resolve to the host sink ({reason}) — "
+                f"use reader() here, or lift the conf pin")
         res = self.manager.read(handle, timeout=timeout,
                                 combine=dep.combine, ordered=dep.ordered,
                                 combine_sum_words=dep.combine_sum_words,

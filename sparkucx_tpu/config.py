@@ -87,7 +87,8 @@ class TpuShuffleConf:
         "coordinator_address", "meta_buffer_size", "min_buffer_size",
         "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
         "spill_threshold", "spill_dir", "a2a_impl", "a2a_wire",
-        "read_sink", "wire_error_sample_rows", "sort_impl",
+        "read_sink", "read_merge_impl", "wire_error_sample_rows",
+        "sort_impl",
         "sort_strips", "combine_compaction", "fetch_granularity",
         "capacity_factor", "cap_buckets", "cap_bucket_growth",
         "wave_rows", "wave_depth", "pack_threads",
@@ -430,17 +431,37 @@ class TpuShuffleConf:
         drain codec), ``device`` (partitions stay sharded jax Arrays and
         the result hands them — donation-safe, zero D2H — straight to a
         jitted consumer step: reader.DeviceShuffleReaderResult.consume;
-        the MoE expert-dispatch path), or ``auto`` (default — host
-        unless the consumer declares a device sink per read,
-        ``manager.read(..., sink="device")``). The manager resolves the
-        tier per read: distributed / hierarchical / combine / ordered
-        reads need host-side merges and fall back to host with a
-        warn-once log, and the report's ``sink`` field names the tier
-        that actually ran (the resolved-impl discipline). The allowed
-        set lives in ONE place — shuffle/alltoall.ALLOWED_SINKS."""
+        the MoE expert-dispatch and groupby-aggregate paths), or
+        ``auto`` (default — host unless the consumer declares a device
+        sink per read, ``manager.read(..., sink="device")``). Legal for
+        ALL FOUR read modes on the single-process flat exchange —
+        ordered/combine land fully merged on device (the exchange
+        step's in-step merge single-shot; reader.device_merge_fold for
+        waved reads). The manager resolves the tier per read:
+        distributed / hierarchical reads still need host-side
+        materialization and fall back to host with a warn-once log AND
+        a counted ``shuffle.sink.fallback.count`` (the doctor's
+        sink_fallback evidence); the report's ``sink`` field names the
+        tier that actually ran (the resolved-impl discipline). The
+        allowed set lives in ONE place — shuffle/alltoall
+        .ALLOWED_SINKS."""
         from sparkucx_tpu.shuffle.alltoall import validate_sink
         return validate_sink(self._get("read.sink", "auto"),
                              conf_key=PREFIX + "read.sink")
+
+    @property
+    def read_merge_impl(self) -> str:
+        """How the ordered/combine DEVICE sink folds per-wave key-sorted
+        runs on device (reader.device_merge_fold): ``auto`` (default —
+        resolves to jnp, the XLA sort-network formulation), ``jnp``, or
+        ``pallas`` (the ops/pallas/segmented.py merge / segment-reduce
+        kernels — the measured alternative; a combine whose value dtype
+        the kernel cannot accumulate falls back to jnp with a log
+        line). The allowed set lives in ONE place —
+        shuffle/alltoall.ALLOWED_MERGE_IMPLS."""
+        from sparkucx_tpu.shuffle.alltoall import validate_merge_impl
+        return validate_merge_impl(self._get("read.mergeImpl", "auto"),
+                                   conf_key=PREFIX + "read.mergeImpl")
 
     @property
     def wire_error_sample_rows(self) -> int:

@@ -1,0 +1,325 @@
+"""Device-native segmented merge & segment-reduce — the on-device half
+of the ``ordered`` and ``combine`` read modes (ROADMAP item 3).
+
+The host used to be the merge engine: per-wave key-sorted runs came back
+D2H and ``reader.merge_sorted_rows`` / ``reader.combine_packed_rows``
+restored the cross-wave contract in numpy — the one aggregation-shaped
+round-trip left after the device sink deleted the plain/shard drain.
+This module moves that merge into the compiled step, in the Ragged Paged
+Attention posture (PAPERS.md): ragged-native device kernels beat host
+fallbacks at any realistic shape, so the fold over wave buffers should
+happen where the buffers already live.
+
+Two primitives, each with a jnp/XLA PRIMARY path and a Pallas kernel in
+the ``ops/pallas`` lineage (``ragged_a2a.py`` discipline: feature-
+detected ``_compiler_params`` shim, an ``interpret_supported()`` gate
+tests/bench consult, interpret resolution from the backend at trace
+time):
+
+* :func:`merge_rows` — merge TWO partition-major key-sorted row buffers
+  into one, sentinel-padded rows last. jnp path: one batched
+  ``keysort_rows`` over the concatenation (a sort network subsumes the
+  merge — the scatter/gather-free formulation every step body uses).
+  Pallas path: a two-pointer sequential merge (the classic merge
+  kernel; O(n) work vs the sort's O(n log^2 n), but scalar-sequential —
+  the measured-alternative seed for a blocked merge-path kernel, not
+  the default).
+* :func:`segment_reduce_rows` — reduce runs of equal (partition, key)
+  in an ALREADY-SORTED buffer to one row each: the leading
+  ``sum_words`` transport words accumulate (float32 accumulation for
+  float schemas, int32 ring arithmetic for ints — the
+  ``reader.combine_packed_rows`` numerics, which themselves mirror
+  ``ops/aggregate.combine_rows``), the remaining value words are
+  CARRIED per key (per-key-constant payload: any representative is THE
+  value). jnp path: ``combine_rows`` (its grouping sort is a no-op cost
+  on sorted input but keeps one code path). Pallas path: a sequential
+  run-accumulator kernel writing compacted rows in place.
+
+Transport rows are the reader's fused int32 format: cols 0,1 = int64
+key as [lo, hi]; key order is signed int64 = lexicographic (hi signed,
+lo unsigned via the ``_FLIP`` trick — see ops/aggregate's module
+docstring). Partition ids arrive as an explicit per-row lane with the
+SENTINEL ``num_parts`` marking invalid rows (the pallas step body's
+densify idiom), because validity is not a prefix once two buffers
+concatenate.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from sparkucx_tpu.ops.partition import counts_from_sorted
+
+_FLIP = np.int32(-0x80000000)   # two's-complement 0x8000_0000
+
+
+def _compiler_params(**kw):
+    """Pallas compiler-params across jax generations (the ragged_a2a
+    shim): TPUCompilerParams -> CompilerParams rename, same fields."""
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
+def interpret_supported() -> bool:
+    """Whether THIS jax can run the kernels in interpret mode. Unlike
+    the remote-DMA transport (ragged_a2a needs ``pltpu.InterpretParams``
+    to simulate cross-device copies), these are compute-only kernels —
+    the boolean ``interpret=True`` path works on every jax generation —
+    so the gate is a constant True. It exists so callers/tests consult
+    ONE predicate per kernel module, the ops/pallas gating contract."""
+    return True
+
+
+def _resolve_interpret(interpret) -> bool:
+    """None -> interpret iff the default backend is CPU (trace-time
+    resolution, the ragged_a2a idiom — pin explicitly when tracing for
+    a backend other than the host's)."""
+    if interpret is None:
+        return jax.default_backend() == "cpu"
+    return bool(interpret)
+
+
+# -- merge -----------------------------------------------------------------
+
+def _merge_kernel(a_ref, ap_ref, b_ref, bp_ref, o_ref, op_ref):
+    """Two-pointer merge of two (partition, key)-sorted row buffers.
+
+    Sequential over the output (fori_loop, dynamic-index loads/stores):
+    correct on the interpreter and compilable on TPU, but scalar-bound —
+    the jnp sort path is the production default; this kernel is the
+    lineage seed for a blocked merge-path version (grid over output
+    tiles, binary-search partition at tile boundaries)."""
+    ca = a_ref.shape[0]
+    cb = b_ref.shape[0]
+
+    def body(i, carry):
+        ia, ib = carry
+        ia_c = jnp.minimum(ia, ca - 1)
+        ib_c = jnp.minimum(ib, cb - 1)
+        ra = a_ref[pl.ds(ia_c, 1), :]          # [1, W]
+        rb = b_ref[pl.ds(ib_c, 1), :]
+        pa = ap_ref[ia_c, 0]
+        pb = bp_ref[ib_c, 0]
+        # composite (partition, key_hi signed, key_lo unsigned) compare;
+        # ties take A — stability across the fold is unspecified either
+        # way (the ordered contract is key order, not tie order)
+        ha, la = ra[0, 1], ra[0, 0] ^ _FLIP
+        hb, lb = rb[0, 1], rb[0, 0] ^ _FLIP
+        a_le = (pa < pb) | ((pa == pb) & (
+            (ha < hb) | ((ha == hb) & (la <= lb))))
+        take_a = (a_le & (ia < ca)) | (ib >= cb)
+        o_ref[pl.ds(i, 1), :] = jnp.where(take_a, ra, rb)
+        op_ref[pl.ds(i, 1), :] = jnp.where(
+            take_a, pa, pb).reshape(1, 1)
+        ta = take_a.astype(jnp.int32)
+        return (ia + ta, ib + (1 - ta))
+
+    jax.lax.fori_loop(0, ca + cb, body,
+                      (jnp.int32(0), jnp.int32(0)))
+
+
+def _merge_pallas(a_rows, a_part, b_rows, b_part, interpret: bool):
+    ca, W = a_rows.shape
+    cb = b_rows.shape[0]
+    return pl.pallas_call(
+        _merge_kernel,
+        out_shape=(jax.ShapeDtypeStruct((ca + cb, W), jnp.int32),
+                   jax.ShapeDtypeStruct((ca + cb, 1), jnp.int32)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 4,
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(a_rows, a_part.reshape(ca, 1), b_rows, b_part.reshape(cb, 1))
+
+
+def merge_rows(
+    a_rows: jnp.ndarray, a_part: jnp.ndarray,
+    b_rows: jnp.ndarray, b_part: jnp.ndarray,
+    num_parts: int, *, impl: str = "jnp", interpret=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Merge two partition-major key-sorted buffers into one.
+
+    a_rows/b_rows — [ca, W] / [cb, W] int32 transport rows, each sorted
+    by (partition, signed int64 key) with invalid rows LAST.
+    a_part/b_part — [ca] / [cb] int32 partition ids, SENTINEL
+    ``num_parts`` on invalid rows (sorted with their rows).
+
+    Returns (rows [ca+cb, W], part [ca+cb], pcounts [num_parts]):
+    merged partition-major key-sorted rows, sentinels last; pcounts[r]
+    counts only real partitions."""
+    if impl == "jnp":
+        from sparkucx_tpu.ops.aggregate import keysort_rows
+        cat = jnp.concatenate([a_rows, b_rows])
+        pcat = jnp.concatenate([a_part, b_part])
+        cap = cat.shape[0]
+        spart, srows, pcounts = keysort_rows(
+            cat, pcat, jnp.int32(cap), num_parts)
+        return srows, spart, pcounts
+    if impl != "pallas":
+        raise ValueError(f"unknown merge impl {impl!r}; want jnp|pallas")
+    rows, part2 = _merge_pallas(a_rows, a_part, b_rows, b_part,
+                                _resolve_interpret(interpret))
+    part = part2.reshape(-1)
+    return rows, part, counts_from_sorted(part, num_parts)
+
+
+# -- segment reduce --------------------------------------------------------
+
+def _segreduce_kernel(rows_ref, part_ref, o_rows_ref, o_part_ref, n_ref,
+                      *, sum_words: int, float_acc: bool,
+                      num_parts: int):
+    """Run-accumulator over a (partition, key)-sorted buffer: one output
+    row per distinct (partition, key), compacted to the front; the
+    leading ``sum_words`` value words accumulate (float32 / int32 ring),
+    the rest of the representative row is carried verbatim. Sequential
+    like the merge kernel — same lineage-seed posture."""
+    cap, W = rows_ref.shape
+    o_rows_ref[:] = jnp.zeros((cap, W), jnp.int32)
+    o_part_ref[:] = jnp.full((cap, 1), num_parts, jnp.int32)
+    acc_dt = jnp.float32 if float_acc else jnp.int32
+
+    def lanes_of(row):
+        words = row[:, 2:2 + sum_words]
+        if float_acc:
+            return jax.lax.bitcast_convert_type(words, jnp.float32)
+        return words
+
+    def body(i, carry):
+        optr, pp, ph, plo, acc = carry
+        row = rows_ref[pl.ds(i, 1), :]          # [1, W]
+        p = part_ref[i, 0]
+        hi, lo = row[0, 1], row[0, 0]
+        valid = p < num_parts
+        is_new = valid & ((i == 0) | (p != pp) | (hi != ph) | (lo != plo))
+        optr2 = jnp.where(is_new, optr + 1, optr)
+        lanes = lanes_of(row)
+        acc2 = jnp.where(is_new, lanes, acc + lanes)
+
+        @pl.when(is_new)
+        def _():
+            # representative row: key words + carried lanes verbatim
+            o_rows_ref[pl.ds(optr2, 1), :] = row
+            o_part_ref[pl.ds(optr2, 1), :] = p.reshape(1, 1)
+
+        @pl.when(valid)
+        def _():
+            words = acc2 if not float_acc else \
+                jax.lax.bitcast_convert_type(acc2, jnp.int32)
+            o_rows_ref[pl.ds(optr2, 1), 2:2 + sum_words] = words
+
+        return (optr2, p, hi, lo, acc2)
+
+    optr, _, _, _, _ = jax.lax.fori_loop(
+        0, cap, body,
+        (jnp.int32(-1), jnp.int32(num_parts), jnp.int32(0), jnp.int32(0),
+         jnp.zeros((1, sum_words), acc_dt)))
+    n_ref[0, 0] = optr + 1
+
+
+def _segreduce_pallas(rows, part, num_parts: int, sum_words: int,
+                      float_acc: bool, interpret: bool):
+    cap, W = rows.shape
+    return pl.pallas_call(
+        functools.partial(_segreduce_kernel, sum_words=sum_words,
+                          float_acc=float_acc, num_parts=num_parts),
+        out_shape=(jax.ShapeDtypeStruct((cap, W), jnp.int32),
+                   jax.ShapeDtypeStruct((cap, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((1, 1), jnp.int32)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 2,
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM)),
+        interpret=interpret,
+    )(rows, part.reshape(cap, 1))
+
+
+def pallas_reduce_supported(val_dtype) -> bool:
+    """The pallas segment-reduce accumulates whole int32 transport words
+    in registers, so only 4-byte value dtypes (float32/int32/uint32)
+    ride it; sub-word schemas (int8/16, float16) keep the jnp path —
+    their lanes pack several values per word and the in-register ring
+    arithmetic would carry across element boundaries."""
+    return np.dtype(val_dtype).itemsize == 4
+
+
+def segment_reduce_rows(
+    rows: jnp.ndarray, part: jnp.ndarray, num_parts: int,
+    val_words: int, val_dtype, op: str = "sum", sum_words: int = 0,
+    compaction: str = "stable", *, impl: str = "jnp", interpret=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One row per distinct (partition, key): sum the leading
+    ``sum_words`` value words (0 = the whole value row), carry the rest.
+
+    ``rows``/``part`` follow the :func:`merge_rows` output contract —
+    the pallas path REQUIRES sorted input (it is a linear run scan); the
+    jnp path (``ops/aggregate.combine_rows``) sorts internally, so it
+    accepts any order and is the production default.
+
+    Returns (rows_out [cap, W], pcounts [num_parts], n_out [1])."""
+    if op != "sum":
+        raise ValueError(f"unknown combiner {op!r}")
+    vdt = np.dtype(val_dtype)
+    if impl == "jnp":
+        from sparkucx_tpu.ops.aggregate import combine_rows
+        return combine_rows(rows, part, jnp.int32(rows.shape[0]),
+                            num_parts, val_words, vdt, op,
+                            sum_words=sum_words, compaction=compaction)
+    if impl != "pallas":
+        raise ValueError(f"unknown reduce impl {impl!r}; want jnp|pallas")
+    if not pallas_reduce_supported(vdt):
+        raise ValueError(
+            f"pallas segment-reduce needs a 4-byte value dtype, got "
+            f"{vdt} — use impl='jnp' (pallas_reduce_supported gates)")
+    sw = sum_words if sum_words > 0 else val_words
+    rows_out, part2, n = _segreduce_pallas(
+        rows, part, num_parts, sw,
+        float_acc=np.issubdtype(vdt, np.floating),
+        interpret=_resolve_interpret(interpret))
+    pcounts = counts_from_sorted(part2.reshape(-1), num_parts)
+    return rows_out, pcounts, n.reshape(1)
+
+
+def merge_reduce_rows(
+    a_rows: jnp.ndarray, a_part: jnp.ndarray,
+    b_rows: jnp.ndarray, b_part: jnp.ndarray,
+    num_parts: int, val_words: int, val_dtype, op: str = "sum",
+    sum_words: int = 0, compaction: str = "stable",
+    *, impl: str = "jnp", interpret=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Merge two combined buffers AND re-reduce by key — one fold step
+    of the device combine (a key spanning both inputs has one row in
+    each; the reduce restores one row total, summed/carried lanes per
+    the :func:`segment_reduce_rows` split).
+
+    jnp path: one ``combine_rows`` over the concatenation (its grouping
+    sort does the merge for free). Pallas path: merge kernel then
+    segment-reduce kernel — both sequential lineage kernels.
+
+    Returns (rows_out [ca+cb, W], pcounts [num_parts], n_out [1])."""
+    if impl == "jnp":
+        from sparkucx_tpu.ops.aggregate import combine_rows
+        cat = jnp.concatenate([a_rows, b_rows])
+        pcat = jnp.concatenate([a_part, b_part])
+        return combine_rows(cat, pcat, jnp.int32(cat.shape[0]),
+                            num_parts, val_words, np.dtype(val_dtype),
+                            op, sum_words=sum_words,
+                            compaction=compaction)
+    rows, part, _ = merge_rows(a_rows, a_part, b_rows, b_part,
+                               num_parts, impl=impl,
+                               interpret=interpret)
+    return segment_reduce_rows(rows, part, num_parts, val_words,
+                               val_dtype, op, sum_words=sum_words,
+                               compaction=compaction, impl=impl,
+                               interpret=interpret)
+
+
+__all__ = ["merge_rows", "segment_reduce_rows", "merge_reduce_rows",
+           "interpret_supported", "pallas_reduce_supported"]

@@ -105,6 +105,36 @@ ALLOWED_SINKS = ("host", "device", "auto")
 READ_SINK_KEY = "spark.shuffle.tpu.read.sink"
 
 
+# Device-merge implementations (conf key ``spark.shuffle.tpu.read.
+# mergeImpl``) — how the ordered/combine device sink folds per-wave
+# key-sorted runs on device (ops/pallas/segmented.py):
+#
+# ``auto``   — resolve to ``jnp`` (the XLA sort-network formulation is
+#              the measured production path on every backend today; the
+#              pallas kernels are the opt-in measured alternative).
+# ``jnp``    — batched keysort / combine_rows over the concatenation.
+# ``pallas`` — the sequential merge / segment-reduce kernels; combine
+#              additionally needs a 4-byte value dtype
+#              (segmented.pallas_reduce_supported) or the fold falls
+#              back to jnp with a log line.
+ALLOWED_MERGE_IMPLS = ("auto", "jnp", "pallas")
+
+READ_MERGE_IMPL_KEY = "spark.shuffle.tpu.read.mergeImpl"
+
+
+def validate_merge_impl(impl: str,
+                        conf_key: str = READ_MERGE_IMPL_KEY) -> str:
+    """The one validation seam for the device-merge impl set (the
+    validate_impl/validate_wire/validate_sink discipline): config.py and
+    the reader's fold resolve accept exactly ``ALLOWED_MERGE_IMPLS``."""
+    if impl not in ALLOWED_MERGE_IMPLS:
+        raise ValueError(
+            f"{conf_key}={impl!r}: want one of {ALLOWED_MERGE_IMPLS} "
+            f"(jnp = XLA sort-network merge, pallas = the "
+            f"ops/pallas/segmented.py kernels, auto = jnp)")
+    return impl
+
+
 def validate_sink(sink: str, conf_key: str = READ_SINK_KEY) -> str:
     """The one validation seam for the read-sink tier set: config.py,
     the manager's per-read resolve and the bench CLI accept exactly

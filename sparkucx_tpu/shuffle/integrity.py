@@ -335,6 +335,79 @@ def aggregate_digests(entry, num_maps: int, key_only: bool
 
 
 # -- fault injection (the `corrupt` site) ----------------------------------
+def host_partition_ids(keys: np.ndarray, num_partitions: int,
+                       partitioner: str = "hash",
+                       bounds=None) -> np.ndarray:
+    """Host twin of the device partitioners (ops/partition.py) over
+    int64 keys — bit-for-bit the routing the compiled step ran, so a
+    post-collective check can re-derive where every received key MUST
+    have been sent. hash: the 32-bit mixing hash over the low key word
+    (exactly what hash_partition consumes); direct: the clipped key;
+    range: searchsorted over the static split points (side='right' =
+    #(b <= key), matching range_partition_words)."""
+    keys = np.asarray(keys, dtype=np.int64)
+    if partitioner == "direct":
+        # the device clips the LOW int32 word (reader._make_part_fn
+        # reads rows[:, 0]), not the full int64 — mirror it exactly or
+        # a >int32 key verifies against a partition the step never
+        # computed
+        lo = (keys & np.int64(0xFFFFFFFF)).astype(np.uint32) \
+            .view(np.int32)
+        return np.clip(lo.astype(np.int64), 0, num_partitions - 1)
+    if partitioner == "range":
+        b = np.asarray(bounds, dtype=np.int64)
+        return np.searchsorted(b, keys, side="right").astype(np.int64)
+    from sparkucx_tpu.shuffle.writer import _hash32_np
+    return (_hash32_np(keys)
+            % np.uint32(num_partitions)).astype(np.int64)
+
+
+def verify_key_routing(rows: np.ndarray, totals: np.ndarray,
+                       num_partitions: int, num_shards: int,
+                       partitioner: str = "hash", bounds=None) -> int:
+    """Post-collective key-lane check over a DEVICE receive buffer's
+    host-side copy (the ``integrity.verify=full`` posture for device-
+    sink reads): every valid row on shard p must carry a key whose
+    partition — re-derived through the exact host twin of the device
+    routing — lies in the partition range the blocked map assigns p.
+    Key lanes are exact on EVERY wire tier (the int8 wire narrows value
+    lanes only), so this holds bit-for-bit even where the per-row
+    digests cannot (combine legitimately rewrites rows; dequantized
+    values are legitimately lossy).
+
+    ``rows`` — [P*cap, width] int32 transport rows; ``totals`` — [P]
+    valid-row counts per shard. Returns verified KEY bytes; raises
+    :class:`_StagedMismatch` naming the shard and the stray partition
+    on any violation (the manager wraps it typed)."""
+    from sparkucx_tpu.ops.partition import blocked_partition_map
+    rows = np.asarray(rows)
+    totals = np.asarray(totals, dtype=np.int64).reshape(-1)
+    cap = rows.shape[0] // max(num_shards, 1)
+    p2d = np.asarray(blocked_partition_map(num_partitions, num_shards))
+    verified = 0
+    for s in range(num_shards):
+        n = int(totals[s])
+        if n <= 0:
+            continue
+        blk = rows[s * cap:s * cap + min(n, cap)]
+        keys = np.ascontiguousarray(blk[:, :2]).view(np.int64).ravel()
+        part = host_partition_ids(keys, num_partitions, partitioner,
+                                  bounds)
+        owner = p2d[np.clip(part, 0, num_partitions - 1)]
+        bad = np.nonzero((owner != s)
+                         | (part < 0) | (part >= num_partitions))[0]
+        if bad.size:
+            i = int(bad[0])
+            raise _StagedMismatch(
+                f"shard {s} row {i}: key {int(keys[i])} routes to "
+                f"partition {int(part[i])} (owner shard "
+                f"{int(owner[i]) if 0 <= part[i] < num_partitions else '?'}) "
+                f"— delivered to the wrong shard, or key lanes "
+                f"corrupted in flight")
+        verified += int(keys.nbytes)
+    return verified
+
+
 class _FlipToken:
     """One injected bit flip + how to undo it. The corrupt site models
     TRANSIENT corruption — a flipped bit observed in flight: the flip

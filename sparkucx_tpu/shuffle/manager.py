@@ -60,6 +60,7 @@ from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES,
                                         C_INTEGRITY_RECOVERED,
                                         C_INTEGRITY_VERIFIED,
                                         C_REPLAY_MS, C_REPLAYS,
+                                        C_SINK_FALLBACK,
                                         COMPILE_HITS, COMPILE_PROGRAMS,
                                         G_TENANT_INFLIGHT,
                                         GLOBAL_METRICS, H_ADMIT_CROSS,
@@ -217,6 +218,13 @@ class ExchangeReport:
     # host_roundtrip rule and bench --stage devread grade.
     sink: str = "host"
     d2h_bytes: int = 0
+    # Device-native ordered/combine (read.sink=device): wall the
+    # cross-wave DEVICE merge fold spent (reader.device_merge_fold —
+    # compiled merge programs over the completed waves, blocked for an
+    # honest figure). 0.0 on host sinks, single-shot device reads (the
+    # exchange step already merged) and plain device reads. The
+    # bench --stage devcombine merge-leg gate reads this.
+    merge_ms: float = 0.0
     # Multi-tenant plane (shuffle/tenancy.py): the tenant this shuffle
     # was registered under (conf tenant.id, or the register_shuffle
     # override) — the join key between this report, the per-tenant
@@ -926,15 +934,15 @@ class TpuShuffleManager:
             return
         rep._full_done = True
         if getattr(res, "sink", "host") == "device":
-            # the full-level digest check is host-side by design (it
-            # re-reads drained rows) — a device-sink result never
-            # drains, so forcing it here would re-pay the round-trip
-            # the sink deletes; staged verify already ran at pack time
-            self._warn_integrity_once(
-                "full_device",
-                "integrity.verify=full: device-sink reads verify at the "
-                "staged level only — the post-collective digest check "
-                "is host-side, and the device sink exists to not drain")
+            # device sink: the full digest check is host-side by design
+            # and forcing the whole drain would re-pay the round-trip
+            # the sink deletes — but silently downgrading to staged was
+            # dishonest. Instead verify the EXACT lanes the wire
+            # contract guarantees (keys + partition routing) on ONE
+            # SAMPLED wave through a host-side COPY (device buffers
+            # stay live for the consumer), counting the sampled D2H
+            # bytes honestly in shuffle.read.d2h.bytes / the report.
+            self._verify_full_device(handle, res, rep)
             return
         if combine:
             self._warn_integrity_once(
@@ -985,6 +993,67 @@ class TpuShuffleManager:
             verified += int(k.nbytes) + (int(v.nbytes)
                                          if v is not None
                                          and not key_only else 0)
+        self.node.metrics.inc(C_INTEGRITY_VERIFIED, float(verified))
+        rep.integrity = "full"
+        rep.integrity_bytes += verified
+
+    def _verify_full_device(self, handle: ShuffleHandle, res,
+                            rep) -> None:
+        """``integrity.verify=full`` over a DEVICE-sink result: sample
+        the FIRST wave's KEY LANES (single-shot reads are one wave;
+        waved ordered/combine reads land one MERGED view, so the sample
+        covers the whole fold; a waved PLAIN device read is sampled at
+        wave 0 only — the ISSUE-12 sampled-wave contract, with
+        ``integrity_bytes`` recording exactly what was checked) as a
+        host-side copy and re-derive every key's partition through the
+        host twin of the device routing (integrity.verify_key_routing).
+        Works for ALL modes — combine included, where per-row digests
+        cannot survive the rewrite — and under every wire tier (key
+        lanes are exact). Only the two key-lane columns transfer (the
+        check reads nothing else), and the sampled pull is REAL D2H,
+        charged to the read (``shuffle.read.d2h.bytes`` +
+        ``ExchangeReport.d2h_bytes``) — the honest cost of
+        verification, never smuggled. The pallas transport's
+        chunk-ALIGNED plain layout (pad rows INSIDE segments — valid
+        rows are not a prefix) cannot ride the prefix-based check and
+        keeps the staged-only posture, warn-once."""
+        from sparkucx_tpu.shuffle import integrity as integ
+        from sparkucx_tpu.shuffle.reader import _note_d2h
+        views = res.wave_views()
+        if not views:
+            return
+        v = views[0]
+        if getattr(v, "_align_chunk", 0):
+            # chunk-aligned receive layout (pallas plain / strip sort):
+            # per-segment pad rows sit between valid runs, so the
+            # prefix slice would "verify" junk — or falsely flag it
+            self._warn_integrity_once(
+                "full_device_aligned",
+                "integrity.verify=full: device-sink reads on a "
+                "chunk-aligned receive layout (pallas plain / strip "
+                "sort) verify at the staged level only — valid rows "
+                "are not a dense prefix there")
+            return
+        with v._fetch_lock:
+            rows_dev = v._rows_dev
+            totals_dev = v._totals_dev
+        if rows_dev is None or totals_dev is None:
+            return        # already drained/consumed: nothing to sample
+        # key lanes only: the check reads cols 0..1 of the valid prefix
+        rows = np.asarray(rows_dev[:, :2])   # COPY — buffers stay live
+        _note_d2h(v, rows.nbytes)
+        totals = np.asarray(totals_dev).reshape(-1)
+        try:
+            verified = integ.verify_key_routing(
+                rows, totals, handle.num_partitions,
+                self.node.num_devices, partitioner=handle.partitioner,
+                bounds=handle.bounds)
+        except integ._StagedMismatch as e:
+            raise BlockCorruptionError(self._note_corruption(
+                handle.shuffle_id,
+                "device receive buffer (post-collective, key lanes, "
+                "sampled wave 0)",
+                int(rows.nbytes), str(e))) from None
         self.node.metrics.inc(C_INTEGRITY_VERIFIED, float(verified))
         rep.integrity = "full"
         rep.integrity_bytes += verified
@@ -2304,6 +2373,13 @@ class TpuShuffleManager:
         wv = getattr(result, "wave_views", None)
         if wv is not None:
             for v in wv():
+                # pre-arming pulls parked on the VIEW flush too: the
+                # full-level device sampling runs inside result() via
+                # _post_result, BEFORE on_done arms this callback
+                early = getattr(v, "_d2h_early", 0)
+                if early:
+                    v._d2h_early = 0
+                    cb(early)
                 v._d2h_cb = cb
 
     # -- capacity learning -------------------------------------------------
@@ -2346,6 +2422,18 @@ class TpuShuffleManager:
             self._warned_sink.add(key)
             log.warning(msg)
 
+    def _note_sink_fallback(self, mode: str, reason_key: str) -> None:
+        """A read that ASKED for the device sink landed on host: the
+        graded evidence behind the doctor's ``sink_fallback`` rule —
+        the cumulative counter plus a labeled twin naming the read mode
+        (plain/ordered/combine) and the fallback reason, so the finding
+        can say WHICH aggregation-shaped reads are still paying the
+        round-trip and why."""
+        m = self.node.metrics
+        m.inc(C_SINK_FALLBACK, 1.0)
+        m.inc(labeled(C_SINK_FALLBACK, mode=mode, reason=reason_key),
+              1.0)
+
     def _resolve_sink(self, requested: Optional[str],
                       combine: Optional[str] = None, ordered: bool = False,
                       distributed: bool = False) -> str:
@@ -2358,17 +2446,24 @@ class TpuShuffleManager:
 
         ``auto`` (conf default) = host unless the consumer declared a
         device sink for this read; ``device`` makes device the default
-        ask; ``host`` pins the historical drain. A device ask falls back
-        to host — warn-once, naming the reason — where the result
-        cannot stay resident: distributed reads (the partial view
-        force-materializes local shards), the hierarchical two-stage
-        exchange, and combine/ordered reads (cross-run merges are
-        host-side)."""
+        ask; ``host`` pins the historical drain. The device sink is
+        legal for ALL FOUR read modes on the single-process flat
+        exchange: plain/shard land as delivered, ordered/combine land
+        fully merged on device (single-shot: the exchange step already
+        merged; waved: reader.device_merge_fold folds the per-wave runs
+        through the compiled merge). A device ask still falls back to
+        host — warn-once AND counted (``shuffle.sink.fallback.count``,
+        the doctor's sink_fallback evidence) — where the result cannot
+        stay resident: distributed reads (the partial view
+        force-materializes local shards) and the hierarchical two-stage
+        exchange."""
         from sparkucx_tpu.shuffle.alltoall import validate_sink
         if requested is not None:
             validate_sink(requested, conf_key="read(sink=...)")
             if requested == "auto":
                 requested = None
+        mode = "combine" if combine else ("ordered" if ordered
+                                          else "plain")
         conf = self.conf.read_sink
         want = requested
         if want is None:
@@ -2379,23 +2474,25 @@ class TpuShuffleManager:
                 "read(sink='device') under spark.shuffle.tpu.read.sink="
                 "host — the conf pins the host drain; set read.sink=auto "
                 "(or device) to honor per-read device sinks")
+            self._note_sink_fallback(mode, "conf_pins_host")
             want = "host"
         if want != "device":
             return "host"
         reason = None
+        reason_key = ""
         if distributed:
             reason = ("distributed reads force-materialize their local "
                       "shards (the device sink is single-process for now)")
+            reason_key = "distributed"
         elif self.hierarchical:
             reason = "the hierarchical two-stage exchange drains host-side"
-        elif combine or ordered:
-            reason = ("combine/ordered results merge runs host-side "
-                      "(cross-wave/cross-sender key merges)")
+            reason_key = "hierarchical"
         if reason is not None:
             self._warn_sink_once(
                 "fallback_" + reason[:24],
                 f"read.sink=device resolves to host for this read: "
                 f"{reason}")
+            self._note_sink_fallback(mode, reason_key)
             return "host"
         return "device"
 
@@ -3502,11 +3599,34 @@ class PendingWaveShuffle:
             # admission reservation (HBM residency: every undrained
             # wave's receive buffer) rides the outer result and releases
             # at consume()/close().
-            from sparkucx_tpu.shuffle.reader import \
-                DeviceShuffleReaderResult
+            from sparkucx_tpu.shuffle.reader import (
+                DeviceShuffleReaderResult, device_merge_fold)
             views = [w.wave_views()[0] for w in wave_results]
             res = DeviceShuffleReaderResult(
                 views, self._outer_plan, self._val_tail, self._val_dtype)
+            if (self._outer_plan.combine or self._outer_plan.ordered) \
+                    and len(views) > 1:
+                # ordered/combine: the W per-wave key-sorted/combined
+                # runs fold through the compiled device merge (the
+                # inner result's own consume chain — every wave buffer
+                # donated into the merge program), landing the consumer
+                # ONE fully merged device view. Zero payload D2H; the
+                # merge programs count into this read's step-cache
+                # delta (finalized below), so the warm-recompile gate
+                # covers them too.
+                import jax as _jax
+                t_merge = time.perf_counter()
+                merged = device_merge_fold(res, mgr.exchange_mesh,
+                                           mgr.axis, mgr.conf)
+                # block for an honest merge wall: the wave collectives
+                # already completed (each wave's overflow verdict forced
+                # them), so this window is the merge programs alone
+                _jax.block_until_ready(merged._rows_dev)
+                rep.merge_ms = (time.perf_counter() - t_merge) * 1e3
+                res = DeviceShuffleReaderResult(
+                    [merged], self._outer_plan, self._val_tail,
+                    self._val_dtype)
+                mgr._arm_d2h(res, rep)
             res._release_hbm = self._release_admitted
         else:
             self._release_admitted()

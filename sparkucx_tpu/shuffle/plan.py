@@ -306,6 +306,25 @@ def make_plan(
     )
 
 
+def merge_family(plan: ShufflePlan, acc_cap: int, wave_cap: int,
+                 width: int, merge_impl: str) -> tuple:
+    """Compiled-program family key for the DEVICE MERGE step of an
+    ordered/combine device-sink waved read (reader.device_merge_fold) —
+    the merge/combine analog of :meth:`ShufflePlan.family`, kept here so
+    the family definition has one home. Only the fields that shape the
+    merge program ride the key: the exchange capacities (cap_in/cap_out,
+    wire) deliberately do NOT — two reads whose exchanges differ but
+    whose merge shapes agree share ONE merge program, which is what
+    keeps the warm-recompile count at zero across same-shaped reads
+    (the acceptance contract: one program per (shape family, sink,
+    mode))."""
+    return (plan.num_shards, plan.num_partitions, plan.partitioner,
+            plan.bounds, plan.combine, plan.combine_words,
+            plan.combine_dtype, plan.combine_sum_words,
+            plan.combine_compaction, plan.ordered, plan.pallas_interpret,
+            int(acc_cap), int(wave_cap), int(width), str(merge_impl))
+
+
 def plan_takes_seed(plan: ShufflePlan) -> bool:
     """Whether this plan's compiled step consumes a noise seed — i.e.
     the int8 wire tier is active. THE predicate every dispatch site

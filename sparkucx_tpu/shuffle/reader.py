@@ -1081,6 +1081,206 @@ def combine_packed_rows(blocks, val_words_n: int, val_dtype,
     return out
 
 
+# -- device-native cross-wave merge (read.sink=device, ordered/combine) ----
+
+def merge_step_body(plan: ShufflePlan, acc_cap: int, wave_cap: int,
+                    merge_impl: str):
+    """One fold step of the DEVICE cross-wave merge (call under
+    shard_map): merge the accumulator's rows with one wave's delivered
+    rows — key-sorted merge for ``ordered``, merge + segment-reduce for
+    ``combine`` (ops/pallas/segmented.py holds both formulations; the
+    numerics mirror :func:`combine_packed_rows` by construction —
+    float32 accumulation, integer ring arithmetic, carried lanes).
+
+    Validity is sentinel-encoded (partition id R on invalid rows)
+    because neither input's valid rows form a joint prefix after
+    concatenation. Output rows are sliced back to ``acc_cap`` — the
+    accumulator capacity is derived from the REAL per-shard delivered
+    totals across all waves (device_merge_fold), so every surviving row
+    fits by construction and the step needs no overflow plumbing."""
+    R = plan.num_partitions
+    part_fn = _make_part_fn(plan, R)
+
+    from sparkucx_tpu.ops.pallas.segmented import (merge_reduce_rows,
+                                                   merge_rows)
+
+    def step(acc_rows, acc_n, wave_rows, wave_n):
+        # acc_rows [acc_cap, W]; acc_n [1]; wave_rows [wave_cap, W];
+        # wave_n [1] — all per shard
+        pa = jnp.where(
+            jnp.arange(acc_cap, dtype=jnp.int32) < acc_n[0],
+            part_fn(acc_rows), jnp.int32(R))
+        pw = jnp.where(
+            jnp.arange(wave_cap, dtype=jnp.int32) < wave_n[0],
+            part_fn(wave_rows), jnp.int32(R))
+        if plan.combine:
+            rows_out, pcounts, _ = merge_reduce_rows(
+                acc_rows, pa, wave_rows, pw, R, plan.combine_words,
+                np.dtype(plan.combine_dtype), plan.combine,
+                sum_words=plan.combine_sum_words,
+                compaction=plan.combine_compaction, impl=merge_impl,
+                interpret=plan.pallas_interpret)
+        else:
+            rows_out, _, pcounts = merge_rows(
+                acc_rows, pa, wave_rows, pw, R, impl=merge_impl,
+                interpret=plan.pallas_interpret)
+        # real rows only: sentinel groups (junk past the valid counts)
+        # sort last and must not inflate the carry's valid count — the
+        # pallas step body's pcounts-not-group-count discipline
+        total = pcounts.sum().astype(jnp.int32).reshape(1)
+        return rows_out[:acc_cap], pcounts.reshape(1, R), total
+
+    return step
+
+
+def _build_merge_step(mesh: Mesh, axis: str, plan: ShufflePlan,
+                      acc_cap: int, wave_cap: int, width: int,
+                      merge_impl: str):
+    """The device merge program for one (merge family) — served from the
+    shared step cache so ordered/combine device reads keep the
+    one-program-per-family contract (plan.merge_family deliberately
+    drops the exchange capacities). The accumulator is DONATED — the
+    fold is its last consumer, so XLA may alias the output into its
+    HBM; the wave buffer frees through consume()'s dropped references."""
+    from sparkucx_tpu.shuffle.plan import merge_family
+    from sparkucx_tpu.shuffle.stepcache import GLOBAL_STEP_CACHE
+    fam = merge_family(plan, acc_cap, wave_cap, width, merge_impl)
+    return GLOBAL_STEP_CACHE.get(
+        ("devmerge", mesh, axis) + fam,
+        lambda: _build_merge_step_uncached(mesh, axis, plan, acc_cap,
+                                           wave_cap, width, merge_impl),
+        {"kind": "devmerge", "acc_cap": acc_cap, "wave_cap": wave_cap,
+         "width": width, "impl": merge_impl,
+         "mode": "combine" if plan.combine else "ordered"})
+
+
+def _build_merge_step_uncached(mesh: Mesh, axis: str, plan: ShufflePlan,
+                               acc_cap: int, wave_cap: int, width: int,
+                               merge_impl: str):
+    step = merge_step_body(plan, acc_cap, wave_cap, merge_impl)
+    sm = jax.shard_map(step, mesh=mesh, in_specs=(P(axis),) * 4,
+                       out_specs=(P(axis), P(axis), P(axis)),
+                       check_vma=False)
+    # donate the ACCUMULATOR only: the wave buffer's last reference is
+    # dropped by consume() before the call, so its HBM frees either way,
+    # and XLA flags the differently-shaped wave operand as an unusable
+    # donation (a per-call warning) when it cannot alias it into the
+    # acc-shaped output
+    return jax.jit(sm, donate_argnums=(0,))
+
+
+def _build_seed_acc(mesh: Mesh, axis: str, acc_cap: int, wave_cap: int,
+                    width: int, num_parts: int):
+    """The fold's FIRST step: seed the accumulator from wave 0 WITHOUT
+    a merge — the wave's rows are already partition-major key-sorted
+    (the exchange step merged within the wave) and its [1, R] seg row
+    is already the accumulator's partition counts, so seeding is a
+    pad/truncate to ``acc_cap`` (valid rows are a prefix and fit by the
+    acc sizing), not a sort. Saves one full merge program per read —
+    on dispatch-bound backends that is a whole launch."""
+    from sparkucx_tpu.shuffle.stepcache import GLOBAL_STEP_CACHE
+
+    def build():
+        def seed(rows, seg, nv):
+            if acc_cap <= wave_cap:
+                out = rows[:acc_cap]
+            else:
+                out = jnp.concatenate(
+                    [rows, jnp.zeros((acc_cap - wave_cap, width),
+                                     jnp.int32)])
+            return out, seg, nv
+        sm = jax.shard_map(seed, mesh=mesh,
+                           in_specs=(P(axis),) * 3,
+                           out_specs=(P(axis),) * 3, check_vma=False)
+        # no donation: the acc-shaped output cannot alias the wave-
+        # shaped input when the caps differ, and XLA warns per call on
+        # an unusable donation; the wave buffer frees through the
+        # dropped references either way
+        return jax.jit(sm)
+
+    return GLOBAL_STEP_CACHE.get(
+        ("devmerge-seed", mesh, axis, acc_cap, wave_cap, width,
+         num_parts), build,
+        {"kind": "devmerge-seed", "acc_cap": acc_cap,
+         "wave_cap": wave_cap, "width": width})
+
+
+def resolve_merge_impl(conf, plan: ShufflePlan) -> str:
+    """Resolve ``read.mergeImpl`` against what THIS plan's fold can run
+    (the _resolve_wire discipline — pure conf/plan facts): ``auto`` is
+    jnp; ``pallas`` demands a 4-byte combine dtype (the segment-reduce
+    kernel accumulates whole transport words) and falls back to jnp
+    with a log line otherwise."""
+    impl = conf.read_merge_impl
+    if impl == "auto":
+        return "jnp"
+    if impl == "pallas" and plan.combine:
+        from sparkucx_tpu.ops.pallas.segmented import \
+            pallas_reduce_supported
+        if not pallas_reduce_supported(np.dtype(plan.combine_dtype)):
+            log.info("read.mergeImpl=pallas resolves to jnp for this "
+                     "read: combine dtype %s is not a 4-byte lane "
+                     "(pallas_reduce_supported)", plan.combine_dtype)
+            return "jnp"
+    return impl
+
+
+def device_merge_fold(res: "DeviceShuffleReaderResult", mesh: Mesh,
+                      axis: str, conf) -> "LazyShuffleReaderResult":
+    """Fold a multi-wave ordered/combine DEVICE result into ONE merged
+    device view — the on-device replacement for the host cross-wave
+    merge (:func:`merge_sorted_rows` / :func:`combine_packed_rows`),
+    driven through the result's own ``consume(fn, carry)`` chain so
+    every wave's receive buffer is DONATED into the merge program the
+    moment the fold reaches it (zero payload D2H by construction).
+
+    The accumulator capacity derives from the REAL per-shard delivered
+    totals across waves (one [P]-int pull per wave — metadata-class,
+    the seg-matrix exclusion precedent of ``_note_d2h``), quantized on
+    the cap-bucket ladder so same-shaped warm reads land on the same
+    compiled merge program (0 warm recompiles)."""
+    from sparkucx_tpu.shuffle.plan import bucket_cap_conf
+    plan = res._plan
+    Pn = plan.num_shards
+    R = plan.num_partitions
+    views = res.wave_views()
+    totals = np.stack([np.asarray(v._totals_dev).reshape(-1)
+                       for v in views])                     # [W, P]
+    need = int(totals.sum(axis=0).max()) if totals.size else 0
+    acc_cap = bucket_cap_conf(max(8, -(-need // 8) * 8), conf)
+    width = views[0]._rows_dev.shape[1]
+    merge_impl = resolve_merge_impl(conf, plan)
+    # wave 0 seeds the accumulator sort-free (its rows are already
+    # merged within the wave and its seg row IS the acc's counts) —
+    # grab its on-device seg BEFORE consume drops the view's buffers
+    seg0 = views[0]._seg_dev
+    seg_box = {}
+    wave_i = [0]
+
+    def fold(carry, rows, tot):
+        wave_cap = rows.shape[0] // Pn
+        if wave_i[0] == 0:
+            sstep = _build_seed_acc(mesh, axis, acc_cap, wave_cap,
+                                    width, R)
+            out_rows, pcounts, out_n = sstep(rows, seg0, tot)
+        else:
+            a_rows, a_n = carry
+            mstep = _build_merge_step(mesh, axis, plan, acc_cap,
+                                      wave_cap, width, merge_impl)
+            out_rows, pcounts, out_n = mstep(a_rows, a_n, rows, tot)
+        wave_i[0] += 1
+        seg_box["seg"] = pcounts
+        return (out_rows, out_n)
+
+    acc_rows, acc_n = res.consume(fold, None)
+    view = LazyShuffleReaderResult(
+        R, np.asarray(_blocked_map(R, Pn)), acc_rows, seg_box["seg"],
+        Pn, acc_cap, res._val_shape, res._val_dtype,
+        per_shard_segs=True)
+    view._totals_dev = acc_n
+    return view
+
+
 def drain_wave_result(res) -> None:
     """Drain one completed wave: pull every locally-addressable shard's
     receive buffer (and the seg matrix) host-side NOW — the D2H stage of
@@ -1335,7 +1535,12 @@ class DeviceShuffleReaderResult:
             "device-sink results hold partitions in HBM — consume() them "
             "into a jitted step, or host_view() for the numpy contract "
             "(which re-pays the D2H this sink deletes); a numpy consumer "
-            "under conf read.sink=device should read(sink='host')")
+            "under conf read.sink=device should read(sink='host'). This "
+            "holds for ALL four read modes now: plain/shard, ordered "
+            "(device-merged key order) and combine (device segment-"
+            "reduce) all land device-resident — rows are valid up to "
+            "device_totals() per shard, key-sorted within partitions "
+            "for ordered/combine")
 
     # the numpy-iteration surface fails CLOSED with the same guidance —
     # a host-contract consumer handed a device result by a conf-level

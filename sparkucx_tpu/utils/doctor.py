@@ -69,6 +69,14 @@ host_roundtrip    a device-sink-capable consumer ran a     spark.shuffle.tpu.rea
                   while the consumer pushed bytes back
                   H2D (shuffle.consume.h2d.bytes) — the
                   round-trip read.sink=device deletes
+sink_fallback     reads that ASKED for the device sink     spark.shuffle.tpu.read.sink
+                  landed on host (shuffle.sink.fallback.
+                  count, labeled {mode, reason}) — the
+                  finding names WHICH read modes
+                  (plain/ordered/combine) fell back and
+                  why (distributed/hierarchical/conf-
+                  pinned); the device sink is legal for
+                  all four modes single-process
 ================  =======================================  =====================================
 
 The same :class:`Finding` schema carries ``bench.py --stage regress``
@@ -82,6 +90,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Union
 
 from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES, C_D2H, C_H2D,
+                                        C_SINK_FALLBACK,
                                         C_INTEGRITY_CORRUPT,
                                         C_INTEGRITY_CORRUPT_BLOCKS,
                                         C_INTEGRITY_QUARANTINED,
@@ -194,6 +203,14 @@ class Thresholds:
     roundtrip_min_bytes: float = 1e6
     roundtrip_critical_bytes: float = 64e6
     roundtrip_critical_reads: int = 3
+    # sink_fallback: reads that ASKED for the device sink resolved to
+    # host (manager._resolve_sink: distributed / hierarchical / conf-
+    # pinned). One fallback is already a finding — an explicit intent
+    # mismatch is never noise, and the PR-10 warn-once log line used to
+    # be the only evidence — but it stays a WARN (the read still ran,
+    # correctly, on host); critical once the mismatch repeats enough to
+    # say a steady consumer path is paying the round-trip every read.
+    sink_fallback_critical: int = 8
     # block_corruption: checksum verification (integrity.verify) caught
     # blocks whose bytes no longer match their commit records, or the
     # restart ledger quarantined blocks. ONE detected corruption is
@@ -1042,6 +1059,59 @@ def _rule_host_roundtrip(view: ClusterView,
         trace_ids=[r.get("trace_id", "") for r in hosts[:4]])]
 
 
+def _rule_sink_fallback(view: ClusterView,
+                        th: Thresholds) -> List[Finding]:
+    """Reads that ASKED for the device sink landed on the host drain —
+    the manager's ``_resolve_sink`` fallback, graded instead of a
+    warn-once log line. The labeled counter twins name the read MODE
+    (plain/ordered/combine — the ordered/combine modes are exactly the
+    aggregation-shaped reads the device merge made legal, so a fallback
+    there is the old round-trip tax resurfacing) and the REASON
+    (distributed / hierarchical / conf_pins_host). Quiet when no read
+    ever asked for a device sink it didn't get."""
+    total = float(view.counters.get(C_SINK_FALLBACK, 0.0))
+    if total <= 0:
+        return []
+    by_mode: Dict[str, float] = {}
+    by_reason: Dict[str, float] = {}
+    for name, v in view.counters.items():
+        base, labels = parse_labeled(name)
+        if base != C_SINK_FALLBACK or not labels:
+            continue
+        if "mode" in labels:
+            by_mode[labels["mode"]] = by_mode.get(
+                labels["mode"], 0.0) + float(v)
+        if "reason" in labels:
+            by_reason[labels["reason"]] = by_reason.get(
+                labels["reason"], 0.0) + float(v)
+    modes = ", ".join(f"{m}×{int(n)}"
+                      for m, n in sorted(by_mode.items())) or "unknown"
+    reasons = ", ".join(sorted(by_reason)) or "unknown"
+    return [Finding(
+        rule="sink_fallback",
+        grade="critical" if total >= th.sink_fallback_critical
+        else "warn",
+        summary=(f"{int(total)} read(s) requested read.sink=device but "
+                 f"resolved to the host drain (modes: {modes}; "
+                 f"reasons: {reasons}) — the consumer asked for "
+                 f"device-resident results and paid the host "
+                 f"round-trip instead"),
+        evidence={"fallbacks": int(total),
+                  "by_mode": {m: int(n) for m, n in by_mode.items()},
+                  "by_reason": {r: int(n)
+                                for r, n in by_reason.items()}},
+        conf_key="spark.shuffle.tpu.read.sink",
+        remediation=("the device sink is legal for ALL four read modes "
+                     "(plain/shard/ordered/combine) on the single-"
+                     "process flat exchange — if the reason is "
+                     "conf_pins_host, set spark.shuffle.tpu.read.sink="
+                     "auto (or device); distributed and hierarchical "
+                     "reads still drain host-side by design, so either "
+                     "run the consumer on the flat single-process mesh "
+                     "or accept the drain and read(sink='host') to "
+                     "silence the intent mismatch"))]
+
+
 def _labeled_series(mapping, base: str, label: str) -> Dict[str, Any]:
     """{label value: entry} for every identity in ``mapping`` whose base
     name is ``base`` and whose label block carries ``label`` — the
@@ -1145,7 +1215,7 @@ _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_bw_underutilization, _rule_padding_waste,
           _rule_wire_dequant, _rule_peer_timeout, _rule_replay_storm,
           _rule_block_corruption, _rule_host_roundtrip,
-          _rule_quota_starvation)
+          _rule_sink_fallback, _rule_quota_starvation)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

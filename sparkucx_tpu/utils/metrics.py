@@ -105,6 +105,13 @@ C_INTEGRITY_RECOVERED = "shuffle.integrity.recovered.count"
 # half the device sink deletes.
 C_D2H = "shuffle.read.d2h.bytes"
 C_H2D = "shuffle.consume.h2d.bytes"
+# Reads that ASKED for the device sink but landed on host (the manager's
+# _resolve_sink fallback: distributed / hierarchical / conf-pinned
+# reads). Labeled twins carry {mode="plain|ordered|combine",
+# reason=...} — the doctor's sink_fallback rule grades the total and
+# names the modes, since PR-12 made the device sink legal for every
+# read mode on the single-process flat exchange.
+C_SINK_FALLBACK = "shuffle.sink.fallback.count"
 
 # Multi-tenant service plane (shuffle/tenancy.py, shuffle/manager.py
 # admission): ONE place for the names so the fair-share queue, the

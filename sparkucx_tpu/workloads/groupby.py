@@ -3,7 +3,21 @@
 The reference validates the whole plugin with Spark's ``GroupByTest 100
 100`` on a standalone cluster (ref: buildlib/test.sh:162-166): mappers
 generate random KV pairs, the shuffle groups them by key, the job counts
-distinct keys. Same semantics here through the manager API."""
+distinct keys. Same semantics here through the manager API.
+
+Two arms:
+
+* :func:`run_groupby` — the historical host-contract job (numpy
+  partition views, grouping verified row by row).
+* :func:`run_groupby_device` — the groupby-AGGREGATE shape riding the
+  DEVICE combiner end to end (Exoshuffle's flagship workload for
+  library-level shuffle, PAPERS.md): ``read(combine="sum",
+  sink="device")`` lands ONE combined, key-sorted row per distinct key
+  per partition ON DEVICE (waved reads fold per-wave runs through the
+  compiled merge — reader.device_merge_fold), and a jitted consumer
+  step aggregates over the donated buffers. Zero payload D2H: the only
+  bytes that come back are the per-shard aggregate scalars.
+"""
 
 from __future__ import annotations
 
@@ -46,5 +60,110 @@ def run_groupby(manager: TpuShuffleManager, *, num_mappers: int = 8,
         if distinct != truth_keys:
             raise AssertionError("key set mismatch after grouping")
         return {"distinct_keys": len(distinct), "rows": rows}
+    finally:
+        manager.unregister_shuffle(shuffle_id)
+
+
+def make_device_groupby_step(mesh, axis: str, cap: int, width: int,
+                             value_width: int):
+    """ONE jitted aggregation step over donated combined rows — the
+    device-combiner consumer: per shard, count the valid (= distinct-
+    key) rows and sum the decoded float32 value lanes. The receive
+    buffer is donated (its HBM frees into the aggregate), and the only
+    host-bound bytes are the [P] per-shard scalars."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from sparkucx_tpu.utils import jaxcompat as _jaxcompat  # noqa: F401
+
+    def body(rows, nv):
+        # rows [cap, width] int32 combined transport rows; nv [1]
+        valid = jnp.arange(cap, dtype=jnp.int32) < nv[0]
+        vals = jax.lax.bitcast_convert_type(
+            rows[:, 2:2 + value_width], jnp.float32)
+        s = jnp.where(valid[:, None], vals, 0.0).sum()
+        return nv[0].reshape(1), s.reshape(1)
+
+    sm = jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+                       out_specs=(P(axis), P(axis)), check_vma=False)
+    return jax.jit(sm, donate_argnums=(0,))
+
+
+def run_groupby_device(manager: TpuShuffleManager, *,
+                       num_mappers: int = 8,
+                       pairs_per_mapper: int = 1000,
+                       num_partitions: int = 32, key_space: int = 500,
+                       value_width: int = 4, shuffle_id: int = 9002,
+                       seed: int = 0,
+                       check_d2h: bool = True) -> Dict[str, float]:
+    """GroupBy-aggregate on the device combiner: one combined row per
+    distinct key lands (and is consumed) on device; verification
+    compares the device aggregates against a host oracle computed from
+    the staged pairs. Returns {'distinct_keys', 'rows_staged',
+    'value_sum', 'd2h_bytes'}. The read declares the device sink
+    per-read, so conf ``read.sink=auto`` (the default) auto-selects it
+    — the resolver contract for consumer-declared device workloads."""
+    from sparkucx_tpu.utils.metrics import C_D2H, GLOBAL_METRICS
+    import jax
+
+    rng = np.random.default_rng(seed)
+    h = manager.register_shuffle(shuffle_id, num_mappers, num_partitions)
+    try:
+        truth_keys = set()
+        truth_sum = np.float64(0.0)
+        staged = 0
+        for m in range(num_mappers):
+            w = manager.get_writer(h, m)
+            keys = rng.integers(0, key_space,
+                                size=pairs_per_mapper).astype(np.int64)
+            vals = rng.normal(
+                size=(pairs_per_mapper, value_width)).astype(np.float32)
+            w.write(keys, vals)
+            w.commit(num_partitions)
+            truth_keys.update(int(k) for k in keys)
+            # float32 accumulation everywhere (the device combiner's
+            # numerics) — the oracle uses f64 only to bound drift
+            truth_sum += np.float64(vals.sum(dtype=np.float64))
+            staged += pairs_per_mapper
+
+        res = manager.read(h, combine="sum", sink="device")
+        # snapshot AFTER the read: integrity.verify=full legitimately
+        # samples key lanes D2H inside read() (the honest verification
+        # cost) — the zero-D2H contract here gates the CONSUMER path
+        d0 = GLOBAL_METRICS.get(C_D2H)
+        rows_dev = res.device_rows()
+        cap = rows_dev.shape[0] // manager.node.num_devices
+        width = rows_dev.shape[1]
+        step = make_device_groupby_step(
+            manager.exchange_mesh, manager.axis, cap, width, value_width)
+
+        def fold(carry, rows, nv):
+            c, s = step(rows, nv)
+            if carry is None:
+                return (c, s)
+            return (carry[0] + c, carry[1] + s)
+
+        counts, sums = res.consume(fold)
+        jax.block_until_ready(sums)
+        d2h = GLOBAL_METRICS.get(C_D2H) - d0
+        if check_d2h and d2h != 0:
+            raise AssertionError(
+                f"device groupby pulled {d2h} payload bytes D2H — the "
+                f"combine path must be zero-D2H")
+        distinct = int(np.asarray(counts).sum())
+        value_sum = float(np.asarray(sums, dtype=np.float64).sum())
+        if distinct != len(truth_keys):
+            raise AssertionError(
+                f"distinct-key mismatch: device combiner produced "
+                f"{distinct} rows, oracle has {len(truth_keys)} keys")
+        # f32 sums over ~num_mappers*pairs rows: bound the relative drift
+        denom = max(abs(truth_sum), 1.0)
+        if abs(value_sum - float(truth_sum)) / denom > 1e-3:
+            raise AssertionError(
+                f"value-sum mismatch: device {value_sum} vs oracle "
+                f"{float(truth_sum)}")
+        return {"distinct_keys": distinct, "rows_staged": staged,
+                "value_sum": value_sum, "d2h_bytes": int(d2h)}
     finally:
         manager.unregister_shuffle(shuffle_id)

@@ -192,7 +192,12 @@ def dense_manager():
 
 
 # soak lever shared by the randomized sweeps (test_fuzz_e2e,
-# test_strip_sort): SPARKUCX_FUZZ_SEEDS=200 widens them (CI default 16)
+# test_strip_sort): SPARKUCX_FUZZ_SEEDS=200 widens them. Tier-1 default
+# 12 (was 16): the mode x key-space stratification covers every
+# combination within 12 seeds (2 key spaces x 3 modes repeat every 6),
+# and the 4 trimmed seeds were the single biggest remaining line in the
+# 870 s tier-1 budget after the PR-12 suites joined; CI soak lanes and
+# local runs re-widen via the env.
 import os as _os
 
-FUZZ_SEEDS = int(_os.environ.get("SPARKUCX_FUZZ_SEEDS", "16"))
+FUZZ_SEEDS = int(_os.environ.get("SPARKUCX_FUZZ_SEEDS", "12"))

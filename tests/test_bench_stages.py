@@ -75,6 +75,10 @@ def test_best_recorded_run_ranks_full_stage_with_zero_value(tmp_path):
     assert best["best_any_shape"]["value"] == 14.8
 
 
+# slow-marked for the tier-1 budget: the compile-cost contract is a
+# dedicated ci.yml coldstart artifact, and the bucket arithmetic
+# stays in-tier via test_plan_buckets
+@pytest.mark.slow
 def test_coldstart_bucket_sweep_small():
     """The --stage coldstart sweep machinery at a CI-sized shape:
     bucketing must cut distinct step compiles under row-count drift and
@@ -139,6 +143,11 @@ def test_pipeline_measure_small(mesh8):
     assert rec["speedup"] > 0
 
 
+# slow-marked for the tier-1 budget: the devread contract is a
+# dedicated GATE in ci.yml (bench.py --stage devread) and the
+# device-sink zero-D2H invariants stay in-tier via test_device_sink
+# + the device fuzz sweeps
+@pytest.mark.slow
 def test_devread_measure_small(mesh8):
     """The devread stage's measurement core at a tiny shape: the device
     arm is zero-D2H with one compiled exchange and no warm recompiles,
@@ -163,12 +172,47 @@ def test_devread_measure_small(mesh8):
     assert rec["gates"]["device_d2h_zero"]
 
 
+def test_devcombine_measure_small(mesh8):
+    """The devcombine stage's measurement core at a tiny shape: the
+    device combine arm is zero-D2H with bounded first-read programs and
+    no warm recompiles, lands fully merged on device (waved — the fold
+    ran, merge_ms recorded), agrees with the oracle and the host arm,
+    and the host arm pays the drain + re-upload. The beats-host merge
+    gate belongs to the stage on device backends (the CPU variadic-sort
+    asymmetry is documented there)."""
+    rec = bench.devcombine_measure(rows_per_map=512, maps=2,
+                                   partitions=8, key_space=128,
+                                   val_words=4, reps=1)
+    dev, host = rec["device"], rec["host"]
+    assert dev["d2h_bytes_delta"] == 0
+    assert dev["report_sink"] == "device"
+    assert dev["report_d2h_bytes"] == 0
+    assert dev["programs_first_read"] <= 3
+    assert dev["programs_warm"] == 0
+    assert dev["waves"] >= 2
+    assert dev["report_merge_ms"] > 0.0
+    assert dev["distinct_keys"] == rec["oracle"]["distinct_keys"]
+    assert host["distinct_keys"] == dev["distinct_keys"]
+    assert host["h2d_bytes_delta"] > 0
+    assert host["report_d2h_bytes"] > 0
+    assert rec["gates"]["aggregates_match_oracle"]
+    assert rec["gates"]["arms_agree"]
+    assert rec["ok"] is True       # CPU: structural gates only
+
+
+@pytest.mark.slow
 def test_ragged_measure_small(mesh8):
     """The ragged stage's measurement core at a tiny shape: the dense arm
     measures skew-proportional padding, the ragged arm holds the
     real-bytes contract (pad_ratio 1.0) at every level, and the GB/s
     figures are computed on real payload bytes. The e2e ragged>=dense
-    gate belongs to the stage on native-op backends only."""
+    gate belongs to the stage on native-op backends only.
+
+    Slow-marked for the tier-1 budget (~11 s of per-skew-level node
+    boots + compiles): the same contract is a dedicated ci.yml gate
+    (``bench.py --stage ragged --smoke``), and the accounting
+    invariants stay in-tier via test_ragged_plane + the ragged fuzz
+    sweep."""
     rec = bench.ragged_measure(rows_per_map=512, maps=4, partitions=8,
                                val_words=4, reps=1)
     lv = rec["levels"]
@@ -189,13 +233,19 @@ def test_ragged_measure_small(mesh8):
         ("ragged_vs_dense_speedup" in lv["zipf"])
 
 
+@pytest.mark.slow
 def test_wire_measure_small(mesh8):
     """The wire stage's measurement core at a tiny shape: raw/lossless
     bit-exact, int8 oracle-bounded with the ≤0.30x wire-narrowing the
     lane arithmetic guarantees at the 64-lane contract row, the
     lossless codec measuring real bytes on the waved drain path, and 0
     warm recompiles per (shape family, wire mode). Bandwidth figures
-    are context-only (CPU wall clock at tiny payloads)."""
+    are context-only (CPU wall clock at tiny payloads).
+
+    Slow-marked for the tier-1 budget (~11 s of per-tier node boots +
+    compiles): the same contract is a dedicated ci.yml gate
+    (``bench.py --stage wire``), and the wire exactness stays in-tier
+    via test_wire_plane + the wire fuzz sweep."""
     rec = bench.wire_measure(rows_per_map=512, maps=4, partitions=8,
                              reps=1)
     arms = rec["arms"]
@@ -216,21 +266,30 @@ def test_wire_measure_small(mesh8):
     assert 0.0 < rec["int8_wire_savings_rate"] < 1.0
 
 
+@pytest.mark.slow
 def test_chaos_measure_small(mesh8):
     """The chaos stage's measurement core at a tiny shape: every cell of
     the fault matrix ends hang-free in its expected outcome (typed error
     under failfast, absorbed replay with oracle bytes under replay), and
     the watchdog drill converts a genuine hang into PeerLostError on
-    time with the abandoned worker accounted in the leaked census."""
+    time with the abandoned worker accounted in the leaked census.
+
+    Slow-marked for the tier-1 budget (the heaviest single test in this
+    file at ~25 s across 25 node-booting cells, growing with every
+    matrix row): the chaos contract is a dedicated GATE in ci.yml
+    (``bench.py --stage chaos --smoke``, exit 2 per cell) — tier-1
+    keeps the per-site fault units in test_failures/test_remesh."""
     rec = bench.chaos_measure(rows_per_map=256, maps=2, partitions=8,
                               val_words=2, timeout_ms=2000.0)
     assert rec["ok"] is True
     # dense x {single: 3 sites, waved: 4 sites} x {failfast, replay}
     # plus the wire-compressed int8 x waved x replay cell, plus the
     # device-sink x replay cell (fault in the consumer-handoff window),
+    # plus the combine x device-sink x replay cell (fault mid-fold —
+    # replay through the compiled device merge and donated buffers),
     # plus the corrupt-site block (staged/spill x single/waved x both
     # policies)
-    assert rec["cells_total"] == 24
+    assert rec["cells_total"] == 25
     assert rec["cells_ok"] == rec["cells_total"]
     wire_cells = [c for c in rec["cells"] if c.get("wire") == "int8"]
     assert len(wire_cells) == 1
@@ -238,10 +297,14 @@ def test_chaos_measure_small(mesh8):
     assert wc["outcome"] == "replayed" and wc["replays"] >= 1
     assert wc["wire_held"] and wc["family_stable"] and wc["bytes_ok"]
     sink_cells = [c for c in rec["cells"] if c.get("sink") == "device"]
-    assert len(sink_cells) == 1
-    sc = sink_cells[0]
+    assert len(sink_cells) == 2
+    sc = next(c for c in sink_cells if "read_mode" not in c)
     assert sc["outcome"] == "replayed" and sc["replays"] >= 1
     assert sc["sink_held"] and sc["family_stable"]
+    cc = next(c for c in sink_cells if c.get("read_mode") == "combine")
+    assert cc["outcome"] == "replayed" and cc["replays"] >= 1
+    assert cc["sink_held"] and cc["family_stable"] and cc["bytes_ok"]
+    assert cc["merged_on_device"] and cc["d2h_consumer_path"] == 0
     assert sc["d2h_consumer_path"] == 0
     for c in rec["cells"]:
         assert c["hang_free"], c

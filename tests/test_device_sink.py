@@ -110,17 +110,27 @@ def test_sink_resolution_and_fallbacks(managers):
     assert isinstance(res, DeviceShuffleReaderResult)
     assert m.report(h.shuffle_id).sink == "device"
     res.close()
-    # combine/ordered need host merges: device ask resolves to host
+    # ordered/combine are device-legal now (the device merge): a device
+    # ask stays device and no sink-fallback is counted for it
+    from sparkucx_tpu.utils.metrics import C_SINK_FALLBACK, labeled
+    fb0 = m.node.metrics.get(C_SINK_FALLBACK)
     res = m.read(h, sink="device", ordered=True)
-    assert not isinstance(res, DeviceShuffleReaderResult)
-    assert m.report(h.shuffle_id).sink == "host"
+    assert isinstance(res, DeviceShuffleReaderResult)
+    assert m.report(h.shuffle_id).sink == "device"
+    res.close()
+    assert m.node.metrics.get(C_SINK_FALLBACK) - fb0 == 0
     m.unregister_shuffle(h.shuffle_id)
-    # conf=host pins the drain even under a per-read device ask
+    # conf=host pins the drain even under a per-read device ask — and
+    # the intent mismatch is COUNTED (the doctor's sink_fallback
+    # evidence), labeled with the read mode
     mh = managers(**{"read.sink": "host"})
     h2, _ = _stage(mh)
-    res = mh.read(h2, sink="device")
+    res = mh.read(h2, sink="device", ordered=True)
     assert not isinstance(res, DeviceShuffleReaderResult)
     assert mh.report(h2.shuffle_id).sink == "host"
+    assert mh.node.metrics.get(C_SINK_FALLBACK) - fb0 >= 1
+    assert mh.node.metrics.get(labeled(
+        C_SINK_FALLBACK, mode="ordered", reason="conf_pins_host")) >= 1
     mh.unregister_shuffle(h2.shuffle_id)
     # conf=device makes device the default ask
     md = managers(**{"read.sink": "device"})
@@ -503,6 +513,22 @@ def test_v2_facade_device_read(base_manager):
         assert got and all(isinstance(k, np.ndarray)
                            for k, _v in got.values())
         svc.unregister(sid)
+        # combine-declaring dependencies ride read_device too now (the
+        # device merge made aggregation-shaped reads device-legal)
+        _SID[0] += 1
+        sid2 = _SID[0]
+        dep2 = ShuffleDependency(sid2, 2, 8, combine="sum")
+        h2 = svc.register(dep2)
+        for mid in range(2):
+            w = svc.writer(h2, mid, attempt_id=0)
+            k = rng.integers(0, 50, size=64).astype(np.int64)
+            w.write(k, (k[:, None] * np.arange(1, 3)).astype(np.int32))
+            w.commit()
+        res2 = svc.read_device(h2)
+        assert isinstance(res2, DeviceShuffleReaderResult)
+        assert svc.manager.report(sid2).sink == "device"
+        res2.close()
+        svc.unregister(sid2)
     finally:
         svc.manager.stop()
 

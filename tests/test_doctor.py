@@ -715,6 +715,48 @@ def test_host_roundtrip_sub_noise_floor():
             if f.rule == "host_roundtrip"] == []
 
 
+# -- sink_fallback (read.sink, device-merge era) ---------------------------
+def test_sink_fallback_fires_and_names_mode_and_reason():
+    doc = _healthy_doc()
+    doc["counters"]["shuffle.sink.fallback.count"] = 2
+    doc["counters"][
+        'shuffle.sink.fallback.count{mode="combine",'
+        'reason="distributed"}'] = 2
+    fs = [f for f in diagnose(doc) if f.rule == "sink_fallback"]
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.conf_key == "spark.shuffle.tpu.read.sink"
+    assert f.evidence["fallbacks"] == 2
+    assert f.evidence["by_mode"] == {"combine": 2}
+    assert f.evidence["by_reason"] == {"distributed": 2}
+    assert "combine" in f.summary and "device" in f.summary
+
+
+def test_sink_fallback_critical_on_repetition():
+    doc = _healthy_doc()
+    doc["counters"]["shuffle.sink.fallback.count"] = 12
+    doc["counters"][
+        'shuffle.sink.fallback.count{mode="ordered",'
+        'reason="conf_pins_host"}'] = 12
+    fs = [f for f in diagnose(doc) if f.rule == "sink_fallback"]
+    assert fs and fs[0].grade == "critical"
+    assert fs[0].evidence["by_mode"] == {"ordered": 12}
+
+
+def test_sink_fallback_quiet_without_device_asks():
+    # no read ever asked for a device sink it didn't get — the healthy
+    # doc carries no fallback counter at all
+    assert [f for f in diagnose(_healthy_doc())
+            if f.rule == "sink_fallback"] == []
+    # host-sink reads with big drains but no device ask stay quiet too
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_roundtrip_report(d2h_mb=64.0))
+    doc["counters"]["shuffle.read.d2h.bytes"] = 64e6
+    assert [f for f in diagnose(doc)
+            if f.rule == "sink_fallback"] == []
+
+
 def test_gauges_attribute_per_process_in_cluster_view():
     """build_view keeps gauges per process (point-in-time values must
     attribute, never sum) and hbm_pressure names the pressed process."""

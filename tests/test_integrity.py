@@ -484,3 +484,94 @@ def test_epoch_bump_replay_carries_integrity_records(manager_factory,
     assert h.entry.fetch_integrity(0) is not None
     rep = m.report(24)
     assert rep.integrity == "staged" and rep.replays == 1
+
+
+# -- integrity.verify=full + device sink (ISSUE-12) -------------------------
+def test_full_device_sink_samples_key_lanes_and_counts_d2h(
+        manager_factory, data):
+    """A device-sink read at the full level no longer silently
+    downgrades to staged: the first wave's receive buffer is sampled
+    host-side (a COPY — the device buffers stay consumable), its key
+    lanes re-routed through the host partitioner twin, and the sampled
+    pull is charged HONESTLY to shuffle.read.d2h.bytes + the report."""
+    import jax
+
+    from sparkucx_tpu.utils.metrics import C_D2H, GLOBAL_METRICS
+    keys, vals = data
+    m = manager_factory({"spark.shuffle.tpu.integrity.verify": "full"})
+    h = _stage(m, 30, keys, vals)
+    d0 = GLOBAL_METRICS.get(C_D2H)
+    res = m.read(h, sink="device")
+    sampled = GLOBAL_METRICS.get(C_D2H) - d0
+    rep = m.report(30)
+    assert rep.sink == "device"
+    assert rep.integrity == "full"
+    assert rep.integrity_bytes > 0
+    # the sampled pull is real D2H, counted — exactly the receive
+    # buffer's bytes, no more (the honest cost of full verification)
+    assert sampled > 0
+    assert rep.d2h_bytes == sampled
+    # the device buffers survived the sampling: the consumer still
+    # gets donated arrays with zero ADDITIONAL payload D2H
+    d1 = GLOBAL_METRICS.get(C_D2H)
+    outs = res.consume(lambda c, rows, nv: (c or []) + [rows])
+    jax.block_until_ready(outs)
+    assert GLOBAL_METRICS.get(C_D2H) - d1 == 0
+
+
+def test_full_device_sink_covers_combine(manager_factory, data):
+    """Combined DEVICE reads get the key-lane check too — stronger than
+    the host combine posture (which skips full: per-row digests cannot
+    survive the rewrite, but key routing can)."""
+    import jax
+    keys, vals = data
+    m = manager_factory({"spark.shuffle.tpu.integrity.verify": "full"})
+    h = _stage(m, 31, keys, vals)
+    res = m.read(h, combine="sum", sink="device")
+    rep = m.report(31)
+    assert rep.sink == "device" and rep.integrity == "full"
+    outs = res.consume(lambda c, rows, nv: (c or []) + [rows])
+    jax.block_until_ready(outs)
+
+
+def test_verify_key_routing_detects_misrouted_key(rng):
+    """The host twin check itself: a key lane flipped post-routing (or
+    a row delivered to the wrong shard) raises naming the shard."""
+    from sparkucx_tpu.shuffle.integrity import (_StagedMismatch,
+                                                host_partition_ids,
+                                                verify_key_routing)
+    P_SHARDS, cap = 4, 64
+    rows = np.zeros((P_SHARDS * cap, 4), np.int32)
+    totals = np.zeros(P_SHARDS, np.int64)
+    from sparkucx_tpu.ops.partition import blocked_partition_map
+    p2d = np.asarray(blocked_partition_map(R, P_SHARDS))
+    keys = rng.integers(-(1 << 62), 1 << 62, size=200)
+    part = host_partition_ids(keys, R)
+    for s in range(P_SHARDS):
+        mine = keys[np.asarray(p2d[part]) == s][:cap]
+        n = mine.shape[0]
+        rows[s * cap:s * cap + n, :2] = \
+            mine.astype(np.int64).view(np.int32).reshape(n, 2)
+        totals[s] = n
+    ok = verify_key_routing(rows, totals, R, P_SHARDS)
+    assert ok == int(totals.sum()) * 8     # key bytes verified
+    # flip one bit in a key lane of shard 1's first row
+    bad = rows.copy()
+    bad[cap, 0] ^= 1 << 7
+    with pytest.raises(_StagedMismatch, match="shard 1"):
+        verify_key_routing(bad, totals, R, P_SHARDS)
+
+
+def test_verify_key_routing_partitioners(rng):
+    """direct and range partitioner twins route like the device."""
+    from sparkucx_tpu.shuffle.integrity import host_partition_ids
+    # direct: key IS the partition id, clipped — on the LOW int32 word,
+    # exactly like the device (0xFFFFFFFF reads as int32 -1 -> clip 0)
+    k = np.array([-5, 0, 3, 99, 0xFFFFFFFF], np.int64)
+    assert host_partition_ids(k, R, "direct").tolist() \
+        == [0, 0, 3, R - 1, 0]
+    # range: searchsorted right over split points
+    bounds = np.array([10, 20, 30], np.int64)
+    k = np.array([5, 10, 25, 100], np.int64)
+    assert host_partition_ids(k, 4, "range", bounds).tolist() \
+        == [0, 1, 2, 3]

@@ -49,6 +49,9 @@ def test_forward_matches_dense_oracle(mesh_dp_ep):
                                rtol=2e-4, atol=2e-5)
 
 
+# slow-marked for the tier-1 budget (the PR-10 train-loop discipline:
+# descent loops are slow-marked, forward oracles stay in-tier)
+@pytest.mark.slow
 def test_train_step_learns(mesh_dp_ep):
     cfg = CFG
     init, step = make_train_step(mesh_dp_ep, cfg, lr=3e-3)
@@ -99,6 +102,9 @@ def test_exchange_overflow_poisons_loss(mesh_dp_ep):
     assert not np.isfinite(float(loss))
 
 
+# slow-marked for the tier-1 budget (train-descent loop; the int8
+# exchange exactness stays in-tier via test_wire_plane + the fuzz)
+@pytest.mark.slow
 def test_int8_wire_training_descends(mesh_dp_ep):
     """MoE with int8 wire-quantized dispatch/combine still trains: the
     compressed collective's STE gradients drive the loss down."""

@@ -87,6 +87,10 @@ def test_quantized_gradients_finite_and_close(mesh8, rng):
     assert rel < 0.1, f"relative grad error {rel}"
 
 
+# slow-marked for the tier-1 budget: a statistical soak (many-sample
+# unbiasedness of the stochastic rounding); the bounded-error contract
+# stays in-tier via the wire fuzz bounds and the dequant-error tests
+@pytest.mark.slow
 def test_unbiased_rounding(mesh8, rng):
     # stochastic rounding: averaging many seeds converges to the exact value
     buffers, sizes = _mk(rng)

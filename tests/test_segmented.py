@@ -1,0 +1,314 @@
+"""Device segmented merge & segment-reduce (ops/pallas/segmented.py) —
+the on-device half of ordered/combine device-sink reads.
+
+Covers: the jnp/XLA primary path and the pallas lineage kernels against
+numpy oracles and each other; the NUMERICS CONTRACT against
+``reader.combine_packed_rows`` (the host cross-wave merge the device
+fold replaces): integer ring arithmetic (int32 lane wrap), float32
+accumulation, carried lanes, and exact key/partition lanes; the
+conf/validation seam (read.mergeImpl); and the merge-fold program-family
+discipline (one merge program per family, 0 warm recompiles)."""
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.ops.pallas import segmented as S
+
+R = 7
+W = 6  # 2 key words + 4 value words
+
+
+def _sorted_rows(rng, n, cap, key_lo=0, key_hi=1000, vals=None):
+    """[cap, W] transport rows: n valid rows sorted by (hash-partition,
+    key), sentinel part ids past them — the merge input contract."""
+    from sparkucx_tpu.shuffle.integrity import host_partition_ids
+    keys = rng.integers(key_lo, key_hi, size=n).astype(np.int64)
+    part = host_partition_ids(keys, R).astype(np.int32)
+    order = np.lexsort((keys, part))
+    keys, part = keys[order], part[order]
+    rows = np.zeros((cap, W), np.int32)
+    if n:
+        rows[:n, :2] = keys.view(np.int32).reshape(n, 2)
+        rows[:n, 2:] = (vals[order] if vals is not None else
+                        rng.integers(-(1 << 30), 1 << 30,
+                                     size=(n, W - 2))).astype(np.int32)
+    p = np.full(cap, R, np.int32)
+    p[:n] = part
+    return rows, p, keys
+
+
+def _keys_of(rows, n):
+    return np.ascontiguousarray(rows[:n, :2]).view(np.int64).ravel()
+
+
+@pytest.mark.parametrize("impl", ("jnp", "pallas"))
+def test_merge_rows_matches_sorted_concat_oracle(impl):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    a_rows, a_p, ka = _sorted_rows(rng, 37, 48)
+    b_rows, b_p, kb = _sorted_rows(rng, 21, 24)
+    rows, part, pcounts = S.merge_rows(
+        jnp.asarray(a_rows), jnp.asarray(a_p), jnp.asarray(b_rows),
+        jnp.asarray(b_p), R, impl=impl)
+    rows, part, pcounts = map(np.asarray, (rows, part, pcounts))
+    n = int(pcounts.sum())
+    assert n == 58
+    keys = _keys_of(rows, n)
+    # merged order is (partition, signed key) — numpy lexsort oracle
+    order = np.lexsort((keys, part[:n]))
+    assert np.array_equal(order, np.arange(n)), impl
+    # content: the multiset of (key, value row) pairs is preserved
+    want = sorted(map(tuple, np.concatenate([a_rows[:37], b_rows[:21]])
+                      .tolist()))
+    got = sorted(map(tuple, rows[:n].tolist()))
+    assert got == want
+    # sentinels landed last
+    assert (part[n:] == R).all()
+
+
+@pytest.mark.parametrize("impl", ("jnp", "pallas"))
+def test_merge_rows_empty_and_one_sided(impl):
+    import jax.numpy as jnp
+    rng = np.random.default_rng(4)
+    a_rows, a_p, _ = _sorted_rows(rng, 0, 16)
+    b_rows, b_p, _ = _sorted_rows(rng, 9, 16)
+    rows, part, pcounts = S.merge_rows(
+        jnp.asarray(a_rows), jnp.asarray(a_p), jnp.asarray(b_rows),
+        jnp.asarray(b_p), R, impl=impl)
+    assert int(np.asarray(pcounts).sum()) == 9
+    got = sorted(map(tuple, np.asarray(rows)[:9].tolist()))
+    assert got == sorted(map(tuple, b_rows[:9].tolist()))
+
+
+@pytest.mark.parametrize("impl", ("jnp", "pallas"))
+def test_segment_reduce_int32_wrap_matches_host_combiner(impl):
+    """Integer numerics pin: the device segment-reduce and the HOST
+    cross-wave combiner (reader.combine_packed_rows) wrap identically —
+    int32 ring arithmetic, however wide the true sum."""
+    import jax.numpy as jnp
+
+    from sparkucx_tpu.shuffle.reader import combine_packed_rows
+    rng = np.random.default_rng(5)
+    n, cap = 24, 32
+    # values near the int32 edge so the sums genuinely wrap
+    vals = rng.integers(1 << 30, (1 << 31) - 1, size=(n, W - 2),
+                        dtype=np.int64).astype(np.uint32).view(np.int32)
+    rows, part, _ = _sorted_rows(rng, n, cap, key_lo=0, key_hi=5,
+                                 vals=vals)
+    ro, pc, _ = S.segment_reduce_rows(
+        jnp.asarray(rows), jnp.asarray(part), R, W - 2, np.int32,
+        impl=impl)
+    ro, pc = np.asarray(ro), np.asarray(pc)
+    n_out = int(pc.sum())
+    # host oracle: combine_packed_rows over the SAME rows (its input is
+    # per-wave combined blocks; a single uncombined block is the
+    # degenerate case with every duplicate key in one block)
+    host = combine_packed_rows([rows[:n]], W - 2, np.int32)
+    # host output is globally key-sorted; device output is
+    # (partition, key)-sorted — compare as key->value-row maps
+    dev_map = {int(k): tuple(ro[i, 2:]) for i, k in
+               enumerate(_keys_of(ro, n_out))}
+    host_map = {int(k): tuple(host[i, 2:]) for i, k in
+                enumerate(_keys_of(host, host.shape[0]))}
+    assert dev_map == host_map, impl
+
+
+@pytest.mark.parametrize("impl", ("jnp", "pallas"))
+def test_segment_reduce_f32_and_carry_match_host_combiner(impl):
+    """Float numerics + the summed/carried lane split: float32
+    accumulation, carried lanes byte-identical per key."""
+    import jax.numpy as jnp
+
+    from sparkucx_tpu.shuffle.reader import combine_packed_rows
+    rng = np.random.default_rng(6)
+    n, cap, sum_words = 30, 32, 2
+    keys = rng.integers(0, 6, size=n).astype(np.int64)
+    fv = rng.normal(size=(n, sum_words)).astype(np.float32)
+    carry = np.repeat(keys[:, None].astype(np.int32) * 7 + 3,
+                      W - 2 - sum_words, axis=1)   # per-key-constant
+    vals = np.concatenate([fv.view(np.int32), carry], axis=1)
+    rows, part, _ = _sorted_rows(rng, n, cap, vals=vals)
+    # keys must drive the partition/sort — rebuild with the drawn keys
+    from sparkucx_tpu.shuffle.integrity import host_partition_ids
+    p = host_partition_ids(keys, R).astype(np.int32)
+    order = np.lexsort((keys, p))
+    rows = np.zeros((cap, W), np.int32)
+    rows[:n, :2] = keys[order].view(np.int32).reshape(n, 2)
+    rows[:n, 2:] = vals[order]
+    part = np.full(cap, R, np.int32)
+    part[:n] = p[order]
+    ro, pc, _ = S.segment_reduce_rows(
+        jnp.asarray(rows), jnp.asarray(part), R, W - 2, np.float32,
+        sum_words=sum_words, impl=impl)
+    ro, pc = np.asarray(ro), np.asarray(pc)
+    n_out = int(pc.sum())
+    host = combine_packed_rows([rows[:n]], W - 2, np.float32,
+                               sum_words=sum_words)
+    dev_map = {int(k): ro[i] for i, k in
+               enumerate(_keys_of(ro, n_out))}
+    host_map = {int(k): host[i] for i, k in
+                enumerate(_keys_of(host, host.shape[0]))}
+    assert set(dev_map) == set(host_map)
+    for k in host_map:
+        # carried lanes byte-identical
+        assert np.array_equal(dev_map[k][2 + sum_words:],
+                              host_map[k][2 + sum_words:]), (impl, k)
+        # f32 sums: same accumulation dtype; ordering differences allow
+        # ulp-level drift between the prefix-sum-difference (host) and
+        # the running-sum (pallas) formulations
+        dv = dev_map[k][2:2 + sum_words].view(np.float32)
+        hv = host_map[k][2:2 + sum_words].view(np.float32)
+        np.testing.assert_allclose(dv, hv, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("impl", ("jnp", "pallas"))
+def test_merge_reduce_rows_spanning_key(impl):
+    """A key present in BOTH inputs collapses to one row with the sum —
+    one fold step of the device combine."""
+    import jax.numpy as jnp
+    cap = 8
+
+    def mk(key, val):
+        from sparkucx_tpu.shuffle.integrity import host_partition_ids
+        rows = np.zeros((cap, W), np.int32)
+        rows[0, :2] = np.array([key], np.int64).view(np.int32)
+        rows[0, 2:] = val
+        p = np.full(cap, R, np.int32)
+        p[0] = host_partition_ids(np.array([key], np.int64), R)[0]
+        return rows, p
+
+    a_rows, a_p = mk(42, 10)
+    b_rows, b_p = mk(42, 32)
+    ro, pc, _ = S.merge_reduce_rows(
+        jnp.asarray(a_rows), jnp.asarray(a_p), jnp.asarray(b_rows),
+        jnp.asarray(b_p), R, W - 2, np.int32, impl=impl)
+    ro, pc = np.asarray(ro), np.asarray(pc)
+    assert int(pc.sum()) == 1
+    assert int(_keys_of(ro, 1)[0]) == 42
+    assert (ro[0, 2:] == 42).all()
+
+
+def test_pallas_reduce_supported_gates_subword_dtypes():
+    assert S.pallas_reduce_supported(np.int32)
+    assert S.pallas_reduce_supported(np.float32)
+    assert not S.pallas_reduce_supported(np.int16)
+    assert not S.pallas_reduce_supported(np.int8)
+    with pytest.raises(ValueError, match="4-byte"):
+        import jax.numpy as jnp
+        S.segment_reduce_rows(jnp.zeros((8, W), jnp.int32),
+                              jnp.full((8,), R, jnp.int32), R, W - 2,
+                              np.int16, impl="pallas")
+
+
+def test_interpret_gate_and_conf_seam():
+    # compute-only kernels: boolean interpret works on every jax
+    # generation — the gate is the constant the module documents
+    assert S.interpret_supported()
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.shuffle.alltoall import ALLOWED_MERGE_IMPLS
+    assert ALLOWED_MERGE_IMPLS == ("auto", "jnp", "pallas")
+    with pytest.raises(ValueError, match="read.mergeImpl"):
+        TpuShuffleConf({"spark.shuffle.tpu.read.mergeImpl": "cuda"},
+                       use_env=False)
+    for v in ALLOWED_MERGE_IMPLS:
+        conf = TpuShuffleConf(
+            {"spark.shuffle.tpu.read.mergeImpl": v}, use_env=False)
+        assert conf.read_merge_impl == v
+
+
+def test_resolve_merge_impl_falls_back_for_subword_combine():
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.shuffle.plan import ShufflePlan
+    from sparkucx_tpu.shuffle.reader import resolve_merge_impl
+    conf = TpuShuffleConf(
+        {"spark.shuffle.tpu.read.mergeImpl": "pallas"}, use_env=False)
+    plan16 = ShufflePlan(num_shards=1, num_partitions=4, cap_in=8,
+                         cap_out=8, impl="dense", combine="sum",
+                         combine_words=2, combine_dtype="<i2")
+    assert resolve_merge_impl(conf, plan16) == "jnp"
+    plan32 = ShufflePlan(num_shards=1, num_partitions=4, cap_in=8,
+                         cap_out=8, impl="dense", combine="sum",
+                         combine_words=2, combine_dtype="<f4")
+    assert resolve_merge_impl(conf, plan32) == "pallas"
+    ordered = ShufflePlan(num_shards=1, num_partitions=4, cap_in=8,
+                          cap_out=8, impl="dense", ordered=True)
+    assert resolve_merge_impl(conf, ordered) == "pallas"
+    auto = TpuShuffleConf({}, use_env=False)
+    assert resolve_merge_impl(auto, ordered) == "jnp"
+
+
+def test_merge_family_drops_exchange_capacities():
+    """Two reads whose exchanges differ but whose merge shapes agree
+    share ONE merge program — the 0-warm-recompile contract."""
+    import dataclasses
+
+    from sparkucx_tpu.shuffle.plan import ShufflePlan, merge_family
+    p1 = ShufflePlan(num_shards=8, num_partitions=16, cap_in=128,
+                     cap_out=256, impl="dense", combine="sum",
+                     combine_words=4, combine_dtype="<f4")
+    p2 = dataclasses.replace(p1, cap_in=512, cap_out=1024, wire="int8",
+                             wire_words=4)
+    assert merge_family(p1, 64, 32, 6, "jnp") \
+        == merge_family(p2, 64, 32, 6, "jnp")
+    # mode, caps and impl DO key the family
+    assert merge_family(p1, 64, 32, 6, "jnp") \
+        != merge_family(p1, 128, 32, 6, "jnp")
+    assert merge_family(p1, 64, 32, 6, "jnp") \
+        != merge_family(p1, 64, 32, 6, "pallas")
+    assert merge_family(dataclasses.replace(p1, combine=None,
+                                            combine_words=0,
+                                            combine_dtype="",
+                                            ordered=True),
+                        64, 32, 6, "jnp") \
+        != merge_family(p1, 64, 32, 6, "jnp")
+
+
+@pytest.mark.slow
+def test_device_fold_reuses_one_merge_program_per_family():
+    """E2E program-count pin: two same-shaped waved combine device
+    reads — the second compiles NOTHING (exchange, seed and merge all
+    served warm from the step cache). Slow-marked for the tier-1
+    budget: the same warm==0 contract is gated in-tier by
+    test_devcombine_measure_small (programs_warm) and in CI by the
+    devcombine stage gate; this is the targeted unit for debugging a
+    regression there."""
+    import jax
+
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    from sparkucx_tpu.utils.metrics import (COMPILE_PROGRAMS,
+                                            GLOBAL_METRICS)
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense",
+                           "spark.shuffle.tpu.a2a.waveRows": "48"},
+                          use_env=False)
+    node = TpuNode.start(conf)
+    m = TpuShuffleManager(node, conf)
+    try:
+        def run(sid):
+            rng = np.random.default_rng(11)      # identical staging
+            h = m.register_shuffle(sid, 4, 16)
+            for mid in range(4):
+                k = rng.integers(0, 300, size=200).astype(np.int64)
+                v = (k[:, None] * np.arange(1, 3)).astype(np.int32)
+                w = m.get_writer(h, mid)
+                w.write(k, v)
+                w.commit(16)
+            res = m.read(h, combine="sum", sink="device")
+            outs = res.consume(
+                lambda c, rows, nv: (c or []) + [rows])
+            jax.block_until_ready(outs)
+            rep = m.report(sid)
+            m.unregister_shuffle(sid)
+            return rep
+
+        rep1 = run(96001)
+        assert rep1.waves >= 2
+        assert rep1.merge_ms > 0.0
+        p0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+        rep2 = run(96002)
+        assert GLOBAL_METRICS.get(COMPILE_PROGRAMS) - p0 == 0, \
+            "warm same-shaped device-combine read must not compile"
+        assert rep2.stepcache_programs == 0
+    finally:
+        m.stop()

@@ -99,6 +99,9 @@ def test_train_step_loss_decreases():
     assert losses[-1] < losses[0], losses
 
 
+# slow-marked for the tier-1 budget (the PR-10 discipline: gradient
+# sweeps are slow-marked, the sharded forward oracles stay in-tier)
+@pytest.mark.slow
 def test_grads_finite_all_leaves():
     mesh = _mesh((1, 2, 1, 2, 2))  # pipeline + tp + ep: the NaN-prone combo
     params = init_params(jax.random.PRNGKey(2), CFG)

@@ -152,6 +152,10 @@ def test_string_values_shuffle_roundtrip(manager, rng):
     manager.unregister_shuffle(40)
 
 
+# slow-marked for the tier-1 budget (a double e2e composition; the
+# varlen carry-combine contract stays in-tier via the varlen fuzz
+# sweep and test_service_raw_combine_sum_words)
+@pytest.mark.slow
 def test_wordcount_text_combined_and_plain(manager):
     from sparkucx_tpu.workloads.wordcount import run_wordcount_text
     out = run_wordcount_text(manager, shuffle_id=9023)

@@ -159,7 +159,12 @@ def test_wire_pack_unpack_bounded_and_head_exact(rng):
 
 
 # -- stochastic rounding: unbiased on both quantizer streams ---------------
-@pytest.mark.parametrize("impl", ("jnp", "interpret"))
+# interpret leg slow-marked for the tier-1 budget: the pallas kernel
+# shares the jnp path's rounding formula (caller-supplied uniforms),
+# so the jnp leg pins the statistics in-tier and the interpreter leg
+# re-pins the kernel plumbing in the soak/full lanes
+@pytest.mark.parametrize("impl", (
+    "jnp", pytest.param("interpret", marks=pytest.mark.slow)))
 def test_stochastic_rounding_unbiased(impl, rng):
     from sparkucx_tpu.ops.pallas.quant import (dequantize_rows,
                                                quantize_rows)

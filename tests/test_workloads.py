@@ -25,6 +25,48 @@ def test_groupby(manager):
     assert out["distinct_keys"] == 100
 
 
+def test_groupby_device_combiner(manager):
+    """The groupby-AGGREGATE shape riding the device combiner as the
+    flagship consumer (ISSUE-12): combined rows land and are consumed
+    on device, zero payload D2H, aggregates verified vs the host
+    oracle. Single-shot here (the module manager has no waves); the
+    waved fold leg rides the dedicated waved test below."""
+    from sparkucx_tpu.workloads.groupby import run_groupby_device
+    out = run_groupby_device(manager, num_mappers=8,
+                             pairs_per_mapper=500, key_space=100,
+                             num_partitions=16, shuffle_id=9102)
+    assert out["distinct_keys"] == 100
+    assert out["rows_staged"] == 4000
+    assert out["d2h_bytes"] == 0
+
+
+def test_groupby_device_combiner_waved(manager):
+    """Same flagship through the wave pipeline: per-wave combined runs
+    fold through the compiled device merge (reader.device_merge_fold)
+    before the consumer sees them — still zero D2H, still the oracle's
+    aggregates — and the read.sink=auto conf honors the per-read
+    device declaration (the resolver-audit contract)."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    from sparkucx_tpu.workloads.groupby import run_groupby_device
+    conf = TpuShuffleConf({"spark.shuffle.tpu.a2a.impl": "dense",
+                           "spark.shuffle.tpu.a2a.waveRows": "96"},
+                          use_env=False)
+    m = TpuShuffleManager(manager.node, conf)
+    try:
+        out = run_groupby_device(m, num_mappers=4, pairs_per_mapper=300,
+                                 key_space=100, num_partitions=16,
+                                 shuffle_id=9103)
+        assert out["distinct_keys"] == 100
+        assert out["d2h_bytes"] == 0
+        rep = m.report(9103)        # reports survive unregister (PR-2)
+        assert rep is not None
+        assert rep.waves >= 2 and rep.merge_ms > 0.0
+        assert rep.sink == "device"
+    finally:
+        m.stop()
+
+
 def test_terasort_device_range_sorted(manager):
     # the fully device-side pipeline: range routing AND per-partition key
     # sort both happen inside the compiled step (ordered=True)
