@@ -383,9 +383,11 @@ class ShuffleServiceV2:
             reason = "conf read.sink=host pins the drain"
         elif self.manager.node.is_distributed:
             reason = "distributed reads force-materialize host-side"
-        elif self.manager.hierarchical:
-            reason = "the hierarchical two-stage exchange drains " \
-                     "host-side"
+        # hierarchical is NOT pre-checked since the topology plane:
+        # single-shot hier reads keep the device sink (the stage-2
+        # output is partition-sorted on device); only a WAVED hier
+        # read demotes, and wavedness depends on per-read row counts —
+        # the post-check below fails that case closed
         if reason is not None:
             raise RuntimeError(
                 f"read_device on shuffle {handle.shuffle_id}: this "
@@ -397,16 +399,17 @@ class ShuffleServiceV2:
                                 sink="device")
         if getattr(res, "sink", "host") != "device":
             # the manager's resolve can demote for reasons this adapter
-            # cannot pre-check (conf read.sink=host pin, distributed,
-            # hierarchical mesh) — fail closed with the reason rather
-            # than hand a device-expecting caller a lazy result whose
-            # .consume() dies with a bare AttributeError
+            # cannot pre-check (e.g. a WAVED hierarchical read — the
+            # per-wave tier fold drains host-side) — fail closed with
+            # the reason rather than hand a device-expecting caller a
+            # lazy result whose .consume() dies with a bare
+            # AttributeError
             raise RuntimeError(
                 f"read_device on shuffle {handle.shuffle_id}: the "
                 f"manager resolved this read to the host sink (conf "
-                f"read.sink=host pin, distributed, or hierarchical "
-                f"mesh — see the warn-once log) — use reader() here, "
-                f"or lift the conf pin")
+                f"read.sink=host pin, distributed, or waved "
+                f"hierarchical read — see the warn-once log) — use "
+                f"reader() here, or lift the conf pin")
         return res
 
     # -- async shuffle lifecycle (shuffle/tenancy.py) ----------------------

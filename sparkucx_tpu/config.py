@@ -87,6 +87,7 @@ class TpuShuffleConf:
         "coordinator_address", "meta_buffer_size", "min_buffer_size",
         "min_allocation_size", "pre_allocate_buffers", "pinned_memory",
         "spill_threshold", "spill_dir", "a2a_impl", "a2a_wire",
+        "a2a_topology",
         "read_sink", "read_merge_impl", "wire_error_sample_rows",
         "sort_impl",
         "sort_strips", "combine_compaction", "fetch_granularity",
@@ -96,7 +97,8 @@ class TpuShuffleConf:
         "compile_cache_dir", "compile_min_compile_time_secs",
         "mesh_ici_axis", "mesh_dcn_axis", "num_slices", "num_processes",
         "cores_per_process", "connection_timeout_ms",
-        "collective_timeout_ms", "failure_policy", "replay_budget",
+        "collective_timeout_ms", "ici_timeout_ms", "dcn_timeout_ms",
+        "failure_policy", "replay_budget",
         "max_backoff_ms", "integrity_verify", "ledger_dir")
     # Namespace keys consumed OUTSIDE config.py (grep-verified), plus the
     # prefix families. A spark.shuffle.tpu.* key matching none of these is
@@ -109,8 +111,9 @@ class TpuShuffleConf:
     # here both silences the unknown-key warning AND lists it in the
     # self-describing table — no second copy to drift.
     _EXTERNAL_KEY_DOCS = {
-        "a2a.hierarchical": "force the two-stage ICI/DCN exchange on a "
-                            "multi-slice mesh (shuffle/hierarchical.py)",
+        "a2a.hierarchical": "LEGACY boolean: false forces the flat "
+                            "exchange under a2a.topology=auto (prefer "
+                            "a2a.topology; shuffle/topology.py)",
         "io.format": "shuffle payload codec: raw | arrow | varlen "
                      "(service.py connect)",
         "io.keyColumn": "arrow format: which column is the shuffle key "
@@ -424,6 +427,22 @@ class TpuShuffleConf:
                              conf_key=PREFIX + "a2a.wire")
 
     @property
+    def a2a_topology(self) -> str:
+        """Exchange topology: ``flat`` (one collective over every
+        device — the single-slice contract), ``hier`` (the two-stage
+        ICI-then-DCN decomposition, shuffle/topology.py — each row
+        crosses the slow inter-slice fabric exactly once; requires a
+        2-D ``(dcn, ici)`` mesh with >1 slice), or ``auto`` (default —
+        slice detection from the mesh: hier exactly when the mesh is
+        2-D with more than one slice). The legacy boolean
+        ``a2a.hierarchical=false`` still forces flat under ``auto``
+        (shuffle/topology.resolve_topology honors it); the allowed set
+        lives in ONE place — shuffle/alltoall.ALLOWED_TOPOLOGIES."""
+        from sparkucx_tpu.shuffle.alltoall import validate_topology
+        return validate_topology(self._get("a2a.topology", "auto"),
+                                 conf_key=PREFIX + "a2a.topology")
+
+    @property
     def read_sink(self) -> str:
         """Where a completed exchange LANDS: ``host`` (drain receive
         buffers D2H and serve numpy partition views — the historical
@@ -716,6 +735,35 @@ class TpuShuffleConf:
                 f"spark.shuffle.tpu.failure.collectiveTimeoutMs={v}: "
                 f"want >= 0 (0 = off)")
         return v
+
+    def _tier_timeout(self, tier: str) -> float:
+        v = self.get_float(f"failure.{tier}.timeoutMs",
+                           self.collective_timeout_ms)
+        if v < 0:
+            raise ValueError(
+                f"spark.shuffle.tpu.failure.{tier}.timeoutMs={v}: "
+                f"want >= 0 (0 = off)")
+        return v
+
+    @property
+    def ici_timeout_ms(self) -> float:
+        """Per-tier deadline on the INTRA-slice (ICI) phase of a
+        hierarchical exchange (shuffle/topology.py): past it the
+        watchdog raises PeerLostError naming the ICI tier, so the
+        flight postmortem attributes the hang to the slice fabric
+        instead of the whole collective. Defaults to
+        ``failure.collectiveTimeoutMs`` (0 = off)."""
+        return self._tier_timeout("ici")
+
+    @property
+    def dcn_timeout_ms(self) -> float:
+        """Per-tier deadline on the CROSS-slice (DCN) phase of a
+        hierarchical exchange — the ``failure.ici.timeoutMs`` twin for
+        the slow inter-slice fabric. A DCN expiry names the DCN tier in
+        the typed error and the postmortem, which is what lets the
+        doctor and the operator tell an ICI straggler from a DCN one.
+        Defaults to ``failure.collectiveTimeoutMs`` (0 = off)."""
+        return self._tier_timeout("dcn")
 
     @property
     def failure_policy(self) -> str:

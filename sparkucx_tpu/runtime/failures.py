@@ -134,6 +134,9 @@ class _NullFlightRecorder:
     def current_trace(self) -> Optional[str]:
         return None
 
+    def events(self):
+        return []
+
     def dump(self, reason: str, extra: Optional[Dict] = None):
         return None
 
@@ -216,6 +219,14 @@ class FlightRecorder:
         with self._lock:
             return self._inflight_traces[-1] if self._inflight_traces \
                 else None
+
+    def events(self):
+        """Snapshot of the event ring, oldest first — what a dump would
+        carry; lets tests/drills assert an event landed (e.g. the
+        tiered exchange's tier_fault naming the tier) without forcing a
+        postmortem file."""
+        with self._lock:
+            return list(self._events)
 
     def record(self, kind: str, **data) -> None:
         try:

@@ -122,6 +122,44 @@ ALLOWED_MERGE_IMPLS = ("auto", "jnp", "pallas")
 READ_MERGE_IMPL_KEY = "spark.shuffle.tpu.read.mergeImpl"
 
 
+# Exchange topologies (conf key ``spark.shuffle.tpu.a2a.topology``) — how
+# the collective decomposes over the mesh fabric, orthogonal to a2a.impl
+# (which transport each hop rides) and a2a.wire (how many bytes each row
+# costs on it):
+#
+# ``flat`` — ONE collective over every device, the single-slice contract;
+#            on a multi-slice mesh most device pairs ride DCN, the regime
+#            where the reference's one-big-read model "degrades to
+#            point-to-point transfers again" (shuffle/hierarchical.py:6-8).
+# ``hier`` — the two-stage ICI-then-DCN decomposition
+#            (shuffle/topology.py): stage 1 exchanges within each slice
+#            over ICI grouped by destination DEVICE INDEX, stage 2
+#            exchanges across slices over DCN grouped by destination
+#            SLICE — each row crosses the slow fabric exactly once.
+#            Requires a 2-D ``(dcn, ici)`` mesh with >1 slice.
+# ``auto`` — slice detection from the mesh (the default): hier exactly
+#            when the mesh is 2-D ``(dcn, ici)`` with more than one
+#            slice, flat otherwise.
+ALLOWED_TOPOLOGIES = ("flat", "hier", "auto")
+
+A2A_TOPOLOGY_KEY = "spark.shuffle.tpu.a2a.topology"
+
+
+def validate_topology(topology: str,
+                      conf_key: str = A2A_TOPOLOGY_KEY) -> str:
+    """The one validation seam for the exchange-topology set (the
+    validate_impl/validate_wire/validate_sink discipline): config.py,
+    the topology resolver (shuffle/topology.resolve_topology) and the
+    bench CLI accept exactly ``ALLOWED_TOPOLOGIES``."""
+    if topology not in ALLOWED_TOPOLOGIES:
+        raise ValueError(
+            f"{conf_key}={topology!r}: want one of {ALLOWED_TOPOLOGIES} "
+            f"(flat = one collective over every device, hier = the "
+            f"two-stage ICI/DCN decomposition on a 2-D (dcn, ici) mesh, "
+            f"auto = hier exactly when the mesh has >1 slice)")
+    return topology
+
+
 def validate_merge_impl(impl: str,
                         conf_key: str = READ_MERGE_IMPL_KEY) -> str:
     """The one validation seam for the device-merge impl set (the

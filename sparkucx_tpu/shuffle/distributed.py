@@ -359,7 +359,13 @@ class PendingDistributedShuffle(PendingExchangeBase):
             mine = current_watchdog().call(
                 lambda: any(bool(np.asarray(s.data).any())
                             for s in ovf.addressable_shards),
-                what="exchange completion wait")
+                # the fused hierarchical step cannot split its tiers
+                # under separate deadlines (shuffle/topology.py does,
+                # single-process) — but the fence should still SAY the
+                # wait covered both fabrics when it expires
+                what="hierarchical (ici+dcn fused) exchange completion "
+                     "wait" if self._hier_mesh is not None
+                else "exchange completion wait")
             ovf_global = bool(allgather_blob(
                 np.array([1 if mine else 0], dtype=np.int64),
                 what="overflow verdict").any())

@@ -36,11 +36,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from sparkucx_tpu.ops.partition import destination_sort, hash_partition
-from sparkucx_tpu.shuffle.alltoall import ragged_shuffle
 from sparkucx_tpu.shuffle.plan import ShufflePlan
-from sparkucx_tpu.shuffle.reader import (
-    ShuffleReaderResult, _blocked_map, _device_bounds)
+from sparkucx_tpu.shuffle.reader import ShuffleReaderResult
 from sparkucx_tpu.utils.logging import get_logger
 
 log = get_logger("shuffle.hierarchical")
@@ -48,123 +45,66 @@ log = get_logger("shuffle.hierarchical")
 
 def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
                      plan: ShufflePlan, width: int):
-    """The two-stage exchange for one (mesh, plan, width), served from
-    the shared keyed step cache (shuffle/stepcache.py — one compiled
-    program per plan signature, observable, shared with the flat builder
-    and manager.warmup)."""
+    """The FUSED two-stage exchange for one (mesh, plan, width), served
+    from the shared keyed step cache (shuffle/stepcache.py — one
+    compiled program per plan signature, observable, shared with the
+    flat builder and manager.warmup). Keyed on the STRUCTURAL mesh
+    identity (topology.mesh_cache_key: devices.shape, axis names,
+    device ids) — a remeshed-but-identical mesh (PR-7 replay rebinds a
+    fresh Mesh object over the same devices) reuses its compiled
+    programs instead of recompiling both tiers."""
     from sparkucx_tpu.shuffle.stepcache import GLOBAL_STEP_CACHE
+    from sparkucx_tpu.shuffle.topology import mesh_cache_key
     return GLOBAL_STEP_CACHE.get(
-        ("hier", mesh, dcn_axis, ici_axis, plan, width),
+        ("hier", mesh_cache_key(mesh), dcn_axis, ici_axis, plan, width),
         lambda: _build_hier_step_uncached(mesh, dcn_axis, ici_axis, plan,
                                           width),
         {"kind": "hier", "cap_in": plan.cap_in, "cap_out": plan.cap_out,
-         "width": width, "impl": plan.impl})
+         "width": width, "impl": plan.impl, "wire": plan.wire})
 
 
 def _build_hier_step_uncached(mesh: Mesh, dcn_axis: str, ici_axis: str,
                               plan: ShufflePlan, width: int):
     """Mesh must be 2-D ``(dcn=S, ici=D)``; global shard id g = s*D + d
     matches ``mesh.devices.reshape(-1)`` order, so the flat
-    ``blocked_partition_map`` routing is identical to the flat reader's."""
-    if mesh.axis_names != (dcn_axis, ici_axis):
-        raise ValueError(
-            f"hierarchical shuffle needs mesh axes ({dcn_axis!r}, "
-            f"{ici_axis!r}) in that order, got {mesh.axis_names}")
-    S, D = mesh.devices.shape
-    R = plan.num_partitions
-    Pn = plan.num_shards
-    assert Pn == S * D, (Pn, S, D)
-    # numpy constants, not jnp: closed-over concrete jnp arrays become
-    # lifted executable parameters that the C++ fastpath fails to
-    # re-supply on repeat calls when traced inside a caller's scan
-    # (see reader.step_body)
-    part_to_dest = np.asarray(_blocked_map(R, Pn))
-    bounds = _device_bounds(R, Pn)                # [P+1] partition ranges
+    ``blocked_partition_map`` routing is identical to the flat reader's.
 
-    def part_fn(rows):
-        if plan.partitioner == "direct":
-            return jnp.clip(rows[:, 0], 0, R - 1)
-        if plan.partitioner == "range":
-            from sparkucx_tpu.ops.partition import range_partition_words
-            return range_partition_words(rows[:, 0], rows[:, 1], plan.bounds)
-        return hash_partition(rows[:, 0], R)
+    The stage ALGEBRA has one home — ``topology._stage1_body`` /
+    ``_stage2_body`` (the split tiered path composes the same bodies as
+    two programs with a host join; this fused form inlines the join:
+    stage 1's in-graph totals feed stage 2, a distinct noise stream is
+    derived for the second hop, and the overflow flags OR) — so a fix
+    to the relay grouping or the finalize can never drift between the
+    single-process tiered path and this multi-process fused one."""
+    from sparkucx_tpu.shuffle.alltoall import wire_noise_seed
+    from sparkucx_tpu.shuffle.plan import plan_takes_seed
+    from sparkucx_tpu.shuffle.topology import (TopologyDescriptor,
+                                               _check_hier_mesh,
+                                               _stage1_body, _stage2_body)
+    S, D = mesh.devices.shape
+    assert plan.num_shards == S * D, (plan.num_shards, S, D)
+    topo = TopologyDescriptor("hier", ici_axis=ici_axis,
+                              dcn_axis=dcn_axis, num_slices=int(S),
+                              per_slice=int(D))
+    _check_hier_mesh(mesh, topo)
+    stage1 = _stage1_body(plan, topo, int(plan.cap_out))
+    stage2 = _stage2_body(plan, topo, int(plan.cap_out))
+    seeded = plan_takes_seed(plan)
 
     def step(payload, nvalid):
-        # payload [cap_in, W] int32, col 0 = key_lo; nvalid [1]
-        n0 = nvalid[0]
-        if plan.combine:
-            # map-side combine shrinks BOTH hops; re-sorted by device
-            # index below since partition-major is not d'-major
-            from sparkucx_tpu.ops.aggregate import combine_rows
-            payload, _, n1 = combine_rows(
-                payload, part_fn(payload), n0, R,
-                plan.combine_words, np.dtype(plan.combine_dtype),
-                plan.combine, sum_words=plan.combine_sum_words,
-                compaction=plan.combine_compaction)
-            n0 = n1[0]
-        g = jnp.take(part_to_dest, part_fn(payload))  # global shard
-
-        # stage 1 — ICI: group by destination device index d' = g % D
-        send1, counts1 = destination_sort(
-            payload, g % D, n0, D, method=plan.sort_impl)
-        r1 = ragged_shuffle(send1, counts1, ici_axis,
-                            out_capacity=plan.cap_out, impl=plan.impl)
-
-        # stage 2 — DCN: group by GLOBAL PARTITION id. Every row here is
-        # destined to some (s', d_mine); its global shard g2 = s'*D +
-        # d_mine is monotone in the partition id, so the partition sort
-        # groups by destination slice AND leaves each delivered segment
-        # partition-sorted — no receive-side regrouping (the flat
-        # reader's partition-major design, shuffle/reader.py _build_step).
-        # With combine on, the relay MERGES same-key rows from its whole
-        # slice first — the rows that shrink here are exactly the ones
-        # that would otherwise cross DCN, the slow fabric.
-        part2 = part_fn(r1.data)
-        if plan.combine:
-            from sparkucx_tpu.ops.aggregate import combine_rows
-            send2, rcounts2, _ = combine_rows(
-                r1.data, part2, r1.total[0], R, plan.combine_words,
-                np.dtype(plan.combine_dtype), plan.combine,
-                sum_words=plan.combine_sum_words,
-                compaction=plan.combine_compaction)
+        # payload [cap_in, W] int32, col 0 = key_lo; nvalid [1] — or
+        # [count, seed] on the int8 wire (reader.seeded_nvalid): the
+        # wire tier narrows BOTH hops, the second drawing a distinct
+        # noise stream derived in-graph from the per-shard seed
+        relay, tot1, ovf1 = stage1(payload, nvalid)
+        if seeded:
+            nv2 = jnp.stack([tot1[0],
+                             wire_noise_seed(nvalid[1], 1)]
+                            ).astype(jnp.int32)
         else:
-            # ordered needs no key order at the relay either — the final
-            # stage fully re-sorts; the plain partition sort is cheaper
-            # and byte-identical downstream
-            send2, rcounts2 = destination_sort(
-                r1.data, part2, r1.total[0], R, method=plan.sort_impl)
-        d_mine = jax.lax.axis_index(ici_axis)
-        cum2 = jnp.concatenate([jnp.zeros((1,), jnp.int32),
-                                jnp.cumsum(rcounts2).astype(jnp.int32)])
-        gs = jnp.arange(S, dtype=jnp.int32) * D + d_mine    # my column's shards
-        counts2 = jnp.take(cum2, jnp.take(bounds, gs + 1)) \
-            - jnp.take(cum2, jnp.take(bounds, gs))          # [S]
-        r2 = ragged_shuffle(send2, counts2, dcn_axis,
-                            out_capacity=plan.cap_out, impl=plan.impl)
-        overflow = r1.overflow | r2.overflow
-
-        if plan.combine:
-            # reduce-side merge across relays: one run per partition; the
-            # seg matrix is this shard's own combined counts ([1, R])
-            from sparkucx_tpu.ops.aggregate import combine_rows
-            rows_out, pcounts, n_out = combine_rows(
-                r2.data, part_fn(r2.data), r2.total[0], R,
-                plan.combine_words, np.dtype(plan.combine_dtype),
-                plan.combine, sum_words=plan.combine_sum_words,
-                compaction=plan.combine_compaction)
-            return rows_out, pcounts.reshape(1, R), \
-                n_out.astype(r2.total.dtype), overflow
-        if plan.ordered:
-            from sparkucx_tpu.ops.aggregate import keysort_rows
-            _, rows_out, pcounts = keysort_rows(
-                r2.data, part_fn(r2.data), r2.total[0], R)
-            return rows_out, pcounts.reshape(1, R), r2.total, overflow
-
-        # receivers locate their runs with the relays' per-partition
-        # counts: [S, R] per shard (relays share a device column, so the
-        # dcn all_gather collects exactly this receiver's senders)
-        seg = jax.lax.all_gather(rcounts2, dcn_axis)
-        return r2.data, seg, r2.total, overflow
+            nv2 = tot1
+        rows_out, seg, total, ovf2 = stage2(relay, nv2)
+        return rows_out, seg, total, ovf1 | ovf2
 
     spec = P((dcn_axis, ici_axis))
     sm = jax.shard_map(step, mesh=mesh, in_specs=(spec, spec),

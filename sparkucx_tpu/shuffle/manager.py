@@ -60,7 +60,7 @@ from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES,
                                         C_INTEGRITY_RECOVERED,
                                         C_INTEGRITY_VERIFIED,
                                         C_REPLAY_MS, C_REPLAYS,
-                                        C_SINK_FALLBACK,
+                                        C_SINK_FALLBACK, C_TIER_BYTES,
                                         COMPILE_HITS, COMPILE_PROGRAMS,
                                         G_TENANT_INFLIGHT,
                                         GLOBAL_METRICS, H_ADMIT_CROSS,
@@ -235,6 +235,18 @@ class ExchangeReport:
     # deferred, so consumers wanting the pure exchange wall subtract it.
     tenant: str = ""
     admit_wait_ms: float = 0.0
+    # Topology plane (shuffle/topology.py): per-tier accounting of a
+    # hierarchical exchange — one entry per fabric tier ("ici", "dcn"),
+    # each a separate payload/wire pair (stage-1 ICI bytes vs stage-2
+    # DCN bytes) with its own pad_ratio, measured wall (``ms``, from
+    # the tiered pending's per-tier joins) and effective_bw_gbps; the
+    # DCN entry's ``payload_rows`` with ``cross_exact=true`` is the
+    # each-row-crosses-the-slow-tier-exactly-once evidence (derived
+    # from the metadata table's device matrix). Empty on flat reads.
+    # When present, the headline ``wire_bytes``/``pad_ratio`` above are
+    # the TWO-HOP SUM (the real fabric cost), not the flat
+    # single-collective lower bound the pre-topology reports carried.
+    tiers: List[Dict] = field(default_factory=list)
     completed: bool = False
     error: Optional[str] = None
     # bookkeeping, excluded from to_dict()
@@ -427,22 +439,18 @@ class TpuShuffleManager:
         self.node.epochs.on_bump(self._on_epoch_bump)
 
     def _bind_mesh(self) -> None:
-        """Derive the exchange topology from the node's current mesh."""
+        """Derive the exchange topology from the node's current mesh —
+        resolved through the topology plane (``a2a.topology``, slice
+        detection under ``auto``), so a replay remesh re-resolves on
+        the SURVIVING mesh: a world that is still 2-D multi-slice keeps
+        the two-tier exchange, one that collapsed to a single slice
+        falls back to flat."""
+        from sparkucx_tpu.shuffle.topology import resolve_topology
         mesh = self.node.mesh
-        self.axis = self.conf.mesh_ici_axis \
-            if self.conf.mesh_ici_axis in mesh.axis_names \
-            else mesh.axis_names[-1]
-        self.hierarchical = False
+        self.topology = resolve_topology(mesh, self.conf)
+        self.axis = self.topology.ici_axis
+        self.hierarchical = self.topology.hierarchical
         if len(mesh.axis_names) > 1:
-            dcn = self.conf.mesh_dcn_axis
-            dcn_size = mesh.devices.shape[mesh.axis_names.index(dcn)] \
-                if dcn in mesh.axis_names else 1
-            # Multi-slice: prefer the two-stage ICI->DCN exchange
-            # (shuffle/hierarchical.py) so each row crosses DCN exactly
-            # once; `a2a.hierarchical=false` falls back to the flat
-            # one-collective exchange over a flattened alias mesh.
-            self.hierarchical = dcn_size > 1 and \
-                self.conf.get_bool("a2a.hierarchical", True)
             from jax.sharding import Mesh as _Mesh
             self.exchange_mesh = _Mesh(
                 mesh.devices.reshape(-1), (self.axis,))
@@ -1687,7 +1695,34 @@ class TpuShuffleManager:
             raise NotImplementedError(
                 "impl='pallas' is single-process for now — warmup "
                 "follows read()'s restriction")
-        if self.hierarchical and plan.impl != "pallas":
+        hier = self.hierarchical and plan.impl != "pallas"
+        if hier and not self.node.is_distributed:
+            # the local path dispatches the TIERED two-step exchange:
+            # warm BOTH tier programs — stage 1 on empty inputs, then
+            # stage 2 fed the (zero) relay it produced, so each warmed
+            # program's signature matches its real dispatch exactly
+            from sparkucx_tpu.shuffle.plan import plan_takes_seed \
+                as _takes_seed
+            from sparkucx_tpu.shuffle.topology import (
+                _build_stage1_step, _build_stage2_step)
+            s1 = _build_stage1_step(self.node.mesh, self.topology, plan,
+                                    width, plan.cap_out)
+            s2 = _build_stage2_step(self.node.mesh, self.topology, plan,
+                                    width, plan.cap_out, plan.cap_out)
+            sharding = NamedSharding(
+                self.node.mesh,
+                PSpec((self.conf.mesh_dcn_axis, self.axis)))
+            Pn = plan.num_shards
+            lanes = 2 if _takes_seed(plan) else 1
+            from sparkucx_tpu.io.dlpack import stage_to_device as _std
+            payload = _std(np.zeros((Pn * plan.cap_in, width), np.int32),
+                           sharding)
+            nvalid = _std(np.zeros(Pn * lanes, np.int32), sharding)
+            relay, _tot, _ovf = s1(payload, nvalid)
+            out = s2(relay, nvalid)
+            _jax.block_until_ready(out)
+            return
+        if hier:
             from sparkucx_tpu.shuffle.hierarchical import _build_hier_step
             step = _build_hier_step(self.node.mesh,
                                     self.conf.mesh_dcn_axis, self.axis,
@@ -1986,8 +2021,8 @@ class TpuShuffleManager:
             map_to_dev = np.arange(handle.num_maps) % Pn
             red_to_dev = np.asarray(
                 blocked_partition_map(handle.num_partitions, Pn))
-            validate_row_sizes(table.device_matrix(map_to_dev, red_to_dev,
-                                                   Pn))
+            dev_matrix = table.device_matrix(map_to_dev, red_to_dev, Pn)
+            validate_row_sizes(dev_matrix)
 
             nvalid = np.array(
                 [sum(k.shape[0] for k, _ in outs) for outs in shard_outputs],
@@ -2011,6 +2046,14 @@ class TpuShuffleManager:
             self._report_volume(rep, plan, nvalid, width,
                                 part_rows=table.sizes.sum(axis=0))
             self._estimate_wire_error(rep, plan, shard_outputs)
+            hier = self.hierarchical and plan.impl != "pallas"
+            if hier:
+                # per-tier accounting: stage-1 ICI vs stage-2 DCN as
+                # separate payload/wire pairs, cross-fabric rows EXACT
+                # from the metadata table's device matrix (the
+                # crosses-DCN-exactly-once evidence)
+                self._stamp_tiers(rep, plan, nvalid, width,
+                                  dev_matrix=dev_matrix)
             # Wave-pipelined mode (a2a.waveRows): instead of one giant
             # pack + one monolithic program, split the staged rows into
             # fixed-shape waves and run a software pipeline inside the
@@ -2019,6 +2062,22 @@ class TpuShuffleManager:
             if self.conf.wave_rows > 0 and self._waves_eligible(plan):
                 W = wave_count(nvalid, self.conf.wave_rows)
                 if W > 1:
+                    if hier and plan.sink == "device":
+                        # waved hierarchical reads drain host-side (the
+                        # per-wave tier fold has no device merge over
+                        # the 2-D mesh yet) — counted, the single-shot
+                        # hier path keeps the device sink
+                        mode = "combine" if combine else (
+                            "ordered" if ordered else "plain")
+                        self._warn_sink_once(
+                            "hier_waved",
+                            "read.sink=device on a WAVED hierarchical "
+                            "read resolves to host (single-shot "
+                            "hierarchical reads keep the device sink)")
+                        self._note_sink_fallback(mode,
+                                                 "hierarchical_waved")
+                        plan = dataclasses.replace(plan, sink="host")
+                        rep.sink = "host"
                     return self._submit_waved(
                         handle, shard_outputs, nvalid, plan, width,
                         has_vals, val_tail if has_vals else None,
@@ -2069,22 +2128,30 @@ class TpuShuffleManager:
                     # the pallas transport is flat-only: run it over the
                     # flattened alias mesh (correct on a single process;
                     # the two-stage DCN-once optimization is native/dense
-                    # territory)
+                    # territory) — the report must say what RAN
                     log.info("a2a.impl=pallas on a multi-slice mesh: "
                              "using the flat exchange over %d devices",
                              self.exchange_mesh.devices.size)
+                    rep.hierarchical = False
                     pending = submit_shuffle(
                         self.exchange_mesh, self.axis, plan,
                         shard_rows, nvalid, vt, val_dtype,
                         on_done=on_done, admit=admit,
                         wire_seed=rep._seq)
                 elif self.hierarchical:
-                    from sparkucx_tpu.shuffle.hierarchical import \
-                        submit_shuffle_hierarchical
-                    pending = submit_shuffle_hierarchical(
-                        self.node.mesh, self.conf.mesh_dcn_axis, self.axis,
-                        plan, shard_rows, nvalid, vt, val_dtype,
-                        on_done=on_done, admit=admit)
+                    # the tiered two-step path (shuffle/topology.py):
+                    # stage-1 ICI and stage-2 DCN as separate compiled
+                    # programs with per-tier deadlines/walls/faults —
+                    # same admission, on_done and wire-seed contract as
+                    # the flat pending
+                    from sparkucx_tpu.shuffle.topology import \
+                        submit_shuffle_tiered
+                    pending = submit_shuffle_tiered(
+                        self.node.mesh, self.topology, plan,
+                        shard_rows, nvalid, vt, val_dtype,
+                        on_done=on_done, admit=admit,
+                        wire_seed=rep._seq,
+                        hooks=self._tier_hooks(rep.trace_id))
                 else:
                     pending = submit_shuffle(
                         self.exchange_mesh, self.axis, plan,
@@ -2196,6 +2263,98 @@ class TpuShuffleManager:
         rep.pad_ratio = round(wire / rep.payload_bytes, 6) \
             if rep.payload_bytes else 0.0
 
+    # -- topology plane (shuffle/topology.py) ------------------------------
+    def _tier_hooks(self, trace_id: str):
+        """Per-read plumbing for the tiered two-step exchange: fault
+        sites (tier.ici/tier.dcn), tracer tier spans, flight events and
+        the per-tier watchdog deadlines (failure.ici/dcn.timeoutMs,
+        defaulting from collectiveTimeoutMs)."""
+        from sparkucx_tpu.shuffle.topology import TierHooks, tier_timeouts
+        return TierHooks(faults=self.node.faults, tracer=self.node.tracer,
+                         flight=self.node.flight, trace_id=trace_id,
+                         timeouts=tier_timeouts(self.conf))
+
+    def _stamp_tiers(self, rep: ExchangeReport, plan: ShufflePlan,
+                     nvalid, width: int, dev_matrix=None,
+                     relay_cap=None) -> None:
+        """Fill ``rep.tiers`` (per-tier payload/wire pairs) and make the
+        headline wire accounting the TWO-HOP SUM — the real fabric cost
+        of a hierarchical exchange, replacing the flat single-collective
+        lower bound _report_volume stamped. ``dev_matrix`` ([P, P]
+        source x dest rows, the metadata table's device matrix) makes
+        the cross-fabric row counts exact — the local read path holds
+        it; distributed reads stamp the every-row upper bound."""
+        from sparkucx_tpu.shuffle.topology import tier_layouts
+        rep.tiers = tier_layouts(plan, self.topology, nvalid, width,
+                                 dev_matrix=dev_matrix,
+                                 relay_cap=relay_cap)
+        rep._tier_matrix = None if dev_matrix is None \
+            else np.asarray(dev_matrix)
+        wire = sum(t["wire_bytes"] for t in rep.tiers)
+        rep.wire_bytes = int(wire)
+        rep.pad_ratio = round(wire / rep.payload_bytes, 6) \
+            if rep.payload_bytes else 0.0
+
+    def _stamp_wave_tiers(self, rep: ExchangeReport, wplan: ShufflePlan,
+                          wave_sizes, width: int) -> None:
+        """Waved hierarchical tier accounting: the pipeline dispatches W
+        tiered exchanges of the wave plan's shape — per-tier wire cost
+        is per wave (padded transports pay their caps every wave), the
+        per-tier payload the summed real rows. Cross-fabric counts are
+        not derivable per wave (the device matrix is whole-exchange),
+        so the entries carry the every-row upper bound
+        (cross_exact=false)."""
+        from sparkucx_tpu.shuffle.topology import tier_layouts
+        tiers = None
+        for s in wave_sizes:
+            lays = tier_layouts(wplan, self.topology,
+                                np.asarray([int(s)]), width)
+            if tiers is None:
+                tiers = lays
+            else:
+                for acc, lay in zip(tiers, lays):
+                    for k in ("payload_rows", "payload_bytes",
+                              "wire_rows", "wire_bytes"):
+                        acc[k] += lay[k]
+        for t in tiers or []:
+            t["pad_ratio"] = round(
+                t["wire_bytes"] / t["payload_bytes"], 6) \
+                if t["payload_bytes"] else 0.0
+            t["rows_in"] = int(sum(int(s) for s in wave_sizes))
+        rep.tiers = tiers or []
+        wire = sum(t["wire_bytes"] for t in rep.tiers)
+        rep.wire_bytes = int(wire)
+        rep.pad_ratio = round(wire / rep.payload_bytes, 6) \
+            if rep.payload_bytes else 0.0
+
+    def _settle_tiers(self, rep: ExchangeReport, tier_walls,
+                      width: int, completed: bool = True) -> None:
+        """Stamp measured per-tier walls/rates onto ``rep.tiers`` and
+        account the per-tier wire counters
+        (``shuffle.tier.bytes{tier,tenant}``) — called exactly once per
+        hierarchical read (single-shot on_done, waved finalize). A
+        FAILED read keeps its measured walls (postmortem evidence: the
+        tier that burned the wall is the tier that hung) but counts no
+        wire — the bytes never fully moved."""
+        if not rep.tiers:
+            return
+        from sparkucx_tpu.shuffle.topology import settle_tier_walls
+        if tier_walls:
+            settle_tier_walls(rep.tiers, tier_walls, width)
+        if not completed:
+            return
+        metrics = self.node.metrics
+        tid = rep.tenant or self._tenants.default_id
+        frac = len(self.node.local_shard_ids) \
+            / max(self.node.num_devices, 1)
+        for t in rep.tiers:
+            # LOCAL share, the _inc_volume discipline: counters sum
+            # across processes in doctor.build_view, and the cluster
+            # sum must reconstruct each tier's global wire exactly once
+            metrics.inc(labeled(C_TIER_BYTES, tier=t["tier"],
+                                tenant=tid),
+                        float(t["wire_bytes"]) * frac)
+
     def _finish_device_plane(self, rep: ExchangeReport, step, width: int,
                              completed: bool) -> None:
         """Complete a report's device-plane fields at read settlement:
@@ -2293,11 +2452,28 @@ class TpuShuffleManager:
                     # the overflow retry regrew the plan: wire accounting
                     # must reflect the capacities the FINAL dispatch
                     # padded to, not the ones the first attempt overflowed
-                    lay = ragged_layout(pend._plan,
-                                        np.asarray(report.peer_rows),
-                                        width)
-                    report.wire_bytes = lay.wire_bytes
-                    report.pad_ratio = lay.pad_ratio
+                    if report.tiers:
+                        # tiered: re-derive BOTH hops under the final
+                        # capacities (stage-2 regrow + relay regrow)
+                        self._stamp_tiers(
+                            report, pend._plan,
+                            np.asarray(report.peer_rows), width,
+                            dev_matrix=getattr(report, "_tier_matrix",
+                                               None),
+                            relay_cap=getattr(pend, "_relay_cap", None))
+                    else:
+                        lay = ragged_layout(pend._plan,
+                                            np.asarray(report.peer_rows),
+                                            width)
+                        report.wire_bytes = lay.wire_bytes
+                        report.pad_ratio = lay.pad_ratio
+                if report.tiers:
+                    # per-tier walls/rates + shuffle.tier.bytes{tier,
+                    # tenant} — the single-shot settle (waved reads
+                    # settle in their finalize)
+                    self._settle_tiers(
+                        report, getattr(pend, "tier_walls", None),
+                        width, completed=result is not None)
                 if result is not None and report.payload_bytes:
                     # cumulative real-vs-wire volume counters — the
                     # Prometheus view of the per-report pad_ratio. The
@@ -2388,23 +2564,25 @@ class TpuShuffleManager:
         """Resolve the conf's ``a2a.wire`` ask against what THIS read can
         actually compress — the (wire, wire_words) pair the plan is
         stamped with. ``int8`` demands float32 value lanes (keys and int
-        payloads stay exact by the contract) and a real wire move: the
-        hierarchical two-stage exchange, a 1-shard axis (the local move)
-        and the strip-sorted fast path (no collective at all) all
-        resolve to raw — the report's ``wire`` field says which tier
-        ran, never which was asked for. ``lossless`` is dtype-agnostic
-        (bit-exact host codec). Resolution is pure conf/plan/schema
-        facts — identical on every process, SPMD-safe without a
-        collective (the _waves_eligible discipline)."""
+        payloads stay exact by the contract) and a real wire move: a
+        1-shard axis (the local move) and the strip-sorted fast path
+        (no collective at all) resolve to raw — the report's ``wire``
+        field says which tier ran, never which was asked for. The
+        hierarchical two-stage exchange is int8-ELIGIBLE: each hop
+        quantizes around its own collective (topology._tier_wire_
+        shuffle — the DCN hop, the slow fabric, is exactly where the
+        narrowing pays most; two hops means two rounding steps, still
+        unbiased per step). ``lossless`` is dtype-agnostic (bit-exact
+        host codec). Resolution is pure conf/plan/schema facts —
+        identical on every process, SPMD-safe without a collective
+        (the _waves_eligible discipline)."""
         wire = self.conf.a2a_wire
         if wire == "raw":
             return "raw", 0
         if wire == "lossless":
             return "lossless", 0
         reason = None
-        if self.hierarchical:
-            reason = "the hierarchical two-stage exchange is active"
-        elif plan.num_shards == 1 or plan.strips_active():
+        if plan.num_shards == 1 or plan.strips_active():
             reason = "no wire move exists on this path (1-shard/strips)"
         elif not has_vals:
             reason = "keys-only payload (key lanes stay exact)"
@@ -2448,15 +2626,17 @@ class TpuShuffleManager:
         device sink for this read; ``device`` makes device the default
         ask; ``host`` pins the historical drain. The device sink is
         legal for ALL FOUR read modes on the single-process flat
-        exchange: plain/shard land as delivered, ordered/combine land
-        fully merged on device (single-shot: the exchange step already
-        merged; waved: reader.device_merge_fold folds the per-wave runs
-        through the compiled merge). A device ask still falls back to
-        host — warn-once AND counted (``shuffle.sink.fallback.count``,
-        the doctor's sink_fallback evidence) — where the result cannot
-        stay resident: distributed reads (the partial view
-        force-materializes local shards) and the hierarchical two-stage
-        exchange."""
+        exchange AND the single-shot hierarchical two-tier exchange
+        (the stage-2 output is already partition-sorted on device —
+        ordered/combine land fully merged, shuffle/topology.py): the
+        restriction the pre-topology resolver enforced was pure
+        policy. A device ask still falls back to host — warn-once AND
+        counted (``shuffle.sink.fallback.count``, the doctor's
+        sink_fallback evidence) — where the result cannot stay
+        resident: distributed reads (the partial view
+        force-materializes local shards) and WAVED hierarchical reads
+        (the per-wave fold is demoted at the wave branch, reason
+        ``hierarchical_waved``)."""
         from sparkucx_tpu.shuffle.alltoall import validate_sink
         if requested is not None:
             validate_sink(requested, conf_key="read(sink=...)")
@@ -2484,9 +2664,6 @@ class TpuShuffleManager:
             reason = ("distributed reads force-materialize their local "
                       "shards (the device sink is single-process for now)")
             reason_key = "distributed"
-        elif self.hierarchical:
-            reason = "the hierarchical two-stage exchange drains host-side"
-            reason_key = "hierarchical"
         if reason is not None:
             self._warn_sink_once(
                 "fallback_" + reason[:24],
@@ -2829,18 +3006,25 @@ class TpuShuffleManager:
 
     # -- wave-pipelined exchange (a2a.waveRows) ----------------------------
     def _waves_eligible(self, plan: ShufflePlan) -> bool:
-        """Whether a2a.waveRows applies to this read. Pure conf/plan
-        facts — identical on every process, so the distributed branch
-        decision stays in SPMD lockstep without a collective."""
-        if self.hierarchical:
-            log.info("a2a.waveRows set but the hierarchical two-stage "
-                     "exchange is active — single-shot read (waves ride "
-                     "the flat exchange only)")
-            return False
+        """Whether a2a.waveRows applies to this read. Pure conf/plan/
+        node facts — identical on every process, so the distributed
+        branch decision stays in SPMD lockstep without a collective.
+        Hierarchical reads wave through the tiered two-step path
+        (PendingWaveShuffle dispatches a PendingTieredShuffle per
+        wave, with per-wave tier timelines) on a single process; the
+        DISTRIBUTED hierarchical path stays single-shot — its fused
+        step has no per-stage overflow agreement to drive waves
+        through."""
         if plan.impl == "pallas":
             log.info("a2a.waveRows set with impl='pallas' — single-shot "
                      "read (the remote-DMA transport owns its own "
                      "chunk-aligned flow control)")
+            return False
+        if self.hierarchical and self.node.is_distributed:
+            log.info("a2a.waveRows set but the DISTRIBUTED hierarchical "
+                     "exchange is single-shot (the fused two-stage step "
+                     "has no per-stage overflow agreement) — waves ride "
+                     "single-process topologies")
             return False
         return True
 
@@ -2888,6 +3072,11 @@ class TpuShuffleManager:
         # native collective pays each wave's real rows). Refreshed in
         # _finalize once any overflow regrow settles the final wave plan.
         self._set_wave_wire(rep, wplan, wave_sizes, width)
+        if self.hierarchical and wplan.impl != "pallas" \
+                and not distributed:
+            # hierarchical waves: per-tier accounting summed over the
+            # wave plan's W tiered exchanges (re-settled in finalize)
+            self._stamp_wave_tiers(rep, wplan, wave_sizes, width)
         # pipeline depth: the tenant's waveDepth override wins (a batch
         # tenant can be held to a shallower — cheaper-footprint —
         # pipeline while a high tenant keeps the conf depth). Conf-
@@ -3148,6 +3337,11 @@ class TpuShuffleManager:
             self._report_volume(rep, plan, nvalid, width,
                                 local_rows=int(nvalid_local.sum()))
             self._estimate_wire_error(rep, plan, shard_outputs)
+            if self.hierarchical and plan.impl != "pallas":
+                # per-tier pairs with the every-row upper bound: no
+                # process holds the [M, R] table here, so cross-fabric
+                # rows are not exact (cross_exact=false on the entries)
+                self._stamp_tiers(rep, plan, nvalid, width)
         # Wave-pipelined mode, multi-process: the wave count derives from
         # the ALLGATHERED global size row (identical math everywhere), and
         # agree_wave_count allgathers the verdict so a divergent
@@ -3453,6 +3647,9 @@ class PendingWaveShuffle:
         # a2a.wire=lossless drain accounting: [raw_bytes, compressed]
         # summed over every drained wave's host blocks
         self._lossless = [0, 0]
+        # hierarchical waves: per-tier walls summed over the drained
+        # waves' tiered pendings (the per-wave tier timeline's total)
+        self._tier_walls: Dict[str, float] = {}
 
     # -- lifecycle ---------------------------------------------------------
     def done(self) -> bool:
@@ -3496,6 +3693,14 @@ class PendingWaveShuffle:
                 GLOBAL_METRICS.get(COMPILE_HITS) - rep._hits0)
             rep.stepcache_programs = int(
                 GLOBAL_METRICS.get(COMPILE_PROGRAMS) - rep._prog0)
+            if rep.tiers:
+                # a FAILED hierarchical read keeps the tier walls its
+                # drained waves measured (partial by construction) —
+                # the which-tier-burned-the-wall postmortem evidence;
+                # completed=False counts no wire (the single-shot
+                # on_done discipline)
+                self._mgr._settle_tiers(rep, self._tier_walls,
+                                        self._width, completed=False)
             self._mgr.node.flight.end_trace(rep.trace_id)
             raise
         self._result = res
@@ -3556,12 +3761,17 @@ class PendingWaveShuffle:
                 t2 = time.perf_counter()
                 # MEASURED overlap, not structural: a pack counts as
                 # hidden only when the oldest in-flight collective is
-                # provably still running AFTER the pack finished (done()
-                # poll) — a pack-bound pipeline whose collectives finish
-                # mid-pack must not report itself hidden (that is the
+                # provably still running AFTER the pack finished — a
+                # pack-bound pipeline whose collectives finish mid-pack
+                # must not report itself hidden (that is the
                 # pipeline_stall condition). Partial overlap counts as
                 # not hidden, so the hidden fraction is a lower bound.
-                hidden = oldest is not None and not oldest.done()
+                # The STAGE-LOCAL poll, not done(): a tiered pending's
+                # done() is deliberately False until its DCN hop runs
+                # (dispatched inside result()), and the device idling
+                # between its stages must not read as overlap.
+                hidden = oldest is not None \
+                    and not oldest._outputs_ready()
                 pack_ms = (t1 - t0) * 1e3
                 pack_total += pack_ms
                 if hidden:
@@ -3589,6 +3799,13 @@ class PendingWaveShuffle:
                     p.result()
                 except Exception:
                     pass
+                tw = getattr(p, "tier_walls", None)
+                if tw:
+                    # partial walls are postmortem evidence: the tier
+                    # that burned the wall is the tier that hung
+                    for tier, ms in tw.items():
+                        self._tier_walls[tier] = \
+                            self._tier_walls.get(tier, 0.0) + ms
             raise
         finally:
             self._finish_guard()
@@ -3666,6 +3883,18 @@ class PendingWaveShuffle:
                 mgr.exchange_mesh, mgr.axis, self._wave_plan, shard_rows,
                 wnv, self._shard_ids, self._val_tail, self._val_dtype,
                 on_done=on_done, wire_seed=wseed)
+        if mgr.hierarchical and self._wave_plan.impl != "pallas":
+            # hierarchical waves ride the tiered two-step path: every
+            # wave is its own (ICI, DCN) pair with per-tier deadlines
+            # and walls — _drain_oldest folds them into the per-wave
+            # tier timeline
+            from sparkucx_tpu.shuffle.topology import \
+                submit_shuffle_tiered
+            return submit_shuffle_tiered(
+                mgr.node.mesh, mgr.topology, self._wave_plan,
+                shard_rows, wnv, self._val_tail, self._val_dtype,
+                on_done=on_done, wire_seed=wseed,
+                hooks=mgr._tier_hooks(self._rep.trace_id))
         return submit_shuffle(
             mgr.exchange_mesh, mgr.axis, self._wave_plan, shard_rows,
             wnv, self._val_tail, self._val_dtype, on_done=on_done,
@@ -3711,6 +3940,15 @@ class PendingWaveShuffle:
         entry["wait_ms"] = round(wait_ms, 3)
         retries = int(getattr(pending, "_attempt", 0))
         entry["retries"] = retries
+        tw = getattr(pending, "tier_walls", None)
+        if tw:
+            # per-wave tier timeline (hierarchical waves): this wave's
+            # measured ICI vs DCN walls, plus the exchange-level sums
+            # the finalize settles onto ExchangeReport.tiers
+            for tier, ms in tw.items():
+                entry[f"{tier}_ms"] = round(ms, 3)
+                self._tier_walls[tier] = \
+                    self._tier_walls.get(tier, 0.0) + ms
         wave_results[i] = res
         used = getattr(res, "cap_out_used", None)
         if used and int(used) > self._wave_plan.cap_out:
@@ -3745,6 +3983,14 @@ class PendingWaveShuffle:
             # steady-state cost later same-shape exchanges pay)
             mgr._set_wave_wire(rep, self._wave_plan, self._wave_sizes,
                                self._width)
+            if rep.tiers:
+                # hierarchical waves: re-derive the per-tier pairs under
+                # the final wave plan, then settle the summed per-wave
+                # tier walls + the tier byte counters
+                mgr._stamp_wave_tiers(rep, self._wave_plan,
+                                      self._wave_sizes, self._width)
+        if rep.tiers:
+            mgr._settle_tiers(rep, self._tier_walls, self._width)
         if self._lossless[1]:
             # measured (achieved) host-plane compression of the drained
             # waves, vs the REAL payload — the lossless tier's figure

@@ -1605,11 +1605,15 @@ class PendingExchangeBase:
         else:
             self._admit_cb = admit   # deferred: dispatch in result()
 
-    def done(self) -> bool:
-        """True once the current attempt's outputs are computed on device
-        (local poll; result() then blocks only on D2H / consensus).
-        A handle whose result() failed reports done (completed
-        exceptionally, the Future convention); retrying raises."""
+    def _outputs_ready(self) -> bool:
+        """Stage-local poll: the CURRENTLY DISPATCHED outputs are
+        computed on device. For single-program exchanges this is
+        done(); a multi-stage handle (PendingTieredShuffle) overrides
+        done() with its whole-exchange view while this stays the
+        honest is-the-device-busy probe — the wave pipeline's
+        measured-overlap accounting reads THIS (a pack only counts
+        hidden when a dispatched program was provably still running,
+        never when the device idled between stages)."""
         if self._result is not None or getattr(self, "_dead", False):
             return True
         if getattr(self, "_admit_cb", None) is not None \
@@ -1619,6 +1623,13 @@ class PendingExchangeBase:
             return all(bool(x.is_ready()) for x in self._out)
         except AttributeError:  # backend array without is_ready
             return True
+
+    def done(self) -> bool:
+        """True once the current attempt's outputs are computed on device
+        (local poll; result() then blocks only on D2H / consensus).
+        A handle whose result() failed reports done (completed
+        exceptionally, the Future convention); retrying raises."""
+        return self._outputs_ready()
 
     def _notify(self, result) -> None:
         """Fire on_done exactly once — with the result, or None on failure

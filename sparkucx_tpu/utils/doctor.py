@@ -243,6 +243,17 @@ class Thresholds:
     quota_min_admits: int = 3          # labeled admit histogram floor
     quota_share: float = 0.6           # hog's share of granted bytes
     quota_min_bytes: float = 1e6       # total granted-byte floor
+    # slow_tier: one fabric tier of the hierarchical exchange straggles
+    # beyond its byte share (ExchangeReport.tiers phase spans). The
+    # imbalance is byte-share-NORMALIZED — DCN legitimately carrying
+    # more padded bytes than ICI is structure, not a straggler — and
+    # floored (steady reads only, min wall, min agreeing reads) per
+    # the PR-5 discipline. Critical when the imbalance is extreme or
+    # the same tier keeps straggling.
+    tier_ratio: float = 4.0
+    tier_critical_ratio: float = 12.0
+    tier_min_ms: float = 25.0
+    tier_min_reads: int = 2
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -1102,14 +1113,15 @@ def _rule_sink_fallback(view: ClusterView,
                                 for r, n in by_reason.items()}},
         conf_key="spark.shuffle.tpu.read.sink",
         remediation=("the device sink is legal for ALL four read modes "
-                     "(plain/shard/ordered/combine) on the single-"
-                     "process flat exchange — if the reason is "
+                     "on the single-process flat exchange AND the "
+                     "single-shot hierarchical one — if the reason is "
                      "conf_pins_host, set spark.shuffle.tpu.read.sink="
-                     "auto (or device); distributed and hierarchical "
-                     "reads still drain host-side by design, so either "
-                     "run the consumer on the flat single-process mesh "
-                     "or accept the drain and read(sink='host') to "
-                     "silence the intent mismatch"))]
+                     "auto (or device); distributed reads and WAVED "
+                     "hierarchical reads (reason hierarchical_waved — "
+                     "drop a2a.waveRows for the device consumer) still "
+                     "drain host-side by design, so either reshape the "
+                     "read or accept the drain and read(sink='host') "
+                     "to silence the intent mismatch"))]
 
 
 def _labeled_series(mapping, base: str, label: str) -> Dict[str, Any]:
@@ -1209,13 +1221,94 @@ def _rule_quota_starvation(view: ClusterView,
     return out
 
 
+def _rule_slow_tier(view: ClusterView, th: Thresholds) -> List[Finding]:
+    """One fabric tier of the hierarchical exchange is the straggler —
+    attributed from the per-tier phase spans (``ExchangeReport.tiers``
+    ``ms``, the tiered pending's measured ICI vs DCN joins), normalized
+    by each tier's wire-byte share so a tier that legitimately moves
+    more bytes is not blamed for taking longer. Three signals, all
+    required (the PR-5 ratio+floor discipline):
+
+    * steady reads only — a compile-bearing read's tier walls time XLA,
+      not the fabric;
+    * the slow tier's wall over the ``tier_min_ms`` floor — sub-noise
+      spans attribute nothing;
+    * normalized imbalance ``(ms_slow/ms_fast) / max(wire_slow/
+      wire_fast, 1)`` at ``tier_ratio``+ on a majority of the steady
+      hierarchical reads, all agreeing on WHICH tier.
+
+    Names the tier and its deadline knob: a straggling DCN that
+    eventually hangs should surface as a typed per-tier PeerLostError,
+    and ``a2a.wire=int8`` halves what the slow fabric must carry."""
+    cand: List[tuple] = []
+    for r in _steady(_completed(view)):
+        tiers = {t.get("tier"): t for t in (r.get("tiers") or [])}
+        ici, dcn = tiers.get("ici"), tiers.get("dcn")
+        if not ici or not dcn:
+            continue
+        ms = {"ici": float(ici.get("ms", 0.0)),
+              "dcn": float(dcn.get("ms", 0.0))}
+        slow = "dcn" if ms["dcn"] >= ms["ici"] else "ici"
+        fast = "ici" if slow == "dcn" else "dcn"
+        if ms[slow] < th.tier_min_ms:
+            continue
+        wire = {"ici": float(ici.get("wire_bytes", 0.0)),
+                "dcn": float(dcn.get("wire_bytes", 0.0))}
+        byte_ratio = max(wire[slow] / max(wire[fast], 1.0), 1.0)
+        imbalance = (ms[slow] / max(ms[fast], 1e-3)) / byte_ratio
+        cand.append((slow, imbalance, ms[slow], ms[fast],
+                     r.get("trace_id", "")))
+    if not cand:
+        return []
+    hits = [c for c in cand if c[1] >= th.tier_ratio]
+    if len(hits) < th.tier_min_reads or len(hits) * 2 < len(cand):
+        return []
+    by_tier: Dict[str, int] = {}
+    for slow, *_rest in hits:
+        by_tier[slow] = by_tier.get(slow, 0) + 1
+    tier = max(by_tier, key=by_tier.get)
+    t_hits = [c for c in hits if c[0] == tier]
+    if len(t_hits) * 2 < len(hits):
+        return []                   # no single tier owns the verdict
+    med_imb = _median([c[1] for c in t_hits])
+    fabric = "inter-slice DCN" if tier == "dcn" else "intra-slice ICI"
+    return [Finding(
+        rule="slow_tier",
+        grade="critical" if (med_imb >= th.tier_critical_ratio
+                             or len(t_hits) >= 4) else "warn",
+        summary=(f"the {fabric} tier is the hierarchical exchange's "
+                 f"straggler: its phase wall is {med_imb:.1f}x the "
+                 f"other tier's (byte-share-normalized) on "
+                 f"{len(t_hits)} steady read(s) — median "
+                 f"{_median([c[2] for c in t_hits]):.0f} ms vs "
+                 f"{_median([c[3] for c in t_hits]):.0f} ms"),
+        evidence={"tier": tier,
+                  "normalized_imbalance_median": round(med_imb, 2),
+                  "slow_ms_median": round(
+                      _median([c[2] for c in t_hits]), 1),
+                  "fast_ms_median": round(
+                      _median([c[3] for c in t_hits]), 1),
+                  "reads": len(t_hits),
+                  "hier_reads_seen": len(cand)},
+        conf_key=f"spark.shuffle.tpu.failure.{tier}.timeoutMs",
+        remediation=(f"the {tier} phase is slow beyond its byte share: "
+                     f"check the {fabric} fabric (a flaky link shows "
+                     f"here first); set failure.{tier}.timeoutMs so an "
+                     f"eventual hang surfaces as a typed per-tier "
+                     f"PeerLostError instead of a stall; a2a.wire=int8 "
+                     f"narrows what the slow fabric carries, and "
+                     f"combine-style reads shrink the DCN hop at the "
+                     f"relay"),
+        trace_ids=[c[4] for c in t_hits if c[4]][:8])]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
           _rule_bw_underutilization, _rule_padding_waste,
           _rule_wire_dequant, _rule_peer_timeout, _rule_replay_storm,
           _rule_block_corruption, _rule_host_roundtrip,
-          _rule_sink_fallback, _rule_quota_starvation)
+          _rule_sink_fallback, _rule_quota_starvation, _rule_slow_tier)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

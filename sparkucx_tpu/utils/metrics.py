@@ -112,6 +112,12 @@ C_H2D = "shuffle.consume.h2d.bytes"
 # names the modes, since PR-12 made the device sink legal for every
 # read mode on the single-process flat exchange.
 C_SINK_FALLBACK = "shuffle.sink.fallback.count"
+# Topology plane (shuffle/topology.py): cumulative WIRE bytes each
+# fabric tier of a hierarchical exchange moved, labeled
+# {tier="ici|dcn", tenant=...} — the per-tenant face of
+# ExchangeReport.tiers (a whale's DCN appetite is visible per tenant,
+# the shuffle.payload/wire.bytes discipline applied per fabric).
+C_TIER_BYTES = "shuffle.tier.bytes"
 
 # Multi-tenant service plane (shuffle/tenancy.py, shuffle/manager.py
 # admission): ONE place for the names so the fair-share queue, the

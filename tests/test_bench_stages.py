@@ -288,8 +288,9 @@ def test_chaos_measure_small(mesh8):
     # plus the combine x device-sink x replay cell (fault mid-fold —
     # replay through the compiled device merge and donated buffers),
     # plus the corrupt-site block (staged/spill x single/waved x both
-    # policies)
-    assert rec["cells_total"] == 25
+    # policies), plus the hier x replay x waved cell (fault in the DCN
+    # phase of a wave's tiered exchange)
+    assert rec["cells_total"] == 26
     assert rec["cells_ok"] == rec["cells_total"]
     wire_cells = [c for c in rec["cells"] if c.get("wire") == "int8"]
     assert len(wire_cells) == 1
@@ -303,6 +304,10 @@ def test_chaos_measure_small(mesh8):
     assert sc["sink_held"] and sc["family_stable"]
     cc = next(c for c in sink_cells if c.get("read_mode") == "combine")
     assert cc["outcome"] == "replayed" and cc["replays"] >= 1
+    hc = next(c for c in rec["cells"] if c.get("topology") == "hier")
+    assert hc["outcome"] == "replayed" and hc["replays"] >= 1
+    assert hc["still_hier"] and hc["waved"] and hc["tier_timeline"]
+    assert hc["tier_named"]    # the postmortem ring names the dcn tier
     assert cc["sink_held"] and cc["family_stable"] and cc["bytes_ok"]
     assert cc["merged_on_device"] and cc["d2h_consumer_path"] == 0
     assert sc["d2h_consumer_path"] == 0
@@ -417,3 +422,39 @@ def test_require_backend_tpu_refuses_cpu_stage(tmp_path):
     assert line["error"].startswith("backend fallback refused")
     assert line["resolved_backend"] == "cpu"
     assert line["required_backend"] == "tpu"
+
+
+@pytest.mark.slow
+def test_hier_measure_small(mesh8):
+    """The hier stage's measurement core at a tiny shape: per-tier byte
+    accounting with oracle-exact DCN cross counts (each row crosses the
+    slow fabric exactly once), the emulated >=4x bandwidth model
+    favoring hier at every ratio, the analytic message-count context,
+    0 warm recompiles once the family settles, and the slow_tier
+    doctor drill firing on an injected DCN straggler while the healthy
+    arms diagnose clean.
+
+    Slow-marked for the tier-1 budget (~50 s of arm node boots + two
+    tier compiles each): the same contract is a dedicated GATE in
+    ci.yml (``bench.py --stage hier``), and the accounting invariants
+    stay in-tier via test_topology + the hier fuzz sweep."""
+    rec = bench.hier_measure(rows_per_map=512, maps=4, partitions=8,
+                             reps=1)
+    for skew in ("uniform", "zipf"):
+        lv = rec["levels"][skew]
+        assert lv["dcn_cross_rows_exact"] is True
+        assert lv["hier"]["hierarchical"] is True
+        assert lv["flat"]["hierarchical"] is False
+        assert lv["hier"]["warm_recompiles"] == 0
+        assert lv["flat"]["warm_recompiles"] == 0
+        # analytic context derived from the descriptor (not a gate)
+        ma = lv["dcn_messages_analytic"]
+        assert ma["hier"] < ma["flat"]
+        for m in lv["bandwidth_model"].values():
+            assert m["hier_speedup"] > 1.0
+        tiers = {t["tier"]: t for t in lv["hier"]["tiers"]}
+        assert set(tiers) == {"ici", "dcn"}
+        assert all(t["ms"] > 0 for t in tiers.values())
+    assert rec["levels"]["uniform"]["hier"]["first_read_programs"] == 2
+    assert rec["slow_tier_drill"]["fired"] is True
+    assert rec["slow_tier_drill"]["healthy_quiet"] is True

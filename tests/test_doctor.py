@@ -1258,3 +1258,96 @@ def test_quota_starvation_sub_noise_floors():
     # too few admissions for a p99 verdict
     assert [f for f in diagnose(_tenant_doc(admits=2))
             if f.rule == "quota_starvation"] == []
+
+
+# -- slow_tier (topology plane: ICI vs DCN phase attribution) --------------
+def _tier_entry(tier, ms, wire_mb=1.0, payload_rows=500):
+    return {"tier": tier, "axis": "shuffle" if tier == "ici" else "dcn",
+            "impl": "dense", "groups": 2, "group_shards": 4,
+            "rows_in": 1000, "payload_rows": payload_rows,
+            "payload_bytes": payload_rows * 16, "cross_exact": True,
+            "wire_rows": int(wire_mb * 1e6 / 16),
+            "wire_bytes": int(wire_mb * 1e6), "pad_ratio": 2.0,
+            "wire": "raw", "ms": ms, "bw_gbps": 0.0,
+            "effective_bw_gbps": 0.0}
+
+
+def _hier_report(sid, ici_ms, dcn_ms, ici_mb=2.0, dcn_mb=1.0,
+                 programs=0):
+    r = _report(sid=sid, trace=f"s{sid}.e0.x{sid}", programs=programs)
+    r["hierarchical"] = True
+    r["tiers"] = [_tier_entry("ici", ici_ms, wire_mb=ici_mb),
+                  _tier_entry("dcn", dcn_ms, wire_mb=dcn_mb)]
+    return r
+
+
+def test_slow_tier_fires_on_dcn_straggler():
+    """DCN walls dwarf ICI beyond the byte share on several steady
+    reads — the finding names the DCN tier and its deadline knob."""
+    doc = _healthy_doc()
+    doc["exchange_reports"] = [
+        _hier_report(i, ici_ms=30.0, dcn_ms=400.0) for i in range(1, 4)]
+    fs = [f for f in diagnose(doc) if f.rule == "slow_tier"]
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.evidence["tier"] == "dcn"
+    assert f.conf_key == "spark.shuffle.tpu.failure.dcn.timeoutMs"
+    assert "DCN" in f.summary
+    assert f.trace_ids
+
+
+def test_slow_tier_critical_on_extreme_imbalance():
+    doc = _healthy_doc()
+    doc["exchange_reports"] = [
+        _hier_report(i, ici_ms=20.0, dcn_ms=2000.0, ici_mb=1.0,
+                     dcn_mb=1.0) for i in range(1, 6)]
+    fs = [f for f in diagnose(doc) if f.rule == "slow_tier"]
+    assert fs and fs[0].grade == "critical"
+
+
+def test_slow_tier_ici_attribution():
+    """The rule attributes to WHICHEVER tier straggles — an ICI
+    straggler names ici and its knob."""
+    doc = _healthy_doc()
+    doc["exchange_reports"] = [
+        _hier_report(i, ici_ms=500.0, dcn_ms=25.0, ici_mb=1.0,
+                     dcn_mb=1.0) for i in range(1, 4)]
+    fs = [f for f in diagnose(doc) if f.rule == "slow_tier"]
+    assert fs and fs[0].evidence["tier"] == "ici"
+    assert fs[0].conf_key == "spark.shuffle.tpu.failure.ici.timeoutMs"
+
+
+def test_slow_tier_quiet_goldens():
+    # (a) healthy flat cluster: no tiers at all
+    assert [f for f in diagnose(_healthy_doc())
+            if f.rule == "slow_tier"] == []
+    # (b) balanced hier reads: walls track byte shares
+    doc = _healthy_doc()
+    doc["exchange_reports"] = [
+        _hier_report(i, ici_ms=60.0, dcn_ms=40.0, ici_mb=2.0,
+                     dcn_mb=1.0) for i in range(1, 5)]
+    assert [f for f in diagnose(doc) if f.rule == "slow_tier"] == []
+    # (c) DCN wall larger but explained by its byte share (padded DCN
+    # hop moving 8x the bytes): normalized imbalance stays under ratio
+    doc = _healthy_doc()
+    doc["exchange_reports"] = [
+        _hier_report(i, ici_ms=20.0, dcn_ms=120.0, ici_mb=0.5,
+                     dcn_mb=4.0) for i in range(1, 5)]
+    assert [f for f in diagnose(doc) if f.rule == "slow_tier"] == []
+
+
+def test_slow_tier_sub_noise_floors():
+    # (a) sub-noise walls: 4x imbalance on 2ms spans attributes nothing
+    doc = _healthy_doc()
+    doc["exchange_reports"] = [
+        _hier_report(i, ici_ms=0.5, dcn_ms=8.0) for i in range(1, 5)]
+    assert [f for f in diagnose(doc) if f.rule == "slow_tier"] == []
+    # (b) one read is not a verdict (tier_min_reads)
+    doc = _healthy_doc()
+    doc["exchange_reports"] = [_hier_report(1, 30.0, 400.0)]
+    assert [f for f in diagnose(doc) if f.rule == "slow_tier"] == []
+    # (c) compile-bearing reads are excluded (their walls time XLA)
+    doc = _healthy_doc()
+    doc["exchange_reports"] = [
+        _hier_report(i, 30.0, 400.0, programs=2) for i in range(1, 5)]
+    assert [f for f in diagnose(doc) if f.rule == "slow_tier"] == []
