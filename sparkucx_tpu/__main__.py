@@ -35,8 +35,21 @@ Subcommands:
     or many telemetry dumps — or this live process — and print graded
     findings with evidence and the conf key to turn. Multiple inputs
     aggregate cluster-wide (histograms merge exactly, reports
-    concatenate). ``--fail-on warn|critical`` exits non-zero when a
-    finding of that grade (or worse) fired — the CI gate shape.
+    concatenate). Directories also expand ``history_*.jsonl`` window
+    logs (utils/history.py), so the trend/SLO rules replay a dead
+    process's retained windows. ``--fail-on warn|critical`` exits
+    non-zero when a finding of that grade (or worse) fired — the CI
+    gate shape.
+
+``slo [--input DUMP_OR_DIR ...] [--live-url URL] [--format text|json]``
+    The SLO verdict (utils/slo.py): per-objective error budgets and
+    fast/slow burn rates over retained history windows. Inputs are
+    snapshot/flight dumps or ``history.dir`` directories (the
+    ``history_*.jsonl`` replay path — a FRESH process grades the dead
+    one's windows); without ``--input``, this process's live node.
+    Objectives ride the frames/dumps themselves, so a replay needs no
+    conf. Anchor-checked like stats/trace/timeline. ``--fail-on
+    fast|slow`` exits 3 on a burn of that speed — the CI gate shape.
 """
 
 from __future__ import annotations
@@ -66,17 +79,65 @@ def _expand_inputs(paths) -> list:
             "glob?); pass dump files/directories or drop --input for "
             "live mode")
     out = []
+    from sparkucx_tpu.utils.history import history_files
     for p in paths:
         if os.path.isdir(p):
             hits = sorted(glob.glob(os.path.join(p, "metrics_*.json"))
-                          + glob.glob(os.path.join(p, "flight_*.json")))
+                          + glob.glob(os.path.join(p, "flight_*.json"))
+                          + history_files(p))
             if not hits:
                 raise FileNotFoundError(
-                    f"{p}: no metrics_*.json / flight_*.json dumps")
+                    f"{p}: no metrics_*.json / flight_*.json / "
+                    f"history_*.jsonl dumps")
             out.extend(hits)
         else:
             out.append(p)
     return out
+
+
+def _load_history_doc(path: str, strict_anchor: bool = True):
+    """A ``history_*.jsonl`` window log as a snapshot-shaped doc
+    (``history_frames`` key) the doctor/slo pipelines fold, or None
+    when the file holds no parseable frames (empty, or every line torn
+    by a mid-append death) — the dumps SITTING BESIDE a bad history
+    file must still grade, so the caller skips rather than crashes.
+    The frames carry their own clock anchors; anchor-less lines mean a
+    pre-anchor writer and are rejected like any other dump."""
+    from sparkucx_tpu.utils.export import require_anchor
+    from sparkucx_tpu.utils.history import (frames_to_doc,
+                                            load_history_file)
+    frames = load_history_file(path)
+    if not frames:
+        print(f"warning: {path}: no parseable history frames — "
+              f"skipped", file=sys.stderr)
+        return None
+    doc = frames_to_doc(frames, source=path)
+    if strict_anchor:
+        require_anchor(doc, path)
+    return doc
+
+
+def _load_doc(path: str, strict_anchor: bool = True):
+    """Load any telemetry input: snapshot/flight JSON or history
+    JSONL (None for a frame-less history log — the caller filters),
+    anchor-checked per ``strict_anchor``."""
+    if path.endswith(".jsonl"):
+        return _load_history_doc(path, strict_anchor)
+    return _load_anchored(path) if strict_anchor else _load(path)
+
+
+def _load_docs(paths, strict_anchor_for=lambda p: True) -> list:
+    """Load many inputs, dropping frame-less history logs; all inputs
+    degenerate is an error (a gate diagnosing nothing must say so, not
+    print 'healthy' — the _expand_inputs discipline)."""
+    docs = [_load_doc(p, strict_anchor=strict_anchor_for(p))
+            for p in paths]
+    docs = [d for d in docs if d is not None]
+    if not docs:
+        raise FileNotFoundError(
+            "no usable telemetry inputs (every history log was empty "
+            "or torn)")
+    return docs
 
 
 def _load_anchored(path: str) -> dict:
@@ -154,7 +215,16 @@ def _cmd_trace(args) -> int:
 def _cmd_timeline(args) -> int:
     from sparkucx_tpu.utils.export import merge_timeline
     if args.input is not None:
-        docs = [_load_anchored(p) for p in _expand_inputs(args.input)]
+        # history JSONL logs carry window deltas, not chrome events —
+        # a dump dir routinely holds one next to its metrics/flight
+        # dumps now, and it must not crash (or pollute) the timeline
+        paths = [p for p in _expand_inputs(args.input)
+                 if not p.endswith(".jsonl")]
+        if not paths:
+            raise FileNotFoundError(
+                "--input held only history_*.jsonl window logs; the "
+                "timeline needs snapshot/flight dumps (trace events)")
+        docs = [_load_anchored(p) for p in paths]
     else:
         docs = [_live_snapshot()]
     doc = merge_timeline(docs)
@@ -177,8 +247,10 @@ def _cmd_doctor(args) -> int:
         # findings for humans/scrapers)
         findings = diagnose([_fetch_live(args.live_url)])
     elif args.input is not None:
-        docs = [_load_anchored(p) if args.strict_anchor else _load(p)
-                for p in _expand_inputs(args.input)]
+        docs = _load_docs(
+            _expand_inputs(args.input),
+            strict_anchor_for=lambda p: (args.strict_anchor
+                                         or p.endswith(".jsonl")))
         findings = diagnose(docs)
     else:
         # live: fold in the node's registry + pool watermark when a node
@@ -200,6 +272,58 @@ def _cmd_doctor(args) -> int:
         if any(GRADES.index(f.grade) >= floor for f in findings):
             return 3
     return 0
+
+
+def _cmd_slo(args) -> int:
+    from sparkucx_tpu.utils.slo import render_verdict
+    if getattr(args, "live_url", None):
+        # prefer the node's own evaluated verdict (/slo); a pre-SLO
+        # node 404s there, in which case the snapshot's embedded
+        # frames+objectives grade locally — the dump-mode path
+        import urllib.error
+        try:
+            import urllib.request
+            target = args.live_url.rstrip("/") + "/slo"
+            with urllib.request.urlopen(target, timeout=10) as resp:
+                verdict = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError:
+            verdict = _verdict_from_docs([_fetch_live(args.live_url)])
+    elif args.input is not None:
+        verdict = _verdict_from_docs(
+            _load_docs(_expand_inputs(args.input)))
+    else:
+        from sparkucx_tpu.runtime.node import TpuNode
+        node = TpuNode._instance
+        if node is None or node._closed:
+            print("slo: no live node in this process; pass --input "
+                  "(dump/history dirs) or --live-url", file=sys.stderr)
+            return 2
+        verdict = node.slo_verdict()
+    if args.format == "json":
+        print(json.dumps(verdict, indent=1, default=repr))
+    else:
+        sys.stdout.write(render_verdict(verdict))
+    if args.fail_on:
+        burned = verdict.get("fast_burn") if args.fail_on == "fast" \
+            else (verdict.get("fast_burn") or verdict.get("slow_burn"))
+        if burned:
+            return 3
+    return 0
+
+
+def _verdict_from_docs(docs) -> dict:
+    """Fold docs (snapshots, postmortems, replayed history logs) the
+    same way the doctor does, then evaluate the objectives they carry —
+    a restarted process grades a dead one's windows with zero conf."""
+    from sparkucx_tpu.utils import slo as _slo
+    from sparkucx_tpu.utils.doctor import build_view
+    view = build_view(docs)
+    objectives = _slo.objectives_from_dicts(view.slo_objectives)
+    if not objectives:
+        return _slo.evaluate(view.frames, [])
+    return _slo.evaluate(view.frames, objectives,
+                         policy=_slo.BurnPolicy.from_dict(
+                             view.slo_policy))
 
 
 def _cmd_keys(args) -> int:
@@ -258,6 +382,26 @@ def main(argv=None) -> int:
                             "rules don't need span alignment, so "
                             "pre-anchor dumps are diagnosable by "
                             "default)")
+    p_slo = sub.add_parser(
+        "slo",
+        help="SLO verdict: error budgets + fast/slow burn rates over "
+             "retained history windows, from live telemetry, dumps or "
+             "history.dir JSONL logs")
+    p_slo.add_argument("--input", nargs="*", default=None,
+                       help="snapshot/flight dumps, history_*.jsonl "
+                            "logs, or directories of either; several "
+                            "aggregate cluster-wide (default: this "
+                            "process's live node)")
+    p_slo.add_argument("--live-url", default=None,
+                       help="grade a running node over its live "
+                            "endpoint (metrics.httpPort server)")
+    p_slo.add_argument("--format", default="text",
+                       choices=("text", "json"))
+    p_slo.add_argument("--fail-on", default=None,
+                       choices=("fast", "slow"),
+                       help="exit 3 when a burn of this speed (slow "
+                            "implies fast too) is in progress (CI "
+                            "gate)")
     args = ap.parse_args(argv)
     if args.cmd == "stats":
         return _cmd_stats(args)
@@ -267,6 +411,8 @@ def main(argv=None) -> int:
         return _cmd_timeline(args)
     if args.cmd == "doctor":
         return _cmd_doctor(args)
+    if args.cmd == "slo":
+        return _cmd_slo(args)
     return _cmd_keys(args)
 
 

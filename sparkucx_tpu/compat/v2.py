@@ -158,7 +158,7 @@ class ShuffleServiceV2:
         if metrics_reporter is not None:
             self.node.metrics.add_reporter(metrics_reporter)
         from sparkucx_tpu.service import _start_dumper
-        self._dumper = _start_dumper(conf, self.stats)
+        self._dumper = _start_dumper(conf, self.stats, node=self.node)
         # same live-provider upgrade as the v1 facade (service.py): the
         # scrape/doctor seams must not drift with the adapter contract
         self.node.telemetry_provider = lambda: self.stats("json")
@@ -307,6 +307,13 @@ class ShuffleServiceV2:
         not drift with the host-adapter contract either."""
         from sparkucx_tpu.service import _doctor
         return _doctor(self.node, self.manager, format)
+
+    def slo(self, format: str = "json"):
+        """The SLO verdict over the retained telemetry windows — same
+        evaluator and document as the v1 facade (service._slo): the
+        objective surface does not drift with the adapter contract."""
+        from sparkucx_tpu.service import _slo
+        return _slo(self.node, format)
 
     def __enter__(self) -> "ShuffleServiceV2":
         return self

@@ -159,6 +159,31 @@ class TpuShuffleConf:
                             "capture (default 200 ms)",
         "doctor.captureDir": "where watcher captures land (default: "
                              "the flight recorder dir)",
+        "doctor.rearmHealthyPasses": "watcher re-arm: a captured "
+                                     "finding key absent for N "
+                                     "consecutive passes captures "
+                                     "again on recurrence (default 3)",
+        "history.dir": "windowed telemetry history JSONL directory "
+                       "(utils/history.py; unset = in-memory ring "
+                       "only) — restart-durable, bounded to "
+                       "retainWindows lines",
+        "history.windowSecs": "history window length in seconds "
+                              "(default 60); rolled on the periodic-"
+                              "dumper cadence, no extra thread",
+        "history.retainWindows": "history retention, in windows, for "
+                                 "both the ring and the on-disk log "
+                                 "(default 120)",
+        "slo.*": "service-level objectives (utils/slo.py): "
+                 "slo.read.p99Ms (latency bound, ms), slo.read.target "
+                 "(good fraction, default 0.99), slo.availability, "
+                 "slo.fastWindowSecs/slowWindowSecs (default 300/3600), "
+                 "slo.fastBurn/slowBurn (default 14.4/6), "
+                 "slo.minEvents; per-tenant overrides ride "
+                 "tenant.<id>.slo.* — evaluated over the retained "
+                 "history windows into error budgets + burn rates, "
+                 "surfaced via service.slo(), /slo, the slo CLI, "
+                 "doctor rule slo_burn, and a fast burn degrades "
+                 "/healthz",
         "compile.costCapture": "harvest XLA cost/memory analysis per "
                                "compiled exchange program "
                                "(shuffle/stepcache.py; default on)",

@@ -29,8 +29,13 @@ pressure and in-flight transfer progress. Two pieces close that gap:
   bounded ``jax.profiler`` trace window plus a flight-recorder
   postmortem tagged with the finding — the deep evidence an operator
   cannot capture after the fact, taken exactly when the rules say
-  something is wrong. One capture per distinct finding: a persistent
-  condition must not fill the disk with identical postmortems.
+  something is wrong. One capture per distinct finding while it
+  persists — a steady condition must not fill the disk with identical
+  postmortems — but a finding that CLEARS for
+  ``doctor.rearmHealthyPasses`` consecutive passes re-arms, so a
+  condition recurring an hour later is captured again (by then the
+  bounded ring has evicted the first occurrence's context, which is
+  exactly when fresh evidence matters).
 """
 
 from __future__ import annotations
@@ -179,12 +184,23 @@ class DoctorWatcher:
 
     def __init__(self, node, interval_s: float,
                  profile_ms: float = 200.0,
-                 capture_dir: Optional[str] = None):
+                 capture_dir: Optional[str] = None,
+                 rearm_passes: int = 3):
         self._node = node
         self._interval = max(0.1, float(interval_s))
         self._profile_ms = max(0.0, float(profile_ms))
         self._capture_dir = capture_dir
         self._seen = set()
+        # Re-arm (conf doctor.rearmHealthyPasses): a captured finding
+        # key that stays ABSENT for N consecutive passes leaves _seen,
+        # so a condition that clears and recurs an hour later gets its
+        # profile/postmortem again. The original once-per-lifetime set
+        # silently dropped every recurrence — the bounded ring would
+        # have long evicted the first occurrence's context by then,
+        # which is exactly when the deep capture matters most.
+        self._rearm_passes = max(1, int(rearm_passes))
+        self._healthy_passes: Dict[tuple, int] = {}
+        self._rule_healthy: Dict[str, int] = {}
         self._rule_captures: Dict[str, int] = {}
         self._lock = threading.Lock()
         self.captures: List[Dict] = []       # tests/CI read this
@@ -222,6 +238,42 @@ class DoctorWatcher:
         pluggable ``doctor_provider`` so a facade's richer diagnosis
         (exchange reports included) is what gets watched."""
         findings = self._node.doctor_provider()
+        current = {self._finding_key(f) for f in findings
+                   if f.grade == "critical"}
+        current_rules = {k[0] for k in current}
+        with self._lock:
+            # re-arm pass: a seen key absent from this pass's criticals
+            # accrues one healthy pass; N consecutive absences re-arm it
+            # (a present key resets its streak — flapping conditions
+            # must not re-capture every oscillation)
+            for key in list(self._seen):
+                if key in current:
+                    self._healthy_passes.pop(key, None)
+                    continue
+                n = self._healthy_passes.get(key, 0) + 1
+                if n >= self._rearm_passes:
+                    self._seen.discard(key)
+                    self._healthy_passes.pop(key, None)
+                    log.info("doctor watcher re-armed %s after %d "
+                             "healthy pass(es)", key, n)
+                else:
+                    self._healthy_passes[key] = n
+            # capture-budget refund is per RULE and only when the WHOLE
+            # rule stayed quiet for the streak: a genuinely-cleared
+            # condition recurring later must actually capture past the
+            # cap, while a persistent condition minting a fresh key
+            # every pass (the flood the cap exists for) keeps at least
+            # one critical alive and never refunds itself
+            for rule in list(self._rule_captures):
+                if rule in current_rules:
+                    self._rule_healthy.pop(rule, None)
+                    continue
+                n = self._rule_healthy.get(rule, 0) + 1
+                if n >= self._rearm_passes:
+                    self._rule_healthy.pop(rule, None)
+                    self._rule_captures.pop(rule, None)
+                else:
+                    self._rule_healthy[rule] = n
         fired = []
         for f in findings:
             if f.grade != "critical":

@@ -148,8 +148,37 @@ class TpuNode:
         # re-registers and calls mark_healthy) or a failed device probe.
         self._health_lock = threading.Lock()
         self._unhealthy_reason: Optional[str] = None
+        self._unhealthy_cause: Optional[str] = None
         self.health.on_unhealthy = self._on_device_unhealthy
         self.epochs.on_bump(self._on_epoch_health)
+        # -- SLO plane (utils/history.py + utils/slo.py) -----------------
+        # Windowed telemetry history: frames are deltas between
+        # successive snapshots, retained in a bounded ring and (when
+        # history.dir is set) an on-disk JSONL a restarted process can
+        # replay. NO new sampling thread — the facade's PeriodicDumper
+        # cadence drives tick(); objectives ride each frame so a
+        # replayed history dir is self-describing.
+        from sparkucx_tpu.utils.history import TelemetryHistory
+        from sparkucx_tpu.utils.slo import BurnPolicy, objectives_from_conf
+        self.slo_objectives = objectives_from_conf(conf)
+        self.slo_policy = BurnPolicy.from_conf(conf)
+        frame_extra = {}
+        if self.slo_objectives:
+            frame_extra = {
+                "slo_objectives": [o.to_dict()
+                                   for o in self.slo_objectives],
+                "slo_policy": self.slo_policy.to_dict()}
+        self.history = TelemetryHistory(
+            self._history_collect,
+            window_secs=conf.get_float("history.windowSecs", 60.0),
+            retain_windows=conf.get_int("history.retainWindows", 120),
+            out_dir=conf.get("spark.shuffle.tpu.history.dir"),
+            process_id=process_id, extra=frame_extra)
+        self._slo_cache = (None, -1)   # (verdict, history.version)
+        if self.slo_objectives:
+            # flight postmortems embed the SLO verdict at fault time —
+            # the first thing an operator reads next to the findings
+            self.flight.add_context_provider(self.slo_verdict)
         # Cost capture master switch (shuffle/stepcache.py harvest of
         # XLA cost/memory analysis per compiled program; on by default —
         # off keeps the records, nulls the fields).
@@ -186,7 +215,8 @@ class TpuNode:
         from sparkucx_tpu.utils.live import start_from_conf
         self.live = start_from_conf(
             conf, lambda: self.telemetry_provider(),
-            lambda: self.doctor_provider(), self.health_status)
+            lambda: self.doctor_provider(), self.health_status,
+            slo_fn=self.slo_verdict)
         # Anomaly-triggered deep capture (doctor.watchIntervalSecs):
         # rolling doctor pass; first critical finding => bounded
         # profiler window + tagged flight postmortem.
@@ -196,20 +226,30 @@ class TpuNode:
                 self, watch_s,
                 profile_ms=conf.get_float("doctor.captureMs", 200.0),
                 capture_dir=conf.get(
-                    "spark.shuffle.tpu.doctor.captureDir")).start()
+                    "spark.shuffle.tpu.doctor.captureDir"),
+                rearm_passes=conf.get_int(
+                    "doctor.rearmHealthyPasses", 3)).start()
         else:
             self.watcher = None
         log.info("TpuNode up: %d devices, mesh axes %s",
                  len(jax.devices()), self.mesh.axis_names)
 
-    def telemetry_snapshot(self, reports=None) -> dict:
+    def telemetry_snapshot(self, reports=None,
+                           include_history: bool = True) -> dict:
         """THE canonical live-snapshot shape for this process: both
         registries (process-global + node), the tracer, the arena
         watermark and the process identity — one seam so the facades,
         the CLI's live mode, the bench's doctor pass and the cluster
         harness cannot drift on which fields a doctor rule can rely on.
         ``reports`` is the manager's exchange-report list when the
-        caller owns a manager (the node itself does not)."""
+        caller owns a manager (the node itself does not).
+
+        ``include_history`` embeds the retained window frames
+        (``history_frames``) plus the declared SLO objectives, so every
+        consumer of a snapshot — dumps, flight postmortems, the live
+        /snapshot endpoint, the doctor's build_view — carries the time
+        axis; the history plane itself collects with it off (a frame
+        must not embed the ring it is about to join)."""
         from sparkucx_tpu.utils.export import collect_snapshot
         from sparkucx_tpu.utils.metrics import GLOBAL_METRICS
         # pool watermarks ride as GAUGES (set semantics — Prometheus
@@ -218,16 +258,64 @@ class TpuNode:
         # ONE stats() call feeds both.
         pool_stats = self.pool.stats()
         self.publish_pool_gauges(pool_stats)
+        extra = {"pool": pool_stats,
+                 "process_id": self.process_id,
+                 # the connect-time anchor table: ONE process's dump
+                 # can place every peer's clock on the shared wall
+                 # axis even when the peers' own dumps are missing
+                 # (a crashed peer's flight dump may never land)
+                 "cluster_anchors": self.cluster_anchors}
+        if include_history and getattr(self, "history", None) is not None:
+            frames = self.history.frames()
+            if frames:
+                extra["history_frames"] = frames
+            if self.slo_objectives:
+                extra["slo_objectives"] = [o.to_dict()
+                                           for o in self.slo_objectives]
+                extra["slo_policy"] = self.slo_policy.to_dict()
         return collect_snapshot(
             [GLOBAL_METRICS, self.metrics], tracer=self.tracer,
-            reports=reports,
-            extra={"pool": pool_stats,
-                   "process_id": self.process_id,
-                   # the connect-time anchor table: ONE process's dump
-                   # can place every peer's clock on the shared wall
-                   # axis even when the peers' own dumps are missing
-                   # (a crashed peer's flight dump may never land)
-                   "cluster_anchors": self.cluster_anchors})
+            reports=reports, extra=extra)
+
+    # -- SLO plane (utils/slo.py over the retained history) ---------------
+    def _history_collect(self) -> dict:
+        """The history plane's LEAN snapshot: counters + histograms +
+        gauges + anchor only. The full telemetry_snapshot additionally
+        summarizes spans and serializes chrome events — per-scrape
+        costs a per-window delta never reads, and the roll rides the
+        read path's cadence budget (bench --stage slo gates the whole
+        plane < 1% of the exchange loop)."""
+        from sparkucx_tpu.utils.export import collect_snapshot
+        from sparkucx_tpu.utils.metrics import GLOBAL_METRICS
+        self.publish_pool_gauges()
+        return collect_snapshot(
+            [GLOBAL_METRICS, self.metrics], populated_only=True,
+            extra={"process_id": self.process_id})
+
+    def slo_verdict(self) -> dict:
+        """The SLO verdict over the retained windows, cached per rolled
+        frame (the ring's ``version``): /healthz consults this on every
+        probe, and re-evaluating an unchanged ring would be pure waste.
+        Objective-less nodes return the empty verdict (healthy)."""
+        cached, ver = self._slo_cache
+        if cached is not None and ver == self.history.version:
+            return cached
+        from sparkucx_tpu.utils.slo import evaluate
+        verdict = evaluate(self.history.frames(), self.slo_objectives,
+                           policy=self.slo_policy)
+        self._slo_cache = (verdict, self.history.version)
+        return verdict
+
+    def slo_fast_burn(self):
+        """The /healthz face of the verdict: the burning objective
+        names, or an empty list when healthy / objective-less."""
+        if not self.slo_objectives:
+            return []
+        try:
+            return self.slo_verdict().get("burning", [])
+        except Exception:
+            log.debug("slo evaluation failed", exc_info=True)
+            return []
 
     def publish_pool_gauges(self, stats: Optional[dict] = None) -> None:
         """Arena watermarks -> ``pool.*`` gauges in this node's registry
@@ -254,36 +342,58 @@ class TpuNode:
         self.doctor_provider = self._default_doctor
 
     # -- health (the /healthz verdict) ------------------------------------
-    def mark_unhealthy(self, reason: str) -> None:
+    def mark_unhealthy(self, reason: str,
+                       cause: str = "operator") -> None:
+        """``cause`` is the MACHINE face of the verdict — a stable enum
+        (``epoch_bump`` / ``device_unhealthy`` / ``slo_fast_burn`` /
+        ``closed`` / ``operator``) a probe script switches on, where
+        ``reason`` is the human sentence that changes wording freely."""
         with self._health_lock:
             self._unhealthy_reason = reason
+            self._unhealthy_cause = cause
 
     def mark_healthy(self) -> None:
         """Operator acknowledgment: shuffles re-registered after a
         remesh / the flagged device replaced — serve traffic again."""
         with self._health_lock:
             self._unhealthy_reason = None
+            self._unhealthy_cause = None
 
     def _on_device_unhealthy(self, bad) -> None:
-        self.mark_unhealthy(f"DeviceUnhealthy: {bad}")
+        self.mark_unhealthy(f"DeviceUnhealthy: {bad}",
+                            cause="device_unhealthy")
 
     def _on_epoch_health(self, epoch: int) -> None:
         self.mark_unhealthy(
             f"epoch bumped to {epoch}: registered shuffles dropped — "
-            f"re-register and mark_healthy()")
+            f"re-register and mark_healthy()", cause="epoch_bump")
 
     def health_status(self) -> dict:
-        """The /healthz body: ``ok`` plus the evidence (epoch, device
-        count, the reason when degraded)."""
+        """The /healthz body: ``ok`` plus the evidence — epoch, device
+        count, the human ``reason`` AND the stable machine ``cause``
+        (epoch_bump / device_unhealthy / slo_fast_burn / closed) so a
+        probe can switch on WHY without parsing prose. A fast-burning
+        SLO degrades health like a device fault: the node still serves,
+        but it is eating its error budget at page-now speed and a
+        load balancer should know."""
         with self._health_lock:
-            reason = self._unhealthy_reason
+            reason, cause = self._unhealthy_reason, self._unhealthy_cause
         closed = self._closed
+        if closed:
+            reason, cause = "node closed", "closed"
+        elif reason is None:
+            burning = self.slo_fast_burn()
+            if burning:
+                reason = ("SLO fast burn: " + ", ".join(burning)
+                          + " — error budget burning at page-now speed")
+                cause = "slo_fast_burn"
         return {
-            "ok": not closed and reason is None,
+            "ok": reason is None,
             "epoch": self.epochs.current,
             "devices": self.num_devices,
             "process_id": self.process_id,
-            "reason": "node closed" if closed else reason,
+            "reason": reason,
+            "cause": cause,
         }
 
     def flight_capture_dir(self) -> str:
@@ -439,6 +549,7 @@ class TpuNode:
         if current_watchdog() is self.watchdog:
             set_global_watchdog(None)
         self.epochs.remove_listener(self._on_epoch_health)
+        self.flight.remove_context_provider(self.slo_verdict)
         self.flight.uninstall_abort_hook()
         self.metrics.remove_reporter(self.flight.metrics_reporter)
         self.epochs.remove_listener(self.flight.on_epoch_bump)

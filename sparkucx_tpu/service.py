@@ -69,18 +69,52 @@ def _doctor(node: TpuNode, manager: TpuShuffleManager,
                      f"want findings|json|text")
 
 
-def _start_dumper(conf: TpuShuffleConf, stats_fn):
+def _start_dumper(conf: TpuShuffleConf, stats_fn, node=None):
     """Periodic metrics-snapshot dump thread, keyed by
     ``spark.shuffle.tpu.metrics.dumpDir`` (off when unset) and
     ``metrics.dumpIntervalSecs`` (default 60). Shared by both facade
-    generations — the dumper only needs a stats() callable."""
+    generations — the dumper only needs a stats() callable.
+
+    The dumper's cadence also drives the history plane's window roll
+    (``node.history.tick`` — utils/history.py; no sampling thread of
+    its own): when SLO objectives or a history dir are configured
+    WITHOUT a dump dir, a tick-only dumper runs anyway, at an interval
+    that never exceeds the history window so no window is skipped."""
     dump_dir = conf.get("spark.shuffle.tpu.metrics.dumpDir")
-    if not dump_dir:
+    dump_interval = conf.get_float("metrics.dumpIntervalSecs", 60.0)
+    interval = dump_interval
+    ticks = []
+    history = getattr(node, "history", None) if node is not None else None
+    history_on = history is not None and (
+        history.out_dir or getattr(node, "slo_objectives", None))
+    if history_on:
+        ticks.append(history.tick)
+        interval = min(interval, history.window_secs)
+    if not dump_dir and not ticks:
         return None
+    # the thread beats at the faster of the two cadences; snapshot
+    # files still land at the CONFIGURED dump rate (dump_every) — a
+    # 60 s history window must not silently 10x a 600 s dump interval
+    dump_every = max(1, round(dump_interval / interval))
     from sparkucx_tpu.utils.export import PeriodicDumper
-    interval = conf.get_float("metrics.dumpIntervalSecs", 60.0)
-    return PeriodicDumper(lambda: stats_fn("json"), dump_dir,
-                          interval).start()
+    return PeriodicDumper(lambda: stats_fn("json"), dump_dir or None,
+                          interval, tick_fns=ticks,
+                          dump_every=dump_every).start()
+
+
+def _slo(node: TpuNode, format: str = "json"):
+    """The SLO verdict (utils/slo.py over the node's retained history
+    windows) — shared by both facade generations, the same document
+    the live server's /slo endpoint and the ``slo`` CLI render.
+    ``format="json"`` returns the verdict dict; ``"text"`` the rendered
+    report."""
+    verdict = node.slo_verdict()
+    if format == "json":
+        return verdict
+    if format == "text":
+        from sparkucx_tpu.utils.slo import render_verdict
+        return render_verdict(verdict)
+    raise ValueError(f"unknown slo format {format!r}; want json|text")
 
 
 class ShuffleService:
@@ -115,7 +149,7 @@ class ShuffleService:
         self._metrics_reporter = metrics_reporter
         if metrics_reporter is not None:
             self.node.metrics.add_reporter(metrics_reporter)
-        self._dumper = _start_dumper(conf, self.stats)
+        self._dumper = _start_dumper(conf, self.stats, node=self.node)
         # Upgrade the node's live-telemetry providers to THIS facade's
         # richer pair (exchange reports ride along): the scrape server
         # (/snapshot, /doctor — utils/live.py) and the doctor watcher
@@ -196,6 +230,15 @@ class ShuffleService:
         pressure / overflow loops) with evidence and the conf key to
         turn — see :mod:`sparkucx_tpu.utils.doctor`."""
         return _doctor(self.node, self.manager, format)
+
+    def slo(self, format: str = "json"):
+        """The SLO verdict over the retained telemetry windows:
+        per-objective error budgets and fast/slow burn rates
+        (:mod:`sparkucx_tpu.utils.slo`; objectives from conf
+        ``slo.read.p99Ms`` / ``slo.availability`` + per-tenant
+        ``tenant.<id>.slo.*``). The same document the live ``/slo``
+        endpoint and the ``python -m sparkucx_tpu slo`` CLI render."""
+        return _slo(self.node, format)
 
     def __enter__(self) -> "ShuffleService":
         return self

@@ -77,6 +77,22 @@ sink_fallback     reads that ASKED for the device sink     spark.shuffle.tpu.rea
                   why (distributed/hierarchical/conf-
                   pinned); the device sink is legal for
                   all four modes single-process
+slo_burn          a declared objective (utils/slo.py)      spark.shuffle.tpu.slo.read.p99Ms
+                  is burning its error budget over the
+                  retained history windows — critical on
+                  a fast burn (page-now), warn on a slow
+                  one; names the tenant, the objective
+                  key and the burn multiple, and uses
+                  per-tenant admit/cross-grant evidence
+                  so client self-backpressure is not
+                  blamed on the engine (the PR-11
+                  discriminator discipline)
+latency_trend     windowed read-wait p99 is drifting up    spark.shuffle.tpu.trace.enabled
+                  vs the retained baseline windows,
+                  payload-NORMALIZED (bytes/read ratio
+                  divides the drift) so a load shift is
+                  not misread as a regression — the "is
+                  it getting worse right now" rule
 ================  =======================================  =====================================
 
 The same :class:`Finding` schema carries ``bench.py --stage regress``
@@ -102,7 +118,7 @@ from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES, C_D2H, C_H2D,
                                         H_ADMIT_CROSS, H_ADMIT_WAIT, H_BW,
                                         H_FETCH_FIRST, H_FETCH_WAIT,
                                         H_RETRY_MS, H_WAVE_GAP, Histogram,
-                                        parse_labeled)
+                                        labeled, parse_labeled)
 
 GRADES = ("info", "warn", "critical")
 _GRADE_ORDER = {g: i for i, g in enumerate(GRADES)}
@@ -254,6 +270,20 @@ class Thresholds:
     tier_critical_ratio: float = 12.0
     tier_min_ms: float = 25.0
     tier_min_reads: int = 2
+    # latency_trend: the retained-history drift rule. Recent windows'
+    # merged read-wait p99 vs the BASELINE windows before them,
+    # payload-normalized (recent bytes/read over baseline bytes/read
+    # divides the drift — bigger reads are slower by structure, not by
+    # regression). Floors per the PR-5 discipline: both windows need
+    # real read counts and the recent p99 must clear the noise floor;
+    # the warn ratio is 3x because the log-bucket ladder resolves ~9%
+    # and CPU scheduling jitter alone can double a small p99.
+    trend_recent_frames: int = 3
+    trend_min_frames: int = 6          # recent + a real baseline
+    trend_min_reads: int = 8           # per window side
+    trend_min_ms: float = 5.0
+    trend_ratio: float = 3.0
+    trend_critical_ratio: float = 10.0
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -269,6 +299,14 @@ class ClusterView:
     #                              # per-process {"process_id", "values"}
     #                              # — gauges are point-in-time, so they
     #                              # attribute, never sum
+    # windowed history frames (utils/history.py), folded from every
+    # process's ``history_frames`` — deltas within a time window SUM
+    # across processes, so the trend/SLO rules just concatenate and
+    # bucket by t_end. ``slo_objectives``/``slo_policy`` ride the docs
+    # (the node stamps them), unioned by (key, tenant) / first-seen.
+    frames: List[Dict] = field(default_factory=list)
+    slo_objectives: List[Dict] = field(default_factory=list)
+    slo_policy: Optional[Dict] = None
     processes: int = 1
 
 
@@ -299,6 +337,10 @@ def build_view(snapshots: Union[Dict, Iterable[Dict]]) -> ClusterView:
     reports: List[Dict] = []
     pools: List[Dict] = []
     gauges: List[Dict] = []
+    frames: List[Dict] = []
+    objectives: List[Dict] = []
+    seen_obj = set()
+    policy = None
     for i, doc in enumerate(docs):
         pid = doc.get("process_id", doc.get("pid", i))
         for name, v in (doc.get("counters") or {}).items():
@@ -318,7 +360,31 @@ def build_view(snapshots: Union[Dict, Iterable[Dict]]) -> ClusterView:
         if isinstance(doc.get("gauges"), dict) and doc["gauges"]:
             gauges.append({"process_id": pid,
                            "values": dict(doc["gauges"])})
+        for f in (doc.get("history_frames") or []):
+            if isinstance(f, dict):
+                f = dict(f)
+                f.setdefault("process_id", pid)
+                frames.append(f)
+                if policy is None and isinstance(f.get("slo_policy"),
+                                                 dict):
+                    policy = f["slo_policy"]
+        if policy is None and isinstance(doc.get("slo_policy"), dict):
+            policy = doc["slo_policy"]
+        for src in (doc.get("slo_objectives"),
+                    *[f.get("slo_objectives")
+                      for f in (doc.get("history_frames") or [])
+                      if isinstance(f, dict)]):
+            for o in (src or []):
+                if not isinstance(o, dict):
+                    continue
+                k = (o.get("key"), o.get("tenant", ""))
+                if k not in seen_obj:
+                    seen_obj.add(k)
+                    objectives.append(o)
+    frames.sort(key=lambda f: f.get("t_end", 0.0))
     return ClusterView(counters, hists, reports, pools, gauges,
+                       frames=frames, slo_objectives=objectives,
+                       slo_policy=policy,
                        processes=max(1, len(docs)))
 
 
@@ -1302,13 +1368,186 @@ def _rule_slow_tier(view: ClusterView, th: Thresholds) -> List[Finding]:
         trace_ids=[c[4] for c in t_hits if c[4]][:8])]
 
 
+def _frame_window_hist(frames: List[Dict], name: str) -> Histogram:
+    """Merge one named histogram's window deltas across frames into one
+    distribution (exact — same fixed ladder per frame delta)."""
+    out: Optional[Histogram] = None
+    for f in frames:
+        snap = (f.get("histograms") or {}).get(name)
+        if not snap or not snap.get("count"):
+            continue
+        h = Histogram.from_snapshot(snap, name)
+        out = h if out is None else out.merge(h)
+    return out if out is not None else Histogram(name)
+
+
+def _frame_window_counter(frames: List[Dict], name: str) -> float:
+    return sum(float((f.get("counters") or {}).get(name, 0.0))
+               for f in frames)
+
+
+def _rule_slo_burn(view: ClusterView, th: Thresholds) -> List[Finding]:
+    """A declared service-level objective is burning its error budget
+    over the retained windows (utils/slo.py evaluated over the folded
+    history frames). A fast burn is critical — at the default 14.4x a
+    30-day budget dies in two days — a slow burn is a warning ticket.
+    Names the tenant, the objective key and the burn multiple.
+
+    Discriminator discipline (the PR-11 cross-grants lesson): before
+    blaming the engine, the rule reads the burning tenant's admission
+    evidence from the SAME fast window. A tenant whose reads spent
+    their wall parked in admission while cross-grants stayed ~0 was
+    serialized behind its OWN submissions — client self-backpressure —
+    and the finding says so instead of pointing at the exchange path."""
+    from sparkucx_tpu.utils import slo as _slo
+    if not view.slo_objectives or not view.frames:
+        return []
+    objectives = _slo.objectives_from_dicts(view.slo_objectives)
+    if not objectives:
+        return []
+    policy = _slo.BurnPolicy.from_dict(view.slo_policy)
+    verdict = _slo.evaluate(view.frames, objectives, policy=policy)
+    now = verdict["ts"]
+    out: List[Finding] = []
+    for o in verdict["objectives"]:
+        if not (o["fast_burn"] or o["slow_burn"]):
+            continue
+        tid = o["tenant"]
+        fast_frames = [f for f in view.frames
+                       if now - float(f.get("t_end", 0.0))
+                       <= policy.fast_window_s]
+        ev = {"objective": o["objective"], "tenant": tid or "(global)",
+              "burn_fast": o["burn_fast"], "burn_slow": o["burn_slow"],
+              "target": o["target"],
+              "fast_window": o["windows"]["fast"],
+              "budget_remaining": o["budget"]["remaining"]}
+        self_throttled = False
+        if tid:
+            wait_h = _frame_window_hist(
+                fast_frames, labeled(H_ADMIT_WAIT, tenant=tid))
+            cross_h = _frame_window_hist(
+                fast_frames, labeled(H_ADMIT_CROSS, tenant=tid))
+            payload = _frame_window_counter(
+                fast_frames, labeled("shuffle.payload.bytes",
+                                     tenant=tid))
+            ev["payload_bytes_fast_window"] = int(payload)
+            if wait_h.count:
+                wait99 = wait_h.quantile(0.99)
+                cross99 = cross_h.quantile(0.99) if cross_h.count else 0.0
+                ev["admit_wait_p99_ms"] = round(wait99, 1)
+                ev["cross_grants_p99"] = round(cross99, 1)
+                # real admission stalls with ~no foreign grants passing
+                # the ticket = the tenant queues behind itself
+                self_throttled = (wait99 >= th.quota_min_wait_ms
+                                  and cross99 < 2.0)
+                ev["self_throttled"] = self_throttled
+        who = f"tenant {tid!r}" if tid else "the service"
+        conf_key = ("spark.shuffle.tpu."
+                    + (f"tenant.{tid}." if tid else "") + o["objective"])
+        if self_throttled:
+            remediation = (
+                f"the burning reads spent their wall waiting on {who}'s "
+                f"OWN admission queue (cross-grants ~0 — no neighbor "
+                f"passed them): raise the client's concurrency budget "
+                f"(tenant.{tid}.maxBytesInFlight / maxInflightReads) or "
+                f"submit less, the exchange path is not the bottleneck")
+        else:
+            remediation = (
+                "find WHERE the bad windows spend their wall: "
+                "latency_trend / straggler_peer / slow_tier narrow it; "
+                "if the objective is simply mis-provisioned for this "
+                f"workload, raise {conf_key} rather than paging on it")
+        grade = "critical" if o["fast_burn"] else "warn"
+        if self_throttled and grade == "critical":
+            # a self-inflicted burn still burns the budget, but it is
+            # not an engine page — the discriminator caps the grade
+            grade = "warn"
+        rate = o["windows"]["fast" if o["fast_burn"] else "slow"]
+        out.append(Finding(
+            rule="slo_burn",
+            grade=grade,
+            summary=(f"{who} is burning its "
+                     f"{o['objective']} budget at "
+                     f"{o['burn_fast'] if o['fast_burn'] else o['burn_slow']}x "
+                     f"({'fast' if o['fast_burn'] else 'slow'} window: "
+                     f"{rate['errors']}/{rate['events']} bad events, "
+                     f"{o['budget']['remaining']:.0%} of the error "
+                     f"budget left over retention)"
+                     + (" — evidence says client self-backpressure, "
+                        "not the engine" if self_throttled else "")),
+            evidence=ev,
+            conf_key=conf_key,
+            remediation=remediation))
+    return out
+
+
+def _rule_latency_trend(view: ClusterView,
+                        th: Thresholds) -> List[Finding]:
+    """Is it getting worse RIGHT NOW: the last ``trend_recent_frames``
+    windows' merged read-wait p99 vs the retained baseline windows
+    before them. Payload-normalized — recent bytes/read over baseline
+    bytes/read divides the drift, so a consumer that started issuing
+    4x bigger reads is a load shift, not a regression. Steady-state
+    only by construction (window histograms carry H_FETCH_WAIT; the
+    compile-bearing reads observed into first_wait_ms)."""
+    frames = view.frames
+    if len(frames) < th.trend_min_frames:
+        return []
+    recent = frames[-th.trend_recent_frames:]
+    baseline = frames[:-th.trend_recent_frames]
+    h_rec = _frame_window_hist(recent, H_FETCH_WAIT)
+    h_base = _frame_window_hist(baseline, H_FETCH_WAIT)
+    if h_rec.count < th.trend_min_reads \
+            or h_base.count < th.trend_min_reads:
+        return []
+    p99_rec, p99_base = h_rec.quantile(0.99), h_base.quantile(0.99)
+    if p99_rec < th.trend_min_ms or p99_base <= 0:
+        return []
+    bpr_rec = _frame_window_counter(recent, "shuffle.payload.bytes") \
+        / max(1.0, _frame_window_counter(recent, "shuffle.read.count"))
+    bpr_base = _frame_window_counter(baseline, "shuffle.payload.bytes") \
+        / max(1.0, _frame_window_counter(baseline, "shuffle.read.count"))
+    norm = max(bpr_rec / bpr_base, 1.0) if bpr_base > 0 else 1.0
+    drift = (p99_rec / p99_base) / norm
+    if drift < th.trend_ratio:
+        return []
+    span_s = (float(recent[-1].get("t_end", 0.0))
+              - float(recent[0].get("t_start", 0.0)))
+    return [Finding(
+        rule="latency_trend",
+        grade="critical" if drift >= th.trend_critical_ratio
+        else "warn",
+        summary=(f"read-wait p99 drifted to {p99_rec:.1f} ms over the "
+                 f"last {len(recent)} window(s) (~{span_s:.0f} s) vs "
+                 f"{p99_base:.1f} ms baseline — {drift:.1f}x worse "
+                 f"payload-normalized ({h_rec.count} recent reads vs "
+                 f"{h_base.count} baseline)"),
+        evidence={"recent_p99_ms": round(p99_rec, 2),
+                  "baseline_p99_ms": round(p99_base, 2),
+                  "drift_normalized": round(drift, 2),
+                  "payload_norm": round(norm, 3),
+                  "recent_reads": h_rec.count,
+                  "baseline_reads": h_base.count,
+                  "recent_frames": len(recent),
+                  "baseline_frames": len(baseline)},
+        conf_key="spark.shuffle.tpu.trace.enabled",
+        remediation=("something recent made steady reads slower at the "
+                     "same bytes/read: diff the recent windows' frames "
+                     "(slo CLI --input history dir) against the "
+                     "baseline, then pull the merged timeline for a "
+                     "slow recent exchange; straggler_peer / slow_tier "
+                     "/ hbm_pressure findings in the same pass usually "
+                     "name the culprit"))]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
           _rule_bw_underutilization, _rule_padding_waste,
           _rule_wire_dequant, _rule_peer_timeout, _rule_replay_storm,
           _rule_block_corruption, _rule_host_roundtrip,
-          _rule_sink_fallback, _rule_quota_starvation, _rule_slow_tier)
+          _rule_sink_fallback, _rule_quota_starvation, _rule_slow_tier,
+          _rule_slo_burn, _rule_latency_trend)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

@@ -102,14 +102,17 @@ def merge_histogram_snapshots(into: Dict[str, Dict],
 def collect_snapshot(metrics: Union[Metrics, Iterable[Metrics]],
                      tracer: Optional[Tracer] = None,
                      reports: Optional[List[Dict]] = None,
-                     extra: Optional[Dict] = None) -> Dict:
+                     extra: Optional[Dict] = None,
+                     populated_only: bool = False) -> Dict:
     """Build the canonical snapshot document.
 
     ``metrics`` may be one registry or several (the node's registry plus
     the process-global one the step cache reports into) — counters
     merge with later registries winning name collisions (each counter
     name has ONE owning registry), histograms merge exactly (see
-    :func:`merge_histogram_snapshots`)."""
+    :func:`merge_histogram_snapshots`). ``populated_only`` drops
+    zero-count histograms — the history plane's rolling collector only;
+    scrape/dump consumers keep the full pre-registered surface."""
     if isinstance(metrics, Metrics):
         metrics = [metrics]
     counters: Dict[str, float] = {}
@@ -117,7 +120,8 @@ def collect_snapshot(metrics: Union[Metrics, Iterable[Metrics]],
     gauges: Dict[str, float] = {}
     for m in metrics:
         counters.update(m.snapshot())
-        merge_histogram_snapshots(histograms, m.histograms())
+        merge_histogram_snapshots(
+            histograms, m.histograms(populated_only=populated_only))
         # gauges are point-in-time: later registries win collisions,
         # same one-owning-registry rule as counters
         gauges.update(m.gauges())
@@ -176,7 +180,12 @@ def dedupe_process_docs(docs: Iterable[Dict]) -> List[Dict]:
     by (process_id, pid); within a key the doc with the latest ts (tie:
     most trace events) wins — registries are cumulative, so latest is a
     superset — and exchange reports from the dropped docs fold in,
-    deduplicated by trace id, so a postmortem-only report survives."""
+    deduplicated by trace id, so a postmortem-only report survives.
+    Registry-bearing docs ALWAYS beat frame-only history replays
+    (``frames_to_doc`` docs carry empty counters/histograms by design):
+    a history log whose last window rolled after the last metrics dump
+    must not wipe the process's cumulative state — its frames union in
+    below either way."""
     groups: Dict = {}
     order: List = []
     for i, doc in enumerate(docs):
@@ -201,6 +210,7 @@ def dedupe_process_docs(docs: Iterable[Dict]) -> List[Dict]:
             out.append(group[0])
             continue
         best = max(group, key=lambda d: (
+            bool(d.get("counters") or d.get("histograms")),
             d.get("ts", 0.0),
             len(d.get("trace_events", d.get("events", [])))))
         merged = dict(best)
@@ -216,6 +226,29 @@ def dedupe_process_docs(docs: Iterable[Dict]) -> List[Dict]:
             # the flat key shadows any contexts.exchange_reports copy
             # (doctor's _reports_of prefers it), so nothing double-reads
             merged["exchange_reports"] = reports
+        # history frames union across the group the same way: a flight
+        # postmortem (usually the newest capture, so it wins "best")
+        # does not embed the window ring — dropping the metrics
+        # snapshot's frames with it would blind the trend/SLO rules
+        # exactly when they matter (the dump dir of a dead process)
+        seen_f, frames = set(), []
+        for doc in group:
+            for f in (doc.get("history_frames") or []):
+                if not isinstance(f, dict):
+                    continue
+                fk = (f.get("pid"), f.get("seq"), f.get("t_end"))
+                if fk not in seen_f:
+                    seen_f.add(fk)
+                    frames.append(f)
+        if frames:
+            frames.sort(key=lambda f: f.get("t_end", 0.0))
+            merged["history_frames"] = frames
+            for doc in group:
+                if doc.get("slo_objectives"):
+                    merged.setdefault("slo_objectives",
+                                      doc["slo_objectives"])
+                if doc.get("slo_policy"):
+                    merged.setdefault("slo_policy", doc["slo_policy"])
         out.append(merged)
     return out
 
@@ -334,12 +367,17 @@ def render_prometheus(doc: Dict) -> str:
             for q in ("p50", "p99", "max"):
                 qlines.append((f"{fam}_{q}",
                                f"{fam}_{q}{tail} {_fmt(h.get(q, 0.0))}"))
-        seen_types = set()
+        # companion-gauge families emit GROUPED: one TYPE line with all
+        # of that family's series adjacent — a labeled histogram beside
+        # its unlabeled sibling would otherwise interleave f_p50 /
+        # f_p99 / f_max blocks, which the exposition format forbids
+        # (caught by export.validate_exposition's adjacency check)
+        qfams: Dict[str, List[str]] = {}
         for tname, line in qlines:
-            if tname not in seen_types:
-                seen_types.add(tname)
-                lines.append(f"# TYPE {tname} gauge")
-            lines.append(line)
+            qfams.setdefault(tname, []).append(line)
+        for tname in qfams:
+            lines.append(f"# TYPE {tname} gauge")
+            lines.extend(qfams[tname])
     # span summary rides as gauges so a scrape sees phase timings without
     # needing the chrome trace (one family per aggregate field)
     for name in sorted(doc.get("spans", {})):
@@ -350,6 +388,118 @@ def render_prometheus(doc: Dict) -> str:
                 lines.append(f"# TYPE {n}_{field} gauge")
                 lines.append(f"{n}_{field} {_fmt(agg[field])}")
     return "\n".join(lines) + "\n"
+
+
+_EXPO_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_EXPO_LABELS = r'\{[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*"' \
+               r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\["\\n])*")*\}'
+_EXPO_VALUE = r"(?:[+-]?(?:\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)|\+Inf|-Inf|NaN)"
+_EXPO_SAMPLE = re.compile(
+    f"^({_EXPO_NAME})({_EXPO_LABELS})? {_EXPO_VALUE}$")
+_EXPO_TYPE = re.compile(
+    f"^# TYPE ({_EXPO_NAME}) (counter|gauge|histogram|summary|untyped)$")
+
+
+def validate_exposition(text: str) -> None:
+    """Strict line-grammar check of a Prometheus text exposition
+    (format 0.0.4) — the contract scrapers parse, pinned so a future
+    exporter edit cannot silently break them. Raises ValueError naming
+    the first offending line. Checks:
+
+    * every line is a ``# TYPE`` declaration or a sample matching the
+      ``name{label="escaped value",...} value`` grammar (escapes limited
+      to ``\\\\``, ``\\"``, ``\\n`` — the legal label-value set);
+    * every sample's family was TYPE-declared BEFORE it, exactly once,
+      and all of a family's samples are adjacent to their declaration
+      (the exposition adjacency rule);
+    * histogram families carry ``_bucket``/``_sum``/``_count`` series,
+      bucket ``le`` bounds strictly increase per label set, cumulative
+      counts never decrease, and the ``+Inf`` bucket equals ``_count``.
+    """
+    declared: Dict[str, str] = {}
+    current: Optional[str] = None
+    hist_state: Dict = {}
+
+    def _hist_family_of(name: str) -> Optional[str]:
+        for suffix in ("_bucket", "_sum", "_count"):
+            fam = name[:-len(suffix)] if name.endswith(suffix) else None
+            if fam and declared.get(fam) == "histogram":
+                return fam
+        return None
+
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line:
+            continue
+        m = _EXPO_TYPE.match(line)
+        if m:
+            fam = m.group(1)
+            if fam in declared:
+                raise ValueError(
+                    f"line {i}: duplicate # TYPE for family {fam!r}")
+            declared[fam] = m.group(2)
+            current = fam
+            continue
+        if line.startswith("#"):
+            raise ValueError(
+                f"line {i}: only # TYPE comments are emitted, got "
+                f"{line!r}")
+        m = _EXPO_SAMPLE.match(line)
+        if not m:
+            raise ValueError(f"line {i}: not a legal sample: {line!r}")
+        name, labels = m.group(1), m.group(2) or ""
+        fam = name if name in declared else _hist_family_of(name)
+        if fam is None:
+            raise ValueError(
+                f"line {i}: sample {name!r} has no preceding # TYPE")
+        if fam != current:
+            raise ValueError(
+                f"line {i}: sample {name!r} is not adjacent to its "
+                f"family {fam!r} TYPE block (current block: "
+                f"{current!r})")
+        if declared[fam] == "histogram":
+            st = hist_state.setdefault(fam, {"counts": {}, "le": {}})
+            value = float(line.rsplit(" ", 1)[1]
+                          .replace("+Inf", "inf").replace("-Inf", "-inf")
+                          .replace("NaN", "nan"))
+
+            def _label_key(drop_le: bool) -> str:
+                pairs = re.findall(
+                    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\.)*)"',
+                    labels)
+                return ",".join(f'{k}="{v}"' for k, v in sorted(pairs)
+                                if not (drop_le and k == "le"))
+
+            if name.endswith("_bucket"):
+                lm = re.search(r'le="([^"]*)"', labels)
+                if not lm:
+                    raise ValueError(
+                        f"line {i}: histogram bucket without le label")
+                le = float(lm.group(1).replace("+Inf", "inf"))
+                key = _label_key(drop_le=True)
+                prev = st["le"].get(key)
+                if prev is not None:
+                    if le <= prev[0]:
+                        raise ValueError(
+                            f"line {i}: bucket le={le} not increasing "
+                            f"(prev {prev[0]})")
+                    if value < prev[1]:
+                        raise ValueError(
+                            f"line {i}: cumulative bucket count "
+                            f"decreased ({value} < {prev[1]})")
+                st["le"][key] = (le, value)
+            elif name.endswith("_count"):
+                st["counts"][_label_key(drop_le=False)] = value
+    for fam, st in hist_state.items():
+        for key, (le, cum) in st["le"].items():
+            if le != float("inf"):
+                raise ValueError(
+                    f"histogram {fam!r}[{key}]: bucket series does not "
+                    f"end at +Inf (last le={le})")
+            cnt = st["counts"].get(key)
+            if cnt is not None and cnt != cum:
+                raise ValueError(
+                    f"histogram {fam!r}[{key}]: +Inf bucket {cum} != "
+                    f"_count {cnt}")
 
 
 def write_snapshot(doc: Dict, path: str, fsync: bool = True) -> str:
@@ -374,26 +524,60 @@ class PeriodicDumper:
     (``metrics_<pid>.json``, atomic replace) — the textfile-collector /
     sidecar-scrape integration for engines that cannot host an HTTP
     endpoint. Failures are swallowed and logged once: observability must
-    never fail a shuffle."""
+    never fail a shuffle.
 
-    def __init__(self, collect, out_dir: str, interval_s: float):
+    The dumper's cadence is also the telemetry plane's ONE periodic
+    heartbeat: ``tick_fns`` (the history plane's window roll — see
+    utils/history.py) run on every interval, so retention needs no
+    sampling thread of its own. ``out_dir=None`` runs a tick-only
+    dumper (history configured without a dump dir): the thread beats,
+    no snapshot file is written. ``dump_every`` decouples the two
+    cadences when the thread beats faster than the configured dump
+    interval (history windows shorter than dumpIntervalSecs): ticks
+    run every beat, the snapshot file is written every Nth — the
+    configured dump rate is never silently multiplied."""
+
+    def __init__(self, collect, out_dir: Optional[str],
+                 interval_s: float, tick_fns=(), dump_every: int = 1):
         self._collect = collect
         self._dir = out_dir
         self._interval = max(0.1, float(interval_s))
+        self._tick_fns = list(tick_fns)
+        self._dump_every = max(1, int(dump_every))
+        self._beats = 0
         self._stop = threading.Event()
         self._warned = False
         self._thread = threading.Thread(
             target=self._run, name="sparkucx-metrics-dump", daemon=True)
 
     @property
-    def path(self) -> str:
+    def path(self) -> Optional[str]:
+        if self._dir is None:
+            return None
         return os.path.join(self._dir, f"metrics_{os.getpid()}.json")
 
     def start(self) -> "PeriodicDumper":
         self._thread.start()
         return self
 
-    def dump_once(self) -> Optional[str]:
+    def dump_once(self, force: bool = True) -> Optional[str]:
+        """Tick + (conditionally) write. ``force=True`` — the direct
+        callers' contract (tests, stop()'s final state flush) — always
+        writes; the background loop passes False so ``dump_every``
+        governs the file cadence."""
+        for fn in self._tick_fns:
+            try:
+                fn()
+            except Exception:
+                if not self._warned:
+                    self._warned = True
+                    log.exception("dump tick %r failed; further "
+                                  "failures are silenced", fn)
+        if self._dir is None:
+            return None
+        self._beats += 1
+        if not force and self._beats % self._dump_every:
+            return None
         try:
             os.makedirs(self._dir, exist_ok=True)
             # rolling dump: reader-atomicity only, no fsync stalls
@@ -408,7 +592,7 @@ class PeriodicDumper:
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
-            self.dump_once()
+            self.dump_once(force=False)
 
     def stop(self) -> None:
         self._stop.set()

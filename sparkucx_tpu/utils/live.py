@@ -5,7 +5,7 @@ poll dump files or run a CLI against them — a PULL surface with a disk
 in the middle. The reference leans on Spark's UI for exactly this role
 (a live HTTP pull of executor state); this module is the stack's own:
 a stdlib-http background server (no dependencies — the container rule)
-serving four endpoints off the node's pluggable telemetry providers:
+serving five endpoints off the node's pluggable telemetry providers:
 
 ========== ==========================================================
 endpoint   serves
@@ -18,9 +18,13 @@ endpoint   serves
            returns — one seam, no drift)
 /doctor    the doctor's graded findings as JSON — the same list
            ``service.doctor()`` returns
+/slo       the SLO verdict as JSON (utils/slo.py over the retained
+           history windows) — the same document ``service.slo()``
+           returns: per-objective burn rates + error budgets
 /healthz   200/503 liveness: node open, no epoch bump pending
-           re-registration, no device flagged unhealthy; body carries
-           the epoch and reason
+           re-registration, no device flagged unhealthy, no SLO fast
+           burn; the JSON body carries the epoch, the human ``reason``
+           and the stable machine ``cause`` enum
 ========== ==========================================================
 
 Conf: ``spark.shuffle.tpu.metrics.httpPort`` — unset = off (default),
@@ -54,10 +58,12 @@ class LiveTelemetryServer:
     def __init__(self, snapshot_fn: Callable[[], Dict],
                  doctor_fn: Callable[[], list],
                  health_fn: Callable[[], Dict],
-                 port: int = 0, host: str = "127.0.0.1"):
+                 port: int = 0, host: str = "127.0.0.1",
+                 slo_fn: Optional[Callable[[], Dict]] = None):
         self._snapshot_fn = snapshot_fn
         self._doctor_fn = doctor_fn
         self._health_fn = health_fn
+        self._slo_fn = slo_fn
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -82,7 +88,7 @@ class LiveTelemetryServer:
     def start(self) -> "LiveTelemetryServer":
         self._thread.start()
         log.info("live telemetry server up at %s "
-                 "(/metrics /snapshot /doctor /healthz)", self.url)
+                 "(/metrics /snapshot /doctor /slo /healthz)", self.url)
         return self
 
     def stop(self) -> None:
@@ -113,6 +119,18 @@ class LiveTelemetryServer:
                     [f.to_dict() if hasattr(f, "to_dict") else f
                      for f in findings], indent=1)
                 self._send(req, 200, body, "application/json")
+            elif path == "/slo":
+                if self._slo_fn is None:
+                    self._send(req, 404, json.dumps(
+                        {"error": "no SLO provider on this node (set "
+                                  "spark.shuffle.tpu.slo.read.p99Ms / "
+                                  "slo.availability)"}),
+                        "application/json")
+                else:
+                    self._send(req, 200,
+                               json.dumps(self._slo_fn(), indent=1,
+                                          default=repr),
+                               "application/json")
             elif path == "/healthz":
                 h = self._health_fn()
                 self._send(req, 200 if h.get("ok") else 503,
@@ -121,7 +139,8 @@ class LiveTelemetryServer:
             else:
                 self._send(req, 404, json.dumps(
                     {"error": f"unknown path {path!r}", "paths": [
-                        "/metrics", "/snapshot", "/doctor", "/healthz"]}),
+                        "/metrics", "/snapshot", "/doctor", "/slo",
+                        "/healthz"]}),
                     "application/json")
         except Exception as e:
             log.debug("live request %s failed", path, exc_info=True)
@@ -141,8 +160,8 @@ class LiveTelemetryServer:
         req.wfile.write(data)
 
 
-def start_from_conf(conf, snapshot_fn, doctor_fn,
-                    health_fn) -> Optional[LiveTelemetryServer]:
+def start_from_conf(conf, snapshot_fn, doctor_fn, health_fn,
+                    slo_fn=None) -> Optional[LiveTelemetryServer]:
     """Build+start the server from ``metrics.httpPort`` (None when the
     key is unset — off is the default — or the bind fails: a node must
     never fail to BOOT over its observability port, the same rule as the
@@ -157,7 +176,8 @@ def start_from_conf(conf, snapshot_fn, doctor_fn,
         host = conf.get("spark.shuffle.tpu.metrics.httpHost",
                         "127.0.0.1")
         return LiveTelemetryServer(snapshot_fn, doctor_fn, health_fn,
-                                   port=port, host=host).start()
+                                   port=port, host=host,
+                                   slo_fn=slo_fn).start()
     except Exception as e:
         log.warning("live telemetry server unavailable "
                     "(metrics.httpPort=%r): %s — continuing without a "

@@ -359,6 +359,75 @@ class Histogram:
                 h._counts[idx] = h._counts.get(idx, 0) + c
         return h
 
+    @classmethod
+    def snapshot_delta(cls, cur: Dict, prev: Optional[Dict],
+                       name: str = "") -> Dict:
+        """The WINDOW between two snapshots of the SAME cumulative
+        histogram, as a snapshot dict: counts and sums subtract, and the
+        cumulative bucket series subtracts bucket-wise (same fixed
+        ladder, so per-bucket counts diff exactly). This is the time
+        axis the telemetry plane lacked — ``utils/history.py`` calls it
+        per retained window so the SLO plane can ask "what was p99 in
+        the LAST five minutes" instead of since boot.
+
+        ``prev`` of ``None``/empty means the window starts at zero (the
+        first frame IS the cumulative state). A shrinking count means
+        the source registry restarted mid-window; the honest answer is
+        the current cumulative state, not a negative window.
+
+        The window's min/max are NOT recoverable from two cumulative
+        snapshots (the cumulative min/max may predate the window), so
+        they are estimated from the occupied delta buckets' geometric
+        bounds — the same half-bucket error contract quantiles already
+        carry."""
+        if not prev or not int(prev.get("count", 0)):
+            return dict(cur)
+        c0, c1 = int(prev.get("count", 0)), int(cur.get("count", 0))
+        if c1 < c0:           # source registry restarted: window = cur
+            return dict(cur)
+        empty = {"count": 0, "sum": 0.0, "min": 0.0, "max": 0.0,
+                 "mean": 0.0, "p50": 0.0, "p99": 0.0,
+                 "buckets": [[math.inf, 0]]}
+        if c1 == c0:
+            return dict(empty)
+
+        def _per_bucket(snap):
+            out, prior = {}, 0
+            for le, cum in snap.get("buckets", []):
+                le, cum = float(le), int(cum)
+                if le == math.inf:
+                    continue      # terminal carries no bucket of its own
+                out[le] = cum - prior
+                prior = cum
+            return out
+
+        b_cur, b_prev = _per_bucket(cur), _per_bucket(prev)
+        count = c1 - c0
+        cum, series = 0, []
+        occupied: List[float] = []
+        for le in sorted(set(b_cur) | set(b_prev)):
+            c = max(0, b_cur.get(le, 0) - b_prev.get(le, 0))
+            if c:
+                cum += c
+                series.append([le, cum])
+                occupied.append(le)
+        series.append([math.inf, count])
+        # min/max estimates from the occupied bounds (lower geometric
+        # neighbour for min), clipped to the cumulative envelope
+        if occupied:
+            lo = occupied[0] / cls.GROWTH if occupied[0] > 0 else \
+                min(float(cur.get("min", 0.0)), 0.0)
+            hi = occupied[-1]
+        else:
+            lo = hi = 0.0
+        lo = max(lo, float(cur.get("min", lo)))
+        hi = min(hi, float(cur.get("max", hi))) if hi else hi
+        s = float(cur.get("sum", 0.0)) - float(prev.get("sum", 0.0))
+        h = cls.from_snapshot(
+            {"count": count, "sum": s, "min": lo, "max": hi,
+             "buckets": series}, name)
+        return h.snapshot()
+
     def merge(self, other: "Histogram") -> "Histogram":
         """Fold ``other``'s observations into this histogram (exact —
         same fixed ladder, so bucket counts add). The cluster-wide
@@ -514,11 +583,17 @@ class Metrics:
         with self._lock:
             return dict(self._counters)
 
-    def histograms(self) -> Dict[str, Dict]:
-        """{name: Histogram.snapshot()} — the exporter-facing view."""
+    def histograms(self, populated_only: bool = False) -> Dict[str, Dict]:
+        """{name: Histogram.snapshot()} — the exporter-facing view.
+        ``populated_only`` skips zero-count histograms: exporters want
+        the full pre-registered surface (a dashboard query must not
+        404), but the history plane's window deltas drop empty series
+        anyway and snapshotting them every roll is pure cost on the
+        rolling cadence."""
         with self._lock:
             hists = list(self._histograms.items())
-        return {name: h.snapshot() for name, h in hists}
+        return {name: h.snapshot() for name, h in hists
+                if not populated_only or h.count}
 
     @contextlib.contextmanager
     def timeit(self, name: str, hist: Optional[str] = None):

@@ -103,6 +103,20 @@ def pytest_sessionfinish(session, exitstatus):
         if any(tenant_doc.values()):
             write_snapshot(tenant_doc,
                            os.path.join(out, "tenant_metrics.json"))
+        # history + SLO verdict beside the flight dump: the retained
+        # windows say "when did it start getting worse", the verdict
+        # says "for whom" — attributable without re-running anything
+        if node is not None and not node._closed:
+            frames = node.history.frames()
+            if frames:
+                import json as _json
+                with open(os.path.join(
+                        out, f"history_{os.getpid()}.jsonl"), "w") as f:
+                    for fr in frames:
+                        f.write(_json.dumps(fr, default=repr) + "\n")
+            if node.slo_objectives:
+                write_snapshot(node.slo_verdict(),
+                               os.path.join(out, "slo_verdict.json"))
     except Exception as e:  # artifact collection must never mask the run
         print(f"[conftest] telemetry artifact collection failed: {e!r}")
 

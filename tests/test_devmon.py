@@ -440,3 +440,132 @@ def test_devplane_bench_stage_small(mesh8):
     # hint — both stay out of the steady-state bw histogram by design
     assert rec["bw"]["count"] >= rec["exchanges"] - 2
     assert rec["bw"]["max_gbps"] > 0
+
+
+def test_watcher_rearms_after_consecutive_healthy_passes(
+        service_factory, tmp_path):
+    """PR-14 satellite bugfix: a captured finding key that CLEARS for
+    doctor.rearmHealthyPasses consecutive passes re-arms, so the same
+    condition recurring later is captured again; a flapping condition
+    (present every other pass) never re-arms."""
+    from sparkucx_tpu.utils.doctor import Finding
+    svc = service_factory({
+        "spark.shuffle.tpu.flightRecorder.enabled": "true",
+        "spark.shuffle.tpu.flightRecorder.dir": str(tmp_path / "fl"),
+        "spark.shuffle.tpu.doctor.watchIntervalSecs": "3600",
+        "spark.shuffle.tpu.doctor.rearmHealthyPasses": "2",
+        "spark.shuffle.tpu.doctor.captureMs": "0"})
+    watcher = svc.node.watcher
+    crit = Finding(rule="hbm_pressure", grade="critical",
+                   summary="synthetic", trace_ids=["s1.e0.x1"])
+    svc.node.doctor_provider = lambda: [crit]
+    assert len(watcher.check_once()) == 1      # first occurrence
+    assert watcher.check_once() == []          # persists: no re-capture
+    # clears for ONE pass only, then recurs: streak reset, still armed
+    svc.node.doctor_provider = lambda: []
+    watcher.check_once()
+    svc.node.doctor_provider = lambda: [crit]
+    assert watcher.check_once() == []          # 1 healthy < rearm 2
+    # clears for TWO consecutive passes -> re-armed
+    svc.node.doctor_provider = lambda: []
+    watcher.check_once()
+    watcher.check_once()
+    svc.node.doctor_provider = lambda: [crit]
+    fired = watcher.check_once()
+    assert len(fired) == 1 and fired[0]["rule"] == "hbm_pressure"
+    assert len(watcher.captures) == 2
+    # a persistent flood (fresh key every pass, rule never quiet)
+    # still hits the per-rule cap — the refund only follows a streak
+    # where the WHOLE rule went quiet
+    for i in range(10, 30):
+        svc.node.doctor_provider = (
+            lambda i=i: [Finding(rule="hbm_pressure", grade="critical",
+                                 summary="synthetic",
+                                 trace_ids=[f"s{i}.e0.x{i}"])])
+        watcher.check_once()
+    assert len(watcher.captures) == watcher.RULE_CAPTURE_CAP + 1
+    # ...but once the rule clears for the streak, a recurrence past
+    # the cap captures again (the budget refunds with the re-arm)
+    svc.node.doctor_provider = lambda: []
+    watcher.check_once()
+    watcher.check_once()
+    svc.node.doctor_provider = lambda: [Finding(
+        rule="hbm_pressure", grade="critical", summary="synthetic",
+        trace_ids=["s99.e0.x99"])]
+    assert len(watcher.check_once()) == 1
+
+
+def test_healthz_cause_enum_flips_per_cause(service_factory):
+    """PR-14 satellite: the 503 body carries a stable machine ``cause``
+    a probe can switch on — epoch_bump / device_unhealthy /
+    slo_fast_burn — not just the human reason sentence."""
+    import urllib.error
+    svc = service_factory({
+        "spark.shuffle.tpu.metrics.httpPort": "0",
+        "spark.shuffle.tpu.history.windowSecs": "86400",
+        "spark.shuffle.tpu.slo.read.p99Ms": "10",
+        "spark.shuffle.tpu.slo.minEvents": "4"})
+    url = svc.node.live.url + "/healthz"
+    status, body = _get(url)
+    assert status == 200 and json.loads(body)["cause"] is None
+
+    def _cause():
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(url)
+        assert ei.value.code == 503
+        return json.loads(ei.value.read().decode())["cause"]
+
+    svc.node.epochs.bump("membership change")
+    assert _cause() == "epoch_bump"
+    svc.node.mark_healthy()
+    svc.node._on_device_unhealthy(["TFRT_CPU_7"])
+    assert _cause() == "device_unhealthy"
+    svc.node.mark_healthy()
+    assert _get(url)[0] == 200
+    # SLO fast burn: every windowed read blows the 10 ms bound
+    svc.node.history.roll()
+    for _ in range(8):
+        svc.node.metrics.observe("shuffle.read.wait_ms", 500.0)
+    svc.node.metrics.inc("shuffle.read.count", 8)
+    svc.node.history.roll()
+    assert _cause() == "slo_fast_burn"
+
+
+def test_prometheus_full_grammar_golden_strict_checker():
+    """PR-14 satellite: ONE exposition document exercising the whole
+    grammar — a labeled histogram family beside its unlabeled sibling,
+    labeled counters, gauges, and a pathological escaped label —
+    validated by the strict line-grammar checker, so a future exporter
+    edit cannot silently break scrapers."""
+    from sparkucx_tpu.utils.export import (render_prometheus,
+                                           validate_exposition)
+    from sparkucx_tpu.utils.metrics import Metrics
+    m = Metrics()
+    m.inc("shuffle.read.count", 7)
+    m.inc(labeled("shuffle.read.count", tenant="whale"), 3)
+    m.inc(labeled("shuffle.read.count", tenant='e"v\\i\nl'), 1)
+    for v in (1.0, 5.0, 50.0):
+        m.observe("shuffle.read.wait_ms", v)
+        m.observe(labeled("shuffle.read.wait_ms", tenant="whale"),
+                  v * 2)
+    m.set_gauge("pool.peak_bytes", 4096)
+    m.set_gauge(labeled(G_HBM_IN_USE, device=0), 12345)
+    from sparkucx_tpu.utils.export import collect_snapshot
+    text = render_prometheus(collect_snapshot(m))
+    validate_exposition(text)          # the golden: full grammar, legal
+    # the checker has TEETH: a decreasing bucket series must fail...
+    broken = text.replace(
+        'sparkucx_tpu_shuffle_read_wait_ms_bucket{le="+Inf"} 3',
+        'sparkucx_tpu_shuffle_read_wait_ms_bucket{le="+Inf"} 0')
+    assert broken != text
+    with pytest.raises(ValueError):
+        validate_exposition(broken)
+    # ...and so must a sample with no TYPE declaration
+    with pytest.raises(ValueError, match="no preceding # TYPE"):
+        validate_exposition("orphan_metric 1\n")
+    # ...and a family split away from its TYPE block (adjacency rule)
+    lines = text.splitlines()
+    lines.append(lines[next(i for i, ln in enumerate(lines)
+                            if not ln.startswith("#"))])
+    with pytest.raises(ValueError, match="adjacent"):
+        validate_exposition("\n".join(lines))

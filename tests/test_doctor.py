@@ -1351,3 +1351,182 @@ def test_slow_tier_sub_noise_floors():
     doc["exchange_reports"] = [
         _hier_report(i, 30.0, 400.0, programs=2) for i in range(1, 5)]
     assert [f for f in diagnose(doc) if f.rule == "slow_tier"] == []
+
+
+# -- SLO burn + latency trend (the PR-14 trend-aware rules) ------------------
+def _slo_obj(tenant="", threshold_ms=50.0, target=0.99):
+    return {"key": "slo.read.p99Ms", "kind": "latency",
+            "tenant": tenant, "threshold_ms": threshold_ms,
+            "target": target}
+
+
+def _slo_policy(fast_s=120.0, slow_s=480.0, fast_burn=14.4,
+                slow_burn=6.0, min_events=4):
+    return {"fast_window_s": fast_s, "slow_window_s": slow_s,
+            "fast_burn": fast_burn, "slow_burn": slow_burn,
+            "min_events": min_events}
+
+
+def _frame_doc(frames, objectives=None, policy=None, process_id=0):
+    doc = {"anchor": _anchor(), "process_id": process_id,
+           "counters": {}, "histograms": {},
+           "history_frames": frames}
+    if objectives is not None:
+        doc["slo_objectives"] = objectives
+    if policy is not None:
+        doc["slo_policy"] = policy
+    return doc
+
+
+def _window_frame(t_end, waits=(), tenant=None, seq=1, reads=None,
+                  extra_counters=None, extra_hists=None):
+    from sparkucx_tpu.utils.metrics import labeled
+    name = labeled(H_FETCH_WAIT, tenant=tenant) if tenant \
+        else H_FETCH_WAIT
+    cname = labeled("shuffle.read.count", tenant=tenant) if tenant \
+        else "shuffle.read.count"
+    hists = {}
+    if waits:
+        hists[name] = _hist_snap(list(waits), name)
+    hists.update(extra_hists or {})
+    counters = {cname: float(reads if reads is not None else len(waits))}
+    counters.update(extra_counters or {})
+    return {"kind": "history_frame", "seq": seq,
+            "t_start": t_end - 60.0, "t_end": t_end, "window_s": 60.0,
+            "pid": 1, "process_id": 0, "anchor": _anchor(),
+            "counters": counters, "histograms": hists, "gauges": {}}
+
+
+T0 = 5_000_000.0
+
+
+def test_slo_burn_fires_critical_and_names_objective():
+    frames = [_window_frame(T0 + i * 60.0, waits=[5.0] * 6, seq=i)
+              for i in (1, 2)]
+    frames += [_window_frame(T0 + i * 60.0, waits=[500.0] * 6, seq=i)
+               for i in (3, 4)]
+    doc = _frame_doc(frames, [_slo_obj()], _slo_policy())
+    fs = [f for f in diagnose(doc) if f.rule == "slo_burn"]
+    assert fs and fs[0].grade == "critical"
+    assert fs[0].evidence["objective"] == "slo.read.p99Ms"
+    assert fs[0].evidence["burn_fast"] >= 14.4
+    assert "slo.read.p99Ms" in fs[0].conf_key
+
+
+def test_slo_burn_self_throttled_capped_at_warn():
+    """A tenant whose burning reads sat in its OWN admission queue
+    (real admit waits, ~zero cross-grants) is client self-backpressure:
+    the finding says so and stays a warning, not a page."""
+    from sparkucx_tpu.utils.metrics import (H_ADMIT_CROSS, H_ADMIT_WAIT,
+                                            labeled)
+    tid = "whale"
+    extra = {
+        labeled(H_ADMIT_WAIT, tenant=tid):
+            _hist_snap([800.0] * 6, labeled(H_ADMIT_WAIT, tenant=tid)),
+        labeled(H_ADMIT_CROSS, tenant=tid):
+            _hist_snap([0.0] * 6, labeled(H_ADMIT_CROSS, tenant=tid)),
+    }
+    frames = [_window_frame(T0 + 60.0, waits=[5.0] * 6, tenant=tid,
+                            seq=1)]
+    frames += [_window_frame(T0 + i * 60.0, waits=[900.0] * 6,
+                             tenant=tid, seq=i, extra_hists=extra)
+               for i in (2, 3)]
+    doc = _frame_doc(frames, [_slo_obj(tenant=tid)], _slo_policy())
+    fs = [f for f in diagnose(doc) if f.rule == "slo_burn"]
+    assert fs and fs[0].grade == "warn"
+    assert fs[0].evidence["self_throttled"] is True
+    assert "self-backpressure" in fs[0].summary
+    assert fs[0].evidence["tenant"] == tid
+
+
+def test_slo_burn_quiet_goldens():
+    # (a) healthy windows: no finding
+    frames = [_window_frame(T0 + i * 60.0, waits=[5.0] * 8, seq=i)
+              for i in range(1, 5)]
+    doc = _frame_doc(frames, [_slo_obj()], _slo_policy())
+    assert [f for f in diagnose(doc) if f.rule == "slo_burn"] == []
+    # (b) no objectives declared: frames alone never fire the rule
+    doc = _frame_doc(frames)
+    assert [f for f in diagnose(doc) if f.rule == "slo_burn"] == []
+    # (c) sub-noise: the graded windows hold fewer events than the
+    # min_events floor (the old healthy traffic has aged out of both)
+    frames2 = frames + [_window_frame(T0 + 1000.0, waits=[500.0] * 2,
+                                      seq=5)]
+    doc = _frame_doc(frames2, [_slo_obj()],
+                     _slo_policy(fast_s=60.0, slow_s=480.0,
+                                 min_events=4))
+    assert [f for f in diagnose(doc) if f.rule == "slo_burn"] == []
+
+
+def test_latency_trend_fires_and_grades():
+    frames = [_window_frame(T0 + i * 60.0, waits=[10.0] * 10, seq=i)
+              for i in range(1, 5)]
+    frames += [_window_frame(T0 + i * 60.0, waits=[60.0] * 10, seq=i)
+               for i in (5, 6, 7)]
+    fs = [f for f in diagnose(_frame_doc(frames))
+          if f.rule == "latency_trend"]
+    assert fs and fs[0].grade == "warn"
+    assert fs[0].evidence["drift_normalized"] >= 3.0
+    # critical at an order-of-magnitude drift
+    frames = frames[:4] + [
+        _window_frame(T0 + i * 60.0, waits=[900.0] * 10, seq=i)
+        for i in (5, 6, 7)]
+    fs = [f for f in diagnose(_frame_doc(frames))
+          if f.rule == "latency_trend"]
+    assert fs and fs[0].grade == "critical"
+
+
+def test_latency_trend_quiet_on_payload_shift():
+    """p99 up 5x but bytes/read up 5x too: a load shift, normalized
+    away — NOT a regression finding."""
+    frames = [_window_frame(T0 + i * 60.0, waits=[10.0] * 10, seq=i,
+                            extra_counters={
+                                "shuffle.payload.bytes": 10 * 1000.0})
+              for i in range(1, 5)]
+    frames += [_window_frame(T0 + i * 60.0, waits=[50.0] * 10, seq=i,
+                             extra_counters={
+                                 "shuffle.payload.bytes": 10 * 5000.0})
+               for i in (5, 6, 7)]
+    assert [f for f in diagnose(_frame_doc(frames))
+            if f.rule == "latency_trend"] == []
+
+
+def test_latency_trend_sub_noise_floors():
+    # (a) too few frames
+    frames = [_window_frame(T0 + i * 60.0, waits=[10.0] * 10, seq=i)
+              for i in range(1, 4)]
+    assert [f for f in diagnose(_frame_doc(frames))
+            if f.rule == "latency_trend"] == []
+    # (b) too few reads per side
+    frames = [_window_frame(T0 + i * 60.0, waits=[10.0] * 2, seq=i)
+              for i in range(1, 5)]
+    frames += [_window_frame(T0 + i * 60.0, waits=[60.0] * 2, seq=i)
+               for i in (5, 6, 7)]
+    assert [f for f in diagnose(_frame_doc(frames))
+            if f.rule == "latency_trend"] == []
+    # (c) drift under the noise floor in absolute ms
+    frames = [_window_frame(T0 + i * 60.0, waits=[0.2] * 10, seq=i)
+              for i in range(1, 5)]
+    frames += [_window_frame(T0 + i * 60.0, waits=[1.0] * 10, seq=i)
+               for i in (5, 6, 7)]
+    assert [f for f in diagnose(_frame_doc(frames))
+            if f.rule == "latency_trend"] == []
+
+
+def test_build_view_folds_frames_and_objectives_across_processes():
+    f0 = _window_frame(T0 + 60.0, waits=[5.0] * 4, seq=1)
+    f1 = _window_frame(T0 + 120.0, waits=[7.0] * 4, seq=1)
+    del f1["process_id"]   # unstamped frame: build_view attributes it
+    f1["slo_objectives"] = [_slo_obj(tenant="t2")]
+    d0 = _frame_doc([f0], [_slo_obj()], _slo_policy(), process_id=0)
+    d1 = _frame_doc([f1], process_id=1)
+    d1["pid"] = 2
+    view = build_view([d0, d1])
+    assert len(view.frames) == 2
+    assert [f["t_end"] for f in view.frames] == [T0 + 60.0, T0 + 120.0]
+    assert view.frames[1]["process_id"] == 1
+    # objectives union by (key, tenant): global from d0, t2 from f1
+    keys = {(o["key"], o.get("tenant", ""))
+            for o in view.slo_objectives}
+    assert keys == {("slo.read.p99Ms", ""), ("slo.read.p99Ms", "t2")}
+    assert view.slo_policy["fast_window_s"] == 120.0

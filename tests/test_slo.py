@@ -1,0 +1,488 @@
+"""SLO-plane tests — windowed telemetry history (delta frames, ring +
+on-disk retention, restart replay), objectives/burn-rate evaluation,
+per-tenant budget isolation over real exchanges, the facade/live/CLI
+surfaces, and the tick-only PeriodicDumper mode that drives rolling."""
+
+import json
+import math
+import os
+import time
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.utils import slo as S
+from sparkucx_tpu.utils.history import (TelemetryHistory, counters_delta,
+                                        frames_to_doc, load_history_file)
+from sparkucx_tpu.utils.metrics import (H_FETCH_WAIT, Histogram, Metrics,
+                                        labeled)
+
+BASE_CONF = {
+    "spark.shuffle.tpu.a2a.impl": "dense",
+    "spark.shuffle.tpu.io.format": "raw",
+}
+
+
+def _anchor():
+    perf = time.perf_counter()
+    wall = time.time()
+    return {"wall": wall, "perf": perf, "perf_epoch": perf,
+            "wall_epoch": wall, "pid": 1.0}
+
+
+def _hist_snap(values, name=H_FETCH_WAIT):
+    h = Histogram(name)
+    for v in values:
+        h.observe(float(v))
+    return h.snapshot()
+
+
+def _frame(t_end, waits=(), tenant=None, reads=None, seq=1,
+           objectives=None, window_s=60.0, extra_counters=None):
+    """Synthetic history frame: window read-wait deltas (optionally
+    tenant-labeled) plus matching read-count deltas."""
+    name = labeled(H_FETCH_WAIT, tenant=tenant) if tenant \
+        else H_FETCH_WAIT
+    cname = labeled("shuffle.read.count", tenant=tenant) if tenant \
+        else "shuffle.read.count"
+    counters = {cname: float(reads if reads is not None else len(waits))}
+    counters.update(extra_counters or {})
+    f = {"kind": "history_frame", "seq": seq,
+         "t_start": t_end - window_s, "t_end": t_end,
+         "window_s": window_s, "pid": 1, "process_id": 0,
+         "anchor": _anchor(),
+         "counters": counters,
+         "histograms": {name: _hist_snap(waits, name)} if waits else {},
+         "gauges": {}}
+    if objectives:
+        f["slo_objectives"] = [o.to_dict() for o in objectives]
+    return f
+
+
+# -- Histogram.snapshot_delta ------------------------------------------------
+def test_snapshot_delta_is_the_window(rng):
+    h = Histogram("w")
+    w1 = rng.uniform(1.0, 5.0, size=200)
+    for v in w1:
+        h.observe(v)
+    s0 = h.snapshot()
+    w2 = rng.uniform(50.0, 500.0, size=300)
+    for v in w2:
+        h.observe(v)
+    d = Histogram.snapshot_delta(h.snapshot(), s0)
+    assert int(d["count"]) == len(w2)
+    assert d["sum"] == pytest.approx(w2.sum(), rel=1e-9)
+    # the delta's quantiles are the WINDOW's quantiles (half-bucket
+    # error bound, ~4.5% — same contract as the live histogram)
+    for q, key in ((50, "p50"), (99, "p99")):
+        assert d[key] == pytest.approx(np.percentile(w2, q), rel=0.12)
+    # window min/max are bucket-bounded estimates, never inside-out
+    assert d["min"] <= np.min(w2) * Histogram.GROWTH
+    assert d["max"] >= np.max(w2) / Histogram.GROWTH
+
+
+def test_snapshot_delta_reset_and_empty():
+    h = Histogram("x")
+    for v in (1.0, 2.0):
+        h.observe(v)
+    s = h.snapshot()
+    # no prev / empty prev: the window IS the cumulative state
+    assert Histogram.snapshot_delta(s, None) == s
+    assert Histogram.snapshot_delta(s, {"count": 0}) == s
+    # equal snapshots: empty window
+    d = Histogram.snapshot_delta(s, s)
+    assert d["count"] == 0 and d["buckets"][-1] == [math.inf, 0]
+    # shrinking count = source restarted: honest answer is cur
+    smaller = Histogram("x")
+    for v in (1.0, 2.0, 3.0):
+        smaller.observe(v)
+    assert Histogram.snapshot_delta(s, smaller.snapshot()) == s
+
+
+def test_counters_delta_drops_zero_and_detects_reset():
+    d = counters_delta({"a": 10.0, "b": 5.0, "c": 3.0},
+                       {"a": 4.0, "b": 5.0, "c": 7.0})
+    assert d == {"a": 6.0, "c": 3.0}   # b unchanged -> dropped;
+    #                                    c shrank -> reset -> cur value
+
+
+# -- TelemetryHistory --------------------------------------------------------
+def _mk_history(metrics, tmp_path=None, retain=5, **kw):
+    from sparkucx_tpu.utils.export import collect_snapshot
+    return TelemetryHistory(
+        lambda: collect_snapshot(metrics),
+        window_secs=3600.0, retain_windows=retain,
+        out_dir=str(tmp_path) if tmp_path is not None else None, **kw)
+
+
+def test_history_ring_and_disk_retention(tmp_path):
+    m = Metrics()
+    hist = _mk_history(m, tmp_path, retain=5)
+    assert hist.roll() is None          # first snapshot opens the window
+    for i in range(9):
+        m.inc("shuffle.read.count", 2)
+        m.observe(H_FETCH_WAIT, 5.0)
+        f = hist.roll()
+        assert f["counters"]["shuffle.read.count"] == 2.0
+        assert f["histograms"][H_FETCH_WAIT]["count"] == 1
+    frames = hist.frames()
+    assert len(frames) == 5             # ring bounded
+    assert [f["seq"] for f in frames] == list(range(5, 10))
+    assert all(f["anchor"] for f in frames)
+    # the on-disk log NEVER exceeds retainWindows (oldest-first trunc)
+    lines = load_history_file(hist.path)
+    assert len(lines) == 5
+    assert [f["seq"] for f in lines] == [f["seq"] for f in frames]
+
+
+def test_history_path_is_rank_keyed_not_pid(tmp_path):
+    """The log file is keyed by the stable cluster rank: a restarted
+    rank (fresh pid) writes the SAME file and adopts it, instead of
+    leaving one orphan history_<pid>.jsonl per dead process forever."""
+    m = Metrics()
+    hist = _mk_history(m, tmp_path, retain=4, process_id=7)
+    assert os.path.basename(hist.path) == "history_p7.jsonl"
+    assert str(os.getpid()) not in os.path.basename(hist.path)
+
+
+def test_history_disk_adoption_across_instances(tmp_path):
+    """A restarted writer (same rank => same file) adopts the existing
+    log: the retention bound spans restarts, not just one process
+    lifetime."""
+    m = Metrics()
+    h1 = _mk_history(m, tmp_path, retain=4)
+    h1.roll()
+    for _ in range(3):
+        m.inc("x", 1)
+        h1.roll()
+    h2 = _mk_history(m, tmp_path, retain=4)
+    h2.roll()
+    for _ in range(3):
+        m.inc("x", 1)
+        h2.roll()
+    assert len(load_history_file(h1.path)) <= 4
+
+
+def test_history_tick_rolls_on_cadence_only():
+    m = Metrics()
+    from sparkucx_tpu.utils.export import collect_snapshot
+    hist = TelemetryHistory(lambda: collect_snapshot(m),
+                            window_secs=3600.0, retain_windows=4)
+    assert hist.tick() is None and hist.tick() is None
+    assert hist.frames() == []          # window not elapsed
+    hist.window_secs = 0.0001
+    time.sleep(0.001)
+    hist.tick()                         # opens
+    m.inc("x", 1)
+    time.sleep(0.001)
+    assert hist.tick() is not None      # elapsed -> rolls
+
+
+def test_frames_to_doc_and_empty_raises(tmp_path):
+    with pytest.raises(ValueError):
+        frames_to_doc([], source="empty")
+    f = _frame(time.time(), waits=[5.0, 6.0])
+    doc = frames_to_doc([f])
+    assert doc["history_frames"] == [f]
+    assert doc["anchor"] == f["anchor"]
+
+
+# -- objectives + evaluation -------------------------------------------------
+def test_objectives_from_conf_parse_and_overrides():
+    conf = TpuShuffleConf({
+        **BASE_CONF,
+        "spark.shuffle.tpu.slo.read.p99Ms": "250",
+        "spark.shuffle.tpu.slo.availability": "0.995",
+        "spark.shuffle.tpu.tenant.whale.slo.read.p99Ms": "1000",
+        "spark.shuffle.tpu.tenant.minnow.slo.availability": "0.9",
+    }, use_env=False)
+    objs = {(o.key, o.tenant): o for o in S.objectives_from_conf(conf)}
+    assert objs[("slo.read.p99Ms", "")].threshold_ms == 250.0
+    assert objs[("slo.read.p99Ms", "")].target == 0.99
+    assert objs[("slo.availability", "")].target == 0.995
+    assert objs[("slo.read.p99Ms", "whale")].threshold_ms == 1000.0
+    assert objs[("slo.availability", "minnow")].target == 0.9
+    # unset = no objectives at all (the plane is opt-in)
+    assert S.objectives_from_conf(
+        TpuShuffleConf(BASE_CONF, use_env=False)) == []
+
+
+def test_objectives_validation_fails_fast():
+    for bad in ({"spark.shuffle.tpu.slo.read.p99Ms": "-5"},
+                {"spark.shuffle.tpu.slo.availability": "1.5"},
+                {"spark.shuffle.tpu.tenant.t.slo.read.p99Ms": "0"}):
+        conf = TpuShuffleConf({**BASE_CONF, **bad}, use_env=False)
+        with pytest.raises(ValueError):
+            S.objectives_from_conf(conf)
+
+
+def test_evaluate_burn_fires_clears_and_budget_reaccrues():
+    obj = S.Objective(key="slo.read.p99Ms", kind="latency",
+                      threshold_ms=50.0, target=0.99)
+    pol = S.BurnPolicy(fast_window_s=120.0, slow_window_s=480.0,
+                       fast_burn=14.4, slow_burn=6.0, min_events=4)
+    t0 = 1_000_000.0
+    frames = [_frame(t0 + i * 60.0, waits=[5.0] * 6, seq=i)
+              for i in range(1, 5)]
+    v = S.evaluate(frames, [obj], policy=pol)
+    o = v["objectives"][0]
+    assert not v["fast_burn"] and o["budget"]["remaining"] == 1.0
+    # two bad windows: every read over the bound -> burn 100x
+    frames += [_frame(t0 + i * 60.0, waits=[500.0] * 4, seq=i)
+               for i in (5, 6)]
+    v = S.evaluate(frames, [obj], policy=pol)
+    o = v["objectives"][0]
+    assert v["fast_burn"] and o["burn_fast"] >= pol.fast_burn
+    assert "slo.read.p99Ms" in v["burning"][0]
+    burned_budget = o["budget"]["remaining"]
+    assert burned_budget < 1.0
+    # healthy windows push the bad ones out of the fast window: clears
+    frames += [_frame(t0 + i * 60.0, waits=[5.0] * 6, seq=i)
+               for i in (7, 8, 9)]
+    v = S.evaluate(frames, [obj], policy=pol)
+    assert not v["fast_burn"]
+    # retention eviction (the ring's maxlen in production) re-accrues
+    v = S.evaluate(frames[-3:], [obj], policy=pol)
+    assert v["objectives"][0]["budget"]["remaining"] == 1.0 \
+        > burned_budget
+
+
+def test_good_count_bucket_boundary():
+    snap = _hist_snap([1.0, 2.0, 100.0, 200.0])
+    # threshold between the clusters: exactly the fast half counts good
+    assert S.good_count(snap, 50.0) == 2
+    assert S.good_count(snap, 0.5) == 0
+    assert S.good_count(snap, 1e9) == 4
+
+
+def test_availability_objective_counts_replays():
+    obj = S.Objective(key="slo.availability", kind="availability",
+                      target=0.9)
+    pol = S.BurnPolicy(fast_window_s=120.0, fast_burn=3.0, min_events=4)
+    t0 = 2_000_000.0
+    good = _frame(t0 + 60.0, reads=10, seq=1)
+    bad = _frame(t0 + 120.0, reads=10, seq=2,
+                 extra_counters={"shuffle.replay.count": 8.0})
+    v = S.evaluate([good, bad], [obj], policy=pol)
+    o = v["objectives"][0]
+    assert o["windows"]["fast"]["errors"] == 8
+    assert o["fast_burn"]                  # 40% errors / 10% allowed = 4x
+
+
+# -- per-tenant isolation (the whale/minnow contract) ------------------------
+def test_whale_burn_does_not_move_minnow_budget(manager_factory):
+    """A whale tenant burning its latency budget (injected delay on its
+    reads only) must not move a quiet minnow's budget — the PR-11
+    labeled series keep the signals disjoint."""
+    mgr = manager_factory({
+        "spark.shuffle.tpu.history.windowSecs": "86400",
+        "spark.shuffle.tpu.history.retainWindows": "8",
+        "spark.shuffle.tpu.tenant.whale.slo.read.p99Ms": "400",
+        "spark.shuffle.tpu.tenant.minnow.slo.read.p99Ms": "400",
+        "spark.shuffle.tpu.slo.fastWindowSecs": "120",
+        "spark.shuffle.tpu.slo.minEvents": "2",
+    })
+    node = mgr.node
+    rng = np.random.default_rng(0)
+    handles = {}
+    for sid, tenant in ((700, "whale"), (701, "minnow")):
+        h = mgr.register_shuffle(sid, 2, 4, tenant=tenant)
+        for m in range(2):
+            w = mgr.get_writer(h, m)
+            w.write(rng.integers(0, 1 << 30, size=512))
+            w.commit(4)
+        handles[tenant] = h
+    mgr.read(handles["minnow"])          # warm the program (first read
+    #                                      lands in first_wait_ms)
+    t0 = time.time()
+    node.history.roll(now=t0)
+    for _ in range(3):
+        mgr.read(handles["minnow"])
+    node.faults.arm("exchange", delay_ms=800.0)
+    for _ in range(3):
+        mgr.read(handles["whale"])
+    node.faults.disarm("exchange")
+    node.history.roll(now=t0 + 60.0)
+    by_tenant = {o["tenant"]: o
+                 for o in node.slo_verdict()["objectives"]}
+    assert by_tenant["whale"]["fast_burn"]
+    assert by_tenant["whale"]["budget"]["remaining"] < 1.0
+    assert not by_tenant["minnow"]["fast_burn"]
+    assert by_tenant["minnow"]["budget"]["remaining"] == 1.0
+    # the burn degrades health naming the SLO cause, whale only
+    status = node.health_status()
+    assert not status["ok"] and status["cause"] == "slo_fast_burn"
+    assert "whale" in status["reason"] and "minnow" not in \
+        status["reason"]
+
+
+# -- facade + live endpoint + CLI -------------------------------------------
+@pytest.fixture()
+def service_factory(mesh8):
+    from sparkucx_tpu.service import connect
+    created = []
+
+    def make(overrides=None):
+        while created:
+            created.pop().stop()
+        conf = dict(BASE_CONF)
+        conf.update(overrides or {})
+        svc = connect(conf, use_env=False)
+        created.append(svc)
+        return svc
+
+    yield make
+    while created:
+        created.pop().stop()
+
+
+def test_facade_slo_and_live_endpoint(service_factory):
+    import urllib.request
+    svc = service_factory({
+        "spark.shuffle.tpu.metrics.httpPort": "0",
+        "spark.shuffle.tpu.history.windowSecs": "86400",
+        "spark.shuffle.tpu.slo.read.p99Ms": "500"})
+    rng = np.random.default_rng(1)
+    h = svc.register_shuffle(720, 2, 4)
+    for m in range(2):
+        svc.write(h, m, rng.integers(0, 1 << 30, size=512))
+    svc.read(h)
+    svc.node.history.roll()
+    svc.read(h)
+    svc.node.history.roll()
+    verdict = svc.slo()
+    assert verdict["healthy"] and len(verdict["objectives"]) == 1
+    assert "slo.read.p99Ms" in svc.slo("text")
+    with pytest.raises(ValueError):
+        svc.slo("prometheus")
+    with urllib.request.urlopen(svc.node.live.url + "/slo",
+                                timeout=10) as r:
+        live = json.loads(r.read())
+    assert live["healthy"] is True
+    assert live["objectives"][0]["objective"] == "slo.read.p99Ms"
+    # the facade snapshot embeds the frames + objectives (the doctor's
+    # and the dump replay's input)
+    doc = svc.stats("json")
+    assert doc["history_frames"] and doc["slo_objectives"]
+
+
+def test_v2_facade_slo_surface(service_factory):
+    svc = service_factory({
+        "spark.shuffle.tpu.compat.version": "v2",
+        "spark.shuffle.tpu.slo.read.p99Ms": "500"})
+    assert type(svc).__name__ == "ShuffleServiceV2"
+    v = svc.slo()
+    assert v["healthy"] and v["objectives"][0]["target"] == 0.99
+    assert "slo.read.p99Ms" in svc.slo("text")
+
+
+def _write_history_dir(tmp_path, frames):
+    d = tmp_path / "hist"
+    d.mkdir()
+    p = d / "history_1234.jsonl"
+    with open(p, "w") as f:
+        for fr in frames:
+            f.write(json.dumps(fr) + "\n")
+    return str(d)
+
+
+def test_cli_slo_replays_history_dir(tmp_path, capsys):
+    """A FRESH process grades a dead one's windows purely from
+    history.dir — restart durability through the CLI, objectives ride
+    the frames themselves."""
+    from sparkucx_tpu.__main__ import main as cli_main
+    obj = S.Objective(key="slo.read.p99Ms", kind="latency",
+                      threshold_ms=50.0, target=0.99)
+    t0 = 3_000_000.0
+    frames = [_frame(t0 + i * 60.0, waits=[5.0] * 6, seq=i,
+                     objectives=[obj]) for i in (1, 2)]
+    frames += [_frame(t0 + i * 60.0, waits=[500.0] * 6, seq=i,
+                      objectives=[obj]) for i in (3, 4)]
+    d = _write_history_dir(tmp_path, frames)
+    assert cli_main(["slo", "--input", d, "--format", "json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["frames"] == 4 and doc["fast_burn"] is True
+    # the CI gate shape: exit 3 on a fast burn
+    assert cli_main(["slo", "--input", d, "--fail-on", "fast"]) == 3
+    capsys.readouterr()
+    # the doctor replays the same dir (trend + slo rules fire offline)
+    assert cli_main(["doctor", "--input", d, "--format", "json"]) == 0
+    rules = {f["rule"] for f in json.loads(capsys.readouterr().out)}
+    assert "slo_burn" in rules
+
+
+def test_cli_slo_rejects_anchorless_history(tmp_path):
+    from sparkucx_tpu.__main__ import main as cli_main
+    f = _frame(4_000_000.0, waits=[5.0] * 4)
+    del f["anchor"]
+    d = _write_history_dir(tmp_path, [f])
+    with pytest.raises(ValueError, match="anchor"):
+        cli_main(["slo", "--input", d])
+
+
+def test_cli_slo_live_node_requires_node():
+    from sparkucx_tpu.__main__ import main as cli_main
+    from sparkucx_tpu.runtime.node import TpuNode
+    if TpuNode._instance is not None and not TpuNode._instance._closed:
+        pytest.skip("a live node is up in this process")
+    assert cli_main(["slo"]) == 2
+
+
+# -- dumper drives the roll --------------------------------------------------
+def test_tick_only_dumper_without_dump_dir(service_factory):
+    """History/SLO configured WITHOUT metrics.dumpDir still gets a
+    rolling cadence: the facade starts a tick-only PeriodicDumper
+    (out_dir=None — no snapshot file, just the heartbeat)."""
+    svc = service_factory({
+        "spark.shuffle.tpu.history.windowSecs": "0.05",
+        "spark.shuffle.tpu.slo.read.p99Ms": "500"})
+    assert svc._dumper is not None and svc._dumper.path is None
+    deadline = time.time() + 5.0
+    while not svc.node.history.frames() and time.time() < deadline:
+        time.sleep(0.05)
+    assert svc.node.history.frames(), \
+        "dumper cadence never rolled a history window"
+
+
+def test_dumper_off_without_history_or_dump_dir(service_factory):
+    svc = service_factory()
+    assert svc._dumper is None
+
+
+def test_dedupe_keeps_frames_when_postmortem_wins():
+    """A dump dir holds a process's metrics snapshot (frames embedded)
+    AND its newer flight postmortem (no frames): deduping to the
+    postmortem must not blind the trend/SLO rules — frames union
+    across the group like exchange reports do."""
+    from sparkucx_tpu.utils.export import dedupe_process_docs
+    fr = _frame(6_000_000.0, waits=[5.0] * 4)
+    snap = {"process_id": 0, "pid": 1, "ts": 100.0,
+            "history_frames": [fr],
+            "slo_objectives": [{"key": "slo.read.p99Ms",
+                                "kind": "latency"}]}
+    post = {"process_id": 0, "pid": 1, "ts": 200.0}
+    out = dedupe_process_docs([snap, post])
+    assert len(out) == 1 and out[0]["ts"] == 200.0
+    assert out[0]["history_frames"] == [fr]
+    assert out[0]["slo_objectives"] == snap["slo_objectives"]
+
+
+def test_dedupe_history_replay_never_wipes_registries():
+    """A replayed history JSONL whose last window rolled AFTER the last
+    metrics dump (dump_every>1, or death between dumps) groups with the
+    snapshot — the frame-only doc must not win 'best' and wipe the
+    process's cumulative counters/histograms from every doctor rule."""
+    from sparkucx_tpu.utils.export import dedupe_process_docs
+    from sparkucx_tpu.utils.history import frames_to_doc
+    fr = _frame(160.0, waits=[5.0] * 4)
+    fr["process_id"], fr["pid"] = 0, 1
+    snap = {"process_id": 0, "pid": 1, "ts": 100.0,
+            "counters": {"shuffle.read.count": 9.0},
+            "histograms": {}}
+    hist = frames_to_doc([fr], source="history_p0.jsonl")
+    assert hist["ts"] > snap["ts"]      # the hazard this test pins
+    out = dedupe_process_docs([snap, hist])
+    assert len(out) == 1
+    assert out[0]["counters"] == {"shuffle.read.count": 9.0}
+    assert out[0]["history_frames"] == [fr]
