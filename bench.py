@@ -4369,6 +4369,136 @@ def stage_tenancy(args) -> int:
     return 0 if out["ok"] else 2
 
 
+def analytics_measure(budget_mb=0.5, scale=1.0, seed=0):
+    """The external-memory analytics proof behind ``--stage
+    analytics``: terasort, groupby and the repartition join (the
+    Exoshuffle suite — the workloads the source system served) run at
+    ``10 × budget × scale`` bytes against a ``budget_mb`` pinned-pool
+    memory budget, through one node and a per-workload manager whose
+    spill/wave conf derives from the budget
+    (``workloads.workload_conf_overrides``, width-aware). Gates, per
+    workload:
+
+    * ``scale_10x`` — bytes_in ≥ 10× the budget (the external-memory
+      shape is structural, not an accident of defaults);
+    * ``spill_proven`` — spill bytes > 0 (staged bytes really sealed
+      through the SpillFiles path at this shape);
+    * ``oracle_exact`` — terasort's scalable oracle (monotonicity +
+      boundary carry + sampled splitmix64 multiset digest), groupby's
+      per-key-exact int32 aggregate, the join's exact output-row
+      count;
+    * ``zero_warm_recompiles`` — terasort rounds 2+ compile nothing,
+      groupby's warm re-read compiles nothing, the join's SECOND
+      shuffle compiles nothing (shared plan family / cap bucket / pack
+      executor);
+    * ``pool_within_budget`` — the pinned-pool byte watermark never
+      crossed the budget (the "Memory-efficient array redistribution"
+      constraint, graded);
+    * ``waved`` — terasort/groupby actually streamed (≥2 waves);
+    * per-phase rows/s present on every report (the rows/s contract).
+
+    CPU walls are context (the CI smoke grades structure); the rows/s
+    figures join the regress-diff baseline set like every other
+    artifact."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    from sparkucx_tpu.workloads import workload_conf_overrides
+    from sparkucx_tpu.workloads.groupby import groupby_pipeline
+    from sparkucx_tpu.workloads.join import join_pipeline
+    from sparkucx_tpu.workloads.terasort import terasort_pipeline
+
+    budget_bytes = int(budget_mb * (1 << 20))
+    out = {"budget_mb": budget_mb, "budget_bytes": budget_bytes,
+           "scale": scale}
+    # one node, one pool; each workload gets its own manager whose
+    # spill threshold / wave rows derive from the budget at ITS
+    # transport width (keys-only terasort vs 6-word groupby rows)
+    base_conf = TpuShuffleConf(
+        {"spark.shuffle.tpu.a2a.impl": "dense"}, use_env=False)
+    node = TpuNode.start(base_conf)
+    reports = {}
+    try:
+        specs = (
+            ("terasort", terasort_pipeline, 2,
+             dict(num_partitions=16, chunk_rows=16384)),
+            ("groupby", groupby_pipeline, 6,
+             dict(num_partitions=16, key_space=5000, chunk_rows=16384)),
+            ("join", join_pipeline, 4,
+             dict(num_partitions=16, key_space=5000, chunk_rows=16384)),
+        )
+        for name, pipeline, width, kw in specs:
+            cm = workload_conf_overrides(budget_bytes,
+                                         width_words=width)
+            cm["spark.shuffle.tpu.a2a.impl"] = "dense"
+            conf = TpuShuffleConf(cm, use_env=False)
+            mgr = TpuShuffleManager(node, conf)
+            try:
+                rep = pipeline(mgr, budget_bytes=budget_bytes,
+                               scale=scale, seed=seed, **kw)
+            finally:
+                mgr.stop()
+            reports[name] = rep.to_dict()
+    finally:
+        node.close()
+
+    gates = {}
+    for name, rep in reports.items():
+        gates[f"{name}_scale_10x"] = bool(rep["scale_ratio"] >= 10.0)
+        gates[f"{name}_spill_proven"] = bool(rep["spill_bytes"] > 0)
+        gates[f"{name}_oracle_exact"] = bool(rep["oracle_ok"])
+        gates[f"{name}_zero_warm_recompiles"] = \
+            bool(rep["warm_programs"] == 0)
+        gates[f"{name}_pool_within_budget"] = \
+            bool(rep["pool_peak_bytes"] <= budget_bytes)
+        gates[f"{name}_rows_per_s_per_phase"] = bool(
+            "total" in rep["rows_per_s"]
+            and all(rep["rows_per_s"].get(ph, 0) > 0
+                    for ph, ms in rep["phases"].items() if ms > 0))
+    gates["terasort_waved"] = bool(reports["terasort"]["waves"] >= 2)
+    gates["groupby_waved"] = bool(reports["groupby"]["waves"] >= 2)
+    gates["groupby_zero_d2h"] = bool(
+        reports["groupby"]["extra"]["d2h_bytes"] == 0)
+    gates["join_second_shuffle_compiles_nothing"] = bool(
+        reports["join"]["extra"]["probe_programs"] == 0)
+    out.update(workloads=reports, gates=gates,
+               ok=all(gates.values()),
+               rows_per_s={n: r["rows_per_s"].get("total", 0.0)
+                           for n, r in reports.items()})
+    return out
+
+
+def stage_analytics(args) -> int:
+    """``--stage analytics``: the external-memory analytics gate —
+    terasort/groupby/join at ≥10× the configured memory budget with
+    measured spill, oracle-exact results, rows/s per phase, 0 warm
+    recompiles and the pool watermark under budget. Artifact:
+    ``bench_runs/analytics.json``, committed as a CI regress baseline
+    like pipeline/ragged/wire/chaos; exit 2 on any gate failing.
+    ``--rows-log2`` scales the budget UP: budget_mb =
+    max(0.5, 2^(rows_log2-20)) MiB when given (default 0.5 MiB — the
+    CI smoke shape; the floor exists because below ~0.4 MiB the
+    a2a.waveRows floor makes the wave pack footprint itself outgrow
+    the budget)."""
+    budget_mb = max(0.5, 2.0 ** (args.rows_log2 - 20)) \
+        if args.rows_log2 else 0.5
+    out = {"metric": "analytics",
+           "detail": analytics_measure(budget_mb=budget_mb)}
+    out["ok"] = out["detail"]["ok"]
+    out["gates"] = out["detail"]["gates"]
+    out["telemetry"] = _telemetry_blob()
+    here = os.path.dirname(os.path.abspath(__file__))
+    artifact = os.path.join(here, "bench_runs", "analytics.json")
+    try:
+        os.makedirs(os.path.dirname(artifact), exist_ok=True)
+        _write_artifact(artifact, out)
+        out["artifact"] = os.path.relpath(artifact, here)
+    except OSError as e:
+        out["artifact_error"] = str(e)[:200]
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 2
+
+
 def slo_measure(rows_per_map=2048, maps=4, partitions=8, seed=0):
     """The SLO-plane proof behind ``--stage slo``, five legs:
 
@@ -4685,7 +4815,8 @@ def main() -> None:
                     choices=("coldstart", "obs-overhead", "regress",
                              "pipeline", "devplane", "ragged", "chaos",
                              "wire", "integrity", "devread",
-                             "devcombine", "tenancy", "hier", "slo"),
+                             "devcombine", "tenancy", "hier", "slo",
+                             "analytics"),
                     help="run ONE dedicated stage instead of the ladder: "
                          "coldstart = compile-cost artifact (persistent "
                          "cache cold-vs-warm across processes + "
@@ -4745,7 +4876,14 @@ def main() -> None:
                          "degrades /healthz, clears and re-accrues "
                          "budget; healthy arm quiet; evaluation <1% "
                          "of the exchange loop; 0 compiled programs; "
-                         "restart replay from history.dir agrees). "
+                         "restart replay from history.dir agrees); "
+                         "analytics = external-memory workload gate "
+                         "(terasort/groupby/join at >=10x the memory "
+                         "budget: spill bytes > 0, oracle-exact, "
+                         "rows/s per phase, 0 warm recompiles — "
+                         "terasort rounds 2+, groupby warm re-read "
+                         "and the join's second shuffle all compile "
+                         "nothing — pool watermark <= budget). "
                          "All CPU-measurable")
     ap.add_argument("--baseline", default=None,
                     help="regress stage: prior artifact to diff against "
@@ -4819,7 +4957,8 @@ def main() -> None:
                   "devcombine": stage_devcombine,
                   "tenancy": stage_tenancy,
                   "hier": stage_hier,
-                  "slo": stage_slo}[args.stage](args))
+                  "slo": stage_slo,
+                  "analytics": stage_analytics}[args.stage](args))
 
     if args.require_backend:
         # the fallback ladder EXISTS to swap backends silently — the

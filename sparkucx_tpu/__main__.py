@@ -50,6 +50,14 @@ Subcommands:
     Objectives ride the frames/dumps themselves, so a replay needs no
     conf. Anchor-checked like stats/trace/timeline. ``--fail-on
     fast|slow`` exits 3 on a burn of that speed — the CI gate shape.
+
+``workload <name> [--scale S] [--budget-mb N] [--seed K] [--arrow]``
+    Run one registered analytics pipeline (workloads/ registry:
+    terasort | groupby | join) end to end — external-memory, data
+    ``10 × budget × scale`` bytes streamed through the spill/wave
+    planes — and print its WorkloadReport as JSON (per-phase walls,
+    rows/s, spill evidence, pool peak vs budget, oracle verdict).
+    Exit 4 when the oracle failed.
 """
 
 from __future__ import annotations
@@ -326,6 +334,29 @@ def _verdict_from_docs(docs) -> dict:
                              view.slo_policy))
 
 
+def _cmd_workload(args) -> int:
+    from sparkucx_tpu.workloads import WORKLOADS, run_workload
+    if args.name not in WORKLOADS:
+        print(f"unknown workload {args.name!r}; registered: "
+              f"{', '.join(sorted(WORKLOADS.keys()))}", file=sys.stderr)
+        return 2
+    overrides = {}
+    for kv in args.conf or []:
+        if "=" not in kv:
+            print(f"--conf wants key=value, got {kv!r}", file=sys.stderr)
+            return 2
+        k, v = kv.split("=", 1)
+        overrides[k] = v
+    kwargs = {}
+    if args.arrow:
+        kwargs["arrow"] = True
+    rep = run_workload(args.name, budget_mb=args.budget_mb,
+                       scale=args.scale, seed=args.seed,
+                       conf_overrides=overrides, **kwargs)
+    print(rep.to_json())
+    return 0 if rep.oracle_ok else 4
+
+
 def _cmd_keys(args) -> int:
     from sparkucx_tpu.config import _print_key_table
     _print_key_table()
@@ -402,7 +433,32 @@ def main(argv=None) -> int:
                        help="exit 3 when a burn of this speed (slow "
                             "implies fast too) is in progress (CI "
                             "gate)")
+    p_wl = sub.add_parser(
+        "workload",
+        help="run one registered analytics pipeline (terasort | "
+             "groupby | join) external-memory and print its "
+             "WorkloadReport JSON")
+    p_wl.add_argument("name",
+                      help="registry name (workloads.WORKLOADS)")
+    p_wl.add_argument("--budget-mb", type=float, default=16.0,
+                      help="pinned-pool memory budget in MiB; the "
+                           "dataset is 10 x budget x scale bytes "
+                           "(default 16)")
+    p_wl.add_argument("--scale", type=float, default=1.0,
+                      help="dataset multiplier over the 10x-budget "
+                           "baseline (default 1.0)")
+    p_wl.add_argument("--seed", type=int, default=0)
+    p_wl.add_argument("--arrow", action="store_true",
+                      help="route ingest/egress through the Arrow "
+                           "columnar path (io/arrow.py) where the "
+                           "workload supports it")
+    p_wl.add_argument("--conf", nargs="*", default=None,
+                      metavar="KEY=VALUE",
+                      help="extra spark.shuffle.tpu.* conf overrides "
+                           "(e.g. a2a.impl pins, workload.budgetMb)")
     args = ap.parse_args(argv)
+    if args.cmd == "workload":
+        return _cmd_workload(args)
     if args.cmd == "stats":
         return _cmd_stats(args)
     if args.cmd == "trace":

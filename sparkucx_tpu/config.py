@@ -201,6 +201,13 @@ class TpuShuffleConf:
         "failure.backoffMs": "backoff between failure-recovery attempts",
         "fault.*": "deterministic fault injection: fault.seed + per-site "
                    "arming keys (runtime/failures.FaultInjector)",
+        "workload.*": "analytics workload plane (workloads/ registry, "
+                      "`python -m sparkucx_tpu workload <name>`): "
+                      "workload.budgetMb (pinned-pool memory budget; "
+                      "the dataset is 10 x budget x scale bytes), "
+                      "workload.scale — consumed by "
+                      "workloads.run_workload, which derives "
+                      "spill.threshold + a2a.waveRows from the budget",
     }
     _EXTERNAL_KEYS = tuple(k for k in _EXTERNAL_KEY_DOCS
                            if not k.endswith("*"))

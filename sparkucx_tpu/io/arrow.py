@@ -239,6 +239,38 @@ def kv_to_batch(keys: np.ndarray, values: Optional[np.ndarray],
     return pa.RecordBatch.from_arrays(arrays, names=names)
 
 
+def stage_batches(writer, batches: Sequence["pa.RecordBatch"],
+                  key_column: str, string_max_bytes: int = 64,
+                  recipe: Optional[List] = None,
+                  names: Optional[List[str]] = None,
+                  ) -> Tuple[Optional[List], Optional[List[str]], int]:
+    """Stage Arrow batches into an open map writer WITHOUT committing —
+    the chunked-ingest seam (external-memory workloads stream batch
+    chunks through here between budget-valve spills; ``write_batches``
+    composes it with the commit/recipe-publish contract). Returns the
+    running ``(recipe, names, rows_staged)``; pass the previous call's
+    recipe/names back in so schema drift across chunks fails loudly
+    exactly like drift within one call."""
+    _require_arrow()
+    rows = 0
+    for b in batches:
+        keys, values, dtypes = batch_to_kv(b, key_column,
+                                           string_max_bytes)
+        if not keys.shape[0]:
+            continue
+        bnames = [f for f in b.schema.names if f != key_column]
+        if recipe is None:
+            recipe, names = dtypes, bnames
+        elif dtypes != recipe or bnames != names:
+            raise ValueError(
+                f"batch schema mismatch within map {writer.map_id}: "
+                f"{list(zip(bnames, dtypes))} vs "
+                f"{list(zip(names, recipe))}")
+        writer.write(keys, values)
+        rows += keys.shape[0]
+    return recipe, names, rows
+
+
 def write_batches(manager, handle, map_id: int,
                   batches: Sequence["pa.RecordBatch"], key_column: str,
                   num_partitions: Optional[int] = None,
@@ -250,21 +282,8 @@ def write_batches(manager, handle, map_id: int,
     pass the same value or the recipe check fails loudly)."""
     _require_arrow()
     w = manager.get_writer(handle, map_id)
-    recipe: Optional[List] = None
-    names: Optional[List[str]] = None
-    for b in batches:
-        keys, values, dtypes = batch_to_kv(b, key_column,
-                                           string_max_bytes)
-        if not keys.shape[0]:
-            continue
-        bnames = [f for f in b.schema.names if f != key_column]
-        if recipe is None:
-            recipe, names = dtypes, bnames
-        elif dtypes != recipe or bnames != names:
-            raise ValueError(
-                f"batch schema mismatch within map {map_id}: "
-                f"{list(zip(bnames, dtypes))} vs {list(zip(names, recipe))}")
-        w.write(keys, values)
+    recipe, names, _ = stage_batches(w, batches, key_column,
+                                     string_max_bytes)
     # Recipe checks must precede commit: once committed, the output is
     # published to the metadata plane and a blocked reader may decode it —
     # a mismatch found later would already be a silent bit

@@ -716,6 +716,17 @@ class ShuffleReaderResult:
         result everything is already 'arrived': index order."""
         yield from self.partitions()
 
+    def release_partition(self, r: int) -> None:
+        """Drop partition r's cached dense block (and, on a waved
+        result, its cached cross-wave merge) — the STREAMING-EMIT seam:
+        an external-memory consumer that walks partitions in order and
+        releases each behind itself keeps its copied-block footprint at
+        one partition instead of accumulating the whole dataset in the
+        cache (the workloads' join/terasort emit discipline). Safe to
+        call for never-fetched or single-run partitions (no-op); a
+        later ``partition(r)`` simply rebuilds the block."""
+        self._block_cache.pop(r, None)
+
 
 class LazyShuffleReaderResult(ShuffleReaderResult):
     """Result view over ON-DEVICE arrays with per-shard streaming D2H.
@@ -1383,6 +1394,17 @@ class WavedShuffleReaderResult(ShuffleReaderResult):
             block = _concat_blocks(blocks)
         self._block_cache[r] = block
         return block
+
+    def release_partition(self, r: int) -> None:
+        """The streaming-emit seam on a waved result must release the
+        per-WAVE cached blocks too: the cross-wave merge above pulls
+        ``w._partition_block(r, shard)`` from every wave, and each wave
+        caches its own multi-run concatenation — dropping only the
+        top-level merge would leave W copies of the partition resident
+        and the consumer's footprint would grow with the dataset."""
+        super().release_partition(r)
+        for w in self._waves:
+            w.release_partition(r)
 
 
 class DeviceShuffleReaderResult:

@@ -284,6 +284,18 @@ class Thresholds:
     trend_min_ms: float = 5.0
     trend_ratio: float = 3.0
     trend_critical_ratio: float = 10.0
+    # spill_bound: an analytics workload's wall is dominated by spill
+    # I/O instead of the exchange/merge planes it exists to exercise
+    # (workload.phase.ms{workload,phase} counters from workloads/
+    # PhaseWalls). Shares are over the spill+exchange+merge triple —
+    # ingest/emit are generation/verification and say nothing about
+    # the engine. Floors per the PR-5 discipline: a real wall and real
+    # rows before any share can fire; exchange-dominant is the healthy
+    # shape and stays quiet.
+    spill_share_warn: float = 0.4
+    spill_share_critical: float = 0.7
+    spill_min_wall_ms: float = 500.0
+    spill_min_rows: float = 1000.0
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -1540,6 +1552,76 @@ def _rule_latency_trend(view: ClusterView,
                      "name the culprit"))]
 
 
+def _rule_spill_bound(view: ClusterView,
+                      th: Thresholds) -> List[Finding]:
+    """An analytics workload (workloads/ pipelines) spent the dominant
+    share of its engine wall in SPILL I/O — sealing staged bytes to
+    disk and reading them back — rather than in the exchange or merge
+    planes. Attribution comes from the per-phase walls the pipelines
+    publish (``workload.phase.ms{workload=,phase=}``): shares are
+    computed over the spill/exchange/merge triple (ingest/emit are
+    workload-side generation/verification), per workload label.
+    Exchange-dominant is the healthy posture for a shuffle engine and
+    stays quiet; a spill-bound workload means the configured memory
+    budget (or the disk under ``spill.dir``) is the bottleneck — raise
+    the budget (bigger ``spill.threshold``, fewer forced spills), point
+    ``spill.dir`` at faster storage, or accept the external-memory
+    price. Floors: real wall + real rows before any share fires."""
+    from sparkucx_tpu.utils.metrics import (C_WORKLOAD_PHASE_MS,
+                                            C_WORKLOAD_ROWS)
+    # {workload: {phase: ms}} from the labeled counter family
+    by_wl: Dict[str, Dict[str, float]] = {}
+    for name, v in view.counters.items():
+        base, labels = parse_labeled(name)
+        if base != C_WORKLOAD_PHASE_MS or not labels:
+            continue
+        wl, ph = labels.get("workload"), labels.get("phase")
+        if not wl or not ph:
+            continue
+        by_wl.setdefault(wl, {})[ph] = \
+            by_wl.get(wl, {}).get(ph, 0.0) + float(v)
+    rows_by_wl = _labeled_series(view.counters, C_WORKLOAD_ROWS,
+                                 "workload")
+    out: List[Finding] = []
+    for wl, phases in sorted(by_wl.items()):
+        engine = {ph: phases.get(ph, 0.0)
+                  for ph in ("spill", "exchange", "merge")}
+        engine_ms = sum(engine.values())
+        rows = float(rows_by_wl.get(wl, 0.0))
+        if engine_ms < th.spill_min_wall_ms \
+                or rows < th.spill_min_rows:
+            continue                       # sub-noise workload
+        share = engine[("spill")] / engine_ms
+        if share < th.spill_share_warn:
+            continue                       # exchange/merge-bound: healthy
+        spill_bytes = float(view.counters.get(
+            "shuffle.spill.bytes", 0.0))
+        out.append(Finding(
+            rule="spill_bound",
+            grade="critical" if share >= th.spill_share_critical
+            else "warn",
+            summary=(f"workload {wl!r} is spill-bound: {share:.0%} of "
+                     f"its engine wall ({engine_ms:.0f} ms across "
+                     f"spill/exchange/merge) went to spill I/O — the "
+                     f"memory budget, not the exchange, is the "
+                     f"bottleneck"),
+            evidence={"workload": wl,
+                      "spill_share": round(share, 3),
+                      "phase_ms": {ph: round(ms, 1)
+                                   for ph, ms in phases.items()},
+                      "rows": int(rows),
+                      "spill_bytes": int(spill_bytes)},
+            conf_key="spark.shuffle.tpu.spill.threshold",
+            remediation=("raise the workload memory budget (the "
+                         "pipelines derive spill.threshold and "
+                         "a2a.waveRows from it — fewer forced spills "
+                         "per ingest), point spill.dir at faster "
+                         "storage, or shrink the dataset per round; "
+                         "if exchange_ms is also near zero the run "
+                         "never exercised the engine at all")))
+    return out
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
@@ -1547,7 +1629,7 @@ _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_wire_dequant, _rule_peer_timeout, _rule_replay_storm,
           _rule_block_corruption, _rule_host_roundtrip,
           _rule_sink_fallback, _rule_quota_starvation, _rule_slow_tier,
-          _rule_slo_burn, _rule_latency_trend)
+          _rule_slo_burn, _rule_latency_trend, _rule_spill_bound)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

@@ -143,6 +143,23 @@ C_SUBMIT_THROTTLED = "shuffle.submit.throttled.count"
 # point-in-time admission reservation per tenant (set-semantics gauge)
 G_TENANT_INFLIGHT = "shuffle.inflight.bytes"
 
+# External-memory analytics plane (workloads/, bench --stage analytics):
+# ONE place for the names so the pipelines, the doctor's spill_bound
+# rule and the tests cannot drift. C_SPILL_BYTES accumulates bytes the
+# map writers moved from the pinned arena to sealed spill files
+# (shuffle/writer.py _flush_to_disk — threshold-triggered AND
+# budget-forced spills both land here; the "spill proven" gate is this
+# counter's delta > 0 at the scale shape). C_WORKLOAD_ROWS counts rows
+# a workload pipeline emitted/verified; C_WORKLOAD_PHASE_MS accumulates
+# per-phase walls — both carry labeled twins
+# {workload="terasort|groupby|join", phase="ingest|spill|exchange|
+# merge|emit"} which are what the spill_bound rule attributes a
+# workload's wall with.
+C_SPILL_BYTES = "shuffle.spill.bytes"
+C_SPILL_COUNT = "shuffle.spill.count"
+C_WORKLOAD_ROWS = "workload.rows"
+C_WORKLOAD_PHASE_MS = "workload.phase.ms"
+
 # Device-memory gauge families (runtime/devmon.py sampler; per local
 # device index, encoded as a label via :func:`labeled`): ONE place for
 # the names so the sampler, the doctor's hbm_pressure rule and the

@@ -167,3 +167,240 @@ def run_groupby_device(manager: TpuShuffleManager, *,
                 "value_sum": value_sum, "d2h_bytes": int(d2h)}
     finally:
         manager.unregister_shuffle(shuffle_id)
+
+
+def make_device_groupby_int_step(mesh, axis: str, cap: int, width: int,
+                                 value_width: int):
+    """The int32 twin of :func:`make_device_groupby_step` for the
+    external-memory pipeline: the combined transport words ARE the int32
+    value lanes (no bitcast), so the per-shard aggregate — valid-row
+    count + lane sum — is EXACT integer arithmetic, which is what lets
+    the scale gate demand oracle-exact sums instead of an f32 drift
+    bound."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from sparkucx_tpu.utils import jaxcompat as _jaxcompat  # noqa: F401
+
+    def body(rows, nv):
+        valid = jnp.arange(cap, dtype=jnp.int32) < nv[0]
+        vals = rows[:, 2:2 + value_width]
+        s = jnp.where(valid[:, None], vals, 0).sum()
+        return nv[0].reshape(1), s.reshape(1)
+
+    sm = jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P(axis)),
+                       out_specs=(P(axis), P(axis)), check_vma=False)
+    return jax.jit(sm, donate_argnums=(0,))
+
+
+def groupby_pipeline(manager: TpuShuffleManager, *,
+                     budget_bytes: int, scale: float = 1.0,
+                     total_rows: Optional[int] = None,
+                     num_mappers: int = 8, num_partitions: int = 32,
+                     key_space: int = 20000, value_width: int = 4,
+                     shuffle_id: int = 9300, seed: int = 0,
+                     sink: str = "device", warm_reads: int = 1,
+                     chunk_rows: int = 65536,
+                     arrow: bool = False):
+    """External-memory groupby-aggregate — Exoshuffle's flagship
+    library-level-shuffle workload at ≥10×-budget scale:
+
+    * chunked ingest of (key, int32 value lanes) pairs with the
+      pool-watermark force-spill valve sealing staged bytes through
+      ``SpillFiles`` (``arrow=True`` routes every chunk through the
+      Arrow ingress — ``io/arrow.stage_batches`` on the native int32
+      carrier);
+    * ONE waved exchange with ``combine="sum"``: per-wave combined runs
+      fold through the PR-12 compiled device merge, landing one
+      key-sorted row per distinct key ON DEVICE — the input streams
+      through waves, HBM holds only the aggregate, and the consumer
+      path moves ZERO payload bytes D2H (``sink="host"`` is the
+      verification arm: per-key exact compare against the host
+      oracle);
+    * ``warm_reads`` repeat exchanges gate 0 warm recompiles.
+
+    The oracle is O(key_space): per-key int64 count/sum accumulators
+    folded during ingest — exact, never the dataset. Returns a
+    :class:`~sparkucx_tpu.workloads.WorkloadReport`."""
+    import jax
+
+    from sparkucx_tpu.utils.metrics import C_D2H, GLOBAL_METRICS
+    from sparkucx_tpu.workloads import (MemoryBudget, PhaseWalls,
+                                        WorkloadReport, _program_count,
+                                        _spill_counters)
+
+    pool = manager.node.pool
+    row_bytes = 8 + 4 * value_width
+    if total_rows is None:
+        total_rows = max(num_mappers * num_partitions,
+                         int(10.0 * scale * budget_bytes) // row_bytes)
+    rep = WorkloadReport("groupby", rows_in=total_rows,
+                         bytes_in=total_rows * row_bytes,
+                         budget_bytes=budget_bytes,
+                         backend=jax.default_backend(), oracle="exact")
+    walls = PhaseWalls("groupby", manager.node.metrics)
+    budget = MemoryBudget(pool, budget_bytes)
+    pool.reset_peak_bytes()
+    spill_b0, spill_c0 = _spill_counters()
+    prog0 = _program_count()
+
+    rng = np.random.default_rng(seed)
+    # O(key_space) exact oracle accumulators — the aggregate output is
+    # inherently key_space-bounded, so holding ITS oracle in memory is
+    # legitimate where holding the input would not be
+    truth_count = np.zeros(key_space, dtype=np.int64)
+    truth_vsum = np.zeros(key_space, dtype=np.int64)   # per-key lane sum
+    truth_sum = np.int64(0)
+
+    h = manager.register_shuffle(shuffle_id, num_mappers, num_partitions)
+    writers = [manager.get_writer(h, m) for m in range(num_mappers)]
+    try:
+        with walls.phase("ingest"):
+            per_map = total_rows // num_mappers
+            # threaded across EVERY chunk of every mapper so schema
+            # drift between chunks fails loudly (stage_batches'
+            # contract); one ingest = one schema
+            arrow_recipe = arrow_names = None
+            for m in range(num_mappers):
+                m_rows = per_map if m < num_mappers - 1 else \
+                    total_rows - per_map * (num_mappers - 1)
+                for c0 in range(0, m_rows, chunk_rows):
+                    n = min(chunk_rows, m_rows - c0)
+                    keys = rng.integers(0, key_space,
+                                        size=n).astype(np.int64)
+                    # small magnitudes: the int32 device sums stay
+                    # exact (bounded well inside 2^31 at any shape the
+                    # harnesses run)
+                    vals = rng.integers(0, 4, size=(n, value_width)
+                                        ).astype(np.int32)
+                    np.add.at(truth_count, keys, 1)
+                    np.add.at(truth_vsum, keys,
+                              vals.sum(axis=1, dtype=np.int64))
+                    truth_sum += vals.sum(dtype=np.int64)
+                    if arrow:
+                        from sparkucx_tpu.io.arrow import (kv_to_batch,
+                                                           stage_batches)
+                        batch = kv_to_batch(
+                            keys, vals, key_column="key",
+                            value_columns=[f"v{i}" for i in
+                                           range(value_width)])
+                        arrow_recipe, arrow_names, _ = stage_batches(
+                            writers[m], [batch], "key",
+                            recipe=arrow_recipe, names=arrow_names)
+                    else:
+                        writers[m].write(keys, vals)
+                    with walls.phase("spill"):
+                        budget.maybe_spill(writers)
+            for w in writers:
+                w.commit(num_partitions)
+
+        truth_distinct = int((truth_count > 0).sum())
+        d2h_delta = 0
+        distinct = value_sum = None
+        reads = 1 + max(0, int(warm_reads))
+        warm_mark = None
+        waves = replays = 0
+        # one consumer program per (cap, width) across the warm
+        # re-reads: a fresh make_device_groupby_int_step per read is a
+        # fresh jax.jit function identity, so every warm read would
+        # silently re-trace+recompile an identical program outside the
+        # stepcache the warm_programs gate watches (the moe._forward_fn
+        # lesson)
+        int_steps: dict = {}
+        for i in range(reads):
+            with walls.phase("exchange"):
+                res = manager.read(h, combine="sum", sink=sink)
+            rrep = manager.report(shuffle_id)
+            if rrep is not None:
+                waves = max(waves, int(rrep.waves or 0))
+                replays += int(rrep.replays or 0)
+                # the device fold's wall is timed INSIDE the read
+                # (blocked) — re-attribute it from exchange to merge
+                if rrep.merge_ms:
+                    walls.ms["exchange"] = max(
+                        0.0, walls.ms["exchange"] - rrep.merge_ms)
+                    walls.add("merge", rrep.merge_ms)
+            with walls.phase("emit"):
+                if sink == "device":
+                    d0 = GLOBAL_METRICS.get(C_D2H)
+                    rows_dev = res.device_rows()
+                    cap = rows_dev.shape[0] // manager.node.num_devices
+                    skey = (cap, rows_dev.shape[1])
+                    step = int_steps.get(skey)
+                    if step is None:
+                        step = int_steps[skey] = \
+                            make_device_groupby_int_step(
+                                manager.exchange_mesh, manager.axis,
+                                cap, rows_dev.shape[1], value_width)
+
+                    def fold(carry, rows, nv):
+                        c, s = step(rows, nv)
+                        return (c, s) if carry is None \
+                            else (carry[0] + c, carry[1] + s)
+
+                    counts, sums = res.consume(fold)
+                    jax.block_until_ready(sums)
+                    d2h_delta += int(GLOBAL_METRICS.get(C_D2H) - d0)
+                    distinct = int(np.asarray(counts).sum())
+                    value_sum = int(np.asarray(sums,
+                                               dtype=np.int64).sum())
+                else:
+                    # host arm: per-key EXACT verification against the
+                    # oracle accumulators (the tier-1 tests' leg)
+                    distinct = 0
+                    value_sum = 0
+                    for r, (k, v) in res.partitions():
+                        if not k.shape[0]:
+                            continue
+                        distinct += k.shape[0]
+                        value_sum += int(v.sum(dtype=np.int64))
+                        if (truth_count[k] <= 0).any():
+                            raise AssertionError(
+                                "combined key never ingested")
+                        # per-key EXACT lane-sum check against the
+                        # O(key_space) oracle accumulator
+                        got_rows = v.astype(np.int64).sum(axis=1)
+                        if not np.array_equal(got_rows, truth_vsum[k]):
+                            raise AssertionError(
+                                f"partition {r}: per-key sums diverge "
+                                f"from the ingest oracle")
+            if i == 0:
+                warm_mark = _program_count()
+        rep.warm_programs = _program_count() - (
+            warm_mark if warm_mark is not None else prog0)
+        rep.exchanges = reads
+        rep.waves = waves
+        rep.replays = replays
+
+        rep.oracle_ok = bool(distinct == truth_distinct
+                             and value_sum == int(truth_sum))
+        if sink == "device" and d2h_delta != 0:
+            rep.oracle_ok = False
+        rep.rows_out = int(distinct or 0)
+        rep.extra = {
+            "distinct_keys": int(distinct or 0),
+            "truth_distinct": truth_distinct,
+            "value_sum": int(value_sum or 0),
+            "truth_sum": int(truth_sum),
+            "d2h_bytes": d2h_delta, "sink": sink,
+            "key_space": key_space, "value_width": value_width,
+            "num_mappers": num_mappers,
+            "num_partitions": num_partitions,
+            "forced_spills": budget.forced_spills,
+            "forced_spill_bytes": budget.forced_bytes,
+            "arrow_ingress": bool(arrow),
+        }
+    finally:
+        manager.unregister_shuffle(shuffle_id)
+
+    walls.ms["ingest"] = max(0.0, walls.ms["ingest"] - walls.ms["spill"])
+    spill_b1, spill_c1 = _spill_counters()
+    rep.spill_bytes = spill_b1 - spill_b0
+    rep.spill_count = spill_c1 - spill_c0
+    rep.pool_peak_bytes = int(pool.stats().get("peak_bytes", 0))
+    rep.programs = _program_count() - prog0
+    rep.phases = dict(walls.ms)
+    rep.finalize(total_rows)
+    walls.publish(total_rows)
+    return rep

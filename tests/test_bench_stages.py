@@ -478,3 +478,24 @@ def test_slo_measure_smoke(mesh8):
     assert rec["burn"]["fired_within_windows"] <= 2
     assert rec["programs_delta"] == 0
     assert rec["disk_frames"] <= rec["shape"]["retain_windows"]
+
+
+# slow-marked for the tier-1 budget: the analytics contract is a
+# dedicated ci.yml gate (bench --stage analytics) at this same shape,
+# and the pipelines' units/legs run in-tier in tests/test_workloads.py
+@pytest.mark.slow
+def test_analytics_measure_smoke(mesh8):
+    """The analytics stage's measurement core at the CI smoke budget:
+    all three external-memory pipelines gate green — ≥10× budget with
+    spill proven, oracle-exact, 0 warm recompiles (terasort rounds 2+,
+    groupby warm re-read, the join's second shuffle), pool watermark
+    under budget, rows/s per phase on every report."""
+    # the stage's own default budget: below ~0.4 MiB the a2a.waveRows
+    # floor (1024 rows) makes the wave pack footprint itself outgrow
+    # the budget — the derived-conf formula needs this much room
+    rec = bench.analytics_measure(budget_mb=0.5)
+    for gate, okay in rec["gates"].items():
+        assert okay, (gate, rec["gates"])
+    assert set(rec["workloads"]) == {"terasort", "groupby", "join"}
+    for name, rep in rec["workloads"].items():
+        assert rep["rows_per_s"]["total"] > 0, name

@@ -1530,3 +1530,67 @@ def test_build_view_folds_frames_and_objectives_across_processes():
             for o in view.slo_objectives}
     assert keys == {("slo.read.p99Ms", ""), ("slo.read.p99Ms", "t2")}
     assert view.slo_policy["fast_window_s"] == 120.0
+
+
+# -- spill_bound (analytics workload plane, ISSUE-15) ----------------------
+def _workload_counters(doc, wl, spill_ms, exchange_ms, merge_ms,
+                       rows=50000.0, ingest_ms=100.0):
+    c = doc["counters"]
+    c[f'workload.rows{{workload="{wl}"}}'] = rows
+    c["workload.rows"] = c.get("workload.rows", 0.0) + rows
+    for ph, ms in (("ingest", ingest_ms), ("spill", spill_ms),
+                   ("exchange", exchange_ms), ("merge", merge_ms)):
+        c[f'workload.phase.ms{{workload="{wl}",phase="{ph}"}}'] = ms
+    c["shuffle.spill.bytes"] = 8e6
+
+
+def test_spill_bound_fires_and_names_workload():
+    doc = _healthy_doc()
+    _workload_counters(doc, "terasort", spill_ms=3000.0,
+                       exchange_ms=1500.0, merge_ms=500.0)
+    fs = [f for f in diagnose(doc) if f.rule == "spill_bound"]
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.grade == "warn"
+    assert "terasort" in f.summary and "spill-bound" in f.summary
+    assert f.conf_key == "spark.shuffle.tpu.spill.threshold"
+    assert f.evidence["workload"] == "terasort"
+    assert 0.55 < f.evidence["spill_share"] < 0.65
+    # attribution carries every phase wall, ingest included
+    assert f.evidence["phase_ms"]["exchange"] == 1500.0
+
+
+def test_spill_bound_critical_on_extreme_share():
+    doc = _healthy_doc()
+    _workload_counters(doc, "join", spill_ms=9000.0,
+                       exchange_ms=600.0, merge_ms=400.0)
+    fs = [f for f in diagnose(doc) if f.rule == "spill_bound"]
+    assert fs and fs[0].grade == "critical"
+    assert fs[0].evidence["spill_share"] >= 0.7
+
+
+def test_spill_bound_quiet_when_exchange_dominates():
+    # the healthy analytics posture: the engine, not the disk, owns the
+    # wall — and a doc with no workload counters at all is quiet too
+    assert [f for f in diagnose(_healthy_doc())
+            if f.rule == "spill_bound"] == []
+    doc = _healthy_doc()
+    _workload_counters(doc, "groupby", spill_ms=300.0,
+                       exchange_ms=4000.0, merge_ms=2000.0)
+    assert [f for f in diagnose(doc)
+            if f.rule == "spill_bound"] == []
+
+
+def test_spill_bound_sub_noise_floors():
+    # spill-dominant but under the wall floor: tiny test runs never fire
+    doc = _healthy_doc()
+    _workload_counters(doc, "terasort", spill_ms=200.0,
+                       exchange_ms=50.0, merge_ms=30.0)
+    assert [f for f in diagnose(doc)
+            if f.rule == "spill_bound"] == []
+    # real wall but under the row floor (a few hundred rows of smoke)
+    doc2 = _healthy_doc()
+    _workload_counters(doc2, "terasort", spill_ms=3000.0,
+                       exchange_ms=500.0, merge_ms=100.0, rows=200.0)
+    assert [f for f in diagnose(doc2)
+            if f.rule == "spill_bound"] == []

@@ -168,3 +168,327 @@ def test_q23_empty_frequent_set_guard(manager):
     from sparkucx_tpu.workloads.q23 import run_q23
     with pytest.raises(AssertionError, match="degenerate"):
         run_q23(manager, shuffle_id=9310, frequency_threshold=10_000_000)
+
+
+# -- external-memory analytics plane (ISSUE-15) ----------------------------
+def _wl_manager(manager, extra=None):
+    """Fresh-conf manager over the shared node (the waved-combiner test's
+    pattern): the workload planes — spill threshold, wave rows — are
+    manager conf."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    cm = {"spark.shuffle.tpu.a2a.impl": "dense",
+          "spark.shuffle.tpu.spill.threshold": "8192",
+          "spark.shuffle.tpu.a2a.waveRows": "1024",
+          "spark.shuffle.tpu.a2a.waveDepth": "2"}
+    cm.update(extra or {})
+    return TpuShuffleManager(manager.node,
+                             TpuShuffleConf(cm, use_env=False))
+
+
+def test_reservoir_sampler_streams_bounds():
+    """Streaming Algorithm R: the reservoir never exceeds capacity, sees
+    every row, and its quantile bounds land near the true quantiles of
+    the stream — the RangePartitioner sketch without the O(N) host
+    concatenate."""
+    from sparkucx_tpu.ops.partition import ReservoirSampler
+    rng = np.random.default_rng(7)
+    sampler = ReservoirSampler(capacity=2048, seed=1)
+    total = 0
+    for _ in range(40):
+        n = int(rng.integers(500, 4000))
+        sampler.add(rng.integers(0, 1 << 40, size=n).astype(np.int64))
+        total += n
+    assert sampler.seen == total
+    assert sampler.sample().shape[0] == 2048
+    b = sampler.bounds(16)
+    assert b.shape == (15,) and (np.diff(b) >= 0).all()
+    # uniform stream: split points within a few percent of ideal
+    ideal = np.linspace(0, 1 << 40, 17)[1:-1]
+    assert np.abs(b - ideal).max() < (1 << 40) * 0.08
+
+
+def test_merge_sorted_runs_is_external_and_exact():
+    """The k-way merge streams bounded chunks whose concatenation equals
+    one big sort — duplicates, empty runs and uneven lengths included."""
+    from sparkucx_tpu.workloads.terasort import merge_sorted_runs
+    rng = np.random.default_rng(3)
+    runs = [np.sort(rng.integers(0, 500, size=n).astype(np.int64))
+            for n in (0, 1, 700, 1300, 64, 2500)]
+    chunks = list(merge_sorted_runs(runs, chunk_rows=128))
+    got = np.concatenate(chunks) if chunks else np.zeros(0, np.int64)
+    want = np.sort(np.concatenate(runs))
+    assert np.array_equal(got, want)
+    # bounded window: no emitted chunk dwarfs k x chunk_rows
+    assert max(c.shape[0] for c in chunks) <= len(runs) * 128 + 500
+
+
+def test_run_store_sealed_roundtrip(tmp_path):
+    """RunStore rides the SpillFiles seal: runs appended per round come
+    back as mmapped views split exactly at the recorded run lengths."""
+    from sparkucx_tpu.workloads.terasort import RunStore
+    store = RunStore(str(tmp_path), num_partitions=3, store_id=7)
+    a = np.sort(np.arange(10, dtype=np.int64) * 3)
+    b = np.sort(np.arange(5, dtype=np.int64) * 7)
+    store.append_run(0, a)
+    store.append_run(0, b)
+    store.append_run(2, b)
+    store.append_run(1, np.zeros(0, np.int64))   # dropped
+    store.seal()
+    runs0 = store.runs(0)
+    assert len(runs0) == 2
+    assert np.array_equal(runs0[0], a) and np.array_equal(runs0[1], b)
+    assert store.runs(1) == []
+    assert store.rows(2) == 5
+    store.close()
+
+
+def test_sampled_key_digest_order_and_split_invariant():
+    """The scalable oracle's digest leg: value-based sampling + mod-2^64
+    sums make the digest invariant under any reorder or re-chunking of
+    the stream — exactly what survives a shuffle."""
+    from sparkucx_tpu.workloads import sampled_key_digest
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, 1 << 60, size=5000).astype(np.int64)
+    d_all, n_all = sampled_key_digest(keys, stride=4)
+    perm = rng.permutation(keys)
+    d_perm, n_perm = sampled_key_digest(perm, stride=4)
+    assert (d_all, n_all) == (d_perm, n_perm)
+    d_split = 0
+    n_split = 0
+    for part in np.array_split(perm, 7):
+        d, n = sampled_key_digest(part, stride=4)
+        d_split = (d_split + d) & 0xFFFFFFFFFFFFFFFF
+        n_split += n
+    assert (d_split, n_split) == (d_all, n_all)
+    assert 0 < n_all < keys.shape[0]
+
+
+def test_terasort_external_spill_rounds_exact(manager):
+    """The external-memory terasort at a tiny forced budget: multiple
+    rounds through the sealed-run store, real spill (threshold +
+    budget valve), waved ordered reads, k-way merge — vs the EXACT
+    oracle (below the small-row threshold), with rounds 2+ compiling
+    nothing."""
+    from sparkucx_tpu.workloads.terasort import terasort_pipeline
+    # waveRows under the per-shard round slice so the ordered reads are
+    # genuinely waved (round = 4096 rows over 8 shards)
+    m = _wl_manager(manager, {"spark.shuffle.tpu.a2a.waveRows": "256"})
+    try:
+        rep = terasort_pipeline(m, budget_bytes=64 << 10,
+                                total_rows=16384, num_partitions=8,
+                                chunk_rows=2048, shuffle_id=9500)
+    finally:
+        m.stop()
+    assert rep.oracle == "exact" and rep.oracle_ok, rep.extra
+    assert rep.spill_bytes > 0 and rep.spill_count > 0
+    assert rep.extra["rounds"] >= 2
+    assert rep.warm_programs == 0
+    assert rep.rows_out == rep.rows_in == 16384
+    assert rep.waves >= 2
+    assert set(rep.phases) == {"ingest", "spill", "exchange", "merge",
+                               "emit"}
+    assert rep.rows_per_s["total"] > 0
+
+
+def test_terasort_digest_oracle_at_scale_shape(manager):
+    """Above the exact threshold the oracle switches to the scalable
+    triple (monotonicity + boundary carry + sampled digest) — pinned by
+    forcing the threshold to zero at a small shape."""
+    from sparkucx_tpu.workloads.terasort import terasort_pipeline
+    m = _wl_manager(manager)
+    try:
+        rep = terasort_pipeline(m, budget_bytes=64 << 10,
+                                total_rows=8192, num_partitions=8,
+                                chunk_rows=2048, exact_threshold=0,
+                                shuffle_id=9501)
+    finally:
+        m.stop()
+    assert rep.oracle == "digest" and rep.oracle_ok
+    assert rep.extra["digest_ok"] and rep.extra["monotonic_ok"] \
+        and rep.extra["boundary_ok"]
+    assert rep.extra["digest_rows_checked"] > 0
+
+
+def test_groupby_external_host_arm_per_key_exact(manager):
+    """The groupby pipeline's host verification arm: spill-backed
+    ingest, combine exchange, per-key EXACT int32 sums against the
+    O(key_space) oracle accumulators."""
+    from sparkucx_tpu.workloads.groupby import groupby_pipeline
+    m = _wl_manager(manager)
+    try:
+        rep = groupby_pipeline(m, budget_bytes=64 << 10,
+                               total_rows=6144, key_space=200,
+                               num_partitions=8, chunk_rows=1024,
+                               sink="host", warm_reads=0,
+                               shuffle_id=9510)
+    finally:
+        m.stop()
+    assert rep.oracle_ok
+    assert rep.spill_bytes > 0
+    assert rep.rows_out == rep.extra["truth_distinct"] == 200
+    assert rep.extra["value_sum"] == rep.extra["truth_sum"]
+
+
+def test_groupby_external_device_zero_d2h_warm(manager):
+    """The flagship arm: waved combine read folding through the device
+    merge, consumed at ZERO payload D2H, exact int sums, and the warm
+    re-read compiling nothing."""
+    from sparkucx_tpu.workloads.groupby import groupby_pipeline
+    m = _wl_manager(manager, {"spark.shuffle.tpu.a2a.waveRows": "512"})
+    try:
+        rep = groupby_pipeline(m, budget_bytes=64 << 10,
+                               total_rows=4800, key_space=150,
+                               num_partitions=8, chunk_rows=1024,
+                               sink="device", warm_reads=1,
+                               shuffle_id=9512)
+    finally:
+        m.stop()
+    assert rep.oracle_ok
+    assert rep.extra["d2h_bytes"] == 0
+    assert rep.warm_programs == 0
+    assert rep.waves >= 2 and rep.exchanges == 2
+
+
+def test_groupby_external_arrow_ingress(manager):
+    """Arrow ingress: chunks arrive as RecordBatches and stage through
+    io/arrow.stage_batches on the native int32 carrier — same exact
+    oracle."""
+    pytest.importorskip("pyarrow")
+    from sparkucx_tpu.workloads.groupby import groupby_pipeline
+    m = _wl_manager(manager)
+    try:
+        rep = groupby_pipeline(m, budget_bytes=64 << 10,
+                               total_rows=3072, key_space=100,
+                               num_partitions=8, chunk_rows=1024,
+                               sink="host", warm_reads=0, arrow=True,
+                               shuffle_id=9514)
+    finally:
+        m.stop()
+    assert rep.oracle_ok and rep.extra["arrow_ingress"]
+    assert rep.spill_bytes > 0
+
+
+def test_join_external_second_shuffle_compiles_nothing(manager):
+    """The repartition join's plan-family contract: both sides are
+    same-shaped, so the probe exchange rides the build exchange's
+    compiled program — 0 programs during the second shuffle — and the
+    output-row count matches the exact oracle through the spill path."""
+    from sparkucx_tpu.workloads.join import join_pipeline
+    m = _wl_manager(manager)
+    try:
+        rep = join_pipeline(m, budget_bytes=64 << 10, total_rows=8192,
+                            key_space=400, num_partitions=8,
+                            chunk_rows=1024, shuffle_id=9520)
+    finally:
+        m.stop()
+    assert rep.oracle_ok
+    assert rep.extra["probe_programs"] == 0 and rep.warm_programs == 0
+    assert rep.spill_bytes > 0
+    assert rep.rows_out == rep.extra["expected_rows"] > 0
+
+
+def test_waved_release_partition_drops_per_wave_caches(manager):
+    """The streaming-emit footprint contract on a WAVED result: the
+    cross-wave merge pulls a cached multi-run block from EVERY wave, so
+    ``release_partition`` must drop the per-wave caches too — popping
+    only the top-level merge would leave W resident copies per released
+    partition and the join/terasort emit loops' footprint would grow
+    with the dataset instead of staying one partition."""
+    m = _wl_manager(manager, {"spark.shuffle.tpu.a2a.waveRows": "64"})
+    try:
+        sid = 9530
+        h = m.register_shuffle(sid, 4, 8)
+        rng = np.random.default_rng(5)
+        for mp in range(4):
+            w = m.get_writer(h, mp)
+            w.write(rng.integers(0, 8 * 64, size=512).astype(np.int64))
+            w.commit(8)
+        res = m.read(h)
+        assert len(res._waves) >= 2
+        for r in range(8):
+            res.partition(r)
+        cached = [r for r in range(8) if r in res._block_cache]
+        assert cached, "expected multi-run partitions to cache blocks"
+        wave_cached = sum(len(w._block_cache) for w in res._waves)
+        assert wave_cached > 0, \
+            "expected per-wave multi-run blocks to cache"
+        for r in range(8):
+            res.release_partition(r)
+        assert not res._block_cache
+        assert all(not w._block_cache for w in res._waves)
+        # released partitions rebuild on demand — release is a cache
+        # drop, never a data drop
+        k, _ = res.partition(cached[0])
+        assert k.shape[0] > 0
+        m.unregister_shuffle(sid)
+    finally:
+        m.stop()
+
+
+def test_terasort_chaos_replay_through_sealed_runs(manager):
+    """Chaos leg: an armed exchange fault mid-terasort under
+    failure.policy=replay — the staged (sealed-spill) bytes survive the
+    failed attempt, the replay re-runs on them, and the final merge is
+    oracle-exact with the replay visible on the report."""
+    from sparkucx_tpu.workloads.terasort import terasort_pipeline
+    m = _wl_manager(manager,
+                    {"spark.shuffle.tpu.failure.policy": "replay"})
+    # the injector lives on the NODE (conf-armed at node start); arm
+    # the shared one directly — first exchange hit fails once
+    manager.node.faults.arm("exchange", fail_count=1)
+    try:
+        rep = terasort_pipeline(m, budget_bytes=64 << 10,
+                                total_rows=8192, num_partitions=8,
+                                chunk_rows=2048, shuffle_id=9530)
+    finally:
+        m.stop()
+    assert rep.oracle_ok
+    assert rep.replays >= 1
+    assert rep.spill_bytes > 0
+
+
+def test_workload_registry_and_cli(capsys):
+    """The name→runner registry + the CLI subcommand: unknown names
+    refuse with the registry listed; a real run prints the
+    WorkloadReport JSON and exits by oracle verdict."""
+    import json as _json
+
+    from sparkucx_tpu.__main__ import main as cli_main
+    from sparkucx_tpu.workloads import WORKLOADS
+    assert set(WORKLOADS.keys()) == {"terasort", "groupby", "join"}
+    assert cli_main(["workload", "bogus"]) == 2
+    capsys.readouterr()
+    rc = cli_main(["workload", "terasort", "--budget-mb", "0.0625",
+                   "--scale", "0.1",
+                   "--conf", "spark.shuffle.tpu.a2a.impl=dense"])
+    out = capsys.readouterr().out
+    rep = _json.loads(out)
+    assert rc == 0
+    assert rep["workload"] == "terasort" and rep["oracle_ok"]
+    assert rep["spill_bytes"] > 0
+    assert set(rep["phases"]) == {"ingest", "spill", "exchange",
+                                  "merge", "emit"}
+
+
+def test_workload_phase_counters_feed_doctor(manager):
+    """The pipelines publish workload.rows / workload.phase.ms{...}
+    counters — the spill_bound rule's evidence — into the node
+    registry."""
+    from sparkucx_tpu.utils.metrics import (C_WORKLOAD_PHASE_MS,
+                                            C_WORKLOAD_ROWS, labeled)
+    from sparkucx_tpu.workloads.join import join_pipeline
+    m = _wl_manager(manager)
+    before = manager.node.metrics.get(
+        labeled(C_WORKLOAD_ROWS, workload="join"))
+    try:
+        join_pipeline(m, budget_bytes=64 << 10, total_rows=4096,
+                      key_space=300, num_partitions=8,
+                      chunk_rows=1024, shuffle_id=9540)
+    finally:
+        m.stop()
+    mets = manager.node.metrics
+    assert mets.get(labeled(C_WORKLOAD_ROWS, workload="join")) \
+        == before + 4096
+    assert mets.get(labeled(C_WORKLOAD_PHASE_MS, workload="join",
+                            phase="exchange")) > 0
