@@ -788,7 +788,7 @@ def stage_fetch_device(mon, jax, rows_log2, val_words):
 def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
                  partitions_per_dev, sort_impl, impl, read_mode="plain",
                  key_space=None, sort_strips=1,
-                 combine_compaction="stable"):
+                 combine_compaction="stable", kernel_impl=None):
     import dataclasses
 
     import jax.numpy as jnp
@@ -819,6 +819,10 @@ def exchange_run(jax, rows_log2, val_words, k1, k2, reps,
                                    combine_words=val_words,
                                    combine_dtype="<i4",
                                    combine_compaction=combine_compaction)
+        if kernel_impl:
+            # the A/B the tpu stage runs: jnp combine vs the blocked
+            # pallas segment-reduce on the same exchange geometry
+            plan = dataclasses.replace(plan, kernel_impl=kernel_impl)
     step = step_body(plan, "shuffle")
 
     def make(k):
@@ -4071,8 +4075,14 @@ def stage_regress(args) -> int:
         default = os.path.join(rundir, "obs_overhead.json")
         baseline_path = default if os.path.exists(default) else None
         if baseline_path is None:
-            # any prior artifact with a matching metric field
+            # any prior artifact with a matching metric field — except
+            # the bench_runs/tpu_* namespace: those are ON-CHIP numbers
+            # and a CPU regress diff against one would grade the
+            # backend gap as a perf regression (and vice versa — the
+            # two baseline sets never cross-contaminate)
             for p in sorted(glob.glob(os.path.join(rundir, "*.json"))):
+                if os.path.basename(p).startswith("tpu_"):
+                    continue
                 try:
                     with open(p) as f:
                         if json.load(f).get("metric") == \
@@ -4736,6 +4746,119 @@ def stage_slo(args) -> int:
     return 0 if out["ok"] else 2
 
 
+def stage_tpu(args) -> int:
+    """``--stage tpu``: the backend-conditional speed round — the ONE
+    dedicated stage that runs the REAL resolved backend instead of
+    pinning CPU. On a resolved TPU it records the four figure families
+    into the committed ``bench_runs/tpu_*`` namespace (kept disjoint
+    from the CPU regress baselines — stage_regress excludes the
+    prefix): the blocked-kernel microbench with native pallas timings,
+    devcombine rows/s, hier, analytics rows/s, and the 6.46 GB/s/chip
+    contract-shape exchange. Off TPU it is never a silent pass: under
+    ``--require-backend=tpu`` it refuses with exit 2 (the preflight
+    discipline — a CPU artifact must not carry the TPU claim), and
+    without the flag it exits GREEN with an explicit skip line on
+    stderr plus one JSON skip doc, so CI can run the stage everywhere
+    and the log says which arm it took."""
+    import jax
+    resolved = jax.default_backend()
+    record_backend(args.platform, resolved)
+    if resolved != "tpu":
+        if args.require_backend == "tpu":
+            emit_backend_refusal(args.require_backend)
+            return 2
+        print("bench --stage tpu: no TPU backend resolved "
+              f"(resolved={resolved}); skipping the TPU speed round "
+              "(green-with-skip)", file=sys.stderr, flush=True)
+        print(json.dumps({
+            "metric": "tpu_round", "skipped": True,
+            "reason": f"no TPU backend (resolved={resolved})",
+            "requested_backend": PREFLIGHT["requested_backend"],
+            "resolved_backend": resolved, "ok": True}), flush=True)
+        return 0
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rundir = os.path.join(here, "bench_runs")
+    os.makedirs(rundir, exist_ok=True)
+    families = {}
+
+    def run_family(name, fn):
+        # one family failing must not lose the others' measured numbers
+        # — each lands its own tpu_* artifact as it completes
+        try:
+            doc = fn()
+        except Exception as e:                 # noqa: BLE001
+            doc = {"ok": False, "error": str(e)[:300]}
+        doc.setdefault("metric", f"tpu_{name}")
+        _write_artifact(os.path.join(rundir, f"tpu_{name}.json"), doc)
+        families[name] = doc
+        return doc
+
+    def _kernels():
+        from sparkucx_tpu.ops.pallas.microbench import run_microbench
+        return run_microbench(reps=max(3, args.reps),
+                              rows_log2=args.rows_log2 or 13)
+
+    def _exchange():
+        # the contract shape: 2M rows/chip, the r3 headline's geometry
+        # (6.46 GB/s/chip plain) — both merge impls so the blocked-
+        # kernel combine is measured against the jnp combine on-chip
+        out = {"metric": "tpu_exchange", "contract_GBps": 6.46,
+               "baseline_GBps": BASELINE_GBPS}
+        rl = args.rows_log2 or 21
+        for mode, kimpl in (("plain", None), ("combine", "jnp"),
+                            ("combine", "pallas")):
+            info = exchange_run(
+                jax, rows_log2=rl, val_words=args.val_words,
+                k1=4, k2=16, reps=max(3, args.reps),
+                partitions_per_dev=2, sort_impl="auto", impl="auto",
+                read_mode=mode, kernel_impl=kimpl,
+                key_space=(1 << 16) if mode == "combine" else None)
+            out[f"{mode}_{kimpl or 'na'}"] = info
+        plain = out["plain_na"]["GBps_per_chip"]
+        out["GBps_per_chip"] = plain
+        out["vs_contract"] = round(plain / 6.46, 3)
+        out["ok"] = bool(plain > 0)
+        return out
+
+    def _devcombine():
+        d = devcombine_measure(rows_per_map=1 << (args.rows_log2 or 13),
+                               reps=max(3, args.reps))
+        return {"metric": "tpu_devcombine", "detail": d,
+                "ok": d["ok"]}
+
+    def _hier():
+        d = hier_measure(rows_per_map=1 << min(args.rows_log2 or 12,
+                                               14),
+                         reps=max(3, args.reps))
+        return {"metric": "tpu_hier", "detail": d, "ok": True}
+
+    def _analytics():
+        d = analytics_measure(budget_mb=2.0)
+        return {"metric": "tpu_analytics", "detail": d,
+                "ok": d["ok"]}
+
+    run_family("kernels", _kernels)
+    run_family("exchange", _exchange)
+    run_family("devcombine", _devcombine)
+    run_family("hier", _hier)
+    run_family("analytics", _analytics)
+
+    ok = all(f.get("ok") for f in families.values())
+    summary = {
+        "metric": "tpu_round", "skipped": False, "ok": bool(ok),
+        "value": families["exchange"].get("GBps_per_chip", 0),
+        "unit": "GB/s",
+        "families": {n: {"ok": f.get("ok"),
+                         "artifact": f"bench_runs/tpu_{n}.json"}
+                     for n, f in families.items()},
+        "telemetry": _telemetry_blob(),
+    }
+    _write_artifact(os.path.join(rundir, "tpu_round.json"), summary)
+    print(json.dumps(summary), flush=True)
+    return 0 if ok else 2
+
+
 def stage_exchange(mon, jax, name, seconds, native_ok, record=True,
                    force_impl=None, **kw):
     mon.begin(name, seconds)
@@ -4816,7 +4939,7 @@ def main() -> None:
                              "pipeline", "devplane", "ragged", "chaos",
                              "wire", "integrity", "devread",
                              "devcombine", "tenancy", "hier", "slo",
-                             "analytics"),
+                             "analytics", "tpu"),
                     help="run ONE dedicated stage instead of the ladder: "
                          "coldstart = compile-cost artifact (persistent "
                          "cache cold-vs-warm across processes + "
@@ -4884,7 +5007,14 @@ def main() -> None:
                          "terasort rounds 2+, groupby warm re-read "
                          "and the join's second shuffle all compile "
                          "nothing — pool watermark <= budget). "
-                         "All CPU-measurable")
+                         "All CPU-measurable. EXCEPTION: tpu = the "
+                         "backend-conditional speed round — runs the "
+                         "REAL resolved backend (never pins CPU), "
+                         "records kernels/exchange/devcombine/hier/"
+                         "analytics into bench_runs/tpu_* on a TPU, "
+                         "refuses exit-2 under --require-backend=tpu "
+                         "off-chip, green-with-skip (explicit stderr "
+                         "line) otherwise")
     ap.add_argument("--baseline", default=None,
                     help="regress stage: prior artifact to diff against "
                          "(default bench_runs/obs_overhead.json)")
@@ -4929,6 +5059,13 @@ def main() -> None:
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8").strip()
+
+    if args.stage == "tpu":
+        # the ONE dedicated stage that must NOT pin CPU: it measures
+        # the real resolved backend, refuses under --require-backend
+        # off-chip, and green-with-skips elsewhere (stage_tpu does its
+        # own preflight bookkeeping)
+        sys.exit(stage_tpu(args))
 
     if args.stage is not None:
         # dedicated stages are compile-cost / overhead artifacts,

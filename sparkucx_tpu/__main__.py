@@ -51,6 +51,16 @@ Subcommands:
     conf. Anchor-checked like stats/trace/timeline. ``--fail-on
     fast|slow`` exits 3 on a burn of that speed — the CI gate shape.
 
+``kernelbench [--reps N] [--rows-log2 K] [--out PATH]``
+    The blocked-kernel microbench (ops/pallas/microbench.py): jnp
+    oracle timed on every backend, the pallas arm timed only where the
+    kernels compile natively (TPU) and recorded as an explicit skip
+    with the capability-gate reason elsewhere, parity graded wherever
+    the kernels can run (native or CPU interpret), and the
+    ``compile.step.programs`` invariant gated inside the artifact (one
+    program per shape family per impl, zero warm recompiles). Exit 2
+    on a parity or invariant failure; a skipped arm is a clean pass.
+
 ``workload <name> [--scale S] [--budget-mb N] [--seed K] [--arrow]``
     Run one registered analytics pipeline (workloads/ registry:
     terasort | groupby | join) end to end — external-memory, data
@@ -334,6 +344,23 @@ def _verdict_from_docs(docs) -> dict:
                              view.slo_policy))
 
 
+def _cmd_kernelbench(args) -> int:
+    """``kernelbench``: run the blocked-kernel microbench on whatever
+    backend this process resolved and print the artifact as one JSON
+    doc. Exit 0 only when every parity grade that RAN passed and the
+    compile.step.programs invariant held (one program per shape family
+    per impl on the first pass, zero on the warm pass) — a skipped
+    pallas arm is a clean record, not a failure."""
+    from sparkucx_tpu.ops.pallas.microbench import run_microbench
+    out = run_microbench(reps=args.reps, rows_log2=args.rows_log2)
+    if args.out:
+        from sparkucx_tpu.utils.atomicio import atomic_write_json
+        atomic_write_json(args.out, out, indent=1)
+        out["artifact"] = args.out
+    print(json.dumps(out, indent=1))
+    return 0 if out["ok"] else 2
+
+
 def _cmd_workload(args) -> int:
     from sparkucx_tpu.workloads import WORKLOADS, run_workload
     if args.name not in WORKLOADS:
@@ -456,7 +483,25 @@ def main(argv=None) -> int:
                       metavar="KEY=VALUE",
                       help="extra spark.shuffle.tpu.* conf overrides "
                            "(e.g. a2a.impl pins, workload.budgetMb)")
+    p_kb = sub.add_parser(
+        "kernelbench",
+        help="blocked-kernel microbench (ops/pallas/microbench.py): "
+             "jnp oracle timed everywhere, pallas timed where it "
+             "compiles natively (TPU) and recorded as a skip with the "
+             "gate reason elsewhere, parity graded wherever the "
+             "kernels run at all, compile.step.programs invariant "
+             "gated in the artifact; prints one JSON doc")
+    p_kb.add_argument("--reps", type=int, default=5,
+                      help="timed repetitions per case (default 5)")
+    p_kb.add_argument("--rows-log2", type=int, default=13,
+                      help="log2 rows for the bulk cases (default 13)")
+    p_kb.add_argument("--out", default=None,
+                      help="also write the artifact JSON here "
+                           "(atomic; e.g. bench_runs/tpu_kernels.json "
+                           "on a TPU run)")
     args = ap.parse_args(argv)
+    if args.cmd == "kernelbench":
+        return _cmd_kernelbench(args)
     if args.cmd == "workload":
         return _cmd_workload(args)
     if args.cmd == "stats":

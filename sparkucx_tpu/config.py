@@ -502,13 +502,19 @@ class TpuShuffleConf:
 
     @property
     def read_merge_impl(self) -> str:
-        """How the ordered/combine DEVICE sink folds per-wave key-sorted
-        runs on device (reader.device_merge_fold): ``auto`` (default —
-        resolves to jnp, the XLA sort-network formulation), ``jnp``, or
-        ``pallas`` (the ops/pallas/segmented.py merge / segment-reduce
-        kernels — the measured alternative; a combine whose value dtype
-        the kernel cannot accumulate falls back to jnp with a log
-        line). The allowed set lives in ONE place —
+        """How the ordered/combine fold path runs on device — the
+        receive-side reduce in the exchange step and the cross-wave
+        device merge (reader.device_merge_fold): ``auto`` (default —
+        the blocked pallas kernels exactly where they compile natively,
+        i.e. on a TPU backend, jnp everywhere else), ``jnp`` (the XLA
+        sort-network formulation — the bit-exact oracle), or ``pallas``
+        (the ops/pallas/segmented.py blocked merge-path merge / tiled
+        segment-reduce kernels; a combine whose value dtype the kernel
+        cannot accumulate, or a backend with no native-or-interpret
+        path, falls back to jnp with a log line and a
+        C_KERNEL_FALLBACK count — the doctor's kernel_fallback
+        evidence). Resolution is segmented.resolve_kernel_impl; the
+        allowed set lives in ONE place —
         shuffle/alltoall.ALLOWED_MERGE_IMPLS."""
         from sparkucx_tpu.shuffle.alltoall import validate_merge_impl
         return validate_merge_impl(self._get("read.mergeImpl", "auto"),

@@ -105,18 +105,24 @@ ALLOWED_SINKS = ("host", "device", "auto")
 READ_SINK_KEY = "spark.shuffle.tpu.read.sink"
 
 
-# Device-merge implementations (conf key ``spark.shuffle.tpu.read.
-# mergeImpl``) — how the ordered/combine device sink folds per-wave
-# key-sorted runs on device (ops/pallas/segmented.py):
+# Device-merge / device-kernel implementations (conf key ``spark.
+# shuffle.tpu.read.mergeImpl``) — how the ordered/combine fold path
+# (receive-side reduce, cross-wave device merge) runs on device
+# (ops/pallas/segmented.py; resolution is segmented.resolve_kernel_impl,
+# backend-conditional):
 #
-# ``auto``   — resolve to ``jnp`` (the XLA sort-network formulation is
-#              the measured production path on every backend today; the
-#              pallas kernels are the opt-in measured alternative).
-# ``jnp``    — batched keysort / combine_rows over the concatenation.
-# ``pallas`` — the sequential merge / segment-reduce kernels; combine
+# ``auto``   — the blocked pallas kernels exactly where they COMPILE
+#              natively (a TPU backend), ``jnp`` everywhere else (the
+#              default; auto never advertises pallas off-chip, so the
+#              jnp landing is not a fallback).
+# ``jnp``    — batched keysort / combine_rows over the concatenation
+#              (the XLA sort-network formulation — the bit-exact oracle,
+#              runs on every backend).
+# ``pallas`` — the blocked merge-path merge / tiled segment-reduce
+#              kernels (TPU native, CPU interpret for tests); combine
 #              additionally needs a 4-byte value dtype
 #              (segmented.pallas_reduce_supported) or the fold falls
-#              back to jnp with a log line.
+#              back to jnp with a log line + C_KERNEL_FALLBACK count.
 ALLOWED_MERGE_IMPLS = ("auto", "jnp", "pallas")
 
 READ_MERGE_IMPL_KEY = "spark.shuffle.tpu.read.mergeImpl"
@@ -168,8 +174,9 @@ def validate_merge_impl(impl: str,
     if impl not in ALLOWED_MERGE_IMPLS:
         raise ValueError(
             f"{conf_key}={impl!r}: want one of {ALLOWED_MERGE_IMPLS} "
-            f"(jnp = XLA sort-network merge, pallas = the "
-            f"ops/pallas/segmented.py kernels, auto = jnp)")
+            f"(jnp = XLA sort-network merge, pallas = the blocked "
+            f"ops/pallas/segmented.py kernels, auto = pallas where the "
+            f"kernels compile natively i.e. on TPU, jnp elsewhere)")
     return impl
 
 

@@ -60,6 +60,7 @@ from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES,
                                         C_INTEGRITY_RECOVERED,
                                         C_INTEGRITY_VERIFIED,
                                         C_REPLAY_MS, C_REPLAYS,
+                                        C_KERNEL_FALLBACK,
                                         C_SINK_FALLBACK, C_TIER_BYTES,
                                         COMPILE_HITS, COMPILE_PROGRAMS,
                                         G_TENANT_INFLIGHT,
@@ -218,6 +219,14 @@ class ExchangeReport:
     # host_roundtrip rule and bench --stage devread grade.
     sink: str = "host"
     d2h_bytes: int = 0
+    # Device-kernel tier the combine/ordered fold path RAN
+    # (plan.kernel_impl — segmented.resolve_kernel_impl's verdict, the
+    # resolved-impl discipline): "pallas" = the blocked merge-path /
+    # tiled segment-reduce kernels, "jnp" = the XLA sort-network
+    # formulation (plain reads always say jnp — no fold runs). A conf
+    # ask of pallas that reports jnp here is the kernel_fallback
+    # evidence (C_KERNEL_FALLBACK carries the gate reason).
+    kernel: str = "jnp"
     # Device-native ordered/combine (read.sink=device): wall the
     # cross-wave DEVICE merge fold spent (reader.device_merge_fold —
     # compiled merge programs over the completed waves, blocked for an
@@ -2189,6 +2198,7 @@ class TpuShuffleManager:
         rep.wire_bytes = layout.wire_bytes
         rep.pad_ratio = layout.pad_ratio
         rep.wire = layout.wire
+        rep.kernel = plan.kernel_impl
         # raw/wire row-width gain — the effective-bandwidth multiplier
         # the int8 tier earns (1.0 on raw/lossless; the lossless codec
         # is host-side and must not claim link bandwidth)
@@ -2709,14 +2719,42 @@ class TpuShuffleManager:
                 raise ValueError(
                     f"combine_sum_words={combine_sum_words} out of "
                     f"[0, {vw}] for this value schema")
-            return dataclasses.replace(
+            return self._stamp_kernel(dataclasses.replace(
                 plan, combine=combine,
                 combine_words=vw,
                 combine_dtype=np.dtype(val_dtype).str,
-                combine_sum_words=combine_sum_words)
+                combine_sum_words=combine_sum_words))
         if ordered:
-            return dataclasses.replace(plan, ordered=True)
+            return self._stamp_kernel(
+                dataclasses.replace(plan, ordered=True))
         return plan
+
+    def _stamp_kernel(self, plan: ShufflePlan) -> ShufflePlan:
+        """Resolve the device-kernel tier for a combine/ordered plan
+        (read.mergeImpl through segmented.resolve_kernel_impl — the
+        _resolve_wire discipline applied to the kernel plane) and stamp
+        it: the step bodies and the cross-wave merge fold branch on
+        ``plan.kernel_impl``, the report names it, and family() keys
+        it. A pallas ask that degrades to jnp counts into
+        C_KERNEL_FALLBACK with the gate reason — the doctor's
+        kernel_fallback evidence."""
+        import dataclasses
+        from sparkucx_tpu.ops.pallas.segmented import resolve_kernel_impl
+        import jax as _jax
+        impl, reason = resolve_kernel_impl(
+            self.conf.read_merge_impl, _jax.default_backend(),
+            combine_dtype=plan.combine_dtype or None)
+        if reason is not None:
+            m = self.node.metrics
+            m.inc(C_KERNEL_FALLBACK, 1.0)
+            m.inc(labeled(C_KERNEL_FALLBACK, reason=reason), 1.0)
+            self._warn_sink_once(
+                f"kernel_{reason}",
+                f"read.mergeImpl={self.conf.read_merge_impl} resolves "
+                f"to jnp on this read: {reason} "
+                f"(segmented.resolve_kernel_impl; the report's "
+                f"'kernel' field names what ran)")
+        return dataclasses.replace(plan, kernel_impl=impl)
 
     @staticmethod
     def _cap_key(handle: ShuffleHandle) -> tuple:

@@ -105,6 +105,17 @@ class ShufflePlan:
     # read's output buffers; sharing the executable across sinks would
     # let a donated-buffer alias bleed into the host path's result).
     sink: str = "host"
+    # Device-kernel tier for the combine/ordered fold path
+    # (read.mergeImpl through ops/pallas/segmented.resolve_kernel_impl —
+    # the backend-conditional resolution): "jnp" = the XLA sort-network
+    # formulation (the oracle, runs everywhere), "pallas" = the blocked
+    # merge-path merge / tiled segment-reduce kernels (TPU native, or
+    # CPU interpret for tests). Stamped RESOLVED by the manager
+    # (_decorated_plan), never the conf ask, and rides family(): a jnp
+    # and a pallas read of one shape are different compiled programs
+    # (the fused int8 reduce consumes wire-format rows — sharing the
+    # executable would alias incompatible step bodies).
+    kernel_impl: str = "jnp"
     # Wave-pipelined exchange (a2a.waveRows, shuffle/manager.py): the
     # OUTER descriptive plan of a waved read carries the wave split here
     # — rows per shard per wave and the agreed wave count. The plan each
@@ -137,7 +148,7 @@ class ShufflePlan:
                 self.combine_dtype, self.combine_sum_words,
                 self.combine_compaction, self.ordered, self.bounds,
                 self.pallas_interpret, self.wire, self.wire_words,
-                self.sink)
+                self.sink, self.kernel_impl)
 
     def strips_active(self) -> bool:
         """True when the single-shard strip-sorted plain path runs —

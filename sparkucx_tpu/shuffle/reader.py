@@ -137,14 +137,18 @@ def seeded_nvalid(plan: ShufflePlan, nvalid: np.ndarray, base_seed: int,
                     axis=1).reshape(-1).astype(np.int32)
 
 
-def _wire_ragged_shuffle(plan: ShufflePlan, send, sizes, axis, seed):
+def _wire_ragged_shuffle(plan: ShufflePlan, send, sizes, axis, seed,
+                         unpack: bool = True):
     """One collective on the plan's wire tier: int8 narrows the value
     lanes around ragged_shuffle (quantize on send, dequantize on
     receive — the key lanes and the [P] size row stay exact), every
     other tier is ragged_shuffle verbatim. The delivered rows are
-    full-width either way, so everything downstream of the collective
+    full-width by default, so everything downstream of the collective
     (receive-side combine/keysort, run arithmetic, unpack) is
-    wire-oblivious."""
+    wire-oblivious. ``unpack=False`` hands the caller the received
+    rows STILL in wire format (key lanes exact, value lanes packed) —
+    the fused dequant segment-reduce's input, which dequantizes inside
+    the consuming kernel instead of running a separate program."""
     if seed is None:
         return ragged_shuffle(send, sizes, axis,
                               out_capacity=plan.cap_out, impl=plan.impl)
@@ -152,6 +156,8 @@ def _wire_ragged_shuffle(plan: ShufflePlan, send, sizes, axis, seed):
     packed = wire_pack_rows(send, plan.wire_words, seed)
     r = ragged_shuffle(packed, sizes, axis, out_capacity=plan.cap_out,
                        impl=plan.impl)
+    if not unpack:
+        return r
     data = wire_unpack_rows(r.data, width, plan.wire_words)
     from sparkucx_tpu.shuffle.alltoall import ShuffleResult
     return ShuffleResult(data, r.recv_sizes, r.total, r.overflow)
@@ -254,8 +260,17 @@ def step_body(plan: ShufflePlan, axis: str):
             send, rcounts = destination_sort(payload, part, nvalid[0], R,
                                              method=plan.sort_impl)
 
+        # int8 + blocked kernels + multi-sender combine: keep the
+        # received rows in WIRE format — the fused dequant segment-
+        # reduce consumes them directly (EQuARX: no separate dequant
+        # program). Key lanes are exact in wire rows, so the grouping
+        # keysort below needs no unpack either.
+        width = payload.shape[1]
+        fused = (plan.combine and Pn > 1 and seeded
+                 and plan.kernel_impl == "pallas"
+                 and width == 2 + plan.wire_words)
         r = _wire_ragged_shuffle(plan, send, dev_counts(rcounts), axis,
-                                 seed)
+                                 seed, unpack=not fused)
 
         if plan.combine:
             if Pn == 1:
@@ -268,12 +283,38 @@ def step_body(plan: ShufflePlan, axis: str):
             # reduce-side combine: merge the per-sender segments' rows by
             # key before D2H — one run per partition, so the seg matrix is
             # this shard's OWN combined counts ([1, R] per shard)
-            from sparkucx_tpu.ops.aggregate import combine_rows
-            rows_out, pcounts, n_out = combine_rows(
-                r.data, part_fn(r.data), r.total[0], R,
-                plan.combine_words, np.dtype(plan.combine_dtype),
-                plan.combine, sum_words=plan.combine_sum_words,
-                compaction=plan.combine_compaction)
+            if fused:
+                from sparkucx_tpu.ops.aggregate import keysort_rows
+                from sparkucx_tpu.ops.pallas.segmented import \
+                    segment_reduce_wire_rows
+                spart, swire, _ = keysort_rows(
+                    r.data, part_fn(r.data), r.total[0], R)
+                rows_out, pcounts, n_out = segment_reduce_wire_rows(
+                    swire, spart, R, width, plan.wire_words,
+                    sum_words=plan.combine_sum_words, impl="pallas",
+                    interpret=plan.pallas_interpret)
+            elif plan.kernel_impl == "pallas":
+                # blocked tiled segment-reduce over the grouped rows —
+                # the keysort replaces combine_rows' internal grouping
+                # sort, the reduce replaces its cumsum + flag compaction
+                from sparkucx_tpu.ops.aggregate import keysort_rows
+                from sparkucx_tpu.ops.pallas.segmented import \
+                    segment_reduce_rows
+                spart, srows, _ = keysort_rows(
+                    r.data, part_fn(r.data), r.total[0], R)
+                rows_out, pcounts, n_out = segment_reduce_rows(
+                    srows, spart, R, plan.combine_words,
+                    np.dtype(plan.combine_dtype), plan.combine,
+                    sum_words=plan.combine_sum_words,
+                    compaction=plan.combine_compaction, impl="pallas",
+                    interpret=plan.pallas_interpret)
+            else:
+                from sparkucx_tpu.ops.aggregate import combine_rows
+                rows_out, pcounts, n_out = combine_rows(
+                    r.data, part_fn(r.data), r.total[0], R,
+                    plan.combine_words, np.dtype(plan.combine_dtype),
+                    plan.combine, sum_words=plan.combine_sum_words,
+                    compaction=plan.combine_compaction)
             return rows_out, pcounts.reshape(1, R), \
                 n_out.astype(r.total.dtype), r.overflow
         if plan.ordered:
@@ -377,7 +418,13 @@ def _pallas_step_body(plan: ShufflePlan, axis: str):
         out, recv_real, recv_off, total_al = pallas_ragged_all_to_all(
             srows, dev_counts, axis, out_capacity=cap_eff,
             num_devices=Pn, interpret=interpret)
-        if seeded:
+        # int8 + blocked kernels + combine: keep the DMA'd rows in wire
+        # format — the fused dequant segment-reduce consumes them as-is
+        # (key lanes exact, so the densify keysort needs no unpack)
+        fused = (plan.combine and seeded
+                 and plan.kernel_impl == "pallas"
+                 and width == 2 + plan.wire_words)
+        if seeded and not fused:
             # dequantize right off the DMA: everything downstream (the
             # densify combine/keysort, the run index) sees full rows
             out = wire_unpack_rows(out, width, plan.wire_words)
@@ -397,7 +444,32 @@ def _pallas_step_body(plan: ShufflePlan, axis: str):
         valid = (idx - jnp.take(recv_off, seg_i)) \
             < jnp.take(recv_real, seg_i)
         pkey = jnp.where(valid, part_fn(out), jnp.int32(R))
-        if plan.combine:
+        if fused:
+            # grouping keysort over the WIRE rows (key/partition lanes
+            # exact), then the fused dequant reduce — dequantization
+            # happens inside the consuming kernel, no separate program
+            from sparkucx_tpu.ops.aggregate import keysort_rows
+            from sparkucx_tpu.ops.pallas.segmented import \
+                segment_reduce_wire_rows
+            spart, swire, _ = keysort_rows(
+                out, pkey, jnp.int32(cap_eff), R)
+            rows_out, pcounts, _ = segment_reduce_wire_rows(
+                swire, spart, R, width, plan.wire_words,
+                sum_words=plan.combine_sum_words, impl="pallas",
+                interpret=plan.pallas_interpret)
+        elif plan.combine and plan.kernel_impl == "pallas":
+            from sparkucx_tpu.ops.aggregate import keysort_rows
+            from sparkucx_tpu.ops.pallas.segmented import \
+                segment_reduce_rows
+            spart, srows_g, _ = keysort_rows(
+                out, pkey, jnp.int32(cap_eff), R)
+            rows_out, pcounts, _ = segment_reduce_rows(
+                srows_g, spart, R, plan.combine_words,
+                np.dtype(plan.combine_dtype), plan.combine,
+                sum_words=plan.combine_sum_words,
+                compaction=plan.combine_compaction, impl="pallas",
+                interpret=plan.pallas_interpret)
+        elif plan.combine:
             from sparkucx_tpu.ops.aggregate import combine_rows
             rows_out, pcounts, _ = combine_rows(
                 out, pkey, jnp.int32(cap_eff), R, plan.combine_words,
@@ -1218,21 +1290,23 @@ def _build_seed_acc(mesh: Mesh, axis: str, acc_cap: int, wave_cap: int,
 
 def resolve_merge_impl(conf, plan: ShufflePlan) -> str:
     """Resolve ``read.mergeImpl`` against what THIS plan's fold can run
-    (the _resolve_wire discipline — pure conf/plan facts): ``auto`` is
-    jnp; ``pallas`` demands a 4-byte combine dtype (the segment-reduce
-    kernel accumulates whole transport words) and falls back to jnp
-    with a log line otherwise."""
-    impl = conf.read_merge_impl
-    if impl == "auto":
-        return "jnp"
-    if impl == "pallas" and plan.combine:
-        from sparkucx_tpu.ops.pallas.segmented import \
-            pallas_reduce_supported
-        if not pallas_reduce_supported(np.dtype(plan.combine_dtype)):
-            log.info("read.mergeImpl=pallas resolves to jnp for this "
-                     "read: combine dtype %s is not a 4-byte lane "
-                     "(pallas_reduce_supported)", plan.combine_dtype)
-            return "jnp"
+    on THIS backend (the _resolve_wire discipline — pure conf/plan/
+    backend facts, delegated to segmented.resolve_kernel_impl so the
+    fold and the manager's plan decoration cannot drift): ``auto`` is
+    the blocked pallas kernels exactly where they compile natively
+    (TPU) and jnp elsewhere; ``pallas`` is honored wherever the
+    capability gate clears (TPU native, CPU interpret) and falls back
+    to jnp with a log line otherwise — a combine whose value dtype is
+    not a 4-byte lane gates either way (the segment-reduce kernel
+    accumulates whole transport words)."""
+    from sparkucx_tpu.ops.pallas.segmented import resolve_kernel_impl
+    impl, reason = resolve_kernel_impl(
+        conf.read_merge_impl, jax.default_backend(),
+        combine_dtype=plan.combine_dtype or None)
+    if reason is not None:
+        log.info("read.mergeImpl=%s resolves to jnp for this read: %s "
+                 "(segmented.resolve_kernel_impl)",
+                 conf.read_merge_impl, reason)
     return impl
 
 

@@ -77,6 +77,13 @@ sink_fallback     reads that ASKED for the device sink     spark.shuffle.tpu.rea
                   why (distributed/hierarchical/conf-
                   pinned); the device sink is legal for
                   all four modes single-process
+kernel_fallback   reads that ASKED for the blocked        spark.shuffle.tpu.read.mergeImpl
+                  pallas kernels ran jnp/XLA instead
+                  (shuffle.kernel.fallback.count,
+                  labeled {reason}) — the capability
+                  gate refused (backend_unsupported /
+                  subword_dtype); 'auto' resolving to
+                  jnp off-TPU is clean and never fires
 slo_burn          a declared objective (utils/slo.py)      spark.shuffle.tpu.slo.read.p99Ms
                   is burning its error budget over the
                   retained history windows — critical on
@@ -106,6 +113,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Union
 
 from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES, C_D2H, C_H2D,
+                                        C_KERNEL_FALLBACK,
                                         C_SINK_FALLBACK,
                                         C_INTEGRITY_CORRUPT,
                                         C_INTEGRITY_CORRUPT_BLOCKS,
@@ -227,6 +235,16 @@ class Thresholds:
     # correctly, on host); critical once the mismatch repeats enough to
     # say a steady consumer path is paying the round-trip every read.
     sink_fallback_critical: int = 8
+    # kernel_fallback: reads that ASKED for the blocked pallas kernels
+    # (read.mergeImpl=pallas) resolved to the jnp/XLA path instead
+    # (segmented.resolve_kernel_impl: backend_unsupported /
+    # subword_dtype). Same posture as sink_fallback: one explicit
+    # intent mismatch is already a finding (the warn-once log line used
+    # to be the only evidence) but it stays a WARN — the read still ran
+    # bit-identically on the oracle path; critical once the mismatch
+    # repeats enough to say a steady consumer is paying the slower
+    # kernel every read. 'auto' resolving to jnp off-TPU never counts.
+    kernel_fallback_critical: int = 8
     # block_corruption: checksum verification (integrity.verify) caught
     # blocks whose bytes no longer match their commit records, or the
     # restart ledger quarantined blocks. ONE detected corruption is
@@ -1202,6 +1220,59 @@ def _rule_sink_fallback(view: ClusterView,
                      "to silence the intent mismatch"))]
 
 
+def _rule_kernel_fallback(view: ClusterView,
+                          th: Thresholds) -> List[Finding]:
+    """Reads that ASKED for the blocked pallas kernels landed on the
+    jnp/XLA path — ``segmented.resolve_kernel_impl`` refused the
+    request, graded instead of the manager's warn-once log line. The
+    labeled counter twins name the REASON: ``backend_unsupported``
+    (the backend compiles neither natively — TPU — nor under the CPU
+    interpreter) or ``subword_dtype`` (the combine dtype is not the
+    4-byte lane width the blocked kernels assume). ``auto`` resolving
+    to jnp off-TPU is a clean resolution, not a fallback, and never
+    increments the counter — quiet unless somebody pinned
+    read.mergeImpl=pallas and did not get it."""
+    total = float(view.counters.get(C_KERNEL_FALLBACK, 0.0))
+    if total <= 0:
+        return []
+    by_reason: Dict[str, float] = {}
+    for name, v in view.counters.items():
+        base, labels = parse_labeled(name)
+        if base != C_KERNEL_FALLBACK or not labels:
+            continue
+        if "reason" in labels:
+            by_reason[labels["reason"]] = by_reason.get(
+                labels["reason"], 0.0) + float(v)
+    reasons = ", ".join(f"{r}×{int(n)}"
+                        for r, n in sorted(by_reason.items())) \
+        or "unknown"
+    return [Finding(
+        rule="kernel_fallback",
+        grade="critical" if total >= th.kernel_fallback_critical
+        else "warn",
+        summary=(f"{int(total)} read(s) requested read.mergeImpl="
+                 f"pallas but ran the jnp/XLA kernels instead "
+                 f"(reasons: {reasons}) — the consumer asked for the "
+                 f"blocked device kernels and the capability gate "
+                 f"refused (the ExchangeReport 'kernel' field names "
+                 f"what actually ran)"),
+        evidence={"fallbacks": int(total),
+                  "by_reason": {r: int(n)
+                                for r, n in by_reason.items()}},
+        conf_key="spark.shuffle.tpu.read.mergeImpl",
+        remediation=("the blocked kernels are legal on TPU natively "
+                     "and on CPU under the pallas interpreter "
+                     "(segmented.kernel_gate_reason) with 4-byte "
+                     "combine dtypes (int32/float32/uint32) — if the "
+                     "reason is backend_unsupported, run on TPU or "
+                     "accept the oracle path with read.mergeImpl=auto "
+                     "(picks pallas exactly where it compiles "
+                     "natively, jnp elsewhere, no fallback counted); "
+                     "if subword_dtype, widen the combine values to a "
+                     "4-byte lane dtype or keep jnp — results are "
+                     "identical either way, only the kernel differs"))]
+
+
 def _labeled_series(mapping, base: str, label: str) -> Dict[str, Any]:
     """{label value: entry} for every identity in ``mapping`` whose base
     name is ``base`` and whose label block carries ``label`` — the
@@ -1628,7 +1699,8 @@ _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_bw_underutilization, _rule_padding_waste,
           _rule_wire_dequant, _rule_peer_timeout, _rule_replay_storm,
           _rule_block_corruption, _rule_host_roundtrip,
-          _rule_sink_fallback, _rule_quota_starvation, _rule_slow_tier,
+          _rule_sink_fallback, _rule_kernel_fallback,
+          _rule_quota_starvation, _rule_slow_tier,
           _rule_slo_burn, _rule_latency_trend, _rule_spill_bound)
 
 

@@ -112,6 +112,15 @@ C_H2D = "shuffle.consume.h2d.bytes"
 # names the modes, since PR-12 made the device sink legal for every
 # read mode on the single-process flat exchange.
 C_SINK_FALLBACK = "shuffle.sink.fallback.count"
+# Combine/ordered reads whose device-kernel resolution LANDED on jnp
+# while the conf asked for the blocked pallas kernels
+# (read.mergeImpl=pallas through segmented.resolve_kernel_impl) —
+# the kernel-plane twin of C_SINK_FALLBACK. Labeled twins carry
+# {reason="backend_unsupported|subword_dtype"} (the capability-gate
+# evidence); the doctor's kernel_fallback rule grades the total.
+# 'auto' resolving to jnp on a CPU backend does NOT count — auto never
+# advertised the kernels, so nothing silently degraded.
+C_KERNEL_FALLBACK = "shuffle.kernel.fallback.count"
 # Topology plane (shuffle/topology.py): cumulative WIRE bytes each
 # fabric tier of a hierarchical exchange moved, labeled
 # {tier="ici|dcn", tenant=...} — the per-tenant face of

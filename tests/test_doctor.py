@@ -757,6 +757,48 @@ def test_sink_fallback_quiet_without_device_asks():
             if f.rule == "sink_fallback"] == []
 
 
+# -- kernel_fallback (read.mergeImpl, blocked-kernel era) -------------------
+def test_kernel_fallback_fires_and_names_reason():
+    doc = _healthy_doc()
+    doc["counters"]["shuffle.kernel.fallback.count"] = 3
+    doc["counters"][
+        'shuffle.kernel.fallback.count{reason="subword_dtype"}'] = 3
+    fs = [f for f in diagnose(doc) if f.rule == "kernel_fallback"]
+    assert len(fs) == 1
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.conf_key == "spark.shuffle.tpu.read.mergeImpl"
+    assert f.evidence["fallbacks"] == 3
+    assert f.evidence["by_reason"] == {"subword_dtype": 3}
+    assert "subword_dtype" in f.summary and "pallas" in f.summary
+    # the remediation names the capability gates, not just the knob
+    assert "TPU" in f.remediation and "4-byte" in f.remediation
+
+
+def test_kernel_fallback_critical_on_repetition():
+    doc = _healthy_doc()
+    doc["counters"]["shuffle.kernel.fallback.count"] = 9
+    doc["counters"][
+        'shuffle.kernel.fallback.count'
+        '{reason="backend_unsupported"}'] = 9
+    fs = [f for f in diagnose(doc) if f.rule == "kernel_fallback"]
+    assert fs and fs[0].grade == "critical"
+    assert fs[0].evidence["by_reason"] == {"backend_unsupported": 9}
+
+
+def test_kernel_fallback_quiet_without_pallas_asks():
+    # no read ever pinned mergeImpl=pallas — the healthy doc carries no
+    # fallback counter; 'auto' resolving to jnp off-TPU increments
+    # NOTHING (resolve_kernel_impl returns reason=None), so a busy
+    # CPU-backend doc with reads but no counter stays quiet too
+    assert [f for f in diagnose(_healthy_doc())
+            if f.rule == "kernel_fallback"] == []
+    doc = _healthy_doc()
+    doc["exchange_reports"].append(_roundtrip_report(d2h_mb=64.0))
+    assert [f for f in diagnose(doc)
+            if f.rule == "kernel_fallback"] == []
+
+
 def test_gauges_attribute_per_process_in_cluster_view():
     """build_view keeps gauges per process (point-in-time values must
     attribute, never sum) and hbm_pressure names the pressed process."""

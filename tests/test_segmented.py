@@ -312,3 +312,170 @@ def test_device_fold_reuses_one_merge_program_per_family():
         assert rep2.stepcache_programs == 0
     finally:
         m.stop()
+
+
+# ---------------------------------------------------------------------------
+# Parity fuzz sweep (blocked kernels vs the jnp oracle across ragged
+# shapes). Every skip goes through S.kernel_gate_reason — the ONE
+# shared gate — so a pallas-less env skips with the same reason string
+# the microbench artifact and impl resolution record.
+
+def _require_blocked_kernels():
+    reason = S.kernel_gate_reason()
+    if reason is not None:
+        pytest.skip(reason)
+
+
+def _fuzz_rows(rng, n, cap, num_parts, width, groups, sum_words,
+               float_vals):
+    """Sorted-contract rows with PER-KEY-CONSTANT carried lanes (the
+    data contract: keysort is unstable, so the group representative is
+    arbitrary — any non-constant carried lane is a bug in the data,
+    not the kernel) and exactly-summable f32 (integer-valued) so the
+    bit-exact grade is meaningful on the float arm."""
+    import jax.numpy as jnp
+    _FLIP = np.int32(-0x80000000)
+    groups = max(1, min(groups, n)) if n else 1
+    part = np.sort(rng.integers(0, num_parts, size=groups)
+                   .astype(np.int32))
+    hi = rng.integers(-5, 5, size=groups).astype(np.int32)
+    lo = rng.integers(-2**31, 2**31, size=groups,
+                      dtype=np.int64).astype(np.int32)
+    order = np.lexsort((lo ^ _FLIP, hi, part))
+    part, hi, lo = part[order], hi[order], lo[order]
+    gid = np.sort(rng.integers(0, groups, size=n)) if n \
+        else np.zeros(0, np.int64)
+    sw = sum_words if sum_words > 0 else width - 2
+    rows = np.zeros((cap, width), np.int32)
+    p = np.full(cap, num_parts, np.int32)
+    rows[:n, 0] = lo[gid]
+    rows[:n, 1] = hi[gid]
+    p[:n] = part[gid]
+    carried = rng.integers(-1000, 1000,
+                           size=(groups, width - 2 - sw)).astype(np.int32)
+    if float_vals:
+        rows[:n, 2:2 + sw] = rng.integers(
+            -64, 64, size=(n, sw)).astype(np.float32).view(np.int32)
+    else:
+        rows[:n, 2:2 + sw] = rng.integers(
+            -2**31, 2**31, size=(n, sw),
+            dtype=np.int64).astype(np.int32)
+    rows[:n, 2 + sw:] = carried[gid]
+    return jnp.asarray(rows), jnp.asarray(p)
+
+
+# (n, cap, parts, width, groups): empty, sub-tile, non-tile-aligned n,
+# single row, single segment spanning every tile, all-valid full cap,
+# nearly-singleton groups (group-per-row stress)
+_FUZZ_SHAPES = (
+    (0, 128, 4, 6, 3),
+    (39, 128, 4, 6, 38),
+    (129, 256, 4, 6, 129),
+    (1, 256, 4, 6, 1),
+    (384, 384, 2, 6, 1),
+    (384, 384, 6, 6, 380),
+    (300, 384, 4, 6, 38),
+    (250, 256, 4, 7, 17),
+)
+
+
+@pytest.mark.parametrize("shape", _FUZZ_SHAPES,
+                         ids=lambda s: f"n{s[0]}_cap{s[1]}_g{s[4]}")
+@pytest.mark.parametrize("sum_words", (0, 2))
+@pytest.mark.parametrize("float_vals", (False, True),
+                         ids=("i32", "f32"))
+def test_blocked_segment_reduce_parity_fuzz(shape, sum_words,
+                                            float_vals):
+    """Blocked segment-reduce vs the jnp oracle: n_out, pcounts and
+    every live row bit-exact — int32 sums exact mod 2^32 under any
+    order, f32 sums exactly summable by construction."""
+    _require_blocked_kernels()
+    n, cap, parts, width, groups = shape
+    rng = np.random.default_rng(n * 31 + cap + sum_words)
+    rows, part = _fuzz_rows(rng, n, cap, parts, width, groups,
+                            sum_words, float_vals)
+    vdt = np.float32 if float_vals else np.int32
+    jr, jc, jn = S.segment_reduce_rows(
+        rows, part, parts, width - 2, vdt, sum_words=sum_words,
+        impl="jnp")
+    pr, pc, pn = S.segment_reduce_rows(
+        rows, part, parts, width - 2, vdt, sum_words=sum_words,
+        impl="pallas", interpret=None)
+    k = int(np.asarray(jn)[0])
+    assert k == int(np.asarray(pn)[0])
+    assert np.array_equal(np.asarray(jc), np.asarray(pc))
+    assert np.array_equal(np.asarray(jr)[:k], np.asarray(pr)[:k])
+
+
+@pytest.mark.parametrize("shape", ((0, 128, 4, 4), (39, 128, 4, 4),
+                                   (129, 256, 4, 4), (300, 384, 3, 4),
+                                   (250, 256, 2, 8)),
+                         ids=lambda s: f"n{s[0]}_vw{s[3]}")
+def test_blocked_fused_wire_reduce_parity_fuzz(shape):
+    """int8-dequant-fused segment-reduce vs the jnp unpack-then-reduce
+    oracle: keys/partitions/n_out bit-exact, dequantized f32 sums
+    within the wire dequant bound (the ONLY tolerance in the sweep —
+    both sides sum the SAME dequantized values, but tile-local
+    accumulation vs global cumsum-differencing may part at the last
+    ulp; the dequant itself is bit-identical)."""
+    _require_blocked_kernels()
+    import jax.numpy as jnp
+    from sparkucx_tpu.shuffle.alltoall import wire_pack_rows
+    n, cap, parts, vw = shape
+    width = 2 + vw
+    rng = np.random.default_rng(n * 13 + vw)
+    rows, part = _fuzz_rows(rng, n, cap, parts, width,
+                            max(1, n // 8) if n else 1, 0, True)
+    f = np.asarray(rows).copy()
+    fl = f[:n, 2:].view(np.float32) * np.float32(0.37)
+    f[:n, 2:] = fl.view(np.int32)
+    wired = wire_pack_rows(jnp.asarray(f), vw, jnp.uint32(7))
+    jr, jc, jn = S.segment_reduce_wire_rows(
+        wired, part, parts, width, vw, impl="jnp")
+    pr, pc, pn = S.segment_reduce_wire_rows(
+        wired, part, parts, width, vw, impl="pallas", interpret=None)
+    k = int(np.asarray(jn)[0])
+    assert k == int(np.asarray(pn)[0])
+    assert np.array_equal(np.asarray(jc), np.asarray(pc))
+    ja, pa = np.asarray(jr)[:k], np.asarray(pr)[:k]
+    assert np.array_equal(ja[:, :2], pa[:, :2])
+    assert np.allclose(ja[:, 2:].view(np.float32),
+                       pa[:, 2:].view(np.float32),
+                       rtol=1e-5, atol=1e-4)
+
+
+@pytest.mark.parametrize("split", (0, 1, 29, 64))
+def test_blocked_merge_reduce_parity_fuzz(split):
+    """Blocked merge-path merge+reduce vs the jnp oracle on two sorted
+    runs of every skew (one side empty, singleton, balanced)."""
+    _require_blocked_kernels()
+    import jax.numpy as jnp
+    rng = np.random.default_rng(split + 5)
+    a_rows, a_p = _fuzz_rows(rng, split, max(split, 64), 4, W, 
+                             max(1, split // 2), 2, False)
+    b_rows, b_p = _fuzz_rows(rng, 64 - split, 64, 4, W,
+                             max(1, (64 - split) // 2), 2, False)
+    outs = {}
+    for impl in ("jnp", "pallas"):
+        outs[impl] = S.merge_reduce_rows(
+            a_rows, a_p, b_rows, b_p, 4, W - 2, np.int32,
+            sum_words=2, impl=impl)
+    jr, jc, jn = outs["jnp"]
+    pr, pc, pn = outs["pallas"]
+    k = int(np.asarray(jn)[0])
+    assert k == int(np.asarray(pn)[0])
+    assert np.array_equal(np.asarray(jc), np.asarray(pc))
+    assert np.array_equal(np.asarray(jr)[:k], np.asarray(pr)[:k])
+
+
+def test_gate_helper_is_the_single_skip_authority():
+    """The sweep's skip reason IS kernel_gate_reason's string: on a
+    gated backend every parity test above skips with it verbatim, and
+    resolve_kernel_impl's fallback evidence matches the same gate (one
+    helper, uniform reasons everywhere — microbench, tests, manager)."""
+    assert S.kernel_gate_reason("tpu") is None
+    assert S.kernel_gate_reason("cpu") is None  # interpret path
+    r = S.kernel_gate_reason("gpu")
+    assert r is not None and "backend='gpu'" in r
+    impl, reason = S.resolve_kernel_impl("pallas", "gpu")
+    assert (impl, reason) == ("jnp", "backend_unsupported")

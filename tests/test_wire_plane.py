@@ -432,3 +432,52 @@ def test_moe_forward_lands_in_exchange_telemetry(devices):
     assert GLOBAL_METRICS.get("shuffle.payload.bytes") - pay0 == p
     assert GLOBAL_METRICS.get("shuffle.wire.bytes") - wire0 == w
     assert GLOBAL_METRICS.get("moe.exchange.count") - cnt0 == 2.0
+
+
+def test_int8_wire_lane_arithmetic_pinned():
+    """Regression pin for the chunk-alignment audit (blocked-kernel PR):
+    every consumer of the int8 wire geometry — the packing kernel, the
+    plan accounting, and the reader's chunk alignment — must derive
+    from ONE formula, and that formula is pinned here value-by-value so
+    a drift in any copy breaks loudly."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from sparkucx_tpu.ops.pallas.ragged_a2a import chunk_rows_for
+    from sparkucx_tpu.shuffle.alltoall import (int8_wire_words,
+                                               wire_pack_rows)
+    from sparkucx_tpu.shuffle.plan import ShufflePlan, wire_row_words
+
+    # the formula itself: ceil(vw/4) packed words + 1 f32 scale word
+    assert [int8_wire_words(v) for v in (1, 2, 3, 4, 5, 8, 9)] == \
+        [2, 2, 2, 2, 3, 3, 4]
+
+    plan = ShufflePlan(num_shards=1, num_partitions=4, cap_in=64,
+                       cap_out=64, impl="dense")
+    # raw tier: wire width IS the payload width
+    assert wire_row_words(plan, 10) == 10
+    # int8 tier: exact head + packed values + scale — NARROWER, and the
+    # reader's chunk must follow the narrowed width (the kernel tiles
+    # over wire rows, not payload rows)
+    p8 = dataclasses.replace(plan, wire="int8", wire_words=8)
+    assert wire_row_words(p8, 10) == 10 - 8 + int8_wire_words(8) == 5
+    chunk = chunk_rows_for(wire_row_words(p8, 10))
+    assert chunk == 128 and chunk != chunk_rows_for(10)
+    # the alignment invariant the kernel needs: a chunk of wire rows is
+    # a 128-lane multiple of int32 words
+    assert (chunk * wire_row_words(p8, 10)) % 128 == 0
+
+    # the packing kernel's output shape agrees with the accounting
+    rows = jnp.zeros((8, 10), jnp.int32)
+    packed = wire_pack_rows(rows, 8, jnp.uint32(1))
+    assert packed.shape == (8, wire_row_words(p8, 10))
+
+    # the fused-reduce seam: every combine+int8 plan has wire_words ==
+    # combine_words, so the fused kernel's input width is exactly
+    # 2 + int8_wire_words(combine_words) and its output re-widens to
+    # 2 + combine_words — the widths the reader's fused gate checks
+    pc = dataclasses.replace(plan, combine="sum", combine_words=8,
+                             combine_dtype="<f4", wire="int8",
+                             wire_words=8, kernel_impl="pallas")
+    assert wire_row_words(pc, 2 + 8) == 2 + int8_wire_words(8)
