@@ -1519,6 +1519,205 @@ def stage_obs_overhead(args) -> int:
     return 0 if out["ok"] else 2
 
 
+def anatomy_measure(exchanges=20, rows_per_map=2048, maps=4,
+                    partitions=8, reps=3, seed=0):
+    """Measure the anatomy plane's cost on the CPU exchange loop.
+
+    The GATING number (``overhead_disabled_pct``) follows the
+    obs-overhead discipline — deterministic accounting, not an A/B:
+    count the anatomy hooks one exchange executes with tracing
+    DISABLED (no-op ``span()`` contexts, guarded ``record_span()``
+    calls, the ``_settle_anatomy`` early-return), microbench each
+    disabled primitive in a tight loop, and divide the product by the
+    measured median exchange wall. The enabled-path fold cost and a
+    per-read-mode conservation breakdown (the ≥95% attribution
+    contract across plain/ordered/combine/device-sink) ride along as
+    context."""
+    import contextlib
+    import time as _time
+
+    import numpy as np
+
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    from sparkucx_tpu.utils import anatomy as _anatomy
+    from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+
+    rng = np.random.default_rng(seed)
+    data = [rng.integers(0, 1 << 40, size=rows_per_map, dtype=np.int64)
+            for _ in range(maps)]
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    mgr = TpuShuffleManager(node, conf)
+    sid_box = [60000]
+
+    def one_exchange(**read_kw):
+        sid = sid_box[0]
+        sid_box[0] += 1
+        h = mgr.register_shuffle(sid, maps, partitions)
+        for m in range(maps):
+            w = mgr.get_writer(h, m)
+            if read_kw.get("combine"):
+                k = data[m] % 37
+                w.write(k, np.stack([k, np.ones_like(k)],
+                                    axis=1).astype(np.int32))
+            else:
+                w.write(data[m])
+            w.commit(partitions)
+        res = mgr.read(h, **read_kw)
+        if read_kw.get("sink") == "device":
+            res.host_view()
+        else:
+            res.partition(0)
+        rep = mgr.reports()[-1]
+        mgr.unregister_shuffle(sid)
+        return rep
+
+    def loop_median_ms():
+        times = []
+        for _ in range(exchanges):
+            t0 = _time.perf_counter()
+            one_exchange()
+            times.append(_time.perf_counter() - t0)
+        times.sort()
+        return times[len(times) // 2] * 1e3
+
+    def count_hooks():
+        """Anatomy hook invocations ONE disabled-tracing exchange
+        executes: every no-op span context, every guarded record_span,
+        and the settlement early-return."""
+        counts = {"span": 0, "record_span": 0, "settle": 0}
+        saved = (type(GLOBAL_TRACER).span,
+                 type(GLOBAL_TRACER).record_span,
+                 TpuShuffleManager._settle_anatomy)
+
+        def _span(self, name, **attrs):
+            counts["span"] += 1
+            return saved[0](self, name, **attrs)
+
+        def _record(self, name, t0, t1=None, **attrs):
+            counts["record_span"] += 1
+            return saved[1](self, name, t0, t1, **attrs)
+
+        def _settle(self, report, completed):
+            counts["settle"] += 1
+            return saved[2](self, report, completed)
+
+        type(GLOBAL_TRACER).span = _span
+        type(GLOBAL_TRACER).record_span = _record
+        TpuShuffleManager._settle_anatomy = _settle
+        try:
+            one_exchange()
+        finally:
+            (type(GLOBAL_TRACER).span,
+             type(GLOBAL_TRACER).record_span,
+             TpuShuffleManager._settle_anatomy) = saved
+        return counts
+
+    def microbench(fn, n=20000):
+        fn()
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            fn()
+        return (_time.perf_counter() - t0) / n * 1e6
+
+    out = {"exchanges": exchanges, "rows_per_map": rows_per_map,
+           "maps": maps, "partitions": partitions, "reps": reps}
+    try:
+        loop_median_ms()           # warmup: compile + caches
+        hook_counts = count_hooks()
+        assert not GLOBAL_TRACER.enabled
+
+        def _one_span():
+            with GLOBAL_TRACER.span("bench.noop"):
+                pass
+
+        t_ref = _time.perf_counter()
+        hook_us = {
+            "span": microbench(_one_span),
+            "record_span": microbench(
+                lambda: GLOBAL_TRACER.record_span("bench.noop", t_ref,
+                                                  t_ref)),
+            "settle": microbench(
+                lambda: mgr._settle_anatomy(mgr.reports()[-1], True)),
+        }
+        est_us = sum(hook_counts[k] * hook_us[k] for k in hook_counts)
+        disabled_ms = math.inf
+        for _ in range(reps):
+            disabled_ms = min(disabled_ms, loop_median_ms())
+
+        # enabled context: per-read-mode conservation + the fold cost
+        modes = (("plain", {}), ("ordered", {"ordered": True}),
+                 ("combine", {"combine": "sum"}),
+                 ("device_sink", {"sink": "device"}))
+        conservation = {}
+        fold_us = math.inf
+        GLOBAL_TRACER.enabled = True
+        try:
+            for name, kw in modes:
+                GLOBAL_TRACER.clear()
+                rep = one_exchange(**kw)
+                att = (1.0 - rep.dark_ms / rep.anatomy_wall_ms
+                       if rep.anatomy_wall_ms > 0 else 0.0)
+                conservation[name] = {
+                    "wall_ms": round(rep.anatomy_wall_ms, 3),
+                    "dark_ms": round(rep.dark_ms, 3),
+                    "attributed": round(att, 4),
+                    "phases": {k: round(v, 3)
+                               for k, v in rep.phases.items() if v}}
+                fold_us = min(fold_us, microbench(
+                    lambda: _anatomy.fold_tracer(GLOBAL_TRACER,
+                                                 rep.trace_id),
+                    n=200))
+        finally:
+            GLOBAL_TRACER.enabled = False
+            GLOBAL_TRACER.clear()
+    finally:
+        mgr.stop()
+        node.close()
+    out["hook_counts_per_exchange"] = hook_counts
+    out["hook_cost_us"] = {k: round(v, 4) for k, v in hook_us.items()}
+    out["anatomy_us_per_exchange"] = round(est_us, 3)
+    out["median_exchange_ms_disabled"] = round(disabled_ms, 4)
+    out["overhead_disabled_pct"] = round(
+        est_us / 1e3 / disabled_ms * 100.0, 4)
+    out["fold_us_enabled"] = round(fold_us, 2)
+    out["conservation"] = conservation
+    out["min_attributed"] = round(
+        min(c["attributed"] for c in conservation.values()), 4)
+    return out
+
+
+def stage_anatomy(args) -> int:
+    """``--stage anatomy``: prove the exchange-anatomy plane costs <1%
+    of the CPU exchange loop when tracing is disabled (deterministic
+    accounting, the obs-overhead discipline) AND that an enabled fold
+    attributes ≥95% of every read mode's wall (the conservation
+    contract). Prints ONE JSON line and writes
+    bench_runs/anatomy.json."""
+    out = {"metric": "anatomy",
+           "detail": anatomy_measure(
+               exchanges=20, rows_per_map=1 << (args.rows_log2 or 11),
+               reps=args.reps)}
+    out["ok"] = (out["detail"]["overhead_disabled_pct"] < 1.0
+                 and out["detail"]["min_attributed"] >= 0.95)
+    out["telemetry"] = _telemetry_blob()
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_runs", "anatomy.json")
+    try:
+        os.makedirs(os.path.dirname(artifact), exist_ok=True)
+        _write_artifact(artifact, out)
+        out["artifact"] = os.path.relpath(
+            artifact, os.path.dirname(os.path.abspath(__file__)))
+    except OSError as e:
+        out["artifact_error"] = str(e)[:200]
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 2
+
+
 def pipeline_measure(rows_per_map=1 << 16, maps=8, partitions=16,
                      val_words=16, wave_rows=None, depth=2, reps=3,
                      seed=0):
@@ -4935,7 +5134,8 @@ def main() -> None:
                          "form since r5; stable = 1-key stable sort — "
                          "the conf default)")
     ap.add_argument("--stage", default=None,
-                    choices=("coldstart", "obs-overhead", "regress",
+                    choices=("coldstart", "obs-overhead", "anatomy",
+                             "regress",
                              "pipeline", "devplane", "ragged", "chaos",
                              "wire", "integrity", "devread",
                              "devcombine", "tenancy", "hier", "slo",
@@ -4946,7 +5146,11 @@ def main() -> None:
                          "capBuckets drifting-shape compile sweep); "
                          "obs-overhead = telemetry-plane cost on the "
                          "exchange loop (disabled + doctor pass must "
-                         "each be <1%); regress = diff a bench artifact "
+                         "each be <1%); anatomy = exchange-anatomy "
+                         "plane cost (disabled-path hooks <1%) + the "
+                         "per-read-mode conservation contract "
+                         "(attributed >= 95%); regress = diff a bench "
+                         "artifact "
                          "against a prior one into doctor-schema "
                          "findings; pipeline = wave-pipelined vs "
                          "single-shot A/B (overlap efficiency, bounded "
@@ -5083,6 +5287,7 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
         sys.exit({"coldstart": stage_coldstart,
                   "obs-overhead": stage_obs_overhead,
+                  "anatomy": stage_anatomy,
                   "regress": stage_regress,
                   "pipeline": stage_pipeline,
                   "devplane": stage_devplane,

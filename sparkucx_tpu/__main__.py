@@ -30,6 +30,20 @@ Subcommands:
     REJECTED rather than silently misaligned, and so are ``stats``/
     ``trace`` inputs.
 
+``anatomy [--input DUMP_OR_DIR ...] [--live-url URL] [--trace ID]
+[--format text|json] [--min-attributed F] [--out TIMELINE.json]``
+    The exchange anatomy view (utils/anatomy.py): per-exchange phase
+    ledgers — every wall millisecond attributed to one canonical phase
+    (plan / compile / pack / admission_wait / barrier_wait /
+    transfer.ici / transfer.dcn / merge / sink / spill / verify) or
+    surfaced as ``dark_time`` with its uncovered intervals — plus the
+    cluster critical path (which process, tier and phase bounded the
+    exchange) when the inputs span processes. ``--min-attributed 0.95``
+    exits 1 when any rendered ledger conserves less than 95% of its
+    wall (the CI gate shape); exit 2 when the input holds no settled
+    exchange at all. ``--out`` writes the clock-merged Perfetto
+    timeline with the phase covers as child tracks under each process.
+
 ``doctor [--input DUMP_OR_DIR ...] [--format text|json] [--fail-on G]``
     Automated diagnosis: run the rule engine (utils/doctor.py) over one
     or many telemetry dumps — or this live process — and print graded
@@ -245,7 +259,7 @@ def _cmd_timeline(args) -> int:
         docs = [_load_anchored(p) for p in paths]
     else:
         docs = [_live_snapshot()]
-    doc = merge_timeline(docs)
+    doc = merge_timeline(docs, anatomy=getattr(args, "anatomy", False))
     out = args.out or "timeline.json"
     from sparkucx_tpu.utils.atomicio import atomic_write_json
     atomic_write_json(out, doc, indent=None)
@@ -289,6 +303,61 @@ def _cmd_doctor(args) -> int:
         floor = GRADES.index(args.fail_on)
         if any(GRADES.index(f.grade) >= floor for f in findings):
             return 3
+    return 0
+
+
+def _cmd_anatomy(args) -> int:
+    from sparkucx_tpu.utils import anatomy
+    if getattr(args, "live_url", None):
+        docs = [_fetch_live(args.live_url)]
+    elif args.input is not None:
+        # history JSONL logs carry window deltas, not trace events —
+        # skip them like the timeline does; anchors are checked by the
+        # critical path itself (a single-process ledger is clock-local
+        # and must render even from an anchor-less dump)
+        paths = [p for p in _expand_inputs(args.input)
+                 if not p.endswith(".jsonl")]
+        if not paths:
+            raise FileNotFoundError(
+                "--input held only history_*.jsonl window logs; the "
+                "anatomy view needs snapshot/flight dumps (trace "
+                "events)")
+        docs = [_load(p) for p in paths]
+    else:
+        from sparkucx_tpu.runtime.node import TpuNode
+        node = TpuNode._instance
+        if node is not None and not node._closed:
+            docs = [node.telemetry_snapshot()]
+        else:
+            docs = [_live_snapshot()]
+    rep = anatomy.report_from_docs(docs, trace_id=args.trace)
+    if args.format == "json":
+        print(json.dumps(rep, indent=1, default=repr))
+    else:
+        for led in rep["ledgers"]:
+            sys.stdout.write(anatomy.render_ledger(led))
+        sys.stdout.write(
+            anatomy.render_critical_path(rep["critical_path"]))
+    if args.out:
+        from sparkucx_tpu.utils.atomicio import atomic_write_json
+        from sparkucx_tpu.utils.export import merge_timeline
+        tl = merge_timeline(docs, anatomy=True)
+        atomic_write_json(args.out, tl, indent=None)
+        print(f"wrote {len(tl['traceEvents'])} events (phase child "
+              f"tracks included) -> {args.out}")
+    if not rep["ledgers"]:
+        print("anatomy: no settled exchange in input (tracer off, or "
+              "no read ran)", file=sys.stderr)
+        return 2
+    if args.min_attributed is not None:
+        worst = min(led.get("attributed", 0.0)
+                    for led in rep["ledgers"])
+        if worst < args.min_attributed:
+            print(f"anatomy: conservation audit FAILED — worst ledger "
+                  f"attributed {100.0 * worst:.1f}% "
+                  f"< {100.0 * args.min_attributed:.1f}% required",
+                  file=sys.stderr)
+            return 1
     return 0
 
 
@@ -418,6 +487,38 @@ def main(argv=None) -> int:
                            "directories (default: this process, live)")
     p_tl.add_argument("--out", default=None,
                       help="output path (default timeline.json)")
+    p_tl.add_argument("--anatomy", action="store_true",
+                      help="also render each exchange's swept phase "
+                           "cover (utils/anatomy.py ledger, dark "
+                           "segments included) as child tracks under "
+                           "its process")
+    p_an = sub.add_parser(
+        "anatomy",
+        help="exchange anatomy: per-exchange phase ledgers with the "
+             "conservation audit (dark_time) and the cluster critical "
+             "path, from live telemetry or dumps")
+    p_an.add_argument("--input", nargs="*", default=None,
+                      help="snapshot/flight dump files or dump "
+                           "directories; several join into the "
+                           "cluster critical path (default: this "
+                           "process, live)")
+    p_an.add_argument("--live-url", default=None,
+                      help="fold a running node's /snapshot "
+                           "(metrics.httpPort server)")
+    p_an.add_argument("--trace", default=None,
+                      help="restrict to one exchange trace id "
+                           "(default: every settled exchange, most "
+                           "recent last)")
+    p_an.add_argument("--format", default="text",
+                      choices=("text", "json"))
+    p_an.add_argument("--min-attributed", type=float, default=None,
+                      metavar="FRACTION",
+                      help="exit 1 when any rendered ledger attributes "
+                           "less than this fraction of its wall "
+                           "(e.g. 0.95 — the CI conservation gate)")
+    p_an.add_argument("--out", default=None,
+                      help="write the clock-merged Perfetto timeline "
+                           "with phase child tracks here")
     p_doc = sub.add_parser(
         "doctor",
         help="automated diagnosis: graded findings + the conf key to "
@@ -512,6 +613,8 @@ def main(argv=None) -> int:
         return _cmd_timeline(args)
     if args.cmd == "doctor":
         return _cmd_doctor(args)
+    if args.cmd == "anatomy":
+        return _cmd_anatomy(args)
     if args.cmd == "slo":
         return _cmd_slo(args)
     return _cmd_keys(args)

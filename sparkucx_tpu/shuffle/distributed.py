@@ -81,13 +81,22 @@ def allgather_blob(blob: np.ndarray,
     runtime/watchdog.py): on expiry it raises
     :class:`~sparkucx_tpu.runtime.failures.PeerLostError` after a
     liveness probe and a flight postmortem, instead of hanging forever.
-    With the watchdog off (the default) this is a direct call."""
+    With the watchdog off (the default) this is a direct call.
+
+    Anatomy span: every round records as ``shuffle.barrier`` (the
+    barrier_wait phase) — the call is a rendezvous on the slowest
+    process by construction. No trace attr (the channel is shared by
+    trace-less callers like the clock-anchor gather); the ledger
+    attributes it by containment inside the exchange wall."""
     from jax.experimental import multihost_utils
 
     from sparkucx_tpu.runtime.watchdog import current_watchdog
-    return current_watchdog().call(
-        lambda: np.asarray(multihost_utils.process_allgather(blob)),
-        what=what)
+    from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+    with GLOBAL_TRACER.span("shuffle.barrier", kind="allgather",
+                            what=what):
+        return current_watchdog().call(
+            lambda: np.asarray(multihost_utils.process_allgather(blob)),
+            what=what)
 
 
 def allgather_json(obj) -> list:
@@ -356,70 +365,93 @@ class PendingDistributedShuffle(PendingExchangeBase):
             # like the metadata allgathers (PeerLostError past the
             # deadline, never a silent hang).
             from sparkucx_tpu.runtime.watchdog import current_watchdog
-            mine = current_watchdog().call(
-                lambda: any(bool(np.asarray(s.data).any())
-                            for s in ovf.addressable_shards),
-                # the fused hierarchical step cannot split its tiers
-                # under separate deadlines (shuffle/topology.py does,
-                # single-process) — but the fence should still SAY the
-                # wait covered both fabrics when it expires
-                what="hierarchical (ici+dcn fused) exchange completion "
-                     "wait" if self._hier_mesh is not None
-                else "exchange completion wait")
+            from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+            # anatomy span: this wait IS the fabric transfer from the
+            # host's point of view (the dispatched collective draining);
+            # the tier attr routes it to transfer.dcn/ici in the ledger
+            # (containment-matched — no trace id on this signature)
+            with GLOBAL_TRACER.span(
+                    "shuffle.exchange.wait",
+                    tier="ici+dcn" if self._hier_mesh is not None
+                    else "dcn"):
+                mine = current_watchdog().call(
+                    lambda: any(bool(np.asarray(s.data).any())
+                                for s in ovf.addressable_shards),
+                    # the fused hierarchical step cannot split its tiers
+                    # under separate deadlines (shuffle/topology.py
+                    # does, single-process) — but the fence should still
+                    # SAY the wait covered both fabrics when it expires
+                    what="hierarchical (ici+dcn fused) exchange "
+                         "completion wait"
+                    if self._hier_mesh is not None
+                    else "exchange completion wait")
             ovf_global = bool(allgather_blob(
                 np.array([1 if mine else 0], dtype=np.int64),
                 what="overflow verdict").any())
             if not ovf_global:
-                if cur.combine or cur.ordered or self._hier_mesh is not None:
-                    # SHARDED seg output — collect this process's rows:
-                    # [1, R] own counts under combine/ordered, else
-                    # [S, R] relay counts (hierarchical)
-                    ns = 1 if (cur.combine or cur.ordered) \
-                        else self._hier_mesh.devices.shape[0]
-                    seg_host = _local_shards_of(seg, self._shard_ids, ns)
-                else:
-                    # flat uncombined: replicated [P, R] — any addressable
-                    # copy is the whole matrix (np.asarray rejects
-                    # multi-process arrays)
-                    seg_host = np.asarray(seg.addressable_shards[0].data)
-                # per-shard capacity from the OUTPUT, not the plan: the
-                # pallas transport's buffers are chunk-inflated
-                # (cap_eff = align(cap_out) + P*chunk), so slicing by
-                # cur.cap_out would misattribute shards (reader.py's
-                # single-process _result_inner derives it the same way)
-                cap_shard = rows_out.shape[0] // Pn
-                align_chunk = 0
-                if cur.impl == "pallas" and not (cur.combine
-                                                 or cur.ordered):
-                    from sparkucx_tpu.ops.pallas.ragged_a2a import \
-                        chunk_rows_for
-                    # wire-aware: the step aligned on the WIRE row width
-                    align_chunk = chunk_rows_for(
-                        wire_row_words(cur, self._width))
-                elif cur.strips_active():
-                    # degenerate 1-shard cluster: step_body takes the
-                    # strip fast path (see reader.py resolve)
-                    align_chunk = cur.strip_rows()
-                local_payload = _local_shards_of(rows_out, self._shard_ids,
-                                                 cap_shard)
-                res = DistributedReaderResult(
-                    R, part_to_shard, self._shard_ids, local_payload,
-                    seg_host, self._val_shape, self._val_dtype,
-                    align_chunk=align_chunk)
-                # the distributed path force-materializes its local
-                # shards host-side — honest d2h accounting (the device
-                # sink is single-process for now; manager._resolve_sink)
-                from sparkucx_tpu.shuffle.reader import _note_d2h
-                _note_d2h(res, int(local_payload.nbytes))
-                res.cap_out_used = cur.cap_out
-                if not (cur.combine or cur.ordered
-                        or self._hier_mesh is not None):
-                    # flat plain: the replicated [P, R] seg carries true
-                    # delivered counts, identical on every process — the
-                    # manager's hint decay stays in SPMD lockstep
-                    res.recv_rows_needed = max_recv_rows(
-                        seg_host, part_to_shard, Pn)
-                return res
+                # anatomy span (sink phase): result assembly — the
+                # local-shard drain and seg pull between the collective
+                # completing and the wall settling (containment-matched,
+                # same as reader.py's single-process tail)
+                with GLOBAL_TRACER.span("shuffle.result",
+                                        sink=self._plan.sink):
+                    if cur.combine or cur.ordered \
+                            or self._hier_mesh is not None:
+                        # SHARDED seg output — collect this process's
+                        # rows: [1, R] own counts under combine/ordered,
+                        # else [S, R] relay counts (hierarchical)
+                        ns = 1 if (cur.combine or cur.ordered) \
+                            else self._hier_mesh.devices.shape[0]
+                        seg_host = _local_shards_of(seg, self._shard_ids,
+                                                    ns)
+                    else:
+                        # flat uncombined: replicated [P, R] — any
+                        # addressable copy is the whole matrix
+                        # (np.asarray rejects multi-process arrays)
+                        seg_host = np.asarray(
+                            seg.addressable_shards[0].data)
+                    # per-shard capacity from the OUTPUT, not the plan:
+                    # the pallas transport's buffers are chunk-inflated
+                    # (cap_eff = align(cap_out) + P*chunk), so slicing by
+                    # cur.cap_out would misattribute shards (reader.py's
+                    # single-process _result_inner derives it the same
+                    # way)
+                    cap_shard = rows_out.shape[0] // Pn
+                    align_chunk = 0
+                    if cur.impl == "pallas" and not (cur.combine
+                                                     or cur.ordered):
+                        from sparkucx_tpu.ops.pallas.ragged_a2a import \
+                            chunk_rows_for
+                        # wire-aware: the step aligned on the WIRE row
+                        # width
+                        align_chunk = chunk_rows_for(
+                            wire_row_words(cur, self._width))
+                    elif cur.strips_active():
+                        # degenerate 1-shard cluster: step_body takes the
+                        # strip fast path (see reader.py resolve)
+                        align_chunk = cur.strip_rows()
+                    local_payload = _local_shards_of(
+                        rows_out, self._shard_ids, cap_shard)
+                    res = DistributedReaderResult(
+                        R, part_to_shard, self._shard_ids, local_payload,
+                        seg_host, self._val_shape, self._val_dtype,
+                        align_chunk=align_chunk)
+                    # the distributed path force-materializes its local
+                    # shards host-side — honest d2h accounting (the
+                    # device sink is single-process for now;
+                    # manager._resolve_sink)
+                    from sparkucx_tpu.shuffle.reader import _note_d2h
+                    _note_d2h(res, int(local_payload.nbytes))
+                    res.cap_out_used = cur.cap_out
+                    if not (cur.combine or cur.ordered
+                            or self._hier_mesh is not None):
+                        # flat plain: the replicated [P, R] seg carries
+                        # true delivered counts, identical on every
+                        # process — the manager's hint decay stays in
+                        # SPMD lockstep
+                        res.recv_rows_needed = max_recv_rows(
+                            seg_host, part_to_shard, Pn)
+                    return res
             if self._attempt >= self._plan.max_retries:
                 raise RuntimeError(
                     f"shuffle still overflowing after "
@@ -430,7 +462,14 @@ class PendingDistributedShuffle(PendingExchangeBase):
                      "(attempt %d)", cur.cap_out, self._attempt)
             self._plan = cur.grown()
             self._attempt += 1
-            self._dispatch()
+            # anatomy span (pack phase): the grown-capacity redispatch
+            # re-stages and re-dispatches inside result() — dark on
+            # every overflow retry otherwise (containment-matched, no
+            # trace id on the pending side)
+            from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+            with GLOBAL_TRACER.span("shuffle.dispatch",
+                                    retry=self._attempt):
+                self._dispatch()
 
 
 def submit_shuffle_distributed(

@@ -55,12 +55,19 @@ def _build_hier_step(mesh: Mesh, dcn_axis: str, ici_axis: str,
     programs instead of recompiling both tiers."""
     from sparkucx_tpu.shuffle.stepcache import GLOBAL_STEP_CACHE
     from sparkucx_tpu.shuffle.topology import mesh_cache_key
-    return GLOBAL_STEP_CACHE.get(
-        ("hier", mesh_cache_key(mesh), dcn_axis, ici_axis, plan, width),
-        lambda: _build_hier_step_uncached(mesh, dcn_axis, ici_axis, plan,
-                                          width),
-        {"kind": "hier", "cap_in": plan.cap_in, "cap_out": plan.cap_out,
-         "width": width, "impl": plan.impl, "wire": plan.wire})
+    from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+
+    # anatomy span (compile phase): on a cache hit this is ~ns; on a
+    # miss it wraps the trace+lower of BOTH tiers (the inner
+    # compile.step span from stepcache covers the jit alone)
+    with GLOBAL_TRACER.span("shuffle.hier.build", ici=ici_axis,
+                            dcn=dcn_axis, width=width):
+        return GLOBAL_STEP_CACHE.get(
+            ("hier", mesh_cache_key(mesh), dcn_axis, ici_axis, plan, width),
+            lambda: _build_hier_step_uncached(mesh, dcn_axis, ici_axis, plan,
+                                              width),
+            {"kind": "hier", "cap_in": plan.cap_in, "cap_out": plan.cap_out,
+             "width": width, "impl": plan.impl, "wire": plan.wire})
 
 
 def _build_hier_step_uncached(mesh: Mesh, dcn_axis: str, ici_axis: str,

@@ -256,9 +256,24 @@ class ExchangeReport:
     # the TWO-HOP SUM (the real fabric cost), not the flat
     # single-collective lower bound the pre-topology reports carried.
     tiers: List[Dict] = field(default_factory=list)
+    # Exchange anatomy (utils/anatomy.py, folded at settlement when the
+    # tracer is on): the conservation-audited phase ledger — swept
+    # non-overlapping wall milliseconds per canonical phase, whose sum
+    # plus ``dark_ms`` equals ``anatomy_wall_ms`` exactly.
+    # ``dark_intervals`` are the uncovered [start, end] pairs (ms into
+    # the wall) — the dark_time doctor rule's evidence. Empty/0 when
+    # the tracer is off (the direct-timed plan/pack/dispatch fields
+    # above stay authoritative either way).
+    phases: Dict[str, float] = field(default_factory=dict)
+    dark_ms: float = 0.0
+    anatomy_wall_ms: float = 0.0
+    dark_intervals: List[List[float]] = field(default_factory=list)
     completed: bool = False
     error: Optional[str] = None
     # bookkeeping, excluded from to_dict()
+    # exchange wall start (perf_counter, set by _new_report) — closed
+    # into the shuffle.exchange wall span at settlement
+    _t_start: float = field(default=0.0, repr=False)
     _full_done: bool = field(default=False, repr=False)
     _t_dispatched: float = field(default=0.0, repr=False)
     _hits0: float = field(default=0.0, repr=False)
@@ -950,6 +965,19 @@ class TpuShuffleManager:
         if rep is None or rep._full_done:
             return
         rep._full_done = True
+        # the verify wall as an anatomy span — recorded on BOTH verdicts
+        # (a corruption raise still burned the wall it burned), covering
+        # the device-sampled variant through the delegation below
+        _t0_verify = time.perf_counter()
+        try:
+            self._verify_full_inner(handle, res, rep, combine)
+        finally:
+            self.node.tracer.record_span(
+                "shuffle.verify", _t0_verify, level="full",
+                shuffle_id=handle.shuffle_id, trace=rep.trace_id)
+
+    def _verify_full_inner(self, handle: ShuffleHandle, res,
+                           rep, combine: Optional[str] = None) -> None:
         if getattr(res, "sink", "host") == "device":
             # device sink: the full digest check is host-side by design
             # and forcing the whole drain would re-pay the round-trip
@@ -1181,6 +1209,10 @@ class TpuShuffleManager:
             process_id=self.node.process_id, distributed=distributed,
             hierarchical=self.hierarchical,
             tenant=handle.tenant)
+        # the exchange WALL starts here: a report exists from read start
+        # (postmortem discipline), and the anatomy plane conserves
+        # against this instant at settlement
+        rep._t_start = time.perf_counter()
         # step-cache counters are process-global; the delta between read
         # start and completion attributes compiles to this exchange
         # (approximate under concurrent reads, exact in the common case)
@@ -1586,8 +1618,15 @@ class TpuShuffleManager:
                     - state.get("own0", 0))
                 self._grant_inflight_locked(tid, nbytes)
                 state["reserved"] = nbytes
-                waited = (_time.perf_counter()
-                          - state["queued_at"]) * 1e3
+                t_grant = _time.perf_counter()
+                waited = (t_grant - state["queued_at"]) * 1e3
+                if report is not None:
+                    # the deferred-admission wall as an anatomy span:
+                    # enqueue -> grant, trace-tagged so the ledger's
+                    # admission_wait phase is this exact interval
+                    self.node.tracer.record_span(
+                        "shuffle.admit.wait", state["queued_at"],
+                        t_grant, trace=report.trace_id, tenant=tid)
                 metrics.observe(labeled(H_ADMIT_WAIT, tenant=tid),
                                 waited)
                 metrics.observe(labeled(H_ADMIT_CROSS, tenant=tid),
@@ -1956,9 +1995,17 @@ class TpuShuffleManager:
         # metadata fetch must still be explainable from the postmortem
         rep = self._new_report(handle, distributed=False)
         try:
-            return self._submit_local_staged(
-                handle, timeout, combine, ordered, combine_sum_words, rep,
-                sink=sink)
+            # anatomy envelope (plan phase, lowest priority): absorbs
+            # the submit-side slivers BETWEEN the precise spans — report
+            # setup, plan decoration, admitter arming — so the ledger
+            # conserves; the barrier/pack/dispatch/compile spans inside
+            # all outrank it in the sweep
+            with self.node.tracer.span("shuffle.submit",
+                                       shuffle_id=handle.shuffle_id,
+                                       trace=rep.trace_id):
+                return self._submit_local_staged(
+                    handle, timeout, combine, ordered, combine_sum_words,
+                    rep, sink=sink)
         except BaseException as e:
             rep.error = rep.error or repr(e)[:300]
             # a read that dies before arming never reaches on_done — the
@@ -1974,7 +2021,11 @@ class TpuShuffleManager:
         sink = self._resolve_sink(sink, combine, ordered,
                                   distributed=False)
         rep.sink = sink
-        if not handle.entry.wait_complete(timeout):
+        with tracer.span("shuffle.barrier", kind="map_outputs",
+                         shuffle_id=handle.shuffle_id,
+                         trace=rep.trace_id):
+            complete = handle.entry.wait_complete(timeout)
+        if not complete:
             raise TimeoutError(
                 f"shuffle {handle.shuffle_id}: only "
                 f"{handle.entry.num_present}/{handle.num_maps} map outputs "
@@ -2044,9 +2095,15 @@ class TpuShuffleManager:
                                  bounds=handle.bounds)
                 plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
             rep.plan_ms = (time.perf_counter() - t_plan) * 1e3
-            plan = self._decorated_plan(plan, combine, ordered, has_vals,
-                                        val_tail, val_dtype,
-                                        combine_sum_words, sink=sink)
+            # the decoration validates dtypes against the mode (ordered/
+            # combine) and can pay a one-time compile-adjacent cost on
+            # the first decorated read — plan phase, its own span so the
+            # ledger sees it (rep.plan_ms keeps its original meaning)
+            with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id,
+                             decorate=True, trace=rep.trace_id):
+                plan = self._decorated_plan(plan, combine, ordered,
+                                            has_vals, val_tail, val_dtype,
+                                            combine_sum_words, sink=sink)
 
             # fuse key+value bytes into one int32 row matrix (bit views, no
             # value casts — jnp would silently truncate int64 with x64 off)
@@ -2423,6 +2480,7 @@ class TpuShuffleManager:
         handle_box = {}
 
         def on_done(result):
+            _t_settle = time.perf_counter()
             self.node.pool.put(stage_buf)
             if result is not None and \
                     getattr(result, "sink", "host") == "device":
@@ -2512,6 +2570,19 @@ class TpuShuffleManager:
                     report.completed = True
                 else:
                     report.error = report.error or "exchange failed"
+                # exchange anatomy: close the wall span, fold the phase
+                # ledger, publish phase counters (utils/anatomy.py);
+                # two cheap guards when the tracer is off. The settle
+                # span first: on_done's own accounting (cap learning,
+                # tier settle, device-plane harvest) is the tail
+                # between the result landing and the wall closing, and
+                # it must not read as dark time
+                if self.node.tracer.enabled:
+                    self.node.tracer.record_span(
+                        "shuffle.settle", _t_settle,
+                        trace=report.trace_id)
+                self._settle_anatomy(report,
+                                     completed=result is not None)
                 # the exchange is settled either way — flight-ring events
                 # from here on belong to the next exchange
                 self.node.flight.end_trace(report.trace_id)
@@ -2527,6 +2598,54 @@ class TpuShuffleManager:
                     self._verify_full_result(handle, res, combine)
 
         return on_done, arm
+
+    def _settle_anatomy(self, report: ExchangeReport,
+                        completed: bool) -> None:
+        """Exchange-anatomy settlement (utils/anatomy.py): record the
+        ``shuffle.exchange`` WALL span (report start → now, trace-tagged
+        — the interval the conservation audit holds against), fold the
+        ring's spans into the phase ledger, stamp it onto the report,
+        and publish the ``shuffle.phase.ms{phase=...}`` counters that
+        ride TelemetryHistory frames into the phase_regression rule.
+        Tracer off = one enabled check + one no-op record_span per
+        exchange (the <1% disabled-path discipline, gated by
+        bench --stage anatomy). Fold failures degrade to an un-annotated
+        report — anatomy must never take down a read's settlement."""
+        tracer = self.node.tracer
+        if not tracer.enabled or not report._t_start:
+            return
+        try:
+            tracer.record_span(
+                "shuffle.exchange", report._t_start,
+                shuffle_id=report.shuffle_id, trace=report.trace_id,
+                tenant=report.tenant or self._tenants.default_id,
+                completed=completed)
+            from sparkucx_tpu.utils.anatomy import DARK, fold_tracer
+            from sparkucx_tpu.utils.metrics import (C_PHASE_MS,
+                                                    C_TRACE_DROPPED)
+            led = fold_tracer(tracer, report.trace_id)
+            if led is None:
+                return
+            report.phases = {k: round(v, 3)
+                             for k, v in led.phases_ms.items()}
+            report.dark_ms = round(led.dark_ms, 3)
+            report.anatomy_wall_ms = round(led.wall_ms, 3)
+            report.dark_intervals = [[round(a, 3), round(b, 3)]
+                                     for a, b in led.dark_intervals[:16]]
+            if completed:
+                # the single-shot on_done discipline: a failed exchange
+                # keeps its ledger as postmortem evidence but counts no
+                # phase milliseconds into the trend counters
+                metrics = self.node.metrics
+                for ph, ms in led.phases_ms.items():
+                    metrics.inc(labeled(C_PHASE_MS, phase=ph), ms)
+                if led.dark_ms > 0.0:
+                    metrics.inc(labeled(C_PHASE_MS, phase=DARK),
+                                led.dark_ms)
+            tracer.publish_dropped(self.node.metrics)
+        except Exception:
+            log.debug("anatomy settlement failed for %s",
+                      report.trace_id, exc_info=True)
 
     def _inc_volume(self, tenant: str, payload: float,
                     wire: float) -> None:
@@ -3180,8 +3299,14 @@ class TpuShuffleManager:
         self._resolve_sink(sink, combine, ordered, distributed=True)
         rep = self._new_report(handle, distributed=True)
         try:
-            return self._submit_distributed_impl(
-                handle, timeout, combine, ordered, combine_sum_words, rep)
+            # same anatomy envelope as _submit_local (plan phase,
+            # lowest sweep priority — the precise spans inside win)
+            with self.node.tracer.span("shuffle.submit",
+                                       shuffle_id=handle.shuffle_id,
+                                       trace=rep.trace_id):
+                return self._submit_distributed_impl(
+                    handle, timeout, combine, ordered,
+                    combine_sum_words, rep)
         except BaseException as e:
             rep.error = rep.error or repr(e)[:300]
             self.node.flight.end_trace(rep.trace_id)
@@ -3241,31 +3366,36 @@ class TpuShuffleManager:
                 f"maps) exceeds meta.bufferSize={limit}; raise "
                 f"spark.shuffle.tpu.meta.bufferSize")
         deadline = _time.monotonic() + timeout
-        while True:
-            bitmap = np.zeros(handle.num_maps + 1, dtype=np.int64)
-            for map_id, w in writers.items():
-                if w.committed:
-                    bitmap[map_id] = 1
-            bitmap[-1] = 1 if _time.monotonic() > deadline else 0
-            gathered = allgather_blob(bitmap)          # [nproc, M+1]
-            owners = gathered[:, :-1].sum(axis=0)
-            if (owners > 1).any():
-                dups = np.nonzero(owners > 1)[0].tolist()
-                raise RuntimeError(
-                    f"shuffle {handle.shuffle_id}: map ids {dups} committed "
-                    f"by multiple processes — ambiguous ownership (maps "
-                    f"must be partitioned over processes)")
-            total = int((owners > 0).sum())
-            if total >= handle.num_maps:
-                break
-            if gathered[:, -1].any():
-                raise TimeoutError(
-                    f"shuffle {handle.shuffle_id}: only {total}/"
-                    f"{handle.num_maps} map outputs published within "
-                    f"{timeout}s")
-            _time.sleep(0.05)
-            with self._lock:
-                writers = dict(self._writers.get(handle.shuffle_id, {}))
+        with tracer.span("shuffle.barrier", kind="map_outputs",
+                         shuffle_id=handle.shuffle_id,
+                         trace=rep.trace_id):
+            while True:
+                bitmap = np.zeros(handle.num_maps + 1, dtype=np.int64)
+                for map_id, w in writers.items():
+                    if w.committed:
+                        bitmap[map_id] = 1
+                bitmap[-1] = 1 if _time.monotonic() > deadline else 0
+                gathered = allgather_blob(bitmap)      # [nproc, M+1]
+                owners = gathered[:, :-1].sum(axis=0)
+                if (owners > 1).any():
+                    dups = np.nonzero(owners > 1)[0].tolist()
+                    raise RuntimeError(
+                        f"shuffle {handle.shuffle_id}: map ids {dups} "
+                        f"committed by multiple processes — ambiguous "
+                        f"ownership (maps must be partitioned over "
+                        f"processes)")
+                total = int((owners > 0).sum())
+                if total >= handle.num_maps:
+                    break
+                if gathered[:, -1].any():
+                    raise TimeoutError(
+                        f"shuffle {handle.shuffle_id}: only {total}/"
+                        f"{handle.num_maps} map outputs published within "
+                        f"{timeout}s")
+                _time.sleep(0.05)
+                with self._lock:
+                    writers = dict(
+                        self._writers.get(handle.shuffle_id, {}))
 
         committed_ids = sorted(m for m, w in writers.items() if w.committed)
 
@@ -3363,8 +3493,12 @@ class TpuShuffleManager:
             plan = self._apply_cap_hint(plan, handle, int(nvalid.sum()))
         if rep is not None:
             rep.plan_ms = (time.perf_counter() - t_plan) * 1e3
-        plan = self._decorated_plan(plan, combine, ordered, has_vals,
-                                    val_tail, val_dtype, combine_sum_words)
+        with tracer.span("shuffle.plan", shuffle_id=handle.shuffle_id,
+                         decorate=True,
+                         trace=rep.trace_id if rep is not None else ""):
+            plan = self._decorated_plan(plan, combine, ordered, has_vals,
+                                        val_tail, val_dtype,
+                                        combine_sum_words)
 
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)
@@ -4039,6 +4173,7 @@ class PendingWaveShuffle:
         mgr._finish_device_plane(rep, self._last_step, self._width,
                                  completed=True)
         rep.completed = True
+        mgr._settle_anatomy(rep, completed=True)
         mgr.node.flight.end_trace(rep.trace_id)
         metrics = mgr.node.metrics
         metrics.inc("shuffle.rows", float(self._local_rows))

@@ -1357,7 +1357,10 @@ def device_merge_fold(res: "DeviceShuffleReaderResult", mesh: Mesh,
         seg_box["seg"] = pcounts
         return (out_rows, out_n)
 
-    acc_rows, acc_n = res.consume(fold, None)
+    from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+    with GLOBAL_TRACER.span("shuffle.merge", waves=len(views),
+                            impl=merge_impl):
+        acc_rows, acc_n = res.consume(fold, None)
     view = LazyShuffleReaderResult(
         R, np.asarray(_blocked_map(R, Pn)), acc_rows, seg_box["seg"],
         Pn, acc_cap, res._val_shape, res._val_dtype,
@@ -1770,7 +1773,13 @@ class PendingExchangeBase:
                 # first dispatch (raises TimeoutError if nothing frees)
                 admit, self._admit_cb = self._admit_cb, None
                 admit(True)
-                self._dispatch()
+                # anatomy span (pack phase): the deferred first dispatch
+                # runs here, outside the manager's dispatch span — the
+                # admission wait above is covered, this must be too
+                from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+                with GLOBAL_TRACER.span("shuffle.dispatch",
+                                        deferred=True):
+                    self._dispatch()
             res = self._result_inner()
             # post-result hook (manager arms it at integrity.verify=full):
             # the post-collective digest check runs INSIDE result() so
@@ -1852,9 +1861,16 @@ class PendingShuffle(PendingExchangeBase):
         self._out = step(rows_flat, nvalid)
 
     def _result_inner(self) -> ShuffleReaderResult:
+        from sparkucx_tpu.utils.trace import GLOBAL_TRACER
         while True:
             rows_out, seg, total, ovf = self._out
-            if not np.asarray(ovf).any():
+            # anatomy span: materializing the overflow flag blocks until
+            # the dispatched collective drains — the single-process flat
+            # transfer wait (single-slice mesh => the ICI tier;
+            # containment-matched, no trace id on this signature)
+            with GLOBAL_TRACER.span("shuffle.exchange.wait", tier="ici"):
+                overflowed = bool(np.asarray(ovf).any())
+            if not overflowed:
                 break
             if self._attempt >= self._plan.max_retries:
                 raise RuntimeError(
@@ -1866,56 +1882,72 @@ class PendingShuffle(PendingExchangeBase):
                      "growing", self._plan.cap_out, self._attempt)
             self._plan = self._plan.grown()
             self._attempt += 1
-            self._dispatch()
-        Pn = self._plan.num_shards
-        R = self._plan.num_partitions
-        # cap per shard derives from the OUTPUT (the pallas transport
-        # rounds cap_out up to its chunk-aligned effective capacity)
-        cap_shard = rows_out.shape[0] // Pn
-        align_chunk = 0
-        if self._plan.impl == "pallas" and not (self._plan.combine
-                                                or self._plan.ordered):
-            # plain pallas delivers the chunk-aligned layout; combine/
-            # ordered densify on device and use the normal [1, R]
-            # contract. Chunk follows the WIRE row width — the same
-            # wire_row_words seam the step aligned with
-            from sparkucx_tpu.ops.pallas.ragged_a2a import chunk_rows_for
-            align_chunk = chunk_rows_for(
-                wire_row_words(self._plan, self._rows_host.shape[2]))
-        elif self._plan.strips_active():
-            # strip-sorted single-shard layout: each of the S virtual
-            # senders occupies one strip_rows-sized region (step_body's
-            # strip fast path); the [S, R] seg matrix indexes it with
-            # strip-aligned segment starts
-            align_chunk = self._plan.strip_rows()
-        res = LazyShuffleReaderResult(
-            R, np.asarray(_blocked_map(R, Pn)), rows_out, seg,
-            Pn, cap_shard, self._val_shape, self._val_dtype,
-            per_shard_segs=self._per_shard_segs, align_chunk=align_chunk)
-        # report the PLAN capacity, not the chunk-inflated buffer size:
-        # cap_out_used feeds the manager's learned-cap hint, and the
-        # inflated value would ratchet every same-shape pallas read into
-        # a bigger plan (and a recompile) forever
-        res.cap_out_used = self._plan.cap_out
-        res._totals_dev = total
-        if self._plan.sink == "device":
-            # device-resident sink: partitions stay the sharded arrays
-            # above — no drain, no seg pull (even the metadata read is
-            # deferred to an explicit host_view); the manager arms the
-            # HBM-residency release on the wrapper
-            return DeviceShuffleReaderResult(
-                [res], self._plan, self._val_shape, self._val_dtype)
-        if not (self._plan.combine or self._plan.impl == "pallas"):
-            # plain/ordered: the seg matrix carries true delivered counts
-            # (combine's is post-merge; pallas consumes aligned slack) —
-            # observable "needed" capacity for the manager's hint decay.
-            # Forcing _seg_matrix here costs one tiny host read the
-            # result would do on first partition() anyway.
-            res.recv_rows_needed = max_recv_rows(
-                res._seg_matrix(0) if not self._per_shard_segs
-                else np.asarray(seg).reshape(Pn, -1, R),
-                np.asarray(_blocked_map(R, Pn)), Pn)
-        return res
+            # anatomy span (pack phase): the grown-capacity redispatch
+            # re-stages the rows and re-dispatches inside result() —
+            # dark on every overflow retry otherwise (containment-
+            # matched, no trace id on the pending side)
+            with GLOBAL_TRACER.span("shuffle.dispatch",
+                                    retry=self._attempt):
+                self._dispatch()
+        # anatomy span (sink phase): result assembly — the seg-matrix
+        # host read and the lazy-result wrapper — is the tail between
+        # the collective draining and on_done settling the wall
+        with GLOBAL_TRACER.span("shuffle.result",
+                                sink=self._plan.sink):
+            Pn = self._plan.num_shards
+            R = self._plan.num_partitions
+            # cap per shard derives from the OUTPUT (the pallas
+            # transport rounds cap_out up to its chunk-aligned
+            # effective capacity)
+            cap_shard = rows_out.shape[0] // Pn
+            align_chunk = 0
+            if self._plan.impl == "pallas" and not (self._plan.combine
+                                                    or self._plan.ordered):
+                # plain pallas delivers the chunk-aligned layout;
+                # combine/ordered densify on device and use the normal
+                # [1, R] contract. Chunk follows the WIRE row width —
+                # the same wire_row_words seam the step aligned with
+                from sparkucx_tpu.ops.pallas.ragged_a2a import \
+                    chunk_rows_for
+                align_chunk = chunk_rows_for(
+                    wire_row_words(self._plan, self._rows_host.shape[2]))
+            elif self._plan.strips_active():
+                # strip-sorted single-shard layout: each of the S
+                # virtual senders occupies one strip_rows-sized region
+                # (step_body's strip fast path); the [S, R] seg matrix
+                # indexes it with strip-aligned segment starts
+                align_chunk = self._plan.strip_rows()
+            res = LazyShuffleReaderResult(
+                R, np.asarray(_blocked_map(R, Pn)), rows_out, seg,
+                Pn, cap_shard, self._val_shape, self._val_dtype,
+                per_shard_segs=self._per_shard_segs,
+                align_chunk=align_chunk)
+            # report the PLAN capacity, not the chunk-inflated buffer
+            # size: cap_out_used feeds the manager's learned-cap hint,
+            # and the inflated value would ratchet every same-shape
+            # pallas read into a bigger plan (and a recompile) forever
+            res.cap_out_used = self._plan.cap_out
+            res._totals_dev = total
+            if self._plan.sink == "device":
+                # device-resident sink: partitions stay the sharded
+                # arrays above — no drain, no seg pull (even the
+                # metadata read is deferred to an explicit host_view);
+                # the manager arms the HBM-residency release on the
+                # wrapper
+                return DeviceShuffleReaderResult(
+                    [res], self._plan, self._val_shape, self._val_dtype)
+            if not (self._plan.combine or self._plan.impl == "pallas"):
+                # plain/ordered: the seg matrix carries true delivered
+                # counts (combine's is post-merge; pallas consumes
+                # aligned slack) — observable "needed" capacity for the
+                # manager's hint decay. Forcing _seg_matrix here costs
+                # one tiny host read the result would do on first
+                # partition() anyway.
+                res.recv_rows_needed = max_recv_rows(
+                    res._seg_matrix(0) if not self._per_shard_segs
+                    else np.asarray(seg).reshape(Pn, -1, R),
+                    np.asarray(_blocked_map(R, Pn)), Pn)
+            return res
 
 
 def submit_shuffle(

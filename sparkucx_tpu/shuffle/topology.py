@@ -498,6 +498,13 @@ class TierHooks:
         return self.tracer.span("shuffle.tier", tier=tier,
                                 trace=self.trace_id)
 
+    def named_span(self, name: str, **attrs):
+        """A trace-tagged span for the result-side work the anatomy
+        ledger must not leave dark (stage-2 redispatch, assembly)."""
+        if self.tracer is None:
+            return nullcontext()
+        return self.tracer.span(name, trace=self.trace_id, **attrs)
+
     def record(self, kind: str, **data) -> None:
         if self.flight is not None:
             self.flight.record(kind, **data)
@@ -653,21 +660,37 @@ class PendingTieredShuffle(PendingExchangeBase):
             self._relay_cap *= 2
             self._retries1 += 1
             self._attempt += 1
-            self._dispatch()
-        # only tier metadata crosses to host: [P] totals + the flag
-        totals1 = np.asarray(tot1).astype(np.int64).reshape(-1)
+            # anatomy span (pack phase): the grown-capacity redispatch
+            # re-stages the rows and re-dispatches stage 1 inside
+            # result() — the same dark window as the stage-2 redispatch
+            # below, hit on every relay-capacity overflow
+            with self._hooks.named_span("shuffle.dispatch", stage=1,
+                                        retry=self._retries1):
+                self._dispatch()
+        # only tier metadata crosses to host: [P] totals + the flag —
+        # a blocking D2H on the stage-1 collective's output, so it
+        # rides the ICI tier span in the anatomy ledger
+        with self._hooks.span("ici"):
+            totals1 = np.asarray(tot1).astype(np.int64).reshape(-1)
         # -- stage 2: DCN, output-capacity retry loop ---------------------
         while True:
-            step2 = _build_stage2_step(self._mesh, self._topo, plan,
-                                       width, self._relay_cap,
-                                       plan.cap_out)
-            self._step = step2      # device-plane join point (cost rec)
-            nv2 = self._stage_to_device(seeded_nvalid(
-                plan, totals1,
-                (self._wire_seed + self._attempt) * 2 + 1))
-            self._t_stage = time.perf_counter()
-            self._stage = 2
-            self._out = step2(relay, nv2)
+            # anatomy span (pack phase): the stage-2 redispatch — step
+            # build + seed staging + the dispatch call — runs inside
+            # result(), outside the manager's dispatch span; untagged it
+            # is the hier ledger's biggest dark window. A stage-2 cache
+            # miss traces under compile.step, which outranks pack in the
+            # sweep, so the envelope never steals compile time.
+            with self._hooks.named_span("shuffle.dispatch", stage=2):
+                step2 = _build_stage2_step(self._mesh, self._topo, plan,
+                                           width, self._relay_cap,
+                                           plan.cap_out)
+                self._step = step2  # device-plane join point (cost rec)
+                nv2 = self._stage_to_device(seeded_nvalid(
+                    plan, totals1,
+                    (self._wire_seed + self._attempt) * 2 + 1))
+                self._t_stage = time.perf_counter()
+                self._stage = 2
+                self._out = step2(relay, nv2)
             rows_out, seg, total, ovf2 = self._out
             if not self._fenced_join("dcn", ovf2):
                 break
@@ -683,30 +706,33 @@ class PendingTieredShuffle(PendingExchangeBase):
             self._plan = plan
             self._retries2 += 1
             self._attempt += 1
-        Pn = plan.num_shards
-        R = plan.num_partitions
-        cap_shard = rows_out.shape[0] // Pn
-        res = LazyShuffleReaderResult(
-            R, np.asarray(_blocked_map(R, Pn)), rows_out, seg,
-            Pn, cap_shard, self._val_shape, self._val_dtype,
-            per_shard_segs=True, align_chunk=0)
-        res.cap_out_used = plan.cap_out
-        res._totals_dev = total
-        if not plan.combine:
-            # plain/ordered: observable delivered-rows requirement for
-            # the manager's learned-cap decay (combine's counts are
-            # post-merge) — same tiny host read as the flat path
-            seg_np = np.asarray(seg).reshape(Pn, -1, R)
-            res.recv_rows_needed = max_recv_rows(
-                seg_np, np.asarray(_blocked_map(R, Pn)), Pn)
-        if plan.sink == "device":
-            # the stage-2 output is already partition-sorted on device
-            # (partition-major stage-2 sort; ordered/combine land fully
-            # merged) — the device sink holds it resident exactly like
-            # the flat single-shot path
-            return DeviceShuffleReaderResult(
-                [res], plan, self._val_shape, self._val_dtype)
-        return res
+        # anatomy span (sink phase): result assembly — the seg pull and
+        # the lazy-result wrapper — same tail as the flat path's
+        with self._hooks.named_span("shuffle.result", sink=plan.sink):
+            Pn = plan.num_shards
+            R = plan.num_partitions
+            cap_shard = rows_out.shape[0] // Pn
+            res = LazyShuffleReaderResult(
+                R, np.asarray(_blocked_map(R, Pn)), rows_out, seg,
+                Pn, cap_shard, self._val_shape, self._val_dtype,
+                per_shard_segs=True, align_chunk=0)
+            res.cap_out_used = plan.cap_out
+            res._totals_dev = total
+            if not plan.combine:
+                # plain/ordered: observable delivered-rows requirement
+                # for the manager's learned-cap decay (combine's counts
+                # are post-merge) — same tiny host read as the flat path
+                seg_np = np.asarray(seg).reshape(Pn, -1, R)
+                res.recv_rows_needed = max_recv_rows(
+                    seg_np, np.asarray(_blocked_map(R, Pn)), Pn)
+            if plan.sink == "device":
+                # the stage-2 output is already partition-sorted on
+                # device (partition-major stage-2 sort; ordered/combine
+                # land fully merged) — the device sink holds it resident
+                # exactly like the flat single-shot path
+                return DeviceShuffleReaderResult(
+                    [res], plan, self._val_shape, self._val_dtype)
+            return res
 
 
 def submit_shuffle_tiered(

@@ -340,16 +340,22 @@ class MapOutputWriter:
                                      self.map_id)
             log.info("map %d spilling to %s (threshold %d B)", self.map_id,
                      self._spill.keys_path, self._spill_threshold)
-        for i, keys in enumerate(self._keys):
-            self._spill.append(
-                keys, self._values[i] if self._values else None)
-        self._keys.clear()
-        self._values.clear()
-        for b in self._staged:
-            self.pool.put(b)
-        self._staged.clear()
-        moved = self._staged_bytes
-        self._staged_bytes = 0
+        # anatomy span (spill phase): a spill forced DURING a read (the
+        # budget valve) lands inside the exchange wall by containment; a
+        # map-time threshold spill simply predates any wall and is
+        # ignored by the fold
+        with GLOBAL_TRACER.span("shuffle.spill", map_id=self.map_id,
+                                shuffle_id=self.entry.shuffle_id):
+            for i, keys in enumerate(self._keys):
+                self._spill.append(
+                    keys, self._values[i] if self._values else None)
+            self._keys.clear()
+            self._values.clear()
+            for b in self._staged:
+                self.pool.put(b)
+            self._staged.clear()
+            moved = self._staged_bytes
+            self._staged_bytes = 0
         if moved:
             # the spill-proven evidence (bench --stage analytics gates a
             # positive delta at the scale shape; the doctor's spill_bound

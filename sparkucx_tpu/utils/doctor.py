@@ -100,6 +100,20 @@ latency_trend     windowed read-wait p99 is drifting up    spark.shuffle.tpu.tra
                   divides the drift) so a load shift is
                   not misread as a regression — the "is
                   it getting worse right now" rule
+dark_time         the anatomy conservation audit            spark.shuffle.tpu.trace.capacity
+                  (utils/anatomy.py) left a material        (ring drops) /
+                  share of the settled exchange walls       spark.shuffle.tpu.trace.enabled
+                  attributed to no phase; evidence is
+                  the worst exchange's uncovered
+                  intervals, and a non-zero
+                  trace.spans.dropped counter redirects
+                  blame from instrumentation to ring
+                  capacity
+phase_regression  ONE canonical phase's windowed           per phase (anatomy._PHASE_CONF —
+                  ms-per-read is drifting vs baseline,     e.g. merge -> read.mergeImpl,
+                  payload-normalized like latency_trend    admission_wait -> a2a.maxBytesInFlight)
+                  — names WHICH phase is eating the
+                  wall and the knob that moves it
 ================  =======================================  =====================================
 
 The same :class:`Finding` schema carries ``bench.py --stage regress``
@@ -114,13 +128,15 @@ from typing import Any, Dict, Iterable, List, Optional, Union
 
 from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES, C_D2H, C_H2D,
                                         C_KERNEL_FALLBACK,
+                                        C_PHASE_MS,
                                         C_SINK_FALLBACK,
                                         C_INTEGRITY_CORRUPT,
                                         C_INTEGRITY_CORRUPT_BLOCKS,
                                         C_INTEGRITY_QUARANTINED,
                                         C_INTEGRITY_VERIFIED,
                                         C_PEER_TIMEOUT, C_PROBE_DEAD,
-                                        C_REPLAYS, COMPILE_HITS,
+                                        C_REPLAYS, C_TRACE_DROPPED,
+                                        COMPILE_HITS,
                                         COMPILE_PROGRAMS, COMPILE_SECONDS,
                                         G_HBM_IN_USE, G_HBM_LIMIT,
                                         H_ADMIT_CROSS, H_ADMIT_WAIT, H_BW,
@@ -314,6 +330,26 @@ class Thresholds:
     spill_share_critical: float = 0.7
     spill_min_wall_ms: float = 500.0
     spill_min_rows: float = 1000.0
+    # dark_time: the anatomy plane's conservation audit residual
+    # (utils/anatomy.py — exchange wall minus every swept phase
+    # interval) as a share of the settled walls. A healthy instrumented
+    # exchange attributes >= 95%; warn when the unattributed share says
+    # the phase story is incomplete, critical when most of the wall is
+    # dark (the operator is flying blind on where time goes). Floors
+    # per the PR-5 discipline: real wall and more than one settled
+    # read before any share can fire.
+    dark_share_warn: float = 0.15
+    dark_share_critical: float = 0.40
+    dark_min_wall_ms: float = 25.0
+    dark_min_reads: int = 2
+    # phase_regression: one canonical phase's windowed ms-per-read is
+    # drifting up vs the retained baseline windows, payload-normalized
+    # like latency_trend (shuffle.phase.ms{phase=} counters from
+    # anatomy settlement). Reuses the trend frame/read floors; the ms
+    # floor is per recent-window phase wall per read.
+    phase_trend_min_ms: float = 5.0
+    phase_trend_ratio: float = 3.0
+    phase_trend_critical: float = 10.0
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -1693,6 +1729,146 @@ def _rule_spill_bound(view: ClusterView,
     return out
 
 
+# phase -> the knob that most directly moves it. The autotuner arc's
+# hook (ROADMAP #4): a phase_regression finding names the dominant
+# growing phase AND the key to turn, so a closed loop can act on it.
+_PHASE_CONF = {
+    "plan": "spark.shuffle.tpu.a2a.impl",
+    "compile": "spark.shuffle.tpu.a2a.capBucketGrowth",
+    "pack": "spark.shuffle.tpu.a2a.waveRows",
+    "admission_wait": "spark.shuffle.tpu.a2a.maxBytesInFlight",
+    "barrier_wait": "spark.shuffle.tpu.failure.collectiveTimeoutMs",
+    "transfer.ici": "spark.shuffle.tpu.a2a.wire",
+    "transfer.dcn": "spark.shuffle.tpu.a2a.wire",
+    "merge": "spark.shuffle.tpu.read.mergeImpl",
+    "sink": "spark.shuffle.tpu.io.fetchGranularity",
+    "spill": "spark.shuffle.tpu.spill.threshold",
+    "verify": "spark.shuffle.tpu.integrity.verify",
+}
+
+
+def _rule_dark_time(view: ClusterView, th: Thresholds) -> List[Finding]:
+    """The anatomy plane's conservation audit failed: a material share
+    of the settled exchange walls is attributed to NO phase
+    (utils/anatomy.py dark_time — the residual after sweeping every
+    matched span interval over the wall). Evidence is the worst
+    exchange's uncovered intervals, which localize WHERE in the wall
+    the instrumentation hole sits; when the tracer ring dropped spans
+    (trace.spans.dropped) the ledger is dark because evidence fell off
+    the ring, and the remediation is capacity, not instrumentation."""
+    reps = [r for r in view.reports
+            if r.get("completed") and float(r.get("anatomy_wall_ms",
+                                                  0.0)) > 0]
+    if len(reps) < th.dark_min_reads:
+        return []
+    wall = sum(float(r["anatomy_wall_ms"]) for r in reps)
+    dark = sum(float(r.get("dark_ms", 0.0)) for r in reps)
+    if wall < th.dark_min_wall_ms:
+        return []
+    share = dark / wall
+    if share < th.dark_share_warn:
+        return []
+    worst = max(reps, key=lambda r: float(r.get("dark_ms", 0.0)))
+    dropped = float(view.counters.get(C_TRACE_DROPPED, 0.0))
+    ev = {"dark_share": round(share, 3),
+          "dark_ms": round(dark, 2),
+          "wall_ms": round(wall, 2),
+          "reads": len(reps),
+          "worst_trace": worst.get("trace_id", ""),
+          "worst_dark_ms": round(float(worst.get("dark_ms", 0.0)), 2),
+          "worst_dark_intervals_ms":
+              [[round(a, 2), round(b, 2)]
+               for a, b in (worst.get("dark_intervals") or [])][:8],
+          "trace_spans_dropped": int(dropped)}
+    if dropped > 0:
+        conf_key = "spark.shuffle.tpu.trace.capacity"
+        remediation = (f"the span ring dropped {int(dropped)} span(s) — "
+                       "the dark wall is likely evidence that fell off "
+                       "the ring, not missing instrumentation; raise "
+                       "trace.capacity (or fold closer to the exchange) "
+                       "and re-measure before chasing the intervals")
+    else:
+        conf_key = "spark.shuffle.tpu.trace.enabled"
+        remediation = ("un-instrumented wall time: pull the worst "
+                       "exchange's uncovered intervals (anatomy CLI "
+                       "--trace) and overlay them on the merged "
+                       "timeline — whatever runs in those windows "
+                       "carries no span; zero drops means this is an "
+                       "instrumentation hole, not ring pressure")
+    return [Finding(
+        rule="dark_time",
+        grade="critical" if share >= th.dark_share_critical else "warn",
+        summary=(f"{share:.0%} of {wall:.0f} ms of settled exchange "
+                 f"wall across {len(reps)} read(s) is attributed to no "
+                 f"phase (dark time); worst exchange "
+                 f"{worst.get('trace_id', '?')} carries "
+                 f"{float(worst.get('dark_ms', 0.0)):.1f} ms dark"),
+        evidence=ev,
+        conf_key=conf_key,
+        remediation=remediation,
+        trace_ids=[worst.get("trace_id", "")])]
+
+
+def _rule_phase_regression(view: ClusterView,
+                           th: Thresholds) -> List[Finding]:
+    """WHICH phase is getting worse: latency_trend's recent-vs-baseline
+    split applied per canonical phase (shuffle.phase.ms{phase=} window
+    deltas from anatomy settlement, normalized per read and
+    payload-normalized like the parent rule). Where latency_trend says
+    \"reads are 4x slower\", this rule says \"merge is what grew\" and
+    names the knob that moves merge. One finding per drifting phase,
+    worst first; dark_time drift is reported via _rule_dark_time, not
+    here (it has no knob of its own)."""
+    frames = view.frames
+    if len(frames) < th.trend_min_frames:
+        return []
+    recent = frames[-th.trend_recent_frames:]
+    baseline = frames[:-th.trend_recent_frames]
+    reads_rec = _frame_window_counter(recent, "shuffle.read.count")
+    reads_base = _frame_window_counter(baseline, "shuffle.read.count")
+    if reads_rec < th.trend_min_reads or reads_base < th.trend_min_reads:
+        return []
+    bpr_rec = _frame_window_counter(recent, "shuffle.payload.bytes") \
+        / reads_rec
+    bpr_base = _frame_window_counter(baseline, "shuffle.payload.bytes") \
+        / reads_base
+    norm = max(bpr_rec / bpr_base, 1.0) if bpr_base > 0 else 1.0
+    out: List[Finding] = []
+    for ph in sorted(_PHASE_CONF):
+        name = labeled(C_PHASE_MS, phase=ph)
+        ms_rec = _frame_window_counter(recent, name) / reads_rec
+        ms_base = _frame_window_counter(baseline, name) / reads_base
+        if ms_rec < th.phase_trend_min_ms or ms_base <= 0:
+            continue
+        drift = (ms_rec / ms_base) / norm
+        if drift < th.phase_trend_ratio:
+            continue
+        out.append(Finding(
+            rule="phase_regression",
+            grade="critical" if drift >= th.phase_trend_critical
+            else "warn",
+            summary=(f"phase {ph!r} grew to {ms_rec:.1f} ms/read over "
+                     f"the last {len(recent)} window(s) vs "
+                     f"{ms_base:.1f} ms/read baseline — {drift:.1f}x "
+                     f"worse payload-normalized; the exchange wall is "
+                     f"being eaten by {ph}, not spread evenly"),
+            evidence={"phase": ph,
+                      "recent_ms_per_read": round(ms_rec, 2),
+                      "baseline_ms_per_read": round(ms_base, 2),
+                      "drift_normalized": round(drift, 2),
+                      "payload_norm": round(norm, 3),
+                      "recent_reads": int(reads_rec),
+                      "baseline_reads": int(reads_base)},
+            conf_key=_PHASE_CONF[ph],
+            remediation=(f"one phase regressed while the others held: "
+                         f"turn {_PHASE_CONF[ph]} or diff what changed "
+                         f"around the {ph} path; the anatomy CLI on a "
+                         f"recent exchange shows the swept {ph} "
+                         f"segments against the wall")))
+    out.sort(key=lambda f: -f.evidence["drift_normalized"])
+    return out
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
@@ -1701,7 +1877,8 @@ _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_block_corruption, _rule_host_roundtrip,
           _rule_sink_fallback, _rule_kernel_fallback,
           _rule_quota_starvation, _rule_slow_tier,
-          _rule_slo_burn, _rule_latency_trend, _rule_spill_bound)
+          _rule_slo_burn, _rule_latency_trend, _rule_spill_bound,
+          _rule_dark_time, _rule_phase_regression)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

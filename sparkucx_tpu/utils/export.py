@@ -253,7 +253,7 @@ def dedupe_process_docs(docs: Iterable[Dict]) -> List[Dict]:
     return out
 
 
-def merge_timeline(docs: Iterable[Dict]) -> Dict:
+def merge_timeline(docs: Iterable[Dict], anatomy: bool = False) -> Dict:
     """Merge per-process span captures into ONE Chrome/Perfetto trace doc
     with a track (pid) per process, clock-aligned via each capture's
     wall↔perf anchor.
@@ -264,7 +264,12 @@ def merge_timeline(docs: Iterable[Dict]) -> Dict:
     per-process perf offsets; the merge rebases them onto a shared
     wall-clock zero (the earliest span epoch across processes), so a
     fetch that waited on a straggler peer visibly overlaps that peer's
-    late dispatch in the merged view."""
+    late dispatch in the merged view.
+
+    ``anatomy=True`` additionally renders each process's exchange
+    ledgers (utils/anatomy.py swept phase covers, dark segments
+    included) as synthetic child tracks under that process — off by
+    default so a plain timeline carries exactly the recorded spans."""
     docs = dedupe_process_docs(docs)
     if not docs:
         raise ValueError("merge_timeline: no input docs")
@@ -288,12 +293,17 @@ def merge_timeline(docs: Iterable[Dict]) -> Dict:
         shift_us = (a["wall_epoch"] - t0) * 1e6
         events.append({"name": "process_name", "ph": "M", "pid": pid,
                        "args": {"name": label}})
+        shifted: List[Dict] = []
         for ev in doc.get("trace_events", doc.get("events", [])):
             ev = dict(ev)
             ev["pid"] = pid
             if "ts" in ev:
                 ev["ts"] = ev["ts"] + shift_us
-            events.append(ev)
+            shifted.append(ev)
+        events.extend(shifted)
+        if anatomy:
+            from sparkucx_tpu.utils.anatomy import phase_track_events
+            events.extend(phase_track_events(shifted, pid=pid))
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "metadata": {"processes": len(docs),
                          "wall_epoch_zero": t0}}

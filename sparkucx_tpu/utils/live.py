@@ -21,6 +21,10 @@ endpoint   serves
 /slo       the SLO verdict as JSON (utils/slo.py over the retained
            history windows) — the same document ``service.slo()``
            returns: per-objective burn rates + error budgets
+/anatomy   per-exchange phase ledgers + conservation audit + critical
+           path (utils/anatomy.py ``report_from_docs`` folded from the
+           live snapshot's span ring); ``?trace=<id>`` restricts to
+           one exchange — the same document the anatomy CLI renders
 /healthz   200/503 liveness: node open, no epoch bump pending
            re-registration, no device flagged unhealthy, no SLO fast
            burn; the JSON body carries the epoch, the human ``reason``
@@ -87,8 +91,8 @@ class LiveTelemetryServer:
 
     def start(self) -> "LiveTelemetryServer":
         self._thread.start()
-        log.info("live telemetry server up at %s "
-                 "(/metrics /snapshot /doctor /slo /healthz)", self.url)
+        log.info("live telemetry server up at %s (/metrics /snapshot "
+                 "/doctor /slo /anatomy /healthz)", self.url)
         return self
 
     def stop(self) -> None:
@@ -131,6 +135,20 @@ class LiveTelemetryServer:
                                json.dumps(self._slo_fn(), indent=1,
                                           default=repr),
                                "application/json")
+            elif path == "/anatomy":
+                # folded FROM the canonical snapshot (one seam): the
+                # doc embeds the span ring, so the ledgers and the
+                # conservation audit render server-side; ?trace=<id>
+                # restricts to one exchange
+                from urllib.parse import parse_qs, urlparse
+                from sparkucx_tpu.utils.anatomy import report_from_docs
+                q = parse_qs(urlparse(req.path).query)
+                tr = (q.get("trace") or [None])[0]
+                rep = report_from_docs([self._snapshot_fn()],
+                                       trace_id=tr)
+                self._send(req, 200,
+                           json.dumps(rep, indent=1, default=repr),
+                           "application/json")
             elif path == "/healthz":
                 h = self._health_fn()
                 self._send(req, 200 if h.get("ok") else 503,
@@ -140,7 +158,7 @@ class LiveTelemetryServer:
                 self._send(req, 404, json.dumps(
                     {"error": f"unknown path {path!r}", "paths": [
                         "/metrics", "/snapshot", "/doctor", "/slo",
-                        "/healthz"]}),
+                        "/anatomy", "/healthz"]}),
                     "application/json")
         except Exception as e:
             log.debug("live request %s failed", path, exc_info=True)

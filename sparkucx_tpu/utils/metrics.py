@@ -169,6 +169,19 @@ C_SPILL_COUNT = "shuffle.spill.count"
 C_WORKLOAD_ROWS = "workload.rows"
 C_WORKLOAD_PHASE_MS = "workload.phase.ms"
 
+# Exchange anatomy plane (utils/anatomy.py folded at exchange
+# settlement): C_PHASE_MS accumulates wall milliseconds per canonical
+# phase, labeled {phase="plan|compile|pack|admission_wait|barrier_wait|
+# transfer.ici|transfer.dcn|merge|sink|spill|verify|dark_time"} — the
+# labeled family rides TelemetryHistory counter deltas, which is what
+# lets the phase_regression doctor rule put a TREND on a phase without
+# any new frame machinery. C_TRACE_DROPPED surfaces the tracer ring's
+# drop count as a counter (watermark-delta published by
+# Tracer.publish_dropped) so the dark_time rule can cite span loss as
+# the explanation for an attribution hole.
+C_PHASE_MS = "shuffle.phase.ms"
+C_TRACE_DROPPED = "trace.spans.dropped"
+
 # Device-memory gauge families (runtime/devmon.py sampler; per local
 # device index, encoded as a label via :func:`labeled`): ONE place for
 # the names so the sampler, the doctor's hbm_pressure rule and the

@@ -141,6 +141,7 @@ class Tracer:
         self.annotate_device = annotate_device
         self._spans: deque = deque(maxlen=capacity)
         self._dropped = 0
+        self._published_dropped = 0
         self._capacity = capacity
         self._lock = threading.Lock()
         self._tls = threading.local()
@@ -159,6 +160,24 @@ class Tracer:
             return
         self._record(Span(name, (time.perf_counter() - self._epoch) * 1e6,
                           0.0, threading.get_ident(), 0, attrs))
+
+    def record_span(self, name: str, t0: float, t1: Optional[float] = None,
+                    **attrs) -> None:
+        """Record a span from explicit ``perf_counter`` endpoints — for
+        regions whose start predates knowing whether (or under what
+        name/attrs) they'd be recorded. The exchange WALL span is the
+        canonical user: ``ExchangeReport`` stamps its start inside
+        ``submit()`` and the settlement callback closes it with the
+        trace id only once the read is fully notified. No-op when
+        disabled — ONE branch, so the disabled read path pays a single
+        attribute check per exchange."""
+        if not self.enabled:
+            return
+        if t1 is None:
+            t1 = time.perf_counter()
+        self._record(Span(name, (t0 - self._epoch) * 1e6,
+                          (t1 - t0) * 1e6, threading.get_ident(), 0,
+                          attrs))
 
     def _record(self, s: Span) -> None:
         with self._lock:
@@ -194,6 +213,35 @@ class Tracer:
         with self._lock:
             out = list(self._spans)
         return [s for s in out if s.name == name] if name else out
+
+    def spans_ending_after(self, t_us: float) -> List[Span]:
+        """Spans whose END falls at/after ``t_us`` (tracer-epoch µs),
+        oldest-first. The ring appends in END order (a span records at
+        __exit__), so a reversed walk can stop at the first span that
+        ended earlier — the anatomy fold's per-exchange cost is bounded
+        by the spans of THAT exchange, not the ring's full capacity."""
+        out: List[Span] = []
+        with self._lock:
+            for s in reversed(self._spans):
+                if s.start_us + s.dur_us < t_us:
+                    break
+                out.append(s)
+        out.reverse()
+        return out
+
+    def publish_dropped(self, metrics) -> int:
+        """Publish ring drops into ``metrics`` as the
+        ``trace.spans.dropped`` counter, watermark-delta style: each
+        call adds only the drops since the last publish, so periodic
+        callers (the exchange settlement hook) keep counter semantics
+        over a monotonically growing internal total. Returns the delta."""
+        with self._lock:
+            delta = self._dropped - self._published_dropped
+            self._published_dropped = self._dropped
+        if delta > 0:
+            from sparkucx_tpu.utils.metrics import C_TRACE_DROPPED
+            metrics.inc(C_TRACE_DROPPED, delta)
+        return delta
 
     @property
     def dropped(self) -> int:
@@ -244,6 +292,7 @@ class Tracer:
         with self._lock:
             self._spans.clear()
             self._dropped = 0
+            self._published_dropped = 0
 
     # -- export -----------------------------------------------------------
     def chrome_events(self) -> List[Dict[str, Any]]:

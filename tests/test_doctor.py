@@ -936,6 +936,40 @@ def test_merge_timeline_clock_aligns_tracks():
         {"process 0", "process 1"}
 
 
+def test_merge_timeline_negative_skew_aligns():
+    """Clock-anchor edge case: process 1's epoch started BEFORE the
+    minimum-epoch process 0's view of it — i.e. the joining doc has the
+    EARLIEST wall_epoch and every other doc shifts forward off it. The
+    same wall moment is ts=0.5s on p0 and ts=3.0s on p1 (p1 booted
+    2.5 s earlier); alignment must shift p0 forward, never produce
+    negative timestamps for in-range events."""
+    from sparkucx_tpu.utils.export import merge_timeline
+    ev0 = [{"name": "x", "ph": "X", "ts": 0.5e6, "dur": 1000.0,
+            "pid": 0, "tid": 1, "args": {"trace": "s1.e0.x1"}}]
+    ev1 = [{"name": "x", "ph": "X", "ts": 3.0e6, "dur": 1000.0,
+            "pid": 0, "tid": 1, "args": {"trace": "s1.e0.x1"}}]
+    doc = merge_timeline([_span_doc(0, 1000.0, ev0),
+                          _span_doc(1, 997.5, ev1)])
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    by_pid = {e["pid"]: e for e in xs}
+    assert by_pid[0]["ts"] == pytest.approx(by_pid[1]["ts"])
+    assert all(e["ts"] >= 0 for e in xs)
+
+
+def test_merge_timeline_duplicate_process_docs_dedupe():
+    """A snapshot and a flight postmortem of the SAME process (same
+    process_id + anchor pid) must merge to ONE track, not two clones
+    of every span."""
+    from sparkucx_tpu.utils.export import merge_timeline
+    ev = [{"name": "x", "ph": "X", "ts": 1.0e6, "dur": 500.0,
+           "pid": 0, "tid": 1, "args": {}}]
+    doc = merge_timeline([_span_doc(0, 1000.0, ev),
+                          _span_doc(0, 1000.0, ev)])
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(xs) == 1
+    assert doc["metadata"]["processes"] == 1
+
+
 def test_merge_timeline_rejects_anchorless():
     from sparkucx_tpu.utils.export import merge_timeline, require_anchor
     with pytest.raises(ValueError, match="anchor"):

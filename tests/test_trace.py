@@ -77,6 +77,28 @@ def test_ring_buffer_bound_and_drop_count():
     assert [s.name for s in t.spans()] == ["e6", "e7", "e8", "e9"]
 
 
+def test_publish_dropped_watermark_delta():
+    """Ring drops surface as the ``trace.spans.dropped`` counter in
+    watermark-delta style: each publish adds only the drops since the
+    last one, so the periodic settlement hook keeps counter semantics
+    (the dark_time doctor rule reads this to tell ring pressure from an
+    instrumentation hole)."""
+    from sparkucx_tpu.utils.metrics import C_TRACE_DROPPED, Metrics
+    t = Tracer(enabled=True, capacity=4)
+    m = Metrics()
+    assert t.publish_dropped(m) == 0            # nothing dropped yet
+    assert m.get(C_TRACE_DROPPED) == 0.0
+    for i in range(10):
+        t.instant(f"e{i}")
+    assert t.publish_dropped(m) == 6
+    assert m.get(C_TRACE_DROPPED) == 6.0
+    assert t.publish_dropped(m) == 0            # no double counting
+    assert m.get(C_TRACE_DROPPED) == 6.0
+    t.instant("e10")                            # one more falls off
+    assert t.publish_dropped(m) == 1
+    assert m.get(C_TRACE_DROPPED) == 7.0
+
+
 def test_summary_aggregates():
     t = Tracer(enabled=True)
     for _ in range(5):
