@@ -1718,6 +1718,178 @@ def stage_anatomy(args) -> int:
     return 0 if out["ok"] else 2
 
 
+def fleet_measure(exchanges=15, rows_per_map=2048, maps=4, partitions=8,
+                  peers=3, reps=3, cadence_ms=5000.0, seed=0):
+    """Measure the fleet telemetry plane's cost against the exchange
+    loop — the ``--stage fleet`` artifact.
+
+    The plane is OUT-OF-BAND by design (utils/collector.py): nothing in
+    the exchange loop ever waits on a scrape, so the honest gating
+    number is a DUTY CYCLE, not an A/B — deterministic accounting per
+    the obs-overhead discipline. Two sides are measured on a real node
+    (live server + fleet registry up, exchange loop running):
+
+    * ``peer_serve_duty_pct`` — what serving one ``/snapshot`` render
+      costs the scraped peer, amortized over the nominal scrape cadence
+      (one collector polling at ``cadence_ms``); the gate holds it
+      under 1% of wall, which also bounds it under 1% of the exchange
+      loop occupying that wall.
+    * ``collector_duty_pct`` — the scraping side: one full fleet scrape
+      (this node + canned real-shaped HTTP peers) amortized the same
+      way. The scrape fans per-peer worker threads, so this is ~the
+      slowest peer, not the sum.
+
+    The degraded leg re-scrapes with a dead peer registered and proves
+    the deadline contract: the view lands inside timeout + join slack,
+    the corpse is first-class ``missing``, the survivors' cells are
+    intact — the wedged-peer drill in bench form."""
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    from sparkucx_tpu.utils.collector import (ClusterCollector,
+                                              FleetRegistry,
+                                              registry_entry,
+                                              scrape_snapshot)
+    from sparkucx_tpu.utils.live import LiveTelemetryServer
+
+    rng = np.random.default_rng(seed)
+    data = [rng.integers(0, 1 << 40, size=rows_per_map, dtype=np.int64)
+            for _ in range(maps)]
+    tmp = tempfile.mkdtemp(prefix="sxt_fleet_bench_")
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+        "spark.shuffle.tpu.metrics.httpPort": "0",
+        "spark.shuffle.tpu.failure.ledgerDir": tmp,
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    mgr = TpuShuffleManager(node, conf)
+    sid_box = [70000]
+
+    def one_exchange():
+        sid = sid_box[0]
+        sid_box[0] += 1
+        h = mgr.register_shuffle(sid, maps, partitions)
+        for m in range(maps):
+            w = mgr.get_writer(h, m)
+            w.write(data[m])
+            w.commit(partitions)
+        mgr.read(h).partition(0)
+        mgr.unregister_shuffle(sid)
+
+    def loop_median_ms():
+        times = []
+        for _ in range(exchanges):
+            t0 = _time.perf_counter()
+            one_exchange()
+            times.append(_time.perf_counter() - t0)
+        times.sort()
+        return times[len(times) // 2] * 1e3
+
+    out = {"exchanges": exchanges, "rows_per_map": rows_per_map,
+           "maps": maps, "partitions": partitions, "peers": peers,
+           "reps": reps, "cadence_ms": cadence_ms}
+    extras = []
+    try:
+        loop_median_ms()           # warmup: compile + caches
+        exchange_ms = math.inf
+        for _ in range(reps):
+            exchange_ms = min(exchange_ms, loop_median_ms())
+
+        # the fleet: this real node + canned peers serving a REAL
+        # snapshot doc (frozen from the loop above) over real sockets —
+        # the scrape cost is dominated by render + HTTP, both present
+        frozen = node.telemetry_snapshot(reports=mgr.exchange_reports())
+        my_url = f"http://{node.live.host}:{node.live.port}"
+        rows = [registry_entry(0, my_url, node.tracer.anchor())]
+        for i in range(1, peers):
+            srv = LiveTelemetryServer(
+                lambda d=dict(frozen, process_id=i): d,
+                lambda: [], lambda: {"ok": True}, port=0).start()
+            extras.append(srv)
+            rows.append(registry_entry(i, srv.url, node.tracer.anchor()))
+        coll = ClusterCollector(FleetRegistry(rows), timeout_s=2.0)
+        view = coll.scrape()       # warm sockets + JSON paths
+        assert view["missing_peers"] == [], view["missing_peers"]
+        scrape_ms = math.inf
+        for _ in range(max(3, reps)):
+            t0 = _time.perf_counter()
+            view = coll.scrape()
+            scrape_ms = min(scrape_ms,
+                            (_time.perf_counter() - t0) * 1e3)
+        # the scraped peer's side: one /snapshot GET against the live
+        # node — render + serialize + socket, the cost a busy peer pays
+        serve_ms = math.inf
+        for _ in range(max(3, reps)):
+            t0 = _time.perf_counter()
+            scrape_snapshot(my_url, timeout_s=2.0)
+            serve_ms = min(serve_ms,
+                           (_time.perf_counter() - t0) * 1e3)
+
+        # degraded leg: register a corpse, prove the deadline contract
+        dead_timeout_s = 0.5
+        dead = ClusterCollector(
+            FleetRegistry(rows + [registry_entry(
+                peers, "http://127.0.0.1:9", node.tracer.anchor())]),
+            timeout_s=dead_timeout_s)
+        t0 = _time.perf_counter()
+        dview = dead.scrape()
+        degraded_ms = (_time.perf_counter() - t0) * 1e3
+        degraded_ok = (dview["missing_peers"] == [peers]
+                       and dview["processes_answered"] == peers
+                       and degraded_ms < (dead_timeout_s + 1.0) * 1e3)
+    finally:
+        for srv in extras:
+            srv.stop()
+        mgr.stop()
+        node.close()
+    out["median_exchange_ms"] = round(exchange_ms, 4)
+    out["scrape_ms"] = round(scrape_ms, 3)
+    out["peer_serve_ms"] = round(serve_ms, 3)
+    out["collector_duty_pct"] = round(scrape_ms / cadence_ms * 100.0, 4)
+    out["peer_serve_duty_pct"] = round(serve_ms / cadence_ms * 100.0, 4)
+    out["exchanges_per_cadence"] = round(cadence_ms / exchange_ms, 1)
+    out["serve_cost_in_exchanges"] = round(serve_ms / exchange_ms, 4)
+    out["degraded"] = {
+        "ok": degraded_ok, "scrape_ms": round(degraded_ms, 3),
+        "timeout_s": dead_timeout_s,
+        "missing_peers": dview["missing_peers"],
+        "processes_answered": dview["processes_answered"]}
+    return out
+
+
+def stage_fleet(args) -> int:
+    """``--stage fleet``: prove the out-of-band fleet scrape costs <1%
+    duty cycle on BOTH sides (the scraped peer's render and the
+    collector's full-fleet scrape, each amortized over the nominal
+    cadence) and that a dead peer costs one bounded deadline — the
+    degraded-scrape contract. Prints ONE JSON line and writes
+    bench_runs/fleet.json."""
+    out = {"metric": "fleet",
+           "detail": fleet_measure(
+               exchanges=15, rows_per_map=1 << (args.rows_log2 or 11),
+               reps=args.reps)}
+    out["ok"] = (out["detail"]["collector_duty_pct"] < 1.0
+                 and out["detail"]["peer_serve_duty_pct"] < 1.0
+                 and out["detail"]["degraded"]["ok"])
+    out["telemetry"] = _telemetry_blob()
+    artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_runs", "fleet.json")
+    try:
+        os.makedirs(os.path.dirname(artifact), exist_ok=True)
+        _write_artifact(artifact, out)
+        out["artifact"] = os.path.relpath(
+            artifact, os.path.dirname(os.path.abspath(__file__)))
+    except OSError as e:
+        out["artifact_error"] = str(e)[:200]
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 2
+
+
 def pipeline_measure(rows_per_map=1 << 16, maps=8, partitions=16,
                      val_words=16, wave_rows=None, depth=2, reps=3,
                      seed=0):
@@ -5135,7 +5307,7 @@ def main() -> None:
                          "the conf default)")
     ap.add_argument("--stage", default=None,
                     choices=("coldstart", "obs-overhead", "anatomy",
-                             "regress",
+                             "fleet", "regress",
                              "pipeline", "devplane", "ragged", "chaos",
                              "wire", "integrity", "devread",
                              "devcombine", "tenancy", "hier", "slo",
@@ -5149,7 +5321,11 @@ def main() -> None:
                          "each be <1%); anatomy = exchange-anatomy "
                          "plane cost (disabled-path hooks <1%) + the "
                          "per-read-mode conservation contract "
-                         "(attributed >= 95%); regress = diff a bench "
+                         "(attributed >= 95%); fleet = out-of-band "
+                         "cluster-scrape duty cycle (<1% on both the "
+                         "scraped peer and the collector) + the "
+                         "dead-peer bounded-deadline degraded leg; "
+                         "regress = diff a bench "
                          "artifact "
                          "against a prior one into doctor-schema "
                          "findings; pipeline = wave-pipelined vs "
@@ -5288,6 +5464,7 @@ def main() -> None:
         sys.exit({"coldstart": stage_coldstart,
                   "obs-overhead": stage_obs_overhead,
                   "anatomy": stage_anatomy,
+                  "fleet": stage_fleet,
                   "regress": stage_regress,
                   "pipeline": stage_pipeline,
                   "devplane": stage_devplane,

@@ -75,6 +75,21 @@ Subcommands:
     program per shape family per impl, zero warm recompiles). Exit 2
     on a parity or invariant failure; a skipped arm is a clean pass.
 
+``cluster [--peers URL ... | --registry PATH] [--timeout-s F]
+[--format text|json] [--fail-on warn|critical] [--trace ID]``
+    The fleet view (utils/collector.py): scrape ``/snapshot`` from
+    every peer — ``--peers`` URLs, an explicit ``--registry``
+    (``fleet_registry.json`` or the ``failure.ledgerDir`` holding it,
+    written at connect), or ``./fleet_registry.json`` — with per-peer
+    deadlines, over plain HTTP (NO collectives: this works while the
+    data plane is parked on a dead peer). Renders the degraded-
+    tolerant fleet table (missing peers first-class, per-peer
+    staleness/rtt/clock-skew) plus the cluster doctor's graded
+    findings, fleet-aware rules included (``peer_unresponsive`` with
+    its reachable-vs-dead discriminator, ``clock_drift``). Exit 3 when
+    a finding at/above ``--fail-on`` (default critical) fired; exit 2
+    when NO peer answered at all.
+
 ``workload <name> [--scale S] [--budget-mb N] [--seed K] [--arrow]``
     Run one registered analytics pipeline (workloads/ registry:
     terasort | groupby | join) end to end — external-memory, data
@@ -453,6 +468,39 @@ def _cmd_workload(args) -> int:
     return 0 if rep.oracle_ok else 4
 
 
+def _cmd_cluster(args) -> int:
+    """``cluster``: the out-of-band fleet view + cluster doctor. The
+    whole path is collective-free by construction — it must keep
+    answering when the allgather channel is parked on a wedged peer."""
+    from sparkucx_tpu.utils import collector as fleet
+    try:
+        reg = fleet.resolve_registry(peers=args.peers,
+                                     registry=args.registry)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"cluster: {e}", file=sys.stderr)
+        return 2
+    coll = fleet.ClusterCollector(reg, timeout_s=args.timeout_s)
+    view = coll.scrape()
+    findings = fleet.fleet_diagnose(view)
+    if args.format == "json":
+        print(json.dumps(
+            {"fleet": fleet.fleet_meta(view),
+             "findings": [f.to_dict() for f in findings],
+             "anatomy": coll.anatomy(view, trace_id=args.trace)},
+            indent=1, default=repr))
+    else:
+        sys.stdout.write(fleet.render_fleet_view(view, findings))
+    if view["processes_answered"] == 0:
+        print("cluster: NO peer answered the scrape — the registry "
+              "may be stale, or the fleet is down", file=sys.stderr)
+        return 2
+    from sparkucx_tpu.utils.doctor import GRADES
+    floor = GRADES.index(args.fail_on)
+    if any(GRADES.index(f.grade) >= floor for f in findings):
+        return 3
+    return 0
+
+
 def _cmd_keys(args) -> int:
     from sparkucx_tpu.config import _print_key_table
     _print_key_table()
@@ -584,6 +632,33 @@ def main(argv=None) -> int:
                       metavar="KEY=VALUE",
                       help="extra spark.shuffle.tpu.* conf overrides "
                            "(e.g. a2a.impl pins, workload.budgetMb)")
+    p_cl = sub.add_parser(
+        "cluster",
+        help="out-of-band fleet view: scrape /snapshot from every "
+             "registered peer over plain HTTP (no collectives), "
+             "render the degraded-tolerant table + cluster doctor "
+             "findings; exit 3 on graded findings, 2 when nobody "
+             "answered")
+    p_cl.add_argument("--peers", nargs="*", default=None,
+                      help="peer base URLs (http://host:port), or ONE "
+                           "path to a fleet_registry.json; default: "
+                           "auto-discover ./fleet_registry.json")
+    p_cl.add_argument("--registry", default=None,
+                      help="fleet_registry.json written at connect() "
+                           "(or the failure.ledgerDir holding it)")
+    p_cl.add_argument("--timeout-s", type=float, default=2.0,
+                      help="per-peer scrape deadline in seconds "
+                           "(default 2.0); a wedged peer costs at "
+                           "most this, never a hang")
+    p_cl.add_argument("--format", default="text",
+                      choices=("text", "json"))
+    p_cl.add_argument("--fail-on", default="critical",
+                      choices=("warn", "critical"),
+                      help="exit 3 when a fleet finding at/above "
+                           "this grade fired (default critical)")
+    p_cl.add_argument("--trace", default=None,
+                      help="pin the cross-process anatomy join to "
+                           "this trace id (json format only)")
     p_kb = sub.add_parser(
         "kernelbench",
         help="blocked-kernel microbench (ops/pallas/microbench.py): "
@@ -617,6 +692,8 @@ def main(argv=None) -> int:
         return _cmd_anatomy(args)
     if args.cmd == "slo":
         return _cmd_slo(args)
+    if args.cmd == "cluster":
+        return _cmd_cluster(args)
     return _cmd_keys(args)
 
 

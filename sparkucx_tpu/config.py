@@ -146,6 +146,17 @@ class TpuShuffleConf:
                             "/healthz",
         "metrics.httpHost": "live telemetry server bind host (default "
                             "127.0.0.1 — loopback unless opted out)",
+        "metrics.httpAdvertiseHost": "host the fleet registry PUBLISHES "
+                                     "for peers to scrape (utils/"
+                                     "collector.py; default: the bind "
+                                     "host — warn-once when that is "
+                                     "loopback in a multi-process "
+                                     "world)",
+        "fleet.scrapeTimeoutMs": "per-peer deadline of a fleet "
+                                 "telemetry scrape (utils/collector.py "
+                                 "ClusterCollector; default 2000) — a "
+                                 "dead peer costs one bounded timeout, "
+                                 "never a hang",
         "devmon.enabled": "device memory sampler (runtime/devmon.py): "
                           "HBM + pool watermark gauges on a cadence "
                           "(default off, null-object)",

@@ -216,7 +216,21 @@ class TpuNode:
         self.live = start_from_conf(
             conf, lambda: self.telemetry_provider(),
             lambda: self.doctor_provider(), self.health_status,
-            slo_fn=self.slo_verdict)
+            slo_fn=self.slo_verdict, cluster_fn=self._cluster_view)
+        # Fleet telemetry registry (utils/collector.py): publish this
+        # process's scrape URL through ONE boot-time allgather (the live
+        # server exists by now, so the URL does too), persist the agreed
+        # address book beside the durable ledger for restart adoption,
+        # and wire the out-of-band scraper — including the watchdog's
+        # expiry-path postmortem scrape. Best-effort like the clock
+        # anchors: a node must never fail to BOOT over observability.
+        self.fleet = None
+        self.collector = None
+        try:
+            self._init_fleet()
+        except Exception:
+            log.warning("fleet telemetry registry unavailable",
+                        exc_info=True)
         # Anomaly-triggered deep capture (doctor.watchIntervalSecs):
         # rolling doctor pass; first critical finding => bounded
         # profiler window + tagged flight postmortem.
@@ -233,6 +247,60 @@ class TpuNode:
             self.watcher = None
         log.info("TpuNode up: %d devices, mesh axes %s",
                  len(jax.devices()), self.mesh.axis_names)
+
+    def _init_fleet(self) -> None:
+        """Build the fleet registry + collector (utils/collector.py).
+        Distributed: the entry list comes from the ONE permitted
+        boot-time allgather — every process calls in lockstep, even
+        with its live server off (it publishes {}). Single-process /
+        collective-less backends: the local entry alone."""
+        from sparkucx_tpu.utils import collector as _collector
+        url = _collector.advertised_url(self.conf, self.live,
+                                        multiprocess=self.is_distributed)
+        entry = None
+        if url is not None:
+            entry = _collector.registry_entry(
+                self.process_id, url, self.tracer.anchor())
+        if self.is_distributed:
+            from sparkucx_tpu.shuffle.distributed import \
+                gather_fleet_registry
+            try:
+                entries = gather_fleet_registry(entry)
+            except Exception as e:
+                # same posture as the clock-anchor gather: some backends
+                # lack cross-process collectives — the fleet then knows
+                # only this process (scraping still works locally)
+                log.warning("fleet-registry allgather unavailable (%s); "
+                            "fleet view covers this process only", e)
+                entries = [entry] if entry else []
+        else:
+            entries = [entry] if entry else []
+        self.fleet = _collector.FleetRegistry(entries)
+        root = self.conf.ledger_dir
+        if root and len(self.fleet):
+            try:
+                path = self.fleet.save(root)
+                log.info("fleet registry: %d peer(s) -> %s",
+                         len(self.fleet), path)
+            except OSError as e:
+                log.warning("fleet registry not persisted (%s): %s",
+                            root, e)
+        if len(self.fleet):
+            self.collector = _collector.ClusterCollector(
+                self.fleet, self_id=self.process_id,
+                timeout_s=self.conf.get_float("fleet.scrapeTimeoutMs",
+                                              2000.0) / 1e3)
+            # the survivor's expiry-path postmortem: scrape the fleet
+            # out-of-band and embed each peer's last-known phase ledger
+            self.watchdog.peer_scrape = self.collector.postmortem
+
+    def _cluster_view(self):
+        """The /cluster/* provider: a fresh fleet scrape, or None while
+        no registry exists (the route 404s with the reason)."""
+        coll = getattr(self, "collector", None)
+        if coll is None:
+            return None
+        return coll.scrape()
 
     def telemetry_snapshot(self, reports=None,
                            include_history: bool = True) -> dict:
@@ -265,6 +333,24 @@ class TpuNode:
                  # axis even when the peers' own dumps are missing
                  # (a crashed peer's flight dump may never land)
                  "cluster_anchors": self.cluster_anchors}
+        # Clock re-anchor carriage: every snapshot stamps a FRESH anchor
+        # (collect_snapshot), and the boot anchor rides along in the
+        # ``anchors`` history so merge_timeline/critical_path can prefer
+        # whichever sample is freshest; ``anchor_skew_s`` is this
+        # process's drift estimate since boot (scrape-time re-anchor
+        # minus boot anchor — what the clock_drift rule grades).
+        boot = next((a for a in self.cluster_anchors
+                     if isinstance(a, dict)
+                     and a.get("process_id") == self.process_id
+                     and "wall_epoch" in a), None)
+        if boot is not None:
+            extra["anchors"] = [dict(boot)]
+            extra["anchor_skew_s"] = round(
+                self.tracer.anchor()["wall_epoch"]
+                - float(boot["wall_epoch"]), 6)
+        fleet = getattr(self, "fleet", None)
+        if fleet is not None and len(fleet):
+            extra["fleet_registry"] = fleet.to_doc()
         if include_history and getattr(self, "history", None) is not None:
             frames = self.history.frames()
             if frames:
@@ -541,6 +627,10 @@ class TpuNode:
         if self.live is not None:
             self.live.stop()
         self.reset_providers()
+        # drop the expiry-path scrape hook with the collector: a dead
+        # node's registry must not be scraped through the watchdog
+        self.watchdog.peer_scrape = None
+        self.collector = None
         # drop the process-global fence if it is ours (a later node
         # installs its own): dead-node health/flight refs must not
         # outlive the node through the module global

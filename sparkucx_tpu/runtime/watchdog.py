@@ -88,6 +88,14 @@ class Watchdog:
         self._probe_lock = threading.Lock()
         self._probe_thread: Optional[threading.Thread] = None
         self.expiries = 0                # total deadline hits (tests/CI)
+        # Out-of-band fleet scrape at expiry (utils/collector.py): the
+        # node wires ClusterCollector.postmortem here when a fleet
+        # registry exists. Called with (what=, trace=) on the expiry
+        # path — over HTTP, never a collective (the collective just
+        # proved dead) — and its result is embedded into the flight
+        # postmortem as peer_timeout.peer_postmortem: each peer's
+        # last-known phase ledger instead of a bare timeout.
+        self.peer_scrape: Optional[Callable[..., dict]] = None
 
     @property
     def enabled(self) -> bool:
@@ -186,10 +194,31 @@ class Watchdog:
                 metrics.inc(C_PROBE_DEAD, float(len(dead)))
             except Exception:
                 pass
+        # the survivor's out-of-band view of the fleet: scraped over
+        # HTTP (bounded per-peer deadlines, no collectives — the
+        # collective just proved dead), best-effort like the probe —
+        # telemetry must never mask the PeerLostError verdict
+        postmortem = None
+        if self.peer_scrape is not None:
+            try:
+                postmortem = self.peer_scrape(what=what,
+                                              trace=trace or "")
+            except Exception:
+                log.debug("out-of-band peer scrape failed at expiry",
+                          exc_info=True)
         log.error("collective deadline expired after %.0f ms at %s "
                   "(trace %s); probe verdict: %s", limit, what,
                   trace or "-", verdict if verdict is not None
                   else "unavailable")
+        if postmortem is not None:
+            for pid, cell in (postmortem.get("peers") or {}).items():
+                lk = cell.get("last_known") or {}
+                if cell.get("ok") and not lk.get("settled"):
+                    log.error(
+                        "peer %s is reachable but unsettled: last span "
+                        "%s (phase %s) ended %.1f s ago", pid,
+                        lk.get("last_span"), lk.get("phase"),
+                        lk.get("since_s") or -1.0)
         self.flight.record("peer_timeout", what=what, trace=trace or "",
                            timeout_ms=limit, dead_devices=dead,
                            leaked_threads=n_leaked)
@@ -198,7 +227,8 @@ class Watchdog:
             extra={"peer_timeout": {
                 "what": what, "trace": trace or "", "timeout_ms": limit,
                 "probe": verdict, "dead_devices": dead,
-                "stuck_sections": stuck, "leaked_threads": n_leaked}})
+                "stuck_sections": stuck, "leaked_threads": n_leaked,
+                "peer_postmortem": postmortem}})
 
     def _probe_once(self):
         """One bounded liveness probe. A probe whose previous run is

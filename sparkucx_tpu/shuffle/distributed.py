@@ -190,6 +190,19 @@ def gather_clock_anchors(tracer=None) -> list:
     return allgather_json(a)
 
 
+def gather_fleet_registry(entry) -> list:
+    """COLLECTIVE — the ONE boot-time round the fleet telemetry plane
+    is allowed (utils/collector.py): every process's registry entry
+    (its live-telemetry scrape URL + boot anchor), allgathered at
+    connect when the whole fleet is alive in lockstep by construction.
+    A process whose live server is off publishes ``{}`` — it still
+    MUST call (the collective is unconditional) and simply contributes
+    no scrape target. After this round the plane never touches a
+    collective again: scraping is HTTP, so it keeps working when this
+    very channel is parked on a dead peer."""
+    return allgather_json(entry if entry is not None else {})
+
+
 class DistributedReaderResult(ShuffleReaderResult):
     """Partial, process-local view: only partitions on local shards are
     readable (the Spark-reducer contract). Layout is partition-major

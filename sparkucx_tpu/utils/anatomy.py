@@ -351,14 +351,18 @@ def critical_path(docs: Sequence[Dict[str, Any]],
     duplicate captures of one process dedupe — the merge_timeline
     discipline, inherited wholesale."""
     from sparkucx_tpu.utils.export import (dedupe_process_docs,
-                                           require_anchor)
+                                           freshest_anchor)
     docs = dedupe_process_docs(list(docs))
     if not docs:
         return {"trace_id": None, "process": None, "phase": None,
                 "tier": "", "wall_ms": 0.0, "per_process": []}
-    for i, d in enumerate(docs):
-        require_anchor(d, d.get("source", f"doc[{i}]"))
-    t0 = min(float(d["anchor"]["wall_epoch"]) for d in docs)
+    # freshest-anchor preference (export.freshest_anchor): align each
+    # doc on its newest wall↔perf sample — the boot anchor goes stale
+    # as a long-lived process's wall clock is slewed, and a straggler
+    # verdict built on stale anchors names the wrong peer
+    anch = {id(d): freshest_anchor(d, d.get("source", f"doc[{i}]"))
+            for i, d in enumerate(docs)}
+    t0 = min(float(a["wall_epoch"]) for a in anch.values())
 
     def _events(d):
         return d.get("trace_events") or d.get("events") or []
@@ -366,7 +370,7 @@ def critical_path(docs: Sequence[Dict[str, Any]],
     if trace_id is None:
         counts: Dict[str, List[float]] = {}
         for d in docs:
-            shift = (float(d["anchor"]["wall_epoch"]) - t0) * 1e6
+            shift = (float(anch[id(d)]["wall_epoch"]) - t0) * 1e6
             for ev in wall_events(_events(d)):
                 tr = (ev.get("args") or {}).get("trace")
                 if not tr:
@@ -383,7 +387,7 @@ def critical_path(docs: Sequence[Dict[str, Any]],
     per_process: List[Dict[str, Any]] = []
     straggler = None
     for d in docs:
-        shift = (float(d["anchor"]["wall_epoch"]) - t0) * 1e6
+        shift = (float(anch[id(d)]["wall_epoch"]) - t0) * 1e6
         led = fold_events(_events(d), trace_id)
         if led is None:
             continue

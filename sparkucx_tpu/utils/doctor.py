@@ -350,6 +350,17 @@ class Thresholds:
     phase_trend_min_ms: float = 5.0
     phase_trend_ratio: float = 3.0
     phase_trend_critical: float = 10.0
+    # clock_drift: a peer's scrape-time re-anchor drifted off its boot
+    # anchor (utils/collector.py ``skew_s`` — the wall↔perf pair moved,
+    # i.e. the wall clock stepped / NTP slewed hard / perf drifted).
+    # Timelines stay exact (they re-anchor per scrape, the satellite);
+    # the finding is about TRUST in cross-process ordering: past the
+    # warn floor, "peer A finished before B" claims from boot anchors
+    # are wrong by more than scheduling noise. Floors per the PR-5
+    # discipline: a real skew estimate must exist, and sub-quarter-
+    # second drift is ordinary NTP housekeeping.
+    clock_drift_warn_s: float = 0.25
+    clock_drift_critical_s: float = 5.0
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -374,6 +385,11 @@ class ClusterView:
     slo_objectives: List[Dict] = field(default_factory=list)
     slo_policy: Optional[Dict] = None
     processes: int = 1
+    # fleet scrape metadata (utils/collector.fleet_meta): reachability,
+    # staleness and clock skew per expected peer, present only when the
+    # docs came from a ClusterCollector scrape — the fleet-aware rules
+    # (peer_unresponsive, clock_drift) read it and stay quiet without.
+    fleet: Optional[Dict] = None
 
 
 def _reports_of(doc: Dict) -> List[Dict]:
@@ -386,7 +402,8 @@ def _reports_of(doc: Dict) -> List[Dict]:
     return [r for r in (reps or []) if isinstance(r, dict)]
 
 
-def build_view(snapshots: Union[Dict, Iterable[Dict]]) -> ClusterView:
+def build_view(snapshots: Union[Dict, Iterable[Dict]],
+               fleet: Optional[Dict] = None) -> ClusterView:
     """Normalize one doc or a list of per-process docs into a
     :class:`ClusterView`. Exact aggregation: histogram buckets add
     (same fixed ladder), counters sum, reports concatenate. Multiple
@@ -451,7 +468,7 @@ def build_view(snapshots: Union[Dict, Iterable[Dict]]) -> ClusterView:
     return ClusterView(counters, hists, reports, pools, gauges,
                        frames=frames, slo_objectives=objectives,
                        slo_policy=policy,
-                       processes=max(1, len(docs)))
+                       processes=max(1, len(docs)), fleet=fleet)
 
 
 def _median(vals: List[float]) -> float:
@@ -1869,6 +1886,129 @@ def _rule_phase_regression(view: ClusterView,
     return out
 
 
+def _rule_peer_unresponsive(view: ClusterView,
+                            th: Thresholds) -> List[Finding]:
+    """Fleet-scrape reachability (utils/collector.py): an expected peer
+    did not answer its telemetry port, or every peer answers yet the
+    collective watchdog fired. The discriminator is the whole point —
+    the same bare symptom ("the exchange hung") has three distinct
+    causes an operator handles differently:
+
+    * ``dead`` — scrape failed AND the watchdog's deadline fired: the
+      process is gone from both planes. Critical; remesh over the
+      survivors.
+    * ``telemetry_unreachable`` — scrape failed but no collective
+      deadline has fired: the data plane may be perfectly healthy and
+      only the observability port is down/blocked. Warn; fix the scrape
+      path before trusting any fleet view.
+    * ``wedged_reachable`` — every peer still answers HTTP but the
+      watchdog fired: a process is alive-but-parked in the data plane.
+      Critical; the evidence names the straggler via the anatomy
+      critical path joined over the answered docs (cross-process
+      attribution — WHICH peer, in WHICH phase).
+
+    No noise floor on the missing-peer arms (an expected peer that
+    stops answering is a real event by construction — the registry was
+    agreed at boot when everyone was alive); the wedged arm inherits
+    peer_timeout's no-floor posture."""
+    fleet = view.fleet
+    if not fleet:
+        return []
+    out: List[Finding] = []
+    watchdog_fired = int(view.counters.get(C_PEER_TIMEOUT, 0.0)) > 0
+    peers = fleet.get("peers") or {}
+    missing = list(fleet.get("missing_peers") or [])
+    for pid in missing:
+        cell = peers.get(str(pid), {})
+        disc = "dead" if watchdog_fired else "telemetry_unreachable"
+        out.append(Finding(
+            rule="peer_unresponsive",
+            grade="critical" if disc == "dead" else "warn",
+            summary=(f"peer {pid} did not answer its telemetry scrape "
+                     + (f"({cell.get('error')}) " if cell.get("error")
+                        else "")
+                     + ("and the collective watchdog fired — the "
+                        "process is gone from both planes"
+                        if disc == "dead" else
+                        "but no collective deadline has fired — "
+                        "telemetry-plane outage only; the data plane "
+                        "may be healthy")),
+            evidence={"peer": pid, "discriminator": disc,
+                      "url": cell.get("url"),
+                      "error": cell.get("error"),
+                      "answered": fleet.get("processes_answered"),
+                      "expected": len(fleet.get("expected") or [])},
+            conf_key="spark.shuffle.tpu.metrics.httpAdvertiseHost",
+            remediation=("remesh over the survivors and replay"
+                         if disc == "dead" else
+                         "check the peer's metrics.httpPort server and "
+                         "that metrics.httpAdvertiseHost publishes an "
+                         "address this host can reach (a loopback "
+                         "advertise in a multi-host world is the "
+                         "classic cause)")))
+    if watchdog_fired and not missing and len(fleet.get("expected")
+                                             or []) > 1:
+        cp = fleet.get("critical_path") or {}
+        who = cp.get("process")
+        out.append(Finding(
+            rule="peer_unresponsive",
+            grade="critical",
+            summary=("collective deadline fired but every peer still "
+                     "answers its telemetry port — a process is alive "
+                     "but wedged in the data plane"
+                     + (f"; the critical path names process {who} "
+                        f"(last phase {cp.get('phase')!r}"
+                        + (f", tier {cp['tier']}" if cp.get("tier")
+                           else "") + ")" if who is not None else "")),
+            evidence={"discriminator": "wedged_reachable",
+                      "straggler": who,
+                      "straggler_phase": cp.get("phase"),
+                      "straggler_lag_ms": cp.get("straggler_lag_ms"),
+                      "trace_id": cp.get("trace_id")},
+            conf_key="spark.shuffle.tpu.failure.collectiveTimeoutMs",
+            remediation=("read the flight postmortem's peer_postmortem "
+                         "(the survivor scraped the fleet out-of-band "
+                         "at expiry — each peer's last-known phase "
+                         "ledger is embedded); a wedged-not-dead peer "
+                         "usually means a stuck device program or a "
+                         "desynced collective, not a crash"),
+            trace_ids=[t for t in [cp.get("trace_id")] if t]))
+    return out
+
+
+def _rule_clock_drift(view: ClusterView, th: Thresholds) -> List[Finding]:
+    """Scrape-time re-anchor deltas (utils/collector.py ``skew_s``):
+    a peer's wall↔perf anchor moved since boot — its wall clock stepped
+    or slewed hard. Merged timelines stay exact (they re-anchor per
+    scrape), but boot-anchor-based cross-process ordering claims are
+    now wrong by the skew; warn past ordinary-NTP territory, critical
+    when seconds of drift mean a genuinely broken clock."""
+    fleet = view.fleet
+    if not fleet:
+        return []
+    drifted = []
+    for pid, cell in sorted((fleet.get("peers") or {}).items()):
+        s = cell.get("skew_s")
+        if s is not None and abs(float(s)) >= th.clock_drift_warn_s:
+            drifted.append((pid, float(s)))
+    if not drifted:
+        return []
+    worst = max(abs(s) for _, s in drifted)
+    return [Finding(
+        rule="clock_drift",
+        grade="critical" if worst >= th.clock_drift_critical_s
+        else "warn",
+        summary=(f"{len(drifted)} peer clock(s) drifted off their boot "
+                 f"anchors (worst {worst:.3f} s) — cross-process "
+                 f"ordering from boot anchors is stale; scrape-time "
+                 f"re-anchors are already preferred for timelines"),
+        evidence={"skews_s": {pid: round(s, 4) for pid, s in drifted},
+                  "worst_s": round(worst, 4)},
+        remediation=("check NTP/chrony on the drifted hosts; restart "
+                     "the drifted process to re-publish a fresh boot "
+                     "anchor once its clock is disciplined"))]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
@@ -1878,18 +2018,22 @@ _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_sink_fallback, _rule_kernel_fallback,
           _rule_quota_starvation, _rule_slow_tier,
           _rule_slo_burn, _rule_latency_trend, _rule_spill_bound,
-          _rule_dark_time, _rule_phase_regression)
+          _rule_dark_time, _rule_phase_regression,
+          _rule_peer_unresponsive, _rule_clock_drift)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],
-             thresholds: Optional[Thresholds] = None) -> List[Finding]:
+             thresholds: Optional[Thresholds] = None,
+             fleet: Optional[Dict] = None) -> List[Finding]:
     """Run every rule over one snapshot doc (process-local diagnosis) or
     a list of per-process docs (cluster-wide), most severe first. The
     zero-findings result IS the healthy verdict — rules carry
     minimum-signal floors so an idle or balanced cluster diagnoses
-    clean."""
+    clean. ``fleet`` attaches a ClusterCollector scrape's reachability/
+    skew metadata (utils/collector.fleet_meta) so the fleet-aware rules
+    can grade peers that did NOT contribute a doc."""
     th = thresholds or Thresholds()
-    view = build_view(snapshots)
+    view = build_view(snapshots, fleet=fleet)
     findings: List[Finding] = []
     for rule in _RULES:
         findings.extend(rule(view, th))

@@ -170,6 +170,30 @@ def require_anchor(doc: Dict, source: str = "dump") -> Dict:
     return a
 
 
+def freshest_anchor(doc: Dict, source: str = "dump") -> Dict:
+    """The doc's BEST wall↔perf anchor: the freshest sample (largest
+    ``wall``) among its primary ``anchor`` and its ``anchors`` history
+    (the boot anchor + any re-anchors ride there —
+    ``TpuNode.telemetry_snapshot``). Long-lived processes drift: the
+    wall↔perf relationship measured at boot goes stale as the wall
+    clock is NTP-slewed, so alignment must use the sample taken closest
+    to the spans being aligned — a scrape re-anchors on every
+    ``collect_snapshot`` call precisely so this choice exists. A doc
+    whose primary anchor is missing but whose history holds a valid
+    sample still aligns; no valid sample anywhere fails loudly
+    (the :func:`require_anchor` message)."""
+    cands = []
+    a = doc.get("anchor")
+    if isinstance(a, dict) and "wall_epoch" in a:
+        cands.append(a)
+    for h in (doc.get("anchors") or []):
+        if isinstance(h, dict) and "wall_epoch" in h:
+            cands.append(h)
+    if not cands:
+        return require_anchor(doc, source)   # raises with the message
+    return max(cands, key=lambda c: float(c.get("wall", 0.0)))
+
+
 def dedupe_process_docs(docs: Iterable[Dict]) -> List[Dict]:
     """Collapse multiple captures of the SAME process into one doc. A
     dump directory typically holds both a process's rolling metrics
@@ -273,7 +297,11 @@ def merge_timeline(docs: Iterable[Dict], anatomy: bool = False) -> Dict:
     docs = dedupe_process_docs(docs)
     if not docs:
         raise ValueError("merge_timeline: no input docs")
-    anchors = [require_anchor(d, f"timeline input {i}")
+    # freshest-anchor preference: a long-lived process's boot anchor is
+    # stale relative to its latest re-anchor (every scrape/snapshot
+    # stamps one); aligning on the freshest sample pins the drift
+    # regression the clock_drift rule grades
+    anchors = [freshest_anchor(d, f"timeline input {i}")
                for i, d in enumerate(docs)]
     t0 = min(a["wall_epoch"] for a in anchors)
     # Track identity: the jax process index when the captures are from

@@ -29,6 +29,14 @@ endpoint   serves
            re-registration, no device flagged unhealthy, no SLO fast
            burn; the JSON body carries the epoch, the human ``reason``
            and the stable machine ``cause`` enum
+/cluster/* fleet routes (utils/collector.py, nodes with a boot-time
+           fleet registry): ``/cluster/snapshot`` scrapes every
+           registered peer out-of-band and returns the degraded-
+           tolerant fleet view (missing_peers first-class);
+           ``/cluster/doctor`` grades it (fleet-aware rules included);
+           ``/cluster/anatomy`` folds the answered peers' span rings
+           into the cluster critical path. Served by ANY peer — the
+           one process you can still reach answers for the fleet.
 ========== ==========================================================
 
 Conf: ``spark.shuffle.tpu.metrics.httpPort`` — unset = off (default),
@@ -63,11 +71,19 @@ class LiveTelemetryServer:
                  doctor_fn: Callable[[], list],
                  health_fn: Callable[[], Dict],
                  port: int = 0, host: str = "127.0.0.1",
-                 slo_fn: Optional[Callable[[], Dict]] = None):
+                 slo_fn: Optional[Callable[[], Dict]] = None,
+                 cluster_fn: Optional[Callable[[], Dict]] = None):
         self._snapshot_fn = snapshot_fn
         self._doctor_fn = doctor_fn
         self._health_fn = health_fn
         self._slo_fn = slo_fn
+        # returns the ClusterCollector fleet view (utils/collector.py)
+        # or None while no fleet registry exists on this node — the
+        # /cluster/* routes 404 with a reason instead of guessing.
+        # Served by ANY peer: a scrape of one process answers for the
+        # whole fleet, which is the degraded-mode contract (the peer
+        # you can still reach tells you about the ones you cannot).
+        self._cluster_fn = cluster_fn
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -92,7 +108,7 @@ class LiveTelemetryServer:
     def start(self) -> "LiveTelemetryServer":
         self._thread.start()
         log.info("live telemetry server up at %s (/metrics /snapshot "
-                 "/doctor /slo /anatomy /healthz)", self.url)
+                 "/doctor /slo /anatomy /healthz /cluster/*)", self.url)
         return self
 
     def stop(self) -> None:
@@ -149,6 +165,9 @@ class LiveTelemetryServer:
                 self._send(req, 200,
                            json.dumps(rep, indent=1, default=repr),
                            "application/json")
+            elif path in ("/cluster/snapshot", "/cluster/doctor",
+                          "/cluster/anatomy"):
+                self._route_cluster(req, path)
             elif path == "/healthz":
                 h = self._health_fn()
                 self._send(req, 200 if h.get("ok") else 503,
@@ -158,7 +177,8 @@ class LiveTelemetryServer:
                 self._send(req, 404, json.dumps(
                     {"error": f"unknown path {path!r}", "paths": [
                         "/metrics", "/snapshot", "/doctor", "/slo",
-                        "/anatomy", "/healthz"]}),
+                        "/anatomy", "/healthz", "/cluster/snapshot",
+                        "/cluster/doctor", "/cluster/anatomy"]}),
                     "application/json")
         except Exception as e:
             log.debug("live request %s failed", path, exc_info=True)
@@ -167,6 +187,50 @@ class LiveTelemetryServer:
                            "application/json")
             except Exception:
                 pass  # client went away mid-error; nothing to serve
+
+    def _route_cluster(self, req, path: str) -> None:
+        """The fleet routes: a FRESH scrape of every registered peer per
+        request (staleness is then the requester's choice, not a cache
+        policy), folded server-side like /anatomy — any reachable peer
+        answers for the whole fleet, including the peers that did not."""
+        if self._cluster_fn is None:
+            self._send(req, 404, json.dumps(
+                {"error": "no fleet registry on this node (set "
+                          "spark.shuffle.tpu.metrics.httpPort so "
+                          "connect() publishes a scrape URL; the "
+                          "registry is allgathered at boot)"}),
+                "application/json")
+            return
+        view = self._cluster_fn()
+        if view is None:
+            self._send(req, 404, json.dumps(
+                {"error": "fleet registry empty (no peer published a "
+                          "scrape URL at connect)"}),
+                "application/json")
+            return
+        if path == "/cluster/snapshot":
+            body = json.dumps(view, indent=1, default=repr)
+        elif path == "/cluster/doctor":
+            from sparkucx_tpu.utils.collector import (fleet_diagnose,
+                                                      fleet_meta)
+            findings = fleet_diagnose(view)
+            body = json.dumps(
+                {"fleet": fleet_meta(view),
+                 "findings": [f.to_dict() for f in findings]},
+                indent=1, default=repr)
+        else:  # /cluster/anatomy
+            from urllib.parse import parse_qs, urlparse
+            from sparkucx_tpu.utils.anatomy import report_from_docs
+            from sparkucx_tpu.utils.collector import fleet_docs
+            q = parse_qs(urlparse(req.path).query)
+            tr = (q.get("trace") or [None])[0]
+            docs = fleet_docs(view)
+            rep = report_from_docs(docs, trace_id=tr) if docs else {
+                "ledgers": [], "exchanges_seen": 0,
+                "critical_path": {"error": "no peer answered"}}
+            rep["missing_peers"] = view.get("missing_peers", [])
+            body = json.dumps(rep, indent=1, default=repr)
+        self._send(req, 200, body, "application/json")
 
     @staticmethod
     def _send(req, status: int, body: str, ctype: str) -> None:
@@ -179,7 +243,8 @@ class LiveTelemetryServer:
 
 
 def start_from_conf(conf, snapshot_fn, doctor_fn, health_fn,
-                    slo_fn=None) -> Optional[LiveTelemetryServer]:
+                    slo_fn=None,
+                    cluster_fn=None) -> Optional[LiveTelemetryServer]:
     """Build+start the server from ``metrics.httpPort`` (None when the
     key is unset — off is the default — or the bind fails: a node must
     never fail to BOOT over its observability port, the same rule as the
@@ -194,8 +259,8 @@ def start_from_conf(conf, snapshot_fn, doctor_fn, health_fn,
         host = conf.get("spark.shuffle.tpu.metrics.httpHost",
                         "127.0.0.1")
         return LiveTelemetryServer(snapshot_fn, doctor_fn, health_fn,
-                                   port=port, host=host,
-                                   slo_fn=slo_fn).start()
+                                   port=port, host=host, slo_fn=slo_fn,
+                                   cluster_fn=cluster_fn).start()
     except Exception as e:
         log.warning("live telemetry server unavailable "
                     "(metrics.httpPort=%r): %s — continuing without a "

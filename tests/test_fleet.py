@@ -1,0 +1,672 @@
+"""Fleet telemetry plane tests (utils/collector.py + the fleet-aware
+doctor rules + the watchdog postmortem hook + the ``cluster`` CLI).
+
+The plane's contract is DEGRADED TOLERANCE: every test here either
+kills, hangs, or drifts a peer and asserts the view still assembles —
+missing peers first-class, survivors graded, per-peer deadlines honored,
+no collective anywhere on the path. Subprocess tests use REAL HTTP peers
+(LiveTelemetryServer children) because the failure mode under test is a
+socket that stops answering, which a fake fetch cannot prove.
+"""
+
+import contextlib
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sparkucx_tpu.utils import collector as fleet
+from sparkucx_tpu.utils.collector import (ClusterCollector, FleetRegistry,
+                                          advertised_url, fleet_diagnose,
+                                          last_known_phase, registry_entry,
+                                          registry_path, render_fleet_view,
+                                          resolve_registry)
+from sparkucx_tpu.utils.doctor import Thresholds
+from sparkucx_tpu.utils.live import LiveTelemetryServer
+from sparkucx_tpu.utils.metrics import C_PEER_TIMEOUT
+
+TR = "s1.e0.x1"
+
+
+def _ev(name, ts_us, dur_us, **attrs):
+    return {"name": name, "ph": "X", "ts": float(ts_us),
+            "dur": float(dur_us), "pid": 0, "tid": 1, "args": attrs}
+
+
+def _anchor(wall_epoch=None, wall=None):
+    now = time.time()
+    we = now if wall_epoch is None else float(wall_epoch)
+    return {"wall": now if wall is None else float(wall),
+            "perf": 0.0, "perf_epoch": 0.0, "wall_epoch": we,
+            "pid": 1.0}
+
+
+def _peer_doc(process_id=0, trace=TR, settled=True, wall_epoch=None):
+    """A scrapable snapshot doc: anchor + a settled (or wedged-looking)
+    exchange's span ring."""
+    evs = [_ev("shuffle.plan", 0, 1_000, trace=trace),
+           _ev("shuffle.pack", 1_000, 5_000, trace=trace),
+           _ev("shuffle.tier", 6_000, 3_800, trace=trace, tier="dcn")]
+    if settled:
+        evs.insert(0, _ev("shuffle.exchange", 0, 10_000, trace=trace,
+                          completed=True))
+    return {"process_id": process_id, "anchor": _anchor(wall_epoch),
+            "counters": {}, "trace_events": evs}
+
+
+# -- registry ---------------------------------------------------------------
+def test_registry_entry_roundtrip_and_load_from_dir(tmp_path):
+    e = registry_entry(3, "http://h:1234/", _anchor(wall_epoch=500.0))
+    assert e["url"] == "http://h:1234"          # trailing / normalized
+    assert e["process_id"] == 3 and e["pid"] == os.getpid()
+    reg = FleetRegistry([e])
+    path = reg.save(str(tmp_path))
+    assert path == registry_path(str(tmp_path))
+    # load accepts the file OR the ledger dir holding it
+    for target in (path, str(tmp_path)):
+        got = FleetRegistry.load(target)
+        assert got.peers() == {3: "http://h:1234"}
+        assert got.boot_anchor(3)["wall_epoch"] == 500.0
+    assert got.boot_anchor(99) is None
+
+
+def test_registry_save_merges_survivor_rows(tmp_path):
+    """Restart adoption: a rebooted process re-publishing its row must
+    not wipe the survivors' rows, and the newest published_at wins."""
+    old = [registry_entry(0, "http://a:1", _anchor(), published_at=10.0),
+           registry_entry(1, "http://b:1", _anchor(), published_at=10.0)]
+    FleetRegistry(old).save(str(tmp_path))
+    # process 0 restarts on a new port; process 1's row is adopted
+    FleetRegistry([registry_entry(0, "http://a:2", _anchor(),
+                                  published_at=20.0)]).save(str(tmp_path))
+    got = FleetRegistry.load(str(tmp_path))
+    assert got.peers() == {0: "http://a:2", 1: "http://b:1"}
+    # a STALER republish does not clobber the newer row
+    FleetRegistry([registry_entry(0, "http://a:9", _anchor(),
+                                  published_at=5.0)]).save(str(tmp_path))
+    assert FleetRegistry.load(str(tmp_path)).peers()[0] == "http://a:2"
+
+
+def test_registry_skips_liveless_entries_and_from_urls():
+    # a peer with its live server off allgathers {} — present in the
+    # round (it MUST call), absent from the address book
+    reg = FleetRegistry([{}, registry_entry(1, "http://b:1", _anchor()),
+                         None, {"process_id": "bogus", "url": "x"}])
+    assert reg.expected() == [1]
+    reg2 = FleetRegistry.from_urls(["http://a:1", "http://b:2"])
+    assert reg2.peers() == {0: "http://a:1", 1: "http://b:2"}
+
+
+# -- advertised URL ---------------------------------------------------------
+class _FakeLive:
+    host, port = "127.0.0.1", 8080
+
+
+def test_advertised_url_rewrite_and_loopback_warn_once():
+    import logging
+    from sparkucx_tpu.config import TpuShuffleConf
+    assert advertised_url(TpuShuffleConf({}, use_env=False), None) is None
+    conf = TpuShuffleConf(
+        {"spark.shuffle.tpu.metrics.httpAdvertiseHost": "tpu-host-7"},
+        use_env=False)
+    # advertise rewrites the PUBLISHED host, the bind stays loopback
+    assert advertised_url(conf, _FakeLive(), multiprocess=True) \
+        == "http://tpu-host-7:8080"
+    bare = TpuShuffleConf({}, use_env=False)
+    # the repo logger does not propagate to root — capture directly
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    logger = logging.getLogger("sparkucx_tpu.collector")
+    logger.addHandler(handler)
+    try:
+        fleet._warned_loopback = False
+        assert advertised_url(bare, _FakeLive(), multiprocess=True) \
+            == "http://127.0.0.1:8080"
+        advertised_url(bare, _FakeLive(), multiprocess=True)
+        warns = [r for r in records if "LOOPBACK" in r.getMessage()]
+        assert len(warns) == 1                  # once, not per publish
+        assert "httpAdvertiseHost" in warns[0].getMessage()
+        # single-process never warns: loopback is the correct address
+        fleet._warned_loopback = False
+        records.clear()
+        advertised_url(bare, _FakeLive(), multiprocess=False)
+        assert not [r for r in records if "LOOPBACK" in r.getMessage()]
+    finally:
+        logger.removeHandler(handler)
+
+
+# -- collector over a fake fetch (no sockets) -------------------------------
+def _fake_fleet(docs_by_url, hang=()):
+    """A fetch callable serving canned docs; URLs in ``hang`` sleep past
+    any deadline (on a daemon worker — the scrape must move on)."""
+    def fetch(url, timeout_s):
+        if url in hang:
+            time.sleep(timeout_s + 30.0)
+        if url not in docs_by_url:
+            raise urllib.error.URLError("connection refused")
+        return docs_by_url[url]
+    return fetch
+
+
+def test_scrape_assembles_view_with_skew_and_missing():
+    boot0, boot1 = _anchor(wall_epoch=100.0), _anchor(wall_epoch=200.0)
+    reg = FleetRegistry([
+        {"process_id": 0, "url": "http://a", "anchor": boot0},
+        {"process_id": 1, "url": "http://b", "anchor": boot1},
+        {"process_id": 2, "url": "http://c", "anchor": _anchor()}])
+    docs = {"http://a": _peer_doc(0, wall_epoch=100.5),
+            "http://b": _peer_doc(1, wall_epoch=200.0)}
+    coll = ClusterCollector(reg, timeout_s=1.0,
+                            fetch=_fake_fleet(docs))
+    view = coll.scrape()
+    assert view["expected"] == [0, 1, 2]
+    assert view["missing_peers"] == [2]
+    assert view["processes_answered"] == 2
+    # skew_s = scrape-time re-anchor minus the boot anchor from the
+    # registry — peer 0's clock stepped half a second since boot
+    assert view["peers"]["0"]["skew_s"] == pytest.approx(0.5)
+    assert view["peers"]["1"]["skew_s"] == pytest.approx(0.0)
+    dead = view["peers"]["2"]
+    assert dead["ok"] is False and "refused" in dead["error"]
+    assert dead["doc"] is None and dead["collected_at"] is None
+    for pid in ("0", "1"):
+        c = view["peers"][pid]
+        assert c["ok"] and c["collected_at"] is not None
+        assert c["rtt_ms"] is not None and c["rtt_ms"] >= 0.0
+
+
+def test_scrape_deadline_bounds_a_hung_peer():
+    """The wedged-peer contract in miniature: a peer that ACCEPTS and
+    then never answers costs one bounded deadline, never a hang."""
+    reg = FleetRegistry.from_urls(["http://ok", "http://hung"])
+    docs = {"http://ok": _peer_doc(0)}
+    coll = ClusterCollector(reg, timeout_s=0.3,
+                            fetch=_fake_fleet(docs, hang=("http://hung",)))
+    t0 = time.monotonic()
+    view = coll.scrape()
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0                        # deadline + join slack
+    assert view["missing_peers"] == [1]
+    assert "deadline" in view["peers"]["1"]["error"]
+    assert view["peers"]["0"]["ok"]
+
+
+def test_fleet_meta_strips_docs_and_render_marks_missing():
+    reg = FleetRegistry.from_urls(["http://a", "http://b"])
+    coll = ClusterCollector(reg, timeout_s=0.5, fetch=_fake_fleet(
+        {"http://a": _peer_doc(0)}))
+    view = coll.scrape()
+    meta = fleet.fleet_meta(view)
+    assert "doc" not in meta["peers"]["0"]
+    assert meta["missing_peers"] == [1]
+    txt = render_fleet_view(view)
+    assert "1/2 peer(s) answered" in txt
+    assert "MISSING" in txt and "http://b" in txt
+
+
+# -- fleet-aware doctor rules ----------------------------------------------
+def _view_meta(expected, missing, skews=None, critical_path=None):
+    peers = {}
+    for pid in expected:
+        ok = pid not in missing
+        peers[str(pid)] = {
+            "url": f"http://p{pid}", "ok": ok,
+            "error": None if ok else "URLError(111)",
+            "collected_at": time.time() if ok else None,
+            "rtt_ms": 1.0 if ok else None,
+            "skew_s": (skews or {}).get(pid)}
+    meta = {"generated_at": time.time(), "expected": list(expected),
+            "missing_peers": list(missing),
+            "processes_answered": len(expected) - len(missing),
+            "peers": peers}
+    if critical_path:
+        meta["critical_path"] = critical_path
+    return meta
+
+
+def _grades(findings, rule):
+    return [(f.grade, f.evidence.get("discriminator"))
+            for f in findings if f.rule == rule]
+
+
+def test_peer_unresponsive_telemetry_unreachable_is_warn():
+    """Scrape failed, NO collective deadline fired: only the
+    observability port is known-bad — warn, do not page."""
+    doc = _peer_doc(0)
+    from sparkucx_tpu.utils.doctor import diagnose
+    findings = diagnose([doc], fleet=_view_meta([0, 1], missing=[1]))
+    got = _grades(findings, "peer_unresponsive")
+    assert got == [("warn", "telemetry_unreachable")]
+    f = [x for x in findings if x.rule == "peer_unresponsive"][0]
+    assert f.evidence["peer"] == 1
+    assert "httpAdvertiseHost" in (f.conf_key or "")
+
+
+def test_peer_unresponsive_dead_is_critical():
+    """Scrape failed AND the watchdog fired: gone from both planes."""
+    from sparkucx_tpu.utils.doctor import diagnose
+    doc = _peer_doc(0)
+    doc["counters"] = {C_PEER_TIMEOUT: 1.0}
+    findings = diagnose([doc], fleet=_view_meta([0, 1], missing=[1]))
+    got = _grades(findings, "peer_unresponsive")
+    assert ("critical", "dead") in got
+    dead = [f for f in findings if f.rule == "peer_unresponsive"
+            and f.evidence["discriminator"] == "dead"][0]
+    assert "both planes" in dead.summary
+    assert "remesh" in dead.remediation
+
+
+def test_peer_unresponsive_wedged_reachable_names_straggler():
+    """Everyone answers HTTP but the collective deadline fired: the
+    peer is alive-but-parked, and the evidence names WHO via the
+    anatomy critical path joined over the answered docs."""
+    from sparkucx_tpu.utils.doctor import diagnose
+    doc = _peer_doc(0)
+    doc["counters"] = {C_PEER_TIMEOUT: 1.0}
+    cp = {"trace_id": TR, "process": 3, "phase": "transfer.dcn",
+          "tier": "dcn", "wall_ms": 40_000.0,
+          "straggler_lag_ms": 39_000.0}
+    findings = diagnose(
+        [doc], fleet=_view_meta([0, 1, 2, 3], missing=[],
+                                critical_path=cp))
+    got = _grades(findings, "peer_unresponsive")
+    assert got == [("critical", "wedged_reachable")]
+    f = [x for x in findings if x.rule == "peer_unresponsive"][0]
+    assert f.evidence["straggler"] == 3
+    assert f.evidence["straggler_phase"] == "transfer.dcn"
+    assert "process 3" in f.summary and "transfer.dcn" in f.summary
+    assert f.trace_ids == [TR]
+
+
+def test_peer_unresponsive_quiet_when_fleet_healthy():
+    from sparkucx_tpu.utils.doctor import diagnose
+    findings = diagnose([_peer_doc(0)],
+                        fleet=_view_meta([0, 1], missing=[]))
+    assert _grades(findings, "peer_unresponsive") == []
+    # and entirely absent without fleet meta (local-only diagnosis)
+    assert _grades(diagnose([_peer_doc(0)]), "peer_unresponsive") == []
+
+
+def test_clock_drift_grades_and_floor():
+    from sparkucx_tpu.utils.doctor import diagnose
+    th = Thresholds()
+    quiet = diagnose([_peer_doc(0)], fleet=_view_meta(
+        [0, 1], missing=[], skews={0: 0.01, 1: -0.02}))
+    assert _grades(quiet, "clock_drift") == []
+    warn = diagnose([_peer_doc(0)], fleet=_view_meta(
+        [0, 1], missing=[], skews={0: 0.01, 1: 0.5}))
+    ws = [f for f in warn if f.rule == "clock_drift"]
+    assert [f.grade for f in ws] == ["warn"]
+    assert ws[0].evidence["skews_s"] == {"1": 0.5}
+    crit = diagnose([_peer_doc(0)], fleet=_view_meta(
+        [0, 1], missing=[],
+        skews={0: -(th.clock_drift_critical_s + 1.0), 1: 0.5}))
+    cs = [f for f in crit if f.rule == "clock_drift"]
+    assert [f.grade for f in cs] == ["critical"]
+    assert cs[0].evidence["worst_s"] == pytest.approx(
+        th.clock_drift_critical_s + 1.0)
+
+
+# -- last-known phase + watchdog postmortem ---------------------------------
+def test_last_known_phase_settled_vs_wedged():
+    settled = last_known_phase(_peer_doc(0, settled=True), TR)
+    assert settled["settled"] is True
+    assert settled["wall_ms"] == pytest.approx(10.0)
+    assert settled["dominant_phase"] == "pack"
+    # no exchange wall span: the peer never finished — report the last
+    # COMPLETED span (spans record on end; the in-flight collective is
+    # the silence after it) and how long ago it ended
+    wedged = last_known_phase(_peer_doc(0, settled=False), TR)
+    assert wedged["settled"] is False
+    assert wedged["last_span"] == "shuffle.tier"
+    assert wedged["phase"] == "transfer.dcn"
+    assert wedged["trace_id"] == TR
+    assert wedged["since_s"] is not None and wedged["since_s"] > -1.0
+    empty = last_known_phase({"anchor": _anchor(), "trace_events": []})
+    assert empty["settled"] is False and empty["last_span"] is None
+
+
+def test_watchdog_expiry_embeds_peer_postmortem(tmp_path):
+    """The wedged-peer drill end-to-end: a survivor's collective
+    deadline fires, its watchdog scrapes the fleet OUT-OF-BAND (HTTP,
+    no collectives — the collective just proved dead) and the flight
+    dump says what phase the peer was last seen in."""
+    from sparkucx_tpu.runtime.failures import (FlightRecorder,
+                                               PeerLostError)
+    from sparkucx_tpu.runtime.watchdog import Watchdog
+    peer = _peer_doc(1, settled=False)          # wedged-looking ring
+    srv = LiveTelemetryServer(lambda: peer, lambda: [],
+                              lambda: {"ok": True}, port=0).start()
+    try:
+        reg = FleetRegistry.from_urls([srv.url])
+        coll = ClusterCollector(reg, timeout_s=2.0)
+        rec = FlightRecorder(out_dir=str(tmp_path))
+        wd = Watchdog(100.0, flight=rec)
+        wd.peer_scrape = coll.postmortem
+        release = threading.Event()
+        try:
+            with pytest.raises(PeerLostError):
+                wd.call(release.wait, what="fenced allgather", trace=TR)
+        finally:
+            release.set()
+        doc = json.loads(open(rec.dumps[0]).read())
+        pm = doc["peer_timeout"]["peer_postmortem"]
+        assert pm["what"] == "fenced allgather" and pm["trace"] == TR
+        assert pm["missing_peers"] == []
+        last = pm["peers"]["0"]["last_known"]
+        assert last["settled"] is False
+        assert last["phase"] == "transfer.dcn"
+        assert last["since_s"] is not None
+    finally:
+        srv.stop()
+
+
+def test_watchdog_scrape_failure_never_masks_the_verdict(tmp_path):
+    from sparkucx_tpu.runtime.failures import (FlightRecorder,
+                                               PeerLostError)
+    from sparkucx_tpu.runtime.watchdog import Watchdog
+    rec = FlightRecorder(out_dir=str(tmp_path))
+    wd = Watchdog(100.0, flight=rec)
+
+    def explode(**kw):
+        raise RuntimeError("scrape plane down")
+    wd.peer_scrape = explode
+    release = threading.Event()
+    try:
+        with pytest.raises(PeerLostError):
+            wd.call(release.wait, what="allgather")
+    finally:
+        release.set()
+    doc = json.loads(open(rec.dumps[0]).read())
+    assert doc["peer_timeout"]["peer_postmortem"] is None
+
+
+# -- freshest-anchor re-anchoring ------------------------------------------
+def _proc_doc(process_id, anchor, events, anchors_history=None):
+    d = {"process_id": process_id, "anchor": anchor,
+         "trace_events": events}
+    if anchors_history is not None:
+        d["anchors"] = anchors_history
+    return d
+
+
+def _settled_events(start_us=0.0):
+    return [_ev("shuffle.exchange", start_us, 10_000, trace=TR,
+                completed=True),
+            _ev("shuffle.pack", start_us, 9_000, trace=TR)]
+
+
+def test_freshest_anchor_prefers_newest_sample():
+    from sparkucx_tpu.utils.export import freshest_anchor
+    stale = _anchor(wall_epoch=900.0, wall=10.0)
+    fresh = _anchor(wall_epoch=1000.0, wall=60.0)
+    doc = _proc_doc(0, stale, [], anchors_history=[fresh])
+    assert freshest_anchor(doc)["wall_epoch"] == 1000.0
+    # no history: the primary anchor stands (every pre-fleet doc)
+    assert freshest_anchor(_proc_doc(0, stale, []))["wall_epoch"] == 900.0
+    with pytest.raises(ValueError, match="anchor"):
+        freshest_anchor({"trace_events": []})
+
+
+def test_drift_regression_timeline_and_critical_path_realign():
+    """The clock-drift regression pin: doc B's boot (primary) anchor is
+    0.75 s stale, but a scrape-time re-anchor rides in its ``anchors``
+    history. merge_timeline and critical_path must align on the FRESH
+    anchor — byte-identical to the no-drift run — instead of smearing
+    every cross-process claim by the drift."""
+    from sparkucx_tpu.utils.anatomy import critical_path
+    from sparkucx_tpu.utils.export import merge_timeline
+    a_anchor = _anchor(wall_epoch=1000.0, wall=50.0)
+    true_b = _anchor(wall_epoch=1000.5, wall=60.0)
+    doc_a = _proc_doc(0, a_anchor, _settled_events())
+    clean_b = _proc_doc(1, true_b, _settled_events(start_us=2_000.0))
+    drift_b = _proc_doc(
+        1, _anchor(wall_epoch=999.75, wall=5.0),   # stepped boot anchor
+        _settled_events(start_us=2_000.0), anchors_history=[true_b])
+    for variant in (clean_b, drift_b):
+        tl = merge_timeline([doc_a, variant])
+        by_pid = {}
+        for e in tl["traceEvents"]:
+            if e.get("ph") == "X" and e["name"] == "shuffle.exchange":
+                by_pid[e["pid"]] = e["ts"]
+        # B started 2 ms into its own clock +0.5 s epoch offset later
+        assert by_pid[1] - by_pid[0] == pytest.approx(502_000.0)
+        cp = critical_path([doc_a, variant])
+        assert cp["process"] == 1              # ends last on shared axis
+        assert cp["straggler_lag_ms"] == pytest.approx(502.0)
+
+
+# -- real subprocess peers: the degraded-scrape drill -----------------------
+_CHILD = r"""
+import json, sys, time
+from sparkucx_tpu.utils.live import LiveTelemetryServer
+doc = json.loads(sys.argv[1])
+srv = LiveTelemetryServer(lambda: doc, lambda: [],
+                          lambda: {"ok": True}, port=0).start()
+print(srv.url, flush=True)
+time.sleep(120)
+"""
+
+
+def _spawn_peer(doc):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _CHILD, json.dumps(doc)],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    url = proc.stdout.readline().strip()
+    assert url.startswith("http://"), f"peer failed to boot: {url!r}"
+    return proc, url
+
+
+def test_subprocess_fleet_survives_a_killed_peer():
+    """N real HTTP peers; one dies mid-test. The scrape returns inside
+    its deadline, marks the corpse ``missing``, the doctor still grades
+    the survivors, and peer_unresponsive fires with the right
+    discriminator (telemetry_unreachable: nobody's watchdog fired)."""
+    procs = []
+    try:
+        for pid in range(3):
+            procs.append(_spawn_peer(_peer_doc(pid)))
+        reg = FleetRegistry(
+            [registry_entry(i, url, _anchor())
+             for i, (_, url) in enumerate(procs)])
+        coll = ClusterCollector(reg, timeout_s=5.0)
+        full = coll.scrape()
+        assert full["missing_peers"] == []
+        assert full["processes_answered"] == 3
+        procs[1][0].kill()
+        procs[1][0].wait()
+        view = coll.scrape(timeout_s=2.0)
+        assert view["missing_peers"] == [1]
+        assert view["processes_answered"] == 2
+        assert view["peers"]["0"]["ok"] and view["peers"]["2"]["ok"]
+        findings = fleet_diagnose(view)
+        got = _grades(findings, "peer_unresponsive")
+        assert got == [("warn", "telemetry_unreachable")]
+        # the survivors' docs still fold into a graded cluster view —
+        # degraded, not dead: exchanges from peers 0 and 2 are present
+        assert len(fleet.fleet_docs(view)) == 2
+        rep = coll.anatomy(view, trace_id=TR)
+        assert rep["missing_peers"] == [1]
+        assert rep["exchanges_seen"] >= 1
+    finally:
+        for p, _ in procs:
+            with contextlib.suppress(Exception):
+                p.kill()
+
+
+# -- /cluster routes --------------------------------------------------------
+def test_cluster_routes_404_without_a_registry():
+    srv = LiveTelemetryServer(lambda: _peer_doc(0), lambda: [],
+                              lambda: {"ok": True}, port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url + "/cluster/snapshot",
+                                   timeout=5)
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_cluster_routes_served_by_any_peer():
+    """The degraded-mode contract: scraping ONE process answers for the
+    whole fleet, including the peers it could not reach."""
+    peer = _peer_doc(1)
+    backend = LiveTelemetryServer(lambda: peer, lambda: [],
+                                  lambda: {"ok": True}, port=0).start()
+    try:
+        reg = FleetRegistry([
+            registry_entry(0, backend.url, _anchor()),
+            registry_entry(1, "http://127.0.0.1:9", _anchor())])  # dead
+        coll = ClusterCollector(reg, timeout_s=1.0)
+        front = LiveTelemetryServer(
+            lambda: _peer_doc(0), lambda: [], lambda: {"ok": True},
+            port=0, cluster_fn=coll.scrape).start()
+        try:
+            view = json.loads(urllib.request.urlopen(
+                front.url + "/cluster/snapshot", timeout=10).read())
+            assert view["missing_peers"] == [1]
+            assert view["peers"]["0"]["ok"]
+            doc = json.loads(urllib.request.urlopen(
+                front.url + "/cluster/doctor", timeout=10).read())
+            rules = [f["rule"] for f in doc["findings"]]
+            assert "peer_unresponsive" in rules
+            assert doc["fleet"]["missing_peers"] == [1]
+            rep = json.loads(urllib.request.urlopen(
+                front.url + f"/cluster/anatomy?trace={TR}",
+                timeout=10).read())
+            assert rep["missing_peers"] == [1]
+        finally:
+            front.stop()
+    finally:
+        backend.stop()
+
+
+# -- CLI --------------------------------------------------------------------
+def _run_cli(argv):
+    from sparkucx_tpu.__main__ import main as cli_main
+    out, err = io.StringIO(), io.StringIO()
+    with contextlib.redirect_stdout(out), contextlib.redirect_stderr(err):
+        rc = cli_main(argv)
+    return rc, out.getvalue(), err.getvalue()
+
+
+def test_cluster_cli_healthy_degraded_and_dead(tmp_path):
+    srv = LiveTelemetryServer(lambda: _peer_doc(0), lambda: [],
+                              lambda: {"ok": True}, port=0).start()
+    try:
+        # healthy: one live peer, exit 0, table row is ok
+        FleetRegistry([registry_entry(0, srv.url, _anchor())]).save(
+            str(tmp_path))
+        rc, out, _ = _run_cli(["cluster", "--registry", str(tmp_path),
+                               "--timeout-s", "3"])
+        assert rc == 0
+        assert "1/1 peer(s) answered" in out and "MISSING" not in out
+        # degraded: one live + one dead; default fail-on critical still
+        # exits 0 (telemetry_unreachable is a WARN), --fail-on warn
+        # turns the same view into exit 3 — the CI drill's knob
+        rc, out, _ = _run_cli(["cluster", "--peers", srv.url,
+                               "http://127.0.0.1:9", "--timeout-s", "3",
+                               "--format", "json"])
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["fleet"]["missing_peers"] == [1]
+        assert "peer_unresponsive" in \
+            [f["rule"] for f in doc["findings"]]
+        rc, out, _ = _run_cli(["cluster", "--peers", srv.url,
+                               "http://127.0.0.1:9", "--timeout-s", "3",
+                               "--fail-on", "warn"])
+        assert rc == 3
+        assert "MISSING" in out and "peer_unresponsive" in out
+    finally:
+        srv.stop()
+    # every peer dead: exit 2 (no view to grade at all)
+    rc, _, err = _run_cli(["cluster", "--peers", "http://127.0.0.1:9",
+                           "--timeout-s", "1"])
+    assert rc == 2 and "NO peer answered" in err
+
+
+def test_cluster_cli_missing_registry_exit2(tmp_path):
+    rc, _, err = _run_cli(
+        ["cluster", "--registry", str(tmp_path / "nope.json")])
+    assert rc == 2
+    assert "no fleet registry" in err and "--peers" in err
+
+
+def test_resolve_registry_accepts_file_urls_and_dir(tmp_path):
+    FleetRegistry([registry_entry(5, "http://x:1", _anchor())]).save(
+        str(tmp_path))
+    assert resolve_registry(registry=str(tmp_path)).expected() == [5]
+    assert resolve_registry(
+        peers=[registry_path(str(tmp_path))]).expected() == [5]
+    assert resolve_registry(
+        peers=["http://a:1", "http://b:2"]).expected() == [0, 1]
+    with pytest.raises(FileNotFoundError):
+        resolve_registry(registry=str(tmp_path / "missing"))
+
+
+# -- node integration -------------------------------------------------------
+def test_node_boot_publishes_registry_and_reanchors(tmp_path):
+    """connect() publishes the URL through the boot round, persists the
+    registry beside the ledger, wires the watchdog's scrape hook, and
+    every later snapshot carries the re-anchor history + skew."""
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.metrics.httpPort": "0",
+        "spark.shuffle.tpu.failure.ledgerDir": str(tmp_path),
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    try:
+        reg = FleetRegistry.load(str(tmp_path))
+        assert reg.expected() == [node.process_id]
+        assert reg.peers()[node.process_id] == \
+            f"http://{node.live.host}:{node.live.port}"
+        assert reg.boot_anchor(node.process_id) is not None
+        assert node.collector is not None
+        assert node.watchdog.peer_scrape == node.collector.postmortem
+        snap = node.telemetry_snapshot()
+        # anchors history carries the BOOT anchor; the primary anchor
+        # is the per-snapshot re-anchor — freshest_anchor prefers it
+        assert snap["anchors"][0]["wall_epoch"] == pytest.approx(
+            reg.boot_anchor(node.process_id)["wall_epoch"])
+        assert abs(snap["anchor_skew_s"]) < 5.0   # same healthy clock
+        assert snap["fleet_registry"]["entries"][0]["url"] == \
+            reg.peers()[node.process_id]
+        # the node serves its own fleet view over /cluster/*
+        view = json.loads(urllib.request.urlopen(
+            f"http://{node.live.host}:{node.live.port}"
+            "/cluster/snapshot", timeout=10).read())
+        assert view["processes_answered"] == 1
+        assert view["missing_peers"] == []
+        assert view["peers"][str(node.process_id)]["skew_s"] is not None
+    finally:
+        node.close()
+    assert node.collector is None and node.watchdog.peer_scrape is None
+
+
+def test_node_without_live_server_has_no_fleet(tmp_path):
+    from sparkucx_tpu.config import TpuShuffleConf
+    from sparkucx_tpu.runtime.node import TpuNode
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.failure.ledgerDir": str(tmp_path),
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    try:
+        assert node.collector is None
+        assert not os.path.exists(registry_path(str(tmp_path)))
+        snap = node.telemetry_snapshot()
+        assert "fleet_registry" not in snap
+    finally:
+        node.close()
