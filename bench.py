@@ -3029,6 +3029,12 @@ def devcombine_measure(rows_per_map=1 << 13, maps=4, partitions=16,
       ``partitions()``) + the same aggregation in numpy — the round
       trip the device merge deletes.
 
+    A third, distributed cell re-proves the device-arm contract through
+    the DISTRIBUTED split-tier exchange (forced single-process
+    distributed mode — the PR-9 code-path discipline; cluster job 10
+    gates real multi-host): sink=device legal distributed, zero payload
+    D2H, 0 warm recompiles, same aggregates via host_view.
+
     Both arms must agree on the aggregates (distinct keys exactly, f32
     value sum within drift). The beats-host gate compares MERGE LEGS
     (device fold + consume step vs host merge + repack + re-upload +
@@ -3075,6 +3081,7 @@ def devcombine_measure(rows_per_map=1 << 13, maps=4, partitions=16,
         h = mgr.register_shuffle(93000, maps, partitions)
         truth_sum = np.float64(0.0)
         truth_keys = set()
+        staged = []          # re-staged verbatim by the distributed cell
         for m in range(maps):
             k = rng.integers(0, key_space,
                              size=rows_per_map).astype(np.int64)
@@ -3083,6 +3090,7 @@ def devcombine_measure(rows_per_map=1 << 13, maps=4, partitions=16,
             w = mgr.get_writer(h, m)
             w.write(k, v)
             w.commit(partitions)
+            staged.append((k, v))
             truth_keys.update(int(x) for x in k)
             truth_sum += np.float64(v.sum(dtype=np.float64))
 
@@ -3229,6 +3237,70 @@ def devcombine_measure(rows_per_map=1 << 13, maps=4, partitions=16,
         mgr.stop()
         node.close()
 
+    # -- distributed device arm: the SAME combine contract through the
+    # DISTRIBUTED split-tier exchange, forced single-process distributed
+    # mode (degenerate allgathers — the PR-9 code-path-cell discipline;
+    # cluster job 10 gates real multi-host): read.sink=device stays
+    # legal distributed with ZERO payload D2H, 0 warm recompiles once
+    # the shape family settles, no agreement divergence on a healthy
+    # read, and host_view drains to the same aggregates.
+    from sparkucx_tpu.utils.metrics import C_AGREE_DIVERGENCE
+    conf_d = TpuShuffleConf({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+        "spark.shuffle.tpu.mesh.numSlices": "2",
+        "spark.shuffle.tpu.a2a.waveRows": str(wave_rows),
+        "spark.shuffle.tpu.a2a.waveDepth": "2",
+    }, use_env=False)
+    node = TpuNode.start(conf_d)
+    node.is_distributed = True
+    mgr = TpuShuffleManager(node, conf_d)
+    try:
+        h = mgr.register_shuffle(93001, maps, partitions)
+        for m, (k, v) in enumerate(staged):
+            w = mgr.get_writer(h, m)
+            w.write(k, v)
+            w.commit(partitions)
+        div0 = GLOBAL_METRICS.get(C_AGREE_DIVERGENCE)
+        mgr.read(h, combine="sum", sink="device")   # compile
+        mgr.read(h, combine="sum", sink="device")   # cap-hint settle
+        d2h0 = GLOBAL_METRICS.get(C_D2H)
+        progw0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+        res = None
+        for _ in range(reps):
+            res = mgr.read(h, combine="sum", sink="device")
+        rep_d = mgr.report(93001)
+        # snapshot the VALUE before the host_view drain below: the live
+        # report keeps accruing lazy pulls (_arm_d2h charges the drain
+        # to the read that produced it), and the gate is about the
+        # combine path, not the explicit verification drain
+        d2h_pre_drain = int(rep_d.d2h_bytes)
+        warm_d2h = GLOBAL_METRICS.get(C_D2H) - d2h0
+        warm_progs = GLOBAL_METRICS.get(COMPILE_PROGRAMS) - progw0
+        hv = res.host_view()
+        got_keys, got_sum = 0, 0.0
+        for r in range(partitions):
+            k, v = hv.partition(r)
+            got_keys += int(k.shape[0])
+            got_sum += float(np.asarray(v, dtype=np.float64).sum())
+        dist = {
+            "report_distributed": bool(rep_d.distributed),
+            "report_sink": rep_d.sink,
+            "report_d2h_bytes": d2h_pre_drain,
+            "drain_d2h_bytes": int(rep_d.d2h_bytes) - d2h_pre_drain,
+            "warm_d2h_bytes_delta": warm_d2h,
+            "warm_programs": int(warm_progs),
+            "waves": rep_d.waves,
+            "distinct_keys": got_keys,
+            "value_sum": got_sum,
+            "agreement_divergence_delta":
+                GLOBAL_METRICS.get(C_AGREE_DIVERGENCE) - div0,
+        }
+        mgr.unregister_shuffle(93001)
+    finally:
+        node.is_distributed = False
+        mgr.stop()
+        node.close()
+
     speedup = host["median_ms"] / dev["median_ms"] \
         if dev["median_ms"] else 0.0
     denom = max(abs(truth_sum), 1.0)
@@ -3249,6 +3321,20 @@ def devcombine_measure(rows_per_map=1 << 13, maps=4, partitions=16,
             < 1e-3),
         "host_drains": bool(host["report_d2h_bytes"] > 0),
         "host_reuploads": bool(host["h2d_bytes_delta"] > 0),
+        # distributed cell: same contract through the split-tier path
+        "distributed_report": dist["report_distributed"],
+        "distributed_sink_device": dist["report_sink"] == "device",
+        "distributed_d2h_zero": bool(
+            dist["report_d2h_bytes"] == 0
+            and dist["warm_d2h_bytes_delta"] == 0),
+        "distributed_zero_warm_recompiles":
+            bool(dist["warm_programs"] == 0),
+        "distributed_aggregates_match": bool(
+            dist["distinct_keys"] == len(truth_keys)
+            and abs(dist["value_sum"] - float(truth_sum))
+            / max(abs(truth_sum), 1.0) < 1e-3),
+        "distributed_no_divergence":
+            bool(dist["agreement_divergence_delta"] == 0),
     }
     merge_beats = bool(
         dev["merge_leg_median_ms"] <= host["merge_leg_median_ms"])
@@ -3265,7 +3351,8 @@ def devcombine_measure(rows_per_map=1 << 13, maps=4, partitions=16,
     merge_speedup = host["merge_leg_median_ms"] \
         / dev["merge_leg_median_ms"] if dev["merge_leg_median_ms"] \
         else 0.0
-    out.update(device=dev, host=host, speedup=round(speedup, 3),
+    out.update(device=dev, host=host, distributed=dist,
+               speedup=round(speedup, 3),
                merge_speedup=round(merge_speedup, 3),
                backend=backend,
                oracle={"distinct_keys": len(truth_keys),
@@ -3347,7 +3434,11 @@ def chaos_measure(rows_per_map=1 << 12, maps=4, partitions=16,
     ``failure.collectiveTimeoutMs`` + probe slack. A separate watchdog
     drill runs the deadline fence against a genuinely hung step and
     checks PeerLostError lands on time with the leaked-thread census
-    accounting for the abandoned worker."""
+    accounting for the abandoned worker. Two DISTRIBUTED cells (forced
+    single-process distributed mode, PR-9 code-path discipline) prove
+    the collective replay spends one budget unit group-wide and the
+    split-tier per-stage deadline surfaces a typed PeerLostError naming
+    the straggling tier."""
     import time as _time
 
     import numpy as np
@@ -3936,6 +4027,131 @@ def chaos_measure(rows_per_map=1 << 12, maps=4, partitions=16,
         node.close()
         _shutil.rmtree(flight_dir, ignore_errors=True)
 
+    # distributed cells (agreement plane): forced single-process
+    # distributed mode (node.is_distributed=True — every allgather
+    # degenerates to identity, the PR-9 code-path-cell discipline;
+    # cluster job 10 gates real multi-host, multiprocess CPU collectives
+    # remain the documented env gap). Two cells, SAME contract as their
+    # local twins:
+    #
+    # * exchange x replay — the COLLECTIVE replay: surviving processes
+    #   agree to re-enter ("replay.enter"), spending exactly ONE budget
+    #   unit group-wide, landing on the same plan family to oracle
+    #   bytes with zero agreement divergence.
+    # * tier.dcn x failfast — the PER-STAGE deadline: a DCN straggler
+    #   past failure.dcn.timeoutMs surfaces a typed PeerLostError
+    #   NAMING the dcn tier (the fused-program stall this PR's split
+    #   deleted), and a clean re-read returns oracle bytes.
+    from sparkucx_tpu.utils.metrics import (C_AGREE_DIVERGENCE,
+                                            GLOBAL_METRICS)
+    cell = {"impl": "dense", "mode": "single", "policy": "replay",
+            "site": "exchange", "distributed": True}
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+        "spark.shuffle.tpu.mesh.numSlices": "2",
+        "spark.shuffle.tpu.failure.policy": "replay",
+        "spark.shuffle.tpu.failure.replayBudget": "2",
+        "spark.shuffle.tpu.failure.collectiveTimeoutMs": str(timeout_ms),
+        "spark.shuffle.tpu.network.timeoutMs": str(int(timeout_ms)),
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    node.is_distributed = True
+    mgr = TpuShuffleManager(node, conf)
+    try:
+        h0 = stage(mgr)
+        oracle_d = canonical(mgr.read(h0))
+        clean_rep = mgr.report(h0.shuffle_id)
+        clean_family = clean_rep.plan_family
+        assert clean_rep.distributed, "forced distributed mode inert"
+        mgr.unregister_shuffle(h0.shuffle_id)
+        div0 = GLOBAL_METRICS.get(C_AGREE_DIVERGENCE)
+        t0 = _time.perf_counter()
+        node.faults.arm("exchange", fail_count=1)
+        try:
+            h = stage(mgr)
+            got = canonical(mgr.read(h))
+            rep = mgr.report(h.shuffle_id)
+            cell["replays"] = int(rep.replays)
+            cell["bytes_ok"] = same(got, oracle_d)
+            cell["family_stable"] = rep.plan_family == clean_family
+            cell["still_distributed"] = bool(rep.distributed)
+            cell["outcome"] = "replayed" if rep.replays else "no_fire"
+            fired = node.faults.stats().get("exchange", (0, 0))
+            cell["fault_fired"] = fired[1] >= 1
+            cell["no_divergence"] = \
+                GLOBAL_METRICS.get(C_AGREE_DIVERGENCE) - div0 == 0
+        finally:
+            node.faults.disarm("exchange")
+        cell["wall_ms"] = round((_time.perf_counter() - t0) * 1e3, 1)
+        cell["hang_free"] = cell["wall_ms"] < envelope_ms
+        cell["ok"] = bool(
+            cell["outcome"] == "replayed"
+            # ONE budget unit group-wide — the collective-replay bar
+            and cell["replays"] == 1
+            and cell["fault_fired"] and cell["hang_free"]
+            and cell["bytes_ok"] and cell["family_stable"]
+            and cell["still_distributed"] and cell["no_divergence"])
+        ok &= cell["ok"]
+        cells.append(cell)
+    finally:
+        node.is_distributed = False
+        mgr.stop()
+        node.close()
+
+    cell = {"impl": "dense", "mode": "single", "policy": "failfast",
+            "site": "tier.dcn", "distributed": True}
+    dcn_timeout_ms = 300.0
+    conf = TpuShuffleConf({
+        "spark.shuffle.tpu.a2a.impl": "dense",
+        "spark.shuffle.tpu.mesh.numSlices": "2",
+        "spark.shuffle.tpu.failure.policy": "failfast",
+        "spark.shuffle.tpu.failure.collectiveTimeoutMs": str(timeout_ms),
+        "spark.shuffle.tpu.failure.dcn.timeoutMs": str(dcn_timeout_ms),
+        "spark.shuffle.tpu.network.timeoutMs": str(int(timeout_ms)),
+    }, use_env=False)
+    node = TpuNode.start(conf)
+    node.is_distributed = True
+    mgr = TpuShuffleManager(node, conf)
+    try:
+        h0 = stage(mgr)
+        oracle_d = canonical(mgr.read(h0))
+        mgr.unregister_shuffle(h0.shuffle_id)
+        t0 = _time.perf_counter()
+        node.faults.arm("tier.dcn", delay_ms=timeout_ms * 0.75)
+        try:
+            h = stage(mgr)
+            try:
+                mgr.read(h)
+                cell["outcome"] = "no_fire"
+            except PeerLostError as e:
+                cell["outcome"] = "typed_error"
+                cell["error_type"] = type(e).__name__
+                # the DEADLINE must name the straggling TIER — the
+                # postmortem-attribution contract of the split program
+                cell["tier_named"] = "dcn" in str(e)
+            # a pure-delay site never "injects" (no raise) — consulted
+            # hits are the fired evidence, like the slow_tier drill
+            fired = node.faults.stats().get("tier.dcn", (0, 0))
+            cell["fault_fired"] = fired[0] >= 1
+        finally:
+            node.faults.disarm("tier.dcn")
+        got = canonical(mgr.read(h))
+        cell["bytes_ok"] = same(got, oracle_d)
+        cell["replays"] = 0
+        cell["wall_ms"] = round((_time.perf_counter() - t0) * 1e3, 1)
+        cell["hang_free"] = cell["wall_ms"] < envelope_ms
+        cell["ok"] = bool(
+            cell["outcome"] == "typed_error"
+            and cell.get("tier_named", False)
+            and cell["fault_fired"] and cell["hang_free"]
+            and cell["bytes_ok"])
+        ok &= cell["ok"]
+        cells.append(cell)
+    finally:
+        node.is_distributed = False
+        mgr.stop()
+        node.close()
+
     # watchdog drill: a genuinely hung step must become PeerLostError
     # within the deadline, and the abandoned worker must show up in the
     # leaked census — the in-process stand-in for the killed-peer e2e
@@ -4077,7 +4293,8 @@ def hier_measure(rows_per_map=1 << 13, maps=8, partitions=16, reps=3,
 
     sid_box = [95000]
 
-    def run_arm(topology, skew, extra=None, reads=None, faults=None):
+    def run_arm(topology, skew, extra=None, reads=None, faults=None,
+                distributed=False):
         conf_map = {
             "spark.shuffle.tpu.a2a.impl": "dense",
             "spark.shuffle.tpu.mesh.numSlices": str(S),
@@ -4086,6 +4303,15 @@ def hier_measure(rows_per_map=1 << 13, maps=8, partitions=16, reps=3,
         conf_map.update(extra or {})
         conf = TpuShuffleConf(conf_map, use_env=False)
         node = TpuNode.start(conf)
+        if distributed:
+            # forced single-process distributed mode: every allgather
+            # degenerates to identity, so the SPLIT-TIER distributed
+            # exchange (per-tier programs, per-stage deadlines, agreed
+            # overflow) runs for real — the PR-9 code-path-cell
+            # discipline; real multi-host is gated by cluster job 10
+            # (multiprocess CPU collectives remain the documented
+            # env gap)
+            node.is_distributed = True
         mgr = TpuShuffleManager(node, conf)
 
         def one_exchange():
@@ -4133,11 +4359,13 @@ def hier_measure(rows_per_map=1 << 13, maps=8, partitions=16, reps=3,
                 for site in faults:
                     node.faults.disarm(site)
         finally:
+            node.is_distributed = False
             mgr.stop()
             node.close()
         times.sort()
         out = {
             "topology": topology,
+            "distributed": bool(rep.distributed),
             "hierarchical": bool(rep.hierarchical),
             "e2e_ms_median": round(times[len(times) // 2], 2),
             "payload_mb": round(rep.payload_bytes / 1e6, 3),
@@ -4212,6 +4440,30 @@ def hier_measure(rows_per_map=1 << 13, maps=8, partitions=16, reps=3,
     healthy_quiet = all(
         not lv[arm]["slow_tier_findings"]
         for lv in levels.values() for arm in ("flat", "hier"))
+    # distributed split-tier cell: the SAME hier contract through the
+    # distributed tiered exchange (agreement-planned per-tier programs)
+    # — exact DCN cross-rows from the AGREED device matrix, 0 warm
+    # recompiles, no agreement divergence on a healthy read
+    from sparkucx_tpu.utils.metrics import (C_AGREE_DIVERGENCE,
+                                            C_AGREE_ROUNDS,
+                                            GLOBAL_METRICS)
+    agree0 = GLOBAL_METRICS.get(C_AGREE_ROUNDS)
+    div0 = GLOBAL_METRICS.get(C_AGREE_DIVERGENCE)
+    dist = run_arm("hier", "uniform", distributed=True)
+    dist_tiers = {t["tier"]: t for t in dist.get("tiers", [])}
+    dist_checks = {
+        "report_distributed": dist["distributed"],
+        "hier_held": dist["hierarchical"],
+        "dcn_cross_rows_exact": bool(
+            dist_tiers["dcn"]["cross_exact"]
+            and dist_tiers["dcn"]["payload_rows"]
+            == levels["uniform"]["oracle_cross_rows"]),
+        "warm_zero_recompiles": dist["warm_recompiles"] == 0,
+        "agreement_rounds_ran":
+            GLOBAL_METRICS.get(C_AGREE_ROUNDS) - agree0 > 0,
+        "no_divergence":
+            GLOBAL_METRICS.get(C_AGREE_DIVERGENCE) - div0 == 0,
+    }
     return {
         "shape": {"rows_per_map": rows_per_map, "maps": maps,
                   "partitions": partitions, "val_words": val_words,
@@ -4221,6 +4473,12 @@ def hier_measure(rows_per_map=1 << 13, maps=8, partitions=16, reps=3,
             "fired": drill_ok,
             "findings": slow,
             "healthy_quiet": healthy_quiet,
+        },
+        "distributed_cell": {
+            "arm": dist,
+            "agreement_rounds": int(
+                GLOBAL_METRICS.get(C_AGREE_ROUNDS) - agree0),
+            "checks": dist_checks,
         },
         "context": ("CPU walls are context-only; the gates ride the "
                     "deterministic per-tier byte accounting with tier "
@@ -4238,8 +4496,12 @@ def stage_hier(args) -> int:
     exactly once (numpy-oracle-exact cross counts); one compiled
     program per (family, topology, tier) with 0 warm recompiles; and
     the slow_tier doctor rule fires on an injected DCN straggler naming
-    the dcn tier while the healthy arms diagnose clean. Writes
-    bench_runs/hier.json — a committed CI regress baseline."""
+    the dcn tier while the healthy arms diagnose clean. A distributed
+    cell re-proves the hier contract through the split-tier distributed
+    exchange (forced single-process distributed mode — the PR-9
+    code-path discipline; cluster job 10 gates the real multi-host
+    run). Writes bench_runs/hier.json — a committed CI regress
+    baseline."""
     out = {"metric": "hier",
            "detail": hier_measure(
                rows_per_map=1 << (args.rows_log2 or 12),
@@ -4262,6 +4524,8 @@ def stage_hier(args) -> int:
     ok &= d["levels"]["uniform"]["flat"]["first_read_programs"] == 1
     ok &= d["slow_tier_drill"]["fired"]
     ok &= d["slow_tier_drill"]["healthy_quiet"]
+    # distributed split-tier cell: same contract, agreement-planned
+    ok &= all(d["distributed_cell"]["checks"].values())
     out["ok"] = bool(ok)
     out["telemetry"] = _telemetry_blob()
     artifact = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -4500,7 +4764,9 @@ def tenancy_measure(minnow_rows=1 << 13, whale_rows=1 << 13,
                     minnows=8, minnow_rounds=3, whale_reads=40,
                     val_words=4, whale_deadline_s=120.0):
     """The multi-tenant isolation proof behind ``--stage tenancy``:
-    1 whale + ``minnows`` minnow shuffles sharing one mesh, three cells:
+    1 whale + ``minnows`` minnow shuffles sharing one mesh, three cells
+    (plus a distributed K-worker code-path cell — see
+    ``distributed_cell``):
 
     * ``solo``    — minnow tenant alone (async plane): the uncontended
                     p99 baseline.
@@ -4677,6 +4943,119 @@ def tenancy_measure(minnow_rows=1 << 13, whale_rows=1 << 13,
         "spark.shuffle.tpu.tenant.fairShare": "false",
     }, with_whale=True)
 
+    def distributed_cell():
+        """Code-path cell for the DISTRIBUTED K-worker async plane
+        (forced distributed executor at nproc=1 — agreement rounds
+        degenerate to identity, the PR-9 discipline; cluster job 10
+        gates real multi-host): the conf'd worker count survives
+        distributed mode (no silent width-1 clamp), the agreed-order
+        dispatcher drains whale-flood + minnow traffic in the
+        collectively agreed tenant-DRR order with FIFO held within each
+        tenant, the agreed order is a PURE function of the batch
+        (simulated-process parity), and the asyncAgreedOrder=false
+        opt-out clamps back to width 1. Jobs are lightweight stubs, not
+        concurrent collectives — the same XLA:CPU posture that
+        serializes the cells above; real distributed reads are gated by
+        tests/test_distributed_parity.py and the cluster harness."""
+        import threading
+
+        from sparkucx_tpu.config import TpuShuffleConf
+        from sparkucx_tpu.shuffle.tenancy import (AsyncShuffleExecutor,
+                                                  TenantRegistry,
+                                                  agreed_submission_order)
+        from sparkucx_tpu.utils.metrics import (C_AGREE_DIVERGENCE,
+                                                C_AGREE_ROUNDS,
+                                                GLOBAL_METRICS, Metrics)
+
+        def mk_conf(extra=None):
+            m = {
+                "spark.shuffle.tpu.a2a.impl": "dense",
+                "spark.shuffle.tpu.tenant.asyncWorkers": "4",
+                "spark.shuffle.tpu.tenant.minnow.priority": "high",
+                "spark.shuffle.tpu.tenant.whale.priority": "batch",
+            }
+            m.update(extra or {})
+            return TpuShuffleConf(m, use_env=False)
+
+        conf = mk_conf()
+        reg = TenantRegistry(conf)
+        ex = AsyncShuffleExecutor(conf, reg, Metrics(),
+                                  distributed=True)
+        agree0 = GLOBAL_METRICS.get(C_AGREE_ROUNDS)
+        div0 = GLOBAL_METRICS.get(C_AGREE_DIVERGENCE)
+        started, lock = [], threading.Lock()
+
+        def job(tenant, i):
+            with lock:
+                started.append((tenant, i))
+            time.sleep(0.005)
+            return (tenant, i)
+
+        try:
+            futs = []
+            # the whale floods first, minnows land behind it — the
+            # head-of-line scenario of the fair/starved cells above
+            for i in range(6):
+                futs.append(ex.submit(
+                    lambda i=i: job("whale", i), "whale", 200 + i))
+            for i in range(3):
+                futs.append(ex.submit(
+                    lambda i=i: job("minnow", i), "minnow", 300 + i))
+            results = [f.result(60) for f in futs]
+            resolved = sorted(results) == sorted(
+                [("whale", i) for i in range(6)]
+                + [("minnow", i) for i in range(3)])
+            with lock:
+                whale_starts = [i for t, i in started if t == "whale"]
+                minnow_starts = [i for t, i in started if t == "minnow"]
+            rounds = GLOBAL_METRICS.get(C_AGREE_ROUNDS) - agree0
+            diverged = GLOBAL_METRICS.get(C_AGREE_DIVERGENCE) - div0
+        finally:
+            ex.stop()
+        # opt-out golden: asyncAgreedOrder=false restores the width-1
+        # clamp (warned once; async_workers on reports carries it)
+        ex_opt = AsyncShuffleExecutor(
+            mk_conf({"spark.shuffle.tpu.tenant.asyncAgreedOrder":
+                     "false"}),
+            reg, Metrics(), distributed=True)
+        clamped = ex_opt.workers == 1
+        ex_opt.stop()
+        # simulated-process parity: the DRR order is a pure function of
+        # the (seq, tenant) batch — two processes holding the same
+        # batch compute the identical dispatch order
+        weights = {t: reg.spec(t).weight for t in ("whale", "minnow")}
+        pending = [(1, "whale"), (2, "minnow"), (3, "whale"),
+                   (4, "whale"), (5, "minnow")]
+        order_a = agreed_submission_order(pending,
+                                          lambda t: weights[t])
+        order_b = agreed_submission_order(list(pending),
+                                          lambda t: weights[t])
+        checks = {
+            "k_workers_kept": ex.workers == 4,
+            "dispatcher_engaged": bool(ex._dispatching),
+            "futures_resolve": bool(resolved),
+            "order_deterministic": order_a == order_b
+            and sorted(order_a) == [1, 2, 3, 4, 5],
+            "agreement_rounds_ran": rounds >= 2,
+            "no_divergence": diverged == 0,
+            "opt_out_clamps": clamped,
+        }
+        return {
+            "workers": ex.workers,
+            "agreement_rounds": int(rounds),
+            "agreed_order_sample": order_a,
+            # observed worker-thread START order — context, not a gate:
+            # the pool RELEASES in the agreed order but K concurrent
+            # workers may interleave their first instructions; the
+            # release-order contract is gated deterministically by
+            # tests/test_tenancy.py at width 1
+            "observed_start_order": {"whale": whale_starts,
+                                     "minnow": minnow_starts},
+            "checks": checks,
+        }
+
+    distributed = distributed_cell()
+
     solo_p99 = solo["minnow_p99_ms"] or 1e-6
     isolation = fair["minnow_p99_ms"] / solo_p99
     checks = {
@@ -4694,12 +5073,16 @@ def tenancy_measure(minnow_rows=1 << 13, whale_rows=1 << 13,
         "per_tenant_counters_present":
             any("minnow" in k for k in fair["per_tenant_counters"])
             and any("whale" in k for k in fair["per_tenant_counters"]),
+        # distributed K-worker plane: same tenancy contract through the
+        # agreed-order dispatcher (code-path cell)
+        "distributed_plane": all(distributed["checks"].values()),
     }
     return {
         "shape": {"minnow_rows": minnow_rows, "whale_rows": whale_rows,
                   "minnows": minnows, "minnow_rounds": minnow_rounds,
                   "whale_reads": whale_reads, "val_words": val_words},
         "solo": solo, "fair": fair, "starved": starved,
+        "distributed": distributed,
         "isolation_ratio": round(isolation, 3),
         "starved_vs_solo": round(
             starved["minnow_p99_ms"] / solo_p99, 3),

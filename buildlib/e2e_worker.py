@@ -37,6 +37,20 @@ distributed exchange then completes to oracle bytes. This is the
 external-shuffle-service role (a dead executor's files served without
 re-running its tasks), done as an application-level contract.
 
+Agreement mode (SPARKUCX_TPU_AGREEMENT_PHASE=1, job 10): the
+agreement-DIVERGENCE drill over the split-tier hierarchical exchange
+(--slices 2). First the parity leg: a distributed read routes through
+the per-tier compiled programs (shuffle/distributed.py
+PendingDistributedTieredShuffle) and must land oracle bytes with BOTH
+tier entries exact (the agreed [P, P] cross-row matrix) on every
+process's report. Then the divergence legs: one process simulates
+booting with a DIFFERENT overflow cap (hier.dcn.regrow) and a different
+tenant-weight conf (async.order) — EVERY process must raise
+AgreementDivergenceError naming the dissenting process and the conf key,
+and NONE may hang (the verdict rides the allgather, so the group exits
+the round together). On any failure each worker dumps its flight
+recorder to SPARKUCX_TPU_FLIGHT_DIR for the CI artifact.
+
 Chaos mode (SPARKUCX_TPU_CHAOS_PHASE=1): the killed-peer WATCHDOG
 drill — the hard half of executor loss, where the survivors get NO
 notification at all. All members stage + report STAGED; the survivors
@@ -150,6 +164,126 @@ def _restart_drill(node, base_conf_map, proc_id: int, nprocs: int,
     return 0
 
 
+def _agreement_drill(node, mgr, proc_id: int, nprocs: int) -> int:
+    """Job 10 body: split-tier distributed read to oracle bytes, then
+    the two divergence legs — every process must raise the TYPED error
+    naming the dissenter, and none may hang."""
+    import zlib
+
+    import numpy as np
+
+    from sparkucx_tpu.shuffle.agreement import (AgreementDivergenceError,
+                                                agree)
+    from sparkucx_tpu.shuffle.distributed import allgather_blob
+    from sparkucx_tpu.shuffle.tenancy import agreed_submission_order
+    from sparkucx_tpu.shuffle.writer import _hash32_np
+    from sparkucx_tpu.utils.metrics import (C_AGREE_DIVERGENCE,
+                                            GLOBAL_METRICS)
+
+    num_maps = int(os.environ.get("SPARKUCX_TPU_NUM_MAPS", 2 * nprocs))
+    R = 4 * node.num_devices
+    pairs_per_map = 600
+    my_maps = [m for m in range(num_maps) if m % nprocs == proc_id]
+
+    def map_data(map_id: int):
+        rng = np.random.default_rng(1000 + map_id)
+        keys = rng.integers(0, 1000, size=pairs_per_map).astype(np.int64)
+        vals = np.repeat(keys[:, None], 2, axis=1).astype(np.int32)
+        return keys, vals
+
+    # leg 1: the split-tier distributed read (the mesh is 2-D under
+    # --slices 2, so read() dispatches the per-tier compiled programs)
+    h = mgr.register_shuffle(16, num_maps, R)
+    for m in my_maps:
+        w = mgr.get_writer(h, m)
+        k, v = map_data(m)
+        w.write(k, v)
+        w.commit(R)
+    res = mgr.read(h)
+    allk = np.concatenate([map_data(m)[0] for m in range(num_maps)])
+    allv = np.concatenate([map_data(m)[1] for m in range(num_maps)])
+    parts = _hash32_np(allk) % R
+    checked = 0
+    for r, (gk, gv) in res.partitions():
+        got = sorted(zip(gk.tolist(), map(tuple, gv.tolist())))
+        want = sorted(zip(allk[parts == r].tolist(),
+                          map(tuple, allv[parts == r].tolist())))
+        assert got == want, \
+            f"split-tier partition {r} mismatch on process {proc_id}"
+        checked += 1
+    rep = mgr.report(16)
+    assert rep.distributed and rep.hierarchical, rep
+    assert [t["tier"] for t in rep.tiers] == ["ici", "dcn"], rep.tiers
+    for t in rep.tiers:
+        # exact cross-fabric accounting (the agreed [P, P] matrix) and
+        # a measured wall per stage — the fused program had neither
+        assert t["cross_exact"], t
+        assert t["ms"] > 0, t
+    # the agreed accounting is identical cluster-wide
+    views = {(int(r.get("payload_bytes", 0)), int(r.get("wire_bytes", 0)),
+              tuple((tt["tier"], tt["payload_rows"])
+                    for tt in r.get("tiers", [])))
+             for r in mgr.gather_reports(16) if r}
+    assert len(views) == 1, f"tier accounting diverged: {views}"
+    print(f"worker {proc_id}: SPLIT-TIER READ OK ({checked} partitions "
+          f"oracle-exact, exact cross rows on both tiers)", flush=True)
+
+    dissenter = nprocs - 1
+    base = GLOBAL_METRICS.get(C_AGREE_DIVERGENCE)
+
+    # leg 2a: divergent overflow/regrow capacity — the shape of one
+    # process booted with a different a2a.capacityFactor
+    cap = 263 if proc_id == dissenter else 256
+    raised = 0
+    try:
+        agree("hier.dcn.regrow", np.array([cap], dtype=np.int64),
+              conf_key="spark.shuffle.tpu.a2a.capacityFactor")
+    except AgreementDivergenceError as e:
+        assert e.kind == "value" and e.dissenters == [dissenter], e
+        assert "capacityFactor" in str(e), e
+        raised = 1
+    verdict = allgather_blob(np.array([raised], dtype=np.int64))
+    assert int(np.asarray(verdict).sum()) == nprocs, \
+        f"regrow divergence not raised everywhere: {verdict}"
+
+    # leg 2b: divergent DRR weights — one process's tenant conf orders
+    # the SAME agreed batch differently; the unanimous async.order
+    # round must fail typed on every process
+    batch = [(0, "whale"), (1, "minnow"), (2, "whale"), (3, "whale")]
+    weights = {"whale": 2 if proc_id == dissenter else 1, "minnow": 1}
+    order = agreed_submission_order(list(batch),
+                                    lambda t: weights[t])
+    tenant_of = dict(batch)
+    prop = np.array(
+        [x for s in order
+         for x in (s, zlib.crc32(tenant_of[s].encode()) & 0x7FFFFFFF)],
+        dtype=np.int64)
+    raised = 0
+    try:
+        agree("async.order", prop,
+              conf_key="spark.shuffle.tpu.tenant.asyncAgreedOrder")
+    except AgreementDivergenceError as e:
+        assert e.kind == "value" and e.dissenters == [dissenter], e
+        assert "asyncAgreedOrder" in str(e), e
+        raised = 1
+    verdict = allgather_blob(np.array([raised], dtype=np.int64))
+    assert int(np.asarray(verdict).sum()) == nprocs, \
+        f"order divergence not raised everywhere: {verdict}"
+
+    # both divergences counted and in the flight ring (the doctor's
+    # desync evidence and the postmortem's, respectively)
+    assert GLOBAL_METRICS.get(C_AGREE_DIVERGENCE) >= base + 2
+    kinds = [ev["kind"] for ev in node.flight.events()]
+    assert "agreement_divergence" in kinds, kinds[-20:]
+    print(f"worker {proc_id}: AGREEMENT DIVERGENCE FENCED OK "
+          f"(dissenter {dissenter} named on every process, group exited "
+          f"both rounds together)", flush=True)
+    mgr.unregister_shuffle(16)
+    mgr.stop()
+    node.close()
+    return 0
+
+
 def main() -> int:
     proc_id = int(os.environ["SPARKUCX_TPU_PROC_ID"])
     nprocs = int(os.environ["SPARKUCX_TPU_NPROCS"])
@@ -158,6 +292,7 @@ def main() -> int:
     recovery_phase = os.environ.get("SPARKUCX_TPU_RECOVERY_PHASE", "")
     chaos_phase = os.environ.get("SPARKUCX_TPU_CHAOS_PHASE", "")
     restart_phase = os.environ.get("SPARKUCX_TPU_RESTART_PHASE", "")
+    agreement_phase = os.environ.get("SPARKUCX_TPU_AGREEMENT_PHASE", "")
     victim = int(os.environ.get("SPARKUCX_TPU_VICTIM", "-1"))
     loss_file = os.environ.get("SPARKUCX_TPU_LOSS_FILE", "")
 
@@ -190,6 +325,13 @@ def main() -> int:
         # process's spans and proves the merged timeline clock-aligns
         "spark.shuffle.tpu.trace.enabled": "true",
     }
+    if agreement_phase == "1":
+        # each worker's flight postmortem lands in its own subdir of the
+        # controller-provided dump root (the CI artifact on failure)
+        fdir = os.environ.get("SPARKUCX_TPU_FLIGHT_DIR", "")
+        if fdir:
+            conf_map["spark.shuffle.tpu.flightRecorder.dir"] = \
+                os.path.join(fdir, f"worker{proc_id}")
     if chaos_phase == "1":
         # the drill's whole point: a deadline on every rendezvous. The
         # probe bound (network.timeoutMs, which sizes HealthMonitor's
@@ -227,6 +369,17 @@ def main() -> int:
                               restart_phase)
 
     mgr = TpuShuffleManager(node, conf)
+
+    if agreement_phase == "1":
+        # tenth job: the agreement-divergence drill (see module doc).
+        # Any failure dumps this worker's flight ring — the divergence
+        # events and metric deltas the postmortem needs — before the
+        # non-zero exit fails the controller.
+        try:
+            return _agreement_drill(node, mgr, proc_id, nprocs)
+        except BaseException as e:
+            node.flight.dump(f"agreement drill failed: {e!r}")
+            raise
 
     # NUM_MAPS override lets the recovery re-run execute the ORIGINAL
     # map set on fewer survivors (lost maps redistribute, like Spark
@@ -583,12 +736,12 @@ def main() -> int:
           f"({len(findings)} finding(s): "
           f"{sorted({f.rule for f in findings})})", flush=True)
 
-    # seventh job: the RAGGED WAVE contract across processes — FLAT
-    # meshes only: the wave pipeline is ineligible on the hierarchical
-    # two-stage exchange (manager._waves_eligible), so under --slices>1
-    # every process would single-shot and the wave assertions (and the
-    # divergence drill, where both waveRows confs propose W=1) are
-    # vacuous — skip rather than fail the multi-slice run.
+    # seventh job: the RAGGED WAVE contract across processes. The drill
+    # runs on the FLAT mesh: waves are legal on the hierarchical
+    # exchange too now (each wave dispatches the split-tier program —
+    # manager._waves_eligible), but this job pins the flat wave
+    # contract; the split-tier distributed leg is job 10's
+    # (--agreement), so under --slices>1 we skip rather than double up.
     wvcheck = 0
     if num_slices == 1:
         from sparkucx_tpu.shuffle.distributed import agree_wave_sizes

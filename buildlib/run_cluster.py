@@ -401,6 +401,63 @@ def run_restart(args) -> int:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_agreement(args) -> int:
+    """Agreement-divergence drill (job 10): a split-tier hierarchical
+    read over --slices 2 must land oracle bytes with exact per-tier
+    accounting on every process; then one process simulates a divergent
+    overflow-cap conf and a divergent tenant-weight conf — EVERY process
+    must raise AgreementDivergenceError naming the dissenter (none may
+    hang). Workers dump their flight rings to SPARKUCX_TPU_FLIGHT_DIR
+    on failure for the CI artifact."""
+    slices = max(args.slices, 2)      # the drill IS the split-tier leg
+    procs, all_logs = [], []
+    try:
+        for attempt in range(2):
+            coordinator = f"localhost:{free_port()}"
+            procs, logs = [], []
+            for pid in range(args.nprocs):
+                p, f = spawn(pid, args.nprocs, coordinator, args.devices,
+                             slices,
+                             {"SPARKUCX_TPU_AGREEMENT_PHASE": "1"})
+                procs.append(p)
+                logs.append(f)
+                all_logs.append(f)
+            ok = reap(procs, logs, time.monotonic() + args.timeout)
+            if ok or attempt == 1 or not rendezvous_failed(logs):
+                break
+            print("bootstrap flake (RENDEZVOUS FAILED in a worker log); "
+                  "retrying once on a fresh port")
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        read_ok = fenced = 0
+        for pid, lf in enumerate(logs):
+            lf.seek(0)
+            out = lf.read()
+            read_ok += 1 if "SPLIT-TIER READ OK" in out else 0
+            fenced += 1 if "AGREEMENT DIVERGENCE FENCED OK" in out else 0
+        if read_ok != args.nprocs:
+            print(f"only {read_ok}/{args.nprocs} workers completed the "
+                  f"split-tier read")
+            ok = False
+        if fenced != args.nprocs:
+            print(f"only {fenced}/{args.nprocs} workers fenced the "
+                  f"divergence typed — a silent peer means a hang risk")
+            ok = False
+        print("CLUSTER AGREEMENT:", "PASS" if ok else "FAIL")
+        return 0 if ok else 1
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for f in all_logs:
+            try:
+                f.close()
+                os.unlink(f.name)
+            except OSError:
+                pass
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--nprocs", type=int, default=2)
@@ -423,6 +480,13 @@ def main() -> int:
                          "— intact maps serve with zero recompute, the "
                          "corrupt block quarantines and re-stages, the "
                          "exchange completes to oracle bytes")
+    ap.add_argument("--agreement", action="store_true",
+                    help="agreement-divergence drill (job 10): split-"
+                         "tier hierarchical read to oracle bytes over "
+                         "--slices 2, then one process proposes a "
+                         "different overflow cap / DRR order — every "
+                         "process must raise AgreementDivergenceError "
+                         "naming the dissenter; none may hang")
     ap.add_argument("--timeout", type=float, default=480.0)
     args = ap.parse_args()
 
@@ -432,6 +496,8 @@ def main() -> int:
         return run_chaos(args)
     if args.restart:
         return run_restart(args)
+    if args.agreement:
+        return run_agreement(args)
 
     procs, all_logs = [], []
     try:

@@ -132,7 +132,10 @@ class TpuShuffleConf:
                     "tenant.id (this process's default tenant), "
                     "tenant.priority (high|normal|batch), "
                     "tenant.fairShare (DRR admission on/off), "
-                    "tenant.asyncWorkers, and per-tenant overrides "
+                    "tenant.asyncWorkers, tenant.asyncAgreedOrder "
+                    "(distributed K-worker async rides the agreement "
+                    "channel; false clamps to 1 worker), and per-tenant "
+                    "overrides "
                     "tenant.<id>.priority/.maxBytesInFlight/"
                     ".maxInflightReads/.replayBudget/.integrity.verify/"
                     ".waveDepth",

@@ -135,6 +135,14 @@ class TpuNode:
             metrics=self.metrics)
         self.epochs = EpochManager()
         self.epochs.on_bump(self.flight.on_epoch_bump)
+        # Agreement plane (shuffle/agreement.py): the epoch-scoped round
+        # sequence resets at every mesh epoch bump, so a process that
+        # missed a remesh diverges TYPED in the next round's header
+        # instead of feeding a stale round into a fresh world. Seed the
+        # current epoch at construction (remesh re-seeds via the bump).
+        from sparkucx_tpu.shuffle import agreement as _agreement
+        _agreement.reset_epoch(self.epochs.current)
+        self.epochs.on_bump(_agreement.reset_epoch)
         # Cluster clock anchors: every process's wall↔perf pair,
         # allgathered at connect (every process constructs its node in
         # lockstep, so the collective is safe here) — the alignment data

@@ -167,6 +167,11 @@ class ShuffleService:
         self._async = AsyncShuffleExecutor(
             conf, self.manager._tenants, self.node.metrics,
             distributed=self.node.is_distributed)
+        # ExchangeReport stamps the EFFECTIVE async width — a
+        # distributed facade that asked for K workers but stamps 1 was
+        # clamped (tenant.asyncAgreedOrder=false): the unrequested-
+        # serialization evidence the doctor reads
+        self.manager._async_workers = self._async.workers
         log.info("ShuffleService up: io=%s, %d devices",
                  self.io_format, self.node.num_devices)
 
@@ -334,10 +339,13 @@ class ShuffleService:
         are enforced HERE, at submit: a tenant at its cap blocks until
         one of its reads resolves (backpressure, counted in
         ``shuffle.submit.throttled.count{tenant=...}``). Distributed
-        mode executes futures strictly in submission order on one
-        worker — callers submitting in the same order on every process
-        (the standing SPMD discipline) keep the collective order
-        agreed; see AsyncShuffleExecutor."""
+        mode keeps ``tenant.asyncWorkers`` workers by agreeing each
+        batch's submission order collectively (tenant DRR over the
+        agreement channel, ``tenant.asyncAgreedOrder``; false restores
+        the historical width-1 clamp) — callers submitting the same
+        reads in the same order on every process (the standing SPMD
+        discipline) keep the collective order agreed; see
+        AsyncShuffleExecutor."""
         return self._async.submit(lambda: self.read(handle, **kw),
                                   handle.tenant, handle.shuffle_id,
                                   timeout=kw.get("timeout"))

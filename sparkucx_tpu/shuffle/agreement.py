@@ -1,0 +1,254 @@
+"""Epoch-scoped cross-process agreement — the distributed control plane.
+
+The reference centralizes every cross-executor control decision in a
+driver-hosted rendezvous buffer: workers read the driver's metadata
+block and act on ONE authoritative copy
+(ref: CommonUcxShuffleManager.scala:39-56), so two executors can never
+act on different views of the same decision. JAX multi-controller has
+no driver — every process computes its own copy of every decision — so
+the failure mode inverts: nothing ever disagrees *by design*, but a
+process booted with a divergent conf, a stale registry snapshot, or a
+raced remesh silently computes a DIFFERENT decision and desyncs the
+SPMD group into a hang (or worse, silent corruption) at the next
+collective.
+
+This module is the rendezvous buffer rebuilt as a collective: a named,
+sequenced :func:`agree` round that every process enters in lockstep.
+Each round frames through the watchdog-fenced metadata channel
+(:func:`shuffle.distributed.allgather_blob`), so the three failure
+classes all surface typed, on every process together:
+
+* **divergent proposal** — :class:`AgreementDivergenceError` naming the
+  topic, the dissenting process ids and every process's proposal (the
+  verdict rides the allgather, so no process can raise while a peer
+  proceeds into the next collective);
+* **sequencing split** — a process entering a *different* round (other
+  topic, other sequence number, other epoch — the conf-divergence /
+  missed-remesh shape) raises the same typed error from the fixed-shape
+  header round, before payload shapes can wedge the transport;
+* **dead peer** — ``PeerLostError`` from the channel's watchdog fence
+  (``failure.collectiveTimeoutMs``), never a silent hang.
+
+Rounds are **epoch-scoped**: the (epoch, seq) pair stamps every frame,
+``seq`` resets at each mesh epoch bump (the node wires
+:func:`reset_epoch` as an EpochManager bump listener), so a process
+that missed a remesh diverges in the header — typed — instead of
+feeding a stale round into a fresh world.
+
+Anatomy: one :func:`agree` call is TWO allgather rounds — a fixed
+5-int64 header (epoch, seq, topic, payload length, reduction) that can
+never shape-mismatch, then the payload padded to the agreed maximum
+length. Both ride ``shuffle.barrier`` spans and the watchdog fence.
+
+Clients (the discipline generalized from ``agree_wave_count`` /
+``agree_wave_sizes``, which now call through here): wave count and
+per-wave occupancy, the split-tier overflow/regrow decisions
+(shuffle/distributed.py PendingDistributedTieredShuffle), collective
+replay entry (manager._replay_after_failure), the async plane's global
+submission order (tenancy.py) and the exact tier cross-row totals
+(manager._submit_distributed_staged).
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from sparkucx_tpu.utils.logging import get_logger
+from sparkucx_tpu.utils.metrics import (C_AGREE_DIVERGENCE, C_AGREE_ROUNDS,
+                                        GLOBAL_METRICS, labeled)
+
+log = get_logger("shuffle.agreement")
+
+
+class AgreementDivergenceError(RuntimeError):
+    """Typed verdict of a failed agreement round.
+
+    Raised on EVERY process together (the evidence rides the allgather,
+    so each process computes the same verdict from the same gathered
+    matrix). Fields:
+
+    * ``topic``      — the round's name (``"a2a.waveRows"``,
+      ``"hier.dcn.regrow"``, ``"async.order"``, ...)
+    * ``kind``       — ``"value"`` (same round, different proposals) or
+      ``"sequencing"`` (processes entered DIFFERENT rounds: mismatched
+      topic/sequence/epoch — the conf-divergence shape)
+    * ``dissenters`` — process indices whose proposal differs from the
+      majority view
+    * ``proposals``  — every process's proposal (list per process), so
+      the operator sees WHAT each side believed, not just who
+    * ``conf_key``   — the conf key whose divergence most likely caused
+      the split (the doctor's desync remediation)
+    """
+
+    def __init__(self, topic: str, kind: str, dissenters: Sequence[int],
+                 proposals: List[list], conf_key: str = "",
+                 detail: str = ""):
+        self.topic = topic
+        self.kind = kind
+        self.dissenters = [int(d) for d in dissenters]
+        self.proposals = proposals
+        self.conf_key = conf_key
+        msg = (f"agreement divergence on topic {topic!r} ({kind}): "
+               f"process(es) {self.dissenters} disagree — proposals by "
+               f"process: {proposals}")
+        if detail:
+            msg += f"; {detail}"
+        if conf_key:
+            msg += (f" — check {conf_key} is identical on every process")
+        super().__init__(msg)
+
+
+# -- epoch-scoped sequencing state -----------------------------------------
+# One (epoch, seq) stream per process; identical on every process by the
+# SPMD lockstep (every process enters the same agree() calls in the same
+# order). The lock covers the read-modify-write so an async worker thread
+# and the main thread can never tear a frame's sequence number.
+_LOCK = threading.Lock()
+_STATE = {"epoch": 0, "seq": 0}
+
+
+def reset_epoch(epoch: int) -> None:
+    """Start a fresh agreement stream for mesh epoch ``epoch`` (seq
+    resets to 0). Wired as an EpochManager bump listener by the node, so
+    a remesh fences off every stale round by construction."""
+    with _LOCK:
+        _STATE["epoch"] = int(epoch)
+        _STATE["seq"] = 0
+
+
+def current_round() -> tuple:
+    """(epoch, next sequence number) — test/observability hook."""
+    with _LOCK:
+        return _STATE["epoch"], _STATE["seq"]
+
+
+def _topic_code(topic: str) -> int:
+    # stable across processes/runs (hash() is salted per process); crc32
+    # collisions across the handful of live topics are not a concern —
+    # the code only needs to DETECT divergence, not name the other topic
+    return zlib.crc32(topic.encode("utf-8")) & 0x7FFFFFFF
+
+
+_REDUCE_CODES = {"unanimous": 0, "max": 1, "min": 2, "sum": 3, "any": 4,
+                 "all": 5}
+_REDUCERS = {
+    "max": lambda rows: rows.max(axis=0),
+    "min": lambda rows: rows.min(axis=0),
+    "sum": lambda rows: rows.sum(axis=0),
+    "any": lambda rows: (rows != 0).any(axis=0).astype(np.int64),
+    "all": lambda rows: (rows != 0).all(axis=0).astype(np.int64),
+}
+
+
+def _majority_row(rows: np.ndarray) -> np.ndarray:
+    """The most common row (ties broken toward the lowest process index)
+    — identical on every process, so the dissenter set agrees too."""
+    uniq, inv, counts = np.unique(rows, axis=0, return_inverse=True,
+                                  return_counts=True)
+    best = counts.max()
+    for i in range(rows.shape[0]):          # first process holding a
+        if counts[inv[i]] == best:          # maximally-common proposal
+            return rows[i]
+    return rows[0]
+
+
+def agree(topic: str, payload, reduce: Optional[Union[str, Callable]]
+          = None, conf_key: str = "", timeout_ms: Optional[float] = None,
+          metrics=None) -> np.ndarray:
+    """COLLECTIVE: one named agreement round over an int64 payload
+    vector. Every process must call with the same topic, in the same
+    order relative to every other collective (the standing SPMD
+    discipline this primitive exists to police).
+
+    ``reduce=None`` (unanimity, the default): every process must
+    propose the SAME vector; the agreed copy returns, or
+    :class:`AgreementDivergenceError` raises on every process together.
+    ``reduce`` in {"max","min","sum","any","all"} or a callable
+    ``rows -> row`` over the [nproc, n] proposal matrix: proposals may
+    legitimately differ; the reduction returns. Either way a
+    sequencing split (different topic/seq/epoch across processes)
+    raises typed from the header round.
+
+    ``timeout_ms`` overrides the channel watchdog's deadline for both
+    rounds (per-tier deadlines thread through here). Returns the agreed
+    / reduced [n] int64 vector.
+    """
+    from sparkucx_tpu.shuffle.distributed import allgather_blob
+
+    mine = np.atleast_1d(np.asarray(payload, dtype=np.int64)).reshape(-1)
+    if callable(reduce):
+        reduce_code = len(_REDUCE_CODES)      # caller-supplied reduction
+    else:
+        if reduce is not None and reduce not in _REDUCERS:
+            raise ValueError(
+                f"unknown agreement reduction {reduce!r}; want one of "
+                f"{sorted(_REDUCERS)} or a callable")
+        reduce_code = _REDUCE_CODES[reduce or "unanimous"]
+    with _LOCK:
+        epoch, seq = _STATE["epoch"], _STATE["seq"]
+        _STATE["seq"] += 1
+    m = metrics if metrics is not None else GLOBAL_METRICS
+    try:
+        m.inc(C_AGREE_ROUNDS, 1.0)
+    except Exception:
+        pass
+
+    # Round 1: the fixed-shape header — epoch, sequence, topic, payload
+    # length, reduction. Fixed [5] on every process by construction, so
+    # this round can NEVER shape-mismatch; it catches the sequencing
+    # splits (different round entered) BEFORE the variable-length
+    # payload round could wedge the transport on mismatched shapes.
+    header = np.array([epoch, seq, _topic_code(topic), mine.shape[0],
+                       reduce_code], dtype=np.int64)
+    got_h = np.asarray(allgather_blob(
+        header, what=f"agreement header {topic!r} #{seq}",
+        timeout_ms=timeout_ms)).reshape(-1, 5)
+    if (got_h != got_h[0]).any():
+        maj = _majority_row(got_h)
+        dissent = [i for i in range(got_h.shape[0])
+                   if (got_h[i] != maj).any()]
+        _note_divergence(topic, m)
+        raise AgreementDivergenceError(
+            topic, "sequencing", dissent,
+            [r.tolist() for r in got_h], conf_key=conf_key,
+            detail="processes entered different agreement rounds "
+                   "(header = [epoch, seq, topic, len, reduce]) — a "
+                   "divergent conf or a missed remesh")
+
+    # Round 2: the payload, at the agreed length.
+    got = np.asarray(allgather_blob(
+        mine, what=f"agreement {topic!r} #{seq}",
+        timeout_ms=timeout_ms)).reshape(-1, mine.shape[0])
+    if callable(reduce):
+        return np.asarray(reduce(got), dtype=np.int64)
+    if reduce is not None:
+        return _REDUCERS[reduce](got).astype(np.int64)
+    if (got != got[0]).any():
+        maj = _majority_row(got)
+        dissent = [i for i in range(got.shape[0])
+                   if (got[i] != maj).any()]
+        _note_divergence(topic, m)
+        raise AgreementDivergenceError(
+            topic, "value", dissent, [r.tolist() for r in got],
+            conf_key=conf_key)
+    return got[0].copy()
+
+
+def _note_divergence(topic: str, metrics) -> None:
+    try:
+        metrics.inc(C_AGREE_DIVERGENCE, 1.0)
+        metrics.inc(labeled(C_AGREE_DIVERGENCE, topic=topic), 1.0)
+    except Exception:
+        pass
+    # the flight ring gets the event too (the watchdog's recorder is the
+    # node's when one is live) — job 10's dump shows WHICH round split
+    try:
+        from sparkucx_tpu.runtime.watchdog import current_watchdog
+        current_watchdog().flight.record("agreement_divergence",
+                                         topic=topic)
+    except Exception:
+        pass

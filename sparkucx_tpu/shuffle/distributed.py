@@ -41,8 +41,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sparkucx_tpu.shuffle.plan import ShufflePlan, wire_row_words
 from sparkucx_tpu.shuffle.reader import (
-    PendingExchangeBase, ShuffleReaderResult, _blocked_map, _build_step,
-    max_recv_rows, seeded_nvalid)
+    LazyShuffleReaderResult, PendingExchangeBase, ShuffleReaderResult,
+    _blocked_map, _build_step, max_recv_rows, seeded_nvalid)
+from sparkucx_tpu.shuffle.topology import (PendingTieredShuffle,
+                                           TierHooks,
+                                           TopologyDescriptor)
 from sparkucx_tpu.utils.logging import get_logger
 
 log = get_logger("shuffle.distributed")
@@ -69,7 +72,8 @@ def allgather_sizes(local_vals: np.ndarray, shard_ids: Sequence[int],
 
 
 def allgather_blob(blob: np.ndarray,
-                   what: str = "metadata allgather") -> np.ndarray:
+                   what: str = "metadata allgather",
+                   timeout_ms: Optional[float] = None) -> np.ndarray:
     """[nproc, ...] stack of one small host array per process (schema
     agreement checks).
 
@@ -82,6 +86,9 @@ def allgather_blob(blob: np.ndarray,
     :class:`~sparkucx_tpu.runtime.failures.PeerLostError` after a
     liveness probe and a flight postmortem, instead of hanging forever.
     With the watchdog off (the default) this is a direct call.
+    ``timeout_ms`` overrides the watchdog's standing deadline for this
+    one round (the agreement plane threads per-tier deadlines through
+    here).
 
     Anatomy span: every round records as ``shuffle.barrier`` (the
     barrier_wait phase) — the call is a rendezvous on the slowest
@@ -94,9 +101,18 @@ def allgather_blob(blob: np.ndarray,
     from sparkucx_tpu.utils.trace import GLOBAL_TRACER
     with GLOBAL_TRACER.span("shuffle.barrier", kind="allgather",
                             what=what):
-        return current_watchdog().call(
+        out = current_watchdog().call(
             lambda: np.asarray(multihost_utils.process_allgather(blob)),
-            what=what)
+            what=what, timeout_ms=timeout_ms)
+    # jax's process_allgather skips the leading [nproc] axis at nproc=1
+    # (identity); restore the documented [nproc, ...] contract so the
+    # degenerate single-process gather — the shape every distributed
+    # code path is TESTED under — indexes like the real one
+    if out.shape == np.shape(blob):
+        import jax
+        if jax.process_count() == 1:
+            out = out[None]
+    return out
 
 
 def allgather_json(obj) -> list:
@@ -137,20 +153,27 @@ def agree_wave_count(local_waves: int) -> int:
     divergence is the likeliest drift and must raise too, not just
     nonzero-vs-nonzero. Mismatch raises on every process together (the
     verdict rides the allgather, like the completeness barrier's
-    timeout bit)."""
-    # reshape, not [:, 0]: single-process process_allgather returns the
-    # row without a leading nproc axis
-    got = np.asarray(
-        allgather_blob(np.array([local_waves], dtype=np.int64))
-    ).reshape(-1)
-    w = int(got.max())
-    if (got != w).any():
-        raise RuntimeError(
-            f"wave-count mismatch across processes: {got.tolist()} — "
-            f"spark.shuffle.tpu.a2a.waveRows must be identical on every "
-            f"process (collective reads derive waves from the same "
-            f"global size row)")
-    return w
+    timeout bit).
+
+    The FIRST client of the agreement primitive
+    (shuffle/agreement.py): the round is an epoch-scoped unanimous
+    ``agree`` frame, so a sequencing split (a process entering a
+    different round entirely) is typed too, not just a value split."""
+    from sparkucx_tpu.shuffle.agreement import (AgreementDivergenceError,
+                                                agree)
+    try:
+        return int(agree("a2a.waveRows",
+                         np.array([local_waves], dtype=np.int64),
+                         conf_key="spark.shuffle.tpu.a2a.waveRows")[0])
+    except AgreementDivergenceError as e:
+        if e.kind != "value":
+            raise
+        raise AgreementDivergenceError(
+            e.topic, e.kind, e.dissenters, e.proposals,
+            conf_key=e.conf_key,
+            detail="wave-count mismatch across processes (collective "
+                   "reads derive waves from the same global size "
+                   "row)") from None
 
 
 def agree_wave_sizes(wave_sizes: np.ndarray) -> np.ndarray:
@@ -164,17 +187,24 @@ def agree_wave_sizes(wave_sizes: np.ndarray) -> np.ndarray:
     agreement), which would otherwise dispatch per-wave collectives with
     inconsistent size rows and desync — or silently corrupt — the mesh.
     Mismatch raises on every process together (the verdict rides the
-    allgather). Returns the agreed vector."""
+    allgather). Returns the agreed vector. The second client of the
+    agreement primitive (shuffle/agreement.py)."""
+    from sparkucx_tpu.shuffle.agreement import (AgreementDivergenceError,
+                                                agree)
     mine = np.asarray(wave_sizes, dtype=np.int64).reshape(-1)
-    got = np.asarray(allgather_blob(mine)).reshape(-1, mine.shape[0])
-    if (got != got[0]).any():
-        raise RuntimeError(
-            f"per-wave occupancy mismatch across processes: "
-            f"{got.tolist()} — every process must derive the same "
-            f"per-wave real row counts from the allgathered size row "
-            f"(stale staged outputs or divergent "
-            f"spark.shuffle.tpu.a2a.waveRows conf)")
-    return got[0]
+    try:
+        return agree("a2a.waveSizes", mine,
+                     conf_key="spark.shuffle.tpu.a2a.waveRows")
+    except AgreementDivergenceError as e:
+        if e.kind != "value":
+            raise
+        raise AgreementDivergenceError(
+            e.topic, e.kind, e.dissenters, e.proposals,
+            conf_key=e.conf_key,
+            detail="per-wave occupancy mismatch across processes — "
+                   "every process must derive the same per-wave real "
+                   "row counts from the allgathered size row (stale "
+                   "staged outputs or divergent conf)") from None
 
 
 def gather_clock_anchors(tracer=None) -> list:
@@ -259,6 +289,78 @@ def _local_shards_of(arr: jax.Array, shard_ids: Sequence[int],
         start = s.index[0].start or 0
         by_start[start // rows_per_shard] = np.asarray(s.data)
     return np.stack([by_start[int(i)] for i in shard_ids])
+
+
+class DistributedLazyReaderResult(LazyShuffleReaderResult):
+    """Device-resident PARTIAL view for the multi-process device sink:
+    the payload stays sharded across every process's devices (zero
+    payload D2H — the whole point of ``read.sink=device`` distributed),
+    and only partitions on this process's shards are readable (the
+    Spark-reducer contract of :class:`DistributedReaderResult`).
+
+    The base class's device plumbing already speaks global offsets —
+    ``_shard_dev`` matches addressable shards by ``start // cap_out``,
+    and ``_shard_rows`` raises for a shard another process owns — so the
+    overrides here are only the locality guards and a local-shards seg
+    materialization (``np.asarray`` rejects a non-fully-addressable
+    array; non-local seg rows stay zero and sit unreachable behind the
+    ``partition()`` guard)."""
+
+    def __init__(self, *args, shard_ids: Sequence[int] = (), **kw):
+        super().__init__(*args, **kw)
+        self._shard_ord = {int(s): i for i, s in enumerate(shard_ids)}
+
+    def is_local(self, r: int) -> bool:
+        return int(self._part_to_shard[r]) in self._shard_ord
+
+    def partition(self, r: int):
+        if not self.is_local(r):
+            raise KeyError(
+                f"partition {r} lives on shard "
+                f"{int(self._part_to_shard[r])}, not on this process "
+                f"(local shards: {sorted(self._shard_ord)})")
+        return super().partition(r)
+
+    def partitions(self):
+        for r in range(self.num_partitions):
+            if self.is_local(r):
+                yield r, self.partition(r)
+
+    def _seg_matrix(self, shard: int) -> np.ndarray:
+        with self._fetch_lock:
+            sd = self._seg_dev
+            if self._seg is None and sd is not None \
+                    and self._per_shard_segs \
+                    and not getattr(sd, "is_fully_addressable", True):
+                ns = sd.shape[0] // self._num_shards
+                full = np.zeros(
+                    (self._num_shards, ns, self.num_partitions),
+                    dtype=np.asarray(
+                        sd.addressable_shards[0].data).dtype)
+                for s in sd.addressable_shards:
+                    start = s.index[0].start or 0
+                    full[start // ns] = np.asarray(s.data)
+                self._seg = full
+                self._seg_dev = None
+            return super()._seg_matrix(shard)
+
+
+def local_totals_row(totals_dev, num_shards: int) -> np.ndarray:
+    """The [P] per-shard delivered-totals row of a device result, with
+    non-addressable entries summed in over the agreement channel when
+    the array spans processes (the device merge fold's acc sizing must
+    agree everywhere or the merge programs desync). Metadata-class:
+    one [P] int row, never payload."""
+    if getattr(totals_dev, "is_fully_addressable", True):
+        return np.asarray(totals_dev).reshape(-1)
+    row = np.zeros(int(totals_dev.shape[0]), dtype=np.int64)
+    for s in totals_dev.addressable_shards:
+        start = s.index[0].start or 0
+        d = np.asarray(s.data).reshape(-1)
+        row[start:start + d.shape[0]] = d
+    return np.asarray(allgather_blob(
+        row, what="device-merge totals row")).reshape(-1, row.shape[0]) \
+        .sum(axis=0)
 
 
 def read_shuffle_distributed(
@@ -408,21 +510,6 @@ class PendingDistributedShuffle(PendingExchangeBase):
                 # same as reader.py's single-process tail)
                 with GLOBAL_TRACER.span("shuffle.result",
                                         sink=self._plan.sink):
-                    if cur.combine or cur.ordered \
-                            or self._hier_mesh is not None:
-                        # SHARDED seg output — collect this process's
-                        # rows: [1, R] own counts under combine/ordered,
-                        # else [S, R] relay counts (hierarchical)
-                        ns = 1 if (cur.combine or cur.ordered) \
-                            else self._hier_mesh.devices.shape[0]
-                        seg_host = _local_shards_of(seg, self._shard_ids,
-                                                    ns)
-                    else:
-                        # flat uncombined: replicated [P, R] — any
-                        # addressable copy is the whole matrix
-                        # (np.asarray rejects multi-process arrays)
-                        seg_host = np.asarray(
-                            seg.addressable_shards[0].data)
                     # per-shard capacity from the OUTPUT, not the plan:
                     # the pallas transport's buffers are chunk-inflated
                     # (cap_eff = align(cap_out) + P*chunk), so slicing by
@@ -443,21 +530,53 @@ class PendingDistributedShuffle(PendingExchangeBase):
                         # degenerate 1-shard cluster: step_body takes the
                         # strip fast path (see reader.py resolve)
                         align_chunk = cur.strip_rows()
+                    sharded_seg = (cur.combine or cur.ordered
+                                   or self._hier_mesh is not None)
+                    if cur.sink == "device":
+                        # device sink distributed: the payload stays
+                        # sharded across every process's devices — ZERO
+                        # payload D2H, the single-process device-sink
+                        # contract held multi-host (manager gap 2)
+                        from sparkucx_tpu.shuffle.reader import \
+                            DeviceShuffleReaderResult
+                        view = DistributedLazyReaderResult(
+                            R, part_to_shard, rows_out, seg, Pn,
+                            cap_shard, self._val_shape, self._val_dtype,
+                            per_shard_segs=sharded_seg,
+                            align_chunk=align_chunk,
+                            shard_ids=self._shard_ids)
+                        view.cap_out_used = cur.cap_out
+                        view._totals_dev = total
+                        return DeviceShuffleReaderResult(
+                            [view], cur, self._val_shape,
+                            self._val_dtype)
+                    if sharded_seg:
+                        # SHARDED seg output — collect this process's
+                        # rows: [1, R] own counts under combine/ordered,
+                        # else [S, R] relay counts (hierarchical)
+                        ns = 1 if (cur.combine or cur.ordered) \
+                            else self._hier_mesh.devices.shape[0]
+                        seg_host = _local_shards_of(seg, self._shard_ids,
+                                                    ns)
+                    else:
+                        # flat uncombined: replicated [P, R] — any
+                        # addressable copy is the whole matrix
+                        # (np.asarray rejects multi-process arrays)
+                        seg_host = np.asarray(
+                            seg.addressable_shards[0].data)
                     local_payload = _local_shards_of(
                         rows_out, self._shard_ids, cap_shard)
                     res = DistributedReaderResult(
                         R, part_to_shard, self._shard_ids, local_payload,
                         seg_host, self._val_shape, self._val_dtype,
                         align_chunk=align_chunk)
-                    # the distributed path force-materializes its local
-                    # shards host-side — honest d2h accounting (the
-                    # device sink is single-process for now;
-                    # manager._resolve_sink)
+                    # the HOST sink force-materializes its local shards
+                    # — honest d2h accounting (``read.sink=device`` is
+                    # the zero-D2H path above)
                     from sparkucx_tpu.shuffle.reader import _note_d2h
                     _note_d2h(res, int(local_payload.nbytes))
                     res.cap_out_used = cur.cap_out
-                    if not (cur.combine or cur.ordered
-                            or self._hier_mesh is not None):
+                    if not sharded_seg:
                         # flat plain: the replicated [P, R] seg carries
                         # true delivered counts, identical on every
                         # process — the manager's hint decay stays in
@@ -506,3 +625,145 @@ def submit_shuffle_distributed(
         mesh, axis, plan, local_rows, local_nvalid, shard_ids,
         val_shape, val_dtype, hier_mesh, dcn_axis, on_done=on_done,
         admit=admit, wire_seed=wire_seed)
+
+
+# -- split-tier multi-process exchange --------------------------------------
+class PendingDistributedTieredShuffle(PendingTieredShuffle):
+    """The two-tier (ICI, DCN) exchange over a MULTI-PROCESS mesh as the
+    same TWO per-tier compiled programs the single-process path runs
+    (shuffle/topology.py), replacing the fused single program the
+    distributed path was stuck with — a slow DCN stage no longer stalls
+    the ICI stage's pipeline, and each tier joins under its OWN watchdog
+    deadline (``failure.ici.timeoutMs`` / ``failure.dcn.timeoutMs``).
+
+    The host join between the stages is what forced the fused shape:
+    every process must take the SAME overflow/regrow decision or the
+    group recompiles different programs and desyncs the mesh. The
+    distributed seams override exactly that — the overflow verdict is an
+    ``any``-reduced agreement round, the regrown capacity a unanimous
+    one (:func:`sparkucx_tpu.shuffle.agreement.agree`), both riding
+    inside the tier's span/wall/deadline, so a dissenting peer raises
+    :class:`~sparkucx_tpu.shuffle.agreement.AgreementDivergenceError` on
+    every process together and a dead one raises ``PeerLostError``
+    naming the tier. Staging is process-local
+    (``jax.make_array_from_process_local_data``), and only this
+    process's [L] stage-1 totals cross to host between the stages —
+    the metadata-exclusion precedent, now per process."""
+
+    def __init__(self, mesh: Mesh, topo: TopologyDescriptor,
+                 plan: ShufflePlan, local_rows: np.ndarray,
+                 local_nvalid: np.ndarray, shard_ids: Sequence[int],
+                 val_shape, val_dtype, on_done=None, admit=None,
+                 wire_seed: int = 0, hooks: Optional[TierHooks] = None):
+        # set before super().__init__: the deferred-admission first
+        # dispatch runs inside it and the seams below read the ids
+        self._shard_ids = list(shard_ids)
+        super().__init__(mesh, topo, plan, local_rows, local_nvalid,
+                         val_shape, val_dtype, on_done=on_done,
+                         admit=admit, wire_seed=wire_seed, hooks=hooks)
+
+    # -- the distributed seams (topology.PendingTieredShuffle) -------------
+    def _stage_to_device(self, arr):
+        return jax.make_array_from_process_local_data(
+            self._sharding, np.ascontiguousarray(arr))
+
+    def _seed_nvalid(self, values, stream: int) -> np.ndarray:
+        from sparkucx_tpu.shuffle.reader import seeded_nvalid
+        # per-shard noise streams derive from GLOBAL shard ids, so the
+        # noise a shard draws never depends on process placement
+        return seeded_nvalid(
+            self._plan, values,
+            (self._wire_seed + self._attempt) * 2 + stream,
+            shard_ids=self._shard_ids)
+
+    def _local_overflow(self, ovf) -> bool:
+        return any(bool(np.asarray(s.data).any())
+                   for s in ovf.addressable_shards)
+
+    def _agree_timeout(self, tier: str) -> Optional[float]:
+        limit = float(self._hooks.timeouts.get(tier, 0.0))
+        return limit if limit > 0 else None
+
+    def _agree_overflow(self, tier: str, mine: bool) -> bool:
+        from sparkucx_tpu.shuffle.agreement import agree
+        verdict = agree(f"hier.{tier}.overflow",
+                        np.array([1 if mine else 0], dtype=np.int64),
+                        reduce="any",
+                        conf_key="spark.shuffle.tpu.a2a.capacityFactor",
+                        timeout_ms=self._agree_timeout(tier))
+        return bool(verdict[0])
+
+    def _agree_regrow(self, tier: str, cap: int) -> int:
+        from sparkucx_tpu.shuffle.agreement import agree
+        # unanimity round: a peer proposing a DIFFERENT capacity (a
+        # divergent a2a.capacityFactor / bucket ladder) raises typed on
+        # every process instead of recompiling a mismatched program
+        agreed = agree(f"hier.{tier}.regrow",
+                       np.array([int(cap)], dtype=np.int64),
+                       conf_key="spark.shuffle.tpu.a2a.capacityFactor",
+                       timeout_ms=self._agree_timeout(tier))
+        return int(agreed[0])
+
+    def _totals_host(self, tot1) -> np.ndarray:
+        # only this process's [L] totals cross to host — stage-2 seeding
+        # is per-LOCAL-shard (make_array_from_process_local_data re-
+        # assembles the global lane), the per-process metadata exclusion
+        return _local_shards_of(tot1, self._shard_ids, 1) \
+            .reshape(-1).astype(np.int64)
+
+    def _assemble(self, rows_out, seg, total):
+        plan = self._plan
+        Pn = plan.num_shards
+        R = plan.num_partitions
+        part_to_shard = np.asarray(_blocked_map(R, Pn))
+        cap_shard = rows_out.shape[0] // Pn
+        if plan.sink == "device":
+            # device sink distributed: payload stays sharded in HBM
+            # across every process (zero payload D2H); the view guards
+            # non-local partitions like every distributed result
+            from sparkucx_tpu.shuffle.reader import \
+                DeviceShuffleReaderResult
+            view = DistributedLazyReaderResult(
+                R, part_to_shard, rows_out, seg, Pn, cap_shard,
+                self._val_shape, self._val_dtype, per_shard_segs=True,
+                shard_ids=self._shard_ids)
+            view.cap_out_used = plan.cap_out
+            view._totals_dev = total
+            return DeviceShuffleReaderResult(
+                [view], plan, self._val_shape, self._val_dtype)
+        # host sink: drain ONLY this process's shards — the partial-view
+        # contract of the fused distributed path, now per tier
+        ns = seg.shape[0] // Pn
+        seg_host = _local_shards_of(seg, self._shard_ids, ns)
+        local_payload = _local_shards_of(rows_out, self._shard_ids,
+                                         cap_shard)
+        res = DistributedReaderResult(
+            R, part_to_shard, self._shard_ids, local_payload, seg_host,
+            self._val_shape, self._val_dtype)
+        from sparkucx_tpu.shuffle.reader import _note_d2h
+        _note_d2h(res, int(local_payload.nbytes))
+        res.cap_out_used = plan.cap_out
+        return res
+
+
+def submit_shuffle_tiered_distributed(
+    mesh: Mesh,
+    topo: TopologyDescriptor,
+    plan: ShufflePlan,
+    local_rows: np.ndarray,
+    local_nvalid: np.ndarray,
+    shard_ids: Sequence[int],
+    val_shape,
+    val_dtype,
+    on_done=None,
+    admit=None,
+    wire_seed: int = 0,
+    hooks: Optional[TierHooks] = None,
+) -> PendingDistributedTieredShuffle:
+    """Dispatch the multi-process two-tier exchange without blocking —
+    COLLECTIVE (every process submits and joins in lockstep; the
+    overflow/regrow decisions ride agreement rounds)."""
+    return PendingDistributedTieredShuffle(
+        mesh, topo, plan, local_rows, local_nvalid, shard_ids,
+        val_shape, val_dtype, on_done=on_done, admit=admit,
+        wire_seed=wire_seed, hooks=hooks)

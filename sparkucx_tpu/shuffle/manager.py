@@ -244,6 +244,13 @@ class ExchangeReport:
     # deferred, so consumers wanting the pure exchange wall subtract it.
     tenant: str = ""
     admit_wait_ms: float = 0.0
+    # Async plane width (shuffle/tenancy.py AsyncShuffleExecutor): the
+    # EFFECTIVE worker count of the facade's async executor when this
+    # read ran — 0 when no async plane is attached. A distributed
+    # facade that asked for K workers but reports 1 here was clamped
+    # (tenant.asyncAgreedOrder=false) — the unrequested-serialization
+    # evidence the doctor reads.
+    async_workers: int = 0
     # Topology plane (shuffle/topology.py): per-tier accounting of a
     # hierarchical exchange — one entry per fabric tier ("ici", "dcn"),
     # each a separate payload/wire pair (stage-1 ICI bytes vs stage-2
@@ -670,16 +677,55 @@ class TpuShuffleManager:
 
     def _replay_after_failure(self, handle: ShuffleHandle, err) -> bool:
         """Whether read() may transparently re-run the exchange after a
-        transient failure. Single-process only: a distributed replay
-        decision taken on one process would desync the SPMD group — in
-        multi-process mode the typed error surfaces to the recovery
-        controller (buildlib/run_cluster.py), which re-bootstraps an
-        agreed world; the ledger then serves the re-pin in the fresh
-        manager. A PeerLostError additionally remeshes over the probe's
-        survivors first (the bump routes this shuffle through the
-        ledger)."""
-        if self._policy != "replay" or self.node.is_distributed:
+        transient failure. Multi-process, the decision itself is a
+        COLLECTIVE: every process proposes (shuffle_id, remaining
+        budget) over the agreement channel and the group re-enters the
+        exchange together, spending exactly one budget unit group-wide
+        (each process decrements its own counter once, in lockstep) —
+        a replay verdict taken on one process alone would desync the
+        SPMD group. A distributed PeerLostError never replays in-place:
+        the collective channel itself is dead, so the typed error
+        surfaces to the recovery controller (buildlib/run_cluster.py),
+        which re-bootstraps an agreed world; the ledger then serves the
+        re-pin in the fresh manager. Single-process, a PeerLostError
+        additionally remeshes over the probe's survivors first (the
+        bump routes this shuffle through the ledger)."""
+        if self._policy != "replay":
             return False
+        if self.node.is_distributed:
+            if isinstance(err, PeerLostError):
+                return False      # allgather channel is gone — failfast
+            budget, _ = self._replay_budget_for(handle.shuffle_id)
+            with self._lock:
+                left = budget - self._replay_counts.get(
+                    handle.shuffle_id, 0)
+            from sparkucx_tpu.shuffle.agreement import (
+                AgreementDivergenceError, agree)
+            try:
+                agree("replay.enter",
+                      np.array([handle.shuffle_id, left],
+                               dtype=np.int64),
+                      conf_key="spark.shuffle.tpu.failure.replayBudget")
+            except AgreementDivergenceError as e:
+                # divergent budget (or a peer not replaying this
+                # shuffle at all): no process may re-enter — the
+                # collective would hang half the group
+                log.error("distributed replay vetoed: %s", e)
+                return False
+            except Exception as e:
+                log.error("distributed replay agreement failed (%s); "
+                          "failing fast", e)
+                return False
+            if not self._spend_replay(handle.shuffle_id):
+                return False
+            self.node.flight.record("replay",
+                                    shuffle_id=handle.shuffle_id,
+                                    error=repr(err)[:200],
+                                    distributed=True)
+            log.warning("replaying shuffle %d group-wide after "
+                        "transient failure: %r", handle.shuffle_id,
+                        err)
+            return True
         if not self._spend_replay(handle.shuffle_id):
             return False
         if isinstance(err, PeerLostError):
@@ -1208,7 +1254,10 @@ class TpuShuffleManager:
             partitioner=handle.partitioner,
             process_id=self.node.process_id, distributed=distributed,
             hierarchical=self.hierarchical,
-            tenant=handle.tenant)
+            tenant=handle.tenant,
+            # effective async-plane width: the facade stamps
+            # _async_workers when it builds its executor (0 = none)
+            async_workers=int(getattr(self, "_async_workers", 0)))
         # the exchange WALL starts here: a report exists from read start
         # (postmortem discipline), and the anatomy plane conserves
         # against this instant at settlement
@@ -2362,6 +2411,30 @@ class TpuShuffleManager:
         rep.pad_ratio = round(wire / rep.payload_bytes, 6) \
             if rep.payload_bytes else 0.0
 
+    def _agreed_dev_matrix(self, handle, writers, L, Pn, shard_ids):
+        """Exact [P, P] source x dest row matrix for a DISTRIBUTED
+        hierarchical read. Each process holds only its local maps'
+        registry rows (Spark: sizes live with the writing executor), so
+        every process builds the partial matrix for the maps it staged
+        — source shard = shard_ids[ordinal % L], the
+        _materialize_outputs slot rule over sorted map ids — and the
+        cluster matrix is the agreed SUM. Rides the agreement channel
+        so a divergent topology conf fails typed instead of stamping
+        mismatched tier accounting across processes."""
+        from sparkucx_tpu.ops.partition import blocked_partition_map
+        from sparkucx_tpu.shuffle.agreement import agree
+        local = np.zeros((Pn, Pn), dtype=np.int64)
+        red_to_dev = np.asarray(
+            blocked_partition_map(handle.num_partitions, Pn))
+        for ordinal, mid in enumerate(sorted(writers)):
+            sizes = np.asarray(handle.entry.fetch_record(mid),
+                               dtype=np.int64)
+            src = int(shard_ids[ordinal % L])
+            np.add.at(local[src], red_to_dev, sizes)
+        return agree("tier.crossRows", local.reshape(-1), reduce="sum",
+                     conf_key="spark.shuffle.tpu.a2a.topology"
+                     ).reshape(Pn, Pn)
+
     def _stamp_wave_tiers(self, rep: ExchangeReport, wplan: ShufflePlan,
                           wave_sizes, width: int) -> None:
         """Waved hierarchical tier accounting: the pipeline dispatches W
@@ -2757,15 +2830,16 @@ class TpuShuffleManager:
         legal for ALL FOUR read modes on the single-process flat
         exchange AND the single-shot hierarchical two-tier exchange
         (the stage-2 output is already partition-sorted on device —
-        ordered/combine land fully merged, shuffle/topology.py): the
-        restriction the pre-topology resolver enforced was pure
-        policy. A device ask still falls back to host — warn-once AND
-        counted (``shuffle.sink.fallback.count``, the doctor's
-        sink_fallback evidence) — where the result cannot stay
-        resident: distributed reads (the partial view
-        force-materializes local shards) and WAVED hierarchical reads
-        (the per-wave fold is demoted at the wave branch, reason
-        ``hierarchical_waved``)."""
+        ordered/combine land fully merged, shuffle/topology.py), AND
+        distributed reads (the partial device view keeps the payload
+        sharded in HBM across processes — zero payload D2H,
+        shuffle/distributed.py DistributedLazyReaderResult): the
+        restrictions the earlier resolvers enforced were pure policy.
+        A device ask still falls back to host — warn-once AND counted
+        (``shuffle.sink.fallback.count``, the doctor's sink_fallback
+        evidence) — where the result cannot stay resident: WAVED
+        hierarchical reads (the per-wave fold is demoted at the wave
+        branch, reason ``hierarchical_waved``)."""
         from sparkucx_tpu.shuffle.alltoall import validate_sink
         if requested is not None:
             validate_sink(requested, conf_key="read(sink=...)")
@@ -2786,19 +2860,6 @@ class TpuShuffleManager:
             self._note_sink_fallback(mode, "conf_pins_host")
             want = "host"
         if want != "device":
-            return "host"
-        reason = None
-        reason_key = ""
-        if distributed:
-            reason = ("distributed reads force-materialize their local "
-                      "shards (the device sink is single-process for now)")
-            reason_key = "distributed"
-        if reason is not None:
-            self._warn_sink_once(
-                "fallback_" + reason[:24],
-                f"read.sink=device resolves to host for this read: "
-                f"{reason}")
-            self._note_sink_fallback(mode, reason_key)
             return "host"
         return "device"
 
@@ -3167,21 +3228,14 @@ class TpuShuffleManager:
         node facts — identical on every process, so the distributed
         branch decision stays in SPMD lockstep without a collective.
         Hierarchical reads wave through the tiered two-step path
-        (PendingWaveShuffle dispatches a PendingTieredShuffle per
-        wave, with per-wave tier timelines) on a single process; the
-        DISTRIBUTED hierarchical path stays single-shot — its fused
-        step has no per-stage overflow agreement to drive waves
-        through."""
+        (PendingWaveShuffle dispatches a PendingTieredShuffle per wave
+        — or a PendingDistributedTieredShuffle multi-process, whose
+        per-stage overflow/regrow decisions ride agreement rounds), so
+        waves are legal on every topology."""
         if plan.impl == "pallas":
             log.info("a2a.waveRows set with impl='pallas' — single-shot "
                      "read (the remote-DMA transport owns its own "
                      "chunk-aligned flow control)")
-            return False
-        if self.hierarchical and self.node.is_distributed:
-            log.info("a2a.waveRows set but the DISTRIBUTED hierarchical "
-                     "exchange is single-shot (the fused two-stage step "
-                     "has no per-stage overflow agreement) — waves ride "
-                     "single-process topologies")
             return False
         return True
 
@@ -3229,10 +3283,11 @@ class TpuShuffleManager:
         # native collective pays each wave's real rows). Refreshed in
         # _finalize once any overflow regrow settles the final wave plan.
         self._set_wave_wire(rep, wplan, wave_sizes, width)
-        if self.hierarchical and wplan.impl != "pallas" \
-                and not distributed:
+        if self.hierarchical and wplan.impl != "pallas":
             # hierarchical waves: per-tier accounting summed over the
-            # wave plan's W tiered exchanges (re-settled in finalize)
+            # wave plan's W tiered exchanges (re-settled in finalize) —
+            # distributed included, now that waved multi-process reads
+            # dispatch the same per-tier split programs per wave
             self._stamp_wave_tiers(rep, wplan, wave_sizes, width)
         # pipeline depth: the tenant's waveDepth override wins (a batch
         # tenant can be held to a shallower — cheaper-footprint —
@@ -3293,11 +3348,14 @@ class TpuShuffleManager:
                             ordered: bool = False,
                             combine_sum_words: int = 0,
                             sink: Optional[str] = None):
-        # the device sink is single-process for now: resolve (and
-        # warn-once) HERE, identically on every process — pure
-        # argument/conf facts, no collective needed
-        self._resolve_sink(sink, combine, ordered, distributed=True)
+        # resolve the landing tier HERE, identically on every process —
+        # pure argument/conf facts, no collective needed; the device
+        # sink is legal distributed (DistributedLazyReaderResult keeps
+        # the payload sharded in HBM, zero payload D2H)
+        sink = self._resolve_sink(sink, combine, ordered,
+                                  distributed=True)
         rep = self._new_report(handle, distributed=True)
+        rep.sink = sink
         try:
             # same anatomy envelope as _submit_local (plan phase,
             # lowest sweep priority — the precise spans inside win)
@@ -3306,7 +3364,7 @@ class TpuShuffleManager:
                                        trace=rep.trace_id):
                 return self._submit_distributed_impl(
                     handle, timeout, combine, ordered,
-                    combine_sum_words, rep)
+                    combine_sum_words, rep, sink)
         except BaseException as e:
             rep.error = rep.error or repr(e)[:300]
             self.node.flight.end_trace(rep.trace_id)
@@ -3315,7 +3373,8 @@ class TpuShuffleManager:
     def _submit_distributed_impl(self, handle: ShuffleHandle,
                                  timeout: float, combine: Optional[str],
                                  ordered: bool, combine_sum_words: int,
-                                 rep: ExchangeReport):
+                                 rep: ExchangeReport,
+                                 sink: str = "host"):
         """COLLECTIVE multi-process submit (shuffle/distributed.py);
         returns a PendingDistributedShuffle — result() is the other half
         of the collective. Map
@@ -3427,14 +3486,15 @@ class TpuShuffleManager:
                     f"unregister raced this read)")
             return self._submit_distributed_staged(
                 handle, writers, L, Pn, shard_ids, combine, ordered,
-                tracer, combine_sum_words, rep)
+                tracer, combine_sum_words, rep, sink)
         finally:
             self._read_finished(read_gen)
 
     def _submit_distributed_staged(self, handle, writers, L, Pn, shard_ids,
                                    combine, ordered, tracer,
                                    combine_sum_words: int = 0,
-                                   rep: Optional[ExchangeReport] = None):
+                                   rep: Optional[ExchangeReport] = None,
+                                   sink: str = "host"):
         from sparkucx_tpu.shuffle.distributed import (
             allgather_blob, allgather_sizes, submit_shuffle_distributed)
 
@@ -3498,7 +3558,7 @@ class TpuShuffleManager:
                          trace=rep.trace_id if rep is not None else ""):
             plan = self._decorated_plan(plan, combine, ordered, has_vals,
                                         val_tail, val_dtype,
-                                        combine_sum_words)
+                                        combine_sum_words, sink=sink)
 
         width = KEY_WORDS + (value_words(val_tail, val_dtype)
                              if has_vals else 0)
@@ -3510,10 +3570,14 @@ class TpuShuffleManager:
                                 local_rows=int(nvalid_local.sum()))
             self._estimate_wire_error(rep, plan, shard_outputs)
             if self.hierarchical and plan.impl != "pallas":
-                # per-tier pairs with the every-row upper bound: no
-                # process holds the [M, R] table here, so cross-fabric
-                # rows are not exact (cross_exact=false on the entries)
-                self._stamp_tiers(rep, plan, nvalid, width)
+                # exact cross-fabric rows, distributed: no single
+                # process holds the [M, R] table, but each holds its
+                # LOCAL maps' size rows — sum the per-process partial
+                # [P, P] device matrices over the agreement channel
+                self._stamp_tiers(
+                    rep, plan, nvalid, width,
+                    dev_matrix=self._agreed_dev_matrix(
+                        handle, writers, L, Pn, shard_ids))
         # Wave-pipelined mode, multi-process: the wave count derives from
         # the ALLGATHERED global size row (identical math everywhere), and
         # agree_wave_count allgathers the verdict so a divergent
@@ -3581,21 +3645,34 @@ class TpuShuffleManager:
                 vt = val_tail if has_vals else None
                 # flat-only transport: pallas on a multi-slice mesh rides
                 # the flattened alias mesh, same as the local path
-                # (manager.py _submit_local); the two-stage DCN-once
-                # exchange is native/dense territory
+                # (manager.py _submit_local); the two-stage exchange is
+                # native/dense territory
                 hier = self.hierarchical and plan.impl != "pallas"
                 if self.hierarchical and not hier:
                     log.info("a2a.impl=pallas on a multi-slice mesh "
                              "(distributed): using the flat exchange "
                              "over %d devices",
                              self.exchange_mesh.devices.size)
-                pending = submit_shuffle_distributed(
-                    self.exchange_mesh, self.axis, plan, local_rows,
-                    nvalid_local, shard_ids, vt, val_dtype,
-                    hier_mesh=self.node.mesh if hier else None,
-                    dcn_axis=self.conf.mesh_dcn_axis if hier else None,
-                    on_done=on_done, admit=admit,
-                    wire_seed=rep._seq if rep is not None else 0)
+                if hier:
+                    # split per-tier programs: each tier runs under its
+                    # OWN watchdog deadline, overflow/regrow verdicts
+                    # ride agreement rounds (a wedged DCN expires the
+                    # dcn deadline without stalling the ici stage)
+                    from sparkucx_tpu.shuffle.distributed import (
+                        submit_shuffle_tiered_distributed)
+                    pending = submit_shuffle_tiered_distributed(
+                        self.node.mesh, self.topology, plan,
+                        local_rows, nvalid_local, shard_ids, vt,
+                        val_dtype, on_done=on_done, admit=admit,
+                        wire_seed=rep._seq if rep is not None else 0,
+                        hooks=self._tier_hooks(
+                            rep.trace_id if rep is not None else ""))
+                else:
+                    pending = submit_shuffle_distributed(
+                        self.exchange_mesh, self.axis, plan, local_rows,
+                        nvalid_local, shard_ids, vt, val_dtype,
+                        on_done=on_done, admit=admit,
+                        wire_seed=rep._seq if rep is not None else 0)
             if rep is not None:
                 rep.dispatch_ms = (time.perf_counter()
                                    - rep._t_dispatched) * 1e3
@@ -4049,6 +4126,18 @@ class PendingWaveShuffle:
             pool.put(_b)
 
         if self._distributed:
+            if mgr.hierarchical and self._wave_plan.impl != "pallas":
+                # distributed hierarchical waves dispatch the SAME
+                # per-tier split programs as single-shot multi-process
+                # reads — per-wave (ICI, DCN) deadlines and walls, with
+                # overflow/regrow verdicts agreed per wave
+                from sparkucx_tpu.shuffle.distributed import \
+                    submit_shuffle_tiered_distributed
+                return submit_shuffle_tiered_distributed(
+                    mgr.node.mesh, mgr.topology, self._wave_plan,
+                    shard_rows, wnv, self._shard_ids, self._val_tail,
+                    self._val_dtype, on_done=on_done, wire_seed=wseed,
+                    hooks=mgr._tier_hooks(self._rep.trace_id))
             from sparkucx_tpu.shuffle.distributed import \
                 submit_shuffle_distributed
             return submit_shuffle_distributed(

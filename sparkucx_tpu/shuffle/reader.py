@@ -1329,7 +1329,11 @@ def device_merge_fold(res: "DeviceShuffleReaderResult", mesh: Mesh,
     Pn = plan.num_shards
     R = plan.num_partitions
     views = res.wave_views()
-    totals = np.stack([np.asarray(v._totals_dev).reshape(-1)
+    # multi-process device views hold only their local totals shards;
+    # local_totals_row sums the full [P] row over the agreement channel
+    # (one metadata round per wave) so acc sizing agrees everywhere
+    from sparkucx_tpu.shuffle.distributed import local_totals_row
+    totals = np.stack([local_totals_row(v._totals_dev, Pn)
                        for v in views])                     # [W, P]
     need = int(totals.sum(axis=0).max()) if totals.size else 0
     acc_cap = bucket_cap_conf(max(8, -(-need // 8) * 8), conf)

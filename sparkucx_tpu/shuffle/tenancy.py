@@ -32,9 +32,14 @@ Conf surface (all under ``spark.shuffle.tpu.``)::
     tenant.priority                default priority class (high|normal|batch)
     tenant.fairShare               fair-share admission on/off (default on;
                                    off = the historical FIFO queue)
-    tenant.asyncWorkers            async read workers, single-process only
-                                   (default 4; distributed mode forces 1 —
-                                   see AsyncShuffleExecutor)
+    tenant.asyncWorkers            async read workers (default 4); in
+                                   distributed mode K workers require the
+                                   agreed submission order below
+    tenant.asyncAgreedOrder        distributed K-worker async: agree the
+                                   per-batch submission order collectively
+                                   (default on; off clamps the pool to 1
+                                   worker, warn-once — see
+                                   AsyncShuffleExecutor)
     tenant.<id>.priority           per-tenant priority class
     tenant.<id>.maxBytesInFlight   per-tenant admission quota (0 = only the
                                    global cap applies)
@@ -449,6 +454,45 @@ class FifoAdmitQueue:
         return seen
 
 
+def agreed_submission_order(pending, weight_of) -> list:
+    """Deterministic tenant-DRR dispatch order over ONE async batch.
+
+    ``pending`` — ``(seq, tenant_id)`` pairs in local submission order;
+    ``weight_of(tenant_id)`` — the tenant's priority weight. Returns the
+    seqs in dispatch order: round-robin over tenants in first-appearance
+    order, each tenant serving up to ``weight`` queued reads per round
+    (count-denominated DRR — async reads are request-shaped, so the
+    quantum is a read, not a byte), FIFO within a tenant (submit order
+    is the collective order and must never reorder inside one tenant).
+
+    Pure function of the batch: every process holding the same
+    (seq, tenant) pairs — the standing SPMD submission discipline —
+    computes the SAME order, which the executor then confirms over the
+    agreement channel before dispatching."""
+    queues: Dict[str, deque] = {}
+    order = []
+    for seq, tid in pending:
+        q = queues.get(tid)
+        if q is None:
+            q = queues[tid] = deque()
+            order.append(tid)
+        q.append(seq)
+    out = []
+    while queues:
+        for tid in list(order):
+            q = queues.get(tid)
+            if q is None:
+                continue
+            for _ in range(max(1, int(weight_of(tid)))):
+                if not q:
+                    break
+                out.append(q.popleft())
+            if not q:
+                del queues[tid]
+                order.remove(tid)
+    return out
+
+
 class ShuffleFuture:
     """Handle to one async shuffle read — ``done()`` / ``result()`` /
     ``exception()`` / ``add_done_callback()`` over the facade read that
@@ -495,15 +539,26 @@ class AsyncShuffleExecutor:
     (N exchanges in flight at once, arbitrated by the admission plane)
     and bounded per tenant by ``tenant.<id>.maxInflightReads``.
 
-    Distributed mode forces ONE worker: reads are collective, and the
-    collective order must agree across processes. With a single worker,
-    execution order == submission order on every process, so callers
-    that submit in the same order (the standing SPMD discipline of
-    read()/submit() themselves) keep the collectives aligned — the
-    "agreed ordering" contract. A multi-worker pool would let two
-    processes interleave two in-flight collectives differently and
-    deadlock the mesh; the width-1 clamp rejects that topology by
-    construction rather than detecting it after the hang.
+    Distributed mode keeps K workers by making the dispatch order a
+    COLLECTIVE decision (``tenant.asyncAgreedOrder``, default on): a
+    single dispatcher thread drains submissions in batches, agrees the
+    batch size over the agreement primitive (reduce-min of the pending
+    counts — the straggler's view bounds the batch), computes the
+    tenant-DRR order with :func:`agreed_submission_order` and CONFIRMS
+    it unanimously (``async.order``) before releasing the batch to the
+    pool in that order. Every process therefore enters its collectives
+    in the same agreed sequence while up to K reads overlap — the
+    serving-tier fan-out the width-1 clamp used to forbid. A divergent
+    order (one process submitted different work, or a different
+    asyncWorkers/priority conf) fails ALL of the batch's futures with
+    the typed divergence error naming the dissenter instead of
+    deadlocking the mesh mid-collective.
+
+    ``tenant.asyncAgreedOrder=false`` restores the historical width-1
+    clamp (execution order == submission order by construction, no
+    agreement traffic) — warned once, since a conf asking for K workers
+    and silently getting 1 reads as unrequested serialization
+    (ExchangeReport.async_workers carries the effective width).
 
     Per-tenant in-flight caps are enforced AT SUBMIT: a tenant at its
     cap blocks in ``submit`` until one of its reads resolves (counted in
@@ -521,17 +576,32 @@ class AsyncShuffleExecutor:
             raise ValueError(
                 f"spark.shuffle.tpu.tenant.asyncWorkers={workers}: "
                 f"want >= 1")
-        self.workers = 1 if distributed else workers
-        if distributed and workers != 1:
-            log.info("tenant.asyncWorkers=%d clamped to 1 in distributed "
-                     "mode: async reads execute in submission order so "
-                     "the collective order agrees across processes",
-                     workers)
+        self._agreed_order = conf.get_bool("tenant.asyncAgreedOrder", True)
+        self._distributed = bool(distributed)
+        if distributed and workers != 1 and not self._agreed_order:
+            log.warning(
+                "tenant.asyncWorkers=%d clamped to 1: "
+                "tenant.asyncAgreedOrder=false opts out of the "
+                "collectively agreed submission order, and distributed "
+                "async reads without it must execute strictly in "
+                "submission order — set "
+                "spark.shuffle.tpu.tenant.asyncAgreedOrder=true "
+                "(default) to run K workers over the agreement channel",
+                workers)
+            workers = 1
+        self.workers = workers
+        # the dispatcher (agreed-order batching) engages only when the
+        # distributed pool is actually wider than one worker
+        self._dispatching = distributed and workers > 1 \
+            and self._agreed_order
         self._pool = None
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._inflight: Dict[str, int] = {}
         self._closed = False
+        self._seq = 0                 # local submission counter
+        self._queue: deque = deque()  # (seq, tid, run, outer_future)
+        self._dispatcher = None
 
     def _executor(self):
         with self._lock:
@@ -601,6 +671,27 @@ class AsyncShuffleExecutor:
                 times["wall_ms"] = (time.perf_counter() - t0) * 1e3
                 _release_slot()
 
+        if self._dispatching:
+            # agreed-order mode: the run parks on the dispatcher queue;
+            # the dispatcher batches, agrees the DRR order collectively
+            # and releases the batch to the pool in that order
+            from concurrent.futures import Future
+            outer = Future()
+            with self._cv:
+                if self._closed:
+                    _release_slot()
+                    raise RuntimeError("async executor is stopped")
+                self._seq += 1
+                self._queue.append((self._seq, tid, run, outer,
+                                    _release_slot))
+                if self._dispatcher is None:
+                    self._dispatcher = threading.Thread(
+                        target=self._dispatch_loop,
+                        name="sxt-async-dispatch", daemon=True)
+                    self._dispatcher.start()
+                self._cv.notify_all()
+            return ShuffleFuture(outer, times, tid, shuffle_id)
+
         try:
             fut = self._executor().submit(run)
         except BaseException:
@@ -613,13 +704,111 @@ class AsyncShuffleExecutor:
             lambda f: _release_slot() if f.cancelled() else None)
         return ShuffleFuture(fut, times, tid, shuffle_id)
 
+    # -- agreed-order dispatch (distributed K-worker mode) -----------------
+    def _dispatch_loop(self):
+        """Single dispatcher: drains the submission queue in batches
+        whose size and tenant-DRR order are AGREED across processes
+        before any read of the batch enters the pool. One thread per
+        process runs the agreement plane, so agreement seq numbers
+        advance identically everywhere regardless of how many worker
+        threads are mid-read."""
+        while True:
+            with self._cv:
+                while not self._queue and not self._closed:
+                    self._cv.wait(0.2)
+                if self._closed:
+                    return
+                n_local = len(self._queue)
+            try:
+                self._dispatch_batch(n_local)
+            except Exception:
+                log.error("async dispatcher died; failing queued reads",
+                          exc_info=True)
+                self._fail_queued(RuntimeError(
+                    "async agreed-order dispatcher failed"))
+                return
+
+    def _dispatch_batch(self, n_local: int):
+        import numpy as np
+        from sparkucx_tpu.shuffle.agreement import (
+            AgreementDivergenceError, agree)
+        conf_key = "spark.shuffle.tpu.tenant.asyncAgreedOrder"
+        # reduce-min: the straggler's pending count bounds the batch, so
+        # no process dispatches work a peer has not submitted yet (the
+        # standing SPMD discipline: all processes submit the same reads
+        # in the same local order)
+        n = int(agree("async.batch",
+                      np.array([n_local], dtype=np.int64),
+                      reduce="min", conf_key=conf_key)[0])
+        if n < 1:
+            return
+        with self._cv:
+            batch = [self._queue.popleft() for _ in range(n)]
+        by_seq = {item[0]: item for item in batch}
+        order = agreed_submission_order(
+            [(seq, tid) for seq, tid, _r, _f, _rel in batch],
+            lambda t: self._registry.spec(t).weight)
+        try:
+            # unanimity over (seq, tenant) pairs: a process that queued
+            # DIFFERENT work (or resolves different priority weights)
+            # fails the whole batch typed, naming the dissenter, before
+            # any collective runs under a divergent order
+            import zlib
+            proposal = np.array(
+                [x for seq in order
+                 for x in (seq,
+                           zlib.crc32(by_seq[seq][1].encode()))],
+                dtype=np.int64)
+            agree("async.order", proposal, conf_key=conf_key)
+        except AgreementDivergenceError as e:
+            for _seq, _tid, _run, outer, release in batch:
+                release()
+                if not outer.done():
+                    outer.set_exception(e)
+            return
+        pool = self._executor()
+        for seq in order:
+            _s, _tid, run, outer, release = by_seq[seq]
+            fut = pool.submit(run)
+            # a run cancelled by stop(cancel_futures=True) never enters
+            # its finally — release its tenant slot here (same rule as
+            # the direct path)
+            fut.add_done_callback(
+                lambda f, rel=release: rel() if f.cancelled() else None)
+            self._chain(fut, outer)
+
+    @staticmethod
+    def _chain(fut, outer):
+        def done(f):
+            if f.cancelled():
+                outer.cancel()
+            elif f.exception() is not None:
+                outer.set_exception(f.exception())
+            else:
+                outer.set_result(f.result())
+        fut.add_done_callback(done)
+
+    def _fail_queued(self, err: BaseException) -> None:
+        with self._cv:
+            drained, self._queue = list(self._queue), deque()
+        for _seq, _tid, _run, outer, release in drained:
+            release()
+            if not outer.done():
+                outer.set_exception(err)
+
     def stop(self, wait: bool = True) -> None:
         with self._cv:
             self._closed = True
             pool, self._pool = self._pool, None
+            dispatcher, self._dispatcher = self._dispatcher, None
             # wake submitters blocked at a tenant cap so they observe
             # _closed and raise instead of waiting on a drained pool
             self._cv.notify_all()
+        if dispatcher is not None:
+            dispatcher.join(timeout=5.0)
+        # undispatched queued reads never reach the pool: fail them so
+        # their futures resolve and their tenant slots free
+        self._fail_queued(RuntimeError("async executor is stopped"))
         if pool is not None:
             # in-flight reads hold arena buffers and admission
             # reservations — draining them is the clean-teardown rule

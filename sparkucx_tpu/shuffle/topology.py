@@ -36,10 +36,13 @@ This module is the production rebuild:
   dequantize after; key/partition/size lanes stay exact, so the
   between-stage partition recompute is untouched).
 
-The fused single-program step stays in ``shuffle/hierarchical.py`` for
-the multi-process path (a host sync between stages would need its own
-cross-process overflow agreement per stage); it shares this module's
-cache key and the per-hop wire narrowing.
+The multi-process path runs the SAME two per-tier programs through
+:class:`sparkucx_tpu.shuffle.distributed.PendingDistributedTieredShuffle`,
+which overrides this class's distributed seams (staging, overflow
+reads, and the cross-process regrow/verdict agreement rounds of
+``shuffle/agreement.py``). The fused single-program step in
+``shuffle/hierarchical.py`` remains the low-level fallback shape; it
+shares this module's cache key and the per-hop wire narrowing.
 """
 
 from __future__ import annotations
@@ -576,21 +579,50 @@ class PendingTieredShuffle(PendingExchangeBase):
         from sparkucx_tpu.io.dlpack import stage_to_device
         return stage_to_device(arr, self._sharding)
 
+    # -- the distributed seams ---------------------------------------------
+    # PendingDistributedTieredShuffle (shuffle/distributed.py) overrides
+    # exactly these five hooks to run the SAME two per-tier programs over
+    # a multi-process mesh: local staging, local overflow reads, and the
+    # cross-process agreement rounds (shuffle/agreement.py) that keep the
+    # regrow/verdict decisions in lockstep. Single-process they are
+    # identities, so the hot path pays nothing.
+    def _seed_nvalid(self, values, stream: int) -> np.ndarray:
+        """Seeded nvalid lane for stage ``1 + stream``: distinct
+        per-attempt noise base; stage 2 derives its own (odd) stream, so
+        the two hops never reuse a wire-noise realization."""
+        from sparkucx_tpu.shuffle.reader import seeded_nvalid
+        return seeded_nvalid(
+            self._plan, values,
+            (self._wire_seed + self._attempt) * 2 + stream)
+
+    def _local_overflow(self, ovf) -> bool:
+        return bool(np.asarray(ovf).any())
+
+    def _agree_overflow(self, tier: str, mine: bool) -> bool:
+        """Cross-process overflow verdict (identity single-process)."""
+        return mine
+
+    def _agree_regrow(self, tier: str, cap: int) -> int:
+        """Cross-process capacity-regrow agreement (identity
+        single-process); returns the agreed capacity."""
+        return int(cap)
+
+    def _totals_host(self, tot1) -> np.ndarray:
+        """Stage-1 per-shard totals as the host row stage-2 seeds from
+        (this process's view — the full [P] row single-process)."""
+        return np.asarray(tot1).astype(np.int64).reshape(-1)
+
     def _dispatch(self) -> None:
         """(Re)dispatch STAGE 1 — the PendingExchangeBase seam (the
         deferred-admission first dispatch lands here too)."""
-        from sparkucx_tpu.shuffle.reader import seeded_nvalid
         width = self._rows_host.shape[2]
         step = _build_stage1_step(self._mesh, self._topo, self._plan,
                                   width, self._relay_cap)
         self._step1 = step
         rows_flat = self._stage_to_device(
             self._rows_host.reshape(-1, width))
-        nvalid = self._stage_to_device(seeded_nvalid(
-            self._plan, self._nvalid_host,
-            # distinct per-attempt noise base; stage 2 derives its own
-            # (odd) stream, so the two hops never reuse a realization
-            (self._wire_seed + self._attempt) * 2))
+        nvalid = self._stage_to_device(
+            self._seed_nvalid(self._nvalid_host, 0))
         self._t_stage = time.perf_counter()
         self._stage = 1
         self._out = step(rows_flat, nvalid)
@@ -618,7 +650,7 @@ class PendingTieredShuffle(PendingExchangeBase):
 
         def join():
             hooks.check_fault(tier)
-            return bool(np.asarray(ovf).any())
+            return self._local_overflow(ovf)
 
         limit = float(hooks.timeouts.get(tier, 0.0))
         try:
@@ -626,6 +658,11 @@ class PendingTieredShuffle(PendingExchangeBase):
                 verdict = current_watchdog().call(
                     join, what=f"hierarchical {tier} exchange",
                     trace=hooks.trace_id or None, timeout_ms=limit)
+                # cross-process verdict (identity single-process): the
+                # agreement round rides INSIDE the tier span/wall, so a
+                # peer stuck in this tier burns THIS tier's deadline
+                # and a divergence records as this tier's fault
+                verdict = self._agree_overflow(tier, verdict)
         except BaseException as e:
             # the postmortem names the tier even when the failure is an
             # injected fault rather than a deadline expiry (the chaos
@@ -640,9 +677,6 @@ class PendingTieredShuffle(PendingExchangeBase):
         return verdict
 
     def _result_inner(self):
-        from sparkucx_tpu.shuffle.reader import (
-            DeviceShuffleReaderResult, LazyShuffleReaderResult,
-            _blocked_map, max_recv_rows, seeded_nvalid)
         plan = self._plan
         width = self._rows_host.shape[2]
         # -- stage 1: ICI, relay-capacity retry loop ----------------------
@@ -657,7 +691,11 @@ class PendingTieredShuffle(PendingExchangeBase):
                     f"{self._relay_cap}); extreme skew — repartition")
             log.info("hier ICI overflow at relay_cap=%d (attempt %d); "
                      "growing", self._relay_cap, self._attempt)
-            self._relay_cap *= 2
+            # the regrown capacity is AGREED before redispatch (identity
+            # single-process): one peer regrowing alone would recompile
+            # a different stage-1 program and desync the mesh
+            self._relay_cap = self._agree_regrow("ici",
+                                                 self._relay_cap * 2)
             self._retries1 += 1
             self._attempt += 1
             # anatomy span (pack phase): the grown-capacity redispatch
@@ -671,7 +709,7 @@ class PendingTieredShuffle(PendingExchangeBase):
         # a blocking D2H on the stage-1 collective's output, so it
         # rides the ICI tier span in the anatomy ledger
         with self._hooks.span("ici"):
-            totals1 = np.asarray(tot1).astype(np.int64).reshape(-1)
+            totals1 = self._totals_host(tot1)
         # -- stage 2: DCN, output-capacity retry loop ---------------------
         while True:
             # anatomy span (pack phase): the stage-2 redispatch — step
@@ -685,9 +723,8 @@ class PendingTieredShuffle(PendingExchangeBase):
                                            width, self._relay_cap,
                                            plan.cap_out)
                 self._step = step2  # device-plane join point (cost rec)
-                nv2 = self._stage_to_device(seeded_nvalid(
-                    plan, totals1,
-                    (self._wire_seed + self._attempt) * 2 + 1))
+                nv2 = self._stage_to_device(
+                    self._seed_nvalid(totals1, 1))
                 self._t_stage = time.perf_counter()
                 self._stage = 2
                 self._out = step2(relay, nv2)
@@ -703,36 +740,49 @@ class PendingTieredShuffle(PendingExchangeBase):
             log.info("hier DCN overflow at cap_out=%d (attempt %d); "
                      "growing", plan.cap_out, self._attempt)
             plan = plan.grown()
+            # agreement on the grown output capacity (identity
+            # single-process) — the unanimity round every process must
+            # pass before the group recompiles stage 2
+            self._agree_regrow("dcn", plan.cap_out)
             self._plan = plan
             self._retries2 += 1
             self._attempt += 1
         # anatomy span (sink phase): result assembly — the seg pull and
-        # the lazy-result wrapper — same tail as the flat path's
+        # the lazy-result wrapper — same tail as the flat path's. The
+        # assembly itself is the last distributed seam (the multi-process
+        # subclass builds a partial, process-local view instead).
         with self._hooks.named_span("shuffle.result", sink=plan.sink):
-            Pn = plan.num_shards
-            R = plan.num_partitions
-            cap_shard = rows_out.shape[0] // Pn
-            res = LazyShuffleReaderResult(
-                R, np.asarray(_blocked_map(R, Pn)), rows_out, seg,
-                Pn, cap_shard, self._val_shape, self._val_dtype,
-                per_shard_segs=True, align_chunk=0)
-            res.cap_out_used = plan.cap_out
-            res._totals_dev = total
-            if not plan.combine:
-                # plain/ordered: observable delivered-rows requirement
-                # for the manager's learned-cap decay (combine's counts
-                # are post-merge) — same tiny host read as the flat path
-                seg_np = np.asarray(seg).reshape(Pn, -1, R)
-                res.recv_rows_needed = max_recv_rows(
-                    seg_np, np.asarray(_blocked_map(R, Pn)), Pn)
-            if plan.sink == "device":
-                # the stage-2 output is already partition-sorted on
-                # device (partition-major stage-2 sort; ordered/combine
-                # land fully merged) — the device sink holds it resident
-                # exactly like the flat single-shot path
-                return DeviceShuffleReaderResult(
-                    [res], plan, self._val_shape, self._val_dtype)
-            return res
+            return self._assemble(rows_out, seg, total)
+
+    def _assemble(self, rows_out, seg, total):
+        from sparkucx_tpu.shuffle.reader import (
+            DeviceShuffleReaderResult, LazyShuffleReaderResult,
+            _blocked_map, max_recv_rows)
+        plan = self._plan
+        Pn = plan.num_shards
+        R = plan.num_partitions
+        cap_shard = rows_out.shape[0] // Pn
+        res = LazyShuffleReaderResult(
+            R, np.asarray(_blocked_map(R, Pn)), rows_out, seg,
+            Pn, cap_shard, self._val_shape, self._val_dtype,
+            per_shard_segs=True, align_chunk=0)
+        res.cap_out_used = plan.cap_out
+        res._totals_dev = total
+        if not plan.combine:
+            # plain/ordered: observable delivered-rows requirement
+            # for the manager's learned-cap decay (combine's counts
+            # are post-merge) — same tiny host read as the flat path
+            seg_np = np.asarray(seg).reshape(Pn, -1, R)
+            res.recv_rows_needed = max_recv_rows(
+                seg_np, np.asarray(_blocked_map(R, Pn)), Pn)
+        if plan.sink == "device":
+            # the stage-2 output is already partition-sorted on
+            # device (partition-major stage-2 sort; ordered/combine
+            # land fully merged) — the device sink holds it resident
+            # exactly like the flat single-shot path
+            return DeviceShuffleReaderResult(
+                [res], plan, self._val_shape, self._val_dtype)
+        return res
 
 
 def submit_shuffle_tiered(

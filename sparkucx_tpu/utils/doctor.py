@@ -126,7 +126,10 @@ import dataclasses
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Union
 
-from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES, C_D2H, C_H2D,
+from sparkucx_tpu.utils.metrics import (C_ADMIT_BYTES,
+                                        C_AGREE_DIVERGENCE,
+                                        C_AGREE_ROUNDS,
+                                        C_D2H, C_H2D,
                                         C_KERNEL_FALLBACK,
                                         C_PHASE_MS,
                                         C_SINK_FALLBACK,
@@ -361,6 +364,14 @@ class Thresholds:
     # second drift is ordinary NTP housekeeping.
     clock_drift_warn_s: float = 0.25
     clock_drift_critical_s: float = 5.0
+    # desync: cross-process agreement divergence (shuffle/agreement.py
+    # — the epoch-scoped agree() primitive every distributed control
+    # decision rides). NO noise floor, the peer_timeout posture: ONE
+    # divergence is already a finding — processes proposed different
+    # values for the same deterministic decision, which is a conf split
+    # or broken SPMD discipline, never load noise. Critical once it
+    # repeats: the disagreement is systematic, not a one-off race.
+    desync_critical: int = 2
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -1262,15 +1273,17 @@ def _rule_sink_fallback(view: ClusterView,
                                 for r, n in by_reason.items()}},
         conf_key="spark.shuffle.tpu.read.sink",
         remediation=("the device sink is legal for ALL four read modes "
-                     "on the single-process flat exchange AND the "
-                     "single-shot hierarchical one — if the reason is "
+                     "on the flat exchange — single-process AND "
+                     "distributed (the split-tier path lands device-"
+                     "resident with zero payload D2H) — and the "
+                     "single-shot hierarchical one; if the reason is "
                      "conf_pins_host, set spark.shuffle.tpu.read.sink="
-                     "auto (or device); distributed reads and WAVED "
-                     "hierarchical reads (reason hierarchical_waved — "
-                     "drop a2a.waveRows for the device consumer) still "
-                     "drain host-side by design, so either reshape the "
-                     "read or accept the drain and read(sink='host') "
-                     "to silence the intent mismatch"))]
+                     "auto (or device); only WAVED hierarchical reads "
+                     "(reason hierarchical_waved — drop a2a.waveRows "
+                     "for the device consumer) still drain host-side "
+                     "by design, so either reshape the read or accept "
+                     "the drain and read(sink='host') to silence the "
+                     "intent mismatch"))]
 
 
 def _rule_kernel_fallback(view: ClusterView,
@@ -2009,6 +2022,82 @@ def _rule_clock_drift(view: ClusterView, th: Thresholds) -> List[Finding]:
                      "anchor once its clock is disciplined"))]
 
 
+# topic (or topic prefix, dot-terminated) -> the conf key whose
+# cross-process split most plausibly produced the divergence. Derived
+# from the agree() call sites: a2a.waveRows/waveSizes (distributed
+# split-tier wave programs), hier.<tier>.overflow/regrow (capacity
+# ladder), replay.enter (collective replay budget), async.batch/order
+# (K-worker agreed submission order), tier.crossRows (exact distributed
+# tier accounting).
+_DESYNC_CONF = (
+    ("a2a.", "spark.shuffle.tpu.a2a.waveRows"),
+    ("hier.", "spark.shuffle.tpu.a2a.capacityFactor"),
+    ("replay.", "spark.shuffle.tpu.failure.replayBudget"),
+    ("async.", "spark.shuffle.tpu.tenant.asyncAgreedOrder"),
+    ("tier.", "spark.shuffle.tpu.a2a.topology"),
+)
+
+
+def _rule_desync(view: ClusterView, th: Thresholds) -> List[Finding]:
+    """Agreement divergence (shuffle/agreement.py ``agree()``): peers
+    proposed DIFFERENT values for a decision the SPMD discipline says
+    must be identical everywhere — wave programs, capacity regrows,
+    replay entry, async submission order, tier cross-rows. The labeled
+    counter twins name the TOPIC, and each topic maps to the conf key
+    whose per-process split is the usual cause (the divergence error
+    itself names the same key at raise time; this rule is the
+    after-the-fact flight-recorder face). No noise floor — the
+    peer_timeout posture: one divergence is a conf split or broken
+    determinism, never load noise. Quiet when every agreement round
+    closed unanimous."""
+    total = float(view.counters.get(C_AGREE_DIVERGENCE, 0.0))
+    if total <= 0:
+        return []
+    by_topic = {t: float(v) for t, v in _labeled_series(
+        view.counters, C_AGREE_DIVERGENCE, "topic").items()}
+    # charge the finding to the dominant topic's conf key; every
+    # implicated key rides in the evidence
+    keys: Dict[str, float] = {}
+    for topic, n in by_topic.items():
+        for prefix, key in _DESYNC_CONF:
+            if topic.startswith(prefix):
+                keys[key] = keys.get(key, 0.0) + n
+                break
+        else:
+            keys["spark.shuffle.tpu.*"] = keys.get(
+                "spark.shuffle.tpu.*", 0.0) + n
+    conf_key = max(keys.items(), key=lambda kv: kv[1])[0] if keys \
+        else "spark.shuffle.tpu.*"
+    topics = ", ".join(f"{t}×{int(n)}"
+                       for t, n in sorted(by_topic.items())) \
+        or "unknown"
+    rounds = float(view.counters.get(C_AGREE_ROUNDS, 0.0))
+    return [Finding(
+        rule="desync",
+        grade="critical" if total >= th.desync_critical else "warn",
+        summary=(f"{int(total)} agreement divergence(s) (topics: "
+                 f"{topics}) — processes proposed different values for "
+                 f"a decision that must be identical cluster-wide; the "
+                 f"exchange fails typed instead of deadlocking, but "
+                 f"the cluster is running a split configuration"),
+        evidence={"divergences": int(total),
+                  "by_topic": {t: int(n)
+                               for t, n in sorted(by_topic.items())},
+                  "implicated_conf_keys": {
+                      k: int(n) for k, n in sorted(keys.items())},
+                  "agreement_rounds": int(rounds)},
+        conf_key=conf_key,
+        remediation=("diff the named conf key (and the full "
+                     "spark.shuffle.tpu.* block) across processes — "
+                     "every process must launch with identical shuffle "
+                     "conf; if confs match, the divergence payload in "
+                     "the AgreementDivergenceError names the dissenting "
+                     "processes and their proposals — look for "
+                     "non-deterministic inputs (unsorted dict/set "
+                     "iteration, locale, per-host seeds) feeding the "
+                     "agreed decision on those hosts"))]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
@@ -2019,7 +2108,7 @@ _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_quota_starvation, _rule_slow_tier,
           _rule_slo_burn, _rule_latency_trend, _rule_spill_bound,
           _rule_dark_time, _rule_phase_regression,
-          _rule_peer_unresponsive, _rule_clock_drift)
+          _rule_peer_unresponsive, _rule_clock_drift, _rule_desync)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

@@ -83,6 +83,17 @@ C_PROBE_DEAD = "failure.probe.dead"            # devices a probe found dead
 C_REPLAYS = "shuffle.replay.count"             # exchange replays executed
 C_REPLAY_MS = "shuffle.replay.ms"              # wall burned by failed tries
 
+# Agreement plane (shuffle/agreement.py): cross-process agreement rounds
+# executed and typed divergence verdicts raised. Divergence carries a
+# labeled twin {topic=...} so the doctor's desync rule can name the
+# offending round (and map it to the conf key that governs it) without
+# parsing error strings. Like C_PEER_TIMEOUT, C_AGREE_DIVERGENCE is
+# never noise: a divergence is a real configuration/state split by
+# construction (the primitive already filtered transport flakes through
+# the watchdog-fenced channel).
+C_AGREE_ROUNDS = "shuffle.agreement.rounds.count"
+C_AGREE_DIVERGENCE = "shuffle.agreement.divergence.count"
+
 # Integrity-plane counters (shuffle/integrity.py, shuffle/manager.py
 # verify paths, shuffle/durable.py restart scan): ONE place for the
 # names so the verifiers, the doctor's block_corruption rule and the

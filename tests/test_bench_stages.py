@@ -398,8 +398,13 @@ def test_tenancy_measure_small(mesh8):
     assert set(rec["checks"]) == {
         "minnow_isolation", "whale_completes", "whale_within_deadline",
         "starved_cell_fires", "fair_cell_quiet",
-        "per_tenant_counters_present"}
+        "per_tenant_counters_present", "distributed_plane"}
     assert rec["isolation_ratio"] > 0
+    # the distributed K-worker code-path cell rides every tenancy run:
+    # workers kept, agreed submission order deterministic, no divergence
+    dist = rec["distributed"]
+    assert dist["workers"] == 4
+    assert all(dist["checks"].values()), dist["checks"]
 
 
 def test_backend_preflight_stamps_artifacts(tmp_path):

@@ -1,0 +1,499 @@
+"""Distributed-plane parity — the agreement primitive and the five
+rebuilt multi-host paths (shuffle/agreement.py + the split-tier
+distributed exchange).
+
+Single-process SPMD discipline: at nproc=1 every allgather degenerates
+to identity, so the DISTRIBUTED code paths (agreement rounds, split-tier
+programs, collective replay, agreed async order) execute end to end with
+real collectives — the fixture flips ``node.is_distributed`` on a
+started node, the same routing the multi-process cluster harness
+(buildlib/e2e_worker.py job 10) exercises for real. Divergence shapes
+(which CANNOT occur at nproc=1) are driven through a stubbed allgather
+channel that replays a 3-process gather with one dissenter."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from sparkucx_tpu.config import TpuShuffleConf
+from sparkucx_tpu.shuffle import agreement
+from sparkucx_tpu.shuffle.agreement import (AgreementDivergenceError,
+                                            agree, current_round,
+                                            reset_epoch)
+from sparkucx_tpu.shuffle.writer import _hash32_np
+
+
+def _conf(extra=None):
+    m = {"spark.shuffle.tpu.a2a.impl": "dense",
+         "spark.shuffle.tpu.mesh.numSlices": "2"}
+    m.update(extra or {})
+    return TpuShuffleConf(m, use_env=False)
+
+
+def partition_of(keys, R):
+    return (_hash32_np(np.asarray(keys)) % np.uint32(R)).astype(np.int64)
+
+
+def _check_parts(res, ak, R=8):
+    parts = partition_of(ak, R)
+    for r in range(R):
+        k, _ = res.partition(r)
+        assert sorted(k.tolist()) == sorted(ak[parts == r].tolist())
+
+
+# -- the agreement primitive ------------------------------------------------
+def test_agree_identity_and_sequencing_single_process():
+    """nproc=1: agree() is identity on the payload, the (epoch, seq)
+    stream advances per round and resets at an epoch bump — the
+    lockstep invariant every client leans on."""
+    reset_epoch(7)
+    assert current_round() == (7, 0)
+    out = agree("parity.unit", [3, 1, 4, 1, 5])
+    assert out.tolist() == [3, 1, 4, 1, 5] and out.dtype == np.int64
+    assert current_round() == (7, 1)
+    agree("parity.unit", [2])
+    assert current_round() == (7, 2)
+    reset_epoch(8)
+    assert current_round() == (8, 0)
+
+
+def test_agree_reductions_single_process():
+    reset_epoch(0)
+    assert agree("parity.red", [5, 2], reduce="max").tolist() == [5, 2]
+    assert agree("parity.red", [5, 2], reduce="min").tolist() == [5, 2]
+    assert agree("parity.red", [5, 2], reduce="sum").tolist() == [5, 2]
+    assert agree("parity.red", [0, 3], reduce="any").tolist() == [0, 1]
+    assert agree("parity.red", [0, 3], reduce="all").tolist() == [0, 1]
+    got = agree("parity.red", [4, 6], reduce=lambda rows: rows[0] * 2)
+    assert got.tolist() == [8, 12]
+    with pytest.raises(ValueError, match="agreement reduction"):
+        agree("parity.red", [1], reduce="median")
+
+
+class _FakeGather:
+    """Replays a 3-process allgather on the agreement channel: the
+    header round echoes identically (every process entered the same
+    round) unless ``mutate_header``; the payload round stacks
+    [mine, mine, mutate(mine)] so process 2 dissents."""
+
+    def __init__(self, mutate=None, mutate_header=None):
+        self.mutate = mutate
+        self.mutate_header = mutate_header
+
+    def __call__(self, payload, what="", timeout_ms=None):
+        mine = np.asarray(payload)
+        rows = [mine, mine, mine.copy()]
+        if what.startswith("agreement header"):
+            if self.mutate_header is not None:
+                rows[2] = self.mutate_header(mine.copy())
+        elif self.mutate is not None:
+            rows[2] = self.mutate(mine.copy())
+        return np.stack(rows)
+
+
+def test_agree_value_divergence_names_dissenter(monkeypatch):
+    from sparkucx_tpu.shuffle import distributed as dist
+    reset_epoch(0)
+
+    def bump(row):
+        row[0] += 9
+        return row
+
+    monkeypatch.setattr(dist, "allgather_blob", _FakeGather(mutate=bump))
+    with pytest.raises(AgreementDivergenceError) as ei:
+        agree("a2a.waveRows", [12, 40],
+              conf_key="spark.shuffle.tpu.a2a.waveRows")
+    e = ei.value
+    assert e.topic == "a2a.waveRows" and e.kind == "value"
+    assert e.dissenters == [2]
+    assert e.proposals[2] == [21, 40] and e.proposals[0] == [12, 40]
+    assert "spark.shuffle.tpu.a2a.waveRows" in str(e)
+    assert "process(es) [2]" in str(e)
+
+
+def test_agree_sequencing_divergence_from_header(monkeypatch):
+    """A process entering a DIFFERENT round (stale seq — the missed-
+    remesh / divergent-conf shape) raises typed from the fixed-shape
+    header round, before payload shapes could wedge the transport."""
+    from sparkucx_tpu.shuffle import distributed as dist
+    reset_epoch(3)
+
+    def stale_seq(row):
+        row[1] += 1        # header = [epoch, seq, topic, len, reduce]
+        return row
+
+    monkeypatch.setattr(dist, "allgather_blob",
+                        _FakeGather(mutate_header=stale_seq))
+    with pytest.raises(AgreementDivergenceError) as ei:
+        agree("hier.dcn.regrow", [256],
+              conf_key="spark.shuffle.tpu.a2a.capacityFactor")
+    e = ei.value
+    assert e.kind == "sequencing" and e.dissenters == [2]
+    assert "different agreement rounds" in str(e)
+    assert "capacityFactor" in str(e)
+
+
+def test_agree_divergence_reduction_rounds_never_diverge(monkeypatch):
+    """Reduced rounds (overflow any, batch min) accept legitimately
+    different proposals — only unanimity rounds can split on values."""
+    from sparkucx_tpu.shuffle import distributed as dist
+    reset_epoch(0)
+
+    def flip(row):
+        row[0] = 1 - row[0]
+        return row
+
+    monkeypatch.setattr(dist, "allgather_blob", _FakeGather(mutate=flip))
+    assert agree("hier.ici.overflow", [0], reduce="any").tolist() == [1]
+    assert agree("hier.ici.overflow", [0], reduce="all").tolist() == [0]
+
+
+def test_agree_threads_tear_no_frames():
+    """The (epoch, seq) read-modify-write is lock-covered: concurrent
+    agree() calls (async dispatcher thread + main) never reuse a
+    sequence number."""
+    reset_epoch(0)
+    n, per = 4, 25
+    done = []
+
+    def worker():
+        for _ in range(per):
+            agree("parity.thread", [1], reduce="sum")
+        done.append(1)
+
+    ts = [threading.Thread(target=worker) for _ in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(done) == n
+    assert current_round() == (0, n * per)
+
+
+# -- the distributed read path (nproc=1, is_distributed forced) -------------
+@pytest.fixture(scope="module")
+def dist_mgr():
+    from sparkucx_tpu.runtime.node import TpuNode
+    from sparkucx_tpu.shuffle.manager import TpuShuffleManager
+    conf = _conf()
+    node = TpuNode.start(conf)
+    mgr = TpuShuffleManager(node, conf)
+    # route every read/submit through the DISTRIBUTED arm (allgathers
+    # degenerate to identity at nproc=1; the agreement rounds, split-tier
+    # programs and partial-view results all run for real)
+    node.is_distributed = True
+    yield node, mgr
+    node.is_distributed = False
+    mgr.stop()
+    node.close()
+
+
+def _stage(mgr, sid, rng, M=4, R=8, rows=110, values=False):
+    h = mgr.register_shuffle(sid, M, R)
+    ks, vs = [], []
+    for m in range(M):
+        w = mgr.get_writer(h, m)
+        k = rng.integers(0, 1 << 18, size=rows)
+        if values:
+            v = rng.random((rows, 1), dtype=np.float32)
+            w.write(k, v)
+            vs.append(v)
+        else:
+            w.write(k)
+        w.commit(R)
+        ks.append(k)
+    return h, np.concatenate(ks), (np.concatenate(vs) if values else None)
+
+
+def _base_invariants(rep, sink="host"):
+    assert rep.distributed and rep.hierarchical and rep.completed
+    assert [t["tier"] for t in rep.tiers] == ["ici", "dcn"]
+    assert rep.sink == sink
+    for t in rep.tiers:
+        assert t["ms"] > 0          # per-tier walls measured, per stage
+
+
+def test_distributed_tiered_plain_exact_cross_rows(dist_mgr, rng):
+    """The headline parity cell: a distributed hierarchical read runs
+    the split-tier programs, lands oracle partitions, and stamps EXACT
+    cross-fabric rows (the agreed [P, P] matrix summed from every
+    process's local registry rows — gap 5, replacing the every-row
+    upper bound)."""
+    node, mgr = dist_mgr
+    assert mgr.hierarchical and mgr.topology.kind == "hier"
+    h, ak, _ = _stage(mgr, 901, rng)
+    res = mgr.read(h)
+    _check_parts(res, ak)
+    rep = mgr.report(901)
+    _base_invariants(rep)
+    ici, dcn = rep.tiers
+    assert ici["cross_exact"] and dcn["cross_exact"]
+    from sparkucx_tpu.shuffle.reader import _blocked_map
+    M, rows, R = 4, 110, 8
+    parts = partition_of(ak, R)
+    src_dev = np.concatenate([np.full(rows, m % 8) for m in range(M)])
+    dst_dev = np.asarray(_blocked_map(R, 8))[parts]
+    assert dcn["payload_rows"] == int(
+        ((src_dev // 4) != (dst_dev // 4)).sum())
+    assert ici["payload_rows"] == int(
+        ((src_dev % 4) != (dst_dev % 4)).sum())
+    # the cluster drill's accounting-parity check rides gather_reports
+    # (job 10); at nproc=1 the gather is the identity list
+    reps = mgr.gather_reports(901)
+    assert len(reps) == 1 and reps[0].get("tiers")
+    mgr.unregister_shuffle(901)
+
+
+def test_distributed_warm_read_zero_recompiles(dist_mgr, rng):
+    """Warm distributed reads reuse the SAME per-tier compiled programs
+    (the structural stage-cache key over node.mesh): the second read of
+    a shape family compiles nothing."""
+    node, mgr = dist_mgr
+    h, ak, _ = _stage(mgr, 902, rng)
+    mgr.read(h)
+    mgr.unregister_shuffle(902)
+    h2, ak2, _ = _stage(mgr, 903, rng)
+    res = mgr.read(h2)
+    _check_parts(res, ak2)
+    rep = mgr.report(903)
+    _base_invariants(rep)
+    assert rep.stepcache_programs == 0
+    assert rep.stepcache_hits > 0
+    mgr.unregister_shuffle(903)
+
+
+def test_distributed_combine_host_and_device(dist_mgr, rng):
+    """Device combine over the distributed tiered path (gap 2):
+    combine=sum lands fully merged under both sinks; the device sink
+    reports ZERO payload D2H before any partition is touched."""
+    node, mgr = dist_mgr
+    R, M, rows = 8, 4, 100
+    for sid, sink in ((904, "host"), (905, "device")):
+        h = mgr.register_shuffle(sid, M, R)
+        want = {}
+        for m in range(M):
+            w = mgr.get_writer(h, m)
+            k = (np.arange(m * rows, (m + 1) * rows) % 64).astype(
+                np.int64)
+            v = np.ones((rows, 1), np.float32)
+            w.write(k, v)
+            w.commit(R)
+            for kk in k:
+                want[int(kk)] = want.get(int(kk), 0.0) + 1.0
+        res = mgr.read(h, combine="sum", sink=sink)
+        rep = mgr.report(sid)
+        _base_invariants(rep, sink=sink)
+        if sink == "device":
+            # the zero-payload-D2H criterion, BEFORE any host drain
+            assert rep.d2h_bytes == 0
+            res = res.host_view()
+        got = {}
+        for r in range(R):
+            k, v = res.partition(r)
+            for a, b in zip(k, v[:, 0]):
+                got[int(a)] = float(b)
+        assert got == want
+        mgr.unregister_shuffle(sid)
+
+
+def test_distributed_plain_device_sink_zero_d2h(dist_mgr, rng):
+    """read.sink=device is legal distributed (gap 2): the payload stays
+    sharded, the report says sink=device / d2h_bytes=0, and the
+    escape-hatch host view is oracle-exact."""
+    node, mgr = dist_mgr
+    h, ak, av = _stage(mgr, 906, rng, values=True)
+    res = mgr.read(h, sink="device")
+    rep = mgr.report(906)
+    _base_invariants(rep, sink="device")
+    assert rep.d2h_bytes == 0
+    _check_parts(res.host_view(), ak)
+    mgr.unregister_shuffle(906)
+
+
+def test_distributed_ordered_read(dist_mgr, rng):
+    """ordered=True on the distributed tiered path: partitions come back
+    key-sorted, same oracle multiset."""
+    node, mgr = dist_mgr
+    h, ak, _ = _stage(mgr, 907, rng)
+    res = mgr.read(h, ordered=True)
+    rep = mgr.report(907)
+    _base_invariants(rep)
+    R = 8
+    parts = partition_of(ak, R)
+    for r in range(R):
+        k, _ = res.partition(r)
+        assert sorted(k.tolist()) == sorted(ak[parts == r].tolist())
+        assert (np.diff(k) >= 0).all()
+    mgr.unregister_shuffle(907)
+
+
+@pytest.mark.slow
+def test_distributed_int8_wire(dist_mgr, rng):
+    """a2a.wire=int8 rides the split-tier distributed path: keys exact,
+    values within quantization tolerance, resolved wire on the report."""
+    node, mgr = dist_mgr
+    old = mgr.conf.get("spark.shuffle.tpu.a2a.wire")
+    mgr.conf.set("spark.shuffle.tpu.a2a.wire", "int8")
+    try:
+        h, ak, av = _stage(mgr, 908, rng, values=True)
+        res = mgr.read(h)
+        rep = mgr.report(908)
+        _base_invariants(rep)
+        assert rep.wire == "int8"
+        R = 8
+        parts = partition_of(ak, R)
+        order = np.argsort(ak, kind="stable")
+        for r in range(R):
+            k, v = res.partition(r)
+            assert sorted(k.tolist()) == sorted(
+                ak[parts == r].tolist())
+            want = av[parts == r]
+            assert v.shape[0] == want.shape[0]
+            # int8 wire: relative error bounded by the per-block scale
+            assert float(np.abs(np.sort(v[:, 0]) -
+                                np.sort(want[:, 0])).max()) < 0.05
+    finally:
+        mgr.conf.set("spark.shuffle.tpu.a2a.wire",
+                     old if old is not None else "raw")
+    mgr.unregister_shuffle(908)
+
+
+@pytest.mark.slow
+def test_distributed_waved_read(dist_mgr, rng):
+    """Waves are legal distributed+hierarchical (the _waves_eligible
+    lift): each wave dispatches the split-tier program, per-wave
+    agreement rounds bound occupancy, the report carries the wave
+    timeline plus summed per-tier accounting."""
+    node, mgr = dist_mgr
+    old = mgr.conf.get("spark.shuffle.tpu.a2a.waveRows")
+    mgr.conf.set("spark.shuffle.tpu.a2a.waveRows", "64")
+    try:
+        h, ak, _ = _stage(mgr, 909, rng, rows=120)
+        res = mgr.read(h)
+        _check_parts(res, ak)
+        rep = mgr.report(909)
+        assert rep.distributed and rep.hierarchical and rep.completed
+        assert rep.waves >= 2 and len(rep.wave_timeline) == rep.waves
+        assert [t["tier"] for t in rep.tiers] == ["ici", "dcn"]
+        assert sum(rep.wave_payload_rows) == 4 * 120
+    finally:
+        mgr.conf.set("spark.shuffle.tpu.a2a.waveRows",
+                     old if old is not None else "0")
+    mgr.unregister_shuffle(909)
+
+
+def test_distributed_dcn_deadline_names_tier(dist_mgr, rng):
+    """Per-stage deadlines on the DISTRIBUTED path (gap 1): a wedged
+    DCN stage expires its OWN fence — PeerLostError names the dcn tier
+    while the ICI stage already completed under its deadline."""
+    from sparkucx_tpu.runtime.failures import PeerLostError
+    node, mgr = dist_mgr
+    old = mgr.conf.get("spark.shuffle.tpu.failure.dcn.timeoutMs")
+    mgr.conf.set("spark.shuffle.tpu.failure.dcn.timeoutMs", "150")
+    try:
+        h, _, _ = _stage(mgr, 910, rng, rows=40)
+        node.faults.arm("tier.dcn", delay_ms=1200)
+        with pytest.raises(PeerLostError, match="dcn"):
+            mgr.read(h)
+    finally:
+        node.faults.disarm("tier.dcn")
+        mgr.conf.set("spark.shuffle.tpu.failure.dcn.timeoutMs",
+                     old if old is not None else "0")
+    mgr.unregister_shuffle(910)
+
+
+def test_distributed_collective_replay_one_budget_unit(dist_mgr, rng):
+    """Gap 3: under failure.policy=replay a distributed transient fault
+    replays GROUP-WIDE — survivors agree to re-enter (replay.enter),
+    the read recovers to oracle bytes, and exactly ONE budget unit is
+    spent."""
+    node, mgr = dist_mgr
+    old_policy = mgr._policy
+    mgr._policy = "replay"
+    try:
+        h, ak, _ = _stage(mgr, 911, rng, rows=60)
+        node.faults.arm("exchange", fail_count=1)
+        res = mgr.read(h)
+        _check_parts(res, ak)
+        rep = mgr.report(911)
+        _base_invariants(rep)
+        assert rep.replays == 1 and rep.replay_ms > 0
+        assert mgr._replay_counts.get(911, 0) == 1   # ONE unit, group-wide
+    finally:
+        node.faults.disarm("exchange")
+        mgr._policy = old_policy
+    mgr.unregister_shuffle(911)
+
+
+def test_distributed_replay_vetoed_on_divergence(dist_mgr, rng,
+                                                monkeypatch):
+    """A dissenting replay.enter round (divergent replayBudget) VETOES
+    the group replay — the read fails typed instead of half the group
+    re-entering the collective."""
+    from sparkucx_tpu.runtime.failures import InjectedFault
+    from sparkucx_tpu.shuffle import distributed as dist
+    node, mgr = dist_mgr
+    old_policy = mgr._policy
+    mgr._policy = "replay"
+
+    real = dist.allgather_blob
+
+    def gather(payload, what="", timeout_ms=None):
+        if "replay.enter" in what:
+            mine = np.asarray(payload)
+            other = mine.copy()
+            other[-1] += 1           # peer believes a different budget
+            return np.stack([mine, other])
+        return real(payload, what=what, timeout_ms=timeout_ms)
+
+    try:
+        h, ak, _ = _stage(mgr, 912, rng, rows=40)
+        monkeypatch.setattr(dist, "allgather_blob", gather)
+        node.faults.arm("exchange", fail_count=1)
+        with pytest.raises(InjectedFault):
+            mgr.read(h)
+        assert mgr._replay_counts.get(912, 0) == 0   # no unit burned
+    finally:
+        node.faults.disarm("exchange")
+        mgr._policy = old_policy
+    mgr.unregister_shuffle(912)
+
+
+# -- K-worker agreed submission order ---------------------------------------
+def test_agreed_order_identical_across_processes():
+    """The async plane's global order (gap 4) is a pure function of the
+    agreed batch: every process computes the SAME DRR interleave from
+    the same (seq, tenant) pairs — byte-identical across 'processes'
+    and deterministic across repeats."""
+    from sparkucx_tpu.shuffle.tenancy import agreed_submission_order
+    batch = [(0, "whale"), (1, "minnow"), (2, "whale"), (3, "whale"),
+             (4, "minnow"), (5, "crab")]
+    weights = {"whale": 2, "minnow": 1, "crab": 1}
+    orders = [agreed_submission_order(list(batch),
+                                      lambda t: weights[t])
+              for _ in range(3)]        # three simulated processes
+    assert orders[0] == orders[1] == orders[2]
+    order = orders[0]
+    assert sorted(order) == [0, 1, 2, 3, 4, 5]
+    # DRR: whale (weight 2) drains two reads per round, FIFO within
+    # tenant, round-robin in first-appearance order; crab's only read
+    # lands in round 1, whale's tail and minnow's drain in round 2
+    assert order == [0, 2, 1, 5, 3, 4]
+
+
+def test_agreed_batch_bound_is_min_over_processes(monkeypatch):
+    """The per-batch agreement bounds the dispatch to the SLOWEST
+    process's pending count (reduce=min) so no process dispatches a
+    read a peer has not yet enqueued."""
+    from sparkucx_tpu.shuffle import distributed as dist
+    reset_epoch(0)
+
+    def fewer(row):
+        row[0] = 2
+        return row
+
+    monkeypatch.setattr(dist, "allgather_blob", _FakeGather(mutate=fewer))
+    n = agree("async.batch", [5], reduce="min",
+              conf_key="spark.shuffle.tpu.tenant.asyncAgreedOrder")
+    assert n.tolist() == [2]

@@ -1670,3 +1670,70 @@ def test_spill_bound_sub_noise_floors():
                        exchange_ms=500.0, merge_ms=100.0, rows=200.0)
     assert [f for f in diagnose(doc2)
             if f.rule == "spill_bound"] == []
+
+
+# -- desync (agreement divergence) ----------------------------------------
+def test_desync_fires_on_single_divergence():
+    """ONE divergence is already a warn — the agree() fence means a
+    non-unanimous round is a conf split or broken SPMD determinism,
+    never load noise (the peer_timeout posture: no noise floor) — and
+    the topic maps to the conf key whose split is the usual cause."""
+    from sparkucx_tpu.utils.metrics import (C_AGREE_DIVERGENCE,
+                                            C_AGREE_ROUNDS, labeled)
+    doc = _healthy_doc()
+    doc["counters"][C_AGREE_ROUNDS] = 40.0
+    doc["counters"][C_AGREE_DIVERGENCE] = 1.0
+    doc["counters"][labeled(C_AGREE_DIVERGENCE,
+                            topic="hier.dcn.regrow")] = 1.0
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["desync"]
+    f = fs[0]
+    assert f.grade == "warn"
+    assert f.evidence["divergences"] == 1
+    assert f.evidence["by_topic"] == {"hier.dcn.regrow": 1}
+    assert f.evidence["agreement_rounds"] == 40
+    assert f.conf_key == "spark.shuffle.tpu.a2a.capacityFactor"
+    assert "identical" in f.summary
+    assert "conf" in f.remediation
+
+
+def test_desync_critical_and_dominant_topic_conf_key():
+    # repeats are systematic: critical, and the finding charges the
+    # DOMINANT topic's conf key while every implicated key rides in
+    # the evidence
+    from sparkucx_tpu.utils.metrics import C_AGREE_DIVERGENCE, labeled
+    doc = _healthy_doc()
+    doc["counters"][C_AGREE_DIVERGENCE] = 3.0
+    doc["counters"][labeled(C_AGREE_DIVERGENCE,
+                            topic="async.order")] = 2.0
+    doc["counters"][labeled(C_AGREE_DIVERGENCE,
+                            topic="a2a.waveRows")] = 1.0
+    fs = diagnose(doc)
+    assert _rules_of(fs) == ["desync"]
+    f = fs[0]
+    assert f.grade == "critical"
+    assert f.conf_key == "spark.shuffle.tpu.tenant.asyncAgreedOrder"
+    assert f.evidence["implicated_conf_keys"] == {
+        "spark.shuffle.tpu.tenant.asyncAgreedOrder": 2,
+        "spark.shuffle.tpu.a2a.waveRows": 1,
+    }
+    assert "async.order×2" in f.summary
+
+
+def test_desync_quiet_goldens():
+    """Rounds without divergence are the HEALTHY distributed signal —
+    heavy agreement traffic alone never fires (the rule has no noise
+    floor because unanimity already is the filter); an unmapped topic
+    still fires but charges the conf wildcard."""
+    from sparkucx_tpu.utils.metrics import (C_AGREE_DIVERGENCE,
+                                            C_AGREE_ROUNDS, labeled)
+    doc = _healthy_doc()
+    doc["counters"][C_AGREE_ROUNDS] = 5000.0
+    assert diagnose(doc) == []
+    doc2 = _healthy_doc()
+    doc2["counters"][C_AGREE_DIVERGENCE] = 1.0
+    doc2["counters"][labeled(C_AGREE_DIVERGENCE,
+                             topic="exotic.topic")] = 1.0
+    fs = diagnose(doc2)
+    assert _rules_of(fs) == ["desync"]
+    assert fs[0].conf_key == "spark.shuffle.tpu.*"

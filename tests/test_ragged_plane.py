@@ -133,15 +133,26 @@ def test_agree_wave_sizes_single_process_identity():
     assert got.tolist() == [96, 96, 13]
 
 
+def _divergent_allgather(mutate):
+    """Simulated 2-process channel for the agreement clients: the fixed
+    header round (shuffle/agreement.py round 1) echoes identically —
+    both processes entered the SAME round — and the payload round
+    diverges by ``mutate``, producing a typed value split."""
+    def stub(blob, what="", timeout_ms=None):
+        row = np.asarray(blob).reshape(-1)
+        if what.startswith("agreement header"):
+            return np.stack([row, row])
+        return np.stack([row, mutate(row)])
+    return stub
+
+
 def test_agree_wave_sizes_divergent_view_fails_fast(monkeypatch):
     """A process whose occupancy view differs (stale size row) must raise
     — on every process, since the verdict rides the allgather. Simulated
     here by stubbing the allgather to return divergent proposals."""
     import sparkucx_tpu.shuffle.distributed as dist
-    monkeypatch.setattr(
-        dist, "allgather_blob",
-        lambda blob: np.stack([np.asarray(blob),
-                               np.asarray(blob) + 1]))
+    monkeypatch.setattr(dist, "allgather_blob",
+                        _divergent_allgather(lambda row: row + 1))
     with pytest.raises(RuntimeError, match="per-wave occupancy mismatch"):
         dist.agree_wave_sizes(np.asarray([96, 96, 13]))
 
@@ -150,10 +161,8 @@ def test_agree_wave_count_divergent_conf_fails_fast(monkeypatch):
     """The wave-COUNT agreement (runs on every distributed read) raises
     on divergent a2a.waveRows conf the same way."""
     import sparkucx_tpu.shuffle.distributed as dist
-    monkeypatch.setattr(
-        dist, "allgather_blob",
-        lambda blob: np.stack([np.asarray(blob).reshape(-1),
-                               np.asarray(blob).reshape(-1) * 2]))
+    monkeypatch.setattr(dist, "allgather_blob",
+                        _divergent_allgather(lambda row: row * 2))
     with pytest.raises(RuntimeError, match="wave-count mismatch"):
         dist.agree_wave_count(3)
 

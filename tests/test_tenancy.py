@@ -515,16 +515,32 @@ def test_async_stop_wakes_capped_submitter():
     assert "stopped" in box.get("err", "")
 
 
-def test_async_distributed_forces_single_worker():
+def test_async_distributed_keeps_k_workers_with_agreed_order():
+    """Distributed async keeps the conf'd worker count (the agreed-
+    order dispatcher aligns the collective order); the historical
+    width-1 clamp survives behind tenant.asyncAgreedOrder=false."""
     reg = TenantRegistry(_conf())
     ex = AsyncShuffleExecutor(
         _conf({"spark.shuffle.tpu.tenant.asyncWorkers": "8"}),
         reg, Metrics(), distributed=True)
-    assert ex.workers == 1          # collective order == submission order
+    assert ex.workers == 8
+    assert ex._dispatching
     ex_local = AsyncShuffleExecutor(
         _conf({"spark.shuffle.tpu.tenant.asyncWorkers": "8"}),
         reg, Metrics(), distributed=False)
     assert ex_local.workers == 8
+    assert not ex_local._dispatching
+    ex.stop()
+    ex_local.stop()
+
+
+def test_async_distributed_opt_out_clamps_single_worker():
+    reg = TenantRegistry(_conf())
+    ex = AsyncShuffleExecutor(
+        _conf({"spark.shuffle.tpu.tenant.asyncWorkers": "8",
+               "spark.shuffle.tpu.tenant.asyncAgreedOrder": "false"}),
+        reg, Metrics(), distributed=True)
+    assert ex.workers == 1          # collective order == submission order
     # FIFO execution on the single worker: completion order == submit
     # order even when the first task is the slowest
     order = []
@@ -539,9 +555,40 @@ def test_async_distributed_forces_single_worker():
         f.result(30)
     assert order == [0, 1, 2]
     ex.stop()
-    ex_local.stop()
     with pytest.raises(RuntimeError, match="stopped"):
         ex.submit(lambda: None, None, 9)
+
+
+def test_async_agreed_order_dispatch_single_process():
+    """The agreed-order dispatcher end to end at nproc=1: the agreement
+    rounds degenerate to identity, reads execute in the agreed DRR
+    order, futures resolve with results."""
+    reg = TenantRegistry(_conf())
+    ex = AsyncShuffleExecutor(
+        _conf({"spark.shuffle.tpu.tenant.asyncWorkers": "4"}),
+        reg, Metrics(), distributed=True)
+    assert ex._dispatching
+    futs = [ex.submit(lambda i=i: i * 10, None, i) for i in range(5)]
+    assert [f.result(30) for f in futs] == [0, 10, 20, 30, 40]
+    ex.stop()
+
+
+def test_agreed_submission_order_deterministic_drr():
+    """agreed_submission_order is a pure function of the batch: two
+    simulated processes holding the same (seq, tenant) pairs compute
+    the identical dispatch order, with weight-proportional interleave
+    (high=4 reads per round vs batch=1) and FIFO within a tenant."""
+    from sparkucx_tpu.shuffle.tenancy import agreed_submission_order
+    weights = {"hi": 4, "lo": 1}
+    pending = [(1, "lo"), (2, "hi"), (3, "hi"), (4, "hi"),
+               (5, "hi"), (6, "hi"), (7, "lo")]
+    a = agreed_submission_order(pending, lambda t: weights[t])
+    b = agreed_submission_order(list(pending), lambda t: weights[t])
+    assert a == b                           # simulated-process parity
+    assert sorted(a) == [1, 2, 3, 4, 5, 6, 7]
+    # lo arrived first -> one read (weight 1), then hi's 4-read round
+    assert a[:5] == [1, 2, 3, 4, 5]
+    assert a.index(2) < a.index(3) < a.index(4)   # FIFO within hi
 
 
 # -- satellite: concurrent facade access sweep ------------------------------
