@@ -98,6 +98,7 @@ class TpuShuffleConf:
         "mesh_ici_axis", "mesh_dcn_axis", "num_slices", "num_processes",
         "cores_per_process", "connection_timeout_ms",
         "collective_timeout_ms", "ici_timeout_ms", "dcn_timeout_ms",
+        "replay_agree_timeout_ms",
         "failure_policy", "replay_budget",
         "max_backoff_ms", "integrity_verify", "ledger_dir")
     # Namespace keys consumed OUTSIDE config.py (grep-verified), plus the
@@ -816,6 +817,25 @@ class TpuShuffleConf:
         doctor and the operator tell an ICI straggler from a DCN one.
         Defaults to ``failure.collectiveTimeoutMs`` (0 = off)."""
         return self._tier_timeout("dcn")
+
+    @property
+    def replay_agree_timeout_ms(self) -> float:
+        """Deadline on the collective replay-entry round
+        (``agree("replay.enter")``, shuffle/manager.py): survivors of a
+        transient fault agree to re-enter the exchange together — but a
+        peer whose read SUCCEEDED (or failed with a different error
+        class) never enters the round, so the replaying processes would
+        otherwise stall the full ``failure.collectiveTimeoutMs`` before
+        PeerLostError converts the replay into failfast. Set this lower
+        to bound that stall on partial-failure shapes. Defaults to
+        ``failure.collectiveTimeoutMs`` (0 = off)."""
+        v = self.get_float("failure.replayAgreeTimeoutMs",
+                           self.collective_timeout_ms)
+        if v < 0:
+            raise ValueError(
+                f"spark.shuffle.tpu.failure.replayAgreeTimeoutMs={v}: "
+                f"want >= 0 (0 = off)")
+        return v
 
     @property
     def failure_policy(self) -> str:

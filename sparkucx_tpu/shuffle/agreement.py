@@ -105,9 +105,17 @@ class AgreementDivergenceError(RuntimeError):
 # -- epoch-scoped sequencing state -----------------------------------------
 # One (epoch, seq) stream per process; identical on every process by the
 # SPMD lockstep (every process enters the same agree() calls in the same
-# order). The lock covers the read-modify-write so an async worker thread
-# and the main thread can never tear a frame's sequence number.
+# order). _LOCK covers the counter read-modify-write; _ROUND_LOCK is the
+# agreement-plane mutex held across an ENTIRE round (seq assignment plus
+# both allgathers), so two threads can never interleave the header and
+# payload gathers of distinct rounds — without it, process A could pair
+# thread X's header with thread Y's payload while process B pairs them
+# the other way, and a healthy cluster would read as a sequencing split.
+# The mutex makes rounds atomic per process; WHICH thread's round goes
+# first must still be cross-process deterministic — that ordering is the
+# CollectiveTurnstile's job (the async plane's agreed ticket order).
 _LOCK = threading.Lock()
+_ROUND_LOCK = threading.RLock()
 _STATE = {"epoch": 0, "seq": 0}
 
 
@@ -188,54 +196,136 @@ def agree(topic: str, payload, reduce: Optional[Union[str, Callable]]
                 f"unknown agreement reduction {reduce!r}; want one of "
                 f"{sorted(_REDUCERS)} or a callable")
         reduce_code = _REDUCE_CODES[reduce or "unanimous"]
-    with _LOCK:
-        epoch, seq = _STATE["epoch"], _STATE["seq"]
-        _STATE["seq"] += 1
     m = metrics if metrics is not None else GLOBAL_METRICS
-    try:
-        m.inc(C_AGREE_ROUNDS, 1.0)
-    except Exception:
-        pass
+    # The round is ATOMIC per process: seq assignment and both
+    # allgathers run under the agreement-plane mutex, so a concurrent
+    # agree() from another thread can neither steal this round's seq
+    # nor slot its own allgather between this round's header and
+    # payload. (Cross-thread SCHEDULING order is the caller's contract
+    # — the async plane routes through a CollectiveTurnstile so the
+    # acquisition order here is the agreed ticket order everywhere.)
+    with _ROUND_LOCK:
+        with _LOCK:
+            epoch, seq = _STATE["epoch"], _STATE["seq"]
+            _STATE["seq"] += 1
+        try:
+            m.inc(C_AGREE_ROUNDS, 1.0)
+        except Exception:
+            pass
 
-    # Round 1: the fixed-shape header — epoch, sequence, topic, payload
-    # length, reduction. Fixed [5] on every process by construction, so
-    # this round can NEVER shape-mismatch; it catches the sequencing
-    # splits (different round entered) BEFORE the variable-length
-    # payload round could wedge the transport on mismatched shapes.
-    header = np.array([epoch, seq, _topic_code(topic), mine.shape[0],
-                       reduce_code], dtype=np.int64)
-    got_h = np.asarray(allgather_blob(
-        header, what=f"agreement header {topic!r} #{seq}",
-        timeout_ms=timeout_ms)).reshape(-1, 5)
-    if (got_h != got_h[0]).any():
-        maj = _majority_row(got_h)
-        dissent = [i for i in range(got_h.shape[0])
-                   if (got_h[i] != maj).any()]
-        _note_divergence(topic, m)
-        raise AgreementDivergenceError(
-            topic, "sequencing", dissent,
-            [r.tolist() for r in got_h], conf_key=conf_key,
-            detail="processes entered different agreement rounds "
-                   "(header = [epoch, seq, topic, len, reduce]) — a "
-                   "divergent conf or a missed remesh")
+        # Round 1: the fixed-shape header — epoch, sequence, topic,
+        # payload length, reduction. Fixed [5] on every process by
+        # construction, so this round can NEVER shape-mismatch; it
+        # catches the sequencing splits (different round entered)
+        # BEFORE the variable-length payload round could wedge the
+        # transport on mismatched shapes.
+        header = np.array([epoch, seq, _topic_code(topic), mine.shape[0],
+                           reduce_code], dtype=np.int64)
+        got_h = np.asarray(allgather_blob(
+            header, what=f"agreement header {topic!r} #{seq}",
+            timeout_ms=timeout_ms)).reshape(-1, 5)
+        if (got_h != got_h[0]).any():
+            maj = _majority_row(got_h)
+            dissent = [i for i in range(got_h.shape[0])
+                       if (got_h[i] != maj).any()]
+            _note_divergence(topic, m)
+            raise AgreementDivergenceError(
+                topic, "sequencing", dissent,
+                [r.tolist() for r in got_h], conf_key=conf_key,
+                detail="processes entered different agreement rounds "
+                       "(header = [epoch, seq, topic, len, reduce]) — a "
+                       "divergent conf or a missed remesh")
 
-    # Round 2: the payload, at the agreed length.
-    got = np.asarray(allgather_blob(
-        mine, what=f"agreement {topic!r} #{seq}",
-        timeout_ms=timeout_ms)).reshape(-1, mine.shape[0])
-    if callable(reduce):
-        return np.asarray(reduce(got), dtype=np.int64)
-    if reduce is not None:
-        return _REDUCERS[reduce](got).astype(np.int64)
-    if (got != got[0]).any():
-        maj = _majority_row(got)
-        dissent = [i for i in range(got.shape[0])
-                   if (got[i] != maj).any()]
-        _note_divergence(topic, m)
-        raise AgreementDivergenceError(
-            topic, "value", dissent, [r.tolist() for r in got],
-            conf_key=conf_key)
-    return got[0].copy()
+        # Round 2: the payload, at the agreed length.
+        got = np.asarray(allgather_blob(
+            mine, what=f"agreement {topic!r} #{seq}",
+            timeout_ms=timeout_ms)).reshape(-1, mine.shape[0])
+        if callable(reduce):
+            return np.asarray(reduce(got), dtype=np.int64)
+        if reduce is not None:
+            return _REDUCERS[reduce](got).astype(np.int64)
+        if (got != got[0]).any():
+            maj = _majority_row(got)
+            dissent = [i for i in range(got.shape[0])
+                       if (got[i] != maj).any()]
+            _note_divergence(topic, m)
+            raise AgreementDivergenceError(
+                topic, "value", dissent, [r.tolist() for r in got],
+                conf_key=conf_key)
+        return got[0].copy()
+
+
+class CollectiveTurnstile:
+    """Per-process gate that serializes collective SECTIONS in a
+    cross-process deterministic order.
+
+    The round mutex above makes one agreement round atomic, but a
+    section that issues MANY collectives (a full distributed read:
+    schema gathers, wave agreements, per-tier programs, overflow
+    rounds) must run them all before any other thread's section starts
+    — otherwise process A's scheduler could interleave read X's
+    collectives with read Y's differently than process B's, and the
+    mesh deadlocks on crossed collectives. Tickets are issued in an
+    AGREED order (the async dispatcher issues them from the agreed
+    batch schedule, so ticket k is the same work on every process);
+    ``acquire`` blocks until every earlier ticket has released, which
+    makes the per-process collective stream identical everywhere.
+
+    ``release`` is idempotent and legal out of turn: a ticket whose
+    work was abandoned (dispatch failure, executor stop) marks itself
+    done and the turn skips over it — an abandoned ticket must never
+    wedge the tickets behind it. ``close`` fails all waiters typed
+    (executor shutdown)."""
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._next = 0          # next unissued ticket
+        self._turn = 0          # lowest unreleased ticket
+        self._done = set()      # released out of turn, not yet passed
+        self._closed = False
+
+    def issue(self) -> int:
+        """Take the next ticket. Call in the agreed order (single
+        issuing thread per process — the async dispatcher)."""
+        with self._cv:
+            t = self._next
+            self._next += 1
+            return t
+
+    def acquire(self, ticket: int) -> None:
+        """Block until ``ticket``'s turn. Raises once closed, so a
+        worker parked behind a long section fails typed at shutdown
+        instead of hanging the pool drain."""
+        with self._cv:
+            while True:
+                if self._closed:
+                    raise RuntimeError(
+                        "collective turnstile is closed (executor "
+                        "stopped)")
+                if ticket < self._turn or ticket in self._done:
+                    raise RuntimeError(
+                        f"collective ticket {ticket} was already "
+                        f"released")
+                if self._turn == ticket:
+                    return
+                self._cv.wait(0.2)
+
+    def release(self, ticket: int) -> None:
+        """Mark ``ticket`` done (idempotent, legal before its turn):
+        the turn advances past every consecutive done ticket."""
+        with self._cv:
+            if ticket < self._turn or ticket in self._done:
+                return
+            self._done.add(ticket)
+            while self._turn in self._done:
+                self._done.discard(self._turn)
+                self._turn += 1
+            self._cv.notify_all()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
 
 
 def _note_divergence(topic: str, metrics) -> None:

@@ -701,11 +701,19 @@ class TpuShuffleManager:
                     handle.shuffle_id, 0)
             from sparkucx_tpu.shuffle.agreement import (
                 AgreementDivergenceError, agree)
+            # Dedicated (shorter) deadline for the entry round: when
+            # the failure is NOT group-wide — a peer's read succeeded,
+            # or failed with a different error class — that peer never
+            # enters replay.enter, and without this bound the replaying
+            # survivors would stall the FULL failure.collectiveTimeoutMs
+            # before converting to failfast.
+            enter_ms = self.conf.replay_agree_timeout_ms
             try:
                 agree("replay.enter",
                       np.array([handle.shuffle_id, left],
                                dtype=np.int64),
-                      conf_key="spark.shuffle.tpu.failure.replayBudget")
+                      conf_key="spark.shuffle.tpu.failure.replayBudget",
+                      timeout_ms=enter_ms if enter_ms > 0 else None)
             except AgreementDivergenceError as e:
                 # divergent budget (or a peer not replaying this
                 # shuffle at all): no process may re-enter — the

@@ -546,13 +546,29 @@ class AsyncShuffleExecutor:
     counts — the straggler's view bounds the batch), computes the
     tenant-DRR order with :func:`agreed_submission_order` and CONFIRMS
     it unanimously (``async.order``) before releasing the batch to the
-    pool in that order. Every process therefore enters its collectives
-    in the same agreed sequence while up to K reads overlap — the
-    serving-tier fan-out the width-1 clamp used to forbid. A divergent
-    order (one process submitted different work, or a different
-    asyncWorkers/priority conf) fails ALL of the batch's futures with
-    the typed divergence error naming the dissenter instead of
-    deadlocking the mesh mid-collective.
+    pool in that order. A divergent order (one process submitted
+    different work, or a different asyncWorkers/priority conf) fails
+    ALL of the batch's futures with the typed divergence error naming
+    the dissenter instead of deadlocking the mesh mid-collective.
+
+    The agreed order alone is NOT enough: once released, each read's
+    body issues its own collectives (schema gathers, wave agreements,
+    per-tier programs, overflow rounds), and K OS-scheduled worker
+    threads would interleave those differently per process — the exact
+    cross-process hazard the historical width-1 clamp existed to
+    prevent. So the agreed order is ENFORCED at execution: every
+    dispatched read (and the dispatcher's own agreement rounds) holds a
+    ticket from a per-process :class:`CollectiveTurnstile`, issued in
+    the agreed sequence; a read's collective section — conservatively
+    its whole body, since replay re-enters collectives on failure —
+    runs only when every earlier ticket has released. Collective
+    sections therefore execute in the identical order on every process
+    while the K workers still overlap submission, queueing and future
+    fan-out (a serving tier never blocks a thread per shuffle, tenant
+    caps and the DRR schedule stay cross-process deterministic);
+    overlapping the device phase of one read with the collective
+    issue of the next needs a finer-grained end-of-collectives hook in
+    the manager and is deliberately NOT attempted here.
 
     ``tenant.asyncAgreedOrder=false`` restores the historical width-1
     clamp (execution order == submission order by construction, no
@@ -602,6 +618,10 @@ class AsyncShuffleExecutor:
         self._seq = 0                 # local submission counter
         self._queue: deque = deque()  # (seq, tid, run, outer_future)
         self._dispatcher = None
+        self._turnstile = None
+        if self._dispatching:
+            from sparkucx_tpu.shuffle.agreement import CollectiveTurnstile
+            self._turnstile = CollectiveTurnstile()
 
     def _executor(self):
         with self._lock:
@@ -708,47 +728,99 @@ class AsyncShuffleExecutor:
     def _dispatch_loop(self):
         """Single dispatcher: drains the submission queue in batches
         whose size and tenant-DRR order are AGREED across processes
-        before any read of the batch enters the pool. One thread per
-        process runs the agreement plane, so agreement seq numbers
-        advance identically everywhere regardless of how many worker
-        threads are mid-read."""
-        while True:
-            with self._cv:
-                while not self._queue and not self._closed:
-                    self._cv.wait(0.2)
-                if self._closed:
+        before any read of the batch enters the pool. The dispatcher's
+        own agreement rounds and every dispatched read run under
+        turnstile tickets issued in the agreed sequence, so the
+        per-process collective stream is identical everywhere
+        regardless of how the OS schedules the worker threads."""
+        try:
+            while True:
+                with self._cv:
+                    while not self._queue and not self._closed:
+                        self._cv.wait(0.2)
+                    if self._closed:
+                        return
+                    n_local = len(self._queue)
+                try:
+                    self._dispatch_batch(n_local)
+                except Exception as e:
+                    if getattr(e, "_sxt_batch_failed", False):
+                        # the fault struck AFTER the batch was popped:
+                        # those futures are already resolved and their
+                        # tickets released — reads still queued (or
+                        # submitted since) were never part of the failed
+                        # order, so keep serving them
+                        log.warning("async dispatch batch failed; "
+                                    "dispatcher continues", exc_info=True)
+                        continue
+                    log.error("async dispatcher died; failing queued "
+                              "reads", exc_info=True)
+                    # unregister BEFORE draining, under one lock hold:
+                    # a submit that enqueues after this sees no
+                    # dispatcher and starts a fresh one — only reads
+                    # already queued behind the dead dispatcher fail
+                    with self._cv:
+                        drained, self._queue = list(self._queue), deque()
+                        if self._dispatcher is threading.current_thread():
+                            self._dispatcher = None
+                    self._fail_items(drained, RuntimeError(
+                        "async agreed-order dispatcher failed"))
                     return
-                n_local = len(self._queue)
-            try:
-                self._dispatch_batch(n_local)
-            except Exception:
-                log.error("async dispatcher died; failing queued reads",
-                          exc_info=True)
-                self._fail_queued(RuntimeError(
-                    "async agreed-order dispatcher failed"))
-                return
+        finally:
+            # a dead dispatcher unregisters itself so the next submit
+            # can start a fresh one (stop() sets _closed, under which
+            # submit refuses instead)
+            with self._cv:
+                if self._dispatcher is threading.current_thread():
+                    self._dispatcher = None
 
     def _dispatch_batch(self, n_local: int):
         import numpy as np
         from sparkucx_tpu.shuffle.agreement import (
             AgreementDivergenceError, agree)
         conf_key = "spark.shuffle.tpu.tenant.asyncAgreedOrder"
-        # reduce-min: the straggler's pending count bounds the batch, so
-        # no process dispatches work a peer has not submitted yet (the
-        # standing SPMD discipline: all processes submit the same reads
-        # in the same local order)
-        n = int(agree("async.batch",
-                      np.array([n_local], dtype=np.int64),
-                      reduce="min", conf_key=conf_key)[0])
+        gate = self._turnstile
+        my = gate.issue()
+        try:
+            # the dispatcher's agreement rounds take their own turn, so
+            # they can never interleave with a still-running read's
+            # collectives (batch N+1's rounds wait out batch N)
+            gate.acquire(my)
+            # reduce-min: the straggler's pending count bounds the
+            # batch, so no process dispatches work a peer has not
+            # submitted yet (the standing SPMD discipline: all
+            # processes submit the same reads in the same local order)
+            n = int(agree("async.batch",
+                          np.array([n_local], dtype=np.int64),
+                          reduce="min", conf_key=conf_key)[0])
+        except BaseException:
+            gate.release(my)
+            raise
         if n < 1:
+            gate.release(my)
             return
         with self._cv:
-            batch = [self._queue.popleft() for _ in range(n)]
-        by_seq = {item[0]: item for item in batch}
-        order = agreed_submission_order(
-            [(seq, tid) for seq, tid, _r, _f, _rel in batch],
-            lambda t: self._registry.spec(t).weight)
+            take = min(n, len(self._queue))
+            batch = [self._queue.popleft() for _ in range(take)]
+        if len(batch) < n:
+            # stop() drained the queue between the agreement and the
+            # pop: the executor is closing — fail what we hold rather
+            # than dispatch a partial batch under an order agreed for n
+            gate.release(my)
+            self._fail_items(batch, RuntimeError(
+                "async executor is stopped"))
+            return
+        # From here the batch is OURS: the queue drain (_fail_queued)
+        # can no longer see it, so EVERY exit path below must resolve
+        # its futures and free its tenant slots — a leaked item would
+        # block submitters at maxInflightReads forever.
+        submitted = set()
+        tickets: Dict[int, int] = {}
         try:
+            by_seq = {item[0]: item for item in batch}
+            order = agreed_submission_order(
+                [(seq, tid) for seq, tid, _r, _f, _rel in batch],
+                lambda t: self._registry.spec(t).weight)
             # unanimity over (seq, tenant) pairs: a process that queued
             # DIFFERENT work (or resolves different priority weights)
             # fails the whole batch typed, naming the dissenter, before
@@ -760,22 +832,70 @@ class AsyncShuffleExecutor:
                            zlib.crc32(by_seq[seq][1].encode()))],
                 dtype=np.int64)
             agree("async.order", proposal, conf_key=conf_key)
+            # tickets in the AGREED order: execution (not just
+            # submission) of each read's collective section follows it
+            tickets = {seq: gate.issue() for seq in order}
+            gate.release(my)
+            pool = self._executor()
+            for seq in order:
+                _s, _tid, run, outer, release = by_seq[seq]
+                fut = pool.submit(self._turnstiled(
+                    run, release, tickets[seq]))
+                submitted.add(seq)
+                # a run cancelled by stop(cancel_futures=True) never
+                # enters its finally — release its tenant slot and its
+                # ticket here (same rule as the direct path)
+                fut.add_done_callback(
+                    lambda f, rel=release, t=tickets[seq]:
+                    (rel(), gate.release(t)) if f.cancelled() else None)
+                self._chain(fut, outer)
         except AgreementDivergenceError as e:
-            for _seq, _tid, _run, outer, release in batch:
-                release()
-                if not outer.done():
-                    outer.set_exception(e)
+            gate.release(my)
+            self._fail_items(batch, e)
             return
-        pool = self._executor()
-        for seq in order:
-            _s, _tid, run, outer, release = by_seq[seq]
-            fut = pool.submit(run)
-            # a run cancelled by stop(cancel_futures=True) never enters
-            # its finally — release its tenant slot here (same rule as
-            # the direct path)
-            fut.add_done_callback(
-                lambda f, rel=release: rel() if f.cancelled() else None)
-            self._chain(fut, outer)
+        except BaseException as e:
+            # anything else past the pop (PeerLost from the order
+            # round, unknown-tenant conf error, pool refusal mid-loop):
+            # fail the UNDISPATCHED remainder here, release its tickets
+            # so later batches are not wedged behind abandoned turns,
+            # then let the loop's handler drain the still-queued rest
+            gate.release(my)
+            for seq, t in tickets.items():
+                if seq not in submitted:
+                    gate.release(t)
+            self._fail_items(
+                [it for it in batch if it[0] not in submitted], e)
+            # the batch is fully resolved: tell the loop it may keep
+            # dispatching instead of failing unrelated queued reads
+            e._sxt_batch_failed = True
+            raise
+
+    def _turnstiled(self, run, release_slot, ticket: int):
+        """Wrap a read's body in its collective turn: acquire blocks
+        until every earlier agreed ticket released, so the body's
+        collectives join the per-process stream in the agreed order."""
+        gate = self._turnstile
+
+        def wrapped():
+            try:
+                gate.acquire(ticket)
+            except BaseException:
+                # never entered run(): its finally cannot free the
+                # tenant slot — do it here or the slot leaks
+                release_slot()
+                raise
+            try:
+                return run()
+            finally:
+                gate.release(ticket)
+        return wrapped
+
+    @staticmethod
+    def _fail_items(items, err: BaseException) -> None:
+        for _seq, _tid, _run, outer, release in items:
+            release()
+            if not outer.done():
+                outer.set_exception(err)
 
     @staticmethod
     def _chain(fut, outer):
@@ -791,21 +911,30 @@ class AsyncShuffleExecutor:
     def _fail_queued(self, err: BaseException) -> None:
         with self._cv:
             drained, self._queue = list(self._queue), deque()
-        for _seq, _tid, _run, outer, release in drained:
-            release()
-            if not outer.done():
-                outer.set_exception(err)
+        self._fail_items(drained, err)
 
     def stop(self, wait: bool = True) -> None:
         with self._cv:
             self._closed = True
             pool, self._pool = self._pool, None
-            dispatcher, self._dispatcher = self._dispatcher, None
+            dispatcher = self._dispatcher
             # wake submitters blocked at a tenant cap so they observe
             # _closed and raise instead of waiting on a drained pool
             self._cv.notify_all()
+        if self._turnstile is not None:
+            # wake reads parked on their collective turn BEFORE the
+            # pool drain below — a waiter that kept blocking in acquire
+            # would hang shutdown(wait=True) forever
+            self._turnstile.close()
         if dispatcher is not None:
             dispatcher.join(timeout=5.0)
+            # Past the timeout the dispatcher may still be parked
+            # inside an agree() under a (much longer) watchdog
+            # deadline. It is fenced, not raced: _executor() refuses to
+            # hand out a pool once _closed is set and the closed
+            # turnstile fails its acquires typed, so whatever batch it
+            # popped resolves through _dispatch_batch's own failure
+            # path instead of dispatching into a recreated executor.
         # undispatched queued reads never reach the pool: fail them so
         # their futures resolve and their tenant slots free
         self._fail_queued(RuntimeError("async executor is stopped"))

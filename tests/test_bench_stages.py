@@ -310,8 +310,10 @@ def test_chaos_measure_small(mesh8):
     # replay through the compiled device merge and donated buffers),
     # plus the corrupt-site block (staged/spill x single/waved x both
     # policies), plus the hier x replay x waved cell (fault in the DCN
-    # phase of a wave's tiered exchange)
-    assert rec["cells_total"] == 26
+    # phase of a wave's tiered exchange), plus the two distributed
+    # cells (exchange x replay under collective replay entry, and
+    # tier.dcn x failfast under the per-stage deadline)
+    assert rec["cells_total"] == 28
     assert rec["cells_ok"] == rec["cells_total"]
     wire_cells = [c for c in rec["cells"] if c.get("wire") == "int8"]
     assert len(wire_cells) == 1

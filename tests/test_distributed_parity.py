@@ -12,6 +12,7 @@ started node, the same routing the multi-process cluster harness
 channel that replays a 3-process gather with one dissenter."""
 
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -169,6 +170,70 @@ def test_agree_threads_tear_no_frames():
         t.join()
     assert len(done) == n
     assert current_round() == (0, n * per)
+
+
+def test_agree_rounds_atomic_across_threads(monkeypatch):
+    """The agreement-plane mutex holds across BOTH allgathers of a
+    round: concurrent agree() calls from different threads can never
+    interleave one round's header with another's payload (review
+    round: the lock covered only the counters, so process A could pair
+    thread X's header with thread Y's payload while process B paired
+    them the other way — a spurious sequencing split on a healthy
+    cluster)."""
+    from sparkucx_tpu.shuffle import distributed as dist
+    reset_epoch(0)
+    calls = []
+
+    def gather(payload, what="", timeout_ms=None):
+        calls.append(what)
+        time.sleep(0.001)       # widen the interleave window
+        return np.asarray(payload)[None]
+
+    monkeypatch.setattr(dist, "allgather_blob", gather)
+    per = 10
+
+    def worker():
+        for _ in range(per):
+            agree("parity.atomic", [1], reduce="sum")
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(calls) == 4 * per * 2
+    for header, payload in zip(calls[::2], calls[1::2]):
+        assert header.startswith("agreement header")
+        assert payload.startswith("agreement 'parity.atomic'")
+        # the pair frames the SAME sequence number — rounds are atomic
+        assert header.rsplit("#", 1)[1] == payload.rsplit("#", 1)[1]
+
+
+def test_collective_turnstile_orders_and_skips_abandoned():
+    """CollectiveTurnstile: acquisition strictly follows ticket issue
+    order; an out-of-turn release (abandoned work) is skipped instead
+    of wedging the tickets behind it; close() fails waiters typed."""
+    from sparkucx_tpu.shuffle.agreement import CollectiveTurnstile
+    gate = CollectiveTurnstile()
+    t0, t1, t2, t3 = (gate.issue() for _ in range(4))
+    ran = []
+
+    def hold(ticket, tag):
+        gate.acquire(ticket)
+        ran.append(tag)
+        gate.release(ticket)
+
+    gate.release(t1)            # abandoned before its turn
+    th = threading.Thread(target=hold, args=(t2, "c"))
+    th.start()
+    time.sleep(0.05)
+    assert ran == []            # c parked behind t0
+    hold(t0, "a")
+    th.join(timeout=10)
+    assert ran == ["a", "c"]    # t1 skipped, never blocked t2
+    gate.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        gate.acquire(t3)
 
 
 # -- the distributed read path (nproc=1, is_distributed forced) -------------
@@ -458,6 +523,44 @@ def test_distributed_replay_vetoed_on_divergence(dist_mgr, rng,
         node.faults.disarm("exchange")
         mgr._policy = old_policy
     mgr.unregister_shuffle(912)
+
+
+def test_replay_enter_rides_dedicated_timeout(dist_mgr, rng,
+                                              monkeypatch):
+    """The replay.enter round carries its OWN deadline
+    (failure.replayAgreeTimeoutMs): when a failure is not group-wide a
+    non-replaying peer never enters the round, and the survivors bound
+    their stall by this instead of the full collectiveTimeoutMs."""
+    from sparkucx_tpu.shuffle import distributed as dist
+    node, mgr = dist_mgr
+    old_policy = mgr._policy
+    mgr._policy = "replay"
+    old = mgr.conf.get("spark.shuffle.tpu.failure.replayAgreeTimeoutMs")
+    mgr.conf.set("spark.shuffle.tpu.failure.replayAgreeTimeoutMs",
+                 "1234")
+    seen = []
+    real = dist.allgather_blob
+
+    def gather(payload, what="", timeout_ms=None):
+        if "replay.enter" in what:
+            seen.append(timeout_ms)
+        return real(payload, what=what, timeout_ms=timeout_ms)
+
+    try:
+        h, ak, _ = _stage(mgr, 913, rng, rows=40)
+        monkeypatch.setattr(dist, "allgather_blob", gather)
+        node.faults.arm("exchange", fail_count=1)
+        res = mgr.read(h)
+        _check_parts(res, ak)
+        # header + payload rounds of replay.enter, both fenced at the
+        # dedicated deadline
+        assert seen and all(t == 1234.0 for t in seen)
+    finally:
+        node.faults.disarm("exchange")
+        mgr._policy = old_policy
+        mgr.conf.set("spark.shuffle.tpu.failure.replayAgreeTimeoutMs",
+                     old if old is not None else "0")
+    mgr.unregister_shuffle(913)
 
 
 # -- K-worker agreed submission order ---------------------------------------

@@ -573,6 +573,182 @@ def test_async_agreed_order_dispatch_single_process():
     ex.stop()
 
 
+def test_async_turnstile_orders_execution_not_just_submission():
+    """The agreed DRR order is enforced at EXECUTION: each read's body
+    runs under a collective-turnstile ticket issued in the agreed
+    order, so bodies never overlap and never reorder even on a 4-wide
+    pool — the cross-process collective-interleave hazard OS thread
+    scheduling would otherwise reintroduce (review round: submission
+    order alone left K worker threads racing their collectives)."""
+    reg = TenantRegistry(_conf({
+        "spark.shuffle.tpu.tenant.hi.priority": "high"}))
+    ex = AsyncShuffleExecutor(
+        _conf({"spark.shuffle.tpu.tenant.asyncWorkers": "4"}),
+        reg, Metrics(), distributed=True)
+    try:
+        # pin the dispatcher slot so the loop can't race the batch: one
+        # deterministic batch driven through _dispatch_batch directly
+        ex._dispatcher = threading.current_thread()
+        spans = []
+
+        def body(tag):
+            t0 = time.monotonic()
+            time.sleep(0.02)
+            spans.append((tag, t0, time.monotonic()))
+            return tag
+
+        subs = [("lo0", "lo"), ("hi0", "hi"), ("hi1", "hi"),
+                ("lo1", "lo"), ("hi2", "hi")]
+        futs = [ex.submit(lambda t=t: body(t), tid, i)
+                for i, (t, tid) in enumerate(subs)]
+        ex._dispatch_batch(len(subs))
+        assert [f.result(30) for f in futs] == [t for t, _ in subs]
+        ran = [s[0] for s in sorted(spans, key=lambda s: s[1])]
+        # agreed DRR: lo (normal, weight 2) serves both its reads in
+        # round 1, then hi (weight 4) drains its three — and execution
+        # matches that schedule exactly
+        assert ran == ["lo0", "lo1", "hi0", "hi1", "hi2"]
+        ordered = sorted(spans, key=lambda s: s[1])
+        for (_, _, end), (_, start, _) in zip(ordered, ordered[1:]):
+            assert end <= start      # collective sections never overlap
+    finally:
+        ex._dispatcher = None
+        ex.stop()
+
+
+def test_async_dispatch_failure_after_pop_frees_popped_batch(monkeypatch):
+    """A dispatcher failure AFTER the batch is popped (here: the order
+    round dying mid-agreement) must resolve the popped futures and free
+    their tenant slots — before the fix only still-queued items were
+    failed, so the popped batch leaked its maxInflightReads slots and
+    submitters blocked forever."""
+    from sparkucx_tpu.shuffle import agreement
+    reg = TenantRegistry(_conf(
+        {"spark.shuffle.tpu.tenant.t.maxInflightReads": "2"}))
+    ex = AsyncShuffleExecutor(
+        _conf({"spark.shuffle.tpu.tenant.asyncWorkers": "4"}),
+        reg, Metrics(), distributed=True)
+    real = agreement.agree
+
+    def boom(topic, *a, **k):
+        if topic == "async.order":
+            raise RuntimeError("order channel down")
+        return real(topic, *a, **k)
+
+    try:
+        ex._dispatcher = threading.current_thread()
+        f1 = ex.submit(lambda: 1, "t", 1)
+        f2 = ex.submit(lambda: 2, "t", 2)
+        monkeypatch.setattr(agreement, "agree", boom)
+        with pytest.raises(RuntimeError, match="order channel down"):
+            ex._dispatch_batch(2)
+        for f in (f1, f2):
+            with pytest.raises(RuntimeError, match="order channel down"):
+                f.result(10)
+        assert ex.inflight("t") == 0    # slots freed, not leaked
+        # the turnstile advanced past the abandoned batch: a fresh
+        # batch dispatches cleanly once the channel recovers
+        monkeypatch.setattr(agreement, "agree", real)
+        f3 = ex.submit(lambda: 3, "t", 3)
+        ex._dispatch_batch(1)
+        assert f3.result(10) == 3
+    finally:
+        ex._dispatcher = None
+        ex.stop()
+
+
+def test_async_dispatcher_survives_batch_fault_live_loop(monkeypatch):
+    """Through the LIVE dispatcher loop: a fault that strikes after the
+    batch is popped fails that batch only — the dispatcher keeps
+    serving, so a read submitted after the fault surfaced succeeds
+    instead of being drained by a dying dispatcher (the old behavior
+    raced post-fault submissions into 'dispatcher failed')."""
+    from sparkucx_tpu.shuffle import agreement
+    reg = TenantRegistry(_conf())
+    ex = AsyncShuffleExecutor(
+        _conf({"spark.shuffle.tpu.tenant.asyncWorkers": "4"}),
+        reg, Metrics(), distributed=True)
+    real = agreement.agree
+
+    def boom(topic, *a, **k):
+        if topic == "async.order":
+            raise RuntimeError("order channel down")
+        return real(topic, *a, **k)
+
+    try:
+        monkeypatch.setattr(agreement, "agree", boom)
+        f1 = ex.submit(lambda: 1, "default", 1)
+        with pytest.raises(RuntimeError, match="order channel down"):
+            f1.result(10)
+        assert ex.inflight("default") == 0
+        monkeypatch.setattr(agreement, "agree", real)
+        # same executor, same (still-alive) dispatcher: recovers
+        f2 = ex.submit(lambda: 2, "default", 2)
+        assert f2.result(10) == 2
+        assert ex.inflight("default") == 0
+    finally:
+        ex.stop()
+
+
+def test_async_dispatch_divergence_fails_batch_typed(monkeypatch):
+    """An order-round divergence fails the whole popped batch with the
+    typed error (dissenter named), frees the slots, and leaves the
+    dispatcher alive for the next batch."""
+    from sparkucx_tpu.shuffle import agreement
+    from sparkucx_tpu.shuffle.agreement import AgreementDivergenceError
+    reg = TenantRegistry(_conf())
+    ex = AsyncShuffleExecutor(
+        _conf({"spark.shuffle.tpu.tenant.asyncWorkers": "2"}),
+        reg, Metrics(), distributed=True)
+    real = agreement.agree
+
+    def dissent(topic, *a, **k):
+        if topic == "async.order":
+            raise AgreementDivergenceError(
+                topic, "value", [1], [[0], [9]],
+                conf_key="spark.shuffle.tpu.tenant.asyncAgreedOrder")
+        return real(topic, *a, **k)
+
+    try:
+        ex._dispatcher = threading.current_thread()
+        f1 = ex.submit(lambda: 1, None, 1)
+        monkeypatch.setattr(agreement, "agree", dissent)
+        ex._dispatch_batch(1)           # returns, does NOT raise
+        with pytest.raises(AgreementDivergenceError,
+                           match="asyncAgreedOrder"):
+            f1.result(10)
+        assert ex.inflight("default") == 0
+        monkeypatch.setattr(agreement, "agree", real)
+        f2 = ex.submit(lambda: 2, None, 2)
+        ex._dispatch_batch(1)
+        assert f2.result(10) == 2
+    finally:
+        ex._dispatcher = None
+        ex.stop()
+
+
+def test_async_stop_unblocks_turnstiled_read():
+    """stop() closes the turnstile BEFORE draining the pool: a read
+    parked on its collective turn behind a long-running predecessor
+    fails typed instead of hanging shutdown forever."""
+    reg = TenantRegistry(_conf())
+    ex = AsyncShuffleExecutor(
+        _conf({"spark.shuffle.tpu.tenant.asyncWorkers": "2"}),
+        reg, Metrics(), distributed=True)
+    gate = threading.Event()
+    ex._dispatcher = threading.current_thread()
+    f1 = ex.submit(gate.wait, None, 1)          # will hold the turn
+    f2 = ex.submit(lambda: "late", None, 2)     # parks behind it
+    ex._dispatch_batch(2)
+    time.sleep(0.1)
+    ex._dispatcher = None
+    ex.stop(wait=False)
+    with pytest.raises(RuntimeError, match="closed"):
+        f2.result(10)
+    gate.set()                                  # let the holder finish
+    assert f1.result(10) is True
+
+
 def test_agreed_submission_order_deterministic_drr():
     """agreed_submission_order is a pure function of the batch: two
     simulated processes holding the same (seq, tenant) pairs compute
