@@ -278,6 +278,29 @@ def _agreement_drill(node, mgr, proc_id: int, nprocs: int) -> int:
     print(f"worker {proc_id}: AGREEMENT DIVERGENCE FENCED OK "
           f"(dissenter {dissenter} named on every process, group exited "
           f"both rounds together)", flush=True)
+
+    # leg 2c: the SILENT split — a conf-derived bound under
+    # reduce="min" SETTLES instead of raising (reducers skip the
+    # unanimity check by design), so the dissenter's divergent conf
+    # quietly wins the reduction and no process sees an error. The
+    # run stays green here; the decisions ledger (audit="strict")
+    # records the divergent proposal digests, and ONLY the offline
+    # `decisions --input` audit over the dumped decisions_p*.jsonl
+    # can name the round — exactly what the CI lane asserts.
+    bound = 250 if proc_id == dissenter else 256
+    out = agree("hier.dcn.capms", np.array([bound], dtype=np.int64),
+                reduce="min", audit="strict",
+                conf_key="spark.shuffle.tpu.a2a.capacityFactor")
+    assert int(out[0]) == 250, \
+        f"min-reduce should settle on the dissenter's bound: {out}"
+    last = node.decisions.tail(1)
+    assert last and last[0]["topic"] == "hier.dcn.capms" \
+        and last[0]["ok"] and last[0]["audit"] == "strict" \
+        and len(set(last[0]["proposals"])) > 1, last
+    print(f"worker {proc_id}: SILENT MIN-REDUCE SPLIT SEEDED "
+          f"(settled {int(out[0])} with no error; ledger epoch "
+          f"{last[0]['epoch']} seq {last[0]['seq']} holds the "
+          f"divergent digests for the offline audit)", flush=True)
     mgr.unregister_shuffle(16)
     mgr.stop()
     node.close()
@@ -327,11 +350,16 @@ def main() -> int:
     }
     if agreement_phase == "1":
         # each worker's flight postmortem lands in its own subdir of the
-        # controller-provided dump root (the CI artifact on failure)
+        # controller-provided dump root (the CI artifact on failure);
+        # the decision ledgers land rank-keyed in the root itself
+        # (decisions_p<rank>.jsonl — written live, so they exist on
+        # SUCCESS too: the offline `decisions --input` audit lane
+        # runs over them after the drill)
         fdir = os.environ.get("SPARKUCX_TPU_FLIGHT_DIR", "")
         if fdir:
             conf_map["spark.shuffle.tpu.flightRecorder.dir"] = \
                 os.path.join(fdir, f"worker{proc_id}")
+            conf_map["spark.shuffle.tpu.history.dir"] = fdir
     if chaos_phase == "1":
         # the drill's whole point: a deadline on every rendezvous. The
         # probe bound (network.timeoutMs, which sizes HealthMonitor's

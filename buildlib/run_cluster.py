@@ -407,8 +407,13 @@ def run_agreement(args) -> int:
     accounting on every process; then one process simulates a divergent
     overflow-cap conf and a divergent tenant-weight conf — EVERY process
     must raise AgreementDivergenceError naming the dissenter (none may
-    hang). Workers dump their flight rings to SPARKUCX_TPU_FLIGHT_DIR
-    on failure for the CI artifact."""
+    hang). A final leg seeds the SILENT split: a conf bound under
+    reduce="min" settles green with divergent proposals, detectable
+    only by the decisions-ledger audit over the decisions_p*.jsonl
+    files the workers write into SPARKUCX_TPU_FLIGHT_DIR (the CI
+    decisions lane runs `python -m sparkucx_tpu decisions --input`
+    over them after this drill). Workers dump their flight rings to
+    the same dir on failure for the CI artifact."""
     slices = max(args.slices, 2)      # the drill IS the split-tier leg
     procs, all_logs = [], []
     try:
@@ -430,12 +435,13 @@ def run_agreement(args) -> int:
             for p in procs:
                 if p.poll() is None:
                     p.kill()
-        read_ok = fenced = 0
+        read_ok = fenced = seeded = 0
         for pid, lf in enumerate(logs):
             lf.seek(0)
             out = lf.read()
             read_ok += 1 if "SPLIT-TIER READ OK" in out else 0
             fenced += 1 if "AGREEMENT DIVERGENCE FENCED OK" in out else 0
+            seeded += 1 if "SILENT MIN-REDUCE SPLIT SEEDED" in out else 0
         if read_ok != args.nprocs:
             print(f"only {read_ok}/{args.nprocs} workers completed the "
                   f"split-tier read")
@@ -443,6 +449,11 @@ def run_agreement(args) -> int:
         if fenced != args.nprocs:
             print(f"only {fenced}/{args.nprocs} workers fenced the "
                   f"divergence typed — a silent peer means a hang risk")
+            ok = False
+        if seeded != args.nprocs:
+            print(f"only {seeded}/{args.nprocs} workers settled the "
+                  f"seeded silent min-reduce split — the decisions "
+                  f"audit lane has nothing to catch")
             ok = False
         print("CLUSTER AGREEMENT:", "PASS" if ok else "FAIL")
         return 0 if ok else 1

@@ -90,6 +90,21 @@ Subcommands:
     a finding at/above ``--fail-on`` (default critical) fired; exit 2
     when NO peer answered at all.
 
+``decisions [--input DUMP_OR_DIR ... | --peers URL ... | --registry P]
+[--format text|json] [--fail-on warn|critical]``
+    The decision-plane audit (shuffle/decisions.py): join every
+    rank's agreement ledger (``decisions_p*.jsonl`` dumps, snapshot-
+    embedded rings, or a live ``/decisions`` scrape) by
+    ``(epoch, seq)`` and require the fleet closed IDENTICAL rounds —
+    same topic, same winner digest, and identical proposals under the
+    strict audit contract. Catches the split the runtime cannot: a
+    min/max-reduced round that settled green while one peer proposed
+    a divergent conf-derived bound. Prints the round log and any
+    ``SPLIT @ (epoch, seq)`` lines naming the dissenting peer, then
+    the decision doctor rules (``decision_split``, ``slow_proposer``,
+    ``desync``). Exit 3 when a finding at/above ``--fail-on`` fired;
+    exit 2 when no input held any ledger records at all.
+
 ``workload <name> [--scale S] [--budget-mb N] [--seed K] [--arrow]``
     Run one registered analytics pipeline (workloads/ registry:
     terasort | groupby | join) end to end — external-memory, data
@@ -126,16 +141,17 @@ def _expand_inputs(paths) -> list:
             "glob?); pass dump files/directories or drop --input for "
             "live mode")
     out = []
+    from sparkucx_tpu.shuffle.decisions import decisions_files
     from sparkucx_tpu.utils.history import history_files
     for p in paths:
         if os.path.isdir(p):
             hits = sorted(glob.glob(os.path.join(p, "metrics_*.json"))
                           + glob.glob(os.path.join(p, "flight_*.json"))
-                          + history_files(p))
+                          + history_files(p) + decisions_files(p))
             if not hits:
                 raise FileNotFoundError(
                     f"{p}: no metrics_*.json / flight_*.json / "
-                    f"history_*.jsonl dumps")
+                    f"history_*.jsonl / decisions_*.jsonl dumps")
             out.extend(hits)
         else:
             out.append(p)
@@ -164,11 +180,29 @@ def _load_history_doc(path: str, strict_anchor: bool = True):
     return doc
 
 
+def _load_decisions_doc(path: str):
+    """A ``decisions_*.jsonl`` ledger as a snapshot-shaped doc
+    (``decisions`` key) the doctor's build_view folds per-process. No
+    anchor requirement: decision records carry wall-clock stamps, not
+    span offsets. None when every line is torn — dumps beside a bad
+    ledger must still grade (the _load_history_doc rule)."""
+    from sparkucx_tpu.shuffle.decisions import (decisions_to_doc,
+                                                load_decisions_file)
+    recs = load_decisions_file(path)
+    if not recs:
+        print(f"warning: {path}: no parseable decision records — "
+              f"skipped", file=sys.stderr)
+        return None
+    return decisions_to_doc(recs, source=path)
+
+
 def _load_doc(path: str, strict_anchor: bool = True):
-    """Load any telemetry input: snapshot/flight JSON or history
-    JSONL (None for a frame-less history log — the caller filters),
-    anchor-checked per ``strict_anchor``."""
+    """Load any telemetry input: snapshot/flight JSON, history JSONL,
+    or decisions JSONL (None for a frame/record-less log — the caller
+    filters), anchor-checked per ``strict_anchor``."""
     if path.endswith(".jsonl"):
+        if os.path.basename(path).startswith("decisions_"):
+            return _load_decisions_doc(path)
         return _load_history_doc(path, strict_anchor)
     return _load_anchored(path) if strict_anchor else _load(path)
 
@@ -501,6 +535,86 @@ def _cmd_cluster(args) -> int:
     return 0
 
 
+_DECISION_RULES = ("decision_split", "slow_proposer", "desync")
+
+
+def _cmd_decisions(args) -> int:
+    """``decisions``: join the fleet's decision ledgers and audit their
+    consistency (shuffle/decisions.py). Offline: ``--input`` dump
+    dirs/files (decisions_*.jsonl ledgers, plus snapshots whose
+    embedded tails fill retention gaps). Live: ``--peers``/
+    ``--registry`` scrape every peer's /snapshot out-of-band
+    (collective-free — this is the tool for a WEDGED fleet). Exit 2
+    when no ledger reached the audit, 3 past --fail-on."""
+    from sparkucx_tpu.shuffle.decisions import align_rounds, audit_round
+    from sparkucx_tpu.utils.doctor import (GRADES, build_view, diagnose,
+                                           render_findings)
+    fleet_meta_doc = None
+    if args.input is not None:
+        docs = _load_docs(_expand_inputs(args.input),
+                          strict_anchor_for=lambda p: False)
+    else:
+        from sparkucx_tpu.utils import collector as fleet
+        try:
+            reg = fleet.resolve_registry(peers=args.peers,
+                                         registry=args.registry)
+        except (FileNotFoundError, ValueError) as e:
+            print(f"decisions: {e}", file=sys.stderr)
+            return 2
+        coll = fleet.ClusterCollector(reg, timeout_s=args.timeout_s)
+        view_raw = coll.scrape()
+        fleet_meta_doc = fleet.fleet_meta(view_raw)
+        docs = fleet.fleet_docs(view_raw)
+        if not docs:
+            print("decisions: NO peer answered the scrape",
+                  file=sys.stderr)
+            return 2
+    view = build_view(docs, fleet=fleet_meta_doc)
+    if not view.decisions:
+        print("decisions: no decision-ledger records in the inputs "
+              "(decisions.enabled off, or the fleet never ran an "
+              "agreement round)", file=sys.stderr)
+        return 2
+    aligned = align_rounds(view.decisions)
+    splits = [(row, v) for row in aligned
+              for v in [audit_round(row)] if v is not None]
+    findings = [f for f in diagnose(docs, fleet=fleet_meta_doc)
+                if f.rule in _DECISION_RULES]
+    if args.format == "json":
+        print(json.dumps(
+            {"fleet": fleet_meta_doc,
+             "ledgers": {str(p): {"records": len(r),
+                                  "newest": r[-1] if r else None}
+                         for p, r in sorted(view.decisions.items())},
+             "rounds_audited": len(aligned),
+             "splits": [{"epoch": row["epoch"], "seq": row["seq"],
+                         "topic": next(iter(row["records"].values()))
+                         .get("topic"), **v} for row, v in splits],
+             "findings": [f.to_dict() for f in findings]},
+            indent=1, default=repr))
+    else:
+        print(f"decision ledgers: {len(view.decisions)} peer(s), "
+              f"{len(aligned)} aligned round(s), "
+              f"{len(splits)} split(s)")
+        for p, recs in sorted(view.decisions.items()):
+            newest = recs[-1] if recs else {}
+            print(f"  p{p}: {len(recs)} record(s), newest "
+                  f"(epoch {newest.get('epoch')}, seq "
+                  f"{newest.get('seq')}) topic "
+                  f"{newest.get('topic')!r} ok={newest.get('ok')}")
+        for row, v in splits[-8:]:
+            topic = next(iter(row["records"].values())).get("topic")
+            print(f"  SPLIT @ (epoch {row['epoch']}, seq "
+                  f"{row['seq']}) topic {topic!r}: {v['split']} "
+                  f"split, dissenters {v['dissenters']}")
+        sys.stdout.write(render_findings(findings))
+    if args.fail_on:
+        floor = GRADES.index(args.fail_on)
+        if any(GRADES.index(f.grade) >= floor for f in findings):
+            return 3
+    return 0
+
+
 def _cmd_keys(args) -> int:
     from sparkucx_tpu.config import _print_key_table
     _print_key_table()
@@ -659,6 +773,36 @@ def main(argv=None) -> int:
     p_cl.add_argument("--trace", default=None,
                       help="pin the cross-process anatomy join to "
                            "this trace id (json format only)")
+    p_dec = sub.add_parser(
+        "decisions",
+        help="join the fleet's decision ledgers (shuffle/decisions.py "
+             "agree() round records) and audit cross-peer consistency: "
+             "aligned (epoch, seq) rounds must close with identical "
+             "topic + winner digest; strict-audit reduced rounds with "
+             "identical proposals — the silent-conf-split detector; "
+             "exit 3 past --fail-on, 2 when no ledger reached the "
+             "audit")
+    p_dec.add_argument("--input", nargs="*", default=None,
+                       help="decisions_*.jsonl ledgers, snapshot/"
+                            "flight dumps (embedded ledger tails), or "
+                            "directories of either; several peers "
+                            "join into the audit (default: live "
+                            "fleet scrape)")
+    p_dec.add_argument("--peers", nargs="*", default=None,
+                       help="peer base URLs (http://host:port), or "
+                            "ONE path to a fleet_registry.json")
+    p_dec.add_argument("--registry", default=None,
+                       help="fleet_registry.json written at connect() "
+                            "(or the dir holding it)")
+    p_dec.add_argument("--timeout-s", type=float, default=2.0,
+                       help="per-peer scrape deadline in seconds "
+                            "(default 2.0)")
+    p_dec.add_argument("--format", default="text",
+                       choices=("text", "json"))
+    p_dec.add_argument("--fail-on", default=None,
+                       choices=("warn", "critical"),
+                       help="exit 3 when a decision-plane finding of "
+                            "this grade or worse fired (CI gate)")
     p_kb = sub.add_parser(
         "kernelbench",
         help="blocked-kernel microbench (ops/pallas/microbench.py): "
@@ -694,6 +838,8 @@ def main(argv=None) -> int:
         return _cmd_slo(args)
     if args.cmd == "cluster":
         return _cmd_cluster(args)
+    if args.cmd == "decisions":
+        return _cmd_decisions(args)
     return _cmd_keys(args)
 
 

@@ -188,6 +188,16 @@ class TpuShuffleConf:
         "history.retainWindows": "history retention, in windows, for "
                                  "both the ring and the on-disk log "
                                  "(default 120)",
+        "decisions.enabled": "decision ledger (shuffle/decisions.py): "
+                             "append every agree() round — winner/"
+                             "proposal digests, round wall ms, per-"
+                             "peer header lag — to a bounded ring "
+                             "plus (when history.dir is set) a rank-"
+                             "keyed decisions_p<rank>.jsonl (default "
+                             "on; off = null-object, zero overhead)",
+        "decisions.retain": "decision-ledger retention, in records, "
+                            "for both the ring and the on-disk log "
+                            "(default 256)",
         "slo.*": "service-level objectives (utils/slo.py): "
                  "slo.read.p99Ms (latency bound, ms), slo.read.target "
                  "(good fraction, default 0.99), slo.availability, "

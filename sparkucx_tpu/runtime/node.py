@@ -187,6 +187,25 @@ class TpuNode:
             # flight postmortems embed the SLO verdict at fault time —
             # the first thing an operator reads next to the findings
             self.flight.add_context_provider(self.slo_verdict)
+        # -- decision plane (shuffle/decisions.py) -----------------------
+        # Ledger of every agreement round this process closes: bounded
+        # ring plus (when history.dir is set) a rank-keyed JSONL beside
+        # the history log. Installed through the module seam so agree()
+        # and the turnstile — module-level, no node handle — reach it;
+        # flight postmortems embed the tail (last-decision position
+        # beside the last-span position).
+        from sparkucx_tpu.shuffle.decisions import (NULL_DECISION_LEDGER,
+                                                    DecisionLedger,
+                                                    set_ledger)
+        if conf.get_bool("decisions.enabled", True):
+            self.decisions = DecisionLedger(
+                retain=conf.get_int("decisions.retain", 256),
+                out_dir=conf.get("spark.shuffle.tpu.history.dir"),
+                process_id=process_id)
+        else:
+            self.decisions = NULL_DECISION_LEDGER
+        set_ledger(self.decisions)
+        self.flight.add_context_provider(self.decision_ledger)
         # Cost capture master switch (shuffle/stepcache.py harvest of
         # XLA cost/memory analysis per compiled program; on by default —
         # off keeps the records, nulls the fields).
@@ -224,7 +243,9 @@ class TpuNode:
         self.live = start_from_conf(
             conf, lambda: self.telemetry_provider(),
             lambda: self.doctor_provider(), self.health_status,
-            slo_fn=self.slo_verdict, cluster_fn=self._cluster_view)
+            slo_fn=self.slo_verdict, cluster_fn=self._cluster_view,
+            decisions_fn=(self.decision_ledger
+                          if self.decisions.enabled else None))
         # Fleet telemetry registry (utils/collector.py): publish this
         # process's scrape URL through ONE boot-time allgather (the live
         # server exists by now, so the URL does too), persist the agreed
@@ -367,6 +388,15 @@ class TpuNode:
                 extra["slo_objectives"] = [o.to_dict()
                                            for o in self.slo_objectives]
                 extra["slo_policy"] = self.slo_policy.to_dict()
+        # decision-ledger tail: every snapshot consumer — dumps, fleet
+        # scrapes, the doctor's build_view, the decisions CLI — sees the
+        # retained rounds without new plumbing (the history_frames
+        # carriage discipline). Bounded: the ring is already bounded.
+        decisions = getattr(self, "decisions", None)
+        if decisions is not None:
+            recs = decisions.tail()
+            if recs:
+                extra["decisions"] = recs
         return collect_snapshot(
             [GLOBAL_METRICS, self.metrics], tracer=self.tracer,
             reports=reports, extra=extra)
@@ -399,6 +429,21 @@ class TpuNode:
                            policy=self.slo_policy)
         self._slo_cache = (verdict, self.history.version)
         return verdict
+
+    def decision_ledger(self) -> dict:
+        """The decision plane's postmortem/live face: the last-decision
+        position (epoch/seq/topic — printed beside the last-span
+        position in peer postmortems) plus the retained tail. Flight
+        context provider (keyed ``decision_ledger``) AND the
+        ``/decisions`` live route serve this same doc."""
+        led = getattr(self, "decisions", None)
+        if led is None:
+            return {"enabled": False, "position": None, "decisions": []}
+        return {"enabled": bool(led.enabled),
+                "total": int(led.total),
+                "path": led.path,
+                "position": led.position(),
+                "decisions": led.tail()}
 
     def slo_fast_burn(self):
         """The /healthz face of the verdict: the burning objective
@@ -648,6 +693,15 @@ class TpuNode:
             set_global_watchdog(None)
         self.epochs.remove_listener(self._on_epoch_health)
         self.flight.remove_context_provider(self.slo_verdict)
+        self.flight.remove_context_provider(self.decision_ledger)
+        # drop the module-seam ledger if it is ours (a later node
+        # installs its own) — agree() after close records nowhere
+        from sparkucx_tpu.shuffle.decisions import (NULL_DECISION_LEDGER,
+                                                    current_ledger,
+                                                    set_ledger)
+        if current_ledger() is self.decisions:
+            set_ledger(NULL_DECISION_LEDGER)
+        self.decisions.close()
         self.flight.uninstall_abort_hook()
         self.metrics.remove_reporter(self.flight.metrics_reporter)
         self.epochs.remove_listener(self.flight.on_epoch_bump)

@@ -36,9 +36,25 @@ that missed a remesh diverges in the header — typed — instead of
 feeding a stale round into a fresh world.
 
 Anatomy: one :func:`agree` call is TWO allgather rounds — a fixed
-5-int64 header (epoch, seq, topic, payload length, reduction) that can
-never shape-mismatch, then the payload padded to the agreed maximum
-length. Both ride ``shuffle.barrier`` spans and the watchdog fence.
+6-int64 header (epoch, seq, topic, payload length, reduction, and a
+wall-clock send stamp that rides for free) that can never
+shape-mismatch, then the payload padded to the agreed maximum length.
+Both ride ``shuffle.barrier`` spans and the watchdog fence, wrapped in
+one ``shuffle.agree`` span (the ``agree`` phase of the conserved
+anatomy taxonomy, utils/anatomy.py).
+
+Observability (PR 20): every round — unanimous, reduced, divergent or
+peer-lost — lands one ``shuffle.agreement.rounds.count`` increment
+(plus its ``{topic=}`` twin), one ``shuffle.agreement.round_ms{topic=}``
+observation, and one :class:`~sparkucx_tpu.shuffle.decisions
+.DecisionLedger` record carrying the winner/proposal digests and the
+per-peer header arrival lag. The lag is recovered from the header
+stamps the allgather already serialized — the slowest proposer is
+attributable with NO new wire traffic (stamps come from different
+hosts' wall clocks, so cross-host lag is only as honest as NTP; the
+fleet scrape's ``skew_s`` estimate bounds that error). The turnstile
+records ticket issue→enter waits into ``shuffle.turnstile.wait_ms``
+and its outstanding-ticket depth into a gauge.
 
 Clients (the discipline generalized from ``agree_wave_count`` /
 ``agree_wave_sizes``, which now call through here): wave count and
@@ -52,6 +68,7 @@ submission order (tenancy.py) and the exact tier cross-row totals
 from __future__ import annotations
 
 import threading
+import time
 import zlib
 from typing import Callable, List, Optional, Sequence, Union
 
@@ -59,7 +76,10 @@ import numpy as np
 
 from sparkucx_tpu.utils.logging import get_logger
 from sparkucx_tpu.utils.metrics import (C_AGREE_DIVERGENCE, C_AGREE_ROUNDS,
-                                        GLOBAL_METRICS, labeled)
+                                        C_TURNSTILE_ABANDONED,
+                                        G_TURNSTILE_DEPTH, GLOBAL_METRICS,
+                                        H_AGREE_ROUND, H_TURNSTILE_WAIT,
+                                        labeled)
 
 log = get_logger("shuffle.agreement")
 
@@ -166,7 +186,7 @@ def _majority_row(rows: np.ndarray) -> np.ndarray:
 
 def agree(topic: str, payload, reduce: Optional[Union[str, Callable]]
           = None, conf_key: str = "", timeout_ms: Optional[float] = None,
-          metrics=None) -> np.ndarray:
+          metrics=None, audit: Optional[str] = None) -> np.ndarray:
     """COLLECTIVE: one named agreement round over an int64 payload
     vector. Every process must call with the same topic, in the same
     order relative to every other collective (the standing SPMD
@@ -184,6 +204,16 @@ def agree(topic: str, payload, reduce: Optional[Union[str, Callable]]
     ``timeout_ms`` overrides the channel watchdog's deadline for both
     rounds (per-tier deadlines thread through here). Returns the agreed
     / reduced [n] int64 vector.
+
+    ``audit`` declares the round's ledger-audit contract
+    (shuffle/decisions.py): ``"strict"`` — every peer derives its
+    proposal from conf, so differing proposals under a reducer ARE a
+    silent conf split the after-the-fact auditor must flag;
+    ``"aggregate"`` — proposals are by-design-divergent per-peer shares
+    (queue depths, row sums, votes) and the auditor must not. Default:
+    ``"strict"`` for unanimity rounds (the primitive enforces it
+    anyway), ``"aggregate"`` under a reducer — a reduced conf-guard
+    round must OPT IN to strict auditing.
     """
     from sparkucx_tpu.shuffle.distributed import allgather_blob
 
@@ -197,6 +227,13 @@ def agree(topic: str, payload, reduce: Optional[Union[str, Callable]]
                 f"{sorted(_REDUCERS)} or a callable")
         reduce_code = _REDUCE_CODES[reduce or "unanimous"]
     m = metrics if metrics is not None else GLOBAL_METRICS
+    reduce_name = ("callable" if callable(reduce)
+                   else (reduce or "unanimous"))
+    if audit is None:
+        audit = "strict" if reduce is None else "aggregate"
+    elif audit not in ("strict", "aggregate"):
+        raise ValueError(f"unknown audit contract {audit!r}; want "
+                         f"'strict' or 'aggregate'")
     # The round is ATOMIC per process: seq assignment and both
     # allgathers run under the agreement-plane mutex, so a concurrent
     # agree() from another thread can neither steal this round's seq
@@ -208,51 +245,109 @@ def agree(topic: str, payload, reduce: Optional[Union[str, Callable]]
         with _LOCK:
             epoch, seq = _STATE["epoch"], _STATE["seq"]
             _STATE["seq"] += 1
+        # EVERY exit counts: the increment (and its per-topic twin)
+        # lands before either gather, so a divergent or peer-lost round
+        # still shows in rounds.count and the per-topic divergence
+        # ratio divergence{topic=}/rounds{topic=} is computable
         try:
             m.inc(C_AGREE_ROUNDS, 1.0)
+            m.inc(labeled(C_AGREE_ROUNDS, topic=topic), 1.0)
         except Exception:
             pass
+        from sparkucx_tpu.utils.trace import GLOBAL_TRACER
+        note = {"winner": 0, "proposals": [], "lag": [], "nprocs": 1,
+                "ok": True, "error": ""}
+        t0 = time.perf_counter()
+        try:
+            with GLOBAL_TRACER.span("shuffle.agree", topic=topic):
+                return _run_round(topic, mine, reduce, reduce_code,
+                                  conf_key, timeout_ms, epoch, seq, m,
+                                  note)
+        except BaseException as e:
+            note["ok"] = False
+            if not note["error"]:
+                note["error"] = type(e).__name__
+            raise
+        finally:
+            round_ms = (time.perf_counter() - t0) * 1e3
+            try:
+                m.observe(H_AGREE_ROUND, round_ms)
+                m.observe(labeled(H_AGREE_ROUND, topic=topic), round_ms)
+            except Exception:
+                pass
+            from sparkucx_tpu.shuffle.decisions import current_ledger
+            current_ledger().record(
+                epoch=epoch, seq=seq, topic=topic, reduce=reduce_name,
+                nprocs=note["nprocs"], winner=note["winner"],
+                proposals=note["proposals"], round_ms=round_ms,
+                lag_ms=note["lag"], conf_key=conf_key, ok=note["ok"],
+                error=note["error"], audit=audit)
 
-        # Round 1: the fixed-shape header — epoch, sequence, topic,
-        # payload length, reduction. Fixed [5] on every process by
-        # construction, so this round can NEVER shape-mismatch; it
-        # catches the sequencing splits (different round entered)
-        # BEFORE the variable-length payload round could wedge the
-        # transport on mismatched shapes.
-        header = np.array([epoch, seq, _topic_code(topic), mine.shape[0],
-                           reduce_code], dtype=np.int64)
-        got_h = np.asarray(allgather_blob(
-            header, what=f"agreement header {topic!r} #{seq}",
-            timeout_ms=timeout_ms)).reshape(-1, 5)
-        if (got_h != got_h[0]).any():
-            maj = _majority_row(got_h)
-            dissent = [i for i in range(got_h.shape[0])
-                       if (got_h[i] != maj).any()]
-            _note_divergence(topic, m)
-            raise AgreementDivergenceError(
-                topic, "sequencing", dissent,
-                [r.tolist() for r in got_h], conf_key=conf_key,
-                detail="processes entered different agreement rounds "
-                       "(header = [epoch, seq, topic, len, reduce]) — a "
-                       "divergent conf or a missed remesh")
 
-        # Round 2: the payload, at the agreed length.
-        got = np.asarray(allgather_blob(
-            mine, what=f"agreement {topic!r} #{seq}",
-            timeout_ms=timeout_ms)).reshape(-1, mine.shape[0])
-        if callable(reduce):
-            return np.asarray(reduce(got), dtype=np.int64)
-        if reduce is not None:
-            return _REDUCERS[reduce](got).astype(np.int64)
-        if (got != got[0]).any():
-            maj = _majority_row(got)
-            dissent = [i for i in range(got.shape[0])
-                       if (got[i] != maj).any()]
-            _note_divergence(topic, m)
-            raise AgreementDivergenceError(
-                topic, "value", dissent, [r.tolist() for r in got],
-                conf_key=conf_key)
-        return got[0].copy()
+def _run_round(topic, mine, reduce, reduce_code, conf_key, timeout_ms,
+               epoch, seq, m, note):
+    """One round's two gathers under the already-held round mutex.
+    ``note`` collects what the caller's settlement (metrics + ledger)
+    records on every exit path."""
+    from sparkucx_tpu.shuffle.decisions import digest_row
+    from sparkucx_tpu.shuffle.distributed import allgather_blob
+
+    # Round 1: the fixed-shape header — epoch, sequence, topic,
+    # payload length, reduction, send stamp. Fixed [6] on every
+    # process by construction, so this round can NEVER shape-mismatch;
+    # it catches the sequencing splits (different round entered)
+    # BEFORE the variable-length payload round could wedge the
+    # transport on mismatched shapes. The send stamp (wall-clock ms)
+    # is EXCLUDED from the divergence check — it legitimately differs —
+    # and exists purely so per-peer arrival lag is recoverable from
+    # the gather every round already pays for.
+    header = np.array([epoch, seq, _topic_code(topic), mine.shape[0],
+                       reduce_code, int(time.time() * 1e3)],
+                      dtype=np.int64)
+    got_h = np.asarray(allgather_blob(
+        header, what=f"agreement header {topic!r} #{seq}",
+        timeout_ms=timeout_ms)).reshape(-1, 6)
+    note["nprocs"] = int(got_h.shape[0])
+    stamps = got_h[:, 5]
+    note["lag"] = [float(v) for v in (stamps - stamps.min())]
+    if (got_h[:, :5] != got_h[0, :5]).any():
+        maj = _majority_row(got_h[:, :5])
+        dissent = [i for i in range(got_h.shape[0])
+                   if (got_h[i, :5] != maj).any()]
+        _note_divergence(topic, m)
+        note["error"] = "sequencing"
+        note["proposals"] = [digest_row(r) for r in got_h[:, :5]]
+        raise AgreementDivergenceError(
+            topic, "sequencing", dissent,
+            [r.tolist() for r in got_h[:, :5]], conf_key=conf_key,
+            detail="processes entered different agreement rounds "
+                   "(header = [epoch, seq, topic, len, reduce]) — a "
+                   "divergent conf or a missed remesh")
+
+    # Round 2: the payload, at the agreed length.
+    got = np.asarray(allgather_blob(
+        mine, what=f"agreement {topic!r} #{seq}",
+        timeout_ms=timeout_ms)).reshape(-1, mine.shape[0])
+    note["proposals"] = [digest_row(r) for r in got]
+    if callable(reduce):
+        out = np.asarray(reduce(got), dtype=np.int64)
+        note["winner"] = digest_row(out)
+        return out
+    if reduce is not None:
+        out = _REDUCERS[reduce](got).astype(np.int64)
+        note["winner"] = digest_row(out)
+        return out
+    if (got != got[0]).any():
+        maj = _majority_row(got)
+        dissent = [i for i in range(got.shape[0])
+                   if (got[i] != maj).any()]
+        _note_divergence(topic, m)
+        note["error"] = "value"
+        raise AgreementDivergenceError(
+            topic, "value", dissent, [r.tolist() for r in got],
+            conf_key=conf_key)
+    note["winner"] = digest_row(got[0])
+    return got[0].copy()
 
 
 class CollectiveTurnstile:
@@ -275,14 +370,32 @@ class CollectiveTurnstile:
     work was abandoned (dispatch failure, executor stop) marks itself
     done and the turn skips over it — an abandoned ticket must never
     wedge the tickets behind it. ``close`` fails all waiters typed
-    (executor shutdown)."""
+    (executor shutdown).
 
-    def __init__(self):
+    Telemetry (PR 20): each ticket's issue→enter wait lands in
+    ``shuffle.turnstile.wait_ms`` (how long agreed-order sections queue
+    behind earlier tickets — the decision-plane analogue of
+    admission_wait), the outstanding-ticket count rides a queue-depth
+    gauge, and a ticket released without ever entering counts as
+    abandoned. All best-effort: the turnstile must never fail a
+    shuffle over a metrics fault."""
+
+    def __init__(self, metrics=None):
         self._cv = threading.Condition()
         self._next = 0          # next unissued ticket
         self._turn = 0          # lowest unreleased ticket
         self._done = set()      # released out of turn, not yet passed
         self._closed = False
+        self._m = metrics if metrics is not None else GLOBAL_METRICS
+        self._issued_at = {}    # ticket -> perf_counter at issue
+        self._entered = set()   # tickets that reached their turn
+
+    def _gauge_depth_locked(self) -> None:
+        try:
+            self._m.set_gauge(G_TURNSTILE_DEPTH,
+                              float(self._next - self._turn))
+        except Exception:
+            pass
 
     def issue(self) -> int:
         """Take the next ticket. Call in the agreed order (single
@@ -290,6 +403,8 @@ class CollectiveTurnstile:
         with self._cv:
             t = self._next
             self._next += 1
+            self._issued_at[t] = time.perf_counter()
+            self._gauge_depth_locked()
             return t
 
     def acquire(self, ticket: int) -> None:
@@ -307,6 +422,15 @@ class CollectiveTurnstile:
                         f"collective ticket {ticket} was already "
                         f"released")
                 if self._turn == ticket:
+                    self._entered.add(ticket)
+                    t0 = self._issued_at.get(ticket)
+                    if t0 is not None:
+                        try:
+                            self._m.observe(
+                                H_TURNSTILE_WAIT,
+                                (time.perf_counter() - t0) * 1e3)
+                        except Exception:
+                            pass
                     return
                 self._cv.wait(0.2)
 
@@ -316,10 +440,21 @@ class CollectiveTurnstile:
         with self._cv:
             if ticket < self._turn or ticket in self._done:
                 return
+            if ticket not in self._entered and ticket in self._issued_at:
+                # released without ever entering: the abandoned-ticket
+                # path (dispatch failure / executor stop) — legal, but
+                # counted so a surge of thrown-away work is visible
+                try:
+                    self._m.inc(C_TURNSTILE_ABANDONED, 1.0)
+                except Exception:
+                    pass
+            self._issued_at.pop(ticket, None)
+            self._entered.discard(ticket)
             self._done.add(ticket)
             while self._turn in self._done:
                 self._done.discard(self._turn)
                 self._turn += 1
+            self._gauge_depth_locked()
             self._cv.notify_all()
 
     def close(self) -> None:

@@ -275,6 +275,15 @@ class ExchangeReport:
     dark_ms: float = 0.0
     anatomy_wall_ms: float = 0.0
     dark_intervals: List[List[float]] = field(default_factory=list)
+    # Decision-plane summary (shuffle/decisions.py, stamped at
+    # settlement): the agreement rounds THIS process closed during the
+    # read's wall — {"rounds", "agree_ms", "slowest_topic"} — diffed
+    # from the ledger's monotonic append index, so it is ring-wrap safe
+    # and free when the plane is off (the NULL ledger yields {}).
+    # Per-process activity during the wall, not a per-read causal join:
+    # a concurrent async read's rounds land in whichever report's
+    # window they close in.
+    agreement: Dict = field(default_factory=dict)
     completed: bool = False
     error: Optional[str] = None
     # bookkeeping, excluded from to_dict()
@@ -291,6 +300,9 @@ class ExchangeReport:
     # exchange sequence (the x<seq> of the trace id) — the int8 noise
     # base every dispatch of this read derives its streams from
     _seq: int = field(default=0, repr=False)
+    # decision-ledger monotonic index at read start (-1 = plane off) —
+    # settlement diffs it into the public ``agreement`` summary
+    _agree_mark: int = field(default=-1, repr=False)
 
     # public field names, resolved once: to_dict runs per report per
     # doctor/stats/dump pass, and dataclasses.asdict's recursive deepcopy
@@ -1275,6 +1287,14 @@ class TpuShuffleManager:
         # (approximate under concurrent reads, exact in the common case)
         rep._hits0 = GLOBAL_METRICS.get(COMPILE_HITS)
         rep._prog0 = GLOBAL_METRICS.get(COMPILE_PROGRAMS)
+        # decision-ledger position at read start: settlement diffs the
+        # monotonic append index into report.agreement (same
+        # window-delta discipline as the compile counters above)
+        try:
+            from sparkucx_tpu.shuffle.decisions import current_ledger
+            rep._agree_mark = int(current_ledger().total)
+        except Exception:
+            rep._agree_mark = -1
         with self._lock:
             # Exchange sequence: reads are collective and execute in the
             # same order on every process, so this per-process counter
@@ -2651,6 +2671,7 @@ class TpuShuffleManager:
                     report.completed = True
                 else:
                     report.error = report.error or "exchange failed"
+                self._settle_agreement(report)
                 # exchange anatomy: close the wall span, fold the phase
                 # ledger, publish phase counters (utils/anatomy.py);
                 # two cheap guards when the tracer is off. The settle
@@ -2679,6 +2700,36 @@ class TpuShuffleManager:
                     self._verify_full_result(handle, res, combine)
 
         return on_done, arm
+
+    def _settle_agreement(self, report: ExchangeReport) -> None:
+        """Decision-plane settlement: diff the ledger's monotonic index
+        against the read-start mark into the public ``agreement``
+        summary — rounds closed, wall ms spent agreeing, and the
+        slowest topic (by total ms). Plane off (NULL ledger) or no
+        rounds = the summary stays ``{}``; never raises (telemetry must
+        never fail a shuffle)."""
+        if report._agree_mark < 0:
+            return
+        try:
+            from sparkucx_tpu.shuffle.decisions import current_ledger
+            recs = current_ledger().since(report._agree_mark)
+            if not recs:
+                return
+            by_topic: Dict[str, float] = {}
+            for r in recs:
+                t = r.get("topic", "?")
+                by_topic[t] = by_topic.get(t, 0.0) \
+                    + float(r.get("round_ms", 0.0))
+            slowest = max(by_topic.items(), key=lambda kv: kv[1])[0]
+            report.agreement = {
+                "rounds": len(recs),
+                "agree_ms": round(sum(by_topic.values()), 3),
+                "slowest_topic": slowest,
+                "divergent": sum(1 for r in recs
+                                 if not r.get("ok", True)),
+            }
+        except Exception:
+            pass
 
     def _settle_anatomy(self, report: ExchangeReport,
                         completed: bool) -> None:

@@ -57,7 +57,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 # The canonical taxonomy, ledger-table order. dark_time is NOT a phase:
 # it is the audit's residual, reported beside these.
 PHASES: Tuple[str, ...] = (
-    "plan", "compile", "pack", "admission_wait", "barrier_wait",
+    "plan", "compile", "pack", "admission_wait", "agree", "barrier_wait",
     "transfer.ici", "transfer.dcn", "merge", "sink", "spill", "verify")
 
 DARK = "dark_time"
@@ -72,7 +72,8 @@ DARK = "dark_time"
 # between the precise spans, never to win over one.
 _PRIORITY: Dict[str, int] = {p: i for i, p in enumerate((
     "transfer.dcn", "transfer.ici", "merge", "sink", "spill", "verify",
-    "admission_wait", "barrier_wait", "compile", "pack", "plan"))}
+    "admission_wait", "agree", "barrier_wait", "compile", "pack",
+    "plan"))}
 
 # The exchange wall span name (recorded at settlement by the manager).
 WALL_SPAN = "shuffle.exchange"
@@ -89,6 +90,12 @@ SPAN_PHASE: Dict[str, str] = {
     "shuffle.dispatch": "pack",
     "shuffle.wave": "pack",
     "shuffle.admit.wait": "admission_wait",
+    # agree() envelope (shuffle/agreement.py): one decision round's two
+    # header/payload gathers. Outranks barrier_wait in the sweep so the
+    # shuffle.barrier spans it CONTAINS attribute to the decision, not
+    # to generic barrier blocking — phase_regression then watches
+    # decision stalls for free.
+    "shuffle.agree": "agree",
     "shuffle.barrier": "barrier_wait",
     "shuffle.merge": "merge",
     "shuffle.fetch": "sink",
@@ -102,6 +109,7 @@ SPAN_PHASE: Dict[str, str] = {
 # an exact ``trace`` attr match.
 _CONTAINMENT_OK = frozenset((
     "compile.step", "shuffle.barrier", "shuffle.exchange.wait",
+    "shuffle.agree",
     "shuffle.fetch", "shuffle.merge", "shuffle.spill",
     "shuffle.hier.build", "shuffle.result",
     # the pending-side redispatch (overflow retry, deferred admission)

@@ -302,6 +302,7 @@ class ClusterCollector:
             doc = cell.get("doc")
             if isinstance(doc, dict):
                 entry["last_known"] = last_known_phase(doc, trace)
+                entry["last_decision"] = last_known_decision(doc)
             peers[pid] = entry
         return {"what": what, "trace": trace or "",
                 "generated_at": view["generated_at"],
@@ -422,6 +423,29 @@ def last_known_phase(doc: Dict, trace_id: Optional[str] = None) -> Dict:
             "phase": _span_phase(str(ev.get("name", "")), args),
             "trace_id": args.get("trace") or trace_id,
             "since_s": since}
+
+
+def last_known_decision(doc: Dict) -> Optional[Dict]:
+    """A peer's last-closed agreement round from its scraped decision
+    ledger (shuffle/decisions.py records embedded in the snapshot) —
+    the decision-plane twin of ``last_known_phase``, printed beside it
+    in the watchdog's ``peer_postmortem``. A peer wedged INSIDE an
+    agreement round shows its previous round here (records land on
+    round EXIT), so "last decision (epoch,seq) lags the fleet" is the
+    signature of a peer parked in the agreement collective. ``None``
+    when the peer has no ledger (plane disabled, or pre-PR-20 doc)."""
+    recs = doc.get("decisions")
+    if not isinstance(recs, list) or not recs:
+        return None
+    last = recs[-1]
+    if not isinstance(last, dict):
+        return None
+    out = {k: last.get(k) for k in
+           ("epoch", "seq", "topic", "ok", "ts", "winner")}
+    out["since_s"] = (round(time.time() - float(last["ts"]), 3)
+                      if isinstance(last.get("ts"), (int, float))
+                      else None)
+    return out
 
 
 # -- CLI-side peer resolution ----------------------------------------------

@@ -114,6 +114,20 @@ phase_regression  ONE canonical phase's windowed           per phase (anatomy._P
                   payload-normalized like latency_trend    admission_wait -> a2a.maxBytesInFlight)
                   — names WHICH phase is eating the
                   wall and the knob that moves it
+decision_split    the decision-ledger auditor              per topic (_DESYNC_CONF — e.g.
+                  (shuffle/decisions.py) aligned peers'    hier.* -> a2a.capacityFactor);
+                  ledgers by (epoch, seq) and found a      decisions.enabled when the audit
+                  round that closed with different         is partial (missing ledgers)
+                  topics/winners/proposals across peers
+                  — catches the SILENT split a named
+                  reduce (min/max/sum) settles without
+                  raising; no floor, always critical
+slow_proposer     one process is consistently the last     spark.shuffle.tpu.failure.
+                  header to arrive across agreement        collectiveTimeoutMs
+                  rounds (per-peer send-stamp lag in
+                  every ledger record) — floors: min
+                  audited rounds, min ms lag, dominance
+                  share
 ================  =======================================  =====================================
 
 The same :class:`Finding` schema carries ``bench.py --stage regress``
@@ -372,6 +386,25 @@ class Thresholds:
     # or broken SPMD discipline, never load noise. Critical once it
     # repeats: the disagreement is systematic, not a one-off race.
     desync_critical: int = 2
+    # decision_split: the decision-ledger auditor (shuffle/decisions.py
+    # audit_round over per-peer ledgers aligned by (epoch, seq)) found
+    # peers that closed the SAME round with different topics, winners,
+    # or — the silent case agree()'s reducers never surface — different
+    # proposals under a named reduce (min/max/sum settle without a
+    # unanimity check, so a conf split just silently loses). NO noise
+    # floor, the desync posture: one split round is already broken SPMD
+    # discipline. Always critical — by the time the auditor sees it the
+    # fleet has already acted on divergent inputs.
+    # slow_proposer: per-peer header-round arrival lag (the send stamps
+    # every agree() header carries) says ONE peer is consistently the
+    # last to arrive across many rounds — the agreement plane's
+    # straggler attribution. Floors per the PR-5 discipline: enough
+    # audited rounds to call it a pattern, a real ms lag (sub-ms is
+    # scheduler noise), and a dominance share so a peer that is merely
+    # sometimes-last stays unnamed.
+    slow_proposer_min_rounds: int = 8
+    slow_proposer_min_lag_ms: float = 5.0
+    slow_proposer_share: float = 0.7
 
 
 # -- snapshot normalization ------------------------------------------------
@@ -401,6 +434,11 @@ class ClusterView:
     # docs came from a ClusterCollector scrape — the fleet-aware rules
     # (peer_unresponsive, clock_drift) read it and stay quiet without.
     fleet: Optional[Dict] = None
+    # decision-ledger records (shuffle/decisions.py) keyed by
+    # process_id — per-peer separation is the POINT (the auditor aligns
+    # peers' records by (epoch, seq) to catch split decisions), so
+    # unlike counters these never fold together.
+    decisions: Dict[int, List[Dict]] = field(default_factory=dict)
 
 
 def _reports_of(doc: Dict) -> List[Dict]:
@@ -433,6 +471,7 @@ def build_view(snapshots: Union[Dict, Iterable[Dict]],
     gauges: List[Dict] = []
     frames: List[Dict] = []
     objectives: List[Dict] = []
+    decisions: Dict[int, List[Dict]] = {}
     seen_obj = set()
     policy = None
     for i, doc in enumerate(docs):
@@ -454,6 +493,17 @@ def build_view(snapshots: Union[Dict, Iterable[Dict]],
         if isinstance(doc.get("gauges"), dict) and doc["gauges"]:
             gauges.append({"process_id": pid,
                            "values": dict(doc["gauges"])})
+        # decision-ledger records keep per-process separation (the
+        # auditor compares peers — folding would erase the split);
+        # same-process duplicates union by the record's monotonic n
+        recs = doc.get("decisions")
+        if isinstance(recs, list) and recs:
+            slot = decisions.setdefault(int(pid) if isinstance(
+                pid, (int, float)) else i, [])
+            seen_n = {r.get("n") for r in slot}
+            slot.extend(r for r in recs if isinstance(r, dict)
+                        and r.get("n") not in seen_n)
+            slot.sort(key=lambda r: r.get("n", 0))
         for f in (doc.get("history_frames") or []):
             if isinstance(f, dict):
                 f = dict(f)
@@ -479,7 +529,8 @@ def build_view(snapshots: Union[Dict, Iterable[Dict]],
     return ClusterView(counters, hists, reports, pools, gauges,
                        frames=frames, slo_objectives=objectives,
                        slo_policy=policy,
-                       processes=max(1, len(docs)), fleet=fleet)
+                       processes=max(1, len(docs)), fleet=fleet,
+                       decisions=decisions)
 
 
 def _median(vals: List[float]) -> float:
@@ -1767,6 +1818,7 @@ _PHASE_CONF = {
     "compile": "spark.shuffle.tpu.a2a.capBucketGrowth",
     "pack": "spark.shuffle.tpu.a2a.waveRows",
     "admission_wait": "spark.shuffle.tpu.a2a.maxBytesInFlight",
+    "agree": "spark.shuffle.tpu.failure.collectiveTimeoutMs",
     "barrier_wait": "spark.shuffle.tpu.failure.collectiveTimeoutMs",
     "transfer.ici": "spark.shuffle.tpu.a2a.wire",
     "transfer.dcn": "spark.shuffle.tpu.a2a.wire",
@@ -2026,14 +2078,21 @@ def _rule_clock_drift(view: ClusterView, th: Thresholds) -> List[Finding]:
 # cross-process split most plausibly produced the divergence. Derived
 # from the agree() call sites: a2a.waveRows/waveSizes (distributed
 # split-tier wave programs), hier.<tier>.overflow/regrow (capacity
-# ladder), replay.enter (collective replay budget), async.batch/order
-# (K-worker agreed submission order), tier.crossRows (exact distributed
-# tier accounting).
+# ladder), replay.enter (collective replay budget), async.batch (the
+# reduce-min batch bound) and async.order (the K-worker agreed
+# submission order whose turnstile tickets serialize collective
+# sections — a split here means peers queued different work or
+# resolved different tenant weights), turnstile.* (rounds the
+# CollectiveTurnstile itself closes under its ticket), tier.crossRows
+# (exact distributed tier accounting). Exact topics list before their
+# covering prefix so first-match wins stays correct.
 _DESYNC_CONF = (
     ("a2a.", "spark.shuffle.tpu.a2a.waveRows"),
     ("hier.", "spark.shuffle.tpu.a2a.capacityFactor"),
     ("replay.", "spark.shuffle.tpu.failure.replayBudget"),
+    ("async.order", "spark.shuffle.tpu.tenant.asyncAgreedOrder"),
     ("async.", "spark.shuffle.tpu.tenant.asyncAgreedOrder"),
+    ("turnstile.", "spark.shuffle.tpu.tenant.asyncAgreedOrder"),
     ("tier.", "spark.shuffle.tpu.a2a.topology"),
 )
 
@@ -2072,6 +2131,27 @@ def _rule_desync(view: ClusterView, th: Thresholds) -> List[Finding]:
                        for t, n in sorted(by_topic.items())) \
         or "unknown"
     rounds = float(view.counters.get(C_AGREE_ROUNDS, 0.0))
+    # link the newest divergent decision-ledger record (PR-20): the
+    # (epoch, seq) coordinate an operator feeds straight to the
+    # ``decisions`` CLI to see every peer's side of the round
+    ledger_rec = None
+    for recs in view.decisions.values():
+        for r in recs:
+            if r.get("ok", True):
+                continue
+            if ledger_rec is None or r.get("ts", 0.0) > \
+                    ledger_rec.get("ts", 0.0):
+                ledger_rec = r
+    evidence = {"divergences": int(total),
+                "by_topic": {t: int(n)
+                             for t, n in sorted(by_topic.items())},
+                "implicated_conf_keys": {
+                    k: int(n) for k, n in sorted(keys.items())},
+                "agreement_rounds": int(rounds)}
+    if ledger_rec is not None:
+        evidence["ledger_record"] = {
+            k: ledger_rec.get(k)
+            for k in ("epoch", "seq", "topic", "error", "process_id")}
     return [Finding(
         rule="desync",
         grade="critical" if total >= th.desync_critical else "warn",
@@ -2080,12 +2160,7 @@ def _rule_desync(view: ClusterView, th: Thresholds) -> List[Finding]:
                  f"a decision that must be identical cluster-wide; the "
                  f"exchange fails typed instead of deadlocking, but "
                  f"the cluster is running a split configuration"),
-        evidence={"divergences": int(total),
-                  "by_topic": {t: int(n)
-                               for t, n in sorted(by_topic.items())},
-                  "implicated_conf_keys": {
-                      k: int(n) for k, n in sorted(keys.items())},
-                  "agreement_rounds": int(rounds)},
+        evidence=evidence,
         conf_key=conf_key,
         remediation=("diff the named conf key (and the full "
                      "spark.shuffle.tpu.* block) across processes — "
@@ -2098,6 +2173,165 @@ def _rule_desync(view: ClusterView, th: Thresholds) -> List[Finding]:
                      "agreed decision on those hosts"))]
 
 
+def _rule_decision_split(view: ClusterView,
+                         th: Thresholds) -> List[Finding]:
+    """Decision-ledger audit (shuffle/decisions.py): align every peer's
+    ledger by (epoch, seq) and require each round to have closed with
+    the same topic, the same winner digest, and — under a named reduce
+    — the same proposal multiset. This is the rule that catches the
+    SILENT split ``agree()`` cannot: a min/max/sum-reduced round
+    settles without a unanimity check, so peers feeding divergent
+    values (a conf split under a reduced topic) just quietly lose the
+    reduction and keep running on an answer they never proposed. No
+    noise floor, always critical — by audit time the fleet already
+    acted on the divergent inputs. A peer whose ledger is missing
+    (plane disabled, dump lost) degrades the audit to a warn naming
+    the blind spot — never a crash, and never silence."""
+    if not view.decisions:
+        return []
+    from sparkucx_tpu.shuffle.decisions import align_rounds, audit_round
+    findings: List[Finding] = []
+    expected = set(view.decisions)
+    if view.processes > len(expected):
+        findings.append(Finding(
+            rule="decision_split",
+            grade="warn",
+            summary=(f"decision-ledger audit is PARTIAL: "
+                     f"{len(expected)} of {view.processes} processes "
+                     f"contributed a ledger — split decisions on the "
+                     f"missing peers are invisible to this audit"),
+            evidence={"ledgers": sorted(expected),
+                      "processes": view.processes},
+            conf_key="spark.shuffle.tpu.decisions.enabled",
+            remediation=("enable the decision ledger on every process "
+                         "(decisions.enabled, on by default) and set "
+                         "history.dir so the JSONL survives restarts; "
+                         "re-run the audit over a complete dump set")))
+    aligned = align_rounds(view.decisions)
+    splits = []
+    for row in aligned:
+        verdict = audit_round(row)
+        if verdict is not None:
+            splits.append((row, verdict))
+    if not splits:
+        return findings
+    # charge the dominant split topic's conf key, desync-table mapping
+    keys: Dict[str, float] = {}
+    rows_ev = []
+    for row, verdict in splits:
+        recs = row["records"]
+        any_rec = next(iter(recs.values()))
+        topic = str(any_rec.get("topic", ""))
+        ck = verdict.get("conf_key") or ""
+        if not ck:
+            for prefix, key in _DESYNC_CONF:
+                if topic.startswith(prefix):
+                    ck = key
+                    break
+            else:
+                ck = "spark.shuffle.tpu.*"
+        keys[ck] = keys.get(ck, 0.0) + 1.0
+        rows_ev.append({"epoch": row["epoch"], "seq": row["seq"],
+                        "topic": topic, "split": verdict["split"],
+                        "dissenters": verdict["dissenters"],
+                        "conf_key": ck})
+    conf_key = max(keys.items(), key=lambda kv: kv[1])[0]
+    worst = rows_ev[-1]
+    findings.append(Finding(
+        rule="decision_split",
+        grade="critical",
+        summary=(f"{len(splits)} agreement round(s) closed SPLIT "
+                 f"across peers (newest: topic {worst['topic']!r} at "
+                 f"epoch {worst['epoch']} seq {worst['seq']}, "
+                 f"{worst['split']} split, dissenting process(es) "
+                 f"{worst['dissenters']}) — the fleet is running on "
+                 f"divergent decisions it believes were agreed"),
+        evidence={"split_rounds": rows_ev[-8:],
+                  "splits": len(splits),
+                  "rounds_audited": len(aligned),
+                  "implicated_conf_keys": {
+                      k: int(n) for k, n in sorted(keys.items())}},
+        conf_key=conf_key,
+        remediation=("diff the named conf key across the dissenting "
+                     "processes' launch confs — a reduced topic "
+                     "(min/max/sum) settles silently, so this audit is "
+                     "the ONLY detector; replay the round with "
+                     "`python -m sparkucx_tpu decisions --input <dump>`"
+                     " to see every peer's proposal digest")))
+    return findings
+
+
+def _rule_slow_proposer(view: ClusterView,
+                        th: Thresholds) -> List[Finding]:
+    """Agreement-plane straggler attribution: every ``agree()`` header
+    carries its sender's wall-clock send stamp, so each ledger record
+    holds the per-peer arrival lag of its header round — zero for the
+    last arrival's own stamp baseline, positive for everyone it kept
+    waiting. When ONE process is the slowest proposer across most
+    audited rounds (share floor) with a real lag (ms floor, NTP-skew
+    noise stays under it), the fleet's agreement latency is that
+    peer's scheduling/network problem, not the primitive's. Floors per
+    the PR-5 discipline; names the peer and the timeout knob that
+    bounds the damage."""
+    if not view.decisions or len(view.decisions) < 2:
+        # lag columns are identical on every peer (same gathered
+        # stamps) but attribution needs a real multi-process fleet
+        return []
+    # dedupe rounds across peers: every peer logs the same lag row
+    rounds: Dict[tuple, List[float]] = {}
+    for recs in view.decisions.values():
+        for r in recs:
+            lag = r.get("lag_ms")
+            if not isinstance(lag, list) or len(lag) < 2 \
+                    or not r.get("ok", True):
+                continue
+            rounds.setdefault((r.get("epoch"), r.get("seq")),
+                              [float(v) for v in lag])
+    if len(rounds) < th.slow_proposer_min_rounds:
+        return []
+    nprocs = max(len(v) for v in rounds.values())
+    last_count = [0] * nprocs
+    lag_sum = [0.0] * nprocs
+    for lag in rounds.values():
+        worst = max(range(len(lag)), key=lambda i: lag[i])
+        if lag[worst] >= th.slow_proposer_min_lag_ms:
+            last_count[worst] += 1
+        for i, v in enumerate(lag):
+            lag_sum[i] += v
+    total_slow = sum(last_count)
+    if total_slow < th.slow_proposer_min_rounds:
+        return []
+    culprit = max(range(nprocs), key=lambda i: last_count[i])
+    share = last_count[culprit] / float(total_slow)
+    if share < th.slow_proposer_share:
+        return []
+    mean_lag = lag_sum[culprit] / max(1, len(rounds))
+    return [Finding(
+        rule="slow_proposer",
+        grade="warn",
+        summary=(f"process {culprit} arrived last in "
+                 f"{last_count[culprit]} of {total_slow} lagged "
+                 f"agreement round(s) ({share:.0%}; mean lag "
+                 f"{mean_lag:.1f} ms over {len(rounds)} audited "
+                 f"rounds) — every peer's control decisions wait on "
+                 f"this one proposer"),
+        evidence={"process": culprit,
+                  "slow_rounds": last_count[culprit],
+                  "lagged_rounds": total_slow,
+                  "rounds_audited": len(rounds),
+                  "share": round(share, 3),
+                  "mean_lag_ms": round(mean_lag, 3),
+                  "per_process_slow_counts": last_count},
+        conf_key="spark.shuffle.tpu.failure.collectiveTimeoutMs",
+        remediation=(f"inspect process {culprit}'s host (CPU "
+                     "contention, NUMA/NIC placement, GC or page-cache "
+                     "pressure stall its header sends); the lag rides "
+                     "wall-clock stamps, so first rule out NTP skew "
+                     "via the clock_drift finding — and keep "
+                     "collectiveTimeoutMs above the observed lag so "
+                     "slow never escalates to timed-out"))]
+
+
 _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_compile_churn, _rule_pool_pressure, _rule_overflow_loop,
           _rule_cold_start, _rule_pipeline_stall, _rule_hbm_pressure,
@@ -2108,7 +2342,8 @@ _RULES = (_rule_straggler, _rule_skew, _rule_retry_storm,
           _rule_quota_starvation, _rule_slow_tier,
           _rule_slo_burn, _rule_latency_trend, _rule_spill_bound,
           _rule_dark_time, _rule_phase_regression,
-          _rule_peer_unresponsive, _rule_clock_drift, _rule_desync)
+          _rule_peer_unresponsive, _rule_clock_drift, _rule_desync,
+          _rule_decision_split, _rule_slow_proposer)
 
 
 def diagnose(snapshots: Union[Dict, Iterable[Dict]],

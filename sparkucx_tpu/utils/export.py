@@ -273,6 +273,21 @@ def dedupe_process_docs(docs: Iterable[Dict]) -> List[Dict]:
                                       doc["slo_objectives"])
                 if doc.get("slo_policy"):
                     merged.setdefault("slo_policy", doc["slo_policy"])
+        # decision-ledger records union the same way: the durable
+        # decisions JSONL (decisions_to_doc) outlives the snapshot's
+        # bounded tail, and the auditor needs every round it can get —
+        # records carry a per-process monotonic ``n``, so dedupe is
+        # exact
+        seen_n, decs = set(), []
+        for doc in group:
+            for r in (doc.get("decisions") or []):
+                if not isinstance(r, dict) or r.get("n") in seen_n:
+                    continue
+                seen_n.add(r.get("n"))
+                decs.append(r)
+        if decs:
+            decs.sort(key=lambda r: r.get("n", 0))
+            merged["decisions"] = decs
         out.append(merged)
     return out
 

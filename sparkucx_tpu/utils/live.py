@@ -25,6 +25,9 @@ endpoint   serves
            path (utils/anatomy.py ``report_from_docs`` folded from the
            live snapshot's span ring); ``?trace=<id>`` restricts to
            one exchange — the same document the anatomy CLI renders
+/decisions the node's decision-ledger doc (shuffle/decisions.py): the
+           newest ``agree()`` round records plus position/total — the
+           live twin of the ``decisions_p<rank>.jsonl`` dump file
 /healthz   200/503 liveness: node open, no epoch bump pending
            re-registration, no device flagged unhealthy, no SLO fast
            burn; the JSON body carries the epoch, the human ``reason``
@@ -72,11 +75,13 @@ class LiveTelemetryServer:
                  health_fn: Callable[[], Dict],
                  port: int = 0, host: str = "127.0.0.1",
                  slo_fn: Optional[Callable[[], Dict]] = None,
-                 cluster_fn: Optional[Callable[[], Dict]] = None):
+                 cluster_fn: Optional[Callable[[], Dict]] = None,
+                 decisions_fn: Optional[Callable[[], Dict]] = None):
         self._snapshot_fn = snapshot_fn
         self._doctor_fn = doctor_fn
         self._health_fn = health_fn
         self._slo_fn = slo_fn
+        self._decisions_fn = decisions_fn
         # returns the ClusterCollector fleet view (utils/collector.py)
         # or None while no fleet registry exists on this node — the
         # /cluster/* routes 404 with a reason instead of guessing.
@@ -108,7 +113,8 @@ class LiveTelemetryServer:
     def start(self) -> "LiveTelemetryServer":
         self._thread.start()
         log.info("live telemetry server up at %s (/metrics /snapshot "
-                 "/doctor /slo /anatomy /healthz /cluster/*)", self.url)
+                 "/doctor /slo /anatomy /decisions /healthz /cluster/*)",
+                 self.url)
         return self
 
     def stop(self) -> None:
@@ -151,6 +157,18 @@ class LiveTelemetryServer:
                                json.dumps(self._slo_fn(), indent=1,
                                           default=repr),
                                "application/json")
+            elif path == "/decisions":
+                if self._decisions_fn is None:
+                    self._send(req, 404, json.dumps(
+                        {"error": "no decision ledger on this node "
+                                  "(spark.shuffle.tpu.decisions.enabled"
+                                  "=false)"}),
+                        "application/json")
+                else:
+                    self._send(req, 200,
+                               json.dumps(self._decisions_fn(), indent=1,
+                                          default=repr),
+                               "application/json")
             elif path == "/anatomy":
                 # folded FROM the canonical snapshot (one seam): the
                 # doc embeds the span ring, so the ledgers and the
@@ -177,8 +195,9 @@ class LiveTelemetryServer:
                 self._send(req, 404, json.dumps(
                     {"error": f"unknown path {path!r}", "paths": [
                         "/metrics", "/snapshot", "/doctor", "/slo",
-                        "/anatomy", "/healthz", "/cluster/snapshot",
-                        "/cluster/doctor", "/cluster/anatomy"]}),
+                        "/anatomy", "/decisions", "/healthz",
+                        "/cluster/snapshot", "/cluster/doctor",
+                        "/cluster/anatomy"]}),
                     "application/json")
         except Exception as e:
             log.debug("live request %s failed", path, exc_info=True)
@@ -243,8 +262,8 @@ class LiveTelemetryServer:
 
 
 def start_from_conf(conf, snapshot_fn, doctor_fn, health_fn,
-                    slo_fn=None,
-                    cluster_fn=None) -> Optional[LiveTelemetryServer]:
+                    slo_fn=None, cluster_fn=None,
+                    decisions_fn=None) -> Optional[LiveTelemetryServer]:
     """Build+start the server from ``metrics.httpPort`` (None when the
     key is unset — off is the default — or the bind fails: a node must
     never fail to BOOT over its observability port, the same rule as the
@@ -260,7 +279,8 @@ def start_from_conf(conf, snapshot_fn, doctor_fn, health_fn,
                         "127.0.0.1")
         return LiveTelemetryServer(snapshot_fn, doctor_fn, health_fn,
                                    port=port, host=host, slo_fn=slo_fn,
-                                   cluster_fn=cluster_fn).start()
+                                   cluster_fn=cluster_fn,
+                                   decisions_fn=decisions_fn).start()
     except Exception as e:
         log.warning("live telemetry server unavailable "
                     "(metrics.httpPort=%r): %s — continuing without a "

@@ -93,6 +93,25 @@ C_REPLAY_MS = "shuffle.replay.ms"              # wall burned by failed tries
 # the watchdog-fenced channel).
 C_AGREE_ROUNDS = "shuffle.agreement.rounds.count"
 C_AGREE_DIVERGENCE = "shuffle.agreement.divergence.count"
+# Decision-plane observability (PR 20, shuffle/decisions.py ledger +
+# agreement.py instrumentation). H_AGREE_ROUND times one FULL agree()
+# round (header + payload gathers) wall-clock; the labeled twin
+# {topic=...} keys the per-topic distribution the slow_proposer /
+# decision-stall diagnoses read. Every exit path — unanimous, reduced,
+# divergent, peer-lost — lands exactly one observation (and one
+# C_AGREE_ROUNDS count, with a labeled {topic=} twin), so per-topic
+# divergence RATIOS are computable from the two labeled families alone.
+H_AGREE_ROUND = "shuffle.agreement.round_ms"
+# Turnstile plane (agreement.CollectiveTurnstile): wait_ms is
+# issue→enter latency per ticket (how long an agreed-order section
+# queued behind earlier tickets); depth is the point-in-time count of
+# issued-but-unreleased tickets (queue depth, set-semantics gauge);
+# abandoned counts tickets released without ever entering (dispatch
+# failure / executor stop) — legal by design, but a surge means the
+# async plane is issuing work it then throws away.
+H_TURNSTILE_WAIT = "shuffle.turnstile.wait_ms"
+G_TURNSTILE_DEPTH = "shuffle.turnstile.depth"
+C_TURNSTILE_ABANDONED = "shuffle.turnstile.abandoned.count"
 
 # Integrity-plane counters (shuffle/integrity.py, shuffle/manager.py
 # verify paths, shuffle/durable.py restart scan): ONE place for the

@@ -114,6 +114,18 @@ def pytest_sessionfinish(session, exitstatus):
                         out, f"history_{os.getpid()}.jsonl"), "w") as f:
                     for fr in frames:
                         f.write(_json.dumps(fr, default=repr) + "\n")
+            # decision ledger beside the history: every agree() round the
+            # failing run settled, in the decisions_*.jsonl shape the
+            # `decisions` CLI discovers — a conf split is auditable from
+            # the artifact alone (python -m sparkucx_tpu decisions
+            # --input <dir>)
+            recs = node.decisions.tail()
+            if recs:
+                import json as _json
+                with open(os.path.join(
+                        out, f"decisions_p{os.getpid()}.jsonl"), "w") as f:
+                    for r in recs:
+                        f.write(_json.dumps(r, default=repr) + "\n")
             if node.slo_objectives:
                 write_snapshot(node.slo_verdict(),
                                os.path.join(out, "slo_verdict.json"))

@@ -143,6 +143,30 @@ def test_fleet_measure_small(mesh8):
     assert deg["processes_answered"] == rec["peers"]
 
 
+def test_decisions_measure_small(mesh8):
+    """The decisions stage's measurement core at a tiny shape: real
+    exchanges timed, ledger append + NULL path + turnstile telemetry
+    microbenched, a real multi-round agree() loop audited against its
+    own ledger. The <1% overhead gate itself is the bench stage's
+    contract (full shape); the deterministic contracts ARE asserted
+    here — the NULL path must be cheaper and the self-audit clean
+    (structure, not load-sensitive timing)."""
+    rec = bench.decisions_measure(exchanges=4, rows_per_map=256,
+                                  maps=2, partitions=4, rounds=6,
+                                  reps=1)
+    assert rec["median_exchange_ms"] > 0
+    assert rec["record_us"] > 0
+    assert rec["null_record_us"] > 0
+    assert rec["ticket_telemetry_us"] >= 0
+    assert rec["null_speedup_x"] > 1.0
+    assert rec["rounds_per_exchange"] == 3
+    # the audit contract is deterministic: every settled round clean
+    # against the ledger's own two-peer self-view
+    assert rec["audit_clean"], rec
+    assert rec["rounds_settled"] == 4 * 6
+    assert rec["audit_splits"] == 0
+
+
 def test_pipeline_measure_small(mesh8):
     """The pipeline stage's measurement core at a tiny shape: both arms
     run, the waved arm waves with a full timeline, the structural
